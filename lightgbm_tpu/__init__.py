@@ -1,0 +1,29 @@
+"""LightGBM-TPU: a TPU-native gradient-boosted decision tree framework.
+
+A brand-new implementation of the capabilities of LightGBM v2.3.2
+(histogram-based leaf-wise GBDT with EFB, GOSS, DART, RF, categorical
+splits, monotone constraints, ranking objectives, and feature/data/voting
+parallel training) designed for TPUs: the binned feature matrix lives in
+HBM, histogram construction / split scan / partitioning are XLA/Pallas
+programs, and distributed training uses mesh collectives instead of the
+reference's socket/MPI collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config
+
+# public API filled in as layers land (engine/Booster/sklearn in later
+# milestones); keep imports lazy-tolerant during bring-up.
+try:
+    from .basic import Booster, Dataset
+    from .engine import cv, train
+except ImportError:  # pragma: no cover - during early bring-up only
+    pass
+
+try:
+    from . import sklearn as sklearn  # noqa: F401
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                          LGBMRegressor)
+except ImportError:  # pragma: no cover
+    pass

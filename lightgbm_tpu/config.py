@@ -1,0 +1,454 @@
+"""Configuration / flag system.
+
+TPU-native re-design of the reference parameter system
+(``include/LightGBM/config.h:32-1081``, ``src/io/config.cpp``,
+``src/io/config_auto.cpp``): a typed dataclass holding every training-time
+parameter with LightGBM-compatible names, defaults and the full alias table,
+plus ``Config.from_params`` (the analog of ``Config::Set``) and
+``check_param_conflict`` (analog of ``Config::CheckParamConflict``).
+
+Unlike the reference there is no code generation step: the dataclass *is* the
+source of truth, and aliases live in ``_PARAM_ALIASES`` below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .utils.log import log_warning
+
+kDefaultNumLeaves = 31
+
+# Alias -> canonical name. Mirrors the generated alias table in
+# src/io/config_auto.cpp (ParameterAlias::KeyAliasTransform).
+_PARAM_ALIASES: Dict[str, str] = {
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective", "app": "objective", "application": "objective",
+    "boosting_type": "boosting", "boost": "boosting",
+    "train": "data", "train_data": "data", "train_data_file": "data",
+    "data_filename": "data",
+    "test": "valid", "valid_data": "valid", "valid_data_file": "valid",
+    "test_data": "valid", "test_data_file": "valid", "valid_filenames": "valid",
+    "num_iteration": "num_iterations", "n_iter": "num_iterations",
+    "num_tree": "num_iterations", "num_trees": "num_iterations",
+    "num_round": "num_iterations", "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations", "n_estimators": "num_iterations",
+    "shrinkage_rate": "learning_rate", "eta": "learning_rate",
+    "num_leaf": "num_leaves", "max_leaves": "num_leaves", "max_leaf": "num_leaves",
+    "tree": "tree_learner", "tree_type": "tree_learner",
+    "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads", "nthread": "num_threads",
+    "nthreads": "num_threads", "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed", "random_state": "seed",
+    "hist_pool_size": "histogram_pool_size",
+    "min_data_per_leaf": "min_data_in_leaf", "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction", "subsample": "bagging_fraction",
+    "bagging": "bagging_fraction",
+    "pos_sub_row": "pos_bagging_fraction", "pos_subsample": "pos_bagging_fraction",
+    "pos_bagging": "pos_bagging_fraction",
+    "neg_sub_row": "neg_bagging_fraction", "neg_subsample": "neg_bagging_fraction",
+    "neg_bagging": "neg_bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction", "colsample_bytree": "feature_fraction",
+    "sub_feature_bynode": "feature_fraction_bynode",
+    "colsample_bynode": "feature_fraction_bynode",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "n_iter_no_change": "early_stopping_round",
+    "max_tree_output": "max_delta_step", "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2", "lambda": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints", "monotone_constraint": "monotone_constraints",
+    "feature_contrib": "feature_contri", "fc": "feature_contri",
+    "fp": "feature_contri", "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename",
+    "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename",
+    "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    "model_input": "input_model", "model_in": "input_model",
+    "model_output": "output_model", "model_out": "output_model",
+    "save_period": "snapshot_freq",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "data_seed": "data_random_seed",
+    "is_sparse": "is_enable_sparse", "enable_sparse": "is_enable_sparse",
+    "sparse": "is_enable_sparse",
+    "is_enable_bundle": "enable_bundle", "bundle": "enable_bundle",
+    "is_pre_partition": "pre_partition",
+    "two_round_loading": "two_round", "use_two_round_loading": "two_round",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column", "group_id": "group_column",
+    "query_column": "group_column", "query": "group_column",
+    "query_id": "group_column",
+    "ignore_feature": "ignore_column", "blacklist": "ignore_column",
+    "cat_feature": "categorical_feature",
+    "categorical_column": "categorical_feature", "cat_column": "categorical_feature",
+    "is_save_binary": "save_binary", "is_save_binary_file": "save_binary",
+    "is_predict_raw_score": "predict_raw_score",
+    "predict_rawscore": "predict_raw_score", "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index",
+    "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib", "contrib": "predict_contrib",
+    "predict_result": "output_result", "prediction_result": "output_result",
+    "predict_name": "output_result", "prediction_name": "output_result",
+    "pred_name": "output_result", "name_pred": "output_result",
+    "convert_model_file": "convert_model",
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance", "unbalanced_sets": "is_unbalance",
+    "metrics": "metric", "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at", "ndcg_at": "eval_at", "eval_at_points": "eval_at",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port", "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename",
+    "machine_list": "machine_list_filename", "mlist": "machine_list_filename",
+    "workers": "machines", "nodes": "machines",
+}
+
+_OBJECTIVE_ALIASES: Dict[str, str] = {
+    # objective-name aliases handled in Config::Set of the reference
+    "regression_l2": "regression", "l2": "regression", "mean_squared_error":
+    "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "l1": "regression_l1", "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+    "lambda_rank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "xentropy": "cross_entropy", "xentlambda": "cross_entropy_lambda",
+    "mean_ap": "map",
+}
+
+_METRIC_ALIASES: Dict[str, str] = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression": "l2",
+    "l2_root": "rmse", "root_mean_squared_error": "rmse", "rmse": "rmse",
+    "quantile": "quantile", "huber": "huber", "fair": "fair",
+    "poisson": "poisson", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    "xendcg": "ndcg", "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg",
+    "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc", "auc_mu": "auc_mu",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss",
+    "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "kldiv": "kullback_leibler", "kullback_leibler": "kullback_leibler",
+    "none": "custom", "null": "custom", "custom": "custom", "na": "custom",
+}
+
+
+def _parse_list(value: Any, typ) -> list:
+    if value is None:
+        return []
+    if isinstance(value, str):
+        if not value:
+            return []
+        return [typ(v) for v in value.replace(";", ",").split(",")]
+    if isinstance(value, (list, tuple)):
+        return [typ(v) for v in value]
+    return [typ(value)]
+
+
+@dataclass
+class Config:
+    """All parameters, LightGBM-compatible names (config.h:32-1081)."""
+
+    # ---- core (config.h:96-232)
+    config: str = ""
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data: str = ""
+    valid: List[str] = field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = kDefaultNumLeaves
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"
+    seed: int = 0
+
+    # ---- learning control (config.h:236-517)
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+
+    # ---- IO (config.h:521-671)
+    verbosity: int = 1
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+    snapshot_freq: int = -1
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: str = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+
+    # ---- predict task (config.h:675-741)
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    output_result: str = "LightGBM_predict_result.txt"
+
+    # ---- convert task (config.h:745-757)
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+
+    # ---- objective (config.h:761-832)
+    objective_seed: int = 5
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 20
+    lambdarank_norm: bool = True
+    label_gain: List[float] = field(default_factory=list)
+
+    # ---- metric (config.h:836-862)
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # ---- network (config.h:866-887); on TPU these select the mesh, not sockets
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # ---- device (config.h:891-918). gpu_* kept as accepted-but-ignored
+    # compatibility aliases; the TPU path replaces the OpenCL learner.
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    # TPU-specific knobs (new in this framework)
+    hist_dtype: str = "float32"        # histogram accumulation dtype
+    n_devices: int = 0                 # 0 = all visible devices
+    mesh_axes: str = "data"            # mesh layout for parallel learners
+
+    # internal, filled by check_param_conflict
+    is_parallel: bool = False
+
+    def __post_init__(self):
+        self.objective = _OBJECTIVE_ALIASES.get(self.objective, self.objective)
+
+    # --- analog of Config::Set (src/io/config.cpp:177-245)
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        params = dict(params or {})
+        known = {f.name: f for f in dataclasses.fields(cls)}
+        kwargs: Dict[str, Any] = {}
+        for raw_key, value in params.items():
+            key = _PARAM_ALIASES.get(raw_key, raw_key)
+            if key not in known:
+                log_warning(f"Unknown parameter: {raw_key}")
+                continue
+            if key in kwargs:
+                log_warning(f"{raw_key} is set with multiple values, "
+                            f"current value kept")
+                continue
+            f = known[key]
+            kwargs[key] = _coerce(value, f)
+        cfg = cls(**kwargs)
+        cfg.check_param_conflict()
+        return cfg
+
+    # --- analog of Config::CheckParamConflict (src/io/config.cpp:261-327)
+    def check_param_conflict(self) -> None:
+        from .utils.log import set_verbosity
+        set_verbosity(self.verbosity)
+        if self.max_bin <= 1:
+            raise ValueError("max_bin should be greater than 1")
+        if self.num_leaves <= 1:
+            raise ValueError("num_leaves should be greater than 1")
+        for name in ("bagging_fraction", "feature_fraction",
+                     "feature_fraction_bynode", "pos_bagging_fraction",
+                     "neg_bagging_fraction"):
+            v = getattr(self, name)
+            if not (0.0 < v <= 1.0):
+                raise ValueError(f"{name} should be in (0.0, 1.0]")
+        if self.learning_rate <= 0.0:
+            raise ValueError("learning_rate should be greater than 0")
+        if self.is_single_machine():
+            self.is_parallel = False
+            if self.tree_learner not in ("serial",) and self.num_machines <= 1 \
+                    and self.n_devices == 1:
+                # single machine, single device -> serial learner
+                self.tree_learner = "serial"
+        else:
+            self.is_parallel = True
+        if self.tree_learner == "feature" and self.bagging_fraction < 1.0:
+            log_warning("Found bagging_fraction with feature parallel; "
+                        "bagging applies to the full data on every shard")
+        if self.boosting == "rf":
+            if not (self.bagging_freq > 0 and 0.0 < self.bagging_fraction < 1.0):
+                raise ValueError(
+                    "Random forest needs bagging_freq > 0 and "
+                    "bagging_fraction in (0, 1)")
+        if self.boosting == "goss" and self.top_rate + self.other_rate > 1.0:
+            raise ValueError("top_rate + other_rate must be <= 1.0 for goss")
+        if self.max_depth > 0:
+            full = 1 << self.max_depth
+            if self.num_leaves == kDefaultNumLeaves or self.num_leaves > full:
+                self.num_leaves = min(self.num_leaves, full)
+        if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
+            raise ValueError("num_class must be >= 2 for multiclass objectives")
+        if self.objective not in ("multiclass", "multiclassova") \
+                and self.num_class != 1:
+            raise ValueError("num_class must be 1 for non-multiclass objectives")
+
+    def is_single_machine(self) -> bool:
+        return self.num_machines <= 1 and not self.machines \
+            and not self.machine_list_filename
+
+    def num_tree_per_iteration(self) -> int:
+        return self.num_class if self.objective in (
+            "multiclass", "multiclassova") else 1
+
+    def resolved_metrics(self) -> List[str]:
+        """Metric list with aliases resolved; empty -> metric of objective."""
+        if not self.metric:
+            default = {
+                "regression": "l2", "regression_l1": "l1", "huber": "huber",
+                "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+                "mape": "mape", "gamma": "gamma", "tweedie": "tweedie",
+                "binary": "binary_logloss",
+                "multiclass": "multi_logloss", "multiclassova": "multi_logloss",
+                "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+                "cross_entropy": "cross_entropy",
+                "cross_entropy_lambda": "cross_entropy_lambda",
+                "custom": "custom", "none": "custom",
+            }.get(self.objective)
+            return [default] if default else []
+        out: List[str] = []
+        for m in self.metric:
+            canon = _METRIC_ALIASES.get(m, m)
+            if canon not in out:
+                out.append(canon)
+        return [m for m in out if m != "custom"] \
+            if any(m != "custom" for m in out) else out
+
+    def to_params(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _coerce(value: Any, f: dataclasses.Field) -> Any:
+    """Typed parse of one parameter value (GetInt/GetDouble/GetBool/GetString)."""
+    typ = f.type
+    is_list = str(typ).startswith("List") or "List" in str(typ)
+    if is_list:
+        elem = int if "int" in str(typ) else (
+            float if "float" in str(typ) else str)
+        return _parse_list(value, elem)
+    if typ in ("bool", bool):
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "+", "yes", "y", "on")
+        return bool(value)
+    if typ in ("int", int):
+        return int(float(value))
+    if typ in ("float", float):
+        return float(value)
+    if isinstance(value, (list, tuple)):
+        return ",".join(str(v) for v in value)
+    return str(value)

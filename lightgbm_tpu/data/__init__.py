@@ -1,0 +1,8 @@
+from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
+                      MISSING_NAN, MISSING_NONE, MISSING_ZERO)
+from .dataset import Dataset, Metadata
+
+__all__ = [
+    "BIN_TYPE_CATEGORICAL", "BIN_TYPE_NUMERICAL", "BinMapper", "MISSING_NAN",
+    "MISSING_NONE", "MISSING_ZERO", "Dataset", "Metadata",
+]

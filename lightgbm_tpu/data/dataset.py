@@ -1,0 +1,373 @@
+"""Constructed (binned) dataset + metadata.
+
+TPU-native analog of the reference ``Dataset``/``Metadata``
+(``include/LightGBM/dataset.h:41-678``, ``src/io/dataset.cpp``,
+``src/io/metadata.cpp``): after binning, the feature matrix is a dense
+``uint8``/``uint16`` array ``[num_data, num_used_features]`` that is shipped
+to TPU HBM verbatim — there are no FeatureGroup objects on device; EFB-style
+bundling (dataset.cpp:97-314) collapses *columns before upload* instead of
+packing bins at access time (see ``lightgbm_tpu/data/bundling.py``).
+
+``Metadata`` mirrors dataset.h:41-249: label / weight / query boundaries /
+query weights / init_score, including query-boundary construction from group
+sizes (metadata.cpp).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_info, log_warning
+from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
+                      MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                      kZeroThreshold)
+
+
+class Metadata:
+    """Labels and side information (dataset.h:41-249)."""
+
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None          # float32 [N]
+        self.weights: Optional[np.ndarray] = None        # float32 [N]
+        self.query_boundaries: Optional[np.ndarray] = None  # int32 [nq+1]
+        self.query_weights: Optional[np.ndarray] = None  # float32 [nq]
+        self.init_score: Optional[np.ndarray] = None     # float64 [N*k]
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).ravel()
+        if self.num_data and len(label) != self.num_data:
+            log_fatal(f"Length of label ({len(label)}) doesn't match "
+                      f"num_data ({self.num_data})")
+        self.label = label
+        self.num_data = len(label)
+
+    def set_weights(self, weights: Optional[Sequence[float]]) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        weights = np.asarray(weights, dtype=np.float32).ravel()
+        if self.num_data and len(weights) != self.num_data:
+            log_fatal(f"Length of weights ({len(weights)}) doesn't match "
+                      f"num_data ({self.num_data})")
+        self.weights = weights
+        self._update_query_weights()
+
+    def set_query(self, group: Optional[Sequence[int]]) -> None:
+        """Set query structure from per-query sizes (the .query-file /
+        set_group convention). Boundary arrays (first element 0, last
+        num_data, nondecreasing) are also accepted when they cannot be
+        row-count vectors."""
+        if group is None:
+            self.query_boundaries = None
+            self.query_weights = None
+            return
+        group = np.asarray(group, dtype=np.int64).ravel()
+        if len(group) == 0:
+            log_fatal("group/query must be non-empty")
+        if group.sum() == self.num_data:
+            boundaries = np.concatenate([[0], np.cumsum(group)])
+        elif group[0] == 0 and group[-1] == self.num_data \
+                and (np.diff(group) >= 0).all():
+            boundaries = group
+        else:
+            log_fatal("Sum of query counts doesn't match num_data")
+        self.query_boundaries = boundaries.astype(np.int32)
+        self._update_query_weights()
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).ravel()
+
+    def _update_query_weights(self) -> None:
+        # metadata.cpp: query weight = mean of member weights
+        if self.weights is not None and self.query_boundaries is not None:
+            qb = self.query_boundaries
+            sums = np.add.reduceat(self.weights, qb[:-1])
+            cnts = np.diff(qb)
+            self.query_weights = (sums / np.maximum(cnts, 1)).astype(
+                np.float32)
+
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None \
+            else len(self.query_boundaries) - 1
+
+    def subset(self, indices: np.ndarray) -> "Metadata":
+        out = Metadata(len(indices))
+        if self.label is not None:
+            out.label = self.label[indices]
+        if self.weights is not None:
+            out.weights = self.weights[indices]
+        if self.init_score is not None:
+            k = len(self.init_score) // max(self.num_data, 1)
+            mat = self.init_score.reshape(k, self.num_data)
+            out.init_score = mat[:, indices].ravel()
+        # queries can't be row-subset arbitrarily; caller handles group data
+        return out
+
+
+class Dataset:
+    """Binned dataset resident as one dense device-ready matrix.
+
+    Reference analog: ``Dataset`` (dataset.h:326-678). Differences by design:
+      * storage is row-major ``[N, F]`` small-int, no per-group Bin objects —
+        the TPU histogram kernel reads the matrix directly;
+      * ``most_freq_bin`` elision (sparse storage) is not used on device; the
+        mapping is kept for model-file parity only.
+    """
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.bin_mappers: List[BinMapper] = []       # per ORIGINAL feature
+        self.used_feature_map: List[int] = []        # orig idx -> inner or -1
+        self.real_feature_idx: List[int] = []        # inner idx -> orig idx
+        self.binned: Optional[np.ndarray] = None     # [N, F_used] uint8/16
+        self.num_total_features: int = 0
+        self.feature_names: List[str] = []
+        self.metadata = Metadata()
+        self.max_bin: int = 255
+        self.bin_construct_sample_cnt: int = 200000
+        self.min_data_in_bin: int = 3
+        self.use_missing: bool = True
+        self.zero_as_missing: bool = False
+        self.monotone_types: List[int] = []
+        self.feature_penalty: List[float] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.real_feature_idx)
+
+    def num_bin(self, inner_feature: int) -> int:
+        return self.bin_mappers[self.real_feature_idx[inner_feature]].num_bin
+
+    def num_bins_array(self) -> np.ndarray:
+        return np.asarray([self.num_bin(f) for f in range(self.num_features)],
+                          dtype=np.int32)
+
+    def feature_mapper(self, inner_feature: int) -> BinMapper:
+        return self.bin_mappers[self.real_feature_idx[inner_feature]]
+
+    def inner_feature_index(self, orig_feature: int) -> int:
+        return self.used_feature_map[orig_feature]
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_numpy(cls, data: np.ndarray, config: Config,
+                   label: Optional[Sequence[float]] = None,
+                   weight: Optional[Sequence[float]] = None,
+                   group: Optional[Sequence[int]] = None,
+                   init_score: Optional[Sequence[float]] = None,
+                   feature_names: Optional[List[str]] = None,
+                   categorical_features: Sequence[int] = (),
+                   forced_bins: Optional[Dict[int, List[float]]] = None,
+                   reference: Optional["Dataset"] = None) -> "Dataset":
+        """Bin a raw feature matrix (CostructFromSampleData,
+        dataset_loader.cpp:528-712, + ExtractFeatures push loop)."""
+        data = np.asarray(data)
+        if data.ndim != 2:
+            log_fatal("Dataset data must be 2-dimensional")
+        n, num_features = data.shape
+        self = cls()
+        self.num_data = n
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        self.bin_construct_sample_cnt = config.bin_construct_sample_cnt
+        self.min_data_in_bin = config.min_data_in_bin
+        self.use_missing = config.use_missing
+        self.zero_as_missing = config.zero_as_missing
+        self.feature_names = feature_names or [
+            f"Column_{i}" for i in range(num_features)]
+
+        if reference is not None:
+            # valid set aligned with train (CreateValid, dataset.cpp:703)
+            self.bin_mappers = reference.bin_mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_feature_idx = reference.real_feature_idx
+            self.max_bin = reference.max_bin
+            self.feature_names = reference.feature_names
+            self.monotone_types = reference.monotone_types
+            self.feature_penalty = reference.feature_penalty
+        else:
+            self._find_bins(data, config, categorical_features, forced_bins)
+            self._resolve_monotone_and_penalty(config)
+
+        self._extract_features(data)
+        self.metadata.num_data = n
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weights(weight)
+        self.metadata.set_query(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    def _find_bins(self, data: np.ndarray, config: Config,
+                   categorical_features: Sequence[int],
+                   forced_bins: Optional[Dict[int, List[float]]]) -> None:
+        n, num_features = data.shape
+        sample_cnt = min(n, self.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed)
+        if sample_cnt < n:
+            sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+        else:
+            sample_idx = np.arange(n)
+        cat_set = set(int(c) for c in categorical_features)
+        # feature_pre_filter uses min_data_in_leaf scaled to the sample
+        filter_cnt = int(max(
+            config.min_data_in_leaf * sample_cnt / max(n, 1), 1)) \
+            if config.feature_pre_filter else 0
+
+        self.bin_mappers = []
+        for j in range(num_features):
+            col = np.asarray(data[sample_idx, j], dtype=np.float64)
+            # sample only non-trivial values like the sparse sampler:
+            # zeros are implicit (counted via total_sample_cnt)
+            nonzero = col[(np.abs(col) > kZeroThreshold) | np.isnan(col)]
+            mapper = BinMapper()
+            bt = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
+            fb = (forced_bins or {}).get(j, ())
+            mapper.find_bin(
+                nonzero, total_sample_cnt=sample_cnt,
+                max_bin=_max_bin_for(config, j),
+                min_data_in_bin=self.min_data_in_bin,
+                min_split_data=filter_cnt,
+                pre_filter=config.feature_pre_filter,
+                bin_type=bt, use_missing=self.use_missing,
+                zero_as_missing=self.zero_as_missing,
+                forced_upper_bounds=fb)
+            self.bin_mappers.append(mapper)
+
+        self.used_feature_map = []
+        self.real_feature_idx = []
+        for j, m in enumerate(self.bin_mappers):
+            if m.is_trivial:
+                self.used_feature_map.append(-1)
+            else:
+                self.used_feature_map.append(len(self.real_feature_idx))
+                self.real_feature_idx.append(j)
+        if not self.real_feature_idx:
+            log_warning("There are no meaningful features, as all feature "
+                        "values are constant.")
+
+    def _resolve_monotone_and_penalty(self, config: Config) -> None:
+        mt = list(config.monotone_constraints)
+        fp = list(config.feature_contri)
+        self.monotone_types = [
+            (mt[j] if j < len(mt) else 0) for j in self.real_feature_idx] \
+            if mt else []
+        self.feature_penalty = [
+            (fp[j] if j < len(fp) else 1.0) for j in self.real_feature_idx] \
+            if fp else []
+
+    def _extract_features(self, data: np.ndarray) -> None:
+        n = data.shape[0]
+        width = max(self.num_features, 1)
+        max_b = max([self.num_bin(f) for f in range(self.num_features)],
+                    default=2)
+        dtype = np.uint8 if max_b <= 256 else np.uint16
+        out = np.zeros((n, width), dtype=dtype)
+        for inner, orig in enumerate(self.real_feature_idx):
+            mapper = self.bin_mappers[orig]
+            out[:, inner] = mapper.values_to_bins(
+                np.asarray(data[:, orig], dtype=np.float64)).astype(dtype)
+        self.binned = out
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data: np.ndarray,
+                     label: Optional[Sequence[float]] = None,
+                     weight: Optional[Sequence[float]] = None,
+                     group: Optional[Sequence[int]] = None,
+                     init_score: Optional[Sequence[float]] = None
+                     ) -> "Dataset":
+        cfg = Config(max_bin=self.max_bin,
+                     bin_construct_sample_cnt=self.bin_construct_sample_cnt,
+                     min_data_in_bin=self.min_data_in_bin,
+                     use_missing=self.use_missing,
+                     zero_as_missing=self.zero_as_missing)
+        return Dataset.from_numpy(data, cfg, label=label, weight=weight,
+                                  group=group, init_score=init_score,
+                                  reference=self)
+
+    def subset(self, indices: np.ndarray) -> "Dataset":
+        """CopySubset (dataset.cpp) for bagging-style row subsets."""
+        indices = np.asarray(indices)
+        out = Dataset()
+        out.__dict__.update({k: v for k, v in self.__dict__.items()
+                             if k not in ("binned", "metadata", "num_data")})
+        out.binned = self.binned[indices]
+        out.num_data = len(indices)
+        out.metadata = self.metadata.subset(indices)
+        return out
+
+    # ------------------------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (SaveBinaryFile, dataset.cpp)."""
+        import json
+        meta = {
+            "mappers": [m.to_dict() for m in self.bin_mappers],
+            "used_feature_map": self.used_feature_map,
+            "real_feature_idx": self.real_feature_idx,
+            "feature_names": self.feature_names,
+            "num_total_features": self.num_total_features,
+            "max_bin": self.max_bin,
+            "min_data_in_bin": self.min_data_in_bin,
+            "use_missing": self.use_missing,
+            "zero_as_missing": self.zero_as_missing,
+        }
+        np.savez_compressed(
+            path, binned=self.binned,
+            label=self.metadata.label if self.metadata.label is not None
+            else np.zeros(0, np.float32),
+            weights=self.metadata.weights
+            if self.metadata.weights is not None else np.zeros(0, np.float32),
+            query_boundaries=self.metadata.query_boundaries
+            if self.metadata.query_boundaries is not None
+            else np.zeros(0, np.int32),
+            init_score=self.metadata.init_score
+            if self.metadata.init_score is not None
+            else np.zeros(0, np.float64),
+            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8))
+        log_info(f"Saved binary dataset to {path}")
+
+    @classmethod
+    def load_binary(cls, path: str) -> "Dataset":
+        import json
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            self = cls()
+            self.bin_mappers = [BinMapper.from_dict(d)
+                                for d in meta["mappers"]]
+            self.used_feature_map = meta["used_feature_map"]
+            self.real_feature_idx = meta["real_feature_idx"]
+            self.feature_names = meta["feature_names"]
+            self.num_total_features = meta["num_total_features"]
+            self.max_bin = meta["max_bin"]
+            self.min_data_in_bin = meta["min_data_in_bin"]
+            self.use_missing = meta["use_missing"]
+            self.zero_as_missing = meta["zero_as_missing"]
+            self.binned = z["binned"]
+            self.num_data = len(self.binned)
+            md = Metadata(self.num_data)
+            if len(z["label"]):
+                md.set_label(z["label"])
+            if len(z["weights"]):
+                md.set_weights(z["weights"])
+            if len(z["query_boundaries"]):
+                md.query_boundaries = z["query_boundaries"]
+                md._update_query_weights()
+            if len(z["init_score"]):
+                md.init_score = z["init_score"]
+            self.metadata = md
+        return self
+
+
+def _max_bin_for(config: Config, feature_idx: int) -> int:
+    if config.max_bin_by_feature \
+            and feature_idx < len(config.max_bin_by_feature):
+        return int(config.max_bin_by_feature[feature_idx])
+    return config.max_bin

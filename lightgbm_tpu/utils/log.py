@@ -1,0 +1,100 @@
+"""Leveled logging (analog of include/LightGBM/utils/log.h:19-132).
+
+``log_fatal`` raises (the reference's ``Log::Fatal`` throws
+std::runtime_error, log.h:99-111); levels map to the ``verbosity`` parameter
+the same way (<0 fatal only, 0 +warning, 1 +info, >1 +debug).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+_LEVEL = 1  # matches default verbosity=1
+
+
+class LightGBMError(RuntimeError):
+    pass
+
+
+def set_verbosity(level: int) -> None:
+    global _LEVEL
+    _LEVEL = level
+
+
+def get_verbosity() -> int:
+    return _LEVEL
+
+
+def _emit(tag: str, msg: str) -> None:
+    sys.stdout.write(f"[LightGBM-TPU] [{tag}] {msg}\n")
+    sys.stdout.flush()
+
+
+def log_debug(msg: str) -> None:
+    if _LEVEL > 1:
+        _emit("Debug", msg)
+
+
+def log_info(msg: str) -> None:
+    if _LEVEL >= 1:
+        _emit("Info", msg)
+
+
+def log_warning(msg: str) -> None:
+    if _LEVEL >= 0:
+        _emit("Warning", msg)
+
+
+def log_fatal(msg: str) -> None:
+    raise LightGBMError(msg)
+
+
+class Timer:
+    """Named accumulating timers (Common::Timer, utils/common.h:1026-1108).
+
+    Opt-in like the reference's -DTIMETAG: enable with ``Timer.enable()``;
+    ``print_all`` mirrors the global_timer atexit dump.
+    """
+
+    _enabled = False
+
+    def __init__(self):
+        self.acc: dict[str, float] = {}
+        self.start: dict[str, float] = {}
+
+    @classmethod
+    def enable(cls, on: bool = True) -> None:
+        cls._enabled = on
+
+    def begin(self, name: str) -> None:
+        if Timer._enabled:
+            self.start[name] = time.perf_counter()
+
+    def end(self, name: str) -> None:
+        if Timer._enabled and name in self.start:
+            self.acc[name] = self.acc.get(name, 0.0) + (
+                time.perf_counter() - self.start.pop(name))
+
+    def scope(self, name: str):
+        return _TimerScope(self, name)
+
+    def print_all(self) -> None:
+        for name, dur in sorted(self.acc.items(), key=lambda kv: -kv[1]):
+            _emit("Info", f"{name} costs {dur:.6f}s")
+
+
+class _TimerScope:
+    def __init__(self, timer: Timer, name: str):
+        self.timer, self.name = timer, name
+
+    def __enter__(self):
+        self.timer.begin(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        self.timer.end(self.name)
+        return False
+
+
+global_timer = Timer()

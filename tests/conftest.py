@@ -1,0 +1,15 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Real-TPU runs are exercised separately by the driver; tests must be
+hermetic and exercise the multi-device sharding paths, so force the CPU
+backend with 8 virtual devices BEFORE jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

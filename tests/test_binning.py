@@ -1,0 +1,182 @@
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data import (BIN_TYPE_CATEGORICAL, BinMapper, Dataset,
+                               MISSING_NAN, MISSING_NONE, MISSING_ZERO)
+
+
+def _fit_mapper(values, total=None, max_bin=255, **kw):
+    values = np.asarray(values, dtype=np.float64)
+    total = total if total is not None else len(values)
+    nonzero = values[(np.abs(values) > 1e-35) | np.isnan(values)]
+    m = BinMapper()
+    m.find_bin(nonzero, total_sample_cnt=total, max_bin=max_bin,
+               min_data_in_bin=1, min_split_data=0, pre_filter=False, **kw)
+    return m
+
+
+def test_simple_numerical_bins():
+    vals = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0] * 10)
+    m = _fit_mapper(vals)
+    assert not m.is_trivial
+    assert m.missing_type == MISSING_NONE
+    bins = m.values_to_bins(np.asarray([1.0, 2.0, 3.0, 4.0, 5.0]))
+    # distinct values with plenty of bins -> distinct bins, ordered
+    assert len(set(bins.tolist())) == 5
+    assert all(bins[i] < bins[i + 1] for i in range(4))
+
+
+def test_bin_boundaries_are_monotone():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(5000)
+    m = _fit_mapper(vals, max_bin=63)
+    b = [x for x in m.bin_upper_bound if not math.isnan(x)]
+    assert all(b[i] < b[i + 1] for i in range(len(b) - 1))
+    assert m.num_bin <= 63
+    # mapping respects boundaries
+    bins = m.values_to_bins(vals)
+    for i in range(0, 5000, 97):
+        v = vals[i]
+        assert v <= m.bin_upper_bound[bins[i]]
+        if bins[i] > 0:
+            assert v > m.bin_upper_bound[bins[i] - 1]
+
+
+def test_zero_gets_own_bin():
+    vals = np.concatenate([np.zeros(50), np.linspace(1, 10, 50),
+                           np.linspace(-10, -1, 50)])
+    m = _fit_mapper(vals)
+    zb = m.value_to_bin(0.0)
+    assert m.value_to_bin(1.0) != zb
+    assert m.value_to_bin(-1.0) != zb
+    assert m.default_bin == zb
+
+
+def test_nan_missing_type():
+    vals = np.concatenate([np.linspace(0, 1, 90), [np.nan] * 10])
+    m = _fit_mapper(vals)
+    assert m.missing_type == MISSING_NAN
+    nan_bin = m.values_to_bins(np.asarray([np.nan]))[0]
+    assert nan_bin == m.num_bin - 1
+
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.zeros(50), np.linspace(1, 10, 50)])
+    m = _fit_mapper(vals, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_nan_disabled_use_missing():
+    vals = np.concatenate([np.linspace(0, 1, 90), [np.nan] * 10])
+    m = _fit_mapper(vals, use_missing=False)
+    assert m.missing_type == MISSING_NONE
+    # NaN maps to the zero bin when missing disabled (ValueToBin, bin.h:504)
+    assert m.values_to_bins(np.asarray([np.nan]))[0] == m.value_to_bin(0.0)
+
+
+def test_max_bin_respected():
+    rng = np.random.RandomState(1)
+    vals = rng.randn(10000)
+    for mb in (2, 15, 63, 255):
+        m = _fit_mapper(vals, max_bin=mb)
+        assert m.num_bin <= mb
+
+
+def test_big_count_value_gets_own_bin():
+    # one value holds half the data -> must sit alone in a bin
+    vals = np.concatenate([np.full(500, 7.0),
+                           np.linspace(100, 200, 500)])
+    m = _fit_mapper(vals, max_bin=16)
+    b7 = m.value_to_bin(7.0)
+    assert m.value_to_bin(6.9) <= b7
+    assert m.value_to_bin(100.0) > b7
+
+
+def test_categorical_bins():
+    rng = np.random.RandomState(2)
+    vals = rng.choice([3, 5, 9, 42], size=1000, p=[0.5, 0.3, 0.15, 0.05])
+    m = _fit_mapper(vals.astype(float), bin_type=BIN_TYPE_CATEGORICAL)
+    assert m.bin_type == BIN_TYPE_CATEGORICAL
+    # most frequent category gets bin 0 (count-sorted)
+    assert m.values_to_bins(np.asarray([3.0]))[0] == 0
+    assert m.values_to_bins(np.asarray([5.0]))[0] == 1
+    # unseen category -> last bin
+    assert m.values_to_bins(np.asarray([77.0]))[0] == m.num_bin - 1
+    # bin_to_value round trip
+    assert m.bin_to_value(0) == 3.0
+
+
+def test_trivial_constant_feature():
+    m = _fit_mapper(np.full(100, 3.25))
+    assert not m.is_trivial  # 2 bins: zero-side and the value
+    m2 = _fit_mapper(np.zeros(100))
+    assert m2.is_trivial
+
+
+def test_forced_bins():
+    vals = np.linspace(1, 100, 1000)
+    m = _fit_mapper(vals, forced_upper_bounds=[25.0, 50.0])
+    assert 25.0 in m.bin_upper_bound
+    assert 50.0 in m.bin_upper_bound
+    assert m.value_to_bin(24.0) != m.value_to_bin(26.0)
+
+
+def test_dataset_construction():
+    rng = np.random.RandomState(3)
+    X = rng.randn(500, 10)
+    X[:, 3] = 0.0  # trivial
+    y = rng.rand(500)
+    cfg = Config.from_params({"max_bin": 63, "min_data_in_bin": 1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    assert ds.num_data == 500
+    assert ds.num_features == 9  # trivial feature dropped
+    assert ds.used_feature_map[3] == -1
+    assert ds.binned.shape == (500, 9)
+    assert ds.binned.dtype == np.uint8
+    assert ds.metadata.label is not None
+    nb = ds.num_bins_array()
+    assert (nb <= 63).all()
+    assert (ds.binned.max(axis=0) < nb).all()
+
+
+def test_dataset_valid_alignment():
+    rng = np.random.RandomState(4)
+    X = rng.randn(300, 5)
+    cfg = Config.from_params({"max_bin": 31})
+    ds = Dataset.from_numpy(X, cfg, label=rng.rand(300))
+    Xv = rng.randn(100, 5)
+    dv = ds.create_valid(Xv, label=rng.rand(100))
+    assert dv.num_features == ds.num_features
+    # same mapper object -> same binning of same values
+    same = ds.feature_mapper(0).values_to_bins(Xv[:, 0])
+    assert (dv.binned[:, 0] == same).all()
+
+
+def test_dataset_binary_roundtrip(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.randn(200, 4)
+    cfg = Config.from_params({"max_bin": 31})
+    ds = Dataset.from_numpy(X, cfg, label=rng.rand(200),
+                            weight=rng.rand(200))
+    p = str(tmp_path / "cache.npz")
+    ds.save_binary(p)
+    ds2 = Dataset.load_binary(p)
+    assert (ds2.binned == ds.binned).all()
+    np.testing.assert_allclose(ds2.metadata.label, ds.metadata.label)
+    np.testing.assert_allclose(ds2.metadata.weights, ds.metadata.weights)
+    assert ds2.feature_mapper(0).bin_upper_bound \
+        == ds.feature_mapper(0).bin_upper_bound
+
+
+def test_metadata_query_boundaries():
+    from lightgbm_tpu.data import Metadata
+    md = Metadata(10)
+    md.set_label(np.arange(10))
+    md.set_query([3, 3, 4])
+    assert md.query_boundaries.tolist() == [0, 3, 6, 10]
+    assert md.num_queries() == 3
+    md.set_weights(np.ones(10))
+    assert md.query_weights.tolist() == [1.0, 1.0, 1.0]

@@ -1,0 +1,81 @@
+import pytest
+
+from lightgbm_tpu.config import Config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.num_leaves == 31
+    assert cfg.learning_rate == 0.1
+    assert cfg.max_bin == 255
+    assert cfg.objective == "regression"
+    assert cfg.boosting == "gbdt"
+
+
+def test_aliases():
+    cfg = Config.from_params({
+        "n_estimators": 50, "eta": "0.3", "num_leaf": 15,
+        "min_child_samples": 5, "colsample_bytree": 0.8,
+        "reg_alpha": 1.5, "reg_lambda": 2.0, "subsample": 0.9,
+        "random_state": 42, "application": "binary",
+    })
+    assert cfg.num_iterations == 50
+    assert cfg.learning_rate == 0.3
+    assert cfg.num_leaves == 15
+    assert cfg.min_data_in_leaf == 5
+    assert cfg.feature_fraction == 0.8
+    assert cfg.lambda_l1 == 1.5
+    assert cfg.lambda_l2 == 2.0
+    assert cfg.bagging_fraction == 0.9
+    assert cfg.seed == 42
+    assert cfg.objective == "binary"
+
+
+def test_objective_aliases():
+    assert Config.from_params({"objective": "mse"}).objective == "regression"
+    assert Config.from_params({"objective": "mae"}).objective \
+        == "regression_l1"
+    assert Config.from_params(
+        {"objective": "xentropy"}).objective == "cross_entropy"
+
+
+def test_bool_and_list_parse():
+    cfg = Config.from_params({
+        "is_unbalance": "true", "metric": "auc,binary_logloss",
+        "eval_at": "1,3,5", "monotone_constraints": "1,-1,0",
+    })
+    assert cfg.is_unbalance is True
+    assert cfg.metric == ["auc", "binary_logloss"]
+    assert cfg.eval_at == [1, 3, 5]
+    assert cfg.monotone_constraints == [1, -1, 0]
+
+
+def test_max_depth_caps_leaves():
+    cfg = Config.from_params({"max_depth": 3})
+    assert cfg.num_leaves == 8
+    cfg = Config.from_params({"max_depth": 3, "num_leaves": 6})
+    assert cfg.num_leaves == 6
+
+
+def test_rf_requires_bagging():
+    with pytest.raises(ValueError):
+        Config.from_params({"boosting": "rf"})
+    cfg = Config.from_params(
+        {"boosting": "rf", "bagging_freq": 1, "bagging_fraction": 0.8})
+    assert cfg.boosting == "rf"
+
+
+def test_metric_resolution():
+    assert Config.from_params({"objective": "binary"}).resolved_metrics() \
+        == ["binary_logloss"]
+    cfg = Config.from_params({"objective": "binary", "metric": "auc"})
+    assert cfg.resolved_metrics() == ["auc"]
+    cfg = Config.from_params({"metric": ["l2", "mse", "rmse"]})
+    assert cfg.resolved_metrics() == ["l2", "rmse"]
+
+
+def test_num_class_validation():
+    with pytest.raises(ValueError):
+        Config.from_params({"objective": "multiclass"})
+    cfg = Config.from_params({"objective": "multiclass", "num_class": 3})
+    assert cfg.num_tree_per_iteration() == 3
