@@ -394,7 +394,8 @@ class Config:
                 self.num_leaves = min(self.num_leaves, full)
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objectives")
-        if self.objective not in ("multiclass", "multiclassova") \
+        if self.objective not in ("multiclass", "multiclassova", "custom",
+                                  "none", "null", "na") \
                 and self.num_class != 1:
             raise ValueError("num_class must be 1 for non-multiclass objectives")
 

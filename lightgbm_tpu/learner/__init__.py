@@ -1,0 +1,3 @@
+from .serial import SerialTreeLearner
+
+__all__ = ["SerialTreeLearner"]

@@ -1,0 +1,376 @@
+"""Serial (single-device) leaf-wise tree learner.
+
+Reference analog: ``SerialTreeLearner``
+(``src/treelearner/serial_tree_learner.cpp:29-782``). The whole
+``num_leaves-1`` grow loop compiles to ONE XLA program
+(``lax.while_loop``): per step it
+  * picks the open leaf with the best cached split gain
+    (``Train`` serial_tree_learner.cpp:145-192),
+  * applies the split to the ``leaf_id[N]`` vector (index-free partition,
+    replacing DataPartition::Split),
+  * builds the histogram of the SMALLER child only and derives the larger
+    sibling by subtraction (the smaller/larger-leaf trick,
+    serial_tree_learner.cpp:434-436),
+  * runs the vectorized best-split scan for both children and caches the
+    results per leaf.
+
+All state (leaf_id, histogram cache, per-leaf sums and split candidates,
+tree arrays) stays on device; the host only launches one fused program per
+tree. The histogram cache holds every open leaf (the reference's
+HistogramPool LRU exists to bound host RAM; HBM capacity makes a full
+cache the right TPU default).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.binning import (BIN_TYPE_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                            MISSING_ZERO)
+from ..data.dataset import Dataset
+from ..models.tree import Tree, TreeArrays
+from ..ops.histogram import build_histogram, make_ghc
+from ..ops.partition import split_leaf
+from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
+                         MISSING_ZERO_CODE, FeatureMeta, SplitParams,
+                         best_split_numerical)
+
+_MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
+                 MISSING_ZERO: MISSING_ZERO_CODE,
+                 MISSING_NAN: MISSING_NAN_CODE}
+
+
+def feature_meta_from_dataset(dataset: Dataset,
+                              config: Config) -> FeatureMeta:
+    """Build the static per-feature metadata arrays."""
+    f = dataset.num_features
+    num_bins = dataset.num_bins_array()
+    missing = np.asarray(
+        [_MISSING_CODE[dataset.feature_mapper(i).missing_type]
+         for i in range(f)], np.int32)
+    default_bin = np.asarray(
+        [dataset.feature_mapper(i).default_bin for i in range(f)], np.int32)
+    most_freq = np.asarray(
+        [dataset.feature_mapper(i).most_freq_bin for i in range(f)],
+        np.int32)
+    is_cat = np.asarray(
+        [dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
+         for i in range(f)], bool)
+    monotone = np.asarray(dataset.monotone_types, np.int32) \
+        if dataset.monotone_types else np.zeros(f, np.int32)
+    penalty = np.asarray(dataset.feature_penalty, np.float32) \
+        if dataset.feature_penalty else np.ones(f, np.float32)
+    return FeatureMeta(
+        num_bins=jnp.asarray(num_bins), missing=jnp.asarray(missing),
+        default_bin=jnp.asarray(default_bin),
+        most_freq_bin=jnp.asarray(most_freq),
+        monotone=jnp.asarray(monotone), penalty=jnp.asarray(penalty),
+        is_categorical=jnp.asarray(is_cat))
+
+
+def split_params_from_config(config: Config) -> SplitParams:
+    return SplitParams(
+        lambda_l1=float(config.lambda_l1),
+        lambda_l2=float(config.lambda_l2),
+        max_delta_step=float(config.max_delta_step),
+        min_data_in_leaf=float(config.min_data_in_leaf),
+        min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+        min_gain_to_split=float(config.min_gain_to_split),
+        max_cat_threshold=int(config.max_cat_threshold),
+        cat_l2=float(config.cat_l2),
+        cat_smooth=float(config.cat_smooth),
+        max_cat_to_onehot=int(config.max_cat_to_onehot),
+        min_data_per_group=float(config.min_data_per_group))
+
+
+class GrowResult(NamedTuple):
+    tree: TreeArrays
+    leaf_id: object  # i32 [N]
+
+
+class SerialTreeLearner:
+    """Owns the device copy of the dataset and the compiled grow program."""
+
+    def __init__(self, dataset: Dataset, config: Config,
+                 hist_method: str = "auto"):
+        self.dataset = dataset
+        self.config = config
+        self.meta = feature_meta_from_dataset(dataset, config)
+        self.params = split_params_from_config(config)
+        self.binned = jnp.asarray(dataset.binned)
+        self.num_bins_max = int(dataset.num_bins_array().max(initial=2))
+        self.num_leaves = int(config.num_leaves)
+        self.max_depth = int(config.max_depth)
+        self.hist_method = hist_method
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag_weight: Optional[jnp.ndarray] = None,
+              feature_mask: Optional[jnp.ndarray] = None) -> GrowResult:
+        if bag_weight is None:
+            bag_weight = jnp.ones_like(grad)
+        if feature_mask is None:
+            feature_mask = jnp.ones((self.dataset.num_features,), bool)
+        # module-level jit: learners with equal shapes/params share the
+        # compiled executable (tests and per-class trainers hit the cache)
+        return _grow_jit(self.binned, grad, hess, bag_weight, feature_mask,
+                         self.meta, params=self.params,
+                         num_leaves=self.num_leaves,
+                         max_depth=self.max_depth,
+                         num_bins_max=self.num_bins_max,
+                         hist_method=self.hist_method)
+
+    def to_host_tree(self, result: GrowResult,
+                     shrinkage: float = 1.0) -> Tree:
+        tree = Tree(jax.device_get(result.tree), dataset=self.dataset)
+        if shrinkage != 1.0:
+            tree.shrink(shrinkage)
+        return tree
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "num_leaves", "max_depth",
+                              "num_bins_max", "hist_method"))
+def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta, *,
+              params, num_leaves, max_depth, num_bins_max, hist_method):
+    return grow_tree(binned, grad, hess, bag_weight, feature_mask,
+                     meta=meta, params=params, num_leaves=num_leaves,
+                     max_depth=max_depth, num_bins_max=num_bins_max,
+                     hist_method=hist_method)
+
+
+def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
+              meta: FeatureMeta, params: SplitParams, num_leaves: int,
+              max_depth: int, num_bins_max: int,
+              hist_method: str) -> GrowResult:
+    """One full leaf-wise tree; jit-compiled once per shape."""
+    n, num_features = binned.shape
+    big_l = num_leaves
+    b = num_bins_max
+
+    ghc = make_ghc(grad, hess, bag_weight)
+    root_hist = build_histogram(binned, ghc, b, method=hist_method)
+    root_sums = ghc.sum(axis=0)
+    root_g, root_h, root_c = root_sums[0], root_sums[1], root_sums[2]
+
+    inf = jnp.float32(jnp.inf)
+
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax):
+        res = best_split_numerical(hist, g, h, c, meta, params,
+                                   constraint_min=cmin, constraint_max=cmax,
+                                   feature_mask=feature_mask)
+        blocked = (max_depth > 0) & (depth >= max_depth)
+        return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+
+    root_split = scan_leaf(root_hist, root_g, root_h, root_c,
+                           jnp.int32(0), -inf, inf)
+
+    def at0(arr, val):
+        return arr.at[0].set(val)
+
+    from ..ops.split import leaf_output_no_constraint
+    root_out = leaf_output_no_constraint(
+        root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
+        params.max_delta_step)
+
+    state = dict(
+        k=jnp.int32(1),
+        leaf_id=jnp.zeros((n,), jnp.int32),
+        hist=at0(jnp.zeros((big_l, num_features, b, 3), jnp.float32),
+                 root_hist),
+        leaf_g=at0(jnp.zeros((big_l,), jnp.float32), root_g),
+        leaf_h=at0(jnp.zeros((big_l,), jnp.float32), root_h),
+        leaf_c=at0(jnp.zeros((big_l,), jnp.float32), root_c),
+        # cached best split per open leaf
+        bs_gain=at0(jnp.full((big_l,), -jnp.inf), root_split.gain),
+        bs_feat=at0(jnp.zeros((big_l,), jnp.int32), root_split.feature),
+        bs_thr=at0(jnp.zeros((big_l,), jnp.int32), root_split.threshold),
+        bs_dleft=at0(jnp.zeros((big_l,), bool), root_split.default_left),
+        bs_lg=at0(jnp.zeros((big_l,), jnp.float32), root_split.left_g),
+        bs_lh=at0(jnp.zeros((big_l,), jnp.float32), root_split.left_h),
+        bs_lc=at0(jnp.zeros((big_l,), jnp.float32), root_split.left_c),
+        bs_lout=at0(jnp.zeros((big_l,), jnp.float32),
+                    root_split.left_output),
+        bs_rout=at0(jnp.zeros((big_l,), jnp.float32),
+                    root_split.right_output),
+        bs_iscat=at0(jnp.zeros((big_l,), bool), root_split.is_cat),
+        bs_bitset=at0(jnp.zeros((big_l, MAX_CAT_WORDS), jnp.uint32),
+                      root_split.cat_bitset),
+        # pointer-fixing bookkeeping: which node references each leaf
+        ref_node=jnp.full((big_l,), -1, jnp.int32),
+        ref_side=jnp.zeros((big_l,), jnp.int32),
+        # per-leaf monotone output bounds (LeafConstraints,
+        # monotone_constraints.hpp:32-66)
+        leaf_cmin=jnp.full((big_l,), -jnp.inf, jnp.float32),
+        leaf_cmax=jnp.full((big_l,), jnp.inf, jnp.float32),
+        # tree arrays
+        split_feature=jnp.zeros((big_l - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((big_l - 1,), jnp.int32),
+        decision_type=jnp.zeros((big_l - 1,), jnp.int32),
+        left_child=jnp.zeros((big_l - 1,), jnp.int32),
+        right_child=jnp.zeros((big_l - 1,), jnp.int32),
+        split_gain_arr=jnp.zeros((big_l - 1,), jnp.float32),
+        internal_value=jnp.zeros((big_l - 1,), jnp.float32),
+        internal_weight=jnp.zeros((big_l - 1,), jnp.float32),
+        internal_count=jnp.zeros((big_l - 1,), jnp.float32),
+        cat_bitsets=jnp.zeros((big_l - 1, MAX_CAT_WORDS), jnp.uint32),
+        leaf_value=at0(jnp.zeros((big_l,), jnp.float32), root_out),
+        leaf_weight=at0(jnp.zeros((big_l,), jnp.float32), root_h),
+        leaf_count=at0(jnp.zeros((big_l,), jnp.float32), root_c),
+        leaf_parent=jnp.full((big_l,), -1, jnp.int32),
+        leaf_depth=jnp.zeros((big_l,), jnp.int32),
+    )
+
+    leaf_range = jnp.arange(big_l)
+
+    def cond(st):
+        open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
+        return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
+
+    def body(st):
+        k = st["k"]
+        open_gain = jnp.where(leaf_range < k, st["bs_gain"], -jnp.inf)
+        leaf = jnp.argmax(open_gain).astype(jnp.int32)
+        new = k
+        s = k - 1  # internal node index for this split
+
+        feat = st["bs_feat"][leaf]
+        thr = st["bs_thr"][leaf]
+        dleft = st["bs_dleft"][leaf]
+        gain = st["bs_gain"][leaf]
+        is_cat = st["bs_iscat"][leaf]
+        bitset = st["bs_bitset"][leaf]
+        lg, lh, lc = st["bs_lg"][leaf], st["bs_lh"][leaf], st["bs_lc"][leaf]
+        pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
+            st["leaf_c"][leaf]
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+
+        # ---- partition rows of `leaf` ---------------------------------
+        bin_col = jnp.take(binned, feat, axis=1)
+        leaf_id = split_leaf(
+            st["leaf_id"], bin_col, leaf, new, thr, dleft,
+            meta.missing[feat], meta.default_bin[feat],
+            meta.num_bins[feat], is_cat, bitset)
+
+        # ---- tree arrays ---------------------------------------------
+        dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
+        upd = st["ref_node"][leaf] >= 0
+        pnode = jnp.where(upd, st["ref_node"][leaf], 0)
+        pside = st["ref_side"][leaf]
+        left_child = st["left_child"].at[pnode].set(
+            jnp.where(upd & (pside == 0), s, st["left_child"][pnode]))
+        right_child = st["right_child"].at[pnode].set(
+            jnp.where(upd & (pside == 1), s, st["right_child"][pnode]))
+        left_child = left_child.at[s].set(~leaf)
+        right_child = right_child.at[s].set(~new)
+
+        depth = st["leaf_depth"][leaf] + 1
+        parent_out = leaf_output_no_constraint(
+            pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
+            params.max_delta_step)
+
+        # ---- histograms: smaller child built, sibling by subtraction --
+        parent_hist = st["hist"][leaf]
+        small = jnp.where(lc <= rc, leaf, new)
+        ghc_small = ghc * (leaf_id == small).astype(jnp.float32)[:, None]
+        hist_small = build_histogram(binned, ghc_small, b,
+                                     method=hist_method)
+        hist_other = parent_hist - hist_small
+        left_small = lc <= rc
+        hist_left = jnp.where(left_small, hist_small, hist_other)
+        hist_right = jnp.where(left_small, hist_other, hist_small)
+
+        # ---- monotone constraint propagation -------------------------
+        # (LeafConstraints::UpdateConstraints monotone_constraints.hpp:44)
+        mono = meta.monotone[feat]
+        mid = (lout + rout) * 0.5
+        pcmin, pcmax = st["leaf_cmin"][leaf], st["leaf_cmax"][leaf]
+        numerical = ~is_cat
+        cmin_l = jnp.where(numerical & (mono < 0),
+                           jnp.maximum(pcmin, mid), pcmin)
+        cmax_l = jnp.where(numerical & (mono > 0),
+                           jnp.minimum(pcmax, mid), pcmax)
+        cmin_r = jnp.where(numerical & (mono > 0),
+                           jnp.maximum(pcmin, mid), pcmin)
+        cmax_r = jnp.where(numerical & (mono < 0),
+                           jnp.minimum(pcmax, mid), pcmax)
+
+        # ---- child best splits ---------------------------------------
+        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l)
+        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r)
+
+        def set2(arr, va, vb):
+            return arr.at[leaf].set(va).at[new].set(vb)
+
+        st2 = dict(st)
+        st2.update(
+            k=k + 1,
+            leaf_id=leaf_id,
+            hist=st["hist"].at[leaf].set(hist_left).at[new].set(hist_right),
+            leaf_g=set2(st["leaf_g"], lg, rg),
+            leaf_h=set2(st["leaf_h"], lh, rh),
+            leaf_c=set2(st["leaf_c"], lc, rc),
+            bs_gain=set2(st["bs_gain"], split_l.gain, split_r.gain),
+            bs_feat=set2(st["bs_feat"], split_l.feature, split_r.feature),
+            bs_thr=set2(st["bs_thr"], split_l.threshold, split_r.threshold),
+            bs_dleft=set2(st["bs_dleft"], split_l.default_left,
+                          split_r.default_left),
+            bs_lg=set2(st["bs_lg"], split_l.left_g, split_r.left_g),
+            bs_lh=set2(st["bs_lh"], split_l.left_h, split_r.left_h),
+            bs_lc=set2(st["bs_lc"], split_l.left_c, split_r.left_c),
+            bs_lout=set2(st["bs_lout"], split_l.left_output,
+                         split_r.left_output),
+            bs_rout=set2(st["bs_rout"], split_l.right_output,
+                         split_r.right_output),
+            bs_iscat=set2(st["bs_iscat"], split_l.is_cat, split_r.is_cat),
+            bs_bitset=set2(st["bs_bitset"], split_l.cat_bitset,
+                           split_r.cat_bitset),
+            ref_node=set2(st["ref_node"], s, s),
+            ref_side=set2(st["ref_side"], 0, 1),
+            leaf_cmin=set2(st["leaf_cmin"], cmin_l, cmin_r),
+            leaf_cmax=set2(st["leaf_cmax"], cmax_l, cmax_r),
+            split_feature=st["split_feature"].at[s].set(feat),
+            threshold_bin=st["threshold_bin"].at[s].set(thr),
+            decision_type=st["decision_type"].at[s].set(dec),
+            left_child=left_child,
+            right_child=right_child,
+            split_gain_arr=st["split_gain_arr"].at[s].set(gain),
+            internal_value=st["internal_value"].at[s].set(parent_out),
+            internal_weight=st["internal_weight"].at[s].set(ph),
+            internal_count=st["internal_count"].at[s].set(pc),
+            cat_bitsets=st["cat_bitsets"].at[s].set(bitset),
+            leaf_value=set2(st["leaf_value"], lout, rout),
+            leaf_weight=set2(st["leaf_weight"], lh, rh),
+            leaf_count=set2(st["leaf_count"], lc, rc),
+            leaf_parent=set2(st["leaf_parent"], s, s),
+            leaf_depth=set2(st["leaf_depth"], depth, depth),
+        )
+        return st2
+
+    st = jax.lax.while_loop(cond, body, state)
+
+    tree = TreeArrays(
+        num_leaves=st["k"],
+        split_feature=st["split_feature"],
+        threshold_bin=st["threshold_bin"],
+        decision_type=st["decision_type"],
+        left_child=st["left_child"],
+        right_child=st["right_child"],
+        split_gain=st["split_gain_arr"],
+        internal_value=st["internal_value"],
+        internal_weight=st["internal_weight"],
+        internal_count=st["internal_count"],
+        leaf_value=st["leaf_value"],
+        leaf_weight=st["leaf_weight"],
+        leaf_count=st["leaf_count"],
+        leaf_parent=st["leaf_parent"],
+        leaf_depth=st["leaf_depth"],
+        cat_bitsets=st["cat_bitsets"],
+    )
+    return GrowResult(tree=tree, leaf_id=st["leaf_id"])

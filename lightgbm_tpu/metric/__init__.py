@@ -1,0 +1,3 @@
+from .metrics import Metric, create_metric, create_metrics
+
+__all__ = ["Metric", "create_metric", "create_metrics"]

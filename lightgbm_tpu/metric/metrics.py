@@ -1,0 +1,365 @@
+"""Evaluation metrics.
+
+Reference analog: ``src/metric/*.hpp`` (factory ``metric.cpp:16-63``).
+Point-wise losses are vectorized numpy; each metric reports
+``factor_to_bigger_better`` exactly like the reference (metric.h) so early
+stopping can normalize directions. Metrics receive RAW scores and the
+objective (for ConvertOutput), mirroring ``Metric::Eval(score, objective)``.
+
+Ranking metrics (ndcg/map) live in ``rank_metrics.py`` (M2).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal
+
+kEpsilon = 1e-15
+_LOG_EPS = 1.0e-12
+
+
+def _xent_loss(label, prob):
+    """XentLoss (xentropy_metric.hpp:35-50) with log-arg clipping."""
+    a = label * np.log(np.maximum(prob, _LOG_EPS))
+    b = (1.0 - label) * np.log(np.maximum(1.0 - prob, _LOG_EPS))
+    return -(a + b)
+
+
+class Metric:
+    """Base: subclasses define name, bigger_better, eval()."""
+
+    factor_to_bigger_better = -1.0  # smaller is better by default
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.label: Optional[np.ndarray] = None
+        self.weights: Optional[np.ndarray] = None
+        self.sum_weights = 0.0
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = None if metadata.label is None \
+            else np.asarray(metadata.label, np.float64)
+        self.weights = None if metadata.weights is None \
+            else np.asarray(metadata.weights, np.float64)
+        self.sum_weights = float(num_data) if self.weights is None \
+            else float(self.weights.sum())
+
+    @property
+    def names(self) -> List[str]:
+        return [self.name]
+
+    def eval(self, score: np.ndarray, objective) -> List[float]:
+        raise NotImplementedError
+
+    # helper: converted predictions
+    def _convert(self, score, objective):
+        if objective is None:
+            return score
+        import jax.numpy as jnp
+        return np.asarray(objective.convert_output(jnp.asarray(score)))
+
+    def _average(self, loss_per_point) -> float:
+        if self.weights is None:
+            return float(loss_per_point.sum() / self.sum_weights)
+        return float((loss_per_point * self.weights).sum()
+                     / self.sum_weights)
+
+
+class _PointwiseRegressionMetric(Metric):
+    """RegressionMetric<T> (regression_metric.hpp:21-117)."""
+
+    def eval(self, score, objective):
+        pred = self._convert(score, objective)
+        return [self._finalize(self._average(
+            self._loss(self.label, pred.astype(np.float64))))]
+
+    def _finalize(self, avg: float) -> float:
+        return avg
+
+    def _loss(self, label, pred):
+        raise NotImplementedError
+
+
+class L2Metric(_PointwiseRegressionMetric):
+    name = "l2"
+
+    def _loss(self, label, pred):
+        return (pred - label) ** 2
+
+
+class RMSEMetric(_PointwiseRegressionMetric):
+    name = "rmse"
+
+    def _loss(self, label, pred):
+        return (pred - label) ** 2
+
+    def _finalize(self, avg):
+        return float(np.sqrt(avg))
+
+
+class L1Metric(_PointwiseRegressionMetric):
+    name = "l1"
+
+    def _loss(self, label, pred):
+        return np.abs(pred - label)
+
+
+class QuantileMetric(_PointwiseRegressionMetric):
+    name = "quantile"
+
+    def _loss(self, label, pred):
+        delta = label - pred
+        alpha = self.config.alpha
+        return np.where(delta < 0, (alpha - 1.0) * delta, alpha * delta)
+
+
+class HuberLossMetric(_PointwiseRegressionMetric):
+    name = "huber"
+
+    def _loss(self, label, pred):
+        diff = pred - label
+        a = self.config.alpha
+        return np.where(np.abs(diff) <= a, 0.5 * diff * diff,
+                        a * (np.abs(diff) - 0.5 * a))
+
+
+class FairLossMetric(_PointwiseRegressionMetric):
+    name = "fair"
+
+    def _loss(self, label, pred):
+        x = np.abs(pred - label)
+        c = self.config.fair_c
+        return c * x - c * c * np.log(1.0 + x / c)
+
+
+class PoissonMetric(_PointwiseRegressionMetric):
+    name = "poisson"
+
+    def _loss(self, label, pred):
+        pred = np.maximum(pred, 1e-10)
+        return pred - label * np.log(pred)
+
+
+class MAPEMetric(_PointwiseRegressionMetric):
+    name = "mape"
+
+    def _loss(self, label, pred):
+        return np.abs(label - pred) / np.maximum(1.0, np.abs(label))
+
+
+class GammaMetric(_PointwiseRegressionMetric):
+    name = "gamma"
+
+    def _loss(self, label, pred):
+        # negative gamma log-likelihood with psi=1
+        # (regression_metric.hpp:261-268 reduces to label/pred + log(pred))
+        return label / pred + np.log(np.maximum(pred, kEpsilon))
+
+
+class GammaDevianceMetric(_PointwiseRegressionMetric):
+    name = "gamma_deviance"
+
+    def _loss(self, label, pred):
+        tmp = label / (pred + 1e-9)
+        return tmp - np.log(np.maximum(tmp, kEpsilon)) - 1.0
+
+    def eval(self, score, objective):
+        pred = self._convert(score, objective)
+        loss = self._loss(self.label, pred.astype(np.float64))
+        total = loss.sum() if self.weights is None \
+            else (loss * self.weights).sum()
+        return [float(total * 2)]  # AverageLoss: sum * 2, no averaging
+
+
+class TweedieMetric(_PointwiseRegressionMetric):
+    name = "tweedie"
+
+    def _loss(self, label, pred):
+        rho = self.config.tweedie_variance_power
+        pred = np.maximum(pred, 1e-10)
+        a = label * np.exp((1 - rho) * np.log(pred)) / (1 - rho)
+        b = np.exp((2 - rho) * np.log(pred)) / (2 - rho)
+        return -a + b
+
+
+class BinaryLoglossMetric(Metric):
+    """binary_metric.hpp:115-130."""
+    name = "binary_logloss"
+
+    def eval(self, score, objective):
+        prob = self._convert(score, objective).astype(np.float64)
+        y = (self.label > 0).astype(np.float64)
+        return [self._average(_xent_loss(y, prob))]
+
+
+class BinaryErrorMetric(Metric):
+    """binary_metric.hpp:133-150: error if prob > 0.5 mismatches label."""
+    name = "binary_error"
+
+    def eval(self, score, objective):
+        prob = self._convert(score, objective).astype(np.float64)
+        pred_pos = prob > 0.5
+        actual_pos = self.label > 0
+        return [self._average((pred_pos != actual_pos).astype(np.float64))]
+
+
+class AUCMetric(Metric):
+    """Weighted AUC with tie handling (binary_metric.hpp:153-250)."""
+    name = "auc"
+    factor_to_bigger_better = 1.0
+
+    def eval(self, score, objective):
+        score = np.asarray(score, np.float64).ravel()
+        label = self.label
+        w = np.ones_like(label) if self.weights is None else self.weights
+        pos = (label > 0).astype(np.float64) * w
+        neg = (label <= 0).astype(np.float64) * w
+        order = np.argsort(-score, kind="stable")
+        s = score[order]
+        pos = pos[order]
+        neg = neg[order]
+        # group by equal score: accumulate neg*(cur_pos/2 + sum_pos_before)
+        boundaries = np.concatenate([[True], s[1:] != s[:-1]])
+        gid = np.cumsum(boundaries) - 1
+        ng = gid[-1] + 1
+        pos_g = np.zeros(ng)
+        neg_g = np.zeros(ng)
+        np.add.at(pos_g, gid, pos)
+        np.add.at(neg_g, gid, neg)
+        sum_pos_before = np.concatenate([[0.0], np.cumsum(pos_g)[:-1]])
+        accum = float((neg_g * (pos_g * 0.5 + sum_pos_before)).sum())
+        total_pos = float(pos_g.sum())
+        total_neg = float(neg_g.sum())
+        if total_pos <= 0 or total_neg <= 0:
+            return [1.0]
+        return [accum / (total_pos * total_neg)]
+
+
+class MultiLoglossMetric(Metric):
+    """multiclass_metric.hpp MultiSoftmaxLoglossMetric."""
+    name = "multi_logloss"
+
+    def eval(self, score, objective):
+        prob = self._convert(score, objective).astype(np.float64)
+        lbl = self.label.astype(np.int64)
+        p = prob[np.arange(len(lbl)), lbl]
+        loss = -np.log(np.maximum(p, kEpsilon))
+        return [self._average(loss)]
+
+
+class MultiErrorMetric(Metric):
+    """top-k error (multiclass_metric.hpp, multi_error_top_k)."""
+    name = "multi_error"
+
+    def eval(self, score, objective):
+        prob = self._convert(score, objective).astype(np.float64)
+        lbl = self.label.astype(np.int64)
+        k = max(1, int(self.config.multi_error_top_k))
+        p_true = prob[np.arange(len(lbl)), lbl]
+        # error when the true class prob is not within the top k
+        # (ties resolved optimistically, like the reference's count of
+        # classes with prob > p_true)
+        rank = (prob > p_true[:, None]).sum(axis=1)
+        return [self._average((rank >= k).astype(np.float64))]
+
+    @property
+    def names(self):
+        return [self.name]
+
+
+class CrossEntropyMetric(Metric):
+    """xentropy_metric.hpp:71-160."""
+    name = "cross_entropy"
+
+    def eval(self, score, objective):
+        prob = self._convert(score, objective).astype(np.float64)
+        return [self._average(_xent_loss(self.label, prob))]
+
+
+class CrossEntropyLambdaMetric(Metric):
+    """xentropy_metric.hpp:166-245: intensity-weighted; weights enter the
+    loss itself, final division is by num_data."""
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective):
+        score = np.asarray(score, np.float64).ravel()
+        if objective is not None:
+            import jax.numpy as jnp
+            hhat = np.asarray(objective.convert_output(jnp.asarray(score)),
+                              np.float64)
+        else:
+            hhat = np.log1p(np.exp(score))
+        w = np.ones_like(hhat) if self.weights is None else self.weights
+        prob = 1.0 - np.exp(-w * hhat)
+        loss = _xent_loss(self.label, prob)
+        return [float(loss.sum() / self.num_data)]
+
+
+class KullbackLeiblerDivergence(Metric):
+    """xentropy_metric.hpp:249-330: cross-entropy plus label entropy."""
+    name = "kullback_leibler"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        p = self.label
+        hp = np.where(p > 0, p * np.log(np.maximum(p, kEpsilon)), 0.0) \
+            + np.where(1 - p > 0,
+                       (1 - p) * np.log(np.maximum(1 - p, kEpsilon)), 0.0)
+        if self.weights is not None:
+            hp = hp * self.weights
+        self.presum_label_entropy = float(hp.sum() / self.sum_weights)
+
+    def eval(self, score, objective):
+        prob = self._convert(score, objective).astype(np.float64)
+        xent = self._average(_xent_loss(self.label, prob))
+        return [xent + self.presum_label_entropy]
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    """Factory (metric.cpp:16-63)."""
+    from .rank_metrics import MapMetric, NDCGMetric
+    from .multiclass_extra import AucMuMetric
+    table = {
+        "l2": L2Metric, "mean_squared_error": L2Metric, "mse": L2Metric,
+        "rmse": RMSEMetric, "l2_root": RMSEMetric,
+        "l1": L1Metric, "mean_absolute_error": L1Metric, "mae": L1Metric,
+        "quantile": QuantileMetric,
+        "huber": HuberLossMetric,
+        "fair": FairLossMetric,
+        "poisson": PoissonMetric,
+        "mape": MAPEMetric,
+        "gamma": GammaMetric,
+        "gamma_deviance": GammaDevianceMetric,
+        "tweedie": TweedieMetric,
+        "binary_logloss": BinaryLoglossMetric,
+        "binary_error": BinaryErrorMetric,
+        "auc": AUCMetric,
+        "auc_mu": AucMuMetric,
+        "multi_logloss": MultiLoglossMetric,
+        "multi_error": MultiErrorMetric,
+        "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
+        "cross_entropy_lambda": CrossEntropyLambdaMetric,
+        "xentlambda": CrossEntropyLambdaMetric,
+        "kullback_leibler": KullbackLeiblerDivergence,
+        "kldiv": KullbackLeiblerDivergence,
+        "ndcg": NDCGMetric, "map": MapMetric,
+    }
+    if name in ("custom", "none", "null", "na", ""):
+        return None
+    if name not in table:
+        log_fatal(f"Unknown metric type name: {name}")
+    return table[name](config)
+
+
+def create_metrics(names, config: Config) -> List[Metric]:
+    out = []
+    for n in names:
+        m = create_metric(n, config)
+        if m is not None:
+            out.append(m)
+    return out

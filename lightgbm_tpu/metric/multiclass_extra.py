@@ -1,0 +1,18 @@
+"""auc_mu multiclass AUC metric (M2).
+
+Reference analog: ``src/metric/multiclass_metric.hpp:200+``.
+"""
+
+from __future__ import annotations
+
+from ..utils.log import log_fatal
+from .metrics import Metric
+
+
+class AucMuMetric(Metric):
+    name = "auc_mu"
+    factor_to_bigger_better = 1.0
+
+    def init(self, metadata, num_data):
+        log_fatal("auc_mu metric lands in M2 "
+                  "(multiclass_metric.hpp:200+ port)")

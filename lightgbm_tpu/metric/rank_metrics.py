@@ -1,0 +1,26 @@
+"""Ranking metrics: NDCG@k and MAP@k (M2).
+
+Reference analog: ``src/metric/rank_metric.hpp`` +
+``src/metric/dcg_calculator.cpp`` and ``src/metric/map_metric.hpp``.
+"""
+
+from __future__ import annotations
+
+from ..utils.log import log_fatal
+from .metrics import Metric
+
+
+class NDCGMetric(Metric):
+    name = "ndcg"
+    factor_to_bigger_better = 1.0
+
+    def init(self, metadata, num_data):
+        log_fatal("ndcg metric lands in M2 (rank_metric.hpp port)")
+
+
+class MapMetric(Metric):
+    name = "map"
+    factor_to_bigger_better = 1.0
+
+    def init(self, metadata, num_data):
+        log_fatal("map metric lands in M2 (map_metric.hpp port)")

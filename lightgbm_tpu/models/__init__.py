@@ -1,0 +1,4 @@
+from .gbdt import GBDT
+from .tree import Tree, TreeArrays
+
+__all__ = ["GBDT", "Tree", "TreeArrays"]

@@ -1,0 +1,445 @@
+"""GBDT boosting driver.
+
+Reference analog: ``GBDT`` (``src/boosting/gbdt.cpp:42-780``, ``gbdt.h``).
+The host orchestrates iterations; each tree is one fused XLA program
+(learner), gradients are one jitted function of the score, and scores
+live on device between iterations. Host work per iteration is O(1) plus
+optional metric evaluation.
+
+Covered here: init wiring (gbdt.cpp:42-120), TrainOneIter with
+boost-from-average / bagging / per-class trees / renewal / shrinkage /
+score update / constant-tree fallback (gbdt.cpp:301-419), RollbackOneIter
+(gbdt.cpp:421-437), eval + early stopping (gbdt.cpp:439-542), bagging
+(gbdt.cpp:163-243). DART/GOSS/RF subclass this in ``variants.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import Dataset
+from ..metric import create_metrics
+from ..objective import create_objective
+from ..utils.log import log_fatal, log_info, log_warning
+from .tree import Tree
+
+kEpsilon = 1e-15
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree driver."""
+
+    def __init__(self, config: Config, train_data: Optional[Dataset],
+                 objective=None, hist_method: str = "auto"):
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective if objective is not None \
+            else create_objective(config)
+        self.num_class = int(config.num_class)
+        self.num_tree_per_iteration = (
+            self.objective.num_model_per_iteration
+            if self.objective is not None else self.num_class)
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.shrinkage_rate = float(config.learning_rate)
+        self.best_iter: Dict = {}
+        self.best_score: Dict = {}
+        self.best_msg: Dict = {}
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self.valid_metrics: List[list] = []
+        self.valid_scores: List[jnp.ndarray] = []
+        self.training_metrics: list = []
+        self._grad_fn = None
+        self.evals_result: Dict[str, Dict[str, list]] = {}
+
+        if train_data is not None:
+            self._setup_train(train_data, hist_method)
+
+    # ------------------------------------------------------------------
+    def _setup_train(self, train_data: Dataset, hist_method: str) -> None:
+        from ..learner.serial import SerialTreeLearner
+        cfg = self.config
+        self.learner = SerialTreeLearner(train_data, cfg,
+                                         hist_method=hist_method)
+        self.num_data = train_data.num_data
+        if self.objective is not None:
+            self.objective.init(train_data.metadata, self.num_data)
+            self._grad_fn = jax.jit(self.objective.gradients)
+        k = self.num_tree_per_iteration
+        init = train_data.metadata.init_score
+        if init is not None:
+            arr = np.asarray(init, np.float64)
+            if arr.size == self.num_data * k:
+                score0 = arr.reshape(k, self.num_data).T
+            else:
+                score0 = np.tile(arr[:, None], (1, k))
+            self._has_init_score = True
+        else:
+            score0 = np.zeros((self.num_data, k))
+            self._has_init_score = False
+        self.train_score = jnp.asarray(score0, jnp.float32)
+        self.class_need_train = [
+            self.objective.class_need_train(i)
+            if self.objective is not None
+            and hasattr(self.objective, "class_need_train") else True
+            for i in range(k)]
+        if cfg.is_provide_training_metric:
+            self.training_metrics = create_metrics(
+                cfg.resolved_metrics(), cfg)
+            for m in self.training_metrics:
+                m.init(train_data.metadata, self.num_data)
+        self._bag_rng = np.random.RandomState(cfg.bagging_seed)
+        self.bag_weight: Optional[jnp.ndarray] = None
+        self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid_data: Dataset, name: str) -> None:
+        metrics = create_metrics(self.config.resolved_metrics(), self.config)
+        for m in metrics:
+            m.init(valid_data.metadata, valid_data.num_data)
+        self.valid_sets.append(valid_data)
+        self.valid_names.append(name)
+        self.valid_metrics.append(metrics)
+        k = self.num_tree_per_iteration
+        init = valid_data.metadata.init_score
+        if init is not None:
+            arr = np.asarray(init, np.float64)
+            if arr.size == valid_data.num_data * k:
+                score0 = arr.reshape(k, valid_data.num_data).T
+            else:
+                score0 = np.tile(arr[:, None], (1, k))
+        else:
+            score0 = np.zeros((valid_data.num_data, k))
+        self.valid_scores.append(jnp.asarray(score0, jnp.float32))
+
+    # ------------------------------------------------------------------
+    # Bagging (gbdt.cpp:163-243): TPU-style = weight mask, not subset copy
+    def _bagging_weight(self, it: int) -> Optional[jnp.ndarray]:
+        cfg = self.config
+        need = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0
+            or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+        if not need:
+            return None
+        if it % cfg.bagging_freq != 0 and self.bag_weight is not None:
+            return self.bag_weight
+        n = self.num_data
+        if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+            # balanced bagging (gbdt.cpp BaggingHelper balanced path)
+            label = np.asarray(self.train_data.metadata.label)
+            pos = label > 0
+            mask = np.zeros(n, np.float32)
+            mask[pos] = (self._bag_rng.rand(int(pos.sum()))
+                         < cfg.pos_bagging_fraction)
+            mask[~pos] = (self._bag_rng.rand(int((~pos).sum()))
+                          < cfg.neg_bagging_fraction)
+        else:
+            mask = (self._bag_rng.rand(n)
+                    < cfg.bagging_fraction).astype(np.float32)
+        self.bag_weight = jnp.asarray(mask)
+        return self.bag_weight
+
+    def _feature_mask(self) -> Optional[jnp.ndarray]:
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return None
+        f = self.train_data.num_features
+        used = max(1, int(round(f * frac)))
+        idx = self._feature_rng.choice(f, used, replace=False)
+        mask = np.zeros(f, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def boost_from_average(self, class_id: int) -> float:
+        """gbdt.cpp:312-335."""
+        cfg = self.config
+        if self.models or self._has_init_score or self.objective is None:
+            return 0.0
+        if cfg.boost_from_average or self.train_data.num_features == 0:
+            init_score = float(self.objective.boost_from_score(class_id))
+            if abs(init_score) > kEpsilon:
+                self.train_score = self.train_score.at[:, class_id].add(
+                    init_score)
+                for i in range(len(self.valid_scores)):
+                    self.valid_scores[i] = \
+                        self.valid_scores[i].at[:, class_id].add(init_score)
+                log_info(f"Start training from score {init_score:.6f}")
+                return init_score
+        elif self.objective.name() in ("regression_l1", "quantile", "mape"):
+            log_warning(
+                f"Disabling boost_from_average in {self.objective.name()} "
+                "may cause the slow convergence")
+        return 0.0
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """Returns True when training should STOP (no more valid splits),
+        mirroring GBDT::TrainOneIter (gbdt.cpp:337-419)."""
+        k = self.num_tree_per_iteration
+        init_scores = [0.0] * k
+        if gradients is None or hessians is None:
+            for tid in range(k):
+                init_scores[tid] = self.boost_from_average(tid)
+            score = self.train_score if k > 1 else self.train_score[:, 0]
+            grad, hess = self._grad_fn(score)
+            if k == 1:
+                grad = grad[:, None]
+                hess = hess[:, None]
+        else:
+            grad = _coerce_custom_grad(gradients, self.num_data, k)
+            hess = _coerce_custom_grad(hessians, self.num_data, k)
+
+        bag = self._bagging_weight(self.iter)
+        fmask = self._feature_mask()
+
+        should_continue = False
+        new_trees: List[Tree] = []
+        for tid in range(k):
+            tree = None
+            if self.class_need_train[tid] \
+                    and self.train_data.num_features > 0:
+                result = self.learner.train(grad[:, tid], hess[:, tid],
+                                            bag_weight=bag,
+                                            feature_mask=fmask)
+                tree = self.learner.to_host_tree(result)
+            if tree is not None and tree.num_leaves > 1:
+                should_continue = True
+                self._renew_tree_output(tree, result, tid)
+                tree.shrink(self.shrinkage_rate)
+                self._update_scores(tree, result, tid)
+                if abs(init_scores[tid]) > kEpsilon:
+                    tree.add_bias(init_scores[tid])
+            else:
+                # constant-tree fallback, first iteration only
+                output = 0.0
+                if len(self.models) < k:
+                    if not self.class_need_train[tid]:
+                        if self.objective is not None:
+                            output = float(
+                                self.objective.boost_from_score(tid))
+                    else:
+                        output = init_scores[tid]
+                    self.train_score = \
+                        self.train_score.at[:, tid].add(output)
+                    for i in range(len(self.valid_scores)):
+                        self.valid_scores[i] = \
+                            self.valid_scores[i].at[:, tid].add(output)
+                tree = _constant_tree(output)
+            new_trees.append(tree)
+
+        if not should_continue:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            # keep first-iteration constant trees, drop later no-op trees
+            # (gbdt.cpp:407-415)
+            if len(self.models) == 0:
+                self.models.extend(new_trees)
+            return True
+        self.models.extend(new_trees)
+        self.iter += 1
+        return False
+
+    def _renew_tree_output(self, tree: Tree, result, tid: int) -> None:
+        """L1-family leaf refit (serial_tree_learner.cpp:720-758).
+
+        Like the reference, the refit only sees in-bag rows (the
+        data_partition holds bagged indices only); out-of-bag rows are
+        masked out of the per-leaf percentiles here.
+        """
+        if self.objective is None or not getattr(
+                self.objective, "is_renew_tree_output", False):
+            return
+        score = np.asarray(self.train_score[:, tid], np.float64)
+        leaf_id = np.asarray(result.leaf_id)
+        if self.bag_weight is not None:
+            bag = np.asarray(self.bag_weight)
+            leaf_id = np.where(bag > 0, leaf_id, -1)  # OOB rows: no leaf
+        new_vals = self.objective.renew_tree_output(
+            score, leaf_id, tree.num_leaves, tree.leaf_value)
+        if new_vals is not None:
+            tree.leaf_value = np.asarray(new_vals,
+                                         np.float64)[:tree.num_leaves]
+
+    def _update_scores(self, tree: Tree, result, tid: int) -> None:
+        # train: leaf_id gather (no traversal), incl. out-of-bag rows
+        leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
+        add = leaf_vals[result.leaf_id]
+        self.train_score = self.train_score.at[:, tid].add(add)
+        # valid: bin-space traversal
+        for i, vd in enumerate(self.valid_sets):
+            vadd = tree.predict_binned(vd.binned)
+            self.valid_scores[i] = self.valid_scores[i].at[:, tid].add(
+                jnp.asarray(vadd, jnp.float32))
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """gbdt.cpp:421-437."""
+        if self.iter <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for tid in range(k):
+            tree = self.models[-k + tid]
+            tree.shrink(-1.0)
+            add = jnp.asarray(tree.leaf_value, jnp.float32)
+            if self.train_data is not None:
+                tadd = tree.predict_binned(self.train_data.binned)
+                self.train_score = self.train_score.at[:, tid].add(
+                    jnp.asarray(tadd, jnp.float32))
+            for i, vd in enumerate(self.valid_sets):
+                vadd = tree.predict_binned(vd.binned)
+                self.valid_scores[i] = self.valid_scores[i].at[:, tid].add(
+                    jnp.asarray(vadd, jnp.float32))
+        del self.models[-k:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
+        """All (dataset_name, metric_name, value, bigger_better) tuples."""
+        out = []
+        for m in self.training_metrics:
+            vals = m.eval(np.asarray(self._metric_score(self.train_score)),
+                          self.objective)
+            for name, v in zip(m.names, vals):
+                out.append(("training", name, v,
+                            m.factor_to_bigger_better > 0))
+        for i, metrics in enumerate(self.valid_metrics):
+            sc = self._metric_score(self.valid_scores[i])
+            for m in metrics:
+                vals = m.eval(np.asarray(sc), self.objective)
+                for name, v in zip(m.names, vals):
+                    out.append((self.valid_names[i], name, v,
+                                m.factor_to_bigger_better > 0))
+        return out
+
+    def _metric_score(self, score: jnp.ndarray):
+        return score if self.num_tree_per_iteration > 1 else score[:, 0]
+
+    def output_metric(self, it: int) -> str:
+        """OutputMetric (gbdt.cpp:484-542): prints, tracks best, returns
+        non-empty best message when early stopping is met."""
+        cfg = self.config
+        need_output = cfg.metric_freq > 0 and it % cfg.metric_freq == 0
+        es_round = cfg.early_stopping_round
+        ret = ""
+        msg_lines = []
+        results = self.eval_metrics()
+        first_metric_seen: Dict[str, bool] = {}
+        for ds_name, mname, value, bigger in results:
+            line = f"Iteration:{it}, {ds_name} {mname} : {value:g}"
+            if need_output:
+                log_info(line)
+            msg_lines.append(line)
+            self.evals_result.setdefault(ds_name, {}).setdefault(
+                mname, []).append(value)
+            if ds_name == "training" or es_round <= 0:
+                continue
+            if cfg.first_metric_only and first_metric_seen.get(ds_name):
+                continue
+            first_metric_seen[ds_name] = True
+            key = (ds_name, mname)
+            cur = value if bigger else -value
+            if key not in self.best_score or cur > self.best_score[key]:
+                self.best_score[key] = cur
+                self.best_iter[key] = it
+                self.best_msg[key] = "\n".join(msg_lines)
+            elif not ret and it - self.best_iter[key] >= es_round:
+                ret = self.best_msg[key]
+        return ret
+
+    def train(self, num_iterations: Optional[int] = None) -> None:
+        """Full training loop (GBDT::Train, gbdt.cpp:245-264)."""
+        iters = num_iterations if num_iterations is not None \
+            else self.config.num_iterations
+        for it in range(self.iter, iters):
+            stop = self.train_one_iter()
+            if stop:
+                break
+            if self._eval_and_check_early_stopping():
+                break
+
+    def _eval_and_check_early_stopping(self) -> bool:
+        best_msg = self.output_metric(self.iter)
+        if best_msg:
+            es = self.config.early_stopping_round
+            log_info(f"Early stopping at iteration {self.iter}, the best "
+                     f"iteration round is {self.iter - es}")
+            log_info(f"Output of best iteration round:\n{best_msg}")
+            del self.models[-es * self.num_tree_per_iteration:]
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    @property
+    def num_iterations_trained(self) -> int:
+        return len(self.models) // self.num_tree_per_iteration
+
+    def predict_raw(self, data: np.ndarray,
+                    num_iteration: int = -1) -> np.ndarray:
+        """PredictRaw (gbdt_prediction.cpp:13-31) over raw features."""
+        data = np.asarray(data, np.float64)
+        n = data.shape[0]
+        k = self.num_tree_per_iteration
+        used = len(self.models) if num_iteration < 0 else min(
+            num_iteration * k, len(self.models))
+        out = np.zeros((n, k))
+        for i in range(used):
+            out[:, i % k] += self.models[i].predict(data)
+        return out if k > 1 else out[:, 0]
+
+    def predict(self, data: np.ndarray,
+                num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(data, num_iteration)
+        if self.objective is not None:
+            return np.asarray(
+                self.objective.convert_output(jnp.asarray(raw)))
+        return raw
+
+
+def _coerce_custom_grad(arr, num_data: int, k: int) -> jnp.ndarray:
+    """Accept [N], [N, K], [K, N] or reference-flat [K*N] layouts."""
+    a = np.asarray(arr, np.float32)
+    if a.ndim == 1:
+        if a.size == num_data:
+            a = a[:, None]
+        elif a.size == num_data * k:
+            a = a.reshape(k, num_data).T  # reference K contiguous blocks
+        else:
+            log_fatal(f"custom gradient length {a.size} does not match "
+                      f"num_data*num_class {num_data * k}")
+    elif a.shape == (k, num_data):
+        a = a.T
+    if a.shape != (num_data, k):
+        log_fatal(f"custom gradient shape {a.shape} invalid")
+    return jnp.asarray(a)
+
+
+def _constant_tree(output: float) -> Tree:
+    """Tree::AsConstantTree (tree.h:191-201)."""
+    from .tree import TreeArrays
+    import numpy as _np
+    arrays = TreeArrays(
+        num_leaves=_np.int32(1),
+        split_feature=_np.zeros(1, _np.int32),
+        threshold_bin=_np.zeros(1, _np.int32),
+        decision_type=_np.zeros(1, _np.int32),
+        left_child=_np.zeros(1, _np.int32),
+        right_child=_np.zeros(1, _np.int32),
+        split_gain=_np.zeros(1, _np.float32),
+        internal_value=_np.zeros(1, _np.float32),
+        internal_weight=_np.zeros(1, _np.float32),
+        internal_count=_np.zeros(1, _np.float32),
+        leaf_value=_np.full(1, output, _np.float32),
+        leaf_weight=_np.zeros(1, _np.float32),
+        leaf_count=_np.zeros(1, _np.float32),
+        leaf_parent=_np.full(1, -1, _np.int32),
+        leaf_depth=_np.zeros(1, _np.int32),
+        cat_bitsets=_np.zeros((1, 8), _np.uint32))
+    return Tree(arrays)

@@ -1,0 +1,262 @@
+"""Decision-tree model: device arrays during training, host object after.
+
+Reference analog: ``class Tree`` (include/LightGBM/tree.h:25-564,
+src/io/tree.cpp). Same flat-array representation and node-numbering
+convention so the LightGBM model text format round-trips:
+
+  * internal node ``s`` is created by the ``s``-th split (0-based);
+  * child pointers >= 0 reference internal nodes, negative values ``~leaf``
+    reference leaves (tree.h left_child_/right_child_);
+  * ``decision_type`` bitfield: bit0 = categorical, bit1 = default_left,
+    bits 2-3 = missing type (tree.h:19-20, 220-239).
+
+During training the same arrays live on device inside the jitted grow loop
+(`TreeArrays`), then are copied out into a host `Tree`.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from ..data.binning import (BIN_TYPE_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                            MISSING_ZERO)
+from ..ops.split import MAX_CAT_WORDS
+
+kCategoricalMask = 1
+kDefaultLeftMask = 2
+
+_MISSING_TYPE_CODE = {MISSING_NONE: 0, MISSING_ZERO: 1, MISSING_NAN: 2}
+_MISSING_TYPE_NAME = {v: k for k, v in _MISSING_TYPE_CODE.items()}
+
+
+class TreeArrays(NamedTuple):
+    """Device-resident tree during/after the jitted grow loop.
+
+    Sizes: L = max leaves; L-1 internal-node slots.
+    """
+    num_leaves: object          # i32 scalar
+    split_feature: object       # i32 [L-1] (inner feature index)
+    threshold_bin: object       # i32 [L-1]
+    decision_type: object       # i32 [L-1] bitfield (cat | default_left)
+    left_child: object          # i32 [L-1] (>=0 node, <0 => ~leaf)
+    right_child: object         # i32 [L-1]
+    split_gain: object          # f32 [L-1]
+    internal_value: object      # f32 [L-1] (output of node as a leaf)
+    internal_weight: object     # f32 [L-1] (sum_hessian)
+    internal_count: object      # f32 [L-1]
+    leaf_value: object          # f32 [L]
+    leaf_weight: object         # f32 [L]
+    leaf_count: object          # f32 [L]
+    leaf_parent: object         # i32 [L]
+    leaf_depth: object          # i32 [L]
+    cat_bitsets: object         # u32 [L-1, MAX_CAT_WORDS] left-side bins
+
+
+class Tree:
+    """Host-side tree (numpy arrays), prediction + serialization."""
+
+    def __init__(self, arrays: TreeArrays, dataset=None,
+                 shrinkage: float = 1.0):
+        a = arrays
+        self.num_leaves = int(a.num_leaves)
+        n = max(self.num_leaves - 1, 1)
+        self.split_feature_inner = np.asarray(
+            a.split_feature, dtype=np.int32)[:n]
+        self.threshold_bin = np.asarray(a.threshold_bin, np.int32)[:n]
+        self.decision_type = np.asarray(a.decision_type, np.int32)[:n]
+        self.left_child = np.asarray(a.left_child, np.int32)[:n]
+        self.right_child = np.asarray(a.right_child, np.int32)[:n]
+        self.split_gain = np.asarray(a.split_gain, np.float32)[:n]
+        self.internal_value = np.asarray(a.internal_value, np.float64)[:n]
+        self.internal_weight = np.asarray(a.internal_weight, np.float64)[:n]
+        self.internal_count = np.asarray(
+            a.internal_count, np.float64)[:n].astype(np.int64)
+        ll = self.num_leaves
+        self.leaf_value = np.asarray(a.leaf_value, np.float64)[:ll]
+        self.leaf_weight = np.asarray(a.leaf_weight, np.float64)[:ll]
+        self.leaf_count = np.asarray(
+            a.leaf_count, np.float64)[:ll].astype(np.int64)
+        self.leaf_parent = np.asarray(a.leaf_parent, np.int32)[:ll]
+        self.leaf_depth = np.asarray(a.leaf_depth, np.int32)[:ll]
+        self.cat_bitsets = np.asarray(a.cat_bitsets, np.uint32)[:n]
+        self.shrinkage = float(shrinkage)
+
+        # raw-value thresholds + real feature indices resolved from dataset
+        if self.num_leaves > 1 and dataset is not None:
+            self.split_feature = np.asarray(
+                [dataset.real_feature_idx[f]
+                 for f in self.split_feature_inner], np.int32)
+            self.threshold = np.asarray([
+                _bin_threshold_to_value(dataset, f_inner, t)
+                for f_inner, t in zip(self.split_feature_inner,
+                                      self.threshold_bin)], np.float64)
+            # per-node missing type from the mapper
+            self._missing_code = np.asarray([
+                _MISSING_TYPE_CODE[dataset.feature_mapper(f).missing_type]
+                for f in self.split_feature_inner], np.int32)
+            self._num_bin = np.asarray(
+                [dataset.feature_mapper(f).num_bin
+                 for f in self.split_feature_inner], np.int32)
+            self._default_bin = np.asarray(
+                [dataset.feature_mapper(f).default_bin
+                 for f in self.split_feature_inner], np.int32)
+            # categorical: raw category values on the left side
+            self.cat_threshold: List[np.ndarray] = []
+            for i in range(len(self.split_feature_inner)):
+                if self.decision_type[i] & kCategoricalMask:
+                    mapper = dataset.feature_mapper(
+                        int(self.split_feature_inner[i]))
+                    cats = _bitset_to_cats(self.cat_bitsets[i], mapper)
+                    self.cat_threshold.append(cats)
+                else:
+                    self.cat_threshold.append(np.zeros(0, np.int64))
+        else:
+            self.split_feature = self.split_feature_inner.copy()
+            self.threshold = np.zeros(len(self.split_feature), np.float64)
+            self._missing_code = np.zeros(len(self.split_feature), np.int32)
+            self._num_bin = np.zeros(len(self.split_feature), np.int32)
+            self._default_bin = np.zeros(len(self.split_feature), np.int32)
+            self.cat_threshold = [np.zeros(0, np.int64)
+                                  for _ in self.split_feature]
+
+    # ------------------------------------------------------------------
+    def shrink(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:164-172)."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        """Tree::AddBias (tree.h:180-189)."""
+        self.leaf_value = self.leaf_value + val
+        self.internal_value = self.internal_value + val
+        self.shrinkage = 1.0
+
+    def default_left(self, node: int) -> bool:
+        return bool(self.decision_type[node] & kDefaultLeftMask)
+
+    def is_categorical(self, node: int) -> bool:
+        return bool(self.decision_type[node] & kCategoricalMask)
+
+    def missing_type(self, node: int) -> str:
+        return _MISSING_TYPE_NAME[int(self._missing_code[node])]
+
+    # ------------------------------------------------------------------
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        """Batch raw-feature prediction (Tree::Predict, tree.h:476)."""
+        return self.leaf_value[self.predict_leaf_index(data)]
+
+    def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
+        n = data.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, np.int32)
+        node = np.zeros(n, np.int32)
+        out = np.full(n, -1, np.int32)
+        active = np.ones(n, bool)
+        for _ in range(self.num_leaves):  # depth bound
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            go_left = self._decide(data[idx], nd)
+            child = np.where(go_left, self.left_child[nd],
+                             self.right_child[nd])
+            is_leaf = child < 0
+            out[idx[is_leaf]] = ~child[is_leaf]
+            node[idx[~is_leaf]] = child[~is_leaf]
+            active[idx[is_leaf]] = False
+        return out
+
+    def _decide(self, rows: np.ndarray, nodes: np.ndarray) -> np.ndarray:
+        """NumericalDecision / CategoricalDecision (tree.h:250-300)."""
+        fval = rows[np.arange(len(nodes)), self.split_feature[nodes]]
+        fval = np.asarray(fval, np.float64)
+        miss = self._missing_code[nodes]
+        is_cat = (self.decision_type[nodes] & kCategoricalMask) != 0
+        dleft = (self.decision_type[nodes] & kDefaultLeftMask) != 0
+        nan_mask = np.isnan(fval)
+        # NaN -> 0 unless missing type is NaN (tree.h:252-254)
+        fval = np.where(nan_mask & (miss != 2), 0.0, fval)
+        is_missing = np.where(miss == 1, np.abs(fval) <= 1e-35,
+                              np.where(miss == 2, nan_mask, False))
+        numeric = np.where(is_missing, dleft, fval <= self.threshold[nodes])
+        if is_cat.any():
+            cat = np.zeros(len(nodes), bool)
+            for i in np.nonzero(is_cat)[0]:
+                cats = self.cat_threshold[nodes[i]]
+                v = fval[i]
+                cat[i] = (not np.isnan(v)) and int(v) >= 0 \
+                    and int(v) in set(cats.tolist())
+            return np.where(is_cat, cat, numeric)
+        return numeric
+
+    def predict_binned(self, binned: np.ndarray) -> np.ndarray:
+        """Prediction over a train-aligned BINNED matrix [N, F_inner].
+
+        Mirrors Dataset-side decisions (bin-space): used for valid-set
+        score updates (ScoreUpdater::AddScore on valid data).
+        """
+        return self.leaf_value[self.predict_leaf_index_binned(binned)]
+
+    def predict_leaf_index_binned(self, binned: np.ndarray) -> np.ndarray:
+        n = binned.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, np.int32)
+        node = np.zeros(n, np.int32)
+        out = np.full(n, -1, np.int32)
+        active = np.ones(n, bool)
+        for _ in range(self.num_leaves):
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            b = binned[idx, self.split_feature_inner[nd]].astype(np.int32)
+            miss = self._missing_code[nd]
+            dleft = (self.decision_type[nd] & kDefaultLeftMask) != 0
+            is_cat = (self.decision_type[nd] & kCategoricalMask) != 0
+            is_missing = np.where(
+                miss == 1, b == self._default_bin[nd],
+                np.where(miss == 2, b == self._num_bin[nd] - 1, False))
+            go_left = np.where(is_missing, dleft,
+                               b <= self.threshold_bin[nd])
+            if is_cat.any():
+                word = np.clip(b // 32, 0, MAX_CAT_WORDS - 1)
+                bits = (self.cat_bitsets[nd, word]
+                        >> (b % 32).astype(np.uint32)) & 1
+                go_left = np.where(is_cat, bits == 1, go_left)
+            child = np.where(go_left, self.left_child[nd],
+                             self.right_child[nd])
+            is_leaf = child < 0
+            out[idx[is_leaf]] = ~child[is_leaf]
+            node[idx[~is_leaf]] = child[~is_leaf]
+            active[idx[is_leaf]] = False
+        return out
+
+    def leaf_depth_of(self, leaf: int) -> int:
+        return int(self.leaf_depth[leaf])
+
+    def num_nodes(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+
+def _bin_threshold_to_value(dataset, inner_feature: int,
+                            threshold_bin: int) -> float:
+    """Bin threshold -> raw-value threshold: the bin's upper bound
+    (Tree::Split stores RealThreshold via BinToValue, tree.cpp)."""
+    mapper = dataset.feature_mapper(int(inner_feature))
+    if mapper.bin_type == BIN_TYPE_CATEGORICAL:
+        return float(threshold_bin)
+    ub = mapper.bin_upper_bound[int(threshold_bin)]
+    # the infinite last bound never appears as a threshold in valid splits
+    return float(ub)
+
+
+def _bitset_to_cats(bitset: np.ndarray, mapper) -> np.ndarray:
+    cats = []
+    for b in range(min(mapper.num_bin, 32 * MAX_CAT_WORDS)):
+        if (int(bitset[b // 32]) >> (b % 32)) & 1:
+            if b < len(mapper.bin_2_categorical):
+                cats.append(int(mapper.bin_2_categorical[b]))
+    return np.asarray(cats, np.int64)

@@ -1,0 +1,3 @@
+from .base import ObjectiveFunction, create_objective
+
+__all__ = ["ObjectiveFunction", "create_objective"]

@@ -1,0 +1,107 @@
+"""Objective function interface + factory.
+
+Reference analog: ``ObjectiveFunction``
+(``include/LightGBM/objective_function.h:19-95``) and the factory
+(``src/objective/objective_function.cpp:15-53``). Gradients/hessians are
+computed as one vectorized JAX function of the score matrix — the per-row
+loops of the reference collapse into array ops (jitted by the GBDT
+driver).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import Metadata
+from ..utils.log import log_fatal
+
+
+class ObjectiveFunction:
+    """Base objective. Subclasses override gradients() and friends."""
+
+    #: number of models (trees) trained per boosting iteration
+    num_model_per_iteration = 1
+    is_constant_hessian = False
+    is_renew_tree_output = False
+    need_accuracte_prediction = True
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[jnp.ndarray] = None
+        self.weights: Optional[jnp.ndarray] = None
+
+    # -- ObjectiveFunction::Init (objective_function.h:29)
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        if metadata.label is None:
+            log_fatal("Label is required for training")
+        self.label = jnp.asarray(metadata.label)
+        self.weights = None if metadata.weights is None \
+            else jnp.asarray(metadata.weights)
+        self.check_label()
+
+    def check_label(self) -> None:
+        pass
+
+    # -- GetGradients: score [N] or [N, K] -> (grad, hess) same shape
+    def gradients(self, score: jnp.ndarray):
+        raise NotImplementedError
+
+    # -- BoostFromScore(class_id) -> initial score (double)
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+    # -- ConvertOutput (raw score -> prediction space)
+    def convert_output(self, score: jnp.ndarray) -> jnp.ndarray:
+        return score
+
+    # -- RenewTreeOutput: L1-family leaf refits; default no-op.
+    # Returns new leaf values [num_leaves] or None.
+    def renew_tree_output(self, score, leaf_id, num_leaves: int,
+                          leaf_value):
+        return None
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def _weighted(self, grad, hess):
+        if self.weights is not None:
+            w = self.weights
+            if grad.ndim == 2:
+                w = w[:, None]
+            return grad * w, hess * w
+        return grad, hess
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    """Factory (objective_function.cpp:15-53)."""
+    from . import binary, multiclass, rank, regression, xentropy
+    name = config.objective
+    table = {
+        "regression": regression.RegressionL2Loss,
+        "regression_l1": regression.RegressionL1Loss,
+        "quantile": regression.RegressionQuantileLoss,
+        "huber": regression.RegressionHuberLoss,
+        "fair": regression.RegressionFairLoss,
+        "poisson": regression.RegressionPoissonLoss,
+        "mape": regression.RegressionMAPELoss,
+        "gamma": regression.RegressionGammaLoss,
+        "tweedie": regression.RegressionTweedieLoss,
+        "binary": binary.BinaryLogloss,
+        "multiclass": multiclass.MulticlassSoftmax,
+        "multiclassova": multiclass.MulticlassOVA,
+        "lambdarank": rank.LambdarankNDCG,
+        "rank_xendcg": rank.RankXENDCG,
+        "cross_entropy": xentropy.CrossEntropy,
+        "cross_entropy_lambda": xentropy.CrossEntropyLambda,
+    }
+    if name in ("custom", "none", "null", "na"):
+        return None
+    if name not in table:
+        log_fatal(f"Unknown objective type name: {name}")
+    return table[name](config)

@@ -1,0 +1,31 @@
+"""Ranking objectives (lambdarank, rank_xendcg).
+
+Reference analog: ``src/objective/rank_objective.hpp:98-330``. Implemented
+in M2 as padded per-query pairwise kernels.
+"""
+
+from __future__ import annotations
+
+from ..config import Config
+from ..utils.log import log_fatal
+from .base import ObjectiveFunction
+
+
+class LambdarankNDCG(ObjectiveFunction):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        log_fatal("lambdarank objective lands in M2 "
+                  "(rank_objective.hpp:98-260 port)")
+
+    def name(self):
+        return "lambdarank"
+
+
+class RankXENDCG(ObjectiveFunction):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        log_fatal("rank_xendcg objective lands in M2 "
+                  "(rank_objective.hpp:262-330 port)")
+
+    def name(self):
+        return "rank_xendcg"

@@ -1,0 +1,291 @@
+"""Regression objective family.
+
+Reference analog: ``src/objective/regression_objective.hpp`` (753 LoC).
+Per-row OpenMP loops become vectorized jnp expressions. L1-type losses
+(l1/quantile/mape) refit leaf outputs with (weighted) percentiles of
+residuals (``RenewTreeOutput`` regression_objective.hpp:250-276,538-564,
+637-657) — implemented as a per-leaf masked percentile in
+``..ops.percentile``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_warning
+from .base import ObjectiveFunction
+
+
+def _sign(x):
+    return jnp.where(x > 0, 1.0, jnp.where(x < 0, -1.0, 0.0))
+
+
+class RegressionL2Loss(ObjectiveFunction):
+    """L2 loss (regression_objective.hpp:90-185)."""
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = bool(config.reg_sqrt)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            lbl = np.asarray(self.label)
+            self.label = jnp.asarray(np.sign(lbl) * np.sqrt(np.abs(lbl)))
+
+    @property
+    def is_constant_hessian(self):
+        return self.weights is None
+
+    def gradients(self, score):
+        grad = score - self.label
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        lbl = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            return float((lbl * w).sum() / w.sum())
+        return float(lbl.mean())
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return jnp.sign(score) * score * score
+        return score
+
+    def name(self):
+        return "regression"
+
+
+class RegressionL1Loss(RegressionL2Loss):
+    """L1 loss with median leaf refit (regression_objective.hpp:190-290)."""
+
+    renew_alpha = 0.5
+    is_renew_tree_output = True
+
+    def gradients(self, score):
+        diff = score - self.label
+        grad = _sign(diff)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        from ..ops.percentile import percentile_host
+        return percentile_host(np.asarray(self.label),
+                               None if self.weights is None
+                               else np.asarray(self.weights), 0.5)
+
+    def renew_tree_output(self, score, leaf_id, num_leaves, leaf_value):
+        from ..ops.percentile import renew_leaf_outputs
+        residual = self.label - score
+        return renew_leaf_outputs(residual, leaf_id, num_leaves,
+                                  self.weights, self.renew_alpha)
+
+    def name(self):
+        return "regression_l1"
+
+
+class RegressionHuberLoss(RegressionL2Loss):
+    """Huber loss (regression_objective.hpp:296-400); alpha threshold."""
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if self.sqrt:
+            log_warning("Cannot use sqrt transform in huber Regression, "
+                        "will auto disable it")
+            self.sqrt = False
+
+    @property
+    def is_constant_hessian(self):
+        return self.weights is None
+
+    def gradients(self, score):
+        diff = score - self.label
+        grad = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                         _sign(diff) * self.alpha)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+    def name(self):
+        return "huber"
+
+
+class RegressionFairLoss(RegressionL2Loss):
+    """Fair loss (regression_objective.hpp:354-404)."""
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+        self.sqrt = False
+
+    @property
+    def is_constant_hessian(self):
+        return False
+
+    def gradients(self, score):
+        x = score - self.label
+        c = self.c
+        grad = c * x / (jnp.abs(x) + c)
+        hess = c * c / (jnp.abs(x) + c) ** 2
+        return self._weighted(grad, hess)
+
+    def name(self):
+        return "fair"
+
+
+class RegressionPoissonLoss(RegressionL2Loss):
+    """Poisson regression (regression_objective.hpp:407-478).
+
+    score is log-rate; grad = exp(f) - y, hess = exp(f + max_delta_step).
+    """
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.max_delta_step = float(config.poisson_max_delta_step)
+        self.sqrt = False
+
+    def check_label(self):
+        lbl = np.asarray(self.label)
+        if lbl.min(initial=0.0) < 0.0:
+            log_fatal(f"[{self.name()}]: at least one target label is "
+                      "negative")
+        if lbl.sum() == 0.0:
+            log_fatal(f"[{self.name()}]: sum of labels is zero")
+
+    @property
+    def is_constant_hessian(self):
+        return False
+
+    def gradients(self, score):
+        grad = jnp.exp(score) - self.label
+        hess = jnp.exp(score + self.max_delta_step)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return float(np.log(max(RegressionL2Loss.boost_from_score(self),
+                                1e-300)))
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+    def name(self):
+        return "poisson"
+
+
+class RegressionQuantileLoss(RegressionL2Loss):
+    """Quantile (pinball) loss (regression_objective.hpp:483-596)."""
+
+    is_renew_tree_output = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if not 0.0 < self.alpha < 1.0:
+            log_fatal("Quantile alpha should be in (0, 1)")
+
+    @property
+    def is_constant_hessian(self):
+        return self.weights is None
+
+    def gradients(self, score):
+        delta = score - self.label
+        grad = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        from ..ops.percentile import percentile_host
+        return percentile_host(np.asarray(self.label),
+                               None if self.weights is None
+                               else np.asarray(self.weights), self.alpha)
+
+    def renew_tree_output(self, score, leaf_id, num_leaves, leaf_value):
+        from ..ops.percentile import renew_leaf_outputs
+        residual = self.label - score
+        return renew_leaf_outputs(residual, leaf_id, num_leaves,
+                                  self.weights, self.alpha)
+
+    def name(self):
+        return "quantile"
+
+
+class RegressionMAPELoss(RegressionL1Loss):
+    """MAPE loss (regression_objective.hpp:583-670): L1 scaled by
+    1/max(1, |label|); weighted-median refits."""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lbl = np.asarray(self.label)
+        if np.abs(lbl).min(initial=1.0) <= 1.0:
+            log_warning("Some label values are < 1 in absolute value. "
+                        "MAPE is unstable with such values, so LightGBM "
+                        "rounds them to 1.0 when computing MAPE.")
+        w = np.ones_like(lbl) if self.weights is None \
+            else np.asarray(self.weights)
+        self.label_weight = jnp.asarray(
+            1.0 / np.maximum(1.0, np.abs(lbl)) * w)
+
+    @property
+    def is_constant_hessian(self):
+        return True
+
+    def gradients(self, score):
+        diff = score - self.label
+        grad = _sign(diff) * self.label_weight
+        hess = jnp.ones_like(score) if self.weights is None \
+            else jnp.broadcast_to(self.weights, score.shape)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        from ..ops.percentile import percentile_host
+        return percentile_host(np.asarray(self.label),
+                               np.asarray(self.label_weight), 0.5)
+
+    def renew_tree_output(self, score, leaf_id, num_leaves, leaf_value):
+        from ..ops.percentile import renew_leaf_outputs
+        residual = self.label - score
+        return renew_leaf_outputs(residual, leaf_id, num_leaves,
+                                  self.label_weight, 0.5)
+
+    def name(self):
+        return "mape"
+
+
+class RegressionGammaLoss(RegressionPoissonLoss):
+    """Gamma regression (regression_objective.hpp:673-706)."""
+
+    def gradients(self, score):
+        grad = 1.0 - self.label * jnp.exp(-score)
+        hess = self.label * jnp.exp(-score)
+        if self.weights is not None:
+            # reference applies the weight inside the label term only
+            # (regression_objective.hpp:695-697)
+            grad = 1.0 - self.label * jnp.exp(-score) * self.weights
+            hess = self.label * jnp.exp(-score) * self.weights
+        return grad, hess
+
+    def name(self):
+        return "gamma"
+
+
+class RegressionTweedieLoss(RegressionPoissonLoss):
+    """Tweedie regression (regression_objective.hpp:708-753)."""
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def gradients(self, score):
+        rho = self.rho
+        grad = -self.label * jnp.exp((1 - rho) * score) \
+            + jnp.exp((2 - rho) * score)
+        hess = -self.label * (1 - rho) * jnp.exp((1 - rho) * score) \
+            + (2 - rho) * jnp.exp((2 - rho) * score)
+        return self._weighted(grad, hess)
+
+    def name(self):
+        return "tweedie"

@@ -1,0 +1,8 @@
+from .histogram import build_histogram, fix_histogram, make_ghc
+from .split import (FeatureMeta, SplitParams, SplitResult,
+                    best_split_numerical)
+
+__all__ = [
+    "build_histogram", "fix_histogram", "make_ghc", "FeatureMeta",
+    "SplitParams", "SplitResult", "best_split_numerical",
+]

@@ -1,0 +1,115 @@
+"""Histogram construction: the hottest op of GBDT training.
+
+Reference analog: ``DenseBin::ConstructHistogramInner``
+(``src/io/dense_bin.hpp:76-105``) and the OpenCL kernels
+(``src/treelearner/ocl/histogram256.cl``). On TPU there is no fast
+scatter-add, so the op is reformulated:
+
+  * ``histogram_scatter`` — ``jax.ops.segment_sum`` per feature. Fast on
+    CPU (tests), poor on TPU; the correctness reference.
+  * ``histogram_onehot`` — chunked one-hot contraction
+    ``onehot(bin)[n, F, B] x ghc[n, 3] -> [F, B, 3]`` that XLA maps onto
+    the MXU. TPU path until the Pallas kernel (ops/hist_pallas.py) lands.
+
+Inputs are the whole binned matrix plus a per-row leaf mask; the
+smaller-child + subtraction trick (serial_tree_learner.cpp:434-436) lives
+in the learner, not here.
+
+Histogram layout: ``[F, B, 3]`` float32 with channels (sum_grad, sum_hess,
+count). The reference stores (grad, hess) pairs and derives counts from
+hessians (feature_histogram.hpp:565,581); we carry exact counts instead —
+cheap on TPU and exact under sample weights.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def make_ghc(grad: jnp.ndarray, hess: jnp.ndarray,
+             weight_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Stack (grad, hess, count) channels, optionally bagging-masked."""
+    ones = jnp.ones_like(grad)
+    ghc = jnp.stack([grad, hess, ones], axis=-1)
+    if weight_mask is not None:
+        ghc = ghc * weight_mask[:, None]
+    return ghc
+
+
+def histogram_scatter(binned: jnp.ndarray, ghc: jnp.ndarray,
+                      num_bins: int) -> jnp.ndarray:
+    """Per-feature segment-sum histogram. binned [N, F] int, ghc [N, 3]."""
+    def one_feature(col):
+        return jax.ops.segment_sum(ghc, col, num_segments=num_bins)
+    return jax.vmap(one_feature, in_axes=1, out_axes=0)(
+        binned.astype(jnp.int32))
+
+
+def histogram_onehot(binned: jnp.ndarray, ghc: jnp.ndarray,
+                     num_bins: int, chunk: int = 16384) -> jnp.ndarray:
+    """Chunked one-hot-matmul histogram (MXU-friendly formulation)."""
+    n, num_features = binned.shape
+    chunk = min(chunk, n)
+    num_chunks = (n + chunk - 1) // chunk
+    pad = num_chunks * chunk - n
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        ghc = jnp.pad(ghc, ((0, pad), (0, 0)))  # zero ghc: no contribution
+    xb = binned.astype(jnp.int32).reshape(num_chunks, chunk, num_features)
+    gh = ghc.reshape(num_chunks, chunk, 3)
+    bins = jnp.arange(num_bins, dtype=jnp.int32)
+
+    def body(carry, xs):
+        xc, gc = xs
+        onehot = (xc[:, :, None] == bins[None, None, :]).astype(jnp.float32)
+        # HIGHEST precision: histogram sums feed split gains; bf16-rounded
+        # MXU inputs (TPU default) cost ~3 decimal digits of gradient sum
+        hist = jnp.einsum("cfb,ck->fbk", onehot, gc,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+        return carry + hist, None
+
+    init = jnp.zeros((num_features, num_bins, 3), jnp.float32)
+    out, _ = jax.lax.scan(body, init, (xb, gh))
+    return out
+
+
+def build_histogram(binned: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
+                    method: str = "auto") -> jnp.ndarray:
+    """Dispatch histogram construction. Returns [F, B, 3] float32."""
+    if method == "auto":
+        method = "onehot" if jax.default_backend() in ("tpu", "axon") \
+            else "scatter"
+    if method == "scatter":
+        return histogram_scatter(binned, ghc, num_bins)
+    if method == "onehot":
+        return histogram_onehot(binned, ghc, num_bins)
+    if method == "pallas":
+        from .hist_pallas import histogram_pallas
+        return histogram_pallas(binned, ghc, num_bins)
+    raise ValueError(f"unknown histogram method {method}")
+
+
+def fix_histogram(hist: jnp.ndarray, parent_g: jnp.ndarray,
+                  parent_h: jnp.ndarray, parent_c: jnp.ndarray,
+                  most_freq_bins: jnp.ndarray) -> jnp.ndarray:
+    """Reconstitute an elided most-frequent bin from leaf totals.
+
+    Analog of ``Dataset::FixHistogram`` (dataset.cpp:1424-1442). Our dense
+    device layout always materializes every bin, so this is only used by
+    learners that zero the most-frequent bin to save bandwidth (e.g. the
+    distributed reduce path can skip it and restore post-reduction).
+
+    hist: [F, B, 3]; most_freq_bins: [F] int32.
+    """
+    f = hist.shape[0]
+    totals = hist.sum(axis=1)  # [F, 3] without the elided bin
+    parent = jnp.stack([jnp.broadcast_to(parent_g, (f,)),
+                        jnp.broadcast_to(parent_h, (f,)),
+                        jnp.broadcast_to(parent_c, (f,))], axis=-1)
+    missing = parent - totals
+    onehot = jax.nn.one_hot(most_freq_bins, hist.shape[1], dtype=hist.dtype)
+    return hist + onehot[:, :, None] * missing[:, None, :]

@@ -1,0 +1,49 @@
+"""Leaf membership update (data partitioning).
+
+Reference analog: ``DataPartition::Split`` (data_partition.hpp:101-120) +
+``Dense/SparseBin::Split`` (dense_bin.hpp:132+). The reference keeps a
+reordered index array per leaf; on TPU we keep a ``leaf_id[N]`` vector
+instead — the split is an index-free ``where`` over the whole row set,
+static shapes, no gather/scatter (SURVEY.md design stance).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
+                    MISSING_ZERO_CODE)
+
+
+def rows_go_left(bin_col: jnp.ndarray, threshold, default_left,
+                 missing_code, default_bin, num_bin, is_cat,
+                 cat_bitset) -> jnp.ndarray:
+    """Decide left/right per row in BIN space.
+
+    Mirrors the bin-space decision of Dense/SparseBin::Split: missing rows
+    (zero-bin under Zero-missing, last bin under NaN-missing) follow the
+    default direction; others compare ``bin <= threshold``. Categorical
+    splits test bitset membership of the bin (left = member).
+    """
+    b = bin_col.astype(jnp.int32)
+    is_missing = jnp.where(
+        missing_code == MISSING_ZERO_CODE, b == default_bin,
+        jnp.where(missing_code == MISSING_NAN_CODE, b == num_bin - 1,
+                  jnp.zeros_like(b, dtype=bool)))
+    numeric_left = jnp.where(is_missing, default_left, b <= threshold)
+    # categorical: left iff bit `b` set in bitset (missing/overflow right)
+    word = jnp.clip(b // 32, 0, MAX_CAT_WORDS - 1)
+    bit = (cat_bitset[word] >> (b % 32).astype(jnp.uint32)) & 1
+    cat_left = (bit == 1) & (b < 32 * MAX_CAT_WORDS)
+    return jnp.where(is_cat, cat_left, numeric_left)
+
+
+def split_leaf(leaf_id: jnp.ndarray, bin_col: jnp.ndarray, target_leaf,
+               new_leaf, threshold, default_left, missing_code, default_bin,
+               num_bin, is_cat, cat_bitset) -> jnp.ndarray:
+    """Send right-side rows of ``target_leaf`` to ``new_leaf``."""
+    in_leaf = leaf_id == target_leaf
+    go_left = rows_go_left(bin_col, threshold, default_left, missing_code,
+                           default_bin, num_bin, is_cat, cat_bitset)
+    return jnp.where(in_leaf & ~go_left, new_leaf, leaf_id).astype(
+        leaf_id.dtype)

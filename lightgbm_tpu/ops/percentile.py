@@ -1,0 +1,71 @@
+"""(Weighted) percentiles and per-leaf leaf-output refits.
+
+Reference analog: ``PercentileFun`` / ``WeightedPercentileFun``
+(``src/objective/regression_objective.hpp:18-89``) and the leaf refit
+driver ``SerialTreeLearner::RenewTreeOutput``
+(serial_tree_learner.cpp:720-758). The reference gathers each leaf's rows
+and runs a partial sort; here residuals are argsorted ONCE and every
+leaf's percentile is computed from per-leaf masked cumulative weights —
+one [N] sort + L vectorized reductions, no per-leaf gathers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def percentile_host(data: np.ndarray, weights, alpha: float) -> float:
+    """Exact reference semantics, host-side (used for boost_from_score)."""
+    data = np.asarray(data, np.float64)
+    cnt = len(data)
+    if cnt == 0:
+        return 0.0
+    if cnt <= 1:
+        return float(data[0])
+    if weights is None:
+        # PercentileFun (regression_objective.hpp:18-48): descending order
+        desc = np.sort(data)[::-1]
+        float_pos = (1.0 - alpha) * cnt
+        pos = int(float_pos)
+        if pos < 1:
+            return float(desc[0])
+        if pos >= cnt:
+            return float(desc[-1])
+        bias = float_pos - pos
+        v1, v2 = float(desc[pos - 1]), float(desc[pos])
+        return v1 - (v1 - v2) * bias
+    # WeightedPercentileFun (regression_objective.hpp:50-89)
+    weights = np.asarray(weights, np.float64)
+    order = np.argsort(data, kind="stable")
+    sdata = data[order]
+    cdf = np.cumsum(weights[order])
+    threshold = cdf[-1] * alpha
+    pos = int(np.searchsorted(cdf, threshold, side="right"))
+    pos = min(pos, cnt - 1)
+    if pos == 0 or pos == cnt - 1:
+        return float(sdata[pos])
+    v1, v2 = float(sdata[pos - 1]), float(sdata[pos])
+    if cdf[pos + 1] - cdf[pos] >= 1.0:
+        return (threshold - cdf[pos]) / (cdf[pos + 1] - cdf[pos]) \
+            * (v2 - v1) + v1
+    return v2
+
+
+def renew_leaf_outputs(residual, leaf_id, num_leaves: int, weights,
+                       alpha: float) -> np.ndarray:
+    """Per-leaf (weighted) percentile of residuals.
+
+    Returns float64 [num_leaves]; host-side numpy (renewal runs once per
+    tree; the sort dominates and numpy is fine at this cadence).
+    """
+    residual = np.asarray(residual, np.float64)
+    leaf_id = np.asarray(leaf_id)
+    weights = None if weights is None else np.asarray(weights, np.float64)
+    out = np.zeros(num_leaves, np.float64)
+    for leaf in range(num_leaves):
+        mask = leaf_id == leaf
+        if not mask.any():
+            continue
+        w = None if weights is None else weights[mask]
+        out[leaf] = percentile_host(residual[mask], w, alpha)
+    return out

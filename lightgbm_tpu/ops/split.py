@@ -1,0 +1,273 @@
+"""Vectorized best-split search over feature histograms.
+
+Reference analog: ``FeatureHistogram::FindBestThreshold*``
+(``src/treelearner/feature_histogram.hpp:84-709``). The reference scans
+each feature's bins serially in two directions; here both directions for
+ALL features are evaluated at once as cumulative-sum tensor ops on
+``[F, B]`` grids — a VPU-friendly formulation with no data-dependent
+control flow.
+
+Semantics preserved:
+  * gain math with L1/L2/max_delta_step (feature_histogram.hpp:492-553);
+  * missing handling: two scans when num_bin > 2 and missing != None;
+    Zero-missing skips the default bin from partial sums and thresholds;
+    NaN-missing excludes the NaN bin from the default-left scan
+    (feature_histogram.hpp:103-131, 555-709);
+  * min_data_in_leaf / min_sum_hessian_in_leaf validity, kEpsilon seeding;
+  * monotone-constraint gain zeroing + output clamping
+    (feature_histogram.hpp:507-537);
+  * tie-breaking: default-left scan wins ties; within a scan the
+    reference's iteration order is reproduced (largest threshold for the
+    right-to-left scan, smallest for left-to-right);
+  * per-feature gain penalty (feature_contri, feature_histogram.hpp:89).
+
+Categorical split search lives in ``split_categorical.py`` and is merged
+by the learner.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+kEpsilon = 1e-15
+NEG_INF = -jnp.inf
+
+# missing-type codes (device-side encoding of bin.h:26 MissingType)
+MISSING_NONE_CODE = 0
+MISSING_ZERO_CODE = 1
+MISSING_NAN_CODE = 2
+
+
+class FeatureMeta(NamedTuple):
+    """Static per-feature metadata, all arrays of shape [F]."""
+    num_bins: jnp.ndarray      # int32
+    missing: jnp.ndarray       # int32 code
+    default_bin: jnp.ndarray   # int32
+    most_freq_bin: jnp.ndarray  # int32
+    monotone: jnp.ndarray      # int32 in {-1, 0, +1}
+    penalty: jnp.ndarray       # float32
+    is_categorical: jnp.ndarray  # bool
+
+
+class SplitParams(NamedTuple):
+    """Static (python-scalar) split hyperparameters."""
+    lambda_l1: float
+    lambda_l2: float
+    max_delta_step: float
+    min_data_in_leaf: float
+    min_sum_hessian_in_leaf: float
+    min_gain_to_split: float
+    # categorical (M3)
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
+
+
+class SplitResult(NamedTuple):
+    """Best split of one leaf; all scalars (device)."""
+    gain: jnp.ndarray          # f32, -inf when no valid split
+    feature: jnp.ndarray       # i32 inner feature index
+    threshold: jnp.ndarray     # i32 bin threshold (left = bin <= threshold)
+    default_left: jnp.ndarray  # bool
+    left_g: jnp.ndarray
+    left_h: jnp.ndarray
+    left_c: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+    # categorical support: when is_cat, the split is "bin in bitset"
+    is_cat: jnp.ndarray        # bool
+    cat_bitset: jnp.ndarray    # uint32 [MAX_CAT_WORDS] bin-bitset, left side
+
+
+MAX_CAT_WORDS = 8  # supports categorical features up to 256 bins
+
+
+def threshold_l1(s, l1):
+    reg = jnp.maximum(jnp.abs(s) - l1, 0.0)
+    return jnp.sign(s) * reg
+
+
+def leaf_output_no_constraint(g, h, l1, l2, max_delta_step):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:497-504)."""
+    out = -threshold_l1(g, l1) / (h + l2)
+    if max_delta_step > 0.0:
+        out = jnp.clip(out, -max_delta_step, max_delta_step)
+    return out
+
+
+def leaf_output(g, h, l1, l2, max_delta_step, cmin, cmax):
+    """Constrained variant (feature_histogram.hpp:527-537)."""
+    return jnp.clip(
+        leaf_output_no_constraint(g, h, l1, l2, max_delta_step), cmin, cmax)
+
+
+def gain_given_output(g, h, w, l1, l2):
+    """GetLeafSplitGainGivenOutput (feature_histogram.hpp:550-553)."""
+    sg_l1 = threshold_l1(g, l1)
+    return -(2.0 * sg_l1 * w + (h + l2) * w * w)
+
+
+def leaf_split_gain(g, h, l1, l2, max_delta_step):
+    """GetLeafSplitGain (feature_histogram.hpp:545-548)."""
+    w = leaf_output_no_constraint(g, h, l1, l2, max_delta_step)
+    return gain_given_output(g, h, w, l1, l2)
+
+
+def _split_gains(gl, hl, gr, hr, p: SplitParams, monotone, cmin, cmax):
+    """GetSplitGains (feature_histogram.hpp:507-519)."""
+    wl = leaf_output(gl, hl, p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                     cmin, cmax)
+    wr = leaf_output(gr, hr, p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                     cmin, cmax)
+    gain = gain_given_output(gl, hl, wl, p.lambda_l1, p.lambda_l2) \
+        + gain_given_output(gr, hr, wr, p.lambda_l1, p.lambda_l2)
+    violates = ((monotone > 0) & (wl > wr)) | ((monotone < 0) & (wl < wr))
+    return jnp.where(violates, 0.0, gain)
+
+
+def _argmax_first(x):
+    return jnp.argmax(x)
+
+
+def _argmax_last(x, axis):
+    n = x.shape[axis]
+    rev = jnp.flip(x, axis=axis)
+    return n - 1 - jnp.argmax(rev, axis=axis)
+
+
+def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+                         meta: FeatureMeta, params: SplitParams,
+                         constraint_min=None, constraint_max=None,
+                         feature_mask: jnp.ndarray | None = None
+                         ) -> SplitResult:
+    """Best numerical split over all features of one leaf.
+
+    hist: [F, B, 3] (sum_grad, sum_hess, count) per bin.
+    parent_*: scalar totals of the leaf.
+    Returns a SplitResult; ``gain`` is -inf when nothing is valid.
+    """
+    f, b, _ = hist.shape
+    p = params
+    if constraint_min is None:
+        constraint_min = jnp.float32(-jnp.inf)
+    if constraint_max is None:
+        constraint_max = jnp.float32(jnp.inf)
+
+    g = hist[..., 0]
+    h = hist[..., 1]
+    c = hist[..., 2]
+    bins = jnp.arange(b, dtype=jnp.int32)[None, :]          # [1,B]
+    nb = meta.num_bins[:, None]                              # [F,1]
+    missing = meta.missing[:, None]
+    default_bin = meta.default_bin[:, None]
+    monotone = meta.monotone[:, None]
+
+    parent_h_eps = parent_h + 2.0 * kEpsilon
+    # reference runs the two-scan path only when num_bin > 2 and missing
+    two_scan = (missing != MISSING_NONE_CODE) & (nb > 2)
+    skip_default = two_scan & (missing == MISSING_ZERO_CODE) \
+        & (bins == default_bin)
+    na_excl = two_scan & (missing == MISSING_NAN_CODE)
+    is_na_bin = na_excl & (bins == nb - 1)
+
+    gain_shift = leaf_split_gain(parent_g, parent_h_eps, p.lambda_l1,
+                                 p.lambda_l2, p.max_delta_step)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    def masked(x, m):
+        return jnp.where(m, 0.0, x)
+
+    # ---- dir=+1: left-to-right; default/NaN implicitly go right --------
+    lg_p = jnp.cumsum(masked(g, skip_default), axis=1)
+    lh_p = jnp.cumsum(masked(h, skip_default), axis=1)
+    lc_p = jnp.cumsum(masked(c, skip_default), axis=1)
+    hl_p = lh_p + kEpsilon
+    hr_p = parent_h_eps - hl_p
+    gr_p = parent_g - lg_p
+    cr_p = parent_c - lc_p
+    valid_p = two_scan & (bins <= nb - 2) & ~skip_default
+    valid_p &= (lc_p >= p.min_data_in_leaf) & (cr_p >= p.min_data_in_leaf)
+    valid_p &= (hl_p >= p.min_sum_hessian_in_leaf) \
+        & (hr_p >= p.min_sum_hessian_in_leaf)
+    gains_p = _split_gains(lg_p, hl_p, gr_p, hr_p, p, monotone,
+                           constraint_min, constraint_max)
+    score_p = jnp.where(valid_p & (gains_p > min_gain_shift), gains_p,
+                        NEG_INF)
+
+    # ---- dir=-1: right-to-left; default/NaN implicitly go left ---------
+    mask_m = skip_default | is_na_bin
+    g_m = masked(g, mask_m)
+    h_m = masked(h, mask_m)
+    c_m = masked(c, mask_m)
+    # right side at threshold t = sum of masked bins > t
+    rg_m = g_m.sum(axis=1, keepdims=True) - jnp.cumsum(g_m, axis=1)
+    rh_m = h_m.sum(axis=1, keepdims=True) - jnp.cumsum(h_m, axis=1)
+    rc_m = c_m.sum(axis=1, keepdims=True) - jnp.cumsum(c_m, axis=1)
+    hr_m = rh_m + kEpsilon
+    hl_m = parent_h_eps - hr_m
+    gl_m = parent_g - rg_m
+    cl_m = parent_c - rc_m
+    valid_m = bins <= nb - 2 - na_excl.astype(jnp.int32)
+    # zero-missing skips threshold default_bin-1 (the `continue` skips the
+    # iteration that would have recorded it, feature_histogram.hpp:577)
+    valid_m &= ~(two_scan & (missing == MISSING_ZERO_CODE)
+                 & (bins == default_bin - 1))
+    valid_m &= (cl_m >= p.min_data_in_leaf) & (rc_m >= p.min_data_in_leaf)
+    valid_m &= (hl_m >= p.min_sum_hessian_in_leaf) \
+        & (hr_m >= p.min_sum_hessian_in_leaf)
+    gains_m = _split_gains(gl_m, hl_m, rg_m, hr_m, p, monotone,
+                           constraint_min, constraint_max)
+    score_m = jnp.where(valid_m & (gains_m > min_gain_shift), gains_m,
+                        NEG_INF)
+
+    # ---- per-feature best with reference iteration-order tie-breaks ----
+    t_m = _argmax_last(score_m, axis=1)                      # [F]
+    v_m = jnp.take_along_axis(score_m, t_m[:, None], axis=1)[:, 0]
+    t_p = jnp.argmax(score_p, axis=1)
+    v_p = jnp.take_along_axis(score_p, t_p[:, None], axis=1)[:, 0]
+    use_m = v_m >= v_p                                       # -1 scan first
+    feat_gain = jnp.where(use_m, v_m, v_p)
+    feat_t = jnp.where(use_m, t_m, t_p).astype(jnp.int32)
+
+    feat_valid = jnp.isfinite(feat_gain) & ~meta.is_categorical
+    if feature_mask is not None:
+        feat_valid &= feature_mask
+    feat_score = jnp.where(
+        feat_valid, (feat_gain - min_gain_shift) * meta.penalty, NEG_INF)
+
+    best_f = _argmax_first(feat_score).astype(jnp.int32)
+    best_gain = feat_score[best_f]
+    best_t = feat_t[best_f]
+    best_use_m = use_m[best_f]
+
+    # left-side sums at the winning threshold
+    lg = jnp.where(best_use_m, gl_m[best_f, best_t], lg_p[best_f, best_t])
+    lh_eps = jnp.where(best_use_m, hl_m[best_f, best_t],
+                       hl_p[best_f, best_t])
+    lc = jnp.where(best_use_m, cl_m[best_f, best_t], lc_p[best_f, best_t])
+    rg = parent_g - lg
+    rh_eps = parent_h_eps - lh_eps
+    wl = leaf_output(lg, lh_eps, p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                     constraint_min, constraint_max)
+    wr = leaf_output(rg, rh_eps, p.lambda_l1, p.lambda_l2, p.max_delta_step,
+                     constraint_min, constraint_max)
+
+    # default direction: -1 scan => left; 2-bin NaN fix goes right
+    # (feature_histogram.hpp:127-130)
+    dleft = best_use_m
+    nbf = meta.num_bins[best_f]
+    dleft = jnp.where((nbf <= 2)
+                      & (meta.missing[best_f] == MISSING_NAN_CODE),
+                      False, dleft)
+
+    return SplitResult(
+        gain=best_gain, feature=best_f, threshold=best_t,
+        default_left=dleft, left_g=lg, left_h=lh_eps - kEpsilon, left_c=lc,
+        left_output=wl, right_output=wr,
+        is_cat=jnp.asarray(False),
+        cat_bitset=jnp.zeros((MAX_CAT_WORDS,), jnp.uint32))
