@@ -137,8 +137,17 @@ class Dataset:
         self.zero_as_missing: bool = False
         self.monotone_types: List[int] = []
         self.feature_penalty: List[float] = []
+        self._binned_device = None
 
     # ------------------------------------------------------------------
+    @property
+    def binned_device(self):
+        """Lazy device copy of the binned matrix (uploaded once)."""
+        if self._binned_device is None:
+            import jax.numpy as jnp
+            self._binned_device = jnp.asarray(self.binned)
+        return self._binned_device
+
     @property
     def num_features(self) -> int:
         return len(self.real_feature_idx)

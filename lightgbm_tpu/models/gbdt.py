@@ -120,7 +120,9 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # Bagging (gbdt.cpp:163-243): TPU-style = weight mask, not subset copy
-    def _bagging_weight(self, it: int) -> Optional[jnp.ndarray]:
+    def _bagging_weight(self, it: int, grad=None,
+                        hess=None) -> Optional[jnp.ndarray]:
+        """grad/hess [N, K] are passed for gradient-based sampling (GOSS)."""
         cfg = self.config
         need = cfg.bagging_freq > 0 and (
             cfg.bagging_fraction < 1.0
@@ -197,7 +199,7 @@ class GBDT:
             grad = _coerce_custom_grad(gradients, self.num_data, k)
             hess = _coerce_custom_grad(hessians, self.num_data, k)
 
-        bag = self._bagging_weight(self.iter)
+        bag = self._bagging_weight(self.iter, grad, hess)
         fmask = self._feature_mask()
 
         should_continue = False
@@ -273,11 +275,11 @@ class GBDT:
         leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
         add = leaf_vals[result.leaf_id]
         self.train_score = self.train_score.at[:, tid].add(add)
-        # valid: bin-space traversal
+        # valid: jitted bin-space traversal on device
         for i, vd in enumerate(self.valid_sets):
-            vadd = tree.predict_binned(vd.binned)
-            self.valid_scores[i] = self.valid_scores[i].at[:, tid].add(
-                jnp.asarray(vadd, jnp.float32))
+            vadd = tree.predict_binned_device(vd.binned_device)
+            self.valid_scores[i] = \
+                self.valid_scores[i].at[:, tid].add(vadd)
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
@@ -288,15 +290,14 @@ class GBDT:
         for tid in range(k):
             tree = self.models[-k + tid]
             tree.shrink(-1.0)
-            add = jnp.asarray(tree.leaf_value, jnp.float32)
             if self.train_data is not None:
-                tadd = tree.predict_binned(self.train_data.binned)
-                self.train_score = self.train_score.at[:, tid].add(
-                    jnp.asarray(tadd, jnp.float32))
+                tadd = tree.predict_binned_device(
+                    self.train_data.binned_device)
+                self.train_score = self.train_score.at[:, tid].add(tadd)
             for i, vd in enumerate(self.valid_sets):
-                vadd = tree.predict_binned(vd.binned)
-                self.valid_scores[i] = self.valid_scores[i].at[:, tid].add(
-                    jnp.asarray(vadd, jnp.float32))
+                vadd = tree.predict_binned_device(vd.binned_device)
+                self.valid_scores[i] = \
+                    self.valid_scores[i].at[:, tid].add(vadd)
         del self.models[-k:]
         self.iter -= 1
 
@@ -372,7 +373,25 @@ class GBDT:
             log_info(f"Early stopping at iteration {self.iter}, the best "
                      f"iteration round is {self.iter - es}")
             log_info(f"Output of best iteration round:\n{best_msg}")
-            del self.models[-es * self.num_tree_per_iteration:]
+            # truncate the model back to the best iteration AND keep the
+            # cached scores/iteration counter consistent with it, so that
+            # later eval/continued training see the truncated model
+            k = self.num_tree_per_iteration
+            for tree in self.models[-es * k:]:
+                tree.shrink(-1.0)
+            for j in range(es):
+                for tid in range(k):
+                    tree = self.models[-(es - j) * k + tid]
+                    tadd = tree.predict_binned_device(
+                        self.train_data.binned_device)
+                    self.train_score = \
+                        self.train_score.at[:, tid].add(tadd)
+                    for i, vd in enumerate(self.valid_sets):
+                        vadd = tree.predict_binned_device(vd.binned_device)
+                        self.valid_scores[i] = \
+                            self.valid_scores[i].at[:, tid].add(vadd)
+            del self.models[-es * k:]
+            self.iter -= es
             return True
         return False
 

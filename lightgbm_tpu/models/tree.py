@@ -16,8 +16,11 @@ During training the same arrays live on device inside the jitted grow loop
 
 from __future__ import annotations
 
+import functools
 from typing import List, NamedTuple, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data.binning import (BIN_TYPE_CATEGORICAL, MISSING_NAN, MISSING_NONE,
@@ -234,11 +237,87 @@ class Tree:
             active[idx[is_leaf]] = False
         return out
 
+    def predict_binned_device(self, binned_dev) -> jnp.ndarray:
+        """Device (jitted) bin-space prediction: f32 leaf values [N].
+
+        Used wherever a past tree must be re-scored against a device-
+        resident binned matrix (DART drops/normalize dart.hpp:131-196, RF
+        running average rf.hpp:140-143, rollback, early-stop truncation)
+        — replaces the reference's ScoreUpdater::AddScore traversal with
+        one XLA program; node arrays are padded to a power of two so
+        compilations are shared across trees of similar size.
+        """
+        n = binned_dev.shape[0]
+        if self.num_leaves <= 1:
+            return jnp.full((n,), jnp.float32(self.leaf_value[0]))
+        s = len(self.split_feature_inner)
+        cap = 1
+        while cap < s:
+            cap *= 2
+
+        def pad(a, fill=0):
+            return np.concatenate(
+                [a, np.full((cap - s,) + a.shape[1:], fill, a.dtype)])
+
+        leaf_vals = np.zeros(cap + 1, np.float32)
+        leaf_vals[:self.num_leaves] = self.leaf_value
+        return _traverse_binned_jax(
+            binned_dev,
+            jnp.asarray(pad(self.split_feature_inner)),
+            jnp.asarray(pad(self.threshold_bin)),
+            jnp.asarray(pad(self.decision_type)),
+            jnp.asarray(pad(self.left_child, fill=-1)),
+            jnp.asarray(pad(self.right_child, fill=-1)),
+            jnp.asarray(pad(self._missing_code)),
+            jnp.asarray(pad(self._default_bin)),
+            jnp.asarray(pad(self._num_bin)),
+            jnp.asarray(pad(self.cat_bitsets)),
+            jnp.asarray(leaf_vals))
+
     def leaf_depth_of(self, leaf: int) -> int:
         return int(self.leaf_depth[leaf])
 
     def num_nodes(self) -> int:
         return max(self.num_leaves - 1, 0)
+
+
+@jax.jit
+def _traverse_binned_jax(binned, feat, thr, dec, left, right, miss,
+                         default_bin, num_bin, cat_bitsets, leaf_vals):
+    """Vectorized bin-space tree walk (NumericalDecision semantics of
+    predict_leaf_index_binned, in one lax.while_loop)."""
+    n = binned.shape[0]
+    rows = jnp.arange(n)
+
+    def cond(state):
+        return ~jnp.all(state[2])
+
+    def body(state):
+        node, out, done = state
+        nd = jnp.where(done, 0, node)
+        b = binned[rows, feat[nd]].astype(jnp.int32)
+        m = miss[nd]
+        dleft = (dec[nd] & kDefaultLeftMask) != 0
+        is_cat = (dec[nd] & kCategoricalMask) != 0
+        is_missing = jnp.where(
+            m == 1, b == default_bin[nd],
+            jnp.where(m == 2, b == num_bin[nd] - 1, False))
+        go_left = jnp.where(is_missing, dleft, b <= thr[nd])
+        word = jnp.clip(b // 32, 0, cat_bitsets.shape[1] - 1)
+        bits = (cat_bitsets[nd, word]
+                >> (b % 32).astype(jnp.uint32)) & jnp.uint32(1)
+        go_left = jnp.where(is_cat, bits == 1, go_left)
+        child = jnp.where(go_left, left[nd], right[nd])
+        is_leaf = child < 0
+        out = jnp.where(~done & is_leaf, ~child, out)
+        node = jnp.where(~done & ~is_leaf, child, node)
+        return node, out, done | is_leaf
+
+    node0 = jnp.zeros(n, jnp.int32)
+    out0 = jnp.full(n, leaf_vals.shape[0] - 1, jnp.int32)  # pad slot
+    done0 = jnp.zeros(n, bool)
+    _, out, _ = jax.lax.while_loop(cond, body, (node0, out0, done0))
+    return leaf_vals[out]
 
 
 def _bin_threshold_to_value(dataset, inner_feature: int,

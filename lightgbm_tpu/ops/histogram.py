@@ -31,11 +31,19 @@ import jax.numpy as jnp
 
 def make_ghc(grad: jnp.ndarray, hess: jnp.ndarray,
              weight_mask: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Stack (grad, hess, count) channels, optionally bagging-masked."""
+    """Stack (grad, hess, count) channels, optionally bagging-masked.
+
+    The count channel is the *selection indicator* (weight > 0), not the
+    weight itself: GOSS up-weights sampled small-gradient rows
+    (goss.hpp:92) but each selected row still counts as one datum for
+    min_data_in_leaf, matching the reference's partition-based counts.
+    """
     ones = jnp.ones_like(grad)
-    ghc = jnp.stack([grad, hess, ones], axis=-1)
     if weight_mask is not None:
-        ghc = ghc * weight_mask[:, None]
+        ghc = jnp.stack([grad * weight_mask, hess * weight_mask,
+                         (weight_mask > 0).astype(grad.dtype)], axis=-1)
+    else:
+        ghc = jnp.stack([grad, hess, ones], axis=-1)
     return ghc
 
 
