@@ -1,0 +1,132 @@
+"""Communication strategies for the leaf-wise grow loop.
+
+Reference analog: the parallel tree learners
+(``src/treelearner/{feature,data,voting}_parallel_tree_learner.cpp``)
+layered over the hand-rolled ``Network`` collectives (``src/network/``).
+On TPU the whole Network layer is replaced by XLA mesh collectives
+(psum / all_gather over ICI) inside ``shard_map``; what remains of each
+parallel algorithm is captured here as three hooks injected into ONE
+shared grow loop (``learner/serial.py:grow_tree``):
+
+  * ``reduce_hist``  — histogram aggregation after each build.
+      data-parallel: ``psum`` (the reduce-scatter + aggregate of
+      data_parallel_tree_learner.cpp:149-164, fused by XLA);
+      serial / feature-parallel / voting: identity (histograms stay
+      local by design).
+  * ``reduce_sums``  — (Σg, Σh, Σcount) root aggregation
+      (data_parallel_tree_learner.cpp:120-145).
+  * ``select_split`` — best-split choice for one leaf.
+      serial & data-parallel: local argmax over the (global) histogram;
+      feature-parallel: local scan on the feature shard + all_gather
+      argmax (SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213);
+      voting: local top-k -> all_gather -> weighted-gain GlobalVoting ->
+      psum of only the winning features' histograms -> global scan
+      (voting_parallel_tree_learner.cpp:244-430).
+
+Every hook returns values REPLICATED across mesh devices so the grow
+loop's control flow stays identical everywhere; only row partitioning
+(leaf_id) and histogram work are sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
+                         _argmax_first, assemble_split, best_split_numerical,
+                         per_feature_numerical)
+
+
+class Comm(NamedTuple):
+    """Static strategy object (functions close over mesh axis names)."""
+    reduce_hist: Callable
+    reduce_sums: Callable
+    select_split: Callable
+
+
+def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask):
+    return best_split_numerical(hist, g, h, c, meta, params,
+                                constraint_min=cmin, constraint_max=cmax,
+                                feature_mask=fmask)
+
+
+SERIAL_COMM = Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
+                   select_split=_serial_select)
+
+
+def make_data_parallel_comm(axis: str) -> Comm:
+    """Histograms and root sums are psum'ed; split selection then runs
+    identically (and redundantly — cheap) on every device."""
+    return Comm(
+        reduce_hist=lambda x: jax.lax.psum(x, axis),
+        reduce_sums=lambda x: jax.lax.psum(x, axis),
+        select_split=_serial_select)
+
+
+def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
+    """Every device holds all rows but scans only its feature shard
+    (contiguous blocks, so tie-breaking matches the serial first-index
+    rule); winners are compared via all_gather of the tiny SplitResult
+    (the Allreduce of SplitInfo, parallel_tree_learner.h:190-213)."""
+
+    def select(hist, g, h, c, meta_local, params, cmin, cmax, fmask):
+        pf = per_feature_numerical(hist, g, h, c, meta_local, params,
+                                   cmin, cmax, fmask)
+        lb = _argmax_first(pf.score).astype(jnp.int32)
+        gid = jax.lax.axis_index(axis) * f_local + lb
+        res = assemble_split(pf, lb, g, h, params, cmin, cmax,
+                             feature_id=gid)
+        stacked = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis), res)
+        w = jnp.argmax(stacked.gain)
+        return jax.tree.map(lambda x: x[w], stacked)
+
+    return Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
+                select_split=select)
+
+
+def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
+                              params_local: SplitParams) -> Comm:
+    """PV-Tree. Per leaf: local per-feature scan (with min_data /
+    min_hessian divided by num_machines, voting_parallel_tree_learner.cpp
+    :57-59) -> local top-k -> all_gather(2·top_k LightSplitInfo analog)
+    -> GlobalVoting by gain weighted with local leaf count / mean count
+    (:152-183) -> aggregate only the winning features' histogram columns
+    (CopyLocalHistogram + ReduceScatter, :186-242,344) -> full-parameter
+    scan on the aggregated columns -> replicated winner."""
+
+    def select(hist_local, g, h, c, meta, params, cmin, cmax, fmask):
+        f = hist_local.shape[0]
+        k = min(top_k, f)
+        # local leaf totals (every feature's bins sum to the leaf)
+        loc = hist_local[0].sum(axis=0)
+        pf = per_feature_numerical(hist_local, loc[0], loc[1], loc[2],
+                                   meta, params_local, cmin, cmax, fmask)
+        top_gain, top_ids = jax.lax.top_k(pf.score, k)
+        # weighted gain: local leaf count relative to the mean shard count
+        mean_cnt = c / num_machines
+        w_gain = jnp.where(jnp.isfinite(top_gain),
+                           top_gain * loc[2] / jnp.maximum(mean_cnt, 1.0),
+                           -jnp.inf)
+        all_ids = jax.lax.all_gather(top_ids, axis).reshape(-1)
+        all_gain = jax.lax.all_gather(w_gain, axis).reshape(-1)
+        # per-feature max weighted gain over all candidates, then top-k
+        feat_gain = jnp.full((f,), -jnp.inf).at[all_ids].max(
+            jnp.where(jnp.isfinite(all_gain), all_gain, -jnp.inf))
+        _, win_ids = jax.lax.top_k(feat_gain, k)
+        # aggregate only the winning columns across the data shards
+        hist_sel = jax.lax.psum(hist_local[win_ids], axis)
+        meta_sel = FeatureMeta(*[m[win_ids] for m in meta])
+        fmask_sel = None if fmask is None else fmask[win_ids]
+        pf_glob = per_feature_numerical(hist_sel, g, h, c, meta_sel,
+                                        params, cmin, cmax, fmask_sel)
+        b = _argmax_first(pf_glob.score).astype(jnp.int32)
+        return assemble_split(pf_glob, b, g, h, params, cmin, cmax,
+                              feature_id=win_ids[b])
+
+    return Comm(reduce_hist=lambda x: x,
+                reduce_sums=lambda x: jax.lax.psum(x, axis),
+                select_split=select)

@@ -146,24 +146,38 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta, *,
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               meta: FeatureMeta, params: SplitParams, num_leaves: int,
-              max_depth: int, num_bins_max: int,
-              hist_method: str) -> GrowResult:
-    """One full leaf-wise tree; jit-compiled once per shape."""
-    n, num_features = binned.shape
+              max_depth: int, num_bins_max: int, hist_method: str,
+              comm=None, binned_hist=None, meta_hist=None) -> GrowResult:
+    """One full leaf-wise tree; jit-compiled once per shape.
+
+    ``comm`` injects the parallel-learner collectives (learner/comm.py);
+    ``binned_hist``/``meta_hist`` override the histogram-build inputs for
+    feature-parallel mode (feature-sharded) while ``binned``/``meta``
+    stay global for row partitioning and the tree arrays.
+    """
+    if comm is None:
+        from .comm import SERIAL_COMM
+        comm = SERIAL_COMM
+    if binned_hist is None:
+        binned_hist = binned
+    if meta_hist is None:
+        meta_hist = meta
+    n = binned.shape[0]
+    num_features_hist = binned_hist.shape[1]
     big_l = num_leaves
     b = num_bins_max
 
     ghc = make_ghc(grad, hess, bag_weight)
-    root_hist = build_histogram(binned, ghc, b, method=hist_method)
-    root_sums = ghc.sum(axis=0)
+    root_hist = comm.reduce_hist(
+        build_histogram(binned_hist, ghc, b, method=hist_method))
+    root_sums = comm.reduce_sums(ghc.sum(axis=0))
     root_g, root_h, root_c = root_sums[0], root_sums[1], root_sums[2]
 
     inf = jnp.float32(jnp.inf)
 
     def scan_leaf(hist, g, h, c, depth, cmin, cmax):
-        res = best_split_numerical(hist, g, h, c, meta, params,
-                                   constraint_min=cmin, constraint_max=cmax,
-                                   feature_mask=feature_mask)
+        res = comm.select_split(hist, g, h, c, meta_hist, params,
+                                cmin, cmax, feature_mask)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
@@ -181,7 +195,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     state = dict(
         k=jnp.int32(1),
         leaf_id=jnp.zeros((n,), jnp.int32),
-        hist=at0(jnp.zeros((big_l, num_features, b, 3), jnp.float32),
+        hist=at0(jnp.zeros((big_l, num_features_hist, b, 3), jnp.float32),
                  root_hist),
         leaf_g=at0(jnp.zeros((big_l,), jnp.float32), root_g),
         leaf_h=at0(jnp.zeros((big_l,), jnp.float32), root_h),
@@ -279,8 +293,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         parent_hist = st["hist"][leaf]
         small = jnp.where(lc <= rc, leaf, new)
         ghc_small = ghc * (leaf_id == small).astype(jnp.float32)[:, None]
-        hist_small = build_histogram(binned, ghc_small, b,
-                                     method=hist_method)
+        hist_small = comm.reduce_hist(
+            build_histogram(binned_hist, ghc_small, b, method=hist_method))
         hist_other = parent_hist - hist_small
         left_small = lc <= rc
         hist_left = jnp.where(left_small, hist_small, hist_other)

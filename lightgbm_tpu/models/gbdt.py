@@ -63,10 +63,10 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _setup_train(self, train_data: Dataset, hist_method: str) -> None:
-        from ..learner.serial import SerialTreeLearner
         cfg = self.config
-        self.learner = SerialTreeLearner(train_data, cfg,
-                                         hist_method=hist_method)
+        from ..parallel import create_tree_learner
+        self.learner = create_tree_learner(
+            cfg.tree_learner, train_data, cfg, hist_method=hist_method)
         self.num_data = train_data.num_data
         if self.objective is not None:
             self.objective.init(train_data.metadata, self.num_data)

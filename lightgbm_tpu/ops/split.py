@@ -140,16 +140,27 @@ def _argmax_last(x, axis):
     return n - 1 - jnp.argmax(rev, axis=axis)
 
 
-def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
-                         meta: FeatureMeta, params: SplitParams,
-                         constraint_min=None, constraint_max=None,
-                         feature_mask: jnp.ndarray | None = None
-                         ) -> SplitResult:
-    """Best numerical split over all features of one leaf.
+class PerFeatureSplits(NamedTuple):
+    """Best split per feature (arrays of shape [F]) — the intermediate
+    the parallel learners exchange (voting: top-k of ``score``;
+    feature-parallel: local argmax then cross-device compare)."""
+    score: jnp.ndarray       # f32 penalized gain above shift, -inf invalid
+    threshold: jnp.ndarray   # i32
+    left_g: jnp.ndarray      # f32
+    left_h: jnp.ndarray      # f32 (eps-free)
+    left_c: jnp.ndarray      # f32
+    default_left: jnp.ndarray  # bool
+
+
+def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+                          meta: FeatureMeta, params: SplitParams,
+                          constraint_min=None, constraint_max=None,
+                          feature_mask: jnp.ndarray | None = None
+                          ) -> PerFeatureSplits:
+    """Per-feature best numerical split of one leaf.
 
     hist: [F, B, 3] (sum_grad, sum_hess, count) per bin.
     parent_*: scalar totals of the leaf.
-    Returns a SplitResult; ``gain`` is -inf when nothing is valid.
     """
     f, b, _ = hist.shape
     p = params
@@ -240,34 +251,67 @@ def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     feat_score = jnp.where(
         feat_valid, (feat_gain - min_gain_shift) * meta.penalty, NEG_INF)
 
-    best_f = _argmax_first(feat_score).astype(jnp.int32)
-    best_gain = feat_score[best_f]
-    best_t = feat_t[best_f]
-    best_use_m = use_m[best_f]
+    # left-side sums at each feature's winning threshold
+    fr = jnp.arange(f)
+    lg_f = jnp.where(use_m, gl_m[fr, t_m], lg_p[fr, t_p])
+    lh_f = jnp.where(use_m, hl_m[fr, t_m], hl_p[fr, t_p])
+    lc_f = jnp.where(use_m, cl_m[fr, t_m], lc_p[fr, t_p])
 
-    # left-side sums at the winning threshold
-    lg = jnp.where(best_use_m, gl_m[best_f, best_t], lg_p[best_f, best_t])
-    lh_eps = jnp.where(best_use_m, hl_m[best_f, best_t],
-                       hl_p[best_f, best_t])
-    lc = jnp.where(best_use_m, cl_m[best_f, best_t], lc_p[best_f, best_t])
+    # default direction: -1 scan => left; 2-bin NaN fix goes right
+    # (feature_histogram.hpp:127-130)
+    dleft_f = use_m & ~((meta.num_bins <= 2)
+                        & (meta.missing == MISSING_NAN_CODE))
+
+    return PerFeatureSplits(score=feat_score, threshold=feat_t,
+                            left_g=lg_f, left_h=lh_f - kEpsilon,
+                            left_c=lc_f, default_left=dleft_f)
+
+
+def assemble_split(pf: PerFeatureSplits, best_f, parent_g, parent_h,
+                   params: SplitParams, constraint_min, constraint_max,
+                   feature_id=None) -> SplitResult:
+    """Gather one feature's per-feature result into a SplitResult.
+
+    ``best_f`` indexes into ``pf``; ``feature_id`` (defaults to best_f)
+    is the feature index recorded in the tree — parallel learners pass
+    the GLOBAL id while indexing their local shard.
+    """
+    p = params
+    parent_h_eps = parent_h + 2.0 * kEpsilon
+    lg = pf.left_g[best_f]
+    lh_eps = pf.left_h[best_f] + kEpsilon
+    lc = pf.left_c[best_f]
     rg = parent_g - lg
     rh_eps = parent_h_eps - lh_eps
     wl = leaf_output(lg, lh_eps, p.lambda_l1, p.lambda_l2, p.max_delta_step,
                      constraint_min, constraint_max)
     wr = leaf_output(rg, rh_eps, p.lambda_l1, p.lambda_l2, p.max_delta_step,
                      constraint_min, constraint_max)
-
-    # default direction: -1 scan => left; 2-bin NaN fix goes right
-    # (feature_histogram.hpp:127-130)
-    dleft = best_use_m
-    nbf = meta.num_bins[best_f]
-    dleft = jnp.where((nbf <= 2)
-                      & (meta.missing[best_f] == MISSING_NAN_CODE),
-                      False, dleft)
-
+    fid = best_f if feature_id is None else feature_id
     return SplitResult(
-        gain=best_gain, feature=best_f, threshold=best_t,
-        default_left=dleft, left_g=lg, left_h=lh_eps - kEpsilon, left_c=lc,
+        gain=pf.score[best_f], feature=jnp.asarray(fid, jnp.int32),
+        threshold=pf.threshold[best_f],
+        default_left=pf.default_left[best_f],
+        left_g=lg, left_h=lh_eps - kEpsilon, left_c=lc,
         left_output=wl, right_output=wr,
         is_cat=jnp.asarray(False),
         cat_bitset=jnp.zeros((MAX_CAT_WORDS,), jnp.uint32))
+
+
+def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+                         meta: FeatureMeta, params: SplitParams,
+                         constraint_min=None, constraint_max=None,
+                         feature_mask: jnp.ndarray | None = None
+                         ) -> SplitResult:
+    """Best numerical split over all features of one leaf
+    (per-feature scan + first-index argmax, the serial composition)."""
+    if constraint_min is None:
+        constraint_min = jnp.float32(-jnp.inf)
+    if constraint_max is None:
+        constraint_max = jnp.float32(jnp.inf)
+    pf = per_feature_numerical(hist, parent_g, parent_h, parent_c, meta,
+                               params, constraint_min, constraint_max,
+                               feature_mask)
+    best_f = _argmax_first(pf.score).astype(jnp.int32)
+    return assemble_split(pf, best_f, parent_g, parent_h, params,
+                          constraint_min, constraint_max)

@@ -1,0 +1,9 @@
+"""Multi-device / multi-host parallel training over a jax.sharding.Mesh."""
+
+from .learners import (DataParallelTreeLearner, FeatureParallelTreeLearner,
+                       VotingParallelTreeLearner, create_tree_learner,
+                       default_mesh)
+
+__all__ = ["DataParallelTreeLearner", "FeatureParallelTreeLearner",
+           "VotingParallelTreeLearner", "create_tree_learner",
+           "default_mesh"]
