@@ -1,0 +1,68 @@
+"""Benchmark: Higgs-like binary GBDT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published Higgs run — 10.5M rows x 28 features,
+500 iterations, num_leaves=255, lr=0.1 in 238.505 s on 2x E5-2670v3
+(docs/Experiments.rst:103-117) = 22.01M row-iterations/second. We measure
+the same quantity (rows * boosting-iterations / wall-clock second) on a
+synthetic Higgs-shaped problem sized to fit a quick bench run, so
+vs_baseline = our_throughput / 22.01e6 (>1 means faster than the
+reference CPU run).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_ROW_ITERS_PER_S = 10_500_000 * 500 / 238.505
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 500_000))
+    f = int(os.environ.get("BENCH_FEATURES", 28))
+    num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    warmup = int(os.environ.get("BENCH_WARMUP_ITERS", 1))
+    iters = int(os.environ.get("BENCH_ITERS", 3))
+
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(n, f).astype(np.float32)
+    logit = (2.0 * X[:, 0] - 1.5 * X[:, 1] + X[:, 2] * X[:, 3]
+             + 0.8 * X[:, 4] * X[:, 5] - X[:, 6])
+    y = (logit + rng.randn(n).astype(np.float32) > 0).astype(np.float32)
+
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": num_leaves,
+        "learning_rate": 0.1, "max_bin": 255, "metric": "",
+        "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    booster = GBDT(cfg, ds)
+
+    for _ in range(warmup):  # compile + autotune
+        booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+    dt = time.perf_counter() - t0
+
+    throughput = n * iters / dt
+    print(json.dumps({
+        "metric": "higgs_like_train_throughput",
+        "value": round(throughput / 1e6, 4),
+        "unit": "Mrow-iters/s",
+        "vs_baseline": round(throughput / BASELINE_ROW_ITERS_PER_S, 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -64,14 +64,16 @@ def default_mesh(num_devices: Optional[int] = None) -> Mesh:
 
 def mesh_from_config(config: Config) -> Mesh:
     """Resolve the shard count the way the reference resolves
-    num_machines (config.h:866): an explicit num_machines > 1 limits the
-    mesh; otherwise every visible device joins it."""
+    num_machines (config.h:866): an explicit num_machines > 1 or
+    n_devices > 0 caps the mesh; otherwise every visible device joins."""
     if config.num_machines > 1:
         return default_mesh(config.num_machines)
+    if config.n_devices > 0:
+        return default_mesh(config.n_devices)
     return default_mesh()
 
 
-def _pad_rows(n: int, d: int) -> int:
+def _round_up(n: int, d: int) -> int:
     return (n + d - 1) // d * d
 
 
@@ -116,7 +118,7 @@ class DataParallelTreeLearner(_MeshLearnerBase):
     def _build(self):
         d = self.num_shards
         n = self.dataset.num_data
-        self._n_pad = _pad_rows(n, d)
+        self._n_pad = _round_up(n, d)
         binned = self.binned
         if self._n_pad != n:
             binned = jnp.pad(binned, ((0, self._n_pad - n), (0, 0)))
@@ -152,7 +154,7 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
         n = self.dataset.num_data
         self._n_pad = n  # rows are replicated, no row padding
         f = self.dataset.num_features
-        self._f_pad = (f + d - 1) // d * d
+        self._f_pad = _round_up(f, d)
         self._f_local = self._f_pad // d
         fpad = self._f_pad - f
         binned_hist = self.binned
@@ -212,7 +214,7 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
     def _build(self):
         d = self.num_shards
         n = self.dataset.num_data
-        self._n_pad = _pad_rows(n, d)
+        self._n_pad = _round_up(n, d)
         binned = self.binned
         if self._n_pad != n:
             binned = jnp.pad(binned, ((0, self._n_pad - n), (0, 0)))
