@@ -36,8 +36,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
-                         _argmax_first, assemble_split, best_split_numerical,
-                         per_feature_numerical)
+                         _argmax_first, assemble_split, best_split,
+                         per_feature_splits)
 
 
 class Comm(NamedTuple):
@@ -48,9 +48,9 @@ class Comm(NamedTuple):
 
 
 def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask):
-    return best_split_numerical(hist, g, h, c, meta, params,
-                                constraint_min=cmin, constraint_max=cmax,
-                                feature_mask=fmask)
+    return best_split(hist, g, h, c, meta, params,
+                      constraint_min=cmin, constraint_max=cmax,
+                      feature_mask=fmask)
 
 
 SERIAL_COMM = Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
@@ -73,8 +73,8 @@ def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
     (the Allreduce of SplitInfo, parallel_tree_learner.h:190-213)."""
 
     def select(hist, g, h, c, meta_local, params, cmin, cmax, fmask):
-        pf = per_feature_numerical(hist, g, h, c, meta_local, params,
-                                   cmin, cmax, fmask)
+        pf = per_feature_splits(hist, g, h, c, meta_local, params,
+                                cmin, cmax, fmask)
         lb = _argmax_first(pf.score).astype(jnp.int32)
         gid = jax.lax.axis_index(axis) * f_local + lb
         res = assemble_split(pf, lb, g, h, params, cmin, cmax,
@@ -103,8 +103,8 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         k = min(top_k, f)
         # local leaf totals (every feature's bins sum to the leaf)
         loc = hist_local[0].sum(axis=0)
-        pf = per_feature_numerical(hist_local, loc[0], loc[1], loc[2],
-                                   meta, params_local, cmin, cmax, fmask)
+        pf = per_feature_splits(hist_local, loc[0], loc[1], loc[2],
+                                meta, params_local, cmin, cmax, fmask)
         top_gain, top_ids = jax.lax.top_k(pf.score, k)
         # weighted gain: local leaf count relative to the mean shard count
         mean_cnt = c / num_machines
@@ -121,8 +121,8 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         hist_sel = jax.lax.psum(hist_local[win_ids], axis)
         meta_sel = FeatureMeta(*[m[win_ids] for m in meta])
         fmask_sel = None if fmask is None else fmask[win_ids]
-        pf_glob = per_feature_numerical(hist_sel, g, h, c, meta_sel,
-                                        params, cmin, cmax, fmask_sel)
+        pf_glob = per_feature_splits(hist_sel, g, h, c, meta_sel,
+                                     params, cmin, cmax, fmask_sel)
         b = _argmax_first(pf_glob.score).astype(jnp.int32)
         return assemble_split(pf_glob, b, g, h, params, cmin, cmax,
                               feature_id=win_ids[b])

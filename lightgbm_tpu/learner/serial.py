@@ -38,8 +38,7 @@ from ..models.tree import Tree, TreeArrays
 from ..ops.histogram import build_histogram, make_ghc
 from ..ops.partition import split_leaf
 from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
-                         MISSING_ZERO_CODE, FeatureMeta, SplitParams,
-                         best_split_numerical)
+                         MISSING_ZERO_CODE, FeatureMeta, SplitParams)
 
 _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
                  MISSING_ZERO: MISSING_ZERO_CODE,
@@ -102,7 +101,10 @@ class SerialTreeLearner:
         self.dataset = dataset
         self.config = config
         self.meta = feature_meta_from_dataset(dataset, config)
-        self.params = split_params_from_config(config)
+        self.params = split_params_from_config(config)._replace(
+            has_categorical=any(
+                dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
+                for i in range(dataset.num_features)))
         self.binned = jnp.asarray(dataset.binned)
         self.num_bins_max = int(dataset.num_bins_array().max(initial=2))
         self.num_leaves = int(config.num_leaves)

@@ -66,6 +66,9 @@ class SplitParams(NamedTuple):
     cat_smooth: float = 10.0
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
+    # static gate: compile the categorical scan only when the dataset
+    # has categorical features (set by the learner)
+    has_categorical: bool = False
 
 
 class SplitResult(NamedTuple):
@@ -150,6 +153,10 @@ class PerFeatureSplits(NamedTuple):
     left_h: jnp.ndarray      # f32 (eps-free)
     left_c: jnp.ndarray      # f32
     default_left: jnp.ndarray  # bool
+    left_output: jnp.ndarray   # f32, constrained
+    right_output: jnp.ndarray  # f32, constrained
+    is_cat: jnp.ndarray        # bool
+    cat_bitset: jnp.ndarray    # uint32 [F, MAX_CAT_WORDS]
 
 
 def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
@@ -262,9 +269,62 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     dleft_f = use_m & ~((meta.num_bins <= 2)
                         & (meta.missing == MISSING_NAN_CODE))
 
-    return PerFeatureSplits(score=feat_score, threshold=feat_t,
-                            left_g=lg_f, left_h=lh_f - kEpsilon,
-                            left_c=lc_f, default_left=dleft_f)
+    # constrained outputs at the winning threshold (vectorized over [F])
+    wl_f = leaf_output(lg_f, lh_f, p.lambda_l1, p.lambda_l2,
+                       p.max_delta_step, constraint_min, constraint_max)
+    wr_f = leaf_output(parent_g - lg_f, parent_h_eps - lh_f, p.lambda_l1,
+                       p.lambda_l2, p.max_delta_step, constraint_min,
+                       constraint_max)
+
+    return PerFeatureSplits(
+        score=feat_score, threshold=feat_t,
+        left_g=lg_f, left_h=lh_f - kEpsilon,
+        left_c=lc_f, default_left=dleft_f,
+        left_output=wl_f, right_output=wr_f,
+        is_cat=jnp.zeros((f,), bool),
+        cat_bitset=jnp.zeros((f, MAX_CAT_WORDS), jnp.uint32))
+
+
+def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+                       meta: FeatureMeta, params: SplitParams,
+                       constraint_min=None, constraint_max=None,
+                       feature_mask: jnp.ndarray | None = None
+                       ) -> PerFeatureSplits:
+    """Numerical + categorical per-feature scan, merged per feature.
+
+    The categorical scan compiles only when ``params.has_categorical``
+    (a static flag) — pure-numerical datasets pay nothing.
+    """
+    if constraint_min is None:
+        constraint_min = jnp.float32(-jnp.inf)
+    if constraint_max is None:
+        constraint_max = jnp.float32(jnp.inf)
+    pf = per_feature_numerical(hist, parent_g, parent_h, parent_c, meta,
+                               params, constraint_min, constraint_max,
+                               feature_mask)
+    if not params.has_categorical:
+        return pf
+    from .split_categorical import per_feature_categorical
+    cat = per_feature_categorical(hist, parent_g, parent_h, parent_c, meta,
+                                  params, constraint_min, constraint_max,
+                                  feature_mask)
+    use = meta.is_categorical
+
+    def sel(a, b):
+        return jnp.where(use, a, b) if a.ndim == 1 \
+            else jnp.where(use[:, None], a, b)
+
+    return PerFeatureSplits(
+        score=sel(cat["score"], pf.score),
+        threshold=pf.threshold,
+        left_g=sel(cat["left_g"], pf.left_g),
+        left_h=sel(cat["left_h"], pf.left_h),
+        left_c=sel(cat["left_c"], pf.left_c),
+        default_left=jnp.where(use, False, pf.default_left),
+        left_output=sel(cat["left_output"], pf.left_output),
+        right_output=sel(cat["right_output"], pf.right_output),
+        is_cat=use & jnp.isfinite(cat["score"]),
+        cat_bitset=sel(cat["bitset"], pf.cat_bitset))
 
 
 def assemble_split(pf: PerFeatureSplits, best_f, parent_g, parent_h,
@@ -276,26 +336,18 @@ def assemble_split(pf: PerFeatureSplits, best_f, parent_g, parent_h,
     is the feature index recorded in the tree — parallel learners pass
     the GLOBAL id while indexing their local shard.
     """
-    p = params
-    parent_h_eps = parent_h + 2.0 * kEpsilon
-    lg = pf.left_g[best_f]
-    lh_eps = pf.left_h[best_f] + kEpsilon
-    lc = pf.left_c[best_f]
-    rg = parent_g - lg
-    rh_eps = parent_h_eps - lh_eps
-    wl = leaf_output(lg, lh_eps, p.lambda_l1, p.lambda_l2, p.max_delta_step,
-                     constraint_min, constraint_max)
-    wr = leaf_output(rg, rh_eps, p.lambda_l1, p.lambda_l2, p.max_delta_step,
-                     constraint_min, constraint_max)
+    del params, constraint_min, constraint_max, parent_g, parent_h
     fid = best_f if feature_id is None else feature_id
     return SplitResult(
         gain=pf.score[best_f], feature=jnp.asarray(fid, jnp.int32),
         threshold=pf.threshold[best_f],
         default_left=pf.default_left[best_f],
-        left_g=lg, left_h=lh_eps - kEpsilon, left_c=lc,
-        left_output=wl, right_output=wr,
-        is_cat=jnp.asarray(False),
-        cat_bitset=jnp.zeros((MAX_CAT_WORDS,), jnp.uint32))
+        left_g=pf.left_g[best_f], left_h=pf.left_h[best_f],
+        left_c=pf.left_c[best_f],
+        left_output=pf.left_output[best_f],
+        right_output=pf.right_output[best_f],
+        is_cat=pf.is_cat[best_f],
+        cat_bitset=pf.cat_bitset[best_f])
 
 
 def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
@@ -312,6 +364,25 @@ def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     pf = per_feature_numerical(hist, parent_g, parent_h, parent_c, meta,
                                params, constraint_min, constraint_max,
                                feature_mask)
+    best_f = _argmax_first(pf.score).astype(jnp.int32)
+    return assemble_split(pf, best_f, parent_g, parent_h, params,
+                          constraint_min, constraint_max)
+
+
+def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
+               meta: FeatureMeta, params: SplitParams,
+               constraint_min=None, constraint_max=None,
+               feature_mask: jnp.ndarray | None = None) -> SplitResult:
+    """Best split (numerical + categorical) over all features of one
+    leaf — the full FindBestThreshold dispatch
+    (feature_histogram.hpp:84-148)."""
+    if constraint_min is None:
+        constraint_min = jnp.float32(-jnp.inf)
+    if constraint_max is None:
+        constraint_max = jnp.float32(jnp.inf)
+    pf = per_feature_splits(hist, parent_g, parent_h, parent_c, meta,
+                            params, constraint_min, constraint_max,
+                            feature_mask)
     best_f = _argmax_first(pf.score).astype(jnp.int32)
     return assemble_split(pf, best_f, parent_g, parent_h, params,
                           constraint_min, constraint_max)
