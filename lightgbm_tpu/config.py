@@ -372,8 +372,8 @@ class Config:
             raise ValueError("learning_rate should be greater than 0")
         if self.is_single_machine():
             self.is_parallel = False
-            if self.tree_learner not in ("serial",) and self.num_machines <= 1 \
-                    and self.n_devices == 1:
+            if self.tree_learner not in ("serial", "partitioned") \
+                    and self.num_machines <= 1 and self.n_devices == 1:
                 # single machine, single device -> serial learner
                 self.tree_learner = "serial"
         else:

@@ -77,8 +77,7 @@ def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
                                 cmin, cmax, fmask)
         lb = _argmax_first(pf.score).astype(jnp.int32)
         gid = jax.lax.axis_index(axis) * f_local + lb
-        res = assemble_split(pf, lb, g, h, params, cmin, cmax,
-                             feature_id=gid)
+        res = assemble_split(pf, lb, feature_id=gid)
         stacked = jax.tree.map(
             lambda x: jax.lax.all_gather(x, axis), res)
         w = jnp.argmax(stacked.gain)
@@ -124,8 +123,7 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         pf_glob = per_feature_splits(hist_sel, g, h, c, meta_sel,
                                      params, cmin, cmax, fmask_sel)
         b = _argmax_first(pf_glob.score).astype(jnp.int32)
-        return assemble_split(pf_glob, b, g, h, params, cmin, cmax,
-                              feature_id=win_ids[b])
+        return assemble_split(pf_glob, b, feature_id=win_ids[b])
 
     return Comm(reduce_hist=lambda x: x,
                 reduce_sums=lambda x: jax.lax.psum(x, axis),

@@ -1,0 +1,363 @@
+"""Partitioned leaf-wise tree learner (the TPU production path).
+
+Reference analog: ``SerialTreeLearner`` + ``DataPartition``
+(serial_tree_learner.cpp:145-192, data_partition.hpp:101-120). Unlike
+``learner/serial.py`` — which keeps a ``leaf_id[N]`` vector and pays a
+FULL-data masked scan per histogram build — this learner keeps the
+training matrix PHYSICALLY PARTITIONED by leaf (contiguous row
+segments, exactly like the reference's ``indices_`` grouped by
+``leaf_begin_``), so each round costs O(leaf rows):
+
+  * split the chosen leaf's segment in place
+    (ops/partition_pallas.py);
+  * build the histogram of the SMALLER child only by streaming its
+    contiguous segment (ops/hist_pallas.py) and derive the sibling by
+    subtraction (serial_tree_learner.cpp:434-436);
+  * run the same vectorized best-split scan (ops/split.py) and cache
+    per-leaf candidates.
+
+The whole tree compiles to one XLA program (``lax.while_loop``); the
+matrix row order persists across trees (only the gh payload is
+repacked per iteration, gathered through the row-id bytes each row
+carries).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import Dataset
+from ..models.tree import Tree, TreeArrays
+from ..ops.hist_pallas import (build_matrix, combine_planes,
+                               extract_row_ids, histogram_segment_raw,
+                               pack_gh)
+from ..ops.partition_pallas import bitset_to_lut, partition_segment
+from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
+from .serial import (GrowResult, feature_meta_from_dataset,
+                     split_params_from_config)
+
+HIST_BLK = 2048
+PART_BLK = 512
+
+
+class PartitionedTreeLearner:
+    """Drop-in for SerialTreeLearner backed by the segment kernels."""
+
+    def __init__(self, dataset: Dataset, config: Config,
+                 hist_method: str = "auto", interpret: Optional[bool] = None):
+        from ..data.binning import BIN_TYPE_CATEGORICAL
+        self.dataset = dataset
+        self.config = config
+        self.meta = feature_meta_from_dataset(dataset, config)
+        self.params = split_params_from_config(config)._replace(
+            has_categorical=any(
+                dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
+                for i in range(dataset.num_features)))
+        self.num_bins_max = int(dataset.num_bins_array().max(initial=2))
+        if self.num_bins_max > 256:
+            raise ValueError(
+                "PartitionedTreeLearner packs bins as uint8 and supports "
+                f"max 256 bins per feature, got {self.num_bins_max}; use "
+                "max_bin<=255 or tree_learner='serial'")
+        self.num_leaves = int(config.num_leaves)
+        self.max_depth = int(config.max_depth)
+        self.num_features = dataset.num_features
+        self.num_data = dataset.num_data
+        if interpret is None:
+            interpret = jax.default_backend() not in ("tpu", "axon")
+        self.interpret = interpret
+        self.mat = build_matrix(jnp.asarray(dataset.binned), HIST_BLK)
+        self.ws = jnp.zeros_like(self.mat)
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              bag_weight: Optional[jnp.ndarray] = None,
+              feature_mask: Optional[jnp.ndarray] = None) -> GrowResult:
+        if bag_weight is None:
+            bag_weight = jnp.ones_like(grad)
+        if feature_mask is None:
+            feature_mask = jnp.ones((self.num_features,), bool)
+        self.mat, self.ws, tree, leaf_id = _grow_partitioned(
+            self.mat, self.ws, grad, hess, bag_weight, feature_mask,
+            self.meta, params=self.params, num_leaves=self.num_leaves,
+            max_depth=self.max_depth, num_bins_max=self.num_bins_max,
+            num_features=self.num_features, n=self.num_data,
+            interpret=self.interpret)
+        return GrowResult(tree=tree, leaf_id=leaf_id)
+
+    def to_host_tree(self, result: GrowResult,
+                     shrinkage: float = 1.0) -> Tree:
+        tree = Tree(jax.device_get(result.tree), dataset=self.dataset)
+        if shrinkage != 1.0:
+            tree.shrink(shrinkage)
+        return tree
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "num_leaves", "max_depth",
+                              "num_bins_max", "num_features", "n",
+                              "interpret"),
+    donate_argnums=(0, 1))
+def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
+                      *, params, num_leaves, max_depth, num_bins_max,
+                      num_features, n, interpret):
+    f = num_features
+    b = num_bins_max
+    big_l = num_leaves
+
+    # repack the gh payload in current row order (rows carry their id)
+    rids = extract_row_ids(mat, f, mat.shape[0])
+    gp = jnp.where(jnp.arange(mat.shape[0]) < n, grad[jnp.clip(rids, 0, n - 1)], 0.0)
+    hp = jnp.where(jnp.arange(mat.shape[0]) < n, hess[jnp.clip(rids, 0, n - 1)], 0.0)
+    cp = jnp.where(jnp.arange(mat.shape[0]) < n,
+                   bag_weight[jnp.clip(rids, 0, n - 1)], 0.0)
+    gp = gp * cp
+    hp = hp * cp
+    mat = pack_gh(mat, f, gp, hp, cp)
+
+    def seg_hist(m, begin, count):
+        raw = histogram_segment_raw(m, begin, count, num_features=f,
+                                    num_bins=b, blk=HIST_BLK,
+                                    interpret=interpret)
+        return combine_planes(raw, f)
+
+    inf = jnp.float32(jnp.inf)
+
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax):
+        res = best_split(hist, g, h, c, meta, params,
+                         constraint_min=cmin, constraint_max=cmax,
+                         feature_mask=feature_mask)
+        blocked = (max_depth > 0) & (depth >= max_depth)
+        return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+
+    root_hist = seg_hist(mat, jnp.int32(0), jnp.int32(n))
+    sums = root_hist[0].sum(axis=0)
+    root_g, root_h, root_c = sums[0], sums[1], sums[2]
+    root_split = scan_leaf(root_hist, root_g, root_h, root_c,
+                           jnp.int32(0), -inf, inf)
+    root_out = leaf_output_no_constraint(
+        root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
+        params.max_delta_step)
+
+    def at0(arr, val):
+        return arr.at[0].set(val)
+
+    state = dict(
+        k=jnp.int32(1),
+        mat=mat, ws=ws,
+        leaf_begin=jnp.zeros((big_l,), jnp.int32),
+        leaf_cnt=at0(jnp.zeros((big_l,), jnp.int32), jnp.int32(n)),
+        hist=at0(jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist),
+        leaf_g=at0(jnp.zeros((big_l,), jnp.float32), root_g),
+        leaf_h=at0(jnp.zeros((big_l,), jnp.float32), root_h),
+        leaf_c=at0(jnp.zeros((big_l,), jnp.float32), root_c),
+        bs_gain=at0(jnp.full((big_l,), -jnp.inf), root_split.gain),
+        bs_feat=at0(jnp.zeros((big_l,), jnp.int32), root_split.feature),
+        bs_thr=at0(jnp.zeros((big_l,), jnp.int32), root_split.threshold),
+        bs_dleft=at0(jnp.zeros((big_l,), bool), root_split.default_left),
+        bs_lg=at0(jnp.zeros((big_l,), jnp.float32), root_split.left_g),
+        bs_lh=at0(jnp.zeros((big_l,), jnp.float32), root_split.left_h),
+        bs_lc=at0(jnp.zeros((big_l,), jnp.float32), root_split.left_c),
+        bs_lout=at0(jnp.zeros((big_l,), jnp.float32),
+                    root_split.left_output),
+        bs_rout=at0(jnp.zeros((big_l,), jnp.float32),
+                    root_split.right_output),
+        bs_iscat=at0(jnp.zeros((big_l,), bool), root_split.is_cat),
+        bs_bitset=at0(jnp.zeros((big_l, MAX_CAT_WORDS), jnp.uint32),
+                      root_split.cat_bitset),
+        ref_node=jnp.full((big_l,), -1, jnp.int32),
+        ref_side=jnp.zeros((big_l,), jnp.int32),
+        leaf_cmin=jnp.full((big_l,), -jnp.inf, jnp.float32),
+        leaf_cmax=jnp.full((big_l,), jnp.inf, jnp.float32),
+        split_feature=jnp.zeros((big_l - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((big_l - 1,), jnp.int32),
+        decision_type=jnp.zeros((big_l - 1,), jnp.int32),
+        left_child=jnp.zeros((big_l - 1,), jnp.int32),
+        right_child=jnp.zeros((big_l - 1,), jnp.int32),
+        split_gain_arr=jnp.zeros((big_l - 1,), jnp.float32),
+        internal_value=jnp.zeros((big_l - 1,), jnp.float32),
+        internal_weight=jnp.zeros((big_l - 1,), jnp.float32),
+        internal_count=jnp.zeros((big_l - 1,), jnp.float32),
+        cat_bitsets=jnp.zeros((big_l - 1, MAX_CAT_WORDS), jnp.uint32),
+        leaf_value=at0(jnp.zeros((big_l,), jnp.float32), root_out),
+        leaf_weight=at0(jnp.zeros((big_l,), jnp.float32), root_h),
+        leaf_count=at0(jnp.zeros((big_l,), jnp.float32), root_c),
+        leaf_parent=jnp.full((big_l,), -1, jnp.int32),
+        leaf_depth=jnp.zeros((big_l,), jnp.int32),
+    )
+
+    leaf_range = jnp.arange(big_l)
+
+    def cond(st):
+        open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
+        return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
+
+    def body(st):
+        k = st["k"]
+        open_gain = jnp.where(leaf_range < k, st["bs_gain"], -jnp.inf)
+        leaf = jnp.argmax(open_gain).astype(jnp.int32)
+        new = k
+        s = k - 1
+
+        feat = st["bs_feat"][leaf]
+        thr = st["bs_thr"][leaf]
+        dleft = st["bs_dleft"][leaf]
+        gain = st["bs_gain"][leaf]
+        is_cat = st["bs_iscat"][leaf]
+        bitset = st["bs_bitset"][leaf]
+        lg, lh, lc = st["bs_lg"][leaf], st["bs_lh"][leaf], st["bs_lc"][leaf]
+        pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
+            st["leaf_c"][leaf]
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+
+        begin = st["leaf_begin"][leaf]
+        cnt = st["leaf_cnt"][leaf]
+
+        # ---- physical partition of the leaf's segment ----------------
+        lut = jnp.where(is_cat, bitset_to_lut(bitset),
+                        jnp.zeros((1, 256), jnp.float32))
+        mat2, ws2, nl1 = partition_segment(
+            st["mat"], st["ws"], begin, cnt, feat, thr,
+            dleft.astype(jnp.int32), meta.missing[feat],
+            meta.default_bin[feat], meta.num_bins[feat],
+            is_cat.astype(jnp.int32), lut, blk=PART_BLK,
+            interpret=interpret)
+        nl = nl1[0]
+        nr = cnt - nl
+
+        # ---- smaller child histogram + sibling subtraction -----------
+        parent_hist = st["hist"][leaf]
+        left_small = nl <= nr
+        sb = jnp.where(left_small, begin, begin + nl)
+        sc = jnp.minimum(nl, nr)
+        hist_small = seg_hist(mat2, sb, sc)
+        hist_other = parent_hist - hist_small
+        hist_left = jnp.where(left_small, hist_small, hist_other)
+        hist_right = jnp.where(left_small, hist_other, hist_small)
+
+        # ---- tree arrays (same bookkeeping as learner/serial.py) -----
+        dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
+        upd = st["ref_node"][leaf] >= 0
+        pnode = jnp.where(upd, st["ref_node"][leaf], 0)
+        pside = st["ref_side"][leaf]
+        left_child = st["left_child"].at[pnode].set(
+            jnp.where(upd & (pside == 0), s, st["left_child"][pnode]))
+        right_child = st["right_child"].at[pnode].set(
+            jnp.where(upd & (pside == 1), s, st["right_child"][pnode]))
+        left_child = left_child.at[s].set(~leaf)
+        right_child = right_child.at[s].set(~new)
+
+        depth = st["leaf_depth"][leaf] + 1
+        parent_out = leaf_output_no_constraint(
+            pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
+            params.max_delta_step)
+
+        # ---- monotone constraint propagation -------------------------
+        mono = meta.monotone[feat]
+        mid = (lout + rout) * 0.5
+        pcmin, pcmax = st["leaf_cmin"][leaf], st["leaf_cmax"][leaf]
+        numerical = ~is_cat
+        cmin_l = jnp.where(numerical & (mono < 0),
+                           jnp.maximum(pcmin, mid), pcmin)
+        cmax_l = jnp.where(numerical & (mono > 0),
+                           jnp.minimum(pcmax, mid), pcmax)
+        cmin_r = jnp.where(numerical & (mono > 0),
+                           jnp.maximum(pcmin, mid), pcmin)
+        cmax_r = jnp.where(numerical & (mono < 0),
+                           jnp.minimum(pcmax, mid), pcmax)
+
+        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l)
+        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r)
+
+        def set2(arr, va, vb):
+            return arr.at[leaf].set(va).at[new].set(vb)
+
+        st2 = dict(st)
+        st2.update(
+            k=k + 1,
+            mat=mat2, ws=ws2,
+            leaf_begin=set2(st["leaf_begin"], begin, begin + nl),
+            leaf_cnt=set2(st["leaf_cnt"], nl, nr),
+            hist=st["hist"].at[leaf].set(hist_left).at[new].set(hist_right),
+            leaf_g=set2(st["leaf_g"], lg, rg),
+            leaf_h=set2(st["leaf_h"], lh, rh),
+            leaf_c=set2(st["leaf_c"], lc, rc),
+            bs_gain=set2(st["bs_gain"], split_l.gain, split_r.gain),
+            bs_feat=set2(st["bs_feat"], split_l.feature, split_r.feature),
+            bs_thr=set2(st["bs_thr"], split_l.threshold,
+                        split_r.threshold),
+            bs_dleft=set2(st["bs_dleft"], split_l.default_left,
+                          split_r.default_left),
+            bs_lg=set2(st["bs_lg"], split_l.left_g, split_r.left_g),
+            bs_lh=set2(st["bs_lh"], split_l.left_h, split_r.left_h),
+            bs_lc=set2(st["bs_lc"], split_l.left_c, split_r.left_c),
+            bs_lout=set2(st["bs_lout"], split_l.left_output,
+                         split_r.left_output),
+            bs_rout=set2(st["bs_rout"], split_l.right_output,
+                         split_r.right_output),
+            bs_iscat=set2(st["bs_iscat"], split_l.is_cat, split_r.is_cat),
+            bs_bitset=set2(st["bs_bitset"], split_l.cat_bitset,
+                           split_r.cat_bitset),
+            ref_node=set2(st["ref_node"], s, s),
+            ref_side=set2(st["ref_side"], 0, 1),
+            leaf_cmin=set2(st["leaf_cmin"], cmin_l, cmin_r),
+            leaf_cmax=set2(st["leaf_cmax"], cmax_l, cmax_r),
+            split_feature=st["split_feature"].at[s].set(feat),
+            threshold_bin=st["threshold_bin"].at[s].set(thr),
+            decision_type=st["decision_type"].at[s].set(dec),
+            left_child=left_child,
+            right_child=right_child,
+            split_gain_arr=st["split_gain_arr"].at[s].set(gain),
+            internal_value=st["internal_value"].at[s].set(parent_out),
+            internal_weight=st["internal_weight"].at[s].set(ph),
+            internal_count=st["internal_count"].at[s].set(pc),
+            cat_bitsets=st["cat_bitsets"].at[s].set(bitset),
+            leaf_value=set2(st["leaf_value"], lout, rout),
+            leaf_weight=set2(st["leaf_weight"], lh, rh),
+            leaf_count=set2(st["leaf_count"], lc, rc),
+            leaf_parent=set2(st["leaf_parent"], s, s),
+            leaf_depth=set2(st["leaf_depth"], depth, depth),
+        )
+        return st2
+
+    st = jax.lax.while_loop(cond, body, state)
+
+    tree = TreeArrays(
+        num_leaves=st["k"],
+        split_feature=st["split_feature"],
+        threshold_bin=st["threshold_bin"],
+        decision_type=st["decision_type"],
+        left_child=st["left_child"],
+        right_child=st["right_child"],
+        split_gain=st["split_gain_arr"],
+        internal_value=st["internal_value"],
+        internal_weight=st["internal_weight"],
+        internal_count=st["internal_count"],
+        leaf_value=st["leaf_value"],
+        leaf_weight=st["leaf_weight"],
+        leaf_count=st["leaf_count"],
+        leaf_parent=st["leaf_parent"],
+        leaf_depth=st["leaf_depth"],
+        cat_bitsets=st["cat_bitsets"],
+    )
+
+    # ---- leaf_id reconstruction: segments -> positions -> row ids ----
+    used = leaf_range < st["k"]
+    begin_eff = jnp.where(used, st["leaf_begin"], n + 1)
+    order_leaves = jnp.argsort(begin_eff)
+    bounds = begin_eff[order_leaves]
+    pos = jnp.arange(n)
+    seg_idx = jnp.searchsorted(bounds, pos, side="right") - 1
+    pos_leaf = order_leaves[jnp.clip(seg_idx, 0, big_l - 1)].astype(
+        jnp.int32)
+    rids_final = extract_row_ids(st["mat"], f, mat.shape[0])[:n]
+    leaf_id = jnp.zeros((n,), jnp.int32).at[
+        jnp.clip(rids_final, 0, n - 1)].set(pos_leaf)
+
+    return st["mat"], st["ws"], tree, leaf_id

@@ -1,0 +1,259 @@
+"""Pallas TPU histogram kernel over a dynamic row segment.
+
+Reference analog: the OpenCL histogram kernels
+(``src/treelearner/ocl/histogram256.cl``) + ``DenseBin::
+ConstructHistogramInner`` (dense_bin.hpp:76-105). The GPU reference
+scatter-adds into workgroup-local memory with float atomics; TPUs have
+no scatter-add, so the kernel is reformulated for the MXU: per bin b,
+
+    hist[b] += lhs[win, 8]^T @ (mat == b)[win, C]
+
+one bf16 matmul whose one-hot factor is exact and whose gh operand is a
+bf16 hi/lo pair summing to the f32 value — full f32 fidelity on the
+bf16 datapath (the reference's ``gpu_use_dp`` story one level up,
+gpu_tree_learner.cpp:299).
+
+**Single training-matrix layout.** Everything a tree build touches
+rides in ONE row-major uint8 matrix (the TPU analog of the reference
+packing 4 dense feature groups per 32-bit word, Feature4,
+gpu_tree_learner.h:75-77):
+
+    cols [0, F)        feature bins (u8)
+    col  F+0..3        grad f32 bytes (little-endian)
+    col  F+4..7        hess f32 bytes
+    col  F+8           bagging/count indicator (0/1)
+    col  F+9..12       row id (i32 bytes; partition bookkeeping)
+    C = round_up(F+13, 128)
+
+Since XLA pads a [N, F] u8 array's minor dim to 128 anyway, these
+payload columns are FREE whenever F % 128 <= 115 — and one buffer
+means the partition kernel moves rows once and the histogram kernel
+issues one DMA stream.
+
+The segment [begin, begin+count) is DYNAMIC — per-leaf cost is
+O(leaf rows), not O(N) (the point of partitioned layout; LightGBM
+scans only the leaf's rows via DataPartition, data_partition.hpp:161).
+DMA windows start at the 8-aligned floor of `begin` (Mosaic granule
+for u8 rows); the in-window shift is masked via the gh operand, so no
+dynamic VMEM slicing is needed anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ALIGN = 8          # Mosaic offset granule for u8 2-D row slices
+GH_COLS = 13       # payload columns appended after the features
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def matrix_cols(num_features: int) -> int:
+    return _round_up(num_features + GH_COLS, 128)
+
+
+def matrix_rows(n: int, blk: int = 2048) -> int:
+    # slack so any window [base + k*blk, +blk+ALIGN) stays in bounds
+    return _round_up(n, blk) + blk + ALIGN
+
+
+def _split_hi_lo_f32(x):
+    """bf16 hi/lo pair summing to f32 x. The hi part TRUNCATES the
+    mantissa via integer masking — a plain astype(bf16).astype(f32)
+    round-trip is folded to identity under XLA's
+    allow-excess-precision, which would silently drop the residual."""
+    hi_f32 = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(x, jnp.uint32)
+        & jnp.uint32(0xFFFF0000), jnp.float32)
+    return hi_f32.astype(jnp.bfloat16), (x - hi_f32).astype(jnp.bfloat16)
+
+
+def build_matrix(binned, blk: int = 2048) -> jnp.ndarray:
+    """[N, F] int bins -> training matrix [N_pad, C] u8 with row ids."""
+    n, f = binned.shape
+    mat = jnp.zeros((matrix_rows(n, blk), matrix_cols(f)), jnp.uint8)
+    mat = mat.at[:n, :f].set(binned.astype(jnp.uint8))
+    rid = jnp.arange(n, dtype=jnp.uint32)
+    for k in range(4):
+        mat = mat.at[:n, f + 9 + k].set(
+            ((rid >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)).astype(
+                jnp.uint8))
+    return mat
+
+
+def pack_gh(mat, num_features: int, grad, hess, cnt) -> jnp.ndarray:
+    """Write the gh payload columns for rows [0, len(grad))."""
+    f = num_features
+    planes = []
+    for v in (grad, hess):
+        u = jax.lax.bitcast_convert_type(v.astype(jnp.float32),
+                                         jnp.uint32)
+        planes += [((u >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)).astype(
+            jnp.uint8) for k in range(4)]
+    planes.append((cnt > 0).astype(jnp.uint8))
+    payload = jnp.stack(planes, axis=1)            # [n, 9]
+    return jax.lax.dynamic_update_slice(mat, payload, (0, f))
+
+
+def extract_row_ids(mat, num_features: int, n: int) -> jnp.ndarray:
+    """Recover i32 row ids from the payload columns (rows [0, n))."""
+    f = num_features
+    b = [mat[:n, f + 9 + k].astype(jnp.uint32) for k in range(4)]
+    return (b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)).astype(
+        jnp.int32)
+
+
+def _hist_seg_kernel(scal_ref,          # SMEM [2] (begin, count)
+                     mat_hbm,           # ANY  [N_pad, C] u8
+                     out_ref,           # VMEM [B, 8, C] f32
+                     buf, sems,         # VMEM [2, win, C] u8, DMA sems [2]
+                     *, blk: int, num_bins: int, cols: int, feat0: int):
+    begin = scal_ref[0]
+    count = scal_ref[1]
+    nblk = pl.cdiv(count, blk)
+    base = (begin // ALIGN) * ALIGN
+    shift = begin - base
+    win = blk + ALIGN
+
+    def dma(slot, i):
+        start = pl.multiple_of(base + i * blk, ALIGN)
+        return pltpu.make_async_copy(
+            mat_hbm.at[pl.ds(start, win), :], buf.at[slot], sems.at[slot])
+
+    out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(nblk > 0)
+    def _():
+        dma(0, 0).start()
+
+    def block_body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < nblk)
+        def _():
+            dma(1 - slot, i + 1).start()
+
+        dma(slot, i).wait()
+        mat = buf[slot]                              # [win, C] u8
+
+        # Mosaic only casts to/from 32-bit types: everything hops
+        # through i32/f32.
+        mat_i32 = mat.astype(jnp.int32)              # [win, C]
+
+        rem = jnp.minimum(count - i * blk, blk)
+        row = jax.lax.broadcasted_iota(jnp.int32, (win, 1), 0)
+        valid = jnp.where((row >= shift) & (row < shift + rem),
+                          jnp.float32(1), jnp.float32(0))   # [win, 1]
+
+        def i32b(c):
+            return mat_i32[:, c:c + 1]
+
+        def f32col(c):                               # little-endian f32
+            # mul-add instead of shift-or: i32 `<< 16` miscompiles on
+            # this Mosaic version (observed on v5e); multiplies are
+            # exact (i32 wraparound gives the same bit pattern)
+            u = (i32b(c) + i32b(c + 1) * 256 + i32b(c + 2) * 65536
+                 + i32b(c + 3) * 16777216)
+            return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+        g = f32col(feat0 + 0) * valid
+        h = f32col(feat0 + 4) * valid
+        cnt = (mat_i32[:, feat0 + 8:feat0 + 9].astype(jnp.float32)
+               * valid)
+        g_hi, g_lo = _split_hi_lo_f32(g)
+        h_hi, h_lo = _split_hi_lo_f32(h)
+        cnt_bf = cnt.astype(jnp.bfloat16)            # 0/1: exact
+        zero = jnp.zeros_like(cnt_bf)
+        lhs = jnp.concatenate(
+            [g_hi, g_lo, h_hi, h_lo, cnt_bf, zero, zero, zero],
+            axis=1)                                  # [win, 8] bf16
+
+        def bin_body(b, _):
+            mask = jnp.where(mat_i32 == b, jnp.float32(1),
+                             jnp.float32(0)).astype(jnp.bfloat16)
+            res = jax.lax.dot_general(
+                lhs, mask, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [8, C]
+            out_ref[b] += res
+            return 0
+
+        jax.lax.fori_loop(0, num_bins, bin_body, 0, unroll=True)
+        return 0
+
+    jax.lax.fori_loop(0, nblk, block_body, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_features", "num_bins", "blk", "interpret"))
+def histogram_segment_raw(mat, begin, count, *, num_features: int,
+                          num_bins: int, blk: int = 2048,
+                          interpret: bool = False):
+    """Raw kernel call on the training matrix. Returns [B, 8, C] f32
+    accumulator planes (combine with ``combine_planes``)."""
+    if blk % ALIGN:
+        raise ValueError(f"blk must be a multiple of {ALIGN}, got {blk}")
+    _, cols = mat.shape
+    scal = jnp.stack([jnp.asarray(begin, jnp.int32),
+                      jnp.asarray(count, jnp.int32)])
+    kernel = functools.partial(_hist_seg_kernel, blk=blk,
+                               num_bins=num_bins, cols=cols,
+                               feat0=num_features)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((num_bins, 8, cols), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, blk + ALIGN, cols), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(scal, mat)
+
+
+def combine_planes(raw: jnp.ndarray, num_features: int) -> jnp.ndarray:
+    """[B, 8, C] accumulator planes -> [F, B, 3] histogram."""
+    g = raw[:, 0] + raw[:, 1]
+    h = raw[:, 2] + raw[:, 3]
+    c = raw[:, 4]
+    hist = jnp.stack([g, h, c], axis=-1)           # [B, C, 3]
+    return hist.transpose(1, 0, 2)[:num_features]  # [F, B, 3]
+
+
+def histogram_segment(mat, begin, count, num_bins: int, num_features: int,
+                      blk: int = 2048, interpret: bool = False
+                      ) -> jnp.ndarray:
+    """Histogram of rows [begin, begin+count) -> [F, B, 3] f32."""
+    raw = histogram_segment_raw(mat, begin, count,
+                                num_features=num_features,
+                                num_bins=num_bins, blk=blk,
+                                interpret=interpret)
+    return combine_planes(raw, num_features)
+
+
+def histogram_pallas(binned, ghc, num_bins: int, blk: int = 2048,
+                     interpret: bool | None = None) -> jnp.ndarray:
+    """Drop-in full-range histogram (ops/histogram.py "pallas" method).
+
+    binned [N, F] int, ghc [N, 3] f32 -> [F, B, 3] f32. Builds the
+    training matrix on the fly — the partitioned learner keeps it
+    resident instead.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    n, f = binned.shape
+    mat = build_matrix(binned, blk)
+    mat = pack_gh(mat, f, ghc[:, 0], ghc[:, 1], ghc[:, 2])
+    return histogram_segment(mat, 0, n, num_bins, f, blk=blk,
+                             interpret=interpret)

@@ -327,8 +327,7 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         cat_bitset=sel(cat["bitset"], pf.cat_bitset))
 
 
-def assemble_split(pf: PerFeatureSplits, best_f, parent_g, parent_h,
-                   params: SplitParams, constraint_min, constraint_max,
+def assemble_split(pf: PerFeatureSplits, best_f,
                    feature_id=None) -> SplitResult:
     """Gather one feature's per-feature result into a SplitResult.
 
@@ -336,7 +335,6 @@ def assemble_split(pf: PerFeatureSplits, best_f, parent_g, parent_h,
     is the feature index recorded in the tree — parallel learners pass
     the GLOBAL id while indexing their local shard.
     """
-    del params, constraint_min, constraint_max, parent_g, parent_h
     fid = best_f if feature_id is None else feature_id
     return SplitResult(
         gain=pf.score[best_f], feature=jnp.asarray(fid, jnp.int32),
@@ -365,8 +363,7 @@ def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                                params, constraint_min, constraint_max,
                                feature_mask)
     best_f = _argmax_first(pf.score).astype(jnp.int32)
-    return assemble_split(pf, best_f, parent_g, parent_h, params,
-                          constraint_min, constraint_max)
+    return assemble_split(pf, best_f)
 
 
 def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
@@ -384,5 +381,4 @@ def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                             params, constraint_min, constraint_max,
                             feature_mask)
     best_f = _argmax_first(pf.score).astype(jnp.int32)
-    return assemble_split(pf, best_f, parent_g, parent_h, params,
-                          constraint_min, constraint_max)
+    return assemble_split(pf, best_f)

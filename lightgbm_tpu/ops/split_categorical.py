@@ -181,7 +181,11 @@ def per_feature_categorical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     bits = jnp.where(use_onehot[:, None], oh_bits, mm_bits)
     l2_f = jnp.where(use_onehot, p.lambda_l2, l2m)
 
-    valid = jnp.isfinite(best) & meta.is_categorical
+    # the left set is materialized as a 256-bin bitset (MAX_CAT_WORDS);
+    # wider categorical features cannot be represented — invalidate them
+    # rather than silently truncating the set
+    valid = jnp.isfinite(best) & meta.is_categorical \
+        & (meta.num_bins <= 32 * MAX_CAT_WORDS)
     if feature_mask is not None:
         valid &= feature_mask
     score = jnp.where(valid, (best - min_gain_shift) * meta.penalty,

@@ -246,7 +246,10 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
         self._fn = functools.partial(sharded, self.binned)
 
 
+from ..learner.partitioned import PartitionedTreeLearner
+
 _LEARNERS = {"serial": SerialTreeLearner,
+             "partitioned": PartitionedTreeLearner,
              "data": DataParallelTreeLearner,
              "feature": FeatureParallelTreeLearner,
              "voting": VotingParallelTreeLearner}
@@ -262,5 +265,13 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
     if cls is None:
         raise ValueError(f"unknown tree_learner {learner_type}")
     if cls is SerialTreeLearner:
+        # on TPU the partitioned learner IS the serial algorithm, with
+        # O(leaf rows) per-split cost (the production single-chip path);
+        # it packs bins as uint8, so >256-bin datasets fall back
+        if jax.default_backend() in ("tpu", "axon") \
+                and int(dataset.num_bins_array().max(initial=2)) <= 256:
+            return PartitionedTreeLearner(dataset, config)
         return SerialTreeLearner(dataset, config, hist_method=hist_method)
+    if cls is PartitionedTreeLearner:
+        return PartitionedTreeLearner(dataset, config)
     return cls(dataset, config, mesh=mesh, hist_method=hist_method)
