@@ -1,0 +1,9 @@
+"""Model IO: LightGBM-compatible text format, JSON dump, SHAP."""
+
+from .model_text import (dump_model_json, load_model_from_file,
+                         load_model_from_string, save_model_to_file,
+                         save_model_to_string)
+
+__all__ = ["save_model_to_string", "save_model_to_file",
+           "load_model_from_string", "load_model_from_file",
+           "dump_model_json"]

@@ -1,0 +1,533 @@
+"""Model text serialization in the reference's format.
+
+Reference analog: ``GBDT::SaveModelToString`` / ``LoadModelFromString``
+(src/boosting/gbdt_model_text.cpp:301-404, 405+) and ``Tree::ToString``
+/ the parsing constructor (src/io/tree.cpp:231-268, 590+). Writing AND
+reading the reference's text format means models interchange with the
+reference's ecosystem (a model trained here loads in reference tools
+and vice versa) and unlocks golden-parity testing.
+
+Layout (version v3):
+    tree
+    version=v3
+    num_class=...            num_tree_per_iteration=...
+    label_index=...          max_feature_idx=...
+    objective=<name + key:value params>
+    [average_output]
+    feature_names=...        [monotone_constraints=...]
+    feature_infos=[min:max] or cat:cat:... per feature
+    tree_sizes=<byte sizes>
+    <blank>
+    Tree=0 ... blocks ...
+    end of trees
+    feature_importances: / parameters: footers
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models.tree import Tree
+
+_MODEL_VERSION = "v3"
+
+# decision_type bit layout (include/LightGBM/tree.h:19-20,220-239)
+K_CAT_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+
+def _missing_bits(code: int) -> int:
+    return (code & 3) << 2
+
+
+def _missing_code_from_bits(decision_type: int) -> int:
+    return (decision_type >> 2) & 3
+
+
+def _fmt(v: float) -> str:
+    """%g-style shortest float formatting used by the reference's
+    ArrayToString (Common::DoubleToStr keeps full double precision)."""
+    s = repr(float(v))
+    return s
+
+
+def _arr(vals, fmt=str) -> str:
+    return " ".join(fmt(v) for v in vals)
+
+
+def _objective_to_string(gbdt) -> str:
+    obj = getattr(gbdt, "objective", None)
+    if obj is None:
+        return ""
+    name = obj.name()
+    parts = [name]
+    if name in ("binary", "multiclassova", "cross_entropy",
+                "cross_entropy_lambda"):
+        if hasattr(obj, "sigmoid"):
+            parts.append(f"sigmoid:{_fmt(obj.sigmoid)}")
+    if name in ("multiclass", "multiclassova"):
+        parts.append(f"num_class:{gbdt.num_class}")
+    if name in ("lambdarank", "rank_xendcg"):
+        pass
+    return " ".join(parts)
+
+
+def _feature_infos(dataset) -> List[str]:
+    """Per-feature value-range strings (Dataset feature_infos_):
+    numerical "[min:max]", categorical "v:v:...", unused "none"."""
+    infos = []
+    from ..data.binning import BIN_TYPE_CATEGORICAL
+    for j in range(dataset.num_total_features):
+        inner = dataset.inner_feature_idx(j)
+        if inner < 0:
+            infos.append("none")
+            continue
+        m = dataset.feature_mapper(inner)
+        if m.bin_type == BIN_TYPE_CATEGORICAL:
+            cats = sorted(int(c) for c in m.bin_2_categorical if c >= 0)
+            infos.append(":".join(str(c) for c in cats) if cats else "none")
+        else:
+            infos.append(f"[{_fmt(m.min_val)}:{_fmt(m.max_val)}]")
+    return infos
+
+
+def _tree_to_string(tree: Tree, index: int) -> str:
+    n = tree.num_leaves
+    s = _io.StringIO()
+    s.write(f"Tree={index}\n")
+    s.write(f"num_leaves={n}\n")
+    nodes = max(n - 1, 0)
+
+    # categorical nodes: value-space bitsets with boundaries
+    cat_nodes = [i for i in range(nodes)
+                 if tree.decision_type[i] & K_CAT_MASK]
+    num_cat = len(cat_nodes)
+    s.write(f"num_cat={num_cat}\n")
+
+    thresholds = []
+    cat_boundaries = [0]
+    cat_words: List[int] = []
+    cat_idx = 0
+    for i in range(nodes):
+        if tree.decision_type[i] & K_CAT_MASK:
+            cats = np.asarray(tree.cat_threshold[i], np.int64)
+            max_cat = int(cats.max(initial=0))
+            nwords = max_cat // 32 + 1
+            words = [0] * nwords
+            for c in cats:
+                words[int(c) // 32] |= 1 << (int(c) % 32)
+            cat_words.extend(words)
+            cat_boundaries.append(cat_boundaries[-1] + nwords)
+            thresholds.append(float(cat_idx))
+            cat_idx += 1
+        else:
+            thresholds.append(float(tree.threshold[i]))
+
+    dec = [int(tree.decision_type[i])
+           | _missing_bits(int(tree._missing_code[i]))
+           for i in range(nodes)]
+
+    if nodes:
+        s.write("split_feature=" + _arr(tree.split_feature) + "\n")
+        s.write("split_gain=" + _arr(tree.split_gain, _fmt) + "\n")
+        s.write("threshold=" + _arr(thresholds, _fmt) + "\n")
+        s.write("decision_type=" + _arr(dec) + "\n")
+        s.write("left_child=" + _arr(tree.left_child) + "\n")
+        s.write("right_child=" + _arr(tree.right_child) + "\n")
+    else:
+        for k in ("split_feature", "split_gain", "threshold",
+                  "decision_type", "left_child", "right_child"):
+            s.write(f"{k}=\n")
+    s.write("leaf_value=" + _arr(tree.leaf_value, _fmt) + "\n")
+    s.write("leaf_weight=" + _arr(tree.leaf_weight, _fmt) + "\n")
+    s.write("leaf_count=" + _arr(tree.leaf_count) + "\n")
+    if nodes:
+        s.write("internal_value=" + _arr(tree.internal_value, _fmt) + "\n")
+        s.write("internal_weight=" + _arr(tree.internal_weight, _fmt)
+                + "\n")
+        s.write("internal_count="
+                + _arr(tree.internal_count.astype(np.int64)) + "\n")
+    else:
+        for k in ("internal_value", "internal_weight", "internal_count"):
+            s.write(f"{k}=\n")
+    if num_cat > 0:
+        s.write("cat_boundaries=" + _arr(cat_boundaries) + "\n")
+        s.write("cat_threshold=" + _arr(cat_words) + "\n")
+    s.write(f"shrinkage={_fmt(tree.shrinkage)}\n")
+    s.write("\n")
+    return s.getvalue()
+
+
+def save_model_to_string(gbdt, start_iteration: int = 0,
+                         num_iteration: int = -1) -> str:
+    """GBDT::SaveModelToString (gbdt_model_text.cpp:301-393)."""
+    dataset = getattr(gbdt.learner, "dataset", None) \
+        if getattr(gbdt, "learner", None) is not None else None
+    k = gbdt.num_tree_per_iteration
+    out = _io.StringIO()
+    out.write("tree\n")
+    out.write(f"version={_MODEL_VERSION}\n")
+    out.write(f"num_class={gbdt.num_class}\n")
+    out.write(f"num_tree_per_iteration={k}\n")
+    out.write(f"label_index={getattr(gbdt.config, 'label_column_index', 0)}\n")
+    if dataset is not None:
+        max_fidx = dataset.num_total_features - 1
+        names = dataset.feature_names
+    else:
+        max_fidx = int(getattr(gbdt, "max_feature_idx", 0))
+        names = getattr(gbdt, "feature_names",
+                        [f"Column_{i}" for i in range(max_fidx + 1)])
+    out.write(f"max_feature_idx={max_fidx}\n")
+    objective = _objective_to_string(gbdt)
+    if objective:
+        out.write(f"objective={objective}\n")
+    if getattr(gbdt, "average_output", False):
+        out.write("average_output\n")
+    out.write("feature_names=" + " ".join(names) + "\n")
+    mono = getattr(gbdt.config, "monotone_constraints", None)
+    if mono:
+        out.write("monotone_constraints=" + _arr(mono) + "\n")
+    if dataset is not None:
+        out.write("feature_infos=" + " ".join(_feature_infos(dataset))
+                  + "\n")
+    else:
+        out.write("feature_infos="
+                  + " ".join(getattr(gbdt, "feature_infos",
+                                     ["none"] * (max_fidx + 1))) + "\n")
+
+    total_iter = len(gbdt.models) // k
+    start_iteration = min(max(start_iteration, 0), total_iter)
+    n_used = len(gbdt.models)
+    if num_iteration > 0:
+        n_used = min((start_iteration + num_iteration) * k, n_used)
+    start_model = start_iteration * k
+    tree_strs = [_tree_to_string(t, i - start_model)
+                 for i, t in enumerate(gbdt.models[start_model:n_used],
+                                       start=start_model)]
+    out.write("tree_sizes=" + _arr(len(t) for t in tree_strs) + "\n\n")
+    for t in tree_strs:
+        out.write(t)
+    out.write("end of trees\n")
+
+    imp = feature_importance(gbdt, "split",
+                             num_iteration if num_iteration > 0 else 0)
+    pairs = sorted([(int(v), names[i]) for i, v in enumerate(imp) if v > 0],
+                   key=lambda p: -p[0])
+    out.write("\nfeature_importances:\n")
+    for v, name in pairs:
+        out.write(f"{name}={v}\n")
+    out.write("\nparameters:\n")
+    for key, val in gbdt.config.to_params().items():
+        out.write(f"[{key}: {val}]\n")
+    out.write("end of parameters\n")
+    return out.getvalue()
+
+
+def save_model_to_file(gbdt, filename: str, start_iteration: int = 0,
+                       num_iteration: int = -1) -> None:
+    with open(filename, "w") as f:
+        f.write(save_model_to_string(gbdt, start_iteration, num_iteration))
+
+
+# ----------------------------------------------------------------------
+def _parse_tree_block(lines: Dict[str, str]) -> Tree:
+    n = int(lines["num_leaves"])
+    num_cat = int(lines.get("num_cat", "0"))
+
+    def ints(key, default=""):
+        v = lines.get(key, default).split()
+        return np.asarray([int(float(x)) for x in v], np.int32)
+
+    def floats(key):
+        v = lines.get(key, "").split()
+        return np.asarray([float(x) for x in v], np.float64)
+
+    tree = Tree.__new__(Tree)
+    tree.num_leaves = n
+    nodes = max(n - 1, 0)
+    tree.split_feature = ints("split_feature")
+    tree.split_feature_inner = tree.split_feature.copy()
+    tree.split_gain = floats("split_gain").astype(np.float32)
+    thresholds = floats("threshold")
+    tree.decision_type = ints("decision_type")
+    tree.left_child = ints("left_child")
+    tree.right_child = ints("right_child")
+    tree.leaf_value = floats("leaf_value")
+    tree.leaf_weight = floats("leaf_weight") \
+        if lines.get("leaf_weight", "").strip() else np.zeros(n)
+    tree.leaf_count = ints("leaf_count") \
+        if lines.get("leaf_count", "").strip() \
+        else np.zeros(n, np.int32)
+    tree.internal_value = floats("internal_value") \
+        if lines.get("internal_value", "").strip() else np.zeros(nodes)
+    tree.internal_weight = floats("internal_weight") \
+        if lines.get("internal_weight", "").strip() else np.zeros(nodes)
+    tree.internal_count = ints("internal_count") \
+        if lines.get("internal_count", "").strip() \
+        else np.zeros(nodes, np.int64)
+    tree.shrinkage = float(lines.get("shrinkage", "1"))
+    tree.leaf_parent = np.full(n, -1, np.int32)
+    tree.leaf_depth = np.zeros(n, np.int32)
+    tree._missing_code = np.asarray(
+        [_missing_code_from_bits(int(d)) for d in tree.decision_type],
+        np.int32)
+    tree._num_bin = np.zeros(nodes, np.int32)
+    tree._default_bin = np.zeros(nodes, np.int32)
+    from ..ops.split import MAX_CAT_WORDS
+    tree.cat_bitsets = np.zeros((max(nodes, 1), MAX_CAT_WORDS), np.uint32)
+
+    # categorical bitsets back to per-node category lists
+    tree.cat_threshold = []
+    tree.threshold = np.zeros(nodes, np.float64)
+    if num_cat > 0:
+        bounds = ints("cat_boundaries")
+        words = [int(w) & 0xFFFFFFFF for w in
+                 lines.get("cat_threshold", "").split()]
+    for i in range(nodes):
+        if int(tree.decision_type[i]) & K_CAT_MASK:
+            ci = int(thresholds[i])
+            cats = []
+            for w in range(int(bounds[ci]), int(bounds[ci + 1])):
+                for bit in range(32):
+                    if (words[w] >> bit) & 1:
+                        cats.append((w - int(bounds[ci])) * 32 + bit)
+            tree.cat_threshold.append(np.asarray(cats, np.int64))
+            tree.threshold[i] = thresholds[i]
+        else:
+            tree.cat_threshold.append(np.zeros(0, np.int64))
+            tree.threshold[i] = thresholds[i] if nodes else 0.0
+    return tree
+
+
+class LoadedBooster:
+    """Prediction-only booster parsed from model text
+    (GBDT::LoadModelFromString, gbdt_model_text.cpp:405+)."""
+
+    def __init__(self):
+        self.models: List[Tree] = []
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.max_feature_idx = 0
+        self.label_index = 0
+        self.objective_str = ""
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.average_output = False
+        self.monotone_constraints: List[int] = []
+        self.parameters: Dict[str, str] = {}
+
+    @property
+    def num_iterations_trained(self) -> int:
+        return len(self.models) // self.num_tree_per_iteration
+
+    def predict_raw(self, data: np.ndarray,
+                    num_iteration: Optional[int] = None) -> np.ndarray:
+        data = np.asarray(data, np.float64)
+        k = self.num_tree_per_iteration
+        n_models = len(self.models) if num_iteration is None \
+            else min(num_iteration * k, len(self.models))
+        out = np.zeros((data.shape[0], k))
+        for i in range(n_models):
+            out[:, i % k] += self.models[i].predict(data)
+        if self.average_output and n_models:
+            out /= max(n_models // k, 1)
+        return out
+
+    def predict(self, data: np.ndarray,
+                num_iteration: Optional[int] = None) -> np.ndarray:
+        raw = self.predict_raw(data, num_iteration)
+        name = self.objective_str.split(" ")[0] if self.objective_str \
+            else ""
+        if name in ("binary", "cross_entropy", "multiclassova"):
+            sigmoid = 1.0
+            for tok in self.objective_str.split()[1:]:
+                if tok.startswith("sigmoid:"):
+                    sigmoid = float(tok.split(":")[1])
+            return 1.0 / (1.0 + np.exp(-sigmoid * raw))
+        if name == "multiclass":
+            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        if name in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        return raw
+
+    def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, np.float64)
+        return np.stack([t.predict_leaf_index(data)
+                         for t in self.models], axis=1)
+
+
+def load_model_from_string(text: str) -> LoadedBooster:
+    booster = LoadedBooster()
+    lines = text.split("\n")
+    i = 0
+    # header until the first blank line after tree_sizes
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line:
+            if any(ln.startswith("Tree=") for ln in lines[i:i + 2]):
+                break
+            continue
+        if line == "tree" or line.startswith("version="):
+            continue
+        if line == "average_output":
+            booster.average_output = True
+            continue
+        if "=" in line:
+            key, _, val = line.partition("=")
+            if key == "num_class":
+                booster.num_class = int(val)
+            elif key == "num_tree_per_iteration":
+                booster.num_tree_per_iteration = int(val)
+            elif key == "label_index":
+                booster.label_index = int(val)
+            elif key == "max_feature_idx":
+                booster.max_feature_idx = int(val)
+            elif key == "objective":
+                booster.objective_str = val
+            elif key == "feature_names":
+                booster.feature_names = val.split()
+            elif key == "feature_infos":
+                booster.feature_infos = val.split()
+            elif key == "monotone_constraints":
+                booster.monotone_constraints = [int(v) for v in val.split()]
+            elif key == "tree_sizes":
+                break
+    # tree blocks
+    cur: Dict[str, str] = {}
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("Tree="):
+            cur = {}
+            continue
+        if line == "end of trees":
+            if cur:
+                booster.models.append(_parse_tree_block(cur))
+            break
+        if not line:
+            if cur:
+                booster.models.append(_parse_tree_block(cur))
+                cur = {}
+            continue
+        key, _, val = line.partition("=")
+        cur[key] = val
+    # parameters footer
+    in_params = False
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line == "parameters:":
+            in_params = True
+            continue
+        if line == "end of parameters":
+            break
+        if in_params and line.startswith("[") and ":" in line:
+            key, _, val = line[1:-1].partition(": ")
+            booster.parameters[key] = val
+    return booster
+
+
+def load_model_from_file(filename: str) -> LoadedBooster:
+    with open(filename) as f:
+        return load_model_from_string(f.read())
+
+
+# ----------------------------------------------------------------------
+def feature_importance(gbdt, importance_type: str = "split",
+                       num_iteration: int = 0) -> np.ndarray:
+    """GBDT::FeatureImportance (gbdt.cpp:744-778): per-feature split
+    counts or total gains over used iterations."""
+    k = gbdt.num_tree_per_iteration
+    models = gbdt.models
+    if num_iteration > 0:
+        models = models[:num_iteration * k]
+    nf = max((int(t.split_feature.max(initial=-1)) for t in models),
+             default=-1) + 1
+    if getattr(gbdt, "learner", None) is not None:
+        nf = max(nf, gbdt.learner.dataset.num_total_features)
+    out = np.zeros(nf)
+    for t in models:
+        for i in range(t.num_leaves - 1):
+            if t.split_gain[i] > 0:
+                if importance_type == "split":
+                    out[t.split_feature[i]] += 1
+                else:
+                    out[t.split_feature[i]] += t.split_gain[i]
+    return out
+
+
+# ----------------------------------------------------------------------
+def _node_json(tree: Tree, node: int) -> dict:
+    """Tree::NodeToJSON (src/io/tree.cpp:286-340)."""
+    if node < 0:  # leaf
+        leaf = ~node
+        return {
+            "leaf_index": int(leaf),
+            "leaf_value": float(tree.leaf_value[leaf]),
+            "leaf_weight": float(tree.leaf_weight[leaf]),
+            "leaf_count": int(tree.leaf_count[leaf]),
+        }
+    is_cat = bool(tree.decision_type[node] & K_CAT_MASK)
+    d = {
+        "split_index": int(node),
+        "split_feature": int(tree.split_feature[node]),
+        "split_gain": float(tree.split_gain[node]),
+        "threshold": sorted(int(c) for c in tree.cat_threshold[node])
+        if is_cat else float(tree.threshold[node]),
+        "decision_type": "==" if is_cat else "<=",
+        "default_left": bool(tree.decision_type[node]
+                             & K_DEFAULT_LEFT_MASK),
+        "missing_type": ["None", "Zero", "NaN"][
+            int(tree._missing_code[node])],
+        "internal_value": float(tree.internal_value[node]),
+        "internal_weight": float(tree.internal_weight[node]),
+        "internal_count": int(tree.internal_count[node]),
+        "left_child": _node_json(tree, int(tree.left_child[node])),
+        "right_child": _node_json(tree, int(tree.right_child[node])),
+    }
+    return d
+
+
+def dump_model_json(gbdt, start_iteration: int = 0,
+                    num_iteration: int = -1) -> str:
+    """GBDT::DumpModel (gbdt_model_text.cpp:21-115)."""
+    dataset = getattr(gbdt.learner, "dataset", None) \
+        if getattr(gbdt, "learner", None) is not None else None
+    k = gbdt.num_tree_per_iteration
+    names = dataset.feature_names if dataset is not None else \
+        getattr(gbdt, "feature_names", [])
+    n_used = len(gbdt.models)
+    if num_iteration > 0:
+        n_used = min((start_iteration + num_iteration) * k, n_used)
+    start_model = start_iteration * k
+    trees = []
+    for i, t in enumerate(gbdt.models[start_model:n_used]):
+        trees.append({
+            "tree_index": i,
+            "num_leaves": int(t.num_leaves),
+            "num_cat": sum(1 for j in range(t.num_leaves - 1)
+                           if t.decision_type[j] & K_CAT_MASK),
+            "shrinkage": float(t.shrinkage),
+            "tree_structure": _node_json(t, 0) if t.num_leaves > 1
+            else {"leaf_value": float(t.leaf_value[0])},
+        })
+    doc = {
+        "name": "tree",
+        "version": _MODEL_VERSION,
+        "num_class": gbdt.num_class,
+        "num_tree_per_iteration": k,
+        "label_index": getattr(gbdt.config, "label_column_index", 0),
+        "max_feature_idx": (dataset.num_total_features - 1)
+        if dataset is not None else 0,
+        "objective": _objective_to_string(gbdt),
+        "average_output": bool(getattr(gbdt, "average_output", False)),
+        "feature_names": list(names),
+        "tree_info": trees,
+    }
+    return json.dumps(doc, indent=2)
