@@ -82,7 +82,7 @@ def _feature_infos(dataset) -> List[str]:
     infos = []
     from ..data.binning import BIN_TYPE_CATEGORICAL
     for j in range(dataset.num_total_features):
-        inner = dataset.inner_feature_idx(j)
+        inner = dataset.inner_feature_index(j)
         if inner < 0:
             infos.append("none")
             continue
