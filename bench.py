@@ -9,23 +9,28 @@ the same quantity (rows * boosting-iterations / wall-clock second) on a
 synthetic Higgs-shaped problem sized to fit a quick bench run, so
 vs_baseline = our_throughput / 22.01e6 (>1 means faster than the
 reference CPU run).
+
+Robustness: the measurement runs in a child process; transient TPU
+backend init failures are retried (BENCH_INIT_RETRIES, default 3).
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 BASELINE_ROW_ITERS_PER_S = 10_500_000 * 500 / 238.505
 
 
-def main():
+def measure():
+    import numpy as np
+
     n = int(os.environ.get("BENCH_ROWS", 500_000))
     f = int(os.environ.get("BENCH_FEATURES", 28))
     num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
-    warmup = int(os.environ.get("BENCH_WARMUP_ITERS", 1))
-    iters = int(os.environ.get("BENCH_ITERS", 3))
+    warmup = int(os.environ.get("BENCH_WARMUP_ITERS", 2))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
 
     import jax
 
@@ -46,13 +51,11 @@ def main():
     ds = Dataset.from_numpy(X, cfg, label=y)
     booster = GBDT(cfg, ds)
 
-    for _ in range(warmup):  # compile + autotune
-        booster.train_one_iter()
+    booster.train(warmup)  # compile sync (iter 0) + async paths
     jax.block_until_ready(booster.train_score)
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        booster.train_one_iter()
+    booster.train(warmup + iters)
     jax.block_until_ready(booster.train_score)
     dt = time.perf_counter() - t0
 
@@ -62,6 +65,39 @@ def main():
         "value": round(throughput / 1e6, 4),
         "unit": "Mrow-iters/s",
         "vs_baseline": round(throughput / BASELINE_ROW_ITERS_PER_S, 4)}))
+
+
+def main():
+    if os.environ.get("_BENCH_CHILD") == "1":
+        measure()
+        return
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache_tpu"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    last = None
+    for attempt in range(retries):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=3600)
+        except subprocess.TimeoutExpired as e:
+            last = ("timeout", str(e.stdout)[-2000:], str(e.stderr)[-2000:])
+            continue
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                print(line)
+                return
+        last = (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
+        time.sleep(15 * (attempt + 1))
+    sys.stderr.write(
+        f"bench failed after {retries} attempts; last rc={last[0]}\n"
+        f"stdout:\n{last[1]}\nstderr:\n{last[2]}\n")
+    sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -165,6 +165,7 @@ def _tree_to_string(tree: Tree, index: int) -> str:
 def save_model_to_string(gbdt, start_iteration: int = 0,
                          num_iteration: int = -1) -> str:
     """GBDT::SaveModelToString (gbdt_model_text.cpp:301-393)."""
+    getattr(gbdt, "finalize_trees", lambda: None)()
     dataset = getattr(gbdt.learner, "dataset", None) \
         if getattr(gbdt, "learner", None) is not None else None
     k = gbdt.num_tree_per_iteration
@@ -443,6 +444,7 @@ def feature_importance(gbdt, importance_type: str = "split",
                        num_iteration: int = 0) -> np.ndarray:
     """GBDT::FeatureImportance (gbdt.cpp:744-778): per-feature split
     counts or total gains over used iterations."""
+    getattr(gbdt, "finalize_trees", lambda: None)()
     k = gbdt.num_tree_per_iteration
     models = gbdt.models
     if num_iteration > 0:
@@ -497,6 +499,7 @@ def _node_json(tree: Tree, node: int) -> dict:
 def dump_model_json(gbdt, start_iteration: int = 0,
                     num_iteration: int = -1) -> str:
     """GBDT::DumpModel (gbdt_model_text.cpp:21-115)."""
+    getattr(gbdt, "finalize_trees", lambda: None)()
     dataset = getattr(gbdt.learner, "dataset", None) \
         if getattr(gbdt, "learner", None) is not None else None
     k = gbdt.num_tree_per_iteration

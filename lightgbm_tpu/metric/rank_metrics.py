@@ -1,26 +1,138 @@
-"""Ranking metrics: NDCG@k and MAP@k (M2).
+"""Ranking metrics: NDCG@k and MAP@k.
 
-Reference analog: ``src/metric/rank_metric.hpp`` +
-``src/metric/dcg_calculator.cpp`` and ``src/metric/map_metric.hpp``.
+Reference analog: ``src/metric/rank_metric.hpp`` (NDCG) +
+``src/metric/dcg_calculator.cpp`` (discount/gain tables, ideal DCG) and
+``src/metric/map_metric.hpp`` (MAP). Per-query evaluation is host-side
+numpy (metrics are host-side throughout this package); sorts are stable
+descending by score exactly like the reference's ``std::stable_sort``.
 """
 
 from __future__ import annotations
 
+from typing import List
+
+import numpy as np
+
+from ..objective.rank import (check_rank_labels, max_dcg_at_k,
+                              resolve_label_gain)
 from ..utils.log import log_fatal
 from .metrics import Metric
 
 
-class NDCGMetric(Metric):
+def _default_eval_at(ks) -> List[int]:
+    """DCGCalculator::DefaultEvalAt (dcg_calculator.cpp:20-31)."""
+    ks = [int(k) for k in ks]
+    if not ks:
+        return [1, 2, 3, 4, 5]
+    if any(k <= 0 for k in ks):
+        log_fatal("eval_at positions must be positive")
+    return ks
+
+
+class _RankMetric(Metric):
+    factor_to_bigger_better = 1.0
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = _default_eval_at(config.eval_at)
+
+    @property
+    def names(self) -> List[str]:
+        return [f"{self.name}@{k}" for k in self.eval_at]
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        qb = metadata.query_boundaries
+        if qb is None:
+            log_fatal(f"The {self.name.upper()} metric requires query "
+                      "information")
+        self.query_boundaries = np.asarray(qb, np.int64)
+        self.num_queries = len(self.query_boundaries) - 1
+        self.query_weights = None if metadata.query_weights is None \
+            else np.asarray(metadata.query_weights, np.float64)
+        self.sum_query_weights = float(self.num_queries) \
+            if self.query_weights is None \
+            else float(self.query_weights.sum())
+
+    def _query_rows(self, i):
+        return slice(int(self.query_boundaries[i]),
+                     int(self.query_boundaries[i + 1]))
+
+    def _weighted_mean(self, per_query: np.ndarray) -> np.ndarray:
+        """per_query [nq, K] -> [K] query-weight-averaged."""
+        if self.query_weights is not None:
+            per_query = per_query * self.query_weights[:, None]
+        return per_query.sum(axis=0) / self.sum_query_weights
+
+
+class NDCGMetric(_RankMetric):
+    """NDCGMetric (rank_metric.hpp:19-168)."""
+
     name = "ndcg"
-    factor_to_bigger_better = 1.0
 
-    def init(self, metadata, num_data):
-        log_fatal("ndcg metric lands in M2 (rank_metric.hpp port)")
+    def __init__(self, config):
+        super().__init__(config)
+        self.label_gain = resolve_label_gain(config)
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        check_rank_labels(self.label, len(self.label_gain))
+        max_q = int(np.diff(self.query_boundaries).max())
+        self.discount = 1.0 / np.log2(2.0 + np.arange(max_q))
+        # cache inverse ideal DCG per (query, k); negative queries -> -1
+        self.inverse_max_dcgs = np.zeros((self.num_queries,
+                                          len(self.eval_at)))
+        for i in range(self.num_queries):
+            lab = self.label[self._query_rows(i)]
+            for j, k in enumerate(self.eval_at):
+                m = max_dcg_at_k(k, lab, self.label_gain, self.discount)
+                self.inverse_max_dcgs[i, j] = 1.0 / m if m > 0 else -1.0
+
+    def eval(self, score, objective) -> List[float]:
+        score = np.asarray(score, np.float64)
+        out = np.zeros((self.num_queries, len(self.eval_at)))
+        gain = self.label_gain
+        for i in range(self.num_queries):
+            rows = self._query_rows(i)
+            if self.inverse_max_dcgs[i, 0] <= 0.0:
+                out[i, :] = 1.0  # all-negative query counts as perfect
+                continue
+            lab = self.label[rows].astype(np.int64)
+            order = np.argsort(-score[rows], kind="stable")
+            g = gain[lab[order]] * self.discount[:len(order)]
+            cum = np.cumsum(g)
+            for j, k in enumerate(self.eval_at):
+                kk = min(k, len(order))
+                out[i, j] = cum[kk - 1] * self.inverse_max_dcgs[i, j]
+        return [float(v) for v in self._weighted_mean(out)]
 
 
-class MapMetric(Metric):
+class MapMetric(_RankMetric):
+    """MapMetric (map_metric.hpp:21-166)."""
+
     name = "map"
-    factor_to_bigger_better = 1.0
 
-    def init(self, metadata, num_data):
-        log_fatal("map metric lands in M2 (map_metric.hpp port)")
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        self.npos = np.asarray([
+            int((self.label[self._query_rows(i)] > 0.5).sum())
+            for i in range(self.num_queries)])
+
+    def eval(self, score, objective) -> List[float]:
+        score = np.asarray(score, np.float64)
+        out = np.zeros((self.num_queries, len(self.eval_at)))
+        for i in range(self.num_queries):
+            rows = self._query_rows(i)
+            order = np.argsort(-score[rows], kind="stable")
+            hits = (self.label[rows][order] > 0.5)
+            cumhits = np.cumsum(hits)
+            pos = np.arange(1, len(order) + 1)
+            ap_terms = np.where(hits, cumhits / pos, 0.0)
+            cum_ap = np.cumsum(ap_terms)
+            for j, k in enumerate(self.eval_at):
+                kk = min(k, len(order))
+                if self.npos[i] > 0:
+                    out[i, j] = cum_ap[kk - 1] / min(self.npos[i], kk)
+                else:
+                    out[i, j] = 1.0
+        return [float(v) for v in self._weighted_mean(out)]

@@ -26,7 +26,7 @@ from ..data.dataset import Dataset
 from ..metric import create_metrics
 from ..objective import create_objective
 from ..utils.log import log_fatal, log_info, log_warning
-from .tree import Tree
+from .tree import DeferredTree, Tree, traverse_tree_arrays
 
 kEpsilon = 1e-15
 
@@ -70,7 +70,11 @@ class GBDT:
         self.num_data = train_data.num_data
         if self.objective is not None:
             self.objective.init(train_data.metadata, self.num_data)
-            self._grad_fn = jax.jit(self.objective.gradients)
+            # objectives with per-call host randomness (rank_xendcg)
+            # jit internally instead
+            self._grad_fn = jax.jit(self.objective.gradients) \
+                if getattr(self.objective, "jittable", True) \
+                else self.objective.gradients
         k = self.num_tree_per_iteration
         init = train_data.metadata.init_score
         if init is not None:
@@ -355,16 +359,127 @@ class GBDT:
                 ret = self.best_msg[key]
         return ret
 
+    # ------------------------------------------------------------------
+    # Async (device-resident) iteration path. train_one_iter's public
+    # contract syncs every iteration — ~2 blocking host round trips per
+    # tree (flag check + host tree pull), which dominate wall time on a
+    # tunneled TPU. The async path keeps everything on device:
+    #   * score updates gather straight from the device TreeArrays;
+    #   * valid-set scoring traverses TreeArrays on device;
+    #   * host Tree objects are DeferredTree (batched device_get later);
+    #   * the stop flag is a device bool, flushed every N iterations —
+    #     safe because an un-splittable iteration contributes EXACTLY
+    #     zero to every score (scale 0), so over-run iterations are
+    #     no-ops that truncation removes (matching gbdt.cpp:407-415).
+    _ASYNC_FLUSH = 16
+
+    def _async_supported(self) -> bool:
+        return (type(self).train_one_iter is GBDT.train_one_iter
+                and self.objective is not None
+                and not getattr(self.objective, "is_renew_tree_output",
+                                False)
+                and all(self.class_need_train))
+
+    def _train_one_iter_async(self):
+        """One boosting iteration with zero host syncs. Returns a device
+        bool scalar: True = a real split happened (continue)."""
+        k = self.num_tree_per_iteration
+        score = self.train_score if k > 1 else self.train_score[:, 0]
+        grad, hess = self._grad_fn(score)
+        if k == 1:
+            grad = grad[:, None]
+            hess = hess[:, None]
+        bag = self._bagging_weight(self.iter, grad, hess)
+        fmask = self._feature_mask()
+        flag = None
+        for tid in range(k):
+            result = self.learner.train(grad[:, tid], hess[:, tid],
+                                        bag_weight=bag, feature_mask=fmask)
+            ta = result.tree
+            ok = ta.num_leaves > 1
+            scale = jnp.where(ok, jnp.float32(self.shrinkage_rate),
+                              jnp.float32(0.0))
+            leaf_vals = ta.leaf_value * scale
+            self.train_score = self.train_score.at[:, tid].add(
+                leaf_vals[result.leaf_id])
+            for i, vd in enumerate(self.valid_sets):
+                vadd = traverse_tree_arrays(ta, vd.binned_device,
+                                            self.learner.meta, scale)
+                self.valid_scores[i] = \
+                    self.valid_scores[i].at[:, tid].add(vadd)
+            self.models.append(DeferredTree(
+                ta, self.learner.dataset,
+                shrinkage=self.shrinkage_rate))
+            flag = ok if flag is None else (flag | ok)
+        self.iter += 1
+        return flag
+
+    def finalize_trees(self) -> None:
+        """Materialize every DeferredTree with ONE batched device->host
+        transfer (instead of one blocking sync per tree)."""
+        deferred = [m for m in self.models
+                    if isinstance(m, DeferredTree) and m._tree is None]
+        if not deferred:
+            return
+        hosts = jax.device_get([d._arrays for d in deferred])
+        for d, h in zip(deferred, hosts):
+            d.materialize(host_arrays=h)
+
+    def _truncate_surplus(self, n_iters: int) -> None:
+        """Drop trailing no-op iterations recorded past the true stop
+        point (their score contribution was zero by construction)."""
+        k = self.num_tree_per_iteration
+        del self.models[-n_iters * k:]
+        self.iter -= n_iters
+
     def train(self, num_iterations: Optional[int] = None) -> None:
         """Full training loop (GBDT::Train, gbdt.cpp:245-264)."""
         iters = num_iterations if num_iterations is not None \
             else self.config.num_iterations
+        use_async = self._async_supported()
+        has_eval = bool(self.training_metrics) \
+            or any(len(m) > 0 for m in self.valid_metrics)
+        # batching the stop-flag check is only sound when a no-split
+        # iteration reproduces identically on the next iteration; host
+        # RNG that advances per call (bagging mask, feature sampling)
+        # breaks that, so flush every iteration there
+        cfg = self.config
+        host_rng_per_iter = (
+            cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
+                                      or cfg.pos_bagging_fraction < 1.0
+                                      or cfg.neg_bagging_fraction < 1.0)
+        ) or cfg.feature_fraction < 1.0
+        flush_every = 1 if (has_eval or host_rng_per_iter) \
+            else self._ASYNC_FLUSH
+        pending: List = []
+        stopped = False
         for it in range(self.iter, iters):
-            stop = self.train_one_iter()
-            if stop:
+            if use_async and self.models:
+                pending.append(self._train_one_iter_async())
+                if len(pending) >= flush_every or it == iters - 1:
+                    flags = [bool(v) for v in jax.device_get(pending)]
+                    pending.clear()
+                    if not all(flags):
+                        self._truncate_surplus(
+                            len(flags) - flags.index(False))
+                        log_warning(
+                            "Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                        stopped = True
+                if stopped:
+                    break
+            else:
+                # first iteration (boost-from-average, constant-tree
+                # fallback) and non-async boosters take the sync path
+                if self.train_one_iter():
+                    break
+            if has_eval and self._eval_and_check_early_stopping():
                 break
-            if self._eval_and_check_early_stopping():
-                break
+        if pending:
+            flags = [bool(v) for v in jax.device_get(pending)]
+            if not all(flags):
+                self._truncate_surplus(len(flags) - flags.index(False))
+        self.finalize_trees()
 
     def _eval_and_check_early_stopping(self) -> bool:
         best_msg = self.output_metric(self.iter)
@@ -403,6 +518,7 @@ class GBDT:
     def predict_raw(self, data: np.ndarray,
                     num_iteration: int = -1) -> np.ndarray:
         """PredictRaw (gbdt_prediction.cpp:13-31) over raw features."""
+        self.finalize_trees()
         data = np.asarray(data, np.float64)
         n = data.shape[0]
         k = self.num_tree_per_iteration

@@ -320,6 +320,120 @@ def _traverse_binned_jax(binned, feat, thr, dec, left, right, miss,
     return leaf_vals[out]
 
 
+class DeferredTree:
+    """A trained tree whose host materialization is deferred.
+
+    The async training path (GBDT.train) keeps every per-iteration
+    product on device; pulling the ~16 TreeArrays buffers to host per
+    tree costs a blocking sync each, so trees are materialized lazily —
+    individually on first attribute access, or in one batched
+    ``jax.device_get`` via ``GBDT.finalize_trees``. Any attribute or
+    method of ``Tree`` works transparently through ``__getattr__``.
+    """
+
+    def __init__(self, arrays: TreeArrays, dataset=None,
+                 shrinkage: float = 1.0):
+        self._arrays = arrays
+        self._dataset = dataset
+        self._pending_shrink = float(shrinkage)
+        self._tree: Optional[Tree] = None
+
+    @property
+    def device_arrays(self) -> Optional[TreeArrays]:
+        return self._arrays
+
+    def shrink(self, rate: float) -> None:
+        if self._tree is not None:
+            self._tree.shrink(rate)
+        else:
+            self._pending_shrink *= rate
+
+    def materialize(self, host_arrays: Optional[TreeArrays] = None) -> Tree:
+        if self._tree is None:
+            a = host_arrays if host_arrays is not None \
+                else jax.device_get(self._arrays)
+            t = Tree(a, dataset=self._dataset)
+            if t.num_leaves <= 1:
+                # un-splittable tree == constant-0 tree (gbdt.cpp:407-415);
+                # the async score update applied scale 0 for it
+                t.leaf_value = np.zeros_like(t.leaf_value)
+            if self._pending_shrink != 1.0:
+                t.shrink(self._pending_shrink)
+            self._tree = t
+            self._arrays = None
+            self._dataset = None
+        return self._tree
+
+    def __getattr__(self, name):
+        # Tree's private per-node arrays (_missing_code etc.) must also
+        # delegate; only this wrapper's own slots terminate the lookup
+        if name in ("_arrays", "_dataset", "_pending_shrink", "_tree"):
+            raise AttributeError(name)
+        return getattr(self.materialize(), name)
+
+
+def traverse_tree_arrays(arrays: TreeArrays, binned_dev, meta,
+                         scale) -> jnp.ndarray:
+    """Device bin-space traversal straight off ``TreeArrays`` — no host
+    round trip. Per-node missing metadata is gathered from the learner's
+    FeatureMeta; ``scale`` multiplies leaf values (shrinkage; pass 0 to
+    nullify an un-splittable tree). Fixed shapes: one compile per
+    (num_leaves_max, N)."""
+    feat = arrays.split_feature
+    miss = meta.missing[feat]
+    dbin = meta.default_bin[feat]
+    nbin = meta.num_bins[feat]
+    leaf_vals = arrays.leaf_value * scale
+    return _traverse_arrays_jax(
+        binned_dev, feat, arrays.threshold_bin, arrays.decision_type,
+        arrays.left_child, arrays.right_child, miss, dbin, nbin,
+        arrays.cat_bitsets, leaf_vals, arrays.num_leaves)
+
+
+@jax.jit
+def _traverse_arrays_jax(binned, feat, thr, dec, left, right, miss,
+                         default_bin, num_bin, cat_bitsets, leaf_vals,
+                         num_leaves):
+    """Like ``_traverse_binned_jax`` but over full-size (num_leaves_max)
+    node arrays with a live ``num_leaves`` scalar: 1-leaf trees resolve
+    to leaf 0 immediately (whose value the caller scaled)."""
+    n = binned.shape[0]
+    rows = jnp.arange(n)
+    fuel_max = leaf_vals.shape[0] + 1
+
+    def cond(state):
+        node, out, done, fuel = state
+        return (~jnp.all(done)) & (fuel < fuel_max)
+
+    def body(state):
+        node, out, done, fuel = state
+        nd = jnp.where(done, 0, node)
+        b = binned[rows, feat[nd]].astype(jnp.int32)
+        m = miss[nd]
+        dleft = (dec[nd] & kDefaultLeftMask) != 0
+        is_cat = (dec[nd] & kCategoricalMask) != 0
+        is_missing = jnp.where(
+            m == 1, b == default_bin[nd],
+            jnp.where(m == 2, b == num_bin[nd] - 1, False))
+        go_left = jnp.where(is_missing, dleft, b <= thr[nd])
+        word = jnp.clip(b // 32, 0, cat_bitsets.shape[1] - 1)
+        bits = (cat_bitsets[nd, word]
+                >> (b % 32).astype(jnp.uint32)) & jnp.uint32(1)
+        go_left = jnp.where(is_cat, bits == 1, go_left)
+        child = jnp.where(go_left, left[nd], right[nd])
+        is_leaf = child < 0
+        out = jnp.where(~done & is_leaf, ~child, out)
+        node = jnp.where(~done & ~is_leaf, child, node)
+        return node, out, done | is_leaf, fuel + 1
+
+    node0 = jnp.zeros(n, jnp.int32)
+    out0 = jnp.zeros(n, jnp.int32)
+    done0 = jnp.broadcast_to(num_leaves <= 1, (n,))
+    _, out, _, _ = jax.lax.while_loop(
+        cond, body, (node0, out0, done0, jnp.int32(0)))
+    return leaf_vals[out]
+
+
 def _bin_threshold_to_value(dataset, inner_feature: int,
                             threshold_bin: int) -> float:
     """Bin threshold -> raw-value threshold: the bin's upper bound

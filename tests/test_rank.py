@@ -1,0 +1,263 @@
+"""Ranking stack tests: lambdarank/xendcg gradients vs a NumPy oracle
+transcribed from the reference loops, NDCG/MAP metric values, and
+end-to-end LTR training lift."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data import Dataset
+from lightgbm_tpu.metric.rank_metrics import MapMetric, NDCGMetric
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objective.rank import (LambdarankNDCG, RankXENDCG,
+                                         default_label_gain)
+
+
+def _synthetic_ltr(nq=60, min_docs=3, max_docs=25, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    counts = rng.randint(min_docs, max_docs + 1, nq)
+    n = counts.sum()
+    X = rng.randn(n, f)
+    rel = 2.2 * X[:, 0] - 1.4 * X[:, 1] + 0.6 * X[:, 2] * X[:, 3] \
+        + rng.randn(n) * 0.5
+    # grade into 0..4 per global quantiles
+    qs = np.quantile(rel, [0.5, 0.75, 0.9, 0.97])
+    y = np.digitize(rel, qs).astype(np.float32)
+    return X, y, counts
+
+
+def _oracle_lambdarank(score, label, qb, sigmoid=1.0, norm=True,
+                       truncation=20, label_gain=None):
+    """Direct transcription of GetGradientsForOneQuery
+    (rank_objective.hpp:139-230) with an exact sigmoid."""
+    gain = default_label_gain() if label_gain is None else label_gain
+    n = len(score)
+    lam = np.zeros(n)
+    hess = np.zeros(n)
+    discount = 1.0 / np.log2(2.0 + np.arange(n))
+    for qi in range(len(qb) - 1):
+        s, e = qb[qi], qb[qi + 1]
+        cnt = e - s
+        sc = score[s:e]
+        lb = label[s:e].astype(int)
+        top = np.sort(lb)[::-1][:truncation]
+        maxdcg = (gain[top] * discount[:len(top)]).sum()
+        inv = 1.0 / maxdcg if maxdcg > 0 else 0.0
+        order = np.argsort(-sc, kind="stable")
+        best, worst = sc[order[0]], sc[order[cnt - 1]]
+        lam_q = np.zeros(cnt)
+        hess_q = np.zeros(cnt)
+        sum_lambdas = 0.0
+        for i in range(cnt):
+            hi = order[i]
+            for j in range(cnt):
+                if i == j:
+                    continue
+                lo = order[j]
+                if lb[hi] <= lb[lo]:
+                    continue
+                ds = sc[hi] - sc[lo]
+                gap = gain[lb[hi]] - gain[lb[lo]]
+                pd = abs(discount[i] - discount[j])
+                delta = gap * pd * inv
+                if norm and best != worst:
+                    delta /= (0.01 + abs(ds))
+                sig = 1.0 / (1.0 + np.exp(sigmoid * ds))
+                pl = -sigmoid * delta * sig
+                ph = sigmoid * sigmoid * delta * sig * (1 - sig)
+                lam_q[hi] += pl
+                lam_q[lo] -= pl
+                hess_q[hi] += ph
+                hess_q[lo] += ph
+                sum_lambdas -= 2 * pl
+        if norm and sum_lambdas > 0:
+            nf = np.log2(1 + sum_lambdas) / sum_lambdas
+            lam_q *= nf
+            hess_q *= nf
+        lam[s:e] = lam_q
+        hess[s:e] = hess_q
+    return lam, hess
+
+
+def test_lambdarank_matches_oracle():
+    import jax.numpy as jnp
+    X, y, counts = _synthetic_ltr(nq=25, max_docs=15, seed=3)
+    cfg = Config.from_params({"objective": "lambdarank", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    obj = LambdarankNDCG(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    rng = np.random.RandomState(0)
+    score = rng.randn(ds.num_data).astype(np.float32)
+    g, h = obj.gradients(jnp.asarray(score))
+    qb = np.asarray(ds.metadata.query_boundaries)
+    og, oh = _oracle_lambdarank(score.astype(np.float64), y, qb)
+    np.testing.assert_allclose(np.asarray(g), og, rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(h), oh, rtol=2e-4, atol=2e-6)
+
+
+def test_lambdarank_zero_at_equal_labels():
+    """Queries with all-equal labels produce zero lambdas."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    X = rng.randn(30, 4)
+    y = np.ones(30, np.float32)
+    counts = np.asarray([10, 20])
+    cfg = Config.from_params({"objective": "lambdarank", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    obj = LambdarankNDCG(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g, h = obj.gradients(jnp.asarray(rng.randn(30).astype(np.float32)))
+    np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(h), 0.0, atol=1e-7)
+
+
+def _oracle_xendcg(score, label, qb, u):
+    """Transcription of RankXENDCG::GetGradientsForOneQuery
+    (rank_objective.hpp:306-349) with supplied uniforms."""
+    n = len(score)
+    lam = np.zeros(n)
+    hess = np.zeros(n)
+    for qi in range(len(qb) - 1):
+        s, e = qb[qi], qb[qi + 1]
+        cnt = e - s
+        sc = score[s:e].astype(np.float64)
+        rho = np.exp(sc - sc.max())
+        rho /= rho.sum()
+        l1 = np.exp2(label[s:e].astype(int)) - u[s:e]
+        sum_labels = max(1e-15, l1.sum())
+        l1 = -l1 / sum_labels + rho
+        if cnt <= 1:
+            lam[s:e] = l1
+        else:
+            sum_l1 = l1.sum()
+            l2 = (sum_l1 - l1) / (1 - rho)
+            sum_l2 = l2.sum()
+            l3 = (sum_l2 - l2) / (1 - rho)
+            lam[s:e] = l1 + rho * l2 + rho * rho * l3
+        hess[s:e] = rho * (1 - rho)
+    return lam, hess
+
+
+def test_xendcg_matches_oracle():
+    import jax.numpy as jnp
+    X, y, counts = _synthetic_ltr(nq=20, seed=4)
+    cfg = Config.from_params({"objective": "rank_xendcg", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    obj = RankXENDCG(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    score = np.random.RandomState(0).randn(ds.num_data).astype(np.float32)
+    obj._rng = np.random.RandomState(123)
+    u = np.random.RandomState(123).rand(ds.num_data).astype(np.float32)
+    g, h = obj.gradients(jnp.asarray(score))
+    qb = np.asarray(ds.metadata.query_boundaries)
+    og, oh = _oracle_xendcg(score, y, qb, u)
+    np.testing.assert_allclose(np.asarray(g), og, rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(h), oh, rtol=2e-4, atol=2e-6)
+
+
+def _oracle_ndcg_at(score, label, qb, ks, gain=None):
+    gain = default_label_gain() if gain is None else gain
+    res = np.zeros(len(ks))
+    nq = len(qb) - 1
+    for qi in range(nq):
+        s, e = qb[qi], qb[qi + 1]
+        lb = label[s:e].astype(int)
+        sc = score[s:e]
+        disc = 1.0 / np.log2(2.0 + np.arange(e - s))
+        order = np.argsort(-sc, kind="stable")
+        for j, k in enumerate(ks):
+            kk = min(k, e - s)
+            ideal = (np.sort(gain[lb])[::-1][:kk] * disc[:kk]).sum()
+            if ideal <= 0:
+                res[j] += 1.0
+            else:
+                dcg = (gain[lb[order[:kk]]] * disc[:kk]).sum()
+                res[j] += dcg / ideal
+    return res / nq
+
+
+def test_ndcg_metric_matches_oracle():
+    X, y, counts = _synthetic_ltr(nq=30, seed=5)
+    cfg = Config.from_params({"objective": "lambdarank",
+                              "eval_at": [1, 3, 5, 10], "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    m = NDCGMetric(cfg)
+    m.init(ds.metadata, ds.num_data)
+    assert m.names == ["ndcg@1", "ndcg@3", "ndcg@5", "ndcg@10"]
+    score = np.random.RandomState(1).randn(ds.num_data)
+    vals = m.eval(score, None)
+    qb = np.asarray(ds.metadata.query_boundaries)
+    oracle = _oracle_ndcg_at(score, y, qb, [1, 3, 5, 10])
+    np.testing.assert_allclose(vals, oracle, rtol=1e-10)
+    # perfect ranking scores NDCG 1
+    vals_perfect = m.eval(y.astype(np.float64), None)
+    # ties in y make stable order == ideal order; all should be 1
+    np.testing.assert_allclose(vals_perfect, 1.0, atol=1e-12)
+
+
+def test_map_metric_basic():
+    # one query, known AP
+    y = np.asarray([1, 0, 1, 0, 0], np.float32)
+    score = np.asarray([5.0, 4.0, 3.0, 2.0, 1.0])
+    cfg = Config.from_params({"objective": "lambdarank",
+                              "eval_at": [3, 5], "verbosity": -1})
+    X = np.random.RandomState(0).randn(5, 2)
+    ds = Dataset.from_numpy(X, cfg, label=y, group=[5])
+    m = MapMetric(cfg)
+    m.init(ds.metadata, ds.num_data)
+    vals = m.eval(score, None)
+    # hits at ranks 1 and 3: precisions 1/1, 2/3
+    ap3 = (1.0 + 2.0 / 3.0) / 2
+    ap5 = (1.0 + 2.0 / 3.0) / 2
+    np.testing.assert_allclose(vals, [ap3, ap5], rtol=1e-12)
+
+
+def test_lambdarank_end_to_end_ndcg_lift():
+    X, y, counts = _synthetic_ltr(nq=80, max_docs=20, seed=6)
+    cfg = Config.from_params({
+        "objective": "lambdarank", "num_leaves": 15, "learning_rate": 0.1,
+        "metric": "ndcg", "eval_at": [10], "min_data_in_leaf": 5,
+        "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    booster = GBDT(cfg, ds)
+    m = NDCGMetric(cfg)
+    m.init(ds.metadata, ds.num_data)
+    before = m.eval(np.zeros(ds.num_data), None)[0]
+    booster.train(30)
+    score = np.asarray(booster.train_score[:, 0], np.float64)
+    after = m.eval(score, None)[0]
+    assert after > before + 0.05, (before, after)
+
+
+def test_xendcg_end_to_end_ndcg_lift():
+    X, y, counts = _synthetic_ltr(nq=80, max_docs=20, seed=7)
+    cfg = Config.from_params({
+        "objective": "rank_xendcg", "num_leaves": 15,
+        "learning_rate": 0.1, "metric": "ndcg", "eval_at": [10],
+        "min_data_in_leaf": 5, "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    booster = GBDT(cfg, ds)
+    m = NDCGMetric(cfg)
+    m.init(ds.metadata, ds.num_data)
+    before = m.eval(np.zeros(ds.num_data), None)[0]
+    booster.train(30)
+    score = np.asarray(booster.train_score[:, 0], np.float64)
+    after = m.eval(score, None)[0]
+    assert after > before + 0.05, (before, after)
+
+
+def test_ndcg_early_stopping_on_valid():
+    X, y, counts = _synthetic_ltr(nq=60, seed=8)
+    Xv, yv, cv = _synthetic_ltr(nq=30, seed=9)
+    cfg = Config.from_params({
+        "objective": "lambdarank", "num_leaves": 15,
+        "learning_rate": 0.3, "metric": "ndcg", "eval_at": [5],
+        "early_stopping_round": 3, "min_data_in_leaf": 5,
+        "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=counts)
+    dv = Dataset.from_numpy(Xv, cfg, label=yv, group=cv, reference=ds)
+    booster = GBDT(cfg, ds)
+    booster.add_valid(dv, "valid_0")
+    booster.train(100)
+    assert booster.num_iterations_trained < 100
+    assert "ndcg@5" in booster.evals_result["valid_0"]

@@ -1,0 +1,130 @@
+"""Device-side microbench: per-call cost of each grow-loop component.
+
+Wraps K repetitions in one jitted fori_loop so host dispatch noise is
+excluded — measures what each piece costs INSIDE the fused grow program.
+
+Run: python tools/profile_kernels.py [rows]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    f = 28
+    reps = 30
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.learner.partitioned import PartitionedTreeLearner
+    from lightgbm_tpu.ops.hist_pallas import (combine_planes,
+                                              histogram_segment_raw)
+    from lightgbm_tpu.ops.partition_pallas import partition_segment
+    from lightgbm_tpu.ops.split import best_split
+
+    print(f"backend={jax.default_backend()} n={n} reps={reps}")
+    rng = np.random.RandomState(42)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + rng.randn(n) > 0).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 255,
+                              "max_bin": 255, "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    learner = PartitionedTreeLearner(ds, cfg)
+    mat, ws = learner.mat, learner.ws
+    b = learner.num_bins_max
+    meta, params = learner.meta, learner.params
+
+    def bench(make_loop, name):
+        fn = jax.jit(make_loop)
+        r = fn()
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        r = fn()
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name::<46} {dt*1e3:9.3f} ms/call")
+        return dt
+
+    # empty loop (loop overhead baseline)
+    def empty():
+        def body(i, acc):
+            return acc + jnp.float32(i)
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+    bench(empty, "empty fori_loop body")
+
+    # hist kernel at several counts
+    for cnt in (2048, 16384, 131072, n):
+        def hloop(cnt=cnt):
+            def body(i, acc):
+                raw = histogram_segment_raw(
+                    mat, jnp.int32(0), jnp.int32(cnt), num_features=f,
+                    num_bins=b, blk=2048, interpret=False)
+                return acc + raw[0, 0, 0]
+            return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+        dt = bench(hloop, f"hist count={cnt}")
+        print(f"    -> {cnt/dt/1e6:9.1f} Mrow/s")
+
+    # partition kernel at several counts
+    lut = jnp.zeros((1, 256), jnp.float32)
+    for cnt in (2048, 16384, 131072, n):
+        def ploop(cnt=cnt):
+            def body(i, carry):
+                m, w, acc = carry
+                m2, w2, nl = partition_segment(
+                    m, w, jnp.int32(0), jnp.int32(cnt), jnp.int32(3),
+                    jnp.int32(128), jnp.int32(0), jnp.int32(0),
+                    jnp.int32(0), jnp.int32(255), jnp.int32(0), lut,
+                    blk=512, interpret=False)
+                return m2, w2, acc + nl[0]
+            return jax.lax.fori_loop(
+                0, reps, body, (mat, ws, jnp.int32(0)))[2]
+        dt = bench(ploop, f"part count={cnt}")
+        print(f"    -> {cnt/dt/1e6:9.1f} Mrow/s")
+
+    # best_split scan
+    raw = histogram_segment_raw(mat, 0, n, num_features=f, num_bins=b,
+                                blk=2048, interpret=False)
+    hist = combine_planes(raw, f)
+    sums = hist[0].sum(axis=0)
+    g0, h0, c0 = sums[0], sums[1], sums[2]
+
+    def sloop():
+        def body(i, acc):
+            res = best_split(hist + acc, g0, h0, c0, meta, params,
+                             constraint_min=-jnp.inf,
+                             constraint_max=jnp.inf,
+                             feature_mask=jnp.ones((f,), bool))
+            return acc + res.gain * 0
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+    bench(sloop, "best_split scan")
+
+    # hist-cache update (the [L, F, B, 3] set pattern)
+    big_l = 255
+    cache = jnp.zeros((big_l, f, b, 3), jnp.float32)
+
+    def cloop():
+        def body(i, c):
+            leaf = jax.lax.rem(i, big_l)
+            c = c.at[leaf].set(hist)
+            return c
+        return jax.lax.fori_loop(0, reps, body, cache)
+    bench(cloop, "hist cache .at[leaf].set")
+
+    def gloop():
+        def body(i, acc):
+            leaf = jax.lax.rem(i, big_l)
+            return acc + cache[leaf][0, 0, 0]
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+    bench(gloop, "hist cache [leaf] gather")
+
+
+if __name__ == "__main__":
+    main()

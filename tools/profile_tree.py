@@ -1,0 +1,134 @@
+"""Per-stage profile of one boosting iteration on the real chip.
+
+Answers VERDICT round-2 item 2: where do the ~2 ms/split go at
+BENCH_ROWS=500k? Measures, in isolation:
+  - full train_one_iter wall
+  - learner.train (the fused grow program) wall
+  - to_host_tree device->host pull
+  - histogram_segment_raw at several segment sizes
+  - partition_segment at several segment sizes
+  - best_split scan alone
+  - grow wall vs num_leaves (fixed-overhead-per-split estimate)
+
+Run: python tools/profile_tree.py [rows]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def timeit(fn, *args, warmup=2, iters=5, **kw):
+    import jax
+    for _ in range(warmup):
+        r = fn(*args, **kw)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args, **kw)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    f, num_leaves = 28, 255
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+
+    print(f"backend={jax.default_backend()} n={n} f={f} "
+          f"leaves={num_leaves}")
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(n, f).astype(np.float32)
+    logit = (2.0 * X[:, 0] - 1.5 * X[:, 1] + X[:, 2] * X[:, 3]
+             + 0.8 * X[:, 4] * X[:, 5] - X[:, 6])
+    y = (logit + rng.randn(n).astype(np.float32) > 0).astype(np.float32)
+
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": num_leaves,
+        "learning_rate": 0.1, "max_bin": 255, "metric": "",
+        "verbosity": -1})
+    t0 = time.perf_counter()
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    print(f"dataset bin+upload: {time.perf_counter()-t0:.3f}s")
+
+    booster = GBDT(cfg, ds)
+    learner = booster.learner
+    print("learner:", type(learner).__name__)
+
+    # full iteration
+    t = timeit(lambda: booster.train_one_iter(), warmup=1, iters=3)
+    print(f"train_one_iter:        {t*1e3:9.2f} ms")
+
+    grad = jnp.asarray(y - 0.5)
+    hess = jnp.full((n,), 0.25, jnp.float32)
+
+    # grow program alone
+    t = timeit(lambda: learner.train(grad, hess), warmup=1, iters=3)
+    print(f"learner.train (grow):  {t*1e3:9.2f} ms")
+
+    res = learner.train(grad, hess)
+    t0 = time.perf_counter()
+    tree = learner.to_host_tree(res)
+    print(f"to_host_tree:          {(time.perf_counter()-t0)*1e3:9.2f} ms")
+
+    # gradient fn
+    t = timeit(lambda: booster._grad_fn(booster.train_score[:, 0]))
+    print(f"grad_fn:               {t*1e3:9.2f} ms")
+
+    if hasattr(learner, "mat"):
+        from lightgbm_tpu.ops.hist_pallas import (combine_planes,
+                                                  histogram_segment_raw)
+        from lightgbm_tpu.ops.partition_pallas import partition_segment
+        mat, ws = learner.mat, learner.ws
+        b = learner.num_bins_max
+        for cnt in (4096, 65536, n // 2, n):
+            t = timeit(histogram_segment_raw, mat, 0, cnt,
+                       num_features=f, num_bins=b, blk=2048,
+                       interpret=False)
+            print(f"hist seg count={cnt:>8}: {t*1e3:9.2f} ms "
+                  f"({cnt/t/1e6:8.1f} Mrow/s)")
+        lut = jnp.zeros((1, 256), jnp.float32)
+        for cnt in (4096, 65536, n // 2, n):
+            t = timeit(partition_segment, mat, ws, 0, cnt, 3, 128, 0,
+                       0, 0, 255, 0, lut, blk=512, interpret=False)
+            print(f"part seg count={cnt:>8}: {t*1e3:9.2f} ms "
+                  f"({cnt/t/1e6:8.1f} Mrow/s)")
+
+        # split scan alone
+        from lightgbm_tpu.ops.split import best_split
+        raw = histogram_segment_raw(mat, 0, n, num_features=f,
+                                    num_bins=b, blk=2048,
+                                    interpret=False)
+        hist = combine_planes(raw, f)
+        g0, h0, c0 = [float(v) for v in hist[0].sum(axis=0)[:3]]
+        scan = jax.jit(lambda hi: best_split(
+            hi, g0, h0, c0, learner.meta, learner.params,
+            constraint_min=-jnp.inf, constraint_max=jnp.inf,
+            feature_mask=jnp.ones((f,), bool)))
+        t = timeit(scan, hist)
+        print(f"best_split scan:       {t*1e3:9.2f} ms")
+
+    # scaling with num_leaves => per-split overhead
+    for nl in (15, 63, 255):
+        cfg2 = Config.from_params({
+            "objective": "binary", "num_leaves": nl,
+            "max_bin": 255, "metric": "", "verbosity": -1})
+        ds2 = Dataset.from_numpy(X, cfg2, label=y)
+        b2 = GBDT(cfg2, ds2)
+        t = timeit(lambda: b2.train_one_iter(), warmup=1, iters=2)
+        print(f"iter @ leaves={nl:>4}:   {t*1e3:9.2f} ms "
+              f"({t/(nl-1)*1e3:7.3f} ms/split)")
+
+
+if __name__ == "__main__":
+    main()
