@@ -11,19 +11,15 @@ reference's socket/MPI collectives.
 
 __version__ = "0.1.0"
 
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
 from .config import Config
+from .engine import CVBooster, cv, train
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
-# public API filled in as layers land (engine/Booster/sklearn in later
-# milestones); keep imports lazy-tolerant during bring-up.
-try:
-    from .basic import Booster, Dataset
-    from .engine import cv, train
-except ImportError:  # pragma: no cover - during early bring-up only
-    pass
-
-try:
-    from . import sklearn as sklearn  # noqa: F401
-    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
-                          LGBMRegressor)
-except ImportError:  # pragma: no cover
-    pass
+__all__ = ["Dataset", "Booster", "LightGBMError", "Config",
+           "train", "cv", "CVBooster",
+           "early_stopping", "print_evaluation", "record_evaluation",
+           "reset_parameter",
+           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]

@@ -1,0 +1,187 @@
+"""Text data loading: CSV / TSV / LibSVM with sidecar files.
+
+Reference analog: ``Parser::CreateParser`` format auto-detection
+(src/io/parser.cpp:1-222) and ``DatasetLoader`` header/label/weight/
+group column resolution + ``.weight``/``.query``/``.init`` sidecar
+files (src/io/dataset_loader.cpp:31-167, metadata.cpp sidecar loads).
+Parsing itself rides on pandas (SURVEY §7 M0: "Text/CSV parser can be
+pandas/pyarrow — no need to replicate the C++ parser").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_info, log_warning
+
+
+def detect_format(path: str) -> str:
+    """CSV / TSV / LibSVM sniffing (Parser::CreateParser logic: count
+    colon-tokens vs tab/comma splits on the first lines)."""
+    with open(path) as f:
+        lines = []
+        for line in f:
+            line = line.strip()
+            if line:
+                lines.append(line)
+            if len(lines) >= 2:
+                break
+    if not lines:
+        log_fatal(f"Data file {path} is empty")
+    probe = lines[-1]
+    tokens = probe.replace("\t", " ").split()
+    n_colon = sum(1 for t in tokens if ":" in t)
+    if n_colon > 0 and n_colon >= len(tokens) - 1:
+        return "libsvm"
+    if "\t" in probe:
+        return "tsv"
+    return "csv"
+
+
+def _resolve_column(spec: str, names: Optional[List[str]]) -> Optional[int]:
+    """'name:<col>' or integer index (dataset_loader.cpp:31-90)."""
+    if not spec:
+        return None
+    if spec.startswith("name:"):
+        col = spec[5:]
+        if names is None or col not in names:
+            log_fatal(f"Could not find column {col} in data file header")
+        return names.index(col)
+    return int(spec)
+
+
+def _resolve_ignore(spec: str, names: Optional[List[str]]) -> List[int]:
+    if not spec:
+        return []
+    out = []
+    if spec.startswith("name:"):
+        for col in spec[5:].split(","):
+            if names is not None and col in names:
+                out.append(names.index(col))
+    else:
+        out = [int(c) for c in spec.split(",")]
+    return out
+
+
+def _load_sidecar(path: str, suffixes) -> Optional[np.ndarray]:
+    """Metadata sidecar files (src/io/metadata.cpp LoadWeights/
+    LoadQueryBoundaries: one value per line, optional 'header')."""
+    for suffix in suffixes:
+        p = path + suffix
+        if os.path.exists(p):
+            vals = []
+            with open(p) as f:
+                for i, line in enumerate(f):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        vals.append(float(line))
+                    except ValueError:
+                        if i == 0:
+                            continue  # header line
+                        raise
+            return np.asarray(vals)
+    return None
+
+
+def load_file(path: str, config: Config) -> Tuple[
+        np.ndarray, Optional[np.ndarray], Optional[np.ndarray],
+        Optional[np.ndarray], Optional[np.ndarray], Optional[List[str]]]:
+    """Load a data file -> (X, label, weight, group, init_score,
+    feature_names).
+
+    Mirrors DatasetLoader::LoadFromFile column resolution: label defaults
+    to the first column; label/weight/group columns are removed from the
+    feature matrix; sidecar ``.weight`` / ``.query``/``.group`` files
+    override in-file columns.
+    """
+    if not os.path.exists(path):
+        log_fatal(f"Data file {path} does not exist")
+    fmt = detect_format(path)
+    label = weight = group = None
+    names: Optional[List[str]] = None
+
+    if fmt == "libsvm":
+        X, label = _load_libsvm(path)
+    else:
+        import pandas as pd
+        sep = "\t" if fmt == "tsv" else ","
+        df = pd.read_csv(path, sep=sep,
+                         header=0 if config.header else None)
+        if config.header:
+            names = [str(c) for c in df.columns]
+        mat = df.to_numpy(np.float64)
+
+        label_idx = _resolve_column(config.label_column, names)
+        if label_idx is None:
+            label_idx = 0
+        weight_idx = _resolve_column(config.weight_column, names)
+        group_idx = _resolve_column(config.group_column, names)
+        ignore = set(_resolve_ignore(config.ignore_column, names))
+
+        drop = {label_idx} | ignore
+        if weight_idx is not None:
+            drop.add(weight_idx)
+        if group_idx is not None:
+            drop.add(group_idx)
+        keep = [i for i in range(mat.shape[1]) if i not in drop]
+        label = mat[:, label_idx]
+        if weight_idx is not None:
+            weight = mat[:, weight_idx]
+        if group_idx is not None:
+            # per-row query ids -> query sizes (Metadata::SetQueryId)
+            qid = mat[:, group_idx]
+            change = np.nonzero(np.diff(qid))[0]
+            bounds = np.concatenate([[0], change + 1, [len(qid)]])
+            group = np.diff(bounds)
+        X = mat[:, keep]
+        if names is not None:
+            names = [names[i] for i in keep]
+
+    sc_weight = _load_sidecar(path, (".weight",))
+    if sc_weight is not None:
+        weight = sc_weight
+    sc_group = _load_sidecar(path, (".query", ".group"))
+    if sc_group is not None:
+        group = sc_group.astype(np.int64)
+    if group is not None:
+        group = np.asarray(group, np.int64)
+    init_score = _load_sidecar(path, (".init",))
+    log_info(f"Loaded {X.shape[0]} rows x {X.shape[1]} features "
+             f"from {path} ({fmt})")
+    return X, label, weight, group, init_score, names
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """LibSVM sparse text -> dense matrix (LibSVMParser,
+    src/io/parser.hpp:84-122). Zero-based or one-based indices are kept
+    as-is (the reference treats indices as given)."""
+    labels: List[float] = []
+    rows: List[List[Tuple[int, float]]] = []
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            toks = line.replace("\t", " ").split()
+            labels.append(float(toks[0]))
+            row = []
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                i, v = t.split(":", 1)
+                i = int(i)
+                row.append((i, float(v)))
+                max_idx = max(max_idx, i)
+            rows.append(row)
+    X = np.zeros((len(rows), max_idx + 1))
+    for r, row in enumerate(rows):
+        for i, v in row:
+            X[r, i] = v
+    return X, np.asarray(labels)

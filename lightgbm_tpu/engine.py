@@ -1,0 +1,335 @@
+"""Training/CV entry points: ``train()`` and ``cv()``.
+
+Reference analog: ``python-package/lightgbm/engine.py`` (train ``:18-276``,
+``_make_n_folds`` ``:299``, cv ``:375+``). Same callback orchestration
+contract (CallbackEnv before/after each iteration, EarlyStopException).
+
+TPU-first addition: when no per-iteration host interaction is needed
+(no valid sets, feval, or callbacks), ``train()`` delegates to the
+internal sync-free pipelined loop (``GBDT.train``) instead of stepping
+one iteration at a time.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (CallbackEnv, EarlyStopException, early_stopping,
+                       print_evaluation, record_evaluation)
+from .utils.log import log_warning
+
+_ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
+                  "n_iter", "num_tree", "num_trees", "num_round",
+                  "num_rounds", "n_estimators")
+_ES_ALIASES = ("early_stopping_round", "early_stopping_rounds",
+               "early_stopping", "n_iter_no_change")
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100, valid_sets=None, valid_names=None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None, verbose_eval=True,
+          keep_training_booster: bool = False, callbacks=None) -> Booster:
+    """engine.py:18-276."""
+    params = copy.deepcopy(params)
+    for alias in _ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+            log_warning(f"Found `{alias}` in params. Will use it instead "
+                        "of argument")
+    for alias in _ES_ALIASES:
+        if alias in params:
+            early_stopping_rounds = int(params.pop(alias))
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round should be greater than zero.")
+    if fobj is not None:
+        params["objective"] = "none"
+
+    if init_model is not None:
+        raise LightGBMError("init_model (continued training) is not "
+                            "supported yet")
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    is_valid_contain_train = False
+    train_data_name = "training"
+    reduced_valid_sets = []
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if not isinstance(valid_data, Dataset):
+                raise TypeError("Training only accepts Dataset object")
+            name = valid_names[i] if valid_names is not None \
+                else f"valid_{i}"
+            reduced_valid_sets.append(valid_data)
+            name_valid_sets.append(name)
+            booster.add_valid(valid_data, name)
+    booster._train_data_name = train_data_name
+
+    # callback assembly (engine.py:186-204)
+    callbacks = set(callbacks) if callbacks is not None else set()
+    if verbose_eval is True:
+        callbacks.add(print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        callbacks.add(print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(early_stopping(
+            early_stopping_rounds,
+            first_metric_only=bool(params.get("first_metric_only",
+                                              False)),
+            verbose=bool(verbose_eval)))
+    if evals_result is not None:
+        callbacks.add(record_evaluation(evals_result))
+    callbacks_before = {cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)}
+    callbacks_after = callbacks - callbacks_before
+    callbacks_before = sorted(
+        callbacks_before, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(
+        callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+
+    need_eval = bool(reduced_valid_sets) or is_valid_contain_train \
+        or feval is not None
+    # print/record callbacks are inert without evaluation results; only
+    # before-iteration callbacks (reset_parameter) and early stopping
+    # (which must raise its no-eval error) block the pipelined path
+    inert_without_eval = all(
+        getattr(cb, "order", 0) in (10, 20)
+        and not getattr(cb, "before_iteration", False)
+        for cb in callbacks)
+    if not need_eval and fobj is None and inert_without_eval \
+            and not (early_stopping_rounds or 0) > 0:
+        # no per-iteration host interaction needed: pipelined fast path
+        booster._gbdt.train(num_boost_round)
+        booster.best_iteration = -1
+        return booster
+
+    # per-iteration loop (engine.py:221-276)
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(CallbackEnv(model=booster, params=params, iteration=i,
+                           begin_iteration=0,
+                           end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if need_eval:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            if reduced_valid_sets:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(CallbackEnv(model=booster, params=params, iteration=i,
+                               begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=
+                               evaluation_result_list))
+        except EarlyStopException as earlyStopException:
+            booster.best_iteration = earlyStopException.best_iteration + 1
+            evaluation_result_list = earlyStopException.best_score
+            break
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for name, metric, score, _ in evaluation_result_list or []:
+        booster.best_score[name][metric] = score
+    if booster.best_iteration <= 0:
+        booster.best_iteration = -1
+    return booster
+
+
+# ----------------------------------------------------------------------
+class CVBooster:
+    """Ensemble of per-fold boosters (engine.py:283-297)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(booster, name)(*args, **kwargs)
+                    for booster in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params,
+                  seed: int, stratified: bool, shuffle: bool):
+    """engine.py:299-356: group-aware / stratified / plain folds."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_group()
+            group = np.repeat(np.arange(len(group_info)), group_info) \
+                if group_info is not None else None
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(), groups=group)
+        return list(folds)
+
+    group_info = full_data.get_group()
+    if group_info is not None:
+        # split whole queries between folds (engine.py:317-330)
+        group_info = np.asarray(group_info, np.int64)
+        flatted_group = np.repeat(np.arange(len(group_info)), group_info)
+        try:
+            from sklearn.model_selection import GroupKFold
+            gkf = GroupKFold(n_splits=nfold)
+            return list(gkf.split(np.empty(num_data),
+                                  groups=flatted_group))
+        except ImportError:
+            pass
+    if stratified:
+        from sklearn.model_selection import StratifiedKFold
+        skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                              random_state=seed)
+        return list(skf.split(np.empty(num_data), full_data.get_label()))
+    rng = np.random.RandomState(seed)
+    idx = rng.permutation(num_data) if shuffle else np.arange(num_data)
+    kstep = num_data // nfold
+    out = []
+    for i in range(nfold):
+        test = idx[i * kstep: (i + 1) * kstep if i < nfold - 1 else None]
+        train = np.setdiff1d(idx, test, assume_unique=False)
+        out.append((train, test))
+    return out
+
+
+def _agg_cv_result(raw_results, eval_train_metric: bool = False):
+    """engine.py:359-373: (name, metric, mean, bigger, stdv) rows; the
+    dataset name prefixes the key only when train metrics are present."""
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}" if eval_train_metric \
+                else one_line[1]
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
+             float(np.std(v))) for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset,
+       num_boost_round: int = 100, folds=None, nfold: int = 5,
+       stratified: bool = True, shuffle: bool = True, metrics=None,
+       fobj=None, feval=None, init_model=None, feature_name="auto",
+       categorical_feature="auto",
+       early_stopping_rounds: Optional[int] = None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False):
+    """engine.py:375-580: k-fold cross-validated boosting."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    params = copy.deepcopy(params)
+    for alias in _ROUND_ALIASES:
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    for alias in _ES_ALIASES:
+        if alias in params:
+            early_stopping_rounds = int(params.pop(alias))
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics is not None:
+        params["metric"] = metrics
+    obj = params.get("objective", "")
+    if stratified and (obj not in ("binary", "multiclass", "multiclassova")
+                       or train_set.group is not None):
+        stratified = False
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    train_set.params = {**params, **train_set.params} \
+        if train_set.params else dict(params)
+    folds = _make_n_folds(train_set, folds, nfold, params, seed,
+                          stratified, shuffle)
+    cvbooster = CVBooster()
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(np.asarray(train_idx))
+        te = train_set.subset(np.asarray(test_idx))
+        booster = Booster(params=params, train_set=tr)
+        booster.add_valid(te, "valid")
+        cvbooster._append(booster)
+
+    results = collections.defaultdict(list)
+    callbacks = set(callbacks) if callbacks is not None else set()
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(early_stopping(early_stopping_rounds,
+                                     verbose=False))
+    if verbose_eval is True:
+        callbacks.add(print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        callbacks.add(print_evaluation(verbose_eval, show_stdv))
+    callbacks_before = {cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)}
+    callbacks_after = callbacks - callbacks_before
+    callbacks_before = sorted(
+        callbacks_before, key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(
+        callbacks_after, key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(CallbackEnv(model=cvbooster, params=params, iteration=i,
+                           begin_iteration=0,
+                           end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        raw = []
+        for booster in cvbooster.boosters:
+            booster.update(fobj=fobj)
+            one = []
+            if eval_train_metric:
+                one.extend(booster.eval_train(feval))
+            one.extend(booster.eval_valid(feval))
+            raw.append(one)
+        res = _agg_cv_result(raw, eval_train_metric)
+        for _, key, mean, _, std in res:
+            results[f"{key}-mean"].append(mean)
+            results[f"{key}-stdv"].append(std)
+        try:
+            for cb in callbacks_after:
+                cb(CallbackEnv(model=cvbooster, params=params,
+                               iteration=i, begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=res))
+        except EarlyStopException as earlyStopException:
+            cvbooster.best_iteration = \
+                earlyStopException.best_iteration + 1
+            for k in results:
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return dict(results)

@@ -1,0 +1,297 @@
+"""Prediction paths: batched device traversal, leaf indices, SHAP.
+
+Reference analogs: ``GBDT::PredictRaw``/``Predict``
+(src/boosting/gbdt_prediction.cpp:13-91), ``Predictor``
+(src/application/predictor.hpp:29-131), ``Tree::PredictContrib`` +
+``TreeSHAP`` (include/LightGBM/tree.h:512-527, src/io/tree.cpp:631-737).
+
+Design (SURVEY §7 M5): the reference predicts row-by-row over raw
+features; here prediction re-bins the input with the training
+``BinMapper``s (exact — bin boundaries are the thresholds) and one
+jitted ``lax.scan`` over the stacked tree arrays traverses ALL trees
+for ALL rows in a single dispatch. Models loaded from text (no
+mappers) fall back to vectorized host traversal. SHAP values use the
+reference's exact TreeSHAP recursion on host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from .utils.log import log_fatal
+
+
+def _model_list(src, num_iteration: int) -> List:
+    if hasattr(src, "finalize_trees"):
+        src.finalize_trees()
+    models = list(src.models)
+    k = src.num_tree_per_iteration
+    if num_iteration is not None and num_iteration > 0:
+        models = models[:num_iteration * k]
+    return models
+
+
+def _convert(src, raw: np.ndarray) -> np.ndarray:
+    """ConvertOutput dispatch for both GBDT and LoadedBooster."""
+    obj = getattr(src, "objective", None)
+    if obj is not None and not isinstance(obj, str):
+        import jax.numpy as jnp
+        return np.asarray(obj.convert_output(jnp.asarray(raw)))
+    name = getattr(src, "objective_str", "").split(" ")[0]
+    if name in ("binary", "cross_entropy", "multiclassova"):
+        sigmoid = 1.0
+        for tok in getattr(src, "objective_str", "").split()[1:]:
+            if tok.startswith("sigmoid:"):
+                sigmoid = float(tok.split(":")[1])
+        return 1.0 / (1.0 + np.exp(-sigmoid * raw))
+    if name == "multiclass":
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    if name in ("poisson", "gamma", "tweedie"):
+        return np.exp(raw)
+    return raw
+
+
+def predict(src, data: np.ndarray, num_iteration: int = -1,
+            raw_score: bool = False, pred_leaf: bool = False,
+            pred_contrib: bool = False) -> np.ndarray:
+    """Unified prediction entry (Predictor closure dispatch,
+    predictor.hpp:39-131)."""
+    data = np.asarray(data, np.float64)
+    models = _model_list(src, num_iteration)
+    k = src.num_tree_per_iteration
+    n = data.shape[0]
+
+    if pred_leaf:
+        if not models:
+            return np.zeros((n, 0), np.int32)
+        return np.stack([t.predict_leaf_index(data) for t in models],
+                        axis=1).astype(np.int32)
+
+    if pred_contrib:
+        return _predict_contrib(models, data, k)
+
+    dataset = None
+    if getattr(src, "learner", None) is not None:
+        dataset = src.learner.dataset
+    if dataset is not None and models \
+            and n * len(models) >= (1 << 16):
+        raw = _device_predict(models, data, dataset, k)
+    else:
+        raw = np.zeros((n, k))
+        for i, t in enumerate(models):
+            raw[:, i % k] += t.predict(data)
+    if getattr(src, "average_output", False) and models:
+        raw /= max(len(models) // k, 1)
+    raw = raw if k > 1 else raw[:, 0]
+    if raw_score:
+        return raw
+    return _convert(src, raw)
+
+
+# ----------------------------------------------------------------------
+def _device_predict(models, data, dataset, k: int) -> np.ndarray:
+    """All trees x all rows in ONE device dispatch: re-bin the input
+    with the training mappers (exact semantics — the raw threshold of
+    every split is its bin's upper bound) and scan over stacked padded
+    tree arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    binned = _bin_data(data, dataset)
+    t = len(models)
+    s_max = max(max(len(m.split_feature_inner) for m in models), 1)
+
+    def stack(attr, dtype, fill=0):
+        out = np.full((t, s_max), fill, dtype)
+        for i, m in enumerate(models):
+            a = getattr(m, attr)
+            out[i, :len(a)] = a
+        return out
+
+    feat = stack("split_feature_inner", np.int32)
+    thr = stack("threshold_bin", np.int32)
+    dec = stack("decision_type", np.int32)
+    left = stack("left_child", np.int32, -1)
+    right = stack("right_child", np.int32, -1)
+    miss = stack("_missing_code", np.int32)
+    dbin = stack("_default_bin", np.int32)
+    nbin = stack("_num_bin", np.int32)
+    nw = models[0].cat_bitsets.shape[1] if len(models) else 8
+    cat = np.zeros((t, s_max, nw), np.uint32)
+    leaf_vals = np.zeros((t, s_max + 1), np.float32)
+    n_leaves = np.zeros((t,), np.int32)
+    tree_class = np.asarray([i % k for i in range(t)], np.int32)
+    for i, m in enumerate(models):
+        cat[i, :len(m.cat_bitsets)] = m.cat_bitsets
+        leaf_vals[i, :m.num_leaves] = m.leaf_value
+        n_leaves[i] = m.num_leaves
+
+    out = _scan_trees(
+        jnp.asarray(binned), jnp.asarray(feat), jnp.asarray(thr),
+        jnp.asarray(dec), jnp.asarray(left), jnp.asarray(right),
+        jnp.asarray(miss), jnp.asarray(dbin), jnp.asarray(nbin),
+        jnp.asarray(cat), jnp.asarray(leaf_vals), jnp.asarray(n_leaves),
+        jnp.asarray(tree_class), k)
+    return np.asarray(jax.device_get(out), np.float64)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _scan_trees(binned, feat, thr, dec, left, right, miss, dbin, nbin,
+                cat, leaf_vals, n_leaves, tree_class, k):
+    import jax.numpy as jnp
+    from .models.tree import _traverse_arrays_jax
+
+    n = binned.shape[0]
+
+    def body(acc, tree):
+        (f, th, d, l, r, mi, db, nb, ct, lv, nl, cls) = tree
+        add = _traverse_arrays_jax(binned, f, th, d, l, r, mi, db, nb,
+                                   ct, lv, nl)
+        return acc.at[:, cls].add(add), None
+
+    acc0 = jnp.zeros((n, k), jnp.float32)
+    acc, _ = jax.lax.scan(
+        body, acc0,
+        (feat, thr, dec, left, right, miss, dbin, nbin, cat, leaf_vals,
+         n_leaves, tree_class))
+    return acc
+
+
+def _bin_data(data: np.ndarray, dataset) -> np.ndarray:
+    """Re-bin raw features with the training BinMappers (ValueToBin,
+    bin.h:504-540) — vectorized per feature."""
+    n = data.shape[0]
+    f_used = dataset.num_features
+    dtype = dataset.binned.dtype
+    out = np.zeros((n, f_used), dtype)
+    for inner in range(f_used):
+        mapper = dataset.feature_mapper(inner)
+        col = data[:, dataset.real_feature_idx[inner]]
+        out[:, inner] = mapper.values_to_bins(col)
+    return out
+
+
+# ----------------------------------------------------------------------
+# SHAP (TreeSHAP, src/io/tree.cpp:631-737)
+def _predict_contrib(models, data: np.ndarray, k: int) -> np.ndarray:
+    """[N, k*(F+1)] SHAP values; last slot per class is the expected
+    value (Tree::PredictContrib, tree.h:512-527)."""
+    n, f = data.shape
+    out = np.zeros((n, k, f + 1))
+    for i, tree in enumerate(models):
+        cls = i % k
+        out[:, cls, f] += _expected_value(tree)
+        if tree.num_leaves > 1:
+            for row in range(n):
+                _tree_shap(tree, data[row], out[row, cls])
+    return out.reshape(n, k * (f + 1)) if k > 1 else out[:, 0, :]
+
+
+def _expected_value(tree) -> float:
+    """Tree::ExpectedValue (tree.cpp:740-748)."""
+    if tree.num_leaves == 1:
+        return float(tree.leaf_value[0])
+    total = float(tree.internal_count[0])
+    return float((tree.leaf_count / total * tree.leaf_value).sum())
+
+
+def _node_count(tree, node: int) -> float:
+    return float(tree.leaf_count[~node]) if node < 0 \
+        else float(tree.internal_count[node])
+
+
+def _tree_shap(tree, x: np.ndarray, phi: np.ndarray) -> None:
+    """Recursive TreeSHAP for one row (tree.cpp:691-737). ``arena``
+    rows are PathElements [feature_index, zero_fraction, one_fraction,
+    pweight]; child levels use a buffer shifted by the entry depth,
+    exactly like the reference's pointer arithmetic."""
+    max_path = int(tree.leaf_depth.max(initial=0)) + 2
+    arena = np.zeros(((max_path + 1) * (max_path + 2) // 2 + max_path, 4))
+
+    def extend(path, depth, zero_f, one_f, fidx):
+        """ExtendPath (tree.cpp:631-643)."""
+        path[depth] = (fidx, zero_f, one_f, 1.0 if depth == 0 else 0.0)
+        for i in range(depth - 1, -1, -1):
+            path[i + 1, 3] += one_f * path[i, 3] * (i + 1) / (depth + 1)
+            path[i, 3] = zero_f * path[i, 3] * (depth - i) / (depth + 1)
+
+    def unwind(path, depth, pidx):
+        """UnwindPath (tree.cpp:645-668)."""
+        zero_f = path[pidx, 1]
+        one_f = path[pidx, 2]
+        next_one = path[depth, 3]
+        for i in range(depth - 1, -1, -1):
+            if one_f != 0:
+                tmp = path[i, 3]
+                path[i, 3] = next_one * (depth + 1) / ((i + 1) * one_f)
+                next_one = tmp - path[i, 3] * zero_f * (depth - i) \
+                    / (depth + 1)
+            else:
+                path[i, 3] = path[i, 3] * (depth + 1) \
+                    / (zero_f * (depth - i))
+        for i in range(pidx, depth):
+            path[i, 0:3] = path[i + 1, 0:3]
+
+    def unwound_sum(path, depth, pidx):
+        """UnwoundPathSum (tree.cpp:670-688)."""
+        zero_f = path[pidx, 1]
+        one_f = path[pidx, 2]
+        next_one = path[depth, 3]
+        total = 0.0
+        for i in range(depth - 1, -1, -1):
+            if one_f != 0:
+                tmp = next_one * (depth + 1) / ((i + 1) * one_f)
+                total += tmp
+                next_one = path[i, 3] - tmp * zero_f * (depth - i) \
+                    / (depth + 1)
+            else:
+                total += (path[i, 3] / zero_f) / ((depth - i)
+                                                  / (depth + 1))
+        return total
+
+    def decide_child(node):
+        go_left = tree._decide(x[None, :], np.asarray([node]))[0]
+        return int(tree.left_child[node]) if go_left \
+            else int(tree.right_child[node])
+
+    def recurse(node, depth, parent_off, parent_zero, parent_one,
+                parent_fidx):
+        off = parent_off + depth
+        path = arena[off:]
+        if depth > 0:
+            path[:depth] = arena[parent_off:parent_off + depth]
+        extend(path, depth, parent_zero, parent_one, parent_fidx)
+        if node < 0:
+            for i in range(1, depth + 1):
+                w = unwound_sum(path, depth, i)
+                phi[int(path[i, 0])] += w * (path[i, 2] - path[i, 1]) \
+                    * tree.leaf_value[~node]
+            return
+        hot = decide_child(node)
+        cold = int(tree.right_child[node]) \
+            if hot == int(tree.left_child[node]) \
+            else int(tree.left_child[node])
+        w = _node_count(tree, node)
+        hot_zero = _node_count(tree, hot) / w
+        cold_zero = _node_count(tree, cold) / w
+        inc_zero, inc_one = 1.0, 1.0
+        fidx_node = int(tree.split_feature[node])
+        pidx = 0
+        while pidx <= depth and int(path[pidx, 0]) != fidx_node:
+            pidx += 1
+        if pidx != depth + 1:
+            inc_zero = path[pidx, 1]
+            inc_one = path[pidx, 2]
+            unwind(path, depth, pidx)
+            depth -= 1
+        recurse(hot, depth + 1, off, hot_zero * inc_zero, inc_one,
+                fidx_node)
+        recurse(cold, depth + 1, off, cold_zero * inc_zero, 0.0,
+                fidx_node)
+
+    recurse(0, 0, 0, 1.0, 1.0, -1)

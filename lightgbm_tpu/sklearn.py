@@ -1,0 +1,358 @@
+"""scikit-learn estimator wrappers.
+
+Reference analog: ``python-package/lightgbm/sklearn.py`` (LGBMModel
+``:169-743``, LGBMRegressor ``:744``, LGBMClassifier ``:771``,
+LGBMRanker ``:913``). Same constructor surface and fit/predict
+contract over the in-package ``train()`` engine.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .engine import train
+
+try:
+    from sklearn.base import (BaseEstimator, ClassifierMixin,
+                              RegressorMixin)
+    from sklearn.preprocessing import LabelEncoder
+    _SKLEARN = True
+except ImportError:  # pragma: no cover
+    _SKLEARN = False
+
+    class BaseEstimator:  # type: ignore
+        pass
+
+    class ClassifierMixin:  # type: ignore
+        pass
+
+    class RegressorMixin:  # type: ignore
+        pass
+
+
+def _eval_function_wrapper(func: Callable):
+    """Wrap sklearn-style feval (y_true, y_pred) into engine feval
+    (sklearn.py:87-168)."""
+    def inner(preds, dataset):
+        labels = dataset.get_label()
+        return func(labels, preds)
+    return inner
+
+
+def _objective_function_wrapper(func: Callable):
+    """Wrap sklearn-style fobj (y_true, y_pred) -> grad, hess
+    (sklearn.py:18-86)."""
+    def inner(preds, dataset):
+        labels = dataset.get_label()
+        grad, hess = func(labels, preds)
+        return grad, hess
+    return inner
+
+
+class LGBMModel(BaseEstimator):
+    """Base sklearn estimator (sklearn.py:169-743)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None,
+                 class_weight=None, min_split_gain: float = 0.0,
+                 min_child_weight: float = 1e-3, min_child_samples: int = 20,
+                 subsample: float = 1.0, subsample_freq: int = 0,
+                 colsample_bytree: float = 1.0, reg_alpha: float = 0.0,
+                 reg_lambda: float = 0.0, random_state=None,
+                 n_jobs: int = -1, silent: bool = True,
+                 importance_type: str = "split", **kwargs):
+        if not _SKLEARN:
+            raise LightGBMError("scikit-learn is required for this "
+                                "module")
+        self.boosting_type = boosting_type
+        self.objective = objective
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.class_weight = class_weight
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._Booster: Optional[Booster] = None
+        self._evals_result = None
+        self._best_score = None
+        self._best_iteration = None
+        self._n_features = None
+        self._classes = None
+        self._n_classes = None
+        self._other_params: Dict[str, Any] = {}
+        self.set_params(**kwargs)
+
+    # -- sklearn plumbing ------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = super().get_params(deep=deep)
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            setattr(self, key, value)
+            if hasattr(self, f"_{key}"):
+                setattr(self, f"_{key}", value)
+            if key not in self.__init__.__code__.co_varnames:
+                self._other_params[key] = value
+        return self
+
+    def _process_params(self) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("silent", None)
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        ren = {
+            "boosting_type": "boosting",
+            "min_split_gain": "min_gain_to_split",
+            "min_child_weight": "min_sum_hessian_in_leaf",
+            "min_child_samples": "min_data_in_leaf",
+            "subsample": "bagging_fraction",
+            "subsample_freq": "bagging_freq",
+            "colsample_bytree": "feature_fraction",
+            "reg_alpha": "lambda_l1",
+            "reg_lambda": "lambda_l2",
+            "subsample_for_bin": "bin_construct_sample_cnt",
+            "random_state": "seed",
+            "n_jobs": None,
+        }
+        out = {}
+        for key, value in params.items():
+            if key in ren:
+                new = ren[key]
+                if new is not None and value is not None:
+                    out[new] = value
+            elif value is not None:
+                out[key] = value
+        if out.get("seed") is None:
+            out.pop("seed", None)
+        if not self.silent:
+            out.setdefault("verbosity", 1)
+        else:
+            out.setdefault("verbosity", -1)
+        return out
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            early_stopping_rounds=None, verbose=False,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None) -> "LGBMModel":
+        params = self._process_params()
+        if self._objective_resolved is not None:
+            params["objective"] = self._objective_resolved
+        fobj = None
+        if callable(self.objective):
+            fobj = _objective_function_wrapper(self.objective)
+            params["objective"] = "none"
+        feval = _eval_function_wrapper(eval_metric) \
+            if callable(eval_metric) else None
+        if isinstance(eval_metric, str):
+            params["metric"] = eval_metric
+        elif isinstance(eval_metric, (list, tuple)):
+            params["metric"] = list(eval_metric)
+
+        if self.class_weight is not None and sample_weight is None:
+            sample_weight = self._class_sample_weight(y)
+
+        train_set = Dataset(X, label=y, weight=sample_weight,
+                            group=group, init_score=init_score,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+        valid_sets = []
+        valid_names = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                if vx is X and vy is y:
+                    valid_sets.append(train_set)
+                else:
+                    vw = eval_sample_weight[i] \
+                        if eval_sample_weight else None
+                    vg = eval_group[i] if eval_group else None
+                    vi = eval_init_score[i] if eval_init_score else None
+                    valid_sets.append(train_set.create_valid(
+                        vx, label=vy, weight=vw, group=vg,
+                        init_score=vi))
+                valid_names.append(
+                    eval_names[i] if eval_names else f"valid_{i}")
+
+        evals_result: Dict = {}
+        self._Booster = train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None,
+            valid_names=valid_names or None, fobj=fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=evals_result, verbose_eval=verbose,
+            callbacks=callbacks)
+        self._evals_result = evals_result
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        self._n_features = self._Booster.num_feature()
+        return self
+
+    @property
+    def _objective_resolved(self) -> Optional[str]:
+        return self.objective if isinstance(self.objective, str) \
+            else None
+
+    def _class_sample_weight(self, y):
+        from sklearn.utils.class_weight import compute_sample_weight
+        return compute_sample_weight(self.class_weight, y)
+
+    def predict(self, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs):
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted, call `fit` "
+                                "before exploiting the model.")
+        return self._Booster.predict(
+            X, raw_score=raw_score, num_iteration=num_iteration,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError("No booster found. Need to call fit "
+                                "beforehand.")
+        return self._Booster
+
+    @property
+    def best_iteration_(self):
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        return self._best_score
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def n_features_(self):
+        return self._n_features
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        if self._Booster is None:
+            raise LightGBMError("No booster found. Need to call fit "
+                                "beforehand.")
+        return self._Booster.feature_importance(
+            importance_type=self.importance_type)
+
+
+class LGBMRegressor(LGBMModel, RegressorMixin):
+    """sklearn.py:744-770."""
+
+    @property
+    def _objective_resolved(self):
+        return self.objective if isinstance(self.objective, str) \
+            else ("regression" if not callable(self.objective) else None)
+
+
+class LGBMClassifier(LGBMModel, ClassifierMixin):
+    """sklearn.py:771-912."""
+
+    def fit(self, X, y, **kwargs):
+        self._le = LabelEncoder().fit(y)
+        encoded = self._le.transform(y)
+        self._classes = self._le.classes_
+        self._n_classes = len(self._classes)
+        if self._n_classes > 2:
+            if not isinstance(self.objective, str) \
+                    or self.objective not in ("multiclass",
+                                              "multiclassova"):
+                if not callable(self.objective):
+                    self.objective = "multiclass"
+            self._other_params["num_class"] = self._n_classes
+        eval_set = kwargs.get("eval_set")
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            kwargs["eval_set"] = [
+                (vx, self._le.transform(vy)) for vx, vy in eval_set]
+        super().fit(X, encoded, **kwargs)
+        return self
+
+    @property
+    def _objective_resolved(self):
+        if isinstance(self.objective, str):
+            return self.objective
+        if callable(self.objective):
+            return None
+        return "binary" if (self._n_classes or 2) <= 2 else "multiclass"
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+    def predict(self, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs):
+        result = self.predict_proba(X, raw_score, num_iteration,
+                                    pred_leaf, pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim > 1:
+            class_index = np.argmax(result, axis=1)
+        else:
+            class_index = (result > 0.5).astype(int)
+        return self._le.inverse_transform(class_index)
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      num_iteration: Optional[int] = None,
+                      pred_leaf: bool = False,
+                      pred_contrib: bool = False, **kwargs):
+        result = super().predict(X, raw_score, num_iteration, pred_leaf,
+                                 pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+
+class LGBMRanker(LGBMModel):
+    """sklearn.py:913-961."""
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        eval_set = kwargs.get("eval_set")
+        if eval_set is not None and kwargs.get("eval_group") is None:
+            raise ValueError("Eval_group cannot be None when eval_set "
+                             "is not None")
+        super().fit(X, y, group=group, **kwargs)
+        return self
+
+    @property
+    def _objective_resolved(self):
+        return self.objective if isinstance(self.objective, str) \
+            else ("lambdarank" if not callable(self.objective) else None)

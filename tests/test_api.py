@@ -1,0 +1,292 @@
+"""Public API tests: lgb.train / cv / Dataset / Booster / callbacks /
+sklearn wrappers / predictor (leaf, contrib), mirroring the reference's
+test_engine.py / test_basic.py / test_sklearn.py strategy."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary(n=1200, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 2 * X[:, 0] - 1.5 * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n) * 0.3 > 0).astype(np.float64)
+    return X, y
+
+
+def _regression(n=1200, f=6, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = 3 * X[:, 0] + np.sin(2 * X[:, 1]) + rng.randn(n) * 0.1
+    return X, y
+
+
+def test_train_basic_binary():
+    X, y = _binary()
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, ds, num_boost_round=10)
+    assert booster.current_iteration() == 10
+    assert booster.num_trees() == 10
+    p = booster.predict(X)
+    assert p.shape == (len(y),)
+    assert ((p > 0.5) == y).mean() > 0.8
+    raw = booster.predict(X, raw_score=True)
+    np.testing.assert_allclose(1 / (1 + np.exp(-raw)), p, rtol=1e-5)
+
+
+def test_train_valid_early_stopping_and_evals_result():
+    X, y = _binary()
+    Xv, yv = _binary(seed=7)
+    ds = lgb.Dataset(X, label=y)
+    dv = ds.create_valid(Xv, label=yv)
+    evals = {}
+    booster = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "learning_rate": 0.3,
+         "metric": "binary_logloss", "verbosity": -1},
+        ds, num_boost_round=200, valid_sets=[dv],
+        early_stopping_rounds=5, evals_result=evals, verbose_eval=False)
+    assert booster.best_iteration > 0
+    assert len(evals["valid_0"]["binary_logloss"]) < 200
+    # predict with best_iteration by default
+    p_best = booster.predict(Xv)
+    p_all = booster.predict(Xv, num_iteration=-1)
+    assert p_best.shape == p_all.shape
+
+
+def test_custom_fobj_feval():
+    X, y = _binary()
+    ds = lgb.Dataset(X, label=y)
+
+    def logloss_obj(preds, dataset):
+        labels = dataset.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1 - p)
+
+    def error_feval(preds, dataset):
+        labels = dataset.get_label()
+        return "my_error", float(((preds > 0) != labels).mean()), False
+
+    evals = {}
+    booster = lgb.train({"num_leaves": 15, "verbosity": -1,
+                         "metric": "custom"},
+                        ds, num_boost_round=10, fobj=logloss_obj,
+                        feval=error_feval, valid_sets=[ds],
+                        evals_result=evals, verbose_eval=False)
+    assert "my_error" in evals["training"]
+    assert evals["training"]["my_error"][-1] < 0.3
+
+
+def test_reset_parameter_callback():
+    X, y = _regression()
+    ds = lgb.Dataset(X, label=y)
+    lrs = [0.3] * 5 + [0.1] * 5
+    booster = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        ds, num_boost_round=10, valid_sets=[ds], verbose_eval=False,
+        callbacks=[lgb.reset_parameter(learning_rate=lrs)])
+    assert booster.current_iteration() == 10
+
+
+def test_cv_regression():
+    X, y = _regression()
+    ds = lgb.Dataset(X, label=y)
+    res = lgb.cv({"objective": "regression", "num_leaves": 15,
+                  "verbosity": -1}, ds, num_boost_round=10, nfold=3,
+                 stratified=False, seed=42)
+    assert "l2-mean" in res and "l2-stdv" in res
+    assert len(res["l2-mean"]) == 10
+    assert res["l2-mean"][-1] < res["l2-mean"][0]
+
+
+def test_cv_binary_stratified_early_stop():
+    X, y = _binary()
+    ds = lgb.Dataset(X, label=y)
+    res = lgb.cv({"objective": "binary", "num_leaves": 31,
+                  "learning_rate": 0.3, "verbosity": -1}, ds,
+                 num_boost_round=100, nfold=3,
+                 early_stopping_rounds=3, seed=42)
+    assert len(res["binary_logloss-mean"]) < 100
+
+
+def test_dataset_save_load_model_file(tmp_path):
+    X, y = _binary()
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, ds, num_boost_round=5)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), booster.predict(X),
+                               rtol=1e-5)
+    s = booster.model_to_string()
+    loaded2 = lgb.Booster(model_str=s)
+    assert loaded2.num_trees() == booster.num_trees()
+    doc = booster.dump_model()
+    assert doc["num_class"] == 1
+
+
+def test_booster_feature_importance_and_names():
+    X, y = _binary()
+    names = [f"feat{i}" for i in range(X.shape[1])]
+    ds = lgb.Dataset(X, label=y, feature_name=names)
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, ds, num_boost_round=5)
+    assert booster.feature_name() == names
+    imp = booster.feature_importance()
+    assert imp.dtype == np.int64 and imp.sum() > 0
+    impg = booster.feature_importance("gain")
+    assert impg[0] > 0
+
+
+def test_pred_leaf_and_contrib():
+    X, y = _binary(n=400)
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "verbosity": -1}, ds, num_boost_round=3)
+    leaves = booster.predict(X, pred_leaf=True)
+    assert leaves.shape == (400, 3)
+    assert leaves.max() < 8 and leaves.min() >= 0
+    Xs = X[:25]
+    contrib = booster.predict(Xs, pred_contrib=True)
+    assert contrib.shape == (25, X.shape[1] + 1)
+    raw = booster.predict(Xs, raw_score=True)
+    # SHAP sums to the raw prediction (phi + expected value)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6,
+                               atol=1e-9)
+
+
+def test_pandas_dataframe_with_categorical():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(5)
+    n = 800
+    df = pd.DataFrame({
+        "num1": rng.randn(n),
+        "cat1": pd.Categorical(rng.choice(["a", "b", "c", "d"], n)),
+        "num2": rng.randn(n),
+    })
+    y = ((df["cat1"].cat.codes.to_numpy() % 2 == 0)
+         & (df["num1"] > 0)).astype(np.float64)
+    ds = lgb.Dataset(df, label=y)
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, ds, num_boost_round=10)
+    p = booster.predict(df)
+    assert ((p > 0.5) == y).mean() > 0.85
+
+
+def test_pandas_categorical_save_load_roundtrip(tmp_path):
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(6)
+    n = 600
+    df = pd.DataFrame({
+        "num1": rng.randn(n),
+        # category order intentionally non-alphabetical
+        "cat1": pd.Categorical(rng.choice(["b", "a", "c"], n),
+                               categories=["b", "a", "c"]),
+    })
+    y = (df["cat1"].cat.codes.to_numpy() == 1).astype(np.float64)
+    booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "verbosity": -1}, lgb.Dataset(df, label=y), 5)
+    path = str(tmp_path / "m.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.pandas_categorical == [["b", "a", "c"]]
+    # a frame with a different local category order must map identically
+    df2 = df.copy()
+    df2["cat1"] = pd.Categorical(df["cat1"].astype(str),
+                                 categories=["a", "b", "c"])
+    np.testing.assert_allclose(loaded.predict(df2), booster.predict(df),
+                               rtol=1e-6)
+
+
+def test_sklearn_classifier():
+    X, y = _binary()
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=15)
+    clf.fit(X, y)
+    acc = (clf.predict(X) == y).mean()
+    assert acc > 0.85
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert clf.n_classes_ == 2
+    assert clf.feature_importances_.sum() > 0
+
+
+def test_sklearn_classifier_multiclass_strings():
+    rng = np.random.RandomState(0)
+    X = rng.randn(900, 5)
+    y_int = (X[:, 0] > 0).astype(int) + 2 * (X[:, 1] > 0.5).astype(int)
+    y = np.asarray(["red", "green", "blue", "black"])[y_int]
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=8)
+    clf.fit(X, y)
+    assert set(clf.classes_) == {"red", "green", "blue", "black"}
+    pred = clf.predict(X)
+    assert (pred == y).mean() > 0.8
+    assert clf.predict_proba(X).shape == (900, 4)
+
+
+def test_sklearn_regressor_with_eval_set():
+    X, y = _regression()
+    Xv, yv = _regression(seed=9)
+    reg = lgb.LGBMRegressor(n_estimators=50, num_leaves=15,
+                            learning_rate=0.2)
+    reg.fit(X, y, eval_set=[(Xv, yv)], eval_metric="l1",
+            early_stopping_rounds=5)
+    assert reg.best_iteration_ != 0
+    pred = reg.predict(Xv)
+    assert np.mean((pred - yv) ** 2) < 1.0
+
+
+def test_sklearn_ranker():
+    rng = np.random.RandomState(3)
+    counts = rng.randint(5, 20, 40)
+    n = counts.sum()
+    X = rng.randn(n, 6)
+    rel = 2 * X[:, 0] - X[:, 1] + rng.randn(n) * 0.4
+    y = np.digitize(rel, np.quantile(rel, [0.6, 0.9]))
+    rk = lgb.LGBMRanker(n_estimators=10, num_leaves=15,
+                        min_child_samples=5)
+    rk.fit(X, y, group=counts)
+    s = rk.predict(X)
+    assert s.shape == (n,)
+    assert np.corrcoef(s, rel)[0, 1] > 0.5
+
+
+def test_file_loading_csv_and_libsvm(tmp_path):
+    X, y = _binary(n=300, f=4)
+    csv = tmp_path / "data.csv"
+    import pandas as pd
+    df = pd.DataFrame(np.column_stack([y, X]))
+    df.to_csv(csv, index=False, header=False)
+    ds = lgb.Dataset(str(csv))
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, ds, num_boost_round=5)
+    assert booster.num_trees() == 5
+    assert ds.num_feature() == 4
+
+    # libsvm with query sidecar
+    svm = tmp_path / "rank.svm"
+    counts = [100, 100, 100]
+    with open(svm, "w") as f:
+        for i in range(300):
+            feats = " ".join(f"{j}:{X[i, j]:.6f}" for j in range(4))
+            f.write(f"{int(y[i])} {feats}\n")
+    with open(str(svm) + ".query", "w") as f:
+        for c in counts:
+            f.write(f"{c}\n")
+    ds2 = lgb.Dataset(str(svm))
+    booster2 = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                          "min_data_in_leaf": 5, "verbosity": -1},
+                         ds2, num_boost_round=3)
+    assert booster2.num_trees() == 3
+
+
+def test_dataset_subset_and_sidecars():
+    X, y = _binary(n=600)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    sub = ds.subset(np.arange(0, 300))
+    sub.construct()
+    assert sub.num_data() == 300
+    assert ds.num_data() == 600
