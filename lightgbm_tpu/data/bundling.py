@@ -1,0 +1,214 @@
+"""Exclusive Feature Bundling (EFB).
+
+Reference analog: ``FindGroups`` / ``FastFeatureBundling``
+(src/io/dataset.cpp:41-314) + ``FeatureGroup`` offsets
+(include/LightGBM/feature_group.h:32-50). Mutually-(nearly-)exclusive
+features share one physical column: the TPU training matrix shrinks
+from ``[N, F]`` to ``[N, G]`` uint8, which divides BOTH the histogram
+kernel work and HBM traffic by F/G on wide-sparse data (the Bosch /
+Criteo shape; SURVEY §7 "lean on EFB bundling to densify").
+
+Layout per multi-feature group: value 0 = every member at its default
+bin; member ``i`` with ``num_bin_i`` bins owns the value range
+``[offset_i, offset_i + num_bin_i - 2]`` (its bins 1..num_bin_i-1),
+with ``offset_{i+1} = offset_i + num_bin_i - 1`` and group total
+``1 + sum(num_bin_i - 1) <= 256``. Per-feature histograms are
+reconstructed at scan time by slicing the group histogram and deriving
+bin 0 from the leaf totals (the reference's ``FixHistogram`` trick,
+dataset.cpp:1424-1442).
+
+Eligibility: numerical features whose default AND most-frequent bin is
+0 (the sparse-feature shape). Others get singleton groups that keep
+raw bin values (offset 0), so dense datasets pass through unchanged.
+
+Conflict rules mirror the reference: a feature may join a group when
+the count of rows where both are non-default stays within
+``total_sample_cnt / 10000``, the group's bin budget stays <= 256, and
+the feature's own conflicts stay <= nnz/2; candidate groups are
+searched newest-first with a random sample capped at 100
+(dataset.cpp:97-185). Two greedy passes (natural order and
+by-descending-nonzero-count) run and the one with fewer groups wins
+(FastFeatureBundling, dataset.cpp:238-302).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+MAX_BIN_PER_GROUP = 256
+MAX_SEARCH_GROUP = 100
+
+
+def decode_feature_bin(col, off, nbf):
+    """Group-column value -> this feature's bin (0 = default bin).
+
+    ``off == 0`` means raw passthrough. Arithmetic-only so the same
+    helper serves numpy host paths and jitted jax paths (no
+    module-specific ``where``).
+    """
+    in_range = (col >= off) & (col < off + nbf - 1)
+    fb = (col - off + 1) * in_range
+    return fb * (off > 0) + col * (off == 0)
+
+
+def encode_feature_bin(out_col: np.ndarray, bins: np.ndarray,
+                       off: int) -> None:
+    """Write a feature's non-default bins into its group column in
+    place (FeatureGroup::PushData semantics; host-side)."""
+    nz = bins != 0
+    out_col[nz] = (bins[nz].astype(np.int64) + off - 1).astype(
+        out_col.dtype)
+
+
+class BundlePlan:
+    """Result of bundling: per-inner-feature column/offset maps."""
+
+    def __init__(self, feature_group: np.ndarray,
+                 feature_offset: np.ndarray, num_groups: int,
+                 group_num_bins: np.ndarray):
+        self.feature_group = feature_group    # [F] i32 matrix column
+        self.feature_offset = feature_offset  # [F] i32, 0 = raw bins
+        self.num_groups = num_groups
+        self.group_num_bins = group_num_bins  # [G] i32
+
+    @property
+    def is_identity(self) -> bool:
+        return self.num_groups == len(self.feature_group) \
+            and (self.feature_offset == 0).all()
+
+
+def _find_groups(nz_idx: List[Optional[np.ndarray]], nbins: np.ndarray,
+                 order: np.ndarray, total: int, max_conflict: int,
+                 seed: int) -> List[List[int]]:
+    """One greedy pass (FindGroups, dataset.cpp:97-185). ``nz_idx[f]``
+    is the sorted array of non-default sample-row indices of eligible
+    feature f (None = ineligible -> singleton). Per-feature storage is
+    O(nnz) like the reference's index lists; only per-GROUP marks are
+    dense bool arrays."""
+    rng = np.random.RandomState(seed)
+    groups: List[List[int]] = []
+    marks: List[np.ndarray] = []
+    used_cnt: List[int] = []
+    total_cnt: List[int] = []
+    nbin: List[int] = []
+
+    singletons: List[List[int]] = []
+    for f in order:
+        f = int(f)
+        if nz_idx[f] is None:
+            singletons.append([f])
+            continue
+        idx = nz_idx[f]
+        nnz = len(idx)
+        add_bins = int(nbins[f]) - 1
+        available = [g for g in range(len(groups))
+                     if total_cnt[g] + nnz <= total + max_conflict
+                     and nbin[g] + add_bins <= MAX_BIN_PER_GROUP]
+        search: List[int] = []
+        if available:
+            search.append(available[-1])  # newest first
+            rest = available[:-1]
+            if len(rest) > MAX_SEARCH_GROUP - 1:
+                pick = rng.choice(len(rest), MAX_SEARCH_GROUP - 1,
+                                  replace=False)
+                rest = [rest[i] for i in pick]
+            search.extend(rest)
+        best = -1
+        best_cnt = -1
+        for g in search:
+            rest_max = max_conflict - total_cnt[g] + used_cnt[g]
+            cnt = int(marks[g][idx].sum())  # O(nnz) conflict count
+            if cnt <= rest_max and cnt <= nnz // 2:
+                best = g
+                best_cnt = cnt
+                break
+        if best >= 0:
+            groups[best].append(f)
+            total_cnt[best] += nnz
+            used_cnt[best] += nnz - best_cnt
+            marks[best][idx] = True
+            nbin[best] += add_bins
+        else:
+            groups.append([f])
+            mark = np.zeros(total, bool)
+            mark[idx] = True
+            marks.append(mark)
+            total_cnt.append(nnz)
+            used_cnt.append(nnz)
+            nbin.append(1 + add_bins)
+    return groups + singletons
+
+
+def plan_bundles(binned: np.ndarray, num_bins: np.ndarray,
+                 eligible: np.ndarray, sample_cnt: int = 100_000,
+                 seed: int = 0) -> BundlePlan:
+    """Greedy two-pass bundling over the binned matrix
+    (FastFeatureBundling, dataset.cpp:238-302)."""
+    n, f = binned.shape
+    if f == 0:
+        return BundlePlan(np.zeros(0, np.int32), np.zeros(0, np.int32),
+                          0, np.zeros(0, np.int32))
+    take = min(n, sample_cnt)
+    if take < n:
+        rows = np.sort(np.random.RandomState(seed).choice(
+            n, take, replace=False))
+        sample = binned[rows]
+    else:
+        sample = binned
+    total = sample.shape[0]
+    max_conflict = total // 10000
+
+    nz_idx: List[Optional[np.ndarray]] = []
+    nnz = np.zeros(f, np.int64)
+    for j in range(f):
+        if eligible[j]:
+            idx = np.nonzero(sample[:, j])[0]
+            nz_idx.append(idx)
+            nnz[j] = len(idx)
+        else:
+            nz_idx.append(None)
+
+    natural = np.arange(f)
+    by_cnt = np.argsort(-nnz, kind="stable")
+    g1 = _find_groups(nz_idx, num_bins, natural, total, max_conflict, seed)
+    g2 = _find_groups(nz_idx, num_bins, by_cnt, total, max_conflict, seed)
+    groups = g2 if len(g2) < len(g1) else g1
+
+    feature_group = np.zeros(f, np.int32)
+    feature_offset = np.zeros(f, np.int32)
+    group_num_bins = np.zeros(len(groups), np.int32)
+    for gid, feats in enumerate(groups):
+        if len(feats) == 1:
+            feature_group[feats[0]] = gid
+            feature_offset[feats[0]] = 0  # raw bins pass through
+            group_num_bins[gid] = num_bins[feats[0]]
+        else:
+            off = 1
+            for fidx in feats:
+                feature_group[fidx] = gid
+                feature_offset[fidx] = off
+                off += int(num_bins[fidx]) - 1
+            group_num_bins[gid] = off
+    return BundlePlan(feature_group, feature_offset, len(groups),
+                      group_num_bins)
+
+
+def bundle_matrix(binned: np.ndarray, plan: BundlePlan) -> np.ndarray:
+    """[N, F] raw bins -> [N, G] bundled columns (FeatureGroup::PushData
+    semantics: non-default values land at their offset; ties resolved
+    by feature order, bounded by the conflict budget)."""
+    n, f = binned.shape
+    max_b = int(plan.group_num_bins.max(initial=2))
+    dtype = np.uint8 if max_b <= 256 else np.uint16
+    out = np.zeros((n, max(plan.num_groups, 1)), dtype)
+    for j in range(f):
+        g = plan.feature_group[j]
+        off = plan.feature_offset[j]
+        col = binned[:, j]
+        if off == 0:
+            out[:, g] = col.astype(dtype)
+        else:
+            encode_feature_bin(out[:, g], col, int(off))
+    return out

@@ -137,6 +137,11 @@ class Dataset:
         self.zero_as_missing: bool = False
         self.monotone_types: List[int] = []
         self.feature_penalty: List[float] = []
+        # EFB bundling maps (identity when unbundled): binned is [N, G]
+        # with per-inner-feature column + value offset (data/bundling.py)
+        self.feature_group: Optional[np.ndarray] = None   # [F] i32
+        self.feature_offset: Optional[np.ndarray] = None  # [F] i32
+        self.group_num_bins: Optional[np.ndarray] = None  # [G] i32
         self._binned_device = None
 
     # ------------------------------------------------------------------
@@ -151,6 +156,22 @@ class Dataset:
     @property
     def num_features(self) -> int:
         return len(self.real_feature_idx)
+
+    @property
+    def num_groups(self) -> int:
+        """Physical matrix columns (== num_features when unbundled)."""
+        if self.group_num_bins is not None:
+            return len(self.group_num_bins)
+        return self.num_features
+
+    def bundle_maps(self):
+        """(feature_group, feature_offset, group_num_bins) with identity
+        defaults for unbundled datasets."""
+        f = self.num_features
+        if self.feature_group is None:
+            return (np.arange(f, dtype=np.int32),
+                    np.zeros(f, np.int32), self.num_bins_array())
+        return self.feature_group, self.feature_offset, self.group_num_bins
 
     def num_bin(self, inner_feature: int) -> int:
         return self.bin_mappers[self.real_feature_idx[inner_feature]].num_bin
@@ -202,11 +223,22 @@ class Dataset:
             self.feature_names = reference.feature_names
             self.monotone_types = reference.monotone_types
             self.feature_penalty = reference.feature_penalty
+            self.feature_group = reference.feature_group
+            self.feature_offset = reference.feature_offset
+            self.group_num_bins = reference.group_num_bins
         else:
             self._find_bins(data, config, categorical_features, forced_bins)
             self._resolve_monotone_and_penalty(config)
 
         self._extract_features(data)
+        if reference is None:
+            self._maybe_bundle(config)
+        elif self.feature_group is not None:
+            from .bundling import BundlePlan, bundle_matrix
+            plan = BundlePlan(self.feature_group, self.feature_offset,
+                              len(self.group_num_bins),
+                              self.group_num_bins)
+            self.binned = bundle_matrix(self.binned, plan)
         self.metadata.num_data = n
         if label is not None:
             self.metadata.set_label(label)
@@ -262,6 +294,38 @@ class Dataset:
         if not self.real_feature_idx:
             log_warning("There are no meaningful features, as all feature "
                         "values are constant.")
+
+    def _maybe_bundle(self, config: Config) -> None:
+        """EFB (FindGroups/FastFeatureBundling, dataset.cpp:41-314):
+        collapse nearly-exclusive features into shared columns. No-op
+        for dense data (every group ends up a singleton)."""
+        from .binning import BIN_TYPE_NUMERICAL
+        if not config.enable_bundle or self.num_features < 2:
+            return
+        if config.tree_learner in ("feature", "voting"):
+            # column-sharded learners slice per-feature columns
+            return
+        from .bundling import bundle_matrix, plan_bundles
+        nb = self.num_bins_array()
+        eligible = np.asarray([
+            m.bin_type == BIN_TYPE_NUMERICAL and m.most_freq_bin == 0
+            and m.default_bin == 0 and m.num_bin <= 256
+            for m in (self.feature_mapper(i)
+                      for i in range(self.num_features))])
+        if not eligible.any():
+            return
+        plan = plan_bundles(self.binned, nb, eligible,
+                            sample_cnt=self.bin_construct_sample_cnt,
+                            seed=config.data_random_seed)
+        if plan.num_groups >= self.num_features:
+            return
+        from ..utils.log import log_info
+        log_info(f"EFB: bundled {self.num_features} features into "
+                 f"{plan.num_groups} columns")
+        self.binned = bundle_matrix(self.binned, plan)
+        self.feature_group = plan.feature_group
+        self.feature_offset = plan.feature_offset
+        self.group_num_bins = plan.group_num_bins
 
     def _resolve_monotone_and_penalty(self, config: Config) -> None:
         mt = list(config.monotone_constraints)
@@ -327,6 +391,12 @@ class Dataset:
             "min_data_in_bin": self.min_data_in_bin,
             "use_missing": self.use_missing,
             "zero_as_missing": self.zero_as_missing,
+            "feature_group": None if self.feature_group is None
+            else [int(v) for v in self.feature_group],
+            "feature_offset": None if self.feature_offset is None
+            else [int(v) for v in self.feature_offset],
+            "group_num_bins": None if self.group_num_bins is None
+            else [int(v) for v in self.group_num_bins],
         }
         np.savez_compressed(
             path, binned=self.binned,
@@ -359,6 +429,13 @@ class Dataset:
             self.min_data_in_bin = meta["min_data_in_bin"]
             self.use_missing = meta["use_missing"]
             self.zero_as_missing = meta["zero_as_missing"]
+            if meta.get("feature_group") is not None:
+                self.feature_group = np.asarray(meta["feature_group"],
+                                                np.int32)
+                self.feature_offset = np.asarray(meta["feature_offset"],
+                                                 np.int32)
+                self.group_num_bins = np.asarray(meta["group_num_bins"],
+                                                 np.int32)
             self.binned = z["binned"]
             self.num_data = len(self.binned)
             md = Metadata(self.num_data)

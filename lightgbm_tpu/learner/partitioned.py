@@ -59,7 +59,10 @@ class PartitionedTreeLearner:
             has_categorical=any(
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
                 for i in range(dataset.num_features)))
-        self.num_bins_max = int(dataset.num_bins_array().max(initial=2))
+        _, _, group_bins = dataset.bundle_maps()
+        self.num_bins_max = max(
+            int(dataset.num_bins_array().max(initial=2)),
+            int(np.asarray(group_bins).max(initial=2)))
         if self.num_bins_max > 256:
             raise ValueError(
                 "PartitionedTreeLearner packs bins as uint8 and supports "
@@ -68,6 +71,8 @@ class PartitionedTreeLearner:
         self.num_leaves = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         self.num_features = dataset.num_features
+        self.num_groups = dataset.num_groups
+        self.bundled = dataset.feature_offset is not None
         self.num_data = dataset.num_data
         if interpret is None:
             interpret = jax.default_backend() not in ("tpu", "axon")
@@ -86,7 +91,8 @@ class PartitionedTreeLearner:
             self.mat, self.ws, grad, hess, bag_weight, feature_mask,
             self.meta, params=self.params, num_leaves=self.num_leaves,
             max_depth=self.max_depth, num_bins_max=self.num_bins_max,
-            num_features=self.num_features, n=self.num_data,
+            num_features=self.num_features, num_groups=self.num_groups,
+            n=self.num_data, bundled=self.bundled,
             interpret=self.interpret)
         return GrowResult(tree=tree, leaf_id=leaf_id)
 
@@ -100,13 +106,13 @@ class PartitionedTreeLearner:
 
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
-                              "num_bins_max", "num_features", "n",
-                              "interpret"),
+                              "num_bins_max", "num_features",
+                              "num_groups", "n", "bundled", "interpret"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       *, params, num_leaves, max_depth, num_bins_max,
-                      num_features, n, interpret):
-    f = num_features
+                      num_features, num_groups, n, bundled, interpret):
+    f = num_groups          # physical matrix columns (EFB groups)
     b = num_bins_max
     big_l = num_leaves
 
@@ -129,6 +135,10 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     inf = jnp.float32(jnp.inf)
 
     def scan_leaf(hist, g, h, c, depth, cmin, cmax):
+        if bundled:
+            from ..ops.histogram import debundle_hist
+            hist = debundle_hist(hist, meta.group, meta.offset,
+                                 meta.num_bins, g, h, c)
         res = best_split(hist, g, h, c, meta, params,
                          constraint_min=cmin, constraint_max=cmax,
                          feature_mask=feature_mask)
@@ -220,13 +230,35 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         cnt = st["leaf_cnt"][leaf]
 
         # ---- physical partition of the leaf's segment ----------------
+        # bundled numerical splits route through the kernel's LUT path:
+        # the 256-entry table encodes "group value -> goes left"
+        # including missing handling in feature-bin space
         lut = jnp.where(is_cat, bitset_to_lut(bitset),
                         jnp.zeros((1, 256), jnp.float32))
+        grp_col = meta.group[feat] if bundled else feat
+        use_lut = is_cat
+        if bundled:
+            from ..data.bundling import decode_feature_bin
+            off = meta.offset[feat]
+            nbf = meta.num_bins[feat]
+            vals = jnp.arange(256, dtype=jnp.int32)
+            # offset 0 would pass values through; masked by
+            # is_bundled_split below, so raw splits keep the fast path
+            fbin = decode_feature_bin(vals, off, nbf)
+            mcode = meta.missing[feat]
+            is_miss = jnp.where(
+                mcode == 1, fbin == meta.default_bin[feat],
+                jnp.where(mcode == 2, fbin == nbf - 1, False))
+            go_left = jnp.where(is_miss, dleft, fbin <= thr)
+            blut = go_left.astype(jnp.float32).reshape(1, 256)
+            is_bundled_split = (off > 0) & ~is_cat
+            lut = jnp.where(is_bundled_split, blut, lut)
+            use_lut = is_cat | is_bundled_split
         mat2, ws2, nl1 = partition_segment(
-            st["mat"], st["ws"], begin, cnt, feat, thr,
+            st["mat"], st["ws"], begin, cnt, grp_col, thr,
             dleft.astype(jnp.int32), meta.missing[feat],
             meta.default_bin[feat], meta.num_bins[feat],
-            is_cat.astype(jnp.int32), lut, blk=PART_BLK,
+            use_lut.astype(jnp.int32), lut, blk=PART_BLK,
             interpret=interpret)
         nl = nl1[0]
         nr = cnt - nl

@@ -65,12 +65,15 @@ def feature_meta_from_dataset(dataset: Dataset,
         if dataset.monotone_types else np.zeros(f, np.int32)
     penalty = np.asarray(dataset.feature_penalty, np.float32) \
         if dataset.feature_penalty else np.ones(f, np.float32)
+    group, offset, _ = dataset.bundle_maps()
     return FeatureMeta(
         num_bins=jnp.asarray(num_bins), missing=jnp.asarray(missing),
         default_bin=jnp.asarray(default_bin),
         most_freq_bin=jnp.asarray(most_freq),
         monotone=jnp.asarray(monotone), penalty=jnp.asarray(penalty),
-        is_categorical=jnp.asarray(is_cat))
+        is_categorical=jnp.asarray(is_cat),
+        group=jnp.asarray(np.asarray(group, np.int32)),
+        offset=jnp.asarray(np.asarray(offset, np.int32)))
 
 
 def split_params_from_config(config: Config) -> SplitParams:
@@ -106,7 +109,11 @@ class SerialTreeLearner:
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
                 for i in range(dataset.num_features)))
         self.binned = jnp.asarray(dataset.binned)
-        self.num_bins_max = int(dataset.num_bins_array().max(initial=2))
+        _, _, group_bins = dataset.bundle_maps()
+        self.num_bins_max = max(
+            int(dataset.num_bins_array().max(initial=2)),
+            int(np.asarray(group_bins).max(initial=2)))
+        self.bundled = dataset.feature_offset is not None
         self.num_leaves = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         self.hist_method = hist_method
@@ -125,7 +132,8 @@ class SerialTreeLearner:
                          num_leaves=self.num_leaves,
                          max_depth=self.max_depth,
                          num_bins_max=self.num_bins_max,
-                         hist_method=self.hist_method)
+                         hist_method=self.hist_method,
+                         bundled=self.bundled)
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -137,19 +145,21 @@ class SerialTreeLearner:
 
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
-                              "num_bins_max", "hist_method"))
+                              "num_bins_max", "hist_method", "bundled"))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta, *,
-              params, num_leaves, max_depth, num_bins_max, hist_method):
+              params, num_leaves, max_depth, num_bins_max, hist_method,
+              bundled=False):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
-                     hist_method=hist_method)
+                     hist_method=hist_method, bundled=bundled)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               meta: FeatureMeta, params: SplitParams, num_leaves: int,
               max_depth: int, num_bins_max: int, hist_method: str,
-              comm=None, binned_hist=None, meta_hist=None) -> GrowResult:
+              comm=None, binned_hist=None, meta_hist=None,
+              bundled: bool = False) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -178,6 +188,11 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     inf = jnp.float32(jnp.inf)
 
     def scan_leaf(hist, g, h, c, depth, cmin, cmax):
+        if bundled:
+            # EFB: group histograms -> per-feature histograms
+            from ..ops.histogram import debundle_hist
+            hist = debundle_hist(hist, meta_hist.group, meta_hist.offset,
+                                 meta_hist.num_bins, g, h, c)
         res = comm.select_split(hist, g, h, c, meta_hist, params,
                                 cmin, cmax, feature_mask)
         blocked = (max_depth > 0) & (depth >= max_depth)
@@ -268,7 +283,12 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
 
         # ---- partition rows of `leaf` ---------------------------------
-        bin_col = jnp.take(binned, feat, axis=1)
+        bin_col = jnp.take(binned, meta.group[feat], axis=1)
+        if bundled:
+            from ..data.bundling import decode_feature_bin
+            bin_col = decode_feature_bin(
+                bin_col.astype(jnp.int32), meta.offset[feat],
+                meta.num_bins[feat]).astype(bin_col.dtype)
         leaf_id = split_leaf(
             st["leaf_id"], bin_col, leaf, new, thr, dleft,
             meta.missing[feat], meta.default_bin[feat],

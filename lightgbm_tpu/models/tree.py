@@ -105,6 +105,11 @@ class Tree:
             self._default_bin = np.asarray(
                 [dataset.feature_mapper(f).default_bin
                  for f in self.split_feature_inner], np.int32)
+            # EFB: physical column + value offset per node
+            grp, off, _ = dataset.bundle_maps()
+            self._col = np.asarray(grp, np.int32)[self.split_feature_inner]
+            self._offset = np.asarray(off,
+                                      np.int32)[self.split_feature_inner]
             # categorical: raw category values on the left side
             self.cat_threshold: List[np.ndarray] = []
             for i in range(len(self.split_feature_inner)):
@@ -121,6 +126,8 @@ class Tree:
             self._missing_code = np.zeros(len(self.split_feature), np.int32)
             self._num_bin = np.zeros(len(self.split_feature), np.int32)
             self._default_bin = np.zeros(len(self.split_feature), np.int32)
+            self._col = self.split_feature_inner.copy()
+            self._offset = np.zeros(len(self.split_feature), np.int32)
             self.cat_threshold = [np.zeros(0, np.int64)
                                   for _ in self.split_feature]
 
@@ -215,7 +222,10 @@ class Tree:
                 break
             idx = np.nonzero(active)[0]
             nd = node[idx]
-            b = binned[idx, self.split_feature_inner[nd]].astype(np.int32)
+            from ..data.bundling import decode_feature_bin
+            b = decode_feature_bin(
+                binned[idx, self._col[nd]].astype(np.int32),
+                self._offset[nd], self._num_bin[nd])
             miss = self._missing_code[nd]
             dleft = (self.decision_type[nd] & kDefaultLeftMask) != 0
             is_cat = (self.decision_type[nd] & kCategoricalMask) != 0
@@ -263,7 +273,8 @@ class Tree:
         leaf_vals[:self.num_leaves] = self.leaf_value
         return _traverse_binned_jax(
             binned_dev,
-            jnp.asarray(pad(self.split_feature_inner)),
+            jnp.asarray(pad(self._col)),
+            jnp.asarray(pad(self._offset)),
             jnp.asarray(pad(self.threshold_bin)),
             jnp.asarray(pad(self.decision_type)),
             jnp.asarray(pad(self.left_child, fill=-1)),
@@ -282,10 +293,12 @@ class Tree:
 
 
 @jax.jit
-def _traverse_binned_jax(binned, feat, thr, dec, left, right, miss,
+def _traverse_binned_jax(binned, col, offset, thr, dec, left, right, miss,
                          default_bin, num_bin, cat_bitsets, leaf_vals):
     """Vectorized bin-space tree walk (NumericalDecision semantics of
-    predict_leaf_index_binned, in one lax.while_loop)."""
+    predict_leaf_index_binned, in one lax.while_loop). ``col``/``offset``
+    are the EFB physical column + value offset per node (offset 0 =
+    raw bins)."""
     n = binned.shape[0]
     rows = jnp.arange(n)
 
@@ -295,7 +308,9 @@ def _traverse_binned_jax(binned, feat, thr, dec, left, right, miss,
     def body(state):
         node, out, done = state
         nd = jnp.where(done, 0, node)
-        b = binned[rows, feat[nd]].astype(jnp.int32)
+        from ..data.bundling import decode_feature_bin
+        b = decode_feature_bin(binned[rows, col[nd]].astype(jnp.int32),
+                               offset[nd], num_bin[nd])
         m = miss[nd]
         dleft = (dec[nd] & kDefaultLeftMask) != 0
         is_cat = (dec[nd] & kCategoricalMask) != 0
@@ -383,15 +398,18 @@ def traverse_tree_arrays(arrays: TreeArrays, binned_dev, meta,
     miss = meta.missing[feat]
     dbin = meta.default_bin[feat]
     nbin = meta.num_bins[feat]
+    col = meta.group[feat] if meta.group is not None else feat
+    off = meta.offset[feat] if meta.offset is not None \
+        else jnp.zeros_like(feat)
     leaf_vals = arrays.leaf_value * scale
     return _traverse_arrays_jax(
-        binned_dev, feat, arrays.threshold_bin, arrays.decision_type,
+        binned_dev, col, off, arrays.threshold_bin, arrays.decision_type,
         arrays.left_child, arrays.right_child, miss, dbin, nbin,
         arrays.cat_bitsets, leaf_vals, arrays.num_leaves)
 
 
 @jax.jit
-def _traverse_arrays_jax(binned, feat, thr, dec, left, right, miss,
+def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right, miss,
                          default_bin, num_bin, cat_bitsets, leaf_vals,
                          num_leaves):
     """Like ``_traverse_binned_jax`` but over full-size (num_leaves_max)
@@ -408,7 +426,9 @@ def _traverse_arrays_jax(binned, feat, thr, dec, left, right, miss,
     def body(state):
         node, out, done, fuel = state
         nd = jnp.where(done, 0, node)
-        b = binned[rows, feat[nd]].astype(jnp.int32)
+        from ..data.bundling import decode_feature_bin
+        b = decode_feature_bin(binned[rows, col[nd]].astype(jnp.int32),
+                               offset[nd], num_bin[nd])
         m = miss[nd]
         dleft = (dec[nd] & kDefaultLeftMask) != 0
         is_cat = (dec[nd] & kCategoricalMask) != 0

@@ -101,6 +101,36 @@ def build_histogram(binned: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
     raise ValueError(f"unknown histogram method {method}")
 
 
+def debundle_hist(hist_g: jnp.ndarray, group: jnp.ndarray,
+                  offset: jnp.ndarray, num_bins: jnp.ndarray,
+                  leaf_g, leaf_h, leaf_c) -> jnp.ndarray:
+    """EFB group histograms -> per-feature histograms.
+
+    hist_g: [G, B, 3] histograms over bundled columns. For feature f
+    with offset o > 0, its bins 1..nb-1 live at group bins
+    o..o+nb-2 (data/bundling.py layout) and bin 0 is reconstructed
+    from the leaf totals — Dataset::FixHistogram semantics
+    (dataset.cpp:1424-1442). offset 0 = raw passthrough. Returns
+    [F, B, 3].
+    """
+    b = hist_g.shape[1]
+    hf = hist_g[group]                               # [F, B, 3]
+    bins = jnp.arange(b, dtype=jnp.int32)[None, :]   # [1, B]
+    src = offset[:, None] + bins - 1                 # [F, B]
+    valid = (bins >= 1) & (bins < num_bins[:, None])
+    gathered = jnp.take_along_axis(
+        hf, jnp.clip(src, 0, b - 1)[:, :, None], axis=1)
+    x = jnp.where(valid[:, :, None], gathered, 0.0)
+    sums = x.sum(axis=1)                             # [F, 3]
+    f = hf.shape[0]
+    totals = jnp.stack([jnp.broadcast_to(leaf_g, (f,)),
+                        jnp.broadcast_to(leaf_h, (f,)),
+                        jnp.broadcast_to(leaf_c, (f,))], axis=-1)
+    x = x.at[:, 0, :].set(totals - sums)
+    bundled = (offset > 0)[:, None, None]
+    return jnp.where(bundled, x, hf)
+
+
 def fix_histogram(hist: jnp.ndarray, parent_g: jnp.ndarray,
                   parent_h: jnp.ndarray, parent_c: jnp.ndarray,
                   most_freq_bins: jnp.ndarray) -> jnp.ndarray:

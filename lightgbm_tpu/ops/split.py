@@ -50,6 +50,10 @@ class FeatureMeta(NamedTuple):
     monotone: jnp.ndarray      # int32 in {-1, 0, +1}
     penalty: jnp.ndarray       # float32
     is_categorical: jnp.ndarray  # bool
+    # EFB bundling maps (data/bundling.py): physical matrix column of
+    # each feature and its value offset inside it (0 = raw bins)
+    group: jnp.ndarray = None    # int32
+    offset: jnp.ndarray = None   # int32
 
 
 class SplitParams(NamedTuple):

@@ -77,6 +77,18 @@ def _round_up(n: int, d: int) -> int:
     return (n + d - 1) // d * d
 
 
+def _reject_bundled(dataset: Dataset, learner_type: str) -> None:
+    """Column-sharded learners cannot consume an EFB-bundled matrix.
+    Dataset construction skips bundling when tree_learner is set up
+    front; this guards reuse of a dataset built for another learner."""
+    if dataset.feature_offset is not None:
+        from ..utils.log import log_fatal
+        log_fatal(
+            f"{learner_type}-parallel training cannot use an EFB-bundled "
+            "Dataset; reconstruct it with enable_bundle=false or with "
+            f"tree_learner={learner_type} set in the dataset params")
+
+
 class _MeshLearnerBase(SerialTreeLearner):
     """Shared setup: mesh, padding, shard_map-wrapped grow program."""
 
@@ -133,7 +145,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                 binned_l, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
-                hist_method=self.hist_method, comm=comm)
+                hist_method=self.hist_method, comm=comm,
+                bundled=self.bundled)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -150,6 +163,7 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
     (feature_parallel_tree_learner.cpp semantics)."""
 
     def _build(self):
+        _reject_bundled(self.dataset, "feature")
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = n  # rows are replicated, no row padding
@@ -171,7 +185,9 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 monotone=jnp.pad(meta.monotone, (0, fpad)),
                 penalty=jnp.pad(meta.penalty, (0, fpad),
                                 constant_values=1.0),
-                is_categorical=jnp.pad(meta.is_categorical, (0, fpad)))
+                is_categorical=jnp.pad(meta.is_categorical, (0, fpad)),
+                group=jnp.pad(meta.group, (0, fpad)),
+                offset=jnp.pad(meta.offset, (0, fpad)))
         else:
             meta_h = meta
         comm = make_feature_parallel_comm(AXIS, self._f_local)
@@ -212,6 +228,9 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
     sharded; only top-k candidate features' histograms are aggregated."""
 
     def _build(self):
+        # voting debundles per shard BEFORE its gather/reduce, so the
+        # bin-0 totals reconstruction would double count across shards
+        _reject_bundled(self.dataset, "voting")
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = _round_up(n, d)
@@ -235,7 +254,8 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
                 binned_l, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
-                hist_method=self.hist_method, comm=comm)
+                hist_method=self.hist_method, comm=comm,
+                bundled=self.bundled)
 
         mapped = shard_map(
             body, mesh=self.mesh,

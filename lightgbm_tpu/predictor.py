@@ -113,7 +113,8 @@ def _device_predict(models, data, dataset, k: int) -> np.ndarray:
             out[i, :len(a)] = a
         return out
 
-    feat = stack("split_feature_inner", np.int32)
+    col = stack("_col", np.int32)
+    off = stack("_offset", np.int32)
     thr = stack("threshold_bin", np.int32)
     dec = stack("decision_type", np.int32)
     left = stack("left_child", np.int32, -1)
@@ -132,7 +133,8 @@ def _device_predict(models, data, dataset, k: int) -> np.ndarray:
         n_leaves[i] = m.num_leaves
 
     out = _scan_trees(
-        jnp.asarray(binned), jnp.asarray(feat), jnp.asarray(thr),
+        jnp.asarray(binned), jnp.asarray(col), jnp.asarray(off),
+        jnp.asarray(thr),
         jnp.asarray(dec), jnp.asarray(left), jnp.asarray(right),
         jnp.asarray(miss), jnp.asarray(dbin), jnp.asarray(nbin),
         jnp.asarray(cat), jnp.asarray(leaf_vals), jnp.asarray(n_leaves),
@@ -141,7 +143,7 @@ def _device_predict(models, data, dataset, k: int) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _scan_trees(binned, feat, thr, dec, left, right, miss, dbin, nbin,
+def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
                 cat, leaf_vals, n_leaves, tree_class, k):
     import jax.numpy as jnp
     from .models.tree import _traverse_arrays_jax
@@ -149,30 +151,37 @@ def _scan_trees(binned, feat, thr, dec, left, right, miss, dbin, nbin,
     n = binned.shape[0]
 
     def body(acc, tree):
-        (f, th, d, l, r, mi, db, nb, ct, lv, nl, cls) = tree
-        add = _traverse_arrays_jax(binned, f, th, d, l, r, mi, db, nb,
+        (c, o, th, d, l, r, mi, db, nb, ct, lv, nl, cls) = tree
+        add = _traverse_arrays_jax(binned, c, o, th, d, l, r, mi, db, nb,
                                    ct, lv, nl)
         return acc.at[:, cls].add(add), None
 
     acc0 = jnp.zeros((n, k), jnp.float32)
     acc, _ = jax.lax.scan(
         body, acc0,
-        (feat, thr, dec, left, right, miss, dbin, nbin, cat, leaf_vals,
-         n_leaves, tree_class))
+        (col, off, thr, dec, left, right, miss, dbin, nbin, cat,
+         leaf_vals, n_leaves, tree_class))
     return acc
 
 
 def _bin_data(data: np.ndarray, dataset) -> np.ndarray:
     """Re-bin raw features with the training BinMappers (ValueToBin,
-    bin.h:504-540) — vectorized per feature."""
+    bin.h:504-540) — vectorized per feature, into the dataset's
+    (possibly EFB-bundled) column layout."""
     n = data.shape[0]
     f_used = dataset.num_features
     dtype = dataset.binned.dtype
-    out = np.zeros((n, f_used), dtype)
+    group, offset, _ = dataset.bundle_maps()
+    out = np.zeros((n, dataset.num_groups), dtype)
+    from .data.bundling import encode_feature_bin
     for inner in range(f_used):
         mapper = dataset.feature_mapper(inner)
-        col = data[:, dataset.real_feature_idx[inner]]
-        out[:, inner] = mapper.values_to_bins(col)
+        vb = mapper.values_to_bins(data[:, dataset.real_feature_idx[inner]])
+        g, off = int(group[inner]), int(offset[inner])
+        if off == 0:
+            out[:, g] = vb.astype(dtype)
+        else:
+            encode_feature_bin(out[:, g], vb, off)
     return out
 
 

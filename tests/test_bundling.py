@@ -1,0 +1,172 @@
+"""EFB bundling tests: grouping algorithm, matrix layout, debundled
+histograms, and end-to-end training accuracy parity on a Bosch-shaped
+wide-sparse synthetic (VERDICT r2 item 6)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data import Dataset
+from lightgbm_tpu.data.bundling import bundle_matrix, plan_bundles
+from lightgbm_tpu.models.gbdt import GBDT
+
+
+def _sparse_problem(n=4000, f=60, informative=4, block=12, seed=0):
+    """Wide mostly-zero matrix: a few dense informative features plus
+    one-hot-style blocks (each row activates at most one feature per
+    block) — the canonical exclusive-feature shape EFB targets."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, f))
+    for j in range(informative):
+        X[:, j] = rng.randn(n)
+    j = informative
+    while j < f:
+        width = min(block, f - j)
+        which = rng.randint(0, width + 1, n)  # width = "none active"
+        rows = np.nonzero(which < width)[0]
+        # indicator-style values (few bins per feature, like one-hot /
+        # count features) so a block fits one u8 column
+        X[rows, j + which[rows]] = rng.randint(1, 4, len(rows))
+        j += width
+    logit = (2 * X[:, 0] - 1.5 * X[:, 1]
+             + 3.0 * (X[:, informative] > 0)
+             + 2.0 * (X[:, informative + 1] > 0))
+    y = (logit + rng.randn(n) * 0.3 > 0.5).astype(np.float32)
+    return X, y
+
+
+def test_plan_bundles_sparse_features_collapse():
+    X, y = _sparse_problem()
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    # sparse features (3% density, conflict budget n/10000) must bundle
+    assert ds.feature_group is not None
+    assert ds.num_groups < ds.num_features / 2
+    assert ds.binned.shape[1] == ds.num_groups
+    # group bin budget respected
+    assert int(ds.group_num_bins.max()) <= 256
+
+
+def test_bundled_matrix_roundtrip_values():
+    """Every feature's bin is recoverable from its bundled column
+    wherever no conflict occurred."""
+    X, y = _sparse_problem(n=2000, f=30)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    if ds.feature_group is None:
+        pytest.skip("nothing bundled")
+    # rebuild raw bins independently
+    raw = np.zeros((ds.num_data, ds.num_features), np.int64)
+    for inner in range(ds.num_features):
+        m = ds.feature_mapper(inner)
+        raw[:, inner] = m.values_to_bins(
+            X[:, ds.real_feature_idx[inner]].astype(np.float64))
+    grp, off, _ = ds.bundle_maps()
+    recovered_ok = 0
+    total_nonzero = 0
+    for inner in range(ds.num_features):
+        g, o = int(grp[inner]), int(off[inner])
+        col = ds.binned[:, g].astype(np.int64)
+        if o == 0:
+            np.testing.assert_array_equal(col, raw[:, inner])
+            continue
+        nb = ds.num_bin(inner)
+        fb = np.where((col >= o) & (col < o + nb - 1), col - o + 1, 0)
+        nz = raw[:, inner] != 0
+        total_nonzero += int(nz.sum())
+        recovered_ok += int((fb[nz] == raw[nz, inner]).sum())
+    # conflicts may clobber a bounded number of values
+    assert total_nonzero > 0
+    assert recovered_ok >= total_nonzero * 0.99
+
+
+def test_debundle_hist_matches_unbundled():
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import (build_histogram, debundle_hist,
+                                            make_ghc)
+    X, y = _sparse_problem(n=2000, f=30)
+    cfg_b = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds_b = Dataset.from_numpy(X, cfg_b, label=y)
+    cfg_u = Config.from_params({"objective": "binary",
+                                "enable_bundle": False, "verbosity": -1})
+    ds_u = Dataset.from_numpy(X, cfg_u, label=y)
+    if ds_b.feature_group is None:
+        pytest.skip("nothing bundled")
+    rng = np.random.RandomState(1)
+    grad = jnp.asarray(rng.randn(ds_b.num_data).astype(np.float32))
+    hess = jnp.asarray(np.abs(rng.randn(ds_b.num_data)).astype(np.float32))
+    ghc = make_ghc(grad, hess)
+    b = max(int(ds_b.group_num_bins.max()),
+            int(ds_u.num_bins_array().max()))
+    hist_g = build_histogram(jnp.asarray(ds_b.binned), ghc, b,
+                             method="scatter")
+    hist_u = build_histogram(jnp.asarray(ds_u.binned), ghc, b,
+                             method="scatter")
+    grp, off, _ = ds_b.bundle_maps()
+    totals = ghc.sum(axis=0)
+    hist_f = debundle_hist(hist_g, jnp.asarray(grp), jnp.asarray(off),
+                           jnp.asarray(ds_b.num_bins_array()),
+                           totals[0], totals[1], totals[2])
+    # compare bin contents feature by feature where bins are in range;
+    # conflicts shift a bounded number of rows between bin 0 and others
+    hf = np.asarray(hist_f)
+    hu = np.asarray(hist_u)
+    for inner in range(ds_b.num_features):
+        nb = ds_b.num_bin(inner)
+        diff = np.abs(hf[inner, :nb, 2] - hu[inner, :nb, 2]).sum()
+        assert diff <= max(4.0, 0.005 * ds_b.num_data), \
+            (inner, diff)
+
+
+def test_bundled_training_matches_unbundled_accuracy():
+    X, y = _sparse_problem()
+    accs = {}
+    preds = {}
+    for tag, enable in (("bundled", True), ("raw", False)):
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 31,
+            "learning_rate": 0.2, "enable_bundle": enable,
+            "verbosity": -1})
+        ds = Dataset.from_numpy(X, cfg, label=y)
+        booster = GBDT(cfg, ds)
+        booster.train(20)
+        p = booster.predict(X)
+        accs[tag] = ((p > 0.5) == y).mean()
+        preds[tag] = p
+    assert accs["bundled"] > 0.9
+    assert abs(accs["bundled"] - accs["raw"]) < 0.02, accs
+
+
+def test_bundled_model_save_load_predict(tmp_path):
+    X, y = _sparse_problem(n=2000, f=40)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 15,
+                              "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    if ds.feature_group is None:
+        pytest.skip("nothing bundled")
+    booster = GBDT(cfg, ds)
+    booster.train(5)
+    from lightgbm_tpu.io.model_text import (load_model_from_string,
+                                            save_model_to_string)
+    loaded = load_model_from_string(save_model_to_string(booster))
+    # loaded model predicts on RAW features; must match training booster
+    np.testing.assert_allclose(loaded.predict_raw(X)[:, 0],
+                               booster.predict_raw(X), rtol=1e-6)
+
+
+def test_bundled_valid_set_and_device_predict():
+    import lightgbm_tpu as lgb
+    X, y = _sparse_problem(n=3000, f=40)
+    Xv, yv = _sparse_problem(n=1000, f=40, seed=9)
+    ds = lgb.Dataset(X, label=y)
+    dv = ds.create_valid(Xv, label=yv)
+    evals = {}
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "metric": "auc", "verbosity": -1}, ds, 10,
+                        valid_sets=[dv], evals_result=evals,
+                        verbose_eval=False)
+    assert evals["valid_0"]["auc"][-1] > 0.8
+    # large predict goes through the device scan path; small through host
+    p_dev = booster.predict(np.vstack([Xv] * 70))  # > 1<<16 rows x trees
+    p_host = booster.predict(Xv)
+    np.testing.assert_allclose(p_dev[:len(Xv)], p_host, rtol=1e-5)
