@@ -1,9 +1,30 @@
-"""auc_mu multiclass AUC metric (M2).
+"""auc_mu multiclass AUC metric.
 
-Reference analog: ``src/metric/multiclass_metric.hpp:200+``.
+Reference analog: ``AucMuMetric``
+(``src/metric/multiclass_metric.hpp:183-300``), implementing the AUC-mu
+measure of Kleiman & Page (ICML'19). For every unordered class pair
+``(i, j)`` the raw scores are projected onto the partition-weight
+difference vector ``v = w[i] - w[j]`` scaled by ``t1 = v[i] - v[j]``,
+and the two-class AUC of that 1-D ranking is computed (ties: class-j
+points at the same projected distance count half). The final value is
+the unweighted mean over the ``C*(C-1)/2`` pairs.
+
+The reference walks a sorted index list per pair; here each pair is a
+vectorized NumPy pass (sort + cumulative j-counts + per-equal-run
+half-tie correction), which reproduces the reference's epsilon-tie walk
+for distances that are exactly equal (the reference's kEpsilon=1e-15
+comparator collapses the same runs on clean data).
+
+Sample weights are ignored on purpose: the reference's AucMuMetric::Init
+reads only the label (multiclass_metric.hpp:196-209) — unlike the
+pointwise multiclass metrics, AUC-mu is defined on unweighted ranks.
+A class with no data poisons its pairs to NaN exactly like the
+reference's 0/0 division (multiclass_metric.hpp:288-293).
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 from ..utils.log import log_fatal
 from .metrics import Metric
@@ -14,5 +35,66 @@ class AucMuMetric(Metric):
     factor_to_bigger_better = 1.0
 
     def init(self, metadata, num_data):
-        log_fatal("auc_mu metric lands in M2 "
-                  "(multiclass_metric.hpp:200+ port)")
+        super().init(metadata, num_data)
+        c = int(self.config.num_class)
+        if c < 2:
+            log_fatal("auc_mu requires num_class >= 2")
+        w = self.config.auc_mu_weights
+        if w:
+            if len(w) != c * c:
+                log_fatal(
+                    f"auc_mu_weights must have {c * c} elements "
+                    f"(num_class^2), got {len(w)}")
+            self.class_weights = np.asarray(w, np.float64).reshape(c, c)
+        else:
+            # default: all-ones off-diagonal, zero diagonal
+            # (Config::GetAucMuWeights, src/io/config.cpp:156-178)
+            self.class_weights = np.ones((c, c), np.float64)
+            np.fill_diagonal(self.class_weights, 0.0)
+        self.num_class = c
+
+    def eval(self, score, objective):
+        # raw scores [N, C]; the reference ignores the objective here
+        score = np.asarray(score, np.float64)
+        c = self.num_class
+        if score.ndim != 2 or score.shape[1] != c:
+            log_fatal(f"auc_mu expects [num_data, num_class] scores, "
+                      f"got shape {score.shape} for num_class={c}")
+        lbl = self.label.astype(np.int64)
+        by_class = [np.nonzero(lbl == k)[0] for k in range(c)]
+        total = 0.0
+        for i in range(c):
+            for j in range(i + 1, c):
+                if by_class[i].size == 0 or by_class[j].size == 0:
+                    total += np.nan  # reference: S/(0*n) = NaN
+                    continue
+                total += self._pair_auc(score, i, j,
+                                        by_class[i], by_class[j])
+        return [2.0 * total / (c * (c - 1))]
+
+    def _pair_auc(self, score, i, j, idx_i, idx_j) -> float:
+        v = self.class_weights[i] - self.class_weights[j]
+        t1 = v[i] - v[j]
+        idx = np.concatenate([idx_i, idx_j])
+        d = t1 * (score[idx] @ v)
+        is_j = np.zeros(idx.size, bool)
+        is_j[idx_i.size:] = True
+        # ascending distance; within equal distances class-j first
+        # (multiclass_metric.hpp:249-258)
+        order = np.lexsort((~is_j, d))
+        d = d[order]
+        is_j = is_j[order]
+        # j's seen strictly before position k (ties sort j first, so
+        # tied j's are included -- matching the reference's walk)
+        cum_j = np.cumsum(is_j)
+        num_j_before = cum_j - is_j  # exclusive at k
+        # per equal-distance run: how many j's share this distance
+        new_run = np.empty(d.size, bool)
+        new_run[0] = True
+        new_run[1:] = d[1:] != d[:-1]
+        run_id = np.cumsum(new_run) - 1
+        j_in_run = np.bincount(run_id, weights=is_j)
+        contrib = np.where(
+            ~is_j, num_j_before - 0.5 * j_in_run[run_id], 0.0)
+        s = float(contrib.sum())
+        return s / (idx_i.size * idx_j.size)
