@@ -369,20 +369,24 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def _src(self):
+        """The backing model: trained GBDT if present, else the parsed
+        LoadedBooster. Every model-IO/inspection method dispatches
+        through here so loaded models are first-class."""
+        src = self._gbdt if self._gbdt is not None else self._loaded
+        if src is None:
+            raise LightGBMError("Booster has neither a trained nor a "
+                                "loaded model")
+        return src
+
     def current_iteration(self) -> int:
-        if self._gbdt is not None:
-            return self._gbdt.num_iterations_trained
-        return self._loaded.num_iterations_trained
+        return self._src().num_iterations_trained
 
     def num_trees(self) -> int:
-        if self._gbdt is not None:
-            return len(self._gbdt.models)
-        return len(self._loaded.models)
+        return len(self._src().models)
 
     def num_model_per_iteration(self) -> int:
-        if self._gbdt is not None:
-            return self._gbdt.num_tree_per_iteration
-        return self._loaded.num_tree_per_iteration
+        return self._src().num_tree_per_iteration
 
     def __inner_predict_train(self) -> np.ndarray:
         sc = np.asarray(self._gbdt.train_score, np.float64)
@@ -456,9 +460,8 @@ class Booster:
         if num_iteration is None:
             num_iteration = self.best_iteration \
                 if self.best_iteration > 0 else -1
-        src = self._gbdt if self._gbdt is not None else self._loaded
         from .predictor import predict as _predict
-        return _predict(src, data, num_iteration=num_iteration,
+        return _predict(self._src(), data, num_iteration=num_iteration,
                         raw_score=raw_score, pred_leaf=pred_leaf,
                         pred_contrib=pred_contrib)
 
@@ -467,12 +470,9 @@ class Booster:
                         start_iteration: int = 0) -> str:
         import json
         from .io.model_text import save_model_to_string
-        if self._gbdt is None:
-            raise LightGBMError("model_to_string requires a trained "
-                                "Booster")
         ni = num_iteration if num_iteration is not None else \
             (self.best_iteration if self.best_iteration > 0 else -1)
-        text = save_model_to_string(self._gbdt, start_iteration, ni)
+        text = save_model_to_string(self._src(), start_iteration, ni)
         # pandas-categorical round trip (reference basic.py appends the
         # category order as a trailing JSON line)
         return text + "\npandas_categorical:" \
@@ -491,13 +491,14 @@ class Booster:
         from .io.model_text import dump_model_json
         ni = num_iteration if num_iteration is not None else \
             (self.best_iteration if self.best_iteration > 0 else -1)
-        return json.loads(dump_model_json(self._gbdt, start_iteration, ni))
+        return json.loads(dump_model_json(self._src(), start_iteration,
+                                          ni))
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         from .io.model_text import feature_importance
         imp = feature_importance(
-            self._gbdt, importance_type,
+            self._src(), importance_type,
             iteration if iteration is not None else 0)
         return imp.astype(np.int64) if importance_type == "split" else imp
 

@@ -61,8 +61,9 @@ def _arr(vals, fmt=str) -> str:
 
 def _objective_to_string(gbdt) -> str:
     obj = getattr(gbdt, "objective", None)
-    if obj is None:
-        return ""
+    if obj is None or isinstance(obj, str):
+        # LoadedBooster: echo the original objective line verbatim
+        return getattr(gbdt, "objective_str", "")
     name = obj.name()
     parts = [name]
     if name in ("binary", "multiclassova", "cross_entropy",
@@ -174,14 +175,17 @@ def save_model_to_string(gbdt, start_iteration: int = 0,
     out.write(f"version={_MODEL_VERSION}\n")
     out.write(f"num_class={gbdt.num_class}\n")
     out.write(f"num_tree_per_iteration={k}\n")
-    out.write(f"label_index={getattr(gbdt.config, 'label_column_index', 0)}\n")
+    cfg = getattr(gbdt, "config", None)
+    label_index = getattr(cfg, "label_column_index",
+                          getattr(gbdt, "label_index", 0))
+    out.write(f"label_index={label_index}\n")
     if dataset is not None:
         max_fidx = dataset.num_total_features - 1
         names = dataset.feature_names
     else:
         max_fidx = int(getattr(gbdt, "max_feature_idx", 0))
-        names = getattr(gbdt, "feature_names",
-                        [f"Column_{i}" for i in range(max_fidx + 1)])
+        names = getattr(gbdt, "feature_names", None) \
+            or [f"Column_{i}" for i in range(max_fidx + 1)]
     out.write(f"max_feature_idx={max_fidx}\n")
     objective = _objective_to_string(gbdt)
     if objective:
@@ -189,16 +193,17 @@ def save_model_to_string(gbdt, start_iteration: int = 0,
     if getattr(gbdt, "average_output", False):
         out.write("average_output\n")
     out.write("feature_names=" + " ".join(names) + "\n")
-    mono = getattr(gbdt.config, "monotone_constraints", None)
+    mono = getattr(cfg, "monotone_constraints", None) \
+        or getattr(gbdt, "monotone_constraints", None)
     if mono:
         out.write("monotone_constraints=" + _arr(mono) + "\n")
     if dataset is not None:
         out.write("feature_infos=" + " ".join(_feature_infos(dataset))
                   + "\n")
     else:
-        out.write("feature_infos="
-                  + " ".join(getattr(gbdt, "feature_infos",
-                                     ["none"] * (max_fidx + 1))) + "\n")
+        infos = getattr(gbdt, "feature_infos", None) \
+            or ["none"] * (max_fidx + 1)
+        out.write("feature_infos=" + " ".join(infos) + "\n")
 
     total_iter = len(gbdt.models) // k
     start_iteration = min(max(start_iteration, 0), total_iter)
@@ -222,7 +227,9 @@ def save_model_to_string(gbdt, start_iteration: int = 0,
     for v, name in pairs:
         out.write(f"{name}={v}\n")
     out.write("\nparameters:\n")
-    for key, val in gbdt.config.to_params().items():
+    params = cfg.to_params() if cfg is not None \
+        else getattr(gbdt, "parameters", {})
+    for key, val in params.items():
         out.write(f"[{key}: {val}]\n")
     out.write("end of parameters\n")
     return out.getvalue()
@@ -503,8 +510,10 @@ def dump_model_json(gbdt, start_iteration: int = 0,
     dataset = getattr(gbdt.learner, "dataset", None) \
         if getattr(gbdt, "learner", None) is not None else None
     k = gbdt.num_tree_per_iteration
-    names = dataset.feature_names if dataset is not None else \
-        getattr(gbdt, "feature_names", [])
+    names = dataset.feature_names if dataset is not None else (
+        getattr(gbdt, "feature_names", None)
+        or [f"Column_{i}"
+            for i in range(int(getattr(gbdt, "max_feature_idx", 0)) + 1)])
     n_used = len(gbdt.models)
     if num_iteration > 0:
         n_used = min((start_iteration + num_iteration) * k, n_used)
@@ -525,9 +534,12 @@ def dump_model_json(gbdt, start_iteration: int = 0,
         "version": _MODEL_VERSION,
         "num_class": gbdt.num_class,
         "num_tree_per_iteration": k,
-        "label_index": getattr(gbdt.config, "label_column_index", 0),
+        "label_index": getattr(getattr(gbdt, "config", None),
+                               "label_column_index",
+                               getattr(gbdt, "label_index", 0)),
         "max_feature_idx": (dataset.num_total_features - 1)
-        if dataset is not None else 0,
+        if dataset is not None
+        else int(getattr(gbdt, "max_feature_idx", 0)),
         "objective": _objective_to_string(gbdt),
         "average_output": bool(getattr(gbdt, "average_output", False)),
         "feature_names": list(names),

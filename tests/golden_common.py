@@ -1,0 +1,85 @@
+"""Deterministic synthetic datasets shared by the golden-parity fixture
+generator (tools/make_golden_fixtures.py) and the parity tests
+(tests/test_golden_parity.py).
+
+The fixtures under tests/fixtures/golden/ are OUTPUTS of the reference
+LightGBM CLI (v2.3.2, built from /root/reference) run on these exact
+arrays; the tests regenerate the arrays (RandomState streams are
+stable across NumPy versions) and compare our loader's predictions
+against the reference's recorded predictions.
+"""
+
+import numpy as np
+
+FIXDIR_NAME = "fixtures/golden"
+
+
+def binary_data():
+    rng = np.random.RandomState(20260730)
+    n, f = 800, 10
+    X = rng.randn(n, f)
+    # feature 3 has missing values (NaN), feature 7 is sparse-ish zeros
+    X[rng.rand(n) < 0.15, 3] = np.nan
+    X[rng.rand(n) < 0.6, 7] = 0.0
+    logit = (1.5 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 4]
+             + np.where(np.isnan(X[:, 3]), 0.3, X[:, 3]))
+    y = (logit + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    ntr = 600
+    return X[:ntr], y[:ntr], X[ntr:], y[ntr:]
+
+
+def multiclass_data():
+    rng = np.random.RandomState(4242)
+    n, f, c = 900, 8, 3
+    X = rng.randn(n, f)
+    score = np.stack([1.2 * X[:, 0] + X[:, 1],
+                      -X[:, 0] + 0.8 * X[:, 2],
+                      X[:, 3] - 0.5 * X[:, 1]], axis=1)
+    y = np.argmax(score + 0.7 * rng.randn(n, c), axis=1).astype(np.float64)
+    ntr = 700
+    return X[:ntr], y[:ntr], X[ntr:], y[ntr:]
+
+
+def categorical_data():
+    rng = np.random.RandomState(777)
+    n, f = 1000, 6
+    X = rng.randn(n, f)
+    # feature 0: categorical with 8 levels, feature 1: categorical 25
+    X[:, 0] = rng.randint(0, 8, n)
+    X[:, 1] = rng.randint(0, 25, n)
+    effect = np.asarray([2.0, -1.0, 0.5, 0.0, -2.0, 1.0, 3.0, -0.5])
+    target = (effect[X[:, 0].astype(int)] + 0.8 * X[:, 2]
+              - X[:, 3] + 0.1 * X[:, 1] + 0.3 * rng.randn(n))
+    ntr = 750
+    return X[:ntr], target[:ntr], X[ntr:], target[ntr:]
+
+
+DATASETS = {
+    "binary": dict(
+        make=binary_data,
+        train_params=["objective=binary", "num_trees=25", "num_leaves=31",
+                      "learning_rate=0.1", "min_data_in_leaf=20",
+                      "verbosity=-1"],
+    ),
+    "multiclass": dict(
+        make=multiclass_data,
+        train_params=["objective=multiclass", "num_class=3",
+                      "num_trees=15", "num_leaves=15",
+                      "learning_rate=0.12", "min_data_in_leaf=20",
+                      "verbosity=-1"],
+    ),
+    "categorical": dict(
+        make=categorical_data,
+        train_params=["objective=regression", "num_trees=20",
+                      "num_leaves=31", "learning_rate=0.1",
+                      "min_data_in_leaf=20",
+                      "categorical_feature=0,1", "verbosity=-1"],
+    ),
+}
+
+
+def write_tsv(path, X, y):
+    """Label-first TSV the reference CLI parses natively; NaN as 'nan'
+    (parser.cpp AtofPrecise accepts it)."""
+    data = np.concatenate([np.asarray(y, np.float64)[:, None], X], axis=1)
+    np.savetxt(path, data, delimiter="\t", fmt="%.17g")

@@ -1,0 +1,61 @@
+"""Produce golden-parity fixtures with the reference LightGBM CLI.
+
+Usage:  python tools/make_golden_fixtures.py /path/to/lightgbm-binary
+
+For every dataset in tests/golden_common.DATASETS this trains the
+reference CLI on deterministic synthetic data and records
+  tests/fixtures/golden/model_<name>.txt      (reference model file)
+  tests/fixtures/golden/pred_<name>.txt       (reference predictions
+                                               on the held-out rows)
+The data itself is NOT stored — tests regenerate it bit-identically
+from the seeded RandomState streams in golden_common.
+
+The committed fixtures are reference OUTPUTS (the compatibility
+contract), not reference code.
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+import golden_common  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "fixtures", "golden")
+
+
+def run(binary, args, cwd):
+    r = subprocess.run([binary] + args, cwd=cwd, capture_output=True,
+                       text=True, timeout=600)
+    if r.returncode != 0:
+        raise RuntimeError(f"{args}: rc={r.returncode}\n{r.stdout}\n"
+                           f"{r.stderr}")
+    return r.stdout
+
+
+def main():
+    binary = sys.argv[1]
+    os.makedirs(FIXDIR, exist_ok=True)
+    scratch = "/tmp/golden_scratch"
+    os.makedirs(scratch, exist_ok=True)
+    for name, spec in golden_common.DATASETS.items():
+        Xtr, ytr, Xte, yte = spec["make"]()
+        train = os.path.join(scratch, f"{name}.train")
+        test = os.path.join(scratch, f"{name}.test")
+        golden_common.write_tsv(train, Xtr, ytr)
+        golden_common.write_tsv(test, Xte, yte)
+        model = os.path.join(FIXDIR, f"model_{name}.txt")
+        pred = os.path.join(FIXDIR, f"pred_{name}.txt")
+        run(binary, ["task=train", f"data={train}",
+                     f"output_model={model}"] + spec["train_params"],
+            cwd=scratch)
+        run(binary, ["task=predict", f"data={test}",
+                     f"input_model={model}", f"output_result={pred}"],
+            cwd=scratch)
+        print(f"{name}: model={os.path.getsize(model)}B "
+              f"pred={os.path.getsize(pred)}B")
+
+
+if __name__ == "__main__":
+    main()
