@@ -39,7 +39,8 @@ from ..ops.hist_pallas import (build_matrix, combine_planes,
                                pack_gh)
 from ..ops.partition_pallas import bitset_to_lut, partition_segment
 from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
-from .serial import (GrowResult, feature_meta_from_dataset,
+from .serial import (GrowResult, bynode_feature_count,
+                     feature_meta_from_dataset, make_node_rand,
                      split_params_from_config)
 
 HIST_BLK = 2048
@@ -54,6 +55,14 @@ class PartitionedTreeLearner:
         from ..data.binning import BIN_TYPE_CATEGORICAL
         self.dataset = dataset
         self.config = config
+        self.extra_trees = bool(config.extra_trees)
+        self.ff_bynode = float(config.feature_fraction_bynode)
+        self._extra_rng = np.random.RandomState(config.extra_seed)
+        self._bynode_rng = np.random.RandomState(
+            config.feature_fraction_seed)
+        self.bynode_count = bynode_feature_count(
+            dataset.num_features, float(config.feature_fraction),
+            self.ff_bynode)
         self.meta = feature_meta_from_dataset(dataset, config)
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
@@ -87,13 +96,21 @@ class PartitionedTreeLearner:
             bag_weight = jnp.ones_like(grad)
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), bool)
+        rand_key = None
+        if self.extra_trees or self.ff_bynode < 1.0:
+            rand_key = jnp.stack([
+                jax.random.PRNGKey(self._extra_rng.randint(0, 2**31 - 1)),
+                jax.random.PRNGKey(
+                    self._bynode_rng.randint(0, 2**31 - 1))])
         self.mat, self.ws, tree, leaf_id = _grow_partitioned(
             self.mat, self.ws, grad, hess, bag_weight, feature_mask,
-            self.meta, params=self.params, num_leaves=self.num_leaves,
+            self.meta, rand_key,
+            params=self.params, num_leaves=self.num_leaves,
             max_depth=self.max_depth, num_bins_max=self.num_bins_max,
             num_features=self.num_features, num_groups=self.num_groups,
             n=self.num_data, bundled=self.bundled,
-            interpret=self.interpret)
+            interpret=self.interpret, extra_trees=self.extra_trees,
+            ff_bynode=self.ff_bynode, bynode_count=self.bynode_count)
         return GrowResult(tree=tree, leaf_id=leaf_id)
 
     def to_host_tree(self, result: GrowResult,
@@ -107,11 +124,14 @@ class PartitionedTreeLearner:
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "num_features",
-                              "num_groups", "n", "bundled", "interpret"),
+                              "num_groups", "n", "bundled", "interpret",
+                              "extra_trees", "ff_bynode", "bynode_count"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
-                      *, params, num_leaves, max_depth, num_bins_max,
-                      num_features, num_groups, n, bundled, interpret):
+                      rand_key=None, *, params, num_leaves, max_depth,
+                      num_bins_max, num_features, num_groups, n, bundled,
+                      interpret, extra_trees=False, ff_bynode=1.0,
+                      bynode_count=2):
     f = num_groups          # physical matrix columns (EFB groups)
     b = num_bins_max
     big_l = num_leaves
@@ -133,15 +153,19 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         return combine_planes(raw, f)
 
     inf = jnp.float32(jnp.inf)
+    node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
+                               meta.num_bins, extra_trees, ff_bynode)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax):
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
             from ..ops.histogram import debundle_hist
             hist = debundle_hist(hist, meta.group, meta.offset,
                                  meta.num_bins, g, h, c)
+        rb, nm = node_rand(salt)
+        fm = feature_mask if nm is None else nm  # nm already in-subset
         res = best_split(hist, g, h, c, meta, params,
                          constraint_min=cmin, constraint_max=cmax,
-                         feature_mask=feature_mask)
+                         feature_mask=fm, rand_bins=rb)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
@@ -149,7 +173,7 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     sums = root_hist[0].sum(axis=0)
     root_g, root_h, root_c = sums[0], sums[1], sums[2]
     root_split = scan_leaf(root_hist, root_g, root_h, root_c,
-                           jnp.int32(0), -inf, inf)
+                           jnp.int32(0), -inf, inf, jnp.int32(0))
     root_out = leaf_output_no_constraint(
         root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
         params.max_delta_step)
@@ -304,8 +328,10 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         cmax_r = jnp.where(numerical & (mono < 0),
                            jnp.minimum(pcmax, mid), pcmax)
 
-        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l)
-        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r)
+        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
+                            2 * k + 1)
+        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
+                            2 * k + 2)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)

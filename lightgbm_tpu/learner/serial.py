@@ -96,6 +96,63 @@ class GrowResult(NamedTuple):
     leaf_id: object  # i32 [N]
 
 
+def bynode_feature_count(num_features: int, feature_fraction: float,
+                         ff_bynode: float) -> int:
+    """Features sampled per node, matching GetUsedFeatures
+    (serial_tree_learner.cpp:226-275): ``round(used * ff_bynode)`` where
+    ``used`` is the per-TREE subset size, floored at min(2, valid)."""
+    used = num_features if feature_fraction >= 1.0 \
+        else max(1, int(round(num_features * feature_fraction)))
+    min_used = min(2, used)
+    return max(min_used, int(round(used * ff_bynode)))
+
+
+def make_node_rand(rand_keys, feature_mask, bynode_count: int, num_bins,
+                   extra_trees: bool, ff_bynode: float):
+    """Per-node randomness for the grow loop, shared by the serial and
+    partitioned learners.
+
+    ``rand_keys`` is a stacked pair of PRNG keys — [0] drives the
+    extra-trees thresholds (seeded from Config.extra_seed), [1] the
+    by-node column sample (seeded from Config.feature_fraction_seed) —
+    two independent streams exactly like the reference's ``rand_`` in
+    FeatureHistogram vs ``random_`` in SerialTreeLearner.
+
+    Returns ``node_rand(salt) -> (rand_bins, node_mask)``:
+      * ``rand_bins`` [F] — extra-trees random candidate threshold per
+        feature, uniform on [0, num_bin-3] (feature_histogram.hpp:98-101
+        NextInt(0, num_bin-2) is half-open), or None;
+      * ``node_mask`` [F] bool — ``bynode_count`` features drawn from
+        WITHIN the per-tree ``feature_mask`` subset (already ANDed), or
+        None when by-node sampling is off.
+    ``salt`` must be a distinct traced int per scan call so every node
+    draws fresh randomness inside one compiled program.
+    """
+    use = (extra_trees or ff_bynode < 1.0) and rand_keys is not None
+    if not use:
+        return lambda salt: (None, None)
+    f = num_bins.shape[0]
+
+    def node_rand(salt):
+        rb = None
+        if extra_trees:
+            kk = jax.random.fold_in(rand_keys[0], salt)
+            u = jax.random.uniform(kk, (f,))
+            span = jnp.maximum(num_bins - 2, 1).astype(jnp.float32)
+            rb = jnp.floor(u * span).astype(jnp.int32)
+        nm = None
+        if ff_bynode < 1.0:
+            kk2 = jax.random.fold_in(rand_keys[1], salt)
+            u2 = jax.random.uniform(kk2, (f,))
+            u2 = jnp.where(feature_mask, u2, -1.0)  # only tree subset
+            kcnt = min(max(bynode_count, 1), f)
+            kth = jax.lax.top_k(u2, kcnt)[0][-1]
+            nm = (u2 >= kth) & feature_mask
+        return rb, nm
+
+    return node_rand
+
+
 class SerialTreeLearner:
     """Owns the device copy of the dataset and the compiled grow program."""
 
@@ -103,6 +160,14 @@ class SerialTreeLearner:
                  hist_method: str = "auto"):
         self.dataset = dataset
         self.config = config
+        self.extra_trees = bool(config.extra_trees)
+        self.ff_bynode = float(config.feature_fraction_bynode)
+        self._extra_rng = np.random.RandomState(config.extra_seed)
+        self._bynode_rng = np.random.RandomState(
+            config.feature_fraction_seed)
+        self.bynode_count = bynode_feature_count(
+            dataset.num_features, float(config.feature_fraction),
+            self.ff_bynode)
         self.meta = feature_meta_from_dataset(dataset, config)
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
@@ -128,12 +193,27 @@ class SerialTreeLearner:
         # module-level jit: learners with equal shapes/params share the
         # compiled executable (tests and per-class trainers hit the cache)
         return _grow_jit(self.binned, grad, hess, bag_weight, feature_mask,
-                         self.meta, params=self.params,
+                         self.meta, rand_key=self.next_tree_key(),
+                         params=self.params,
                          num_leaves=self.num_leaves,
                          max_depth=self.max_depth,
                          num_bins_max=self.num_bins_max,
                          hist_method=self.hist_method,
-                         bundled=self.bundled)
+                         bundled=self.bundled,
+                         extra_trees=self.extra_trees,
+                         ff_bynode=self.ff_bynode,
+                         bynode_count=self.bynode_count)
+
+    def next_tree_key(self):
+        """Fresh per-tree PRNG key pair for extra-trees (extra_seed
+        stream) and by-node feature sampling (feature_fraction_seed
+        stream); None when neither feature is on, keeping the no-RNG
+        compile."""
+        if not (self.extra_trees or self.ff_bynode < 1.0):
+            return None
+        return jnp.stack([
+            jax.random.PRNGKey(self._extra_rng.randint(0, 2**31 - 1)),
+            jax.random.PRNGKey(self._bynode_rng.randint(0, 2**31 - 1))])
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -145,21 +225,27 @@ class SerialTreeLearner:
 
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
-                              "num_bins_max", "hist_method", "bundled"))
-def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta, *,
-              params, num_leaves, max_depth, num_bins_max, hist_method,
-              bundled=False):
+                              "num_bins_max", "hist_method", "bundled",
+                              "extra_trees", "ff_bynode", "bynode_count"))
+def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
+              rand_key=None, *, params, num_leaves, max_depth,
+              num_bins_max, hist_method, bundled=False,
+              extra_trees=False, ff_bynode=1.0, bynode_count=2):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
-                     hist_method=hist_method, bundled=bundled)
+                     hist_method=hist_method, bundled=bundled,
+                     rand_key=rand_key, extra_trees=extra_trees,
+                     ff_bynode=ff_bynode, bynode_count=bynode_count)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               meta: FeatureMeta, params: SplitParams, num_leaves: int,
               max_depth: int, num_bins_max: int, hist_method: str,
               comm=None, binned_hist=None, meta_hist=None,
-              bundled: bool = False) -> GrowResult:
+              bundled: bool = False, rand_key=None,
+              extra_trees: bool = False, ff_bynode: float = 1.0,
+              bynode_count: int = 2) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -186,20 +272,27 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     root_g, root_h, root_c = root_sums[0], root_sums[1], root_sums[2]
 
     inf = jnp.float32(jnp.inf)
+    # the scan's feature axis is LOGICAL features (EFB hists debundle
+    # before select_split), so draws span meta_hist's length, not the
+    # physical group count
+    node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
+                               meta_hist.num_bins, extra_trees, ff_bynode)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax):
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
             # EFB: group histograms -> per-feature histograms
             from ..ops.histogram import debundle_hist
             hist = debundle_hist(hist, meta_hist.group, meta_hist.offset,
                                  meta_hist.num_bins, g, h, c)
+        rb, nm = node_rand(salt)
+        fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta_hist, params,
-                                cmin, cmax, feature_mask)
+                                cmin, cmax, fm, rand_bins=rb)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
     root_split = scan_leaf(root_hist, root_g, root_h, root_c,
-                           jnp.int32(0), -inf, inf)
+                           jnp.int32(0), -inf, inf, jnp.int32(0))
 
     def at0(arr, val):
         return arr.at[0].set(val)
@@ -338,8 +431,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                            jnp.minimum(pcmax, mid), pcmax)
 
         # ---- child best splits ---------------------------------------
-        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l)
-        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r)
+        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
+                            2 * k + 1)
+        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
+                            2 * k + 2)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)

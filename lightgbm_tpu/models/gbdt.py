@@ -448,7 +448,8 @@ class GBDT:
             cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
                                       or cfg.pos_bagging_fraction < 1.0
                                       or cfg.neg_bagging_fraction < 1.0)
-        ) or cfg.feature_fraction < 1.0
+        ) or cfg.feature_fraction < 1.0 or cfg.extra_trees \
+            or cfg.feature_fraction_bynode < 1.0
         flush_every = 1 if (has_eval or host_rng_per_iter) \
             else self._ASYNC_FLUSH
         pending: List = []

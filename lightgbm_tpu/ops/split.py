@@ -166,12 +166,17 @@ class PerFeatureSplits(NamedTuple):
 def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                           meta: FeatureMeta, params: SplitParams,
                           constraint_min=None, constraint_max=None,
-                          feature_mask: jnp.ndarray | None = None
+                          feature_mask: jnp.ndarray | None = None,
+                          rand_bins: jnp.ndarray | None = None
                           ) -> PerFeatureSplits:
     """Per-feature best numerical split of one leaf.
 
     hist: [F, B, 3] (sum_grad, sum_hess, count) per bin.
     parent_*: scalar totals of the leaf.
+    rand_bins: extra-trees mode (Config.extra_trees; the reference's
+    IS_RAND template paths, feature_histogram.hpp:555-709 rand_threshold_):
+    [F] i32 of one uniformly-drawn candidate threshold per feature —
+    both scan directions consider ONLY that bin.
     """
     f, b, _ = hist.shape
     p = params
@@ -213,6 +218,8 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     gr_p = parent_g - lg_p
     cr_p = parent_c - lc_p
     valid_p = two_scan & (bins <= nb - 2) & ~skip_default
+    if rand_bins is not None:
+        valid_p &= bins == rand_bins[:, None]
     valid_p &= (lc_p >= p.min_data_in_leaf) & (cr_p >= p.min_data_in_leaf)
     valid_p &= (hl_p >= p.min_sum_hessian_in_leaf) \
         & (hr_p >= p.min_sum_hessian_in_leaf)
@@ -235,6 +242,8 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     gl_m = parent_g - rg_m
     cl_m = parent_c - rc_m
     valid_m = bins <= nb - 2 - na_excl.astype(jnp.int32)
+    if rand_bins is not None:
+        valid_m &= bins == rand_bins[:, None]
     # zero-missing skips threshold default_bin-1 (the `continue` skips the
     # iteration that would have recorded it, feature_histogram.hpp:577)
     valid_m &= ~(two_scan & (missing == MISSING_ZERO_CODE)
@@ -292,12 +301,17 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
 def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                        meta: FeatureMeta, params: SplitParams,
                        constraint_min=None, constraint_max=None,
-                       feature_mask: jnp.ndarray | None = None
+                       feature_mask: jnp.ndarray | None = None,
+                       rand_bins: jnp.ndarray | None = None
                        ) -> PerFeatureSplits:
     """Numerical + categorical per-feature scan, merged per feature.
 
     The categorical scan compiles only when ``params.has_categorical``
     (a static flag) — pure-numerical datasets pay nothing.
+    ``rand_bins`` (extra-trees) restricts NUMERICAL features to one
+    random threshold each; categorical features keep the full scan
+    (documented divergence: the reference also randomizes categorical
+    candidates in IS_RAND mode).
     """
     if constraint_min is None:
         constraint_min = jnp.float32(-jnp.inf)
@@ -305,7 +319,7 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         constraint_max = jnp.float32(jnp.inf)
     pf = per_feature_numerical(hist, parent_g, parent_h, parent_c, meta,
                                params, constraint_min, constraint_max,
-                               feature_mask)
+                               feature_mask, rand_bins)
     if not params.has_categorical:
         return pf
     from .split_categorical import per_feature_categorical
@@ -373,7 +387,8 @@ def best_split_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
 def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                meta: FeatureMeta, params: SplitParams,
                constraint_min=None, constraint_max=None,
-               feature_mask: jnp.ndarray | None = None) -> SplitResult:
+               feature_mask: jnp.ndarray | None = None,
+               rand_bins: jnp.ndarray | None = None) -> SplitResult:
     """Best split (numerical + categorical) over all features of one
     leaf — the full FindBestThreshold dispatch
     (feature_histogram.hpp:84-148)."""
@@ -383,6 +398,6 @@ def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         constraint_max = jnp.float32(jnp.inf)
     pf = per_feature_splits(hist, parent_g, parent_h, parent_c, meta,
                             params, constraint_min, constraint_max,
-                            feature_mask)
+                            feature_mask, rand_bins)
     best_f = _argmax_first(pf.score).astype(jnp.int32)
     return assemble_split(pf, best_f)

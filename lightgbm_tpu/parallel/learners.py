@@ -113,8 +113,11 @@ class _MeshLearnerBase(SerialTreeLearner):
             grad = jnp.pad(grad, (0, pad))
             hess = jnp.pad(hess, (0, pad))
             bag_weight = jnp.pad(bag_weight, (0, pad))  # zero => no effect
+        rkey = self.next_tree_key()
+        if rkey is None:  # shard_map needs a concrete array either way
+            rkey = jnp.zeros((2, 2), jnp.uint32)  # shape of a key pair
         res = self._fn(grad, hess, bag_weight,
-                       self._pad_feature_mask(feature_mask))
+                       self._pad_feature_mask(feature_mask), rkey)
         if pad:
             res = GrowResult(tree=res.tree, leaf_id=res.leaf_id[:n])
         return res
@@ -140,17 +143,22 @@ class DataParallelTreeLearner(_MeshLearnerBase):
         comm = make_data_parallel_comm(AXIS)
         meta = self.meta
 
-        def body(binned_l, grad, hess, bag, fmask):
+        def body(binned_l, grad, hess, bag, fmask, rkey):
+            # key replicated: every shard draws identical node randomness
+            # (the feature axis is global here), like the reference's
+            # identically-seeded per-machine samplers
             return grow_tree(
                 binned_l, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
                 hist_method=self.hist_method, comm=comm,
-                bundled=self.bundled)
+                bundled=self.bundled, rand_key=rkey,
+                extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
+                bynode_count=self.bynode_count)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P()),
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
         sharded = jax.jit(mapped)
@@ -192,17 +200,28 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
             meta_h = meta
         comm = make_feature_parallel_comm(AXIS, self._f_local)
 
-        def body(binned_g, binned_h, meta_hist, grad, hess, bag, fmask):
+        # the scan axis is the LOCAL feature shard: each shard draws its
+        # own stream (fold in the shard index) over its own slice of the
+        # by-node budget
+        bynode_local = max(1, round(self.bynode_count / d))
+
+        def body(binned_g, binned_h, meta_hist, grad, hess, bag, fmask,
+                 rkey):
+            rkey = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                rkey, jax.lax.axis_index(AXIS))
             return grow_tree(
                 binned_g, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
                 hist_method=self.hist_method, comm=comm,
-                binned_hist=binned_h, meta_hist=meta_hist)
+                binned_hist=binned_h, meta_hist=meta_hist, rand_key=rkey,
+                extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
+                bynode_count=bynode_local)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(), P(None, AXIS), P(AXIS), P(), P(), P(), P(AXIS)),
+            in_specs=(P(), P(None, AXIS), P(AXIS), P(), P(), P(), P(AXIS),
+                      P()),
             out_specs=GrowResult(tree=P(), leaf_id=P()),
             check_rep=False)
         sharded = jax.jit(mapped)
@@ -249,17 +268,19 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
             AXIS, d, int(self.config.top_k), params_local)
         meta = self.meta
 
-        def body(binned_l, grad, hess, bag, fmask):
+        def body(binned_l, grad, hess, bag, fmask, rkey):
             return grow_tree(
                 binned_l, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
                 hist_method=self.hist_method, comm=comm,
-                bundled=self.bundled)
+                bundled=self.bundled, rand_key=rkey,
+                extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
+                bynode_count=self.bynode_count)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P()),
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
         sharded = jax.jit(mapped)

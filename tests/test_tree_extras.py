@@ -1,0 +1,107 @@
+"""extra_trees / feature_fraction_bynode training behavior."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data import Dataset
+from lightgbm_tpu.models.gbdt import GBDT
+
+
+def _data(n=800, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.6 * X[:, 1] - 0.4 * X[:, 2]
+         + 0.3 * rng.randn(n)).astype(np.float64)
+    return X, y
+
+
+def _train(params, X, y, iters=15):
+    cfg = Config.from_params(dict(params))
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    b = GBDT(cfg, ds)
+    b.train(iters)
+    return b
+
+
+BASE = {"objective": "regression", "num_leaves": 15, "metric": "",
+        "min_data_in_leaf": 20, "verbosity": -1}
+
+
+def _mse(b, X, y):
+    return float(np.mean((b.predict(X) - y) ** 2))
+
+
+def test_extra_trees_learns_but_differs_from_exact():
+    X, y = _data()
+    exact = _train(BASE, X, y)
+    xt = _train({**BASE, "extra_trees": True}, X, y)
+    # still learns the signal
+    assert _mse(xt, X, y) < 0.5 * float(np.var(y))
+    # but the trees differ from the exhaustive scan
+    t0, t1 = exact.models[0], xt.models[0]
+    same = (t0.num_leaves == t1.num_leaves
+            and np.array_equal(t0.threshold_bin, t1.threshold_bin))
+    assert not same
+
+
+def test_extra_trees_seed_reproducible():
+    X, y = _data()
+    a = _train({**BASE, "extra_trees": True, "extra_seed": 7}, X, y)
+    b = _train({**BASE, "extra_trees": True, "extra_seed": 7}, X, y)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    c = _train({**BASE, "extra_trees": True, "extra_seed": 8}, X, y)
+    assert not np.array_equal(a.predict(X), c.predict(X))
+
+
+def test_feature_fraction_bynode_restricts_per_node():
+    X, y = _data(f=10)
+    b = _train({**BASE, "feature_fraction_bynode": 0.3}, X, y)
+    # across a whole tree many features can appear (different nodes
+    # sample different subsets) but training must still work
+    assert _mse(b, X, y) < 0.6 * float(np.var(y))
+    # with fraction 1.0 identical to the default path
+    full = _train({**BASE, "feature_fraction_bynode": 1.0}, X, y)
+    exact = _train(BASE, X, y)
+    np.testing.assert_allclose(full.predict(X), exact.predict(X))
+
+
+def test_bynode_samples_within_tree_subset():
+    # feature_fraction=0.2 and feature_fraction_bynode=0.2 together:
+    # by-node must draw from the TREE's subset (min 2 features,
+    # GetUsedFeatures serial_tree_learner.cpp:226-275), so trees still
+    # split instead of hitting empty feature intersections
+    X, y = _data(f=10)
+    b = _train({**BASE, "feature_fraction": 0.2,
+                "feature_fraction_bynode": 0.2}, X, y)
+    depths = [t.num_leaves for t in b.models]
+    assert max(depths) > 4  # real trees, not stubs
+    assert _mse(b, X, y) < float(np.var(y))
+
+
+def test_bynode_seed_independent_of_extra_seed():
+    X, y = _data()
+    base = {**BASE, "feature_fraction_bynode": 0.4,
+            "feature_fraction_seed": 5}
+    a = _train(base, X, y)
+    b = _train({**base, "extra_seed": 99}, X, y)
+    # extra_seed must not perturb the by-node stream
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    c = _train({**base, "feature_fraction_seed": 6}, X, y)
+    assert not np.array_equal(a.predict(X), c.predict(X))
+
+
+def test_extra_trees_with_bynode_and_bagging_smoke():
+    X, y = _data()
+    b = _train({**BASE, "extra_trees": True,
+                "feature_fraction_bynode": 0.5,
+                "bagging_fraction": 0.8, "bagging_freq": 1}, X, y)
+    assert np.isfinite(b.predict(X)).all()
+
+
+@pytest.mark.parametrize("learner", ["data", "voting"])
+def test_extra_trees_parallel_smoke(learner):
+    X, y = _data(n=400)
+    b = _train({**BASE, "extra_trees": True, "tree_learner": learner,
+                "num_leaves": 7}, X, y, iters=5)
+    assert _mse(b, X, y) < 0.8 * float(np.var(y))
