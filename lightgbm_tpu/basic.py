@@ -466,6 +466,33 @@ class Booster:
                         pred_contrib=pred_contrib)
 
     # ------------------------------------------------------------------
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing model on new data (reference
+        basic.py:2614-2659): keep tree structures, refit leaf values by
+        sequential replay with
+        ``leaf = decay_rate*old + (1-decay_rate)*new``."""
+        import copy
+        src = self._src()
+        obj = getattr(src, "objective", None)
+        if obj is None:
+            raise LightGBMError(
+                "Cannot refit due to null objective function.")
+        # all trees, even past best_iteration (reference passes -1)
+        kwargs.setdefault("num_iteration", -1)
+        leaf_preds = self.predict(data, pred_leaf=True, **kwargs)
+        new_params = dict(self.params)
+        new_params["refit_decay_rate"] = decay_rate
+        train_set = Dataset(data, label=label)
+        new_booster = Booster(new_params, train_set)
+        getattr(src, "finalize_trees", lambda: None)()
+        new_booster._gbdt.models = [copy.deepcopy(t) for t in src.models]
+        new_booster._gbdt.iter = len(src.models) \
+            // src.num_tree_per_iteration
+        new_booster._gbdt.refit(leaf_preds)
+        return new_booster
+
+    # ------------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> str:
         import json

@@ -180,6 +180,21 @@ def _parse_list(value: Any, typ) -> list:
     return [typ(value)]
 
 
+_UNIMPLEMENTED_PARAMS = {
+    "cegb_tradeoff": "cost-effective gradient boosting",
+    "cegb_penalty_split": "cost-effective gradient boosting",
+    "cegb_penalty_feature_lazy": "cost-effective gradient boosting",
+    "cegb_penalty_feature_coupled": "cost-effective gradient boosting",
+    "pred_early_stop": "prediction early stopping (documented skip: "
+                       "batched device prediction has no row loop)",
+    "pred_early_stop_freq": "prediction early stopping",
+    "pred_early_stop_margin": "prediction early stopping",
+    "convert_model": "model-to-C conversion",
+    "convert_model_language": "model-to-C conversion",
+    "forcedbins_filename": "forced bin bounds file",
+}
+
+
 @dataclass
 class Config:
     """All parameters, LightGBM-compatible names (config.h:32-1081)."""
@@ -334,6 +349,13 @@ class Config:
         self.objective = _OBJECTIVE_ALIASES.get(self.objective, self.objective)
 
     # --- analog of Config::Set (src/io/config.cpp:177-245)
+    # params that are accepted but NOT implemented yet: setting a
+    # non-default value warns loudly instead of silently ignoring.
+    # Structurally-meaningless-on-TPU params (num_threads,
+    # force_col_wise/row_wise, histogram_pool_size, is_enable_sparse,
+    # pre_partition, two_round, gpu_*) are accepted silently for config
+    # compatibility — XLA owns threading/layout/memory.
+
     @classmethod
     def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
         params = dict(params or {})
@@ -351,8 +373,24 @@ class Config:
             f = known[key]
             kwargs[key] = _coerce(value, f)
         cfg = cls(**kwargs)
+        cfg._warn_unimplemented(kwargs)
         cfg.check_param_conflict()
         return cfg
+
+    def _warn_unimplemented(self, explicit: Dict[str, Any]) -> None:
+        defaults = {
+            f.name: (f.default if f.default is not dataclasses.MISSING
+                     else f.default_factory()
+                     if f.default_factory is not dataclasses.MISSING
+                     else None)
+            for f in dataclasses.fields(self)}
+        for key in explicit:
+            if key in _UNIMPLEMENTED_PARAMS \
+                    and getattr(self, key) != defaults.get(key):
+                log_warning(
+                    f"Parameter {key} ({_UNIMPLEMENTED_PARAMS[key]}) is "
+                    "accepted but NOT implemented in lightgbm_tpu; it "
+                    "has no effect")
 
     # --- analog of Config::CheckParamConflict (src/io/config.cpp:261-327)
     def check_param_conflict(self) -> None:

@@ -39,7 +39,7 @@ from ..ops.hist_pallas import (build_matrix, combine_planes,
                                pack_gh)
 from ..ops.partition_pallas import bitset_to_lut, partition_segment
 from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
-from .serial import (GrowResult, bynode_feature_count,
+from .serial import (GrowResult, NodeRandMixin,
                      feature_meta_from_dataset, make_node_rand,
                      split_params_from_config)
 
@@ -47,7 +47,7 @@ HIST_BLK = 2048
 PART_BLK = 512
 
 
-class PartitionedTreeLearner:
+class PartitionedTreeLearner(NodeRandMixin):
     """Drop-in for SerialTreeLearner backed by the segment kernels."""
 
     def __init__(self, dataset: Dataset, config: Config,
@@ -55,14 +55,7 @@ class PartitionedTreeLearner:
         from ..data.binning import BIN_TYPE_CATEGORICAL
         self.dataset = dataset
         self.config = config
-        self.extra_trees = bool(config.extra_trees)
-        self.ff_bynode = float(config.feature_fraction_bynode)
-        self._extra_rng = np.random.RandomState(config.extra_seed)
-        self._bynode_rng = np.random.RandomState(
-            config.feature_fraction_seed)
-        self.bynode_count = bynode_feature_count(
-            dataset.num_features, float(config.feature_fraction),
-            self.ff_bynode)
+        self._init_node_rand(dataset, config)
         self.meta = feature_meta_from_dataset(dataset, config)
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
@@ -96,12 +89,7 @@ class PartitionedTreeLearner:
             bag_weight = jnp.ones_like(grad)
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), bool)
-        rand_key = None
-        if self.extra_trees or self.ff_bynode < 1.0:
-            rand_key = jnp.stack([
-                jax.random.PRNGKey(self._extra_rng.randint(0, 2**31 - 1)),
-                jax.random.PRNGKey(
-                    self._bynode_rng.randint(0, 2**31 - 1))])
+        rand_key = self.next_tree_key()
         self.mat, self.ws, tree, leaf_id = _grow_partitioned(
             self.mat, self.ws, grad, hess, bag_weight, feature_mask,
             self.meta, rand_key,
@@ -110,7 +98,8 @@ class PartitionedTreeLearner:
             num_features=self.num_features, num_groups=self.num_groups,
             n=self.num_data, bundled=self.bundled,
             interpret=self.interpret, extra_trees=self.extra_trees,
-            ff_bynode=self.ff_bynode, bynode_count=self.bynode_count)
+            ff_bynode=self.ff_bynode, bynode_count=self.bynode_count,
+            forced_plan=self.forced_plan)
         return GrowResult(tree=tree, leaf_id=leaf_id)
 
     def to_host_tree(self, result: GrowResult,
@@ -125,13 +114,14 @@ class PartitionedTreeLearner:
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "num_features",
                               "num_groups", "n", "bundled", "interpret",
-                              "extra_trees", "ff_bynode", "bynode_count"),
+                              "extra_trees", "ff_bynode", "bynode_count",
+                              "forced_plan"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       rand_key=None, *, params, num_leaves, max_depth,
                       num_bins_max, num_features, num_groups, n, bundled,
                       interpret, extra_trees=False, ff_bynode=1.0,
-                      bynode_count=2):
+                      bynode_count=2, forced_plan=()):
     f = num_groups          # physical matrix columns (EFB groups)
     b = num_bins_max
     big_l = num_leaves
@@ -231,24 +221,81 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
         return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
 
-    def body(st):
+    kEps = 1e-15
+
+    def forced_quantities(st, forced):
+        """Left sums of a STATIC forced split read off the leaf's cached
+        histogram (GatherInfoForThreshold analog); missing bins routed
+        like the partition kernel routes the rows."""
+        from ..ops.split import MISSING_NAN_CODE, MISSING_ZERO_CODE
+        fleaf, ffeat, fthr, fdleft, fmiss, fdbin, fnbin = forced
+        hist_leaf = st["hist"][fleaf]
+        if bundled:
+            from ..ops.histogram import debundle_hist
+            pg0, ph0, pc0 = (st["leaf_g"][fleaf], st["leaf_h"][fleaf],
+                             st["leaf_c"][fleaf])
+            hist_leaf = debundle_hist(hist_leaf, meta.group, meta.offset,
+                                      meta.num_bins, pg0, ph0, pc0)
+        cum = hist_leaf[ffeat, :fthr + 1].sum(axis=0)
+        if fmiss == MISSING_NAN_CODE and fdleft and fnbin - 1 > fthr:
+            cum = cum + hist_leaf[ffeat, fnbin - 1]  # NaN rows go left
+        if fmiss == MISSING_ZERO_CODE and not fdleft and fdbin <= fthr:
+            cum = cum - hist_leaf[ffeat, fdbin]  # default bin goes right
+        return cum[0], cum[1], cum[2]
+
+    def body(st, forced=None):
+        from ..ops.split import (gain_given_output, leaf_output,
+                                 leaf_split_gain)
         k = st["k"]
-        open_gain = jnp.where(leaf_range < k, st["bs_gain"], -jnp.inf)
-        leaf = jnp.argmax(open_gain).astype(jnp.int32)
         new = k
         s = k - 1
 
-        feat = st["bs_feat"][leaf]
-        thr = st["bs_thr"][leaf]
-        dleft = st["bs_dleft"][leaf]
-        gain = st["bs_gain"][leaf]
-        is_cat = st["bs_iscat"][leaf]
-        bitset = st["bs_bitset"][leaf]
-        lg, lh, lc = st["bs_lg"][leaf], st["bs_lh"][leaf], st["bs_lc"][leaf]
-        pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
-            st["leaf_c"][leaf]
-        rg, rh, rc = pg - lg, ph - lh, pc - lc
-        lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+        if forced is None:
+            open_gain = jnp.where(leaf_range < k, st["bs_gain"],
+                                  -jnp.inf)
+            leaf = jnp.argmax(open_gain).astype(jnp.int32)
+            feat = st["bs_feat"][leaf]
+            thr = st["bs_thr"][leaf]
+            dleft = st["bs_dleft"][leaf]
+            gain = st["bs_gain"][leaf]
+            is_cat = st["bs_iscat"][leaf]
+            bitset = st["bs_bitset"][leaf]
+            lg, lh, lc = (st["bs_lg"][leaf], st["bs_lh"][leaf],
+                          st["bs_lc"][leaf])
+            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
+                st["leaf_c"][leaf]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+        else:
+            fleaf, ffeat, fthr, fdleft = forced[:4]
+            leaf = jnp.int32(fleaf)
+            feat = jnp.int32(ffeat)
+            thr = jnp.int32(fthr)
+            dleft = jnp.bool_(fdleft)
+            is_cat = jnp.bool_(False)
+            bitset = jnp.zeros((MAX_CAT_WORDS,), jnp.uint32)
+            lg, lh, lc = forced_quantities(st, forced)
+            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
+                st["leaf_c"][leaf]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            cmin0 = st["leaf_cmin"][leaf]
+            cmax0 = st["leaf_cmax"][leaf]
+            lh_e = lh + kEps
+            rh_e = ph + 2 * kEps - lh_e
+            lout = leaf_output(lg, lh_e, params.lambda_l1,
+                               params.lambda_l2, params.max_delta_step,
+                               cmin0, cmax0)
+            rout = leaf_output(rg, rh_e, params.lambda_l1,
+                               params.lambda_l2, params.max_delta_step,
+                               cmin0, cmax0)
+            shift = leaf_split_gain(pg, ph + 2 * kEps, params.lambda_l1,
+                                    params.lambda_l2,
+                                    params.max_delta_step)
+            gain = (gain_given_output(lg, lh_e, lout, params.lambda_l1,
+                                      params.lambda_l2)
+                    + gain_given_output(rg, rh_e, rout, params.lambda_l1,
+                                        params.lambda_l2)
+                    - shift - params.min_gain_to_split)
 
         begin = st["leaf_begin"][leaf]
         cnt = st["leaf_cnt"][leaf]
@@ -384,7 +431,21 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         )
         return st2
 
-    st = jax.lax.while_loop(cond, body, state)
+    # forced splits: unrolled static pre-pass (ForceSplits analog);
+    # an invalid forced split aborts the rest of the plan
+    st = state
+    force_ok = jnp.bool_(True)
+    for step in forced_plan:
+        lg_f, lh_f, _ = forced_quantities(st, step)
+        ph_f = st["leaf_h"][step[0]]
+        force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
+            & (st["k"] < big_l)
+        st = jax.lax.cond(
+            force_ok,
+            functools.partial(body, forced=step),
+            lambda s: s, st)
+
+    st = jax.lax.while_loop(cond, body, st)
 
     tree = TreeArrays(
         num_leaves=st["k"],

@@ -44,6 +44,8 @@ _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
                  MISSING_ZERO: MISSING_ZERO_CODE,
                  MISSING_NAN: MISSING_NAN_CODE}
 
+kEps = 1e-15
+
 
 def feature_meta_from_dataset(dataset: Dataset,
                               config: Config) -> FeatureMeta:
@@ -74,6 +76,77 @@ def feature_meta_from_dataset(dataset: Dataset,
         is_categorical=jnp.asarray(is_cat),
         group=jnp.asarray(np.asarray(group, np.int32)),
         offset=jnp.asarray(np.asarray(offset, np.int32)))
+
+
+def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
+    """Parse forcedsplits_filename into a STATIC unrollable plan.
+
+    Reference analog: ``SerialTreeLearner::ForceSplits``
+    (serial_tree_learner.cpp:465-634). The reference walks the JSON in
+    BFS order at runtime; since leaf ids are assigned deterministically
+    (the i-th split creates leaf i+1), the whole traversal is resolved
+    here at trace time: each entry is
+    ``(leaf, feature_inner, threshold_bin, default_left, missing_code,
+    default_bin, num_bin)`` — all static ints — with ``threshold_bin``
+    chosen so that ``bin <= threshold_bin`` goes left exactly when
+    ``bin < ValueToBin(threshold)``, matching
+    GatherInfoForThresholdNumerical's right-accumulates-``>=`` loop.
+    NaN-missing features send missing left there (the NaN bin is
+    excluded from the right sweep), hence default_left; the missing
+    metadata lets forced_quantities route the NaN / zero-default bins
+    the same way the partition does. A threshold below all data
+    (ValueToBin == 0: empty left side) aborts the rest of the plan like
+    the reference's empty-gather abort.
+    """
+    fn = config.forcedsplits_filename
+    if not fn:
+        return ()
+    import json as _json
+    from collections import deque
+
+    from ..data.binning import BIN_TYPE_CATEGORICAL, MISSING_NAN
+    from ..utils.log import log_warning
+    with open(fn) as f:
+        root = _json.load(f)
+    num_leaves = int(config.num_leaves)
+    plan = []
+    q = deque([(root, 0)])
+    k = 1
+    while q and k < num_leaves:
+        node, leaf = q.popleft()
+        if not node:
+            continue
+        feat_real = int(node["feature"])
+        thr = float(node["threshold"])
+        inner = dataset.inner_feature_index(feat_real)
+        if inner is None or inner < 0:
+            log_warning(f"forced split on unused feature {feat_real} "
+                        "ignored; aborting remaining forced splits")
+            break
+        mapper = dataset.feature_mapper(inner)
+        if mapper.bin_type == BIN_TYPE_CATEGORICAL:
+            log_warning("forced splits on categorical features are not "
+                        "supported; aborting remaining forced splits")
+            break
+        tbin = int(np.asarray(
+            mapper.values_to_bins(np.asarray([thr], np.float64)))[0])
+        if tbin == 0:
+            log_warning(
+                f"forced split threshold {thr} on feature {feat_real} "
+                "is below all data (empty left side); aborting "
+                "remaining forced splits")
+            break
+        tbin -= 1  # left = bin < ValueToBin(threshold)
+        plan.append((leaf, int(inner), tbin,
+                     mapper.missing_type == MISSING_NAN,
+                     _MISSING_CODE[mapper.missing_type],
+                     int(mapper.default_bin), int(mapper.num_bin)))
+        if node.get("left"):
+            q.append((node["left"], leaf))
+        if node.get("right"):
+            q.append((node["right"], k))
+        k += 1
+    return tuple(plan)
 
 
 def split_params_from_config(config: Config) -> SplitParams:
@@ -107,8 +180,37 @@ def bynode_feature_count(num_features: int, feature_fraction: float,
     return max(min_used, int(round(used * ff_bynode)))
 
 
-def make_node_rand(rand_keys, feature_mask, bynode_count: int, num_bins,
-                   extra_trees: bool, ff_bynode: float):
+class NodeRandMixin:
+    """Shared per-tree RNG state for extra-trees / by-node sampling —
+    one definition so the serial, partitioned and mesh learners derive
+    identical key streams."""
+
+    def _init_node_rand(self, dataset: Dataset, config: Config) -> None:
+        self.extra_trees = bool(config.extra_trees)
+        self.ff_bynode = float(config.feature_fraction_bynode)
+        self._extra_rng = np.random.RandomState(config.extra_seed)
+        self._bynode_rng = np.random.RandomState(
+            config.feature_fraction_seed)
+        self.bynode_count = bynode_feature_count(
+            dataset.num_features, float(config.feature_fraction),
+            self.ff_bynode)
+        self.forced_plan = build_forced_plan(dataset, config)
+
+    def next_tree_key(self):
+        """Fresh per-tree PRNG key pair for extra-trees (extra_seed
+        stream) and by-node feature sampling (feature_fraction_seed
+        stream); None when neither feature is on, keeping the no-RNG
+        compile."""
+        if not (self.extra_trees or self.ff_bynode < 1.0):
+            return None
+        return jnp.stack([
+            jax.random.PRNGKey(self._extra_rng.randint(0, 2**31 - 1)),
+            jax.random.PRNGKey(self._bynode_rng.randint(0, 2**31 - 1))])
+
+
+def make_node_rand(rand_keys, feature_mask, bynode_count, num_bins,
+                   extra_trees: bool, ff_bynode: float,
+                   bynode_cap: int | None = None):
     """Per-node randomness for the grow loop, shared by the serial and
     partitioned learners.
 
@@ -125,13 +227,18 @@ def make_node_rand(rand_keys, feature_mask, bynode_count: int, num_bins,
       * ``node_mask`` [F] bool — ``bynode_count`` features drawn from
         WITHIN the per-tree ``feature_mask`` subset (already ANDed), or
         None when by-node sampling is off.
-    ``salt`` must be a distinct traced int per scan call so every node
-    draws fresh randomness inside one compiled program.
+    ``bynode_count`` may be a TRACED int (feature-parallel shards split
+    the global budget unevenly); ``bynode_cap`` must then be the static
+    maximum (top_k needs a static k). ``salt`` must be a distinct
+    traced int per scan call so every node draws fresh randomness
+    inside one compiled program.
     """
     use = (extra_trees or ff_bynode < 1.0) and rand_keys is not None
     if not use:
         return lambda salt: (None, None)
     f = num_bins.shape[0]
+    cap = bynode_cap if bynode_cap is not None else int(bynode_count)
+    cap = min(max(cap, 1), f)
 
     def node_rand(salt):
         rb = None
@@ -145,29 +252,24 @@ def make_node_rand(rand_keys, feature_mask, bynode_count: int, num_bins,
             kk2 = jax.random.fold_in(rand_keys[1], salt)
             u2 = jax.random.uniform(kk2, (f,))
             u2 = jnp.where(feature_mask, u2, -1.0)  # only tree subset
-            kcnt = min(max(bynode_count, 1), f)
-            kth = jax.lax.top_k(u2, kcnt)[0][-1]
+            vals = jax.lax.top_k(u2, cap)[0]
+            cnt = jnp.clip(jnp.asarray(bynode_count, jnp.int32), 0, cap)
+            kth = jnp.where(cnt > 0, vals[jnp.maximum(cnt - 1, 0)],
+                            jnp.float32(2.0))  # cnt=0 -> empty mask
             nm = (u2 >= kth) & feature_mask
         return rb, nm
 
     return node_rand
 
 
-class SerialTreeLearner:
+class SerialTreeLearner(NodeRandMixin):
     """Owns the device copy of the dataset and the compiled grow program."""
 
     def __init__(self, dataset: Dataset, config: Config,
                  hist_method: str = "auto"):
         self.dataset = dataset
         self.config = config
-        self.extra_trees = bool(config.extra_trees)
-        self.ff_bynode = float(config.feature_fraction_bynode)
-        self._extra_rng = np.random.RandomState(config.extra_seed)
-        self._bynode_rng = np.random.RandomState(
-            config.feature_fraction_seed)
-        self.bynode_count = bynode_feature_count(
-            dataset.num_features, float(config.feature_fraction),
-            self.ff_bynode)
+        self._init_node_rand(dataset, config)
         self.meta = feature_meta_from_dataset(dataset, config)
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
@@ -202,18 +304,8 @@ class SerialTreeLearner:
                          bundled=self.bundled,
                          extra_trees=self.extra_trees,
                          ff_bynode=self.ff_bynode,
-                         bynode_count=self.bynode_count)
-
-    def next_tree_key(self):
-        """Fresh per-tree PRNG key pair for extra-trees (extra_seed
-        stream) and by-node feature sampling (feature_fraction_seed
-        stream); None when neither feature is on, keeping the no-RNG
-        compile."""
-        if not (self.extra_trees or self.ff_bynode < 1.0):
-            return None
-        return jnp.stack([
-            jax.random.PRNGKey(self._extra_rng.randint(0, 2**31 - 1)),
-            jax.random.PRNGKey(self._bynode_rng.randint(0, 2**31 - 1))])
+                         bynode_count=self.bynode_count,
+                         forced_plan=self.forced_plan)
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -226,17 +318,20 @@ class SerialTreeLearner:
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "hist_method", "bundled",
-                              "extra_trees", "ff_bynode", "bynode_count"))
+                              "extra_trees", "ff_bynode", "bynode_count",
+                              "forced_plan"))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
               rand_key=None, *, params, num_leaves, max_depth,
               num_bins_max, hist_method, bundled=False,
-              extra_trees=False, ff_bynode=1.0, bynode_count=2):
+              extra_trees=False, ff_bynode=1.0, bynode_count=2,
+              forced_plan=()):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
                      hist_method=hist_method, bundled=bundled,
                      rand_key=rand_key, extra_trees=extra_trees,
-                     ff_bynode=ff_bynode, bynode_count=bynode_count)
+                     ff_bynode=ff_bynode, bynode_count=bynode_count,
+                     forced_plan=forced_plan)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -245,7 +340,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               comm=None, binned_hist=None, meta_hist=None,
               bundled: bool = False, rand_key=None,
               extra_trees: bool = False, ff_bynode: float = 1.0,
-              bynode_count: int = 2) -> GrowResult:
+              bynode_count=2, bynode_cap: int | None = None,
+              forced_plan: tuple = ()) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -276,7 +372,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     # before select_split), so draws span meta_hist's length, not the
     # physical group count
     node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
-                               meta_hist.num_bins, extra_trees, ff_bynode)
+                               meta_hist.num_bins, extra_trees, ff_bynode,
+                               bynode_cap=bynode_cap)
 
     def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
@@ -356,24 +453,81 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
         return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
 
-    def body(st):
+    def forced_quantities(st, forced):
+        """Left sums of a STATIC forced split read off the leaf's
+        cached histogram — the GatherInfoForThreshold analog. Missing
+        bins are routed exactly like the partition will route the rows:
+        NaN bin (num_bin-1) by default_left, zero-missing default bin
+        to the right."""
+        fleaf, ffeat, fthr, fdleft, fmiss, fdbin, fnbin = forced
+        hist_leaf = st["hist"][fleaf]
+        if bundled:
+            from ..ops.histogram import debundle_hist
+            pg0, ph0, pc0 = (st["leaf_g"][fleaf], st["leaf_h"][fleaf],
+                             st["leaf_c"][fleaf])
+            hist_leaf = debundle_hist(hist_leaf, meta_hist.group,
+                                      meta_hist.offset,
+                                      meta_hist.num_bins, pg0, ph0, pc0)
+        cum = hist_leaf[ffeat, :fthr + 1].sum(axis=0)
+        if fmiss == MISSING_NAN_CODE and fdleft and fnbin - 1 > fthr:
+            cum = cum + hist_leaf[ffeat, fnbin - 1]  # NaN rows go left
+        if fmiss == MISSING_ZERO_CODE and not fdleft and fdbin <= fthr:
+            cum = cum - hist_leaf[ffeat, fdbin]  # default bin goes right
+        return cum[0], cum[1], cum[2]
+
+    def body(st, forced=None):
+        from ..ops.split import (gain_given_output, leaf_output,
+                                 leaf_split_gain)
         k = st["k"]
-        open_gain = jnp.where(leaf_range < k, st["bs_gain"], -jnp.inf)
-        leaf = jnp.argmax(open_gain).astype(jnp.int32)
         new = k
         s = k - 1  # internal node index for this split
 
-        feat = st["bs_feat"][leaf]
-        thr = st["bs_thr"][leaf]
-        dleft = st["bs_dleft"][leaf]
-        gain = st["bs_gain"][leaf]
-        is_cat = st["bs_iscat"][leaf]
-        bitset = st["bs_bitset"][leaf]
-        lg, lh, lc = st["bs_lg"][leaf], st["bs_lh"][leaf], st["bs_lc"][leaf]
-        pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
-            st["leaf_c"][leaf]
-        rg, rh, rc = pg - lg, ph - lh, pc - lc
-        lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+        if forced is None:
+            open_gain = jnp.where(leaf_range < k, st["bs_gain"],
+                                  -jnp.inf)
+            leaf = jnp.argmax(open_gain).astype(jnp.int32)
+            feat = st["bs_feat"][leaf]
+            thr = st["bs_thr"][leaf]
+            dleft = st["bs_dleft"][leaf]
+            gain = st["bs_gain"][leaf]
+            is_cat = st["bs_iscat"][leaf]
+            bitset = st["bs_bitset"][leaf]
+            lg, lh, lc = (st["bs_lg"][leaf], st["bs_lh"][leaf],
+                          st["bs_lc"][leaf])
+            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
+                st["leaf_c"][leaf]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+        else:
+            fleaf, ffeat, fthr, fdleft = forced[:4]
+            leaf = jnp.int32(fleaf)
+            feat = jnp.int32(ffeat)
+            thr = jnp.int32(fthr)
+            dleft = jnp.bool_(fdleft)
+            is_cat = jnp.bool_(False)
+            bitset = jnp.zeros((MAX_CAT_WORDS,), jnp.uint32)
+            lg, lh, lc = forced_quantities(st, forced)
+            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
+                st["leaf_c"][leaf]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            cmin0 = st["leaf_cmin"][leaf]
+            cmax0 = st["leaf_cmax"][leaf]
+            lh_e = lh + kEps
+            rh_e = ph + 2 * kEps - lh_e
+            lout = leaf_output(lg, lh_e, params.lambda_l1,
+                               params.lambda_l2, params.max_delta_step,
+                               cmin0, cmax0)
+            rout = leaf_output(rg, rh_e, params.lambda_l1,
+                               params.lambda_l2, params.max_delta_step,
+                               cmin0, cmax0)
+            shift = leaf_split_gain(pg, ph + 2 * kEps, params.lambda_l1,
+                                    params.lambda_l2,
+                                    params.max_delta_step)
+            gain = (gain_given_output(lg, lh_e, lout, params.lambda_l1,
+                                      params.lambda_l2)
+                    + gain_given_output(rg, rh_e, rout, params.lambda_l1,
+                                        params.lambda_l2)
+                    - shift - params.min_gain_to_split)
 
         # ---- partition rows of `leaf` ---------------------------------
         bin_col = jnp.take(binned, meta.group[feat], axis=1)
@@ -484,7 +638,22 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         )
         return st2
 
-    st = jax.lax.while_loop(cond, body, state)
+    # ---- forced splits: unrolled static pre-pass (ForceSplits,
+    # serial_tree_learner.cpp:465-634). Any invalid forced split aborts
+    # the REST of the plan (aborted_last_force_split semantics).
+    st = state
+    force_ok = jnp.bool_(True)
+    for step in forced_plan:
+        lg_f, lh_f, _ = forced_quantities(st, step)
+        ph_f = st["leaf_h"][step[0]]
+        force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
+            & (st["k"] < big_l)
+        st = jax.lax.cond(
+            force_ok,
+            functools.partial(body, forced=step),
+            lambda s: s, st)
+
+    st = jax.lax.while_loop(cond, body, st)
 
     tree = TreeArrays(
         num_leaves=st["k"],

