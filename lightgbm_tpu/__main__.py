@@ -1,0 +1,7 @@
+"""``python -m lightgbm_tpu`` — the CLI entry (src/main.cpp analog)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

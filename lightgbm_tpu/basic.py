@@ -165,6 +165,11 @@ class Dataset:
             cat_idx = _resolve_categorical(
                 self.categorical_feature, feature_name, data.shape[1])
 
+        # keep the parsed matrix only for FILE datasets (cheap handle
+        # for continued-training re-scoring; in-memory datasets keep
+        # self.data itself); free_raw_data drops both below
+        if isinstance(self.data, str):
+            self._raw_matrix = data
         ref_inner = self.reference._inner if self.reference is not None \
             else None
         self._inner = _InnerDataset.from_numpy(
@@ -175,6 +180,7 @@ class Dataset:
             categorical_features=cat_idx, reference=ref_inner)
         if self.free_raw_data:
             self.data = None
+            self._raw_matrix = None
         return self
 
     def _merged_params(self) -> Dict[str, Any]:
@@ -475,7 +481,8 @@ class Booster:
         import copy
         src = self._src()
         obj = getattr(src, "objective", None)
-        if obj is None:
+        obj_str = getattr(src, "objective_str", "")
+        if obj is None and not obj_str:
             raise LightGBMError(
                 "Cannot refit due to null objective function.")
         # all trees, even past best_iteration (reference passes -1)
@@ -483,6 +490,15 @@ class Booster:
         leaf_preds = self.predict(data, pred_leaf=True, **kwargs)
         new_params = dict(self.params)
         new_params["refit_decay_rate"] = decay_rate
+        if "objective" not in new_params and obj_str:
+            # loaded model: recover the objective from its model line
+            # ("binary sigmoid:1", "multiclass num_class:3", ...)
+            toks = obj_str.split()
+            new_params["objective"] = toks[0]
+            for tok in toks[1:]:
+                key, _, val = tok.partition(":")
+                if key and val:
+                    new_params.setdefault(key, val)
         train_set = Dataset(data, label=label)
         new_booster = Booster(new_params, train_set)
         getattr(src, "finalize_trees", lambda: None)()

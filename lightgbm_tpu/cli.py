@@ -1,0 +1,177 @@
+"""Command-line entry: ``python -m lightgbm_tpu config=train.conf``.
+
+Reference analog: ``Application``
+(``src/application/application.cpp:24-224``, ``src/main.cpp``). Accepts
+the reference CLI conventions: ``key=value`` arguments, a ``config=``
+file of ``key = value`` lines with ``#`` comments (CLI args override
+file entries), and the tasks
+
+  * ``task=train`` (default) — load ``data`` (+ ``valid`` list), train,
+    save ``output_model``; ``snapshot_freq=N`` writes
+    ``<output_model>.snapshot_iter_<i>`` every N iterations
+    (gbdt.cpp:258-262); ``input_model`` continues training from an
+    existing model file.
+  * ``task=predict`` — load ``input_model``, predict ``data``, write
+    one line per row to ``output_result`` (predictor.cpp:46-109);
+    honors ``predict_raw_score`` / ``predict_leaf_index`` /
+    ``predict_contrib`` and ``num_iteration_predict``.
+  * ``task=refit`` — load ``input_model``, refit leaf values on
+    ``data`` with ``refit_decay_rate``, save ``output_model``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .utils.log import log_fatal, log_info, log_warning
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, _, val = line.partition("=")
+            params[key.strip()] = val.strip()
+    return params
+
+
+def parse_cli_params(argv: List[str]) -> Dict[str, str]:
+    """CLI ``key=value`` args + optional config file; CLI wins
+    (application.cpp LoadParameters precedence)."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        arg = arg.strip()
+        if not arg or "=" not in arg:
+            if arg:
+                log_warning(f"Unknown CLI argument: {arg}")
+            continue
+        key, _, val = arg.partition("=")
+        cli[key.strip()] = val.strip()
+    conf = cli.pop("config", None) or cli.pop("config_file", None)
+    params = parse_config_file(conf) if conf else {}
+    params.update(cli)
+    return params
+
+
+def _load_predict_data(path: str, config) -> np.ndarray:
+    """Feature matrix of a prediction input file: same parsing as
+    training (label/weight/group columns dropped when present)."""
+    from .data.file_loader import load_file
+    X, _, _, _, _, _ = load_file(path, config)
+    return X
+
+
+def run_train(params: Dict[str, str]) -> None:
+    from . import engine
+    from .basic import Dataset
+    from .config import Config
+    cfg = Config.from_params(params)
+    if not cfg.data:
+        log_fatal("task=train requires data=<training file>")
+    train_set = Dataset(cfg.data, params=dict(params))
+    valid_sets = []
+    valid_names = []
+    for v in cfg.valid:
+        valid_sets.append(Dataset(v, params=dict(params),
+                                  reference=train_set))
+        valid_names.append(v.split("/")[-1])
+
+    callbacks = []
+    output_model = cfg.output_model or "LightGBM_model.txt"
+    if cfg.snapshot_freq > 0:
+        freq = int(cfg.snapshot_freq)
+
+        def snapshot(env):
+            it = env.iteration + 1
+            if it % freq == 0:
+                out = f"{output_model}.snapshot_iter_{it}"
+                env.model.save_model(out)
+                log_info(f"Saved snapshot to {out}")
+        snapshot.order = 30
+        callbacks.append(snapshot)
+
+    booster = engine.train(
+        dict(params), train_set,
+        num_boost_round=int(cfg.num_iterations),
+        valid_sets=valid_sets or None,
+        valid_names=valid_names or None,
+        init_model=cfg.input_model or None,
+        callbacks=callbacks or None)
+    booster.save_model(output_model)
+    log_info(f"Finished training; model saved to {output_model}")
+
+
+def run_predict(params: Dict[str, str]) -> None:
+    from .basic import Booster
+    from .config import Config
+    cfg = Config.from_params(params)
+    if not cfg.input_model:
+        log_fatal("task=predict requires input_model=<model file>")
+    if not cfg.data:
+        log_fatal("task=predict requires data=<input file>")
+    booster = Booster(model_file=cfg.input_model)
+    X = _load_predict_data(cfg.data, cfg)
+    ni = int(cfg.num_iteration_predict)
+    kwargs = dict(num_iteration=ni if ni > 0 else -1)
+    if cfg.predict_leaf_index:
+        pred = booster.predict(X, pred_leaf=True, **kwargs)
+    elif cfg.predict_contrib:
+        pred = booster.predict(X, pred_contrib=True, **kwargs)
+    else:
+        pred = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
+                               **kwargs)
+    out = cfg.output_result or "LightGBM_predict_result.txt"
+    pred = np.asarray(pred)
+    fmt = "%d" if pred.dtype.kind in "iu" else "%.18g"
+    np.savetxt(out, pred, delimiter="\t", fmt=fmt)
+    log_info(f"Finished prediction; results saved to {out}")
+
+
+def run_refit(params: Dict[str, str]) -> None:
+    from .basic import Booster
+    from .config import Config
+    from .data.file_loader import load_file
+    cfg = Config.from_params(params)
+    if not cfg.input_model or not cfg.data:
+        log_fatal("task=refit requires input_model= and data=")
+    booster = Booster(model_file=cfg.input_model)
+    # the refitted booster trains under the task's full config, not
+    # library defaults (the reference CLI refits under config_)
+    booster.params = {k: v for k, v in params.items()
+                      if k not in ("task", "input_model", "output_model",
+                                   "data", "config")}
+    X, label, _, _, _, _ = load_file(cfg.data, cfg)
+    if label is None:
+        log_fatal("task=refit requires labels in the data file")
+    new_booster = booster.refit(X, label,
+                                decay_rate=float(cfg.refit_decay_rate))
+    out = cfg.output_model or "LightGBM_model.txt"
+    new_booster.save_model(out)
+    log_info(f"Finished refit; model saved to {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    params = parse_cli_params(argv)
+    task = params.get("task", "train")
+    if task == "train":
+        run_train(params)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params)
+    elif task == "refit":
+        run_refit(params)
+    elif task == "convert_model":
+        log_fatal("task=convert_model is not implemented")
+    else:
+        log_fatal(f"Unknown task: {task}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,9 +52,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if fobj is not None:
         params["objective"] = "none"
 
+    init_models = None
     if init_model is not None:
-        raise LightGBMError("init_model (continued training) is not "
-                            "supported yet")
+        # continued training (reference engine.py:119-130 +
+        # boosting.cpp:35-68): adopt the existing trees, seed scores
+        if isinstance(init_model, str):
+            from .io.model_text import load_model_from_file
+            src = load_model_from_file(init_model)
+        elif isinstance(init_model, Booster):
+            src = init_model._src()
+        else:
+            raise TypeError("init_model should be a path or a Booster")
+        getattr(src, "finalize_trees", lambda: None)()
+        init_models = [copy.deepcopy(t) for t in src.models]
     if not isinstance(train_set, Dataset):
         raise TypeError("Training only accepts Dataset object")
     if feature_name != "auto":
@@ -86,6 +96,34 @@ def train(params: Dict[str, Any], train_set: Dataset,
             name_valid_sets.append(name)
             booster.add_valid(valid_data, name)
     booster._train_data_name = train_data_name
+
+    if init_models:
+        def _raw_add(ds: Dataset) -> np.ndarray:
+            X = getattr(ds, "_raw_matrix", None)
+            if X is None:
+                X = ds.data
+            if isinstance(X, str):
+                from .data.file_loader import load_file
+                from .config import Config as _Cfg
+                X = load_file(X, _Cfg.from_params(
+                    ds._merged_params()))[0]
+            if X is None:
+                raise LightGBMError(
+                    "continued training (init_model) needs the raw "
+                    "feature matrix to seed scores; construct the "
+                    "Dataset with free_raw_data=False and not via "
+                    "subset()")
+            if hasattr(X, "to_numpy"):
+                X = X.to_numpy()
+            X = np.asarray(X, np.float64)
+            k = booster._gbdt.num_tree_per_iteration
+            out = np.zeros((X.shape[0], k))
+            for i, t in enumerate(init_models):
+                out[:, i % k] += t.predict(X)
+            return out
+        booster._gbdt.init_from_models(
+            init_models, _raw_add(train_set),
+            [_raw_add(v) for v in reduced_valid_sets])
 
     # callback assembly (engine.py:186-204)
     callbacks = set(callbacks) if callbacks is not None else set()
@@ -121,16 +159,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if not need_eval and fobj is None and inert_without_eval \
             and not (early_stopping_rounds or 0) > 0:
         # no per-iteration host interaction needed: pipelined fast path
-        booster._gbdt.train(num_boost_round)
+        booster._gbdt.train(booster._gbdt.iter + num_boost_round)
         booster.best_iteration = -1
         return booster
 
-    # per-iteration loop (engine.py:221-276)
-    for i in range(num_boost_round):
+    # per-iteration loop (engine.py:221-276); iteration numbers are
+    # ABSOLUTE (continued training offsets by the init model's rounds,
+    # reference init_iteration semantics) so early stopping records a
+    # best_iteration that predict()'s model truncation understands
+    base_iter = booster._gbdt.iter
+    end_iter = base_iter + num_boost_round
+    for i in range(base_iter, end_iter):
         for cb in callbacks_before:
             cb(CallbackEnv(model=booster, params=params, iteration=i,
-                           begin_iteration=0,
-                           end_iteration=num_boost_round,
+                           begin_iteration=base_iter,
+                           end_iteration=end_iter,
                            evaluation_result_list=None))
         booster.update(fobj=fobj)
 
@@ -143,8 +186,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         try:
             for cb in callbacks_after:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
-                               begin_iteration=0,
-                               end_iteration=num_boost_round,
+                               begin_iteration=base_iter,
+                               end_iteration=end_iter,
                                evaluation_result_list=
                                evaluation_result_list))
         except EarlyStopException as earlyStopException:

@@ -40,7 +40,8 @@ from ..ops.hist_pallas import (build_matrix, combine_planes,
 from ..ops.partition_pallas import bitset_to_lut, partition_segment
 from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
 from .serial import (GrowResult, NodeRandMixin,
-                     feature_meta_from_dataset, make_node_rand,
+                     feature_meta_from_dataset, forced_left_sums,
+                     forced_split_override, make_node_rand,
                      split_params_from_config)
 
 HIST_BLK = 2048
@@ -223,29 +224,7 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
 
     kEps = 1e-15
 
-    def forced_quantities(st, forced):
-        """Left sums of a STATIC forced split read off the leaf's cached
-        histogram (GatherInfoForThreshold analog); missing bins routed
-        like the partition kernel routes the rows."""
-        from ..ops.split import MISSING_NAN_CODE, MISSING_ZERO_CODE
-        fleaf, ffeat, fthr, fdleft, fmiss, fdbin, fnbin = forced
-        hist_leaf = st["hist"][fleaf]
-        if bundled:
-            from ..ops.histogram import debundle_hist
-            pg0, ph0, pc0 = (st["leaf_g"][fleaf], st["leaf_h"][fleaf],
-                             st["leaf_c"][fleaf])
-            hist_leaf = debundle_hist(hist_leaf, meta.group, meta.offset,
-                                      meta.num_bins, pg0, ph0, pc0)
-        cum = hist_leaf[ffeat, :fthr + 1].sum(axis=0)
-        if fmiss == MISSING_NAN_CODE and fdleft and fnbin - 1 > fthr:
-            cum = cum + hist_leaf[ffeat, fnbin - 1]  # NaN rows go left
-        if fmiss == MISSING_ZERO_CODE and not fdleft and fdbin <= fthr:
-            cum = cum - hist_leaf[ffeat, fdbin]  # default bin goes right
-        return cum[0], cum[1], cum[2]
-
     def body(st, forced=None):
-        from ..ops.split import (gain_given_output, leaf_output,
-                                 leaf_split_gain)
         k = st["k"]
         new = k
         s = k - 1
@@ -267,35 +246,9 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
             lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
         else:
-            fleaf, ffeat, fthr, fdleft = forced[:4]
-            leaf = jnp.int32(fleaf)
-            feat = jnp.int32(ffeat)
-            thr = jnp.int32(fthr)
-            dleft = jnp.bool_(fdleft)
-            is_cat = jnp.bool_(False)
-            bitset = jnp.zeros((MAX_CAT_WORDS,), jnp.uint32)
-            lg, lh, lc = forced_quantities(st, forced)
-            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
-                st["leaf_c"][leaf]
-            rg, rh, rc = pg - lg, ph - lh, pc - lc
-            cmin0 = st["leaf_cmin"][leaf]
-            cmax0 = st["leaf_cmax"][leaf]
-            lh_e = lh + kEps
-            rh_e = ph + 2 * kEps - lh_e
-            lout = leaf_output(lg, lh_e, params.lambda_l1,
-                               params.lambda_l2, params.max_delta_step,
-                               cmin0, cmax0)
-            rout = leaf_output(rg, rh_e, params.lambda_l1,
-                               params.lambda_l2, params.max_delta_step,
-                               cmin0, cmax0)
-            shift = leaf_split_gain(pg, ph + 2 * kEps, params.lambda_l1,
-                                    params.lambda_l2,
-                                    params.max_delta_step)
-            gain = (gain_given_output(lg, lh_e, lout, params.lambda_l1,
-                                      params.lambda_l2)
-                    + gain_given_output(rg, rh_e, rout, params.lambda_l1,
-                                        params.lambda_l2)
-                    - shift - params.min_gain_to_split)
+            (leaf, feat, thr, dleft, gain, is_cat, bitset,
+             lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
+                forced_split_override(st, forced, params, meta, bundled)
 
         begin = st["leaf_begin"][leaf]
         cnt = st["leaf_cnt"][leaf]
@@ -436,7 +389,7 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        lg_f, lh_f, _ = forced_quantities(st, step)
+        lg_f, lh_f, _ = forced_left_sums(st, step, meta, bundled)
         ph_f = st["leaf_h"][step[0]]
         force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
             & (st["k"] < big_l)

@@ -93,7 +93,7 @@ def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
     GatherInfoForThresholdNumerical's right-accumulates-``>=`` loop.
     NaN-missing features send missing left there (the NaN bin is
     excluded from the right sweep), hence default_left; the missing
-    metadata lets forced_quantities route the NaN / zero-default bins
+    metadata lets forced_left_sums route the NaN / zero-default bins
     the same way the partition does. A threshold below all data
     (ValueToBin == 0: empty left side) aborts the rest of the plan like
     the reference's empty-gather abort.
@@ -118,7 +118,10 @@ def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
             continue
         feat_real = int(node["feature"])
         thr = float(node["threshold"])
-        inner = dataset.inner_feature_index(feat_real)
+        try:
+            inner = dataset.inner_feature_index(feat_real)
+        except IndexError:
+            inner = -1
         if inner is None or inner < 0:
             log_warning(f"forced split on unused feature {feat_real} "
                         "ignored; aborting remaining forced splits")
@@ -147,6 +150,66 @@ def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
             q.append((node["right"], k))
         k += 1
     return tuple(plan)
+
+
+def forced_left_sums(st, forced, meta_scan, bundled: bool):
+    """Left sums of a STATIC forced split read off the leaf's cached
+    histogram — the GatherInfoForThreshold analog. Missing bins are
+    routed exactly like the partition routes the rows: NaN bin
+    (num_bin-1) by default_left, zero-missing default bin right."""
+    fleaf, ffeat, fthr, fdleft, fmiss, fdbin, fnbin = forced
+    hist_leaf = st["hist"][fleaf]
+    if bundled:
+        from ..ops.histogram import debundle_hist
+        pg0, ph0, pc0 = (st["leaf_g"][fleaf], st["leaf_h"][fleaf],
+                         st["leaf_c"][fleaf])
+        hist_leaf = debundle_hist(hist_leaf, meta_scan.group,
+                                  meta_scan.offset, meta_scan.num_bins,
+                                  pg0, ph0, pc0)
+    cum = hist_leaf[ffeat, :fthr + 1].sum(axis=0)
+    if fmiss == MISSING_NAN_CODE and fdleft and fnbin - 1 > fthr:
+        cum = cum + hist_leaf[ffeat, fnbin - 1]  # NaN rows go left
+    if fmiss == MISSING_ZERO_CODE and not fdleft and fdbin <= fthr:
+        cum = cum - hist_leaf[ffeat, fdbin]  # default bin goes right
+    return cum[0], cum[1], cum[2]
+
+
+def forced_split_override(st, forced, params: SplitParams, meta_scan,
+                          bundled: bool):
+    """All split-site quantities of a static forced split, shared by
+    the serial and partitioned grow bodies: returns
+    (leaf, feat, thr, dleft, gain, is_cat, bitset,
+     lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout)."""
+    from ..ops.split import (gain_given_output, leaf_output,
+                             leaf_split_gain)
+    fleaf, ffeat, fthr, fdleft = forced[:4]
+    leaf = jnp.int32(fleaf)
+    feat = jnp.int32(ffeat)
+    thr = jnp.int32(fthr)
+    dleft = jnp.bool_(fdleft)
+    is_cat = jnp.bool_(False)
+    bitset = jnp.zeros((MAX_CAT_WORDS,), jnp.uint32)
+    lg, lh, lc = forced_left_sums(st, forced, meta_scan, bundled)
+    pg, ph, pc = (st["leaf_g"][leaf], st["leaf_h"][leaf],
+                  st["leaf_c"][leaf])
+    rg, rh, rc = pg - lg, ph - lh, pc - lc
+    cmin0 = st["leaf_cmin"][leaf]
+    cmax0 = st["leaf_cmax"][leaf]
+    lh_e = lh + kEps
+    rh_e = ph + 2 * kEps - lh_e
+    lout = leaf_output(lg, lh_e, params.lambda_l1, params.lambda_l2,
+                       params.max_delta_step, cmin0, cmax0)
+    rout = leaf_output(rg, rh_e, params.lambda_l1, params.lambda_l2,
+                       params.max_delta_step, cmin0, cmax0)
+    shift = leaf_split_gain(pg, ph + 2 * kEps, params.lambda_l1,
+                            params.lambda_l2, params.max_delta_step)
+    gain = (gain_given_output(lg, lh_e, lout, params.lambda_l1,
+                              params.lambda_l2)
+            + gain_given_output(rg, rh_e, rout, params.lambda_l1,
+                                params.lambda_l2)
+            - shift - params.min_gain_to_split)
+    return (leaf, feat, thr, dleft, gain, is_cat, bitset,
+            lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout)
 
 
 def split_params_from_config(config: Config) -> SplitParams:
@@ -453,31 +516,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
         return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
 
-    def forced_quantities(st, forced):
-        """Left sums of a STATIC forced split read off the leaf's
-        cached histogram — the GatherInfoForThreshold analog. Missing
-        bins are routed exactly like the partition will route the rows:
-        NaN bin (num_bin-1) by default_left, zero-missing default bin
-        to the right."""
-        fleaf, ffeat, fthr, fdleft, fmiss, fdbin, fnbin = forced
-        hist_leaf = st["hist"][fleaf]
-        if bundled:
-            from ..ops.histogram import debundle_hist
-            pg0, ph0, pc0 = (st["leaf_g"][fleaf], st["leaf_h"][fleaf],
-                             st["leaf_c"][fleaf])
-            hist_leaf = debundle_hist(hist_leaf, meta_hist.group,
-                                      meta_hist.offset,
-                                      meta_hist.num_bins, pg0, ph0, pc0)
-        cum = hist_leaf[ffeat, :fthr + 1].sum(axis=0)
-        if fmiss == MISSING_NAN_CODE and fdleft and fnbin - 1 > fthr:
-            cum = cum + hist_leaf[ffeat, fnbin - 1]  # NaN rows go left
-        if fmiss == MISSING_ZERO_CODE and not fdleft and fdbin <= fthr:
-            cum = cum - hist_leaf[ffeat, fdbin]  # default bin goes right
-        return cum[0], cum[1], cum[2]
-
     def body(st, forced=None):
-        from ..ops.split import (gain_given_output, leaf_output,
-                                 leaf_split_gain)
         k = st["k"]
         new = k
         s = k - 1  # internal node index for this split
@@ -499,35 +538,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
             lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
         else:
-            fleaf, ffeat, fthr, fdleft = forced[:4]
-            leaf = jnp.int32(fleaf)
-            feat = jnp.int32(ffeat)
-            thr = jnp.int32(fthr)
-            dleft = jnp.bool_(fdleft)
-            is_cat = jnp.bool_(False)
-            bitset = jnp.zeros((MAX_CAT_WORDS,), jnp.uint32)
-            lg, lh, lc = forced_quantities(st, forced)
-            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
-                st["leaf_c"][leaf]
-            rg, rh, rc = pg - lg, ph - lh, pc - lc
-            cmin0 = st["leaf_cmin"][leaf]
-            cmax0 = st["leaf_cmax"][leaf]
-            lh_e = lh + kEps
-            rh_e = ph + 2 * kEps - lh_e
-            lout = leaf_output(lg, lh_e, params.lambda_l1,
-                               params.lambda_l2, params.max_delta_step,
-                               cmin0, cmax0)
-            rout = leaf_output(rg, rh_e, params.lambda_l1,
-                               params.lambda_l2, params.max_delta_step,
-                               cmin0, cmax0)
-            shift = leaf_split_gain(pg, ph + 2 * kEps, params.lambda_l1,
-                                    params.lambda_l2,
-                                    params.max_delta_step)
-            gain = (gain_given_output(lg, lh_e, lout, params.lambda_l1,
-                                      params.lambda_l2)
-                    + gain_given_output(rg, rh_e, rout, params.lambda_l1,
-                                        params.lambda_l2)
-                    - shift - params.min_gain_to_split)
+            (leaf, feat, thr, dleft, gain, is_cat, bitset,
+             lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
+                forced_split_override(st, forced, params, meta_hist,
+                                      bundled)
 
         # ---- partition rows of `leaf` ---------------------------------
         bin_col = jnp.take(binned, meta.group[feat], axis=1)
@@ -644,7 +658,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        lg_f, lh_f, _ = forced_quantities(st, step)
+        lg_f, lh_f, _ = forced_left_sums(st, step, meta_hist, bundled)
         ph_f = st["leaf_h"][step[0]]
         force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
             & (st["k"] < big_l)

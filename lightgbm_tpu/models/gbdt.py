@@ -286,6 +286,27 @@ class GBDT:
                 self.valid_scores[i].at[:, tid].add(vadd)
 
     # ------------------------------------------------------------------
+    def init_from_models(self, models: List, train_add=None,
+                         valid_adds=None) -> None:
+        """Continued training seed (GBDT::LoadModelFromString +
+        ResetTrainingData resume semantics, boosting.cpp:35-68,
+        gbdt.cpp:258-262): adopt an existing model's trees and add its
+        raw contribution to the cached train/valid scores so the next
+        ``train_one_iter`` boosts on the correct residuals."""
+        self.models = list(models)
+        self.iter = len(models) // self.num_tree_per_iteration
+        if train_add is not None:
+            add = np.asarray(train_add, np.float32)
+            if add.ndim == 1:
+                add = add[:, None]
+            self.train_score = self.train_score + jnp.asarray(add)
+        for i, va in enumerate(valid_adds or []):
+            va = np.asarray(va, np.float32)
+            if va.ndim == 1:
+                va = va[:, None]
+            self.valid_scores[i] = self.valid_scores[i] + jnp.asarray(va)
+
+    # ------------------------------------------------------------------
     def refit(self, leaf_preds: np.ndarray) -> None:
         """RefitTree (gbdt.cpp:266-289) + FitByExistingTree
         (serial_tree_learner.cpp:194-224): keep every tree's structure,

@@ -1,0 +1,152 @@
+"""CLI (python -m lightgbm_tpu) — train/predict/refit tasks with
+reference-style conf files, continued training, snapshots."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import cli
+
+from golden_common import DATASETS, write_tsv
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "golden")
+
+
+@pytest.fixture
+def workdir(tmp_path):
+    Xtr, ytr, Xte, yte = DATASETS["binary"]["make"]()
+    train = str(tmp_path / "bin.train")
+    test = str(tmp_path / "bin.test")
+    write_tsv(train, Xtr, ytr)
+    write_tsv(test, Xte, yte)
+    return dict(path=tmp_path, train=train, test=test, Xte=Xte, yte=yte)
+
+
+def test_cli_train_then_predict(workdir):
+    conf = workdir["path"] / "train.conf"
+    model = str(workdir["path"] / "model.txt")
+    conf.write_text(
+        "# reference-style conf\n"
+        "task = train\n"
+        "objective = binary\n"
+        f"data = {workdir['train']}\n"
+        "num_trees = 10\n"
+        "num_leaves = 15\n"
+        "metric = binary_logloss\n"
+        "verbosity = -1\n")
+    cli.main([f"config={conf}", f"output_model={model}"])
+    assert os.path.exists(model)
+
+    out = str(workdir["path"] / "preds.txt")
+    cli.main(["task=predict", f"data={workdir['test']}",
+              f"input_model={model}", f"output_result={out}",
+              "verbosity=-1"])
+    preds = np.loadtxt(out)
+    assert preds.shape[0] == workdir["Xte"].shape[0]
+    assert ((preds > 0) & (preds < 1)).all()
+    # sane classifier
+    y = workdir["yte"]
+    assert preds[y == 1].mean() > preds[y == 0].mean()
+
+
+def test_cli_predict_matches_reference_cli_output(workdir):
+    """Our predict task over the golden reference model reproduces the
+    reference CLI's own recorded output file."""
+    model = os.path.join(FIXDIR, "model_binary.txt")
+    out = str(workdir["path"] / "preds.txt")
+    cli.main(["task=predict", f"data={workdir['test']}",
+              f"input_model={model}", f"output_result={out}",
+              "verbosity=-1"])
+    ref = np.loadtxt(os.path.join(FIXDIR, "pred_binary.txt"))
+    np.testing.assert_allclose(np.loadtxt(out), ref, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_cli_snapshots_and_continued_training(workdir):
+    model = str(workdir["path"] / "model.txt")
+    cli.main(["task=train", "objective=binary",
+              f"data={workdir['train']}", "num_trees=8", "num_leaves=7",
+              "snapshot_freq=4", f"output_model={model}",
+              "verbosity=-1", "metric=binary_logloss"])
+    assert os.path.exists(f"{model}.snapshot_iter_4")
+    assert os.path.exists(f"{model}.snapshot_iter_8")
+
+    # continued training: 8 existing + 5 new trees
+    model2 = str(workdir["path"] / "model2.txt")
+    cli.main(["task=train", "objective=binary",
+              f"data={workdir['train']}", "num_trees=5", "num_leaves=7",
+              f"input_model={model}", f"output_model={model2}",
+              "verbosity=-1"])
+    from lightgbm_tpu.io.model_text import load_model_from_file
+    m2 = load_model_from_file(model2)
+    assert len(m2.models) == 13
+
+
+def test_cli_refit_task(workdir):
+    model = str(workdir["path"] / "model.txt")
+    cli.main(["task=train", "objective=binary",
+              f"data={workdir['train']}", "num_trees=6", "num_leaves=7",
+              f"output_model={model}", "verbosity=-1"])
+    refit_out = str(workdir["path"] / "refit_model.txt")
+    cli.main(["task=refit", f"data={workdir['test']}",
+              f"input_model={model}", f"output_model={refit_out}",
+              "refit_decay_rate=0.5", "verbosity=-1"])
+    from lightgbm_tpu.io.model_text import load_model_from_file
+    a = load_model_from_file(model)
+    b = load_model_from_file(refit_out)
+    assert len(a.models) == len(b.models)
+    changed = any(
+        not np.allclose(x.leaf_value, y.leaf_value)
+        for x, y in zip(a.models, b.models))
+    assert changed
+
+
+def test_continued_training_early_stopping_absolute_iterations(workdir):
+    # early stopping during continued training must record an ABSOLUTE
+    # best_iteration so predict()'s truncation keeps the init trees
+    import lightgbm_tpu as lgb
+    Xtr, ytr, Xte, yte = DATASETS["binary"]["make"]()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": "binary_logloss"}
+    base = lgb.train(params, lgb.Dataset(Xtr, label=ytr),
+                     num_boost_round=8, verbose_eval=False)
+    dv = lgb.Dataset(Xte, label=yte)
+    cont = lgb.train(params, lgb.Dataset(Xtr, label=ytr),
+                     num_boost_round=200, init_model=base,
+                     valid_sets=[dv], early_stopping_rounds=3,
+                     verbose_eval=False)
+    if cont.best_iteration > 0:
+        assert cont.best_iteration >= 8  # includes the init model
+        p = cont.predict(Xte)  # truncates at best_iteration
+        assert np.isfinite(p).all()
+        # never worse than the init model alone on the valid set
+        def ll(pred):
+            pred = np.clip(pred, 1e-9, 1 - 1e-9)
+            return -np.mean(yte * np.log(pred)
+                            + (1 - yte) * np.log(1 - pred))
+        assert ll(p) <= ll(base.predict(Xte)) + 1e-6
+
+
+def test_continued_training_improves_loss(workdir):
+    import lightgbm_tpu as lgb
+    Xtr, ytr, _, _ = DATASETS["binary"]["make"]()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": ""}
+    base = lgb.train(params, lgb.Dataset(Xtr, label=ytr),
+                     num_boost_round=5)
+    cont = lgb.train(params, lgb.Dataset(Xtr, label=ytr),
+                     num_boost_round=5, init_model=base)
+    assert cont.num_trees() == 10
+
+    def logloss(b):
+        p = np.clip(b.predict(Xtr), 1e-9, 1 - 1e-9)
+        return -np.mean(ytr * np.log(p) + (1 - ytr) * np.log(1 - p))
+
+    assert logloss(cont) < logloss(base)
+    # and equals a straight 10-round run's tree count
+    full = lgb.train(params, lgb.Dataset(Xtr, label=ytr),
+                     num_boost_round=10)
+    # continued trees should closely track the uninterrupted run
+    np.testing.assert_allclose(cont.predict(Xtr), full.predict(Xtr),
+                               rtol=1e-4, atol=1e-5)
