@@ -16,10 +16,12 @@ from .callback import (early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
+from .parallel.distributed import init_distributed
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
 __all__ = ["Dataset", "Booster", "LightGBMError", "Config",
            "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "record_evaluation",
            "reset_parameter",
-           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+           "init_distributed"]

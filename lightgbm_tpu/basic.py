@@ -165,11 +165,6 @@ class Dataset:
             cat_idx = _resolve_categorical(
                 self.categorical_feature, feature_name, data.shape[1])
 
-        # keep the parsed matrix only for FILE datasets (cheap handle
-        # for continued-training re-scoring; in-memory datasets keep
-        # self.data itself); free_raw_data drops both below
-        if isinstance(self.data, str):
-            self._raw_matrix = data
         ref_inner = self.reference._inner if self.reference is not None \
             else None
         self._inner = _InnerDataset.from_numpy(
@@ -180,7 +175,6 @@ class Dataset:
             categorical_features=cat_idx, reference=ref_inner)
         if self.free_raw_data:
             self.data = None
-            self._raw_matrix = None
         return self
 
     def _merged_params(self) -> Dict[str, Any]:

@@ -72,6 +72,9 @@ def run_train(params: Dict[str, str]) -> None:
     from .basic import Dataset
     from .config import Config
     cfg = Config.from_params(params)
+    if cfg.machines or cfg.machine_list_filename:
+        from .parallel.distributed import init_distributed
+        init_distributed(cfg)
     if not cfg.data:
         log_fatal("task=train requires data=<training file>")
     train_set = Dataset(cfg.data, params=dict(params))

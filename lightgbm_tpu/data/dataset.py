@@ -257,15 +257,22 @@ class Dataset:
             sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
         else:
             sample_idx = np.arange(n)
+        # distributed bin finding (dataset_loader.cpp:824-1001): with
+        # pre-partitioned shards the hosts agree on one global sample
+        sample = np.asarray(data[sample_idx], np.float64)
+        from ..parallel.distributed import maybe_gather_bin_sample
+        sample, n_global = maybe_gather_bin_sample(sample, config, n)
+        sample_cnt = sample.shape[0]
         cat_set = set(int(c) for c in categorical_features)
         # feature_pre_filter uses min_data_in_leaf scaled to the sample
+        # over the GLOBAL row count (dataset_loader.cpp scaling)
         filter_cnt = int(max(
-            config.min_data_in_leaf * sample_cnt / max(n, 1), 1)) \
+            config.min_data_in_leaf * sample_cnt / max(n_global, 1), 1)) \
             if config.feature_pre_filter else 0
 
         self.bin_mappers = []
         for j in range(num_features):
-            col = np.asarray(data[sample_idx, j], dtype=np.float64)
+            col = sample[:, j]
             # sample only non-trivial values like the sparse sampler:
             # zeros are implicit (counted via total_sample_cnt)
             nonzero = col[(np.abs(col) > kZeroThreshold) | np.isnan(col)]

@@ -98,13 +98,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster._train_data_name = train_data_name
 
     if init_models:
+        k = booster._gbdt.num_tree_per_iteration
+        src_k = getattr(src, "num_tree_per_iteration", 1)
+        if src_k != k or len(init_models) % k != 0:
+            raise LightGBMError(
+                f"init_model has {src_k} trees per iteration "
+                f"({len(init_models)} trees) but the new booster "
+                f"expects {k}; objective/num_class must match for "
+                "continued training")
+
         def _raw_add(ds: Dataset) -> np.ndarray:
-            X = getattr(ds, "_raw_matrix", None)
-            if X is None:
-                X = ds.data
+            from .basic import (_apply_pandas_categorical, _is_pandas_df,
+                                _to_matrix)
+            X = ds.data
             if isinstance(X, str):
-                from .data.file_loader import load_file
                 from .config import Config as _Cfg
+                from .data.file_loader import load_file
                 X = load_file(X, _Cfg.from_params(
                     ds._merged_params()))[0]
             if X is None:
@@ -113,10 +122,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     "feature matrix to seed scores; construct the "
                     "Dataset with free_raw_data=False and not via "
                     "subset()")
-            if hasattr(X, "to_numpy"):
-                X = X.to_numpy()
+            if _is_pandas_df(X):
+                # same category->code mapping the predict path applies
+                X = _apply_pandas_categorical(X, ds.pandas_categorical)
+            else:
+                X = _to_matrix(X)
             X = np.asarray(X, np.float64)
-            k = booster._gbdt.num_tree_per_iteration
             out = np.zeros((X.shape[0], k))
             for i, t in enumerate(init_models):
                 out[:, i % k] += t.predict(X)

@@ -1,0 +1,181 @@
+"""Multi-host runtime: the reference Network layer done the JAX way.
+
+Reference analog: ``Network::Init`` + the socket/MPI linkers
+(``src/network/network.cpp:45-58``, ``src/network/linkers_socket.cpp``)
+and the distributed bin-finding phase of dataset loading
+(``src/io/dataset_loader.cpp:824-1001``).
+
+On TPU pods the data plane is XLA collectives over ICI/DCN — no
+hand-rolled linkers. What remains host-side is:
+
+  * **process bootstrap** — ``init_distributed`` resolves the machine
+    list exactly like the reference (``machines=ip:port,ip:port,...``
+    or ``machine_list_filename`` with one ``ip port`` per line, local
+    rank found by matching a local interface address) and hands it to
+    ``jax.distributed.initialize`` (coordinator = first machine, DCN);
+  * **distributed bin finding** — with ``pre_partition=true`` every
+    host holds a different data shard, so bin boundaries must be agreed
+    globally: each host contributes its local sample and
+    ``gather_bin_sample`` allgathers them (the reference splits FEATURES
+    across machines and allgathers the resulting BinMappers
+    (dataset_loader.cpp:862-1001); gathering the bounded sample and
+    computing everywhere is collective-wise cheaper on DCN than the
+    mapper serialization round and yields identical mappers on every
+    host, which is the actual invariant).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_info, log_warning
+
+
+def parse_machines(config: Config) -> List[Tuple[str, int]]:
+    """Machine list resolution (Config::Set + network.cpp:45-58):
+    ``machine_list_filename`` (one ``ip port`` per line) takes
+    precedence; else ``machines`` as ``ip:port,ip:port,...``."""
+    out: List[Tuple[str, int]] = []
+    if config.machine_list_filename:
+        with open(config.machine_list_filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.replace(":", " ").split()
+                if len(parts) < 2:
+                    log_warning(f"Invalid machine list line: {line}")
+                    continue
+                out.append((parts[0], int(parts[1])))
+    elif config.machines:
+        for tok in config.machines.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            host, _, port = tok.partition(":")
+            out.append((host, int(port) if port
+                        else int(config.local_listen_port)))
+    return out
+
+
+def _local_addresses() -> set:
+    addrs = {"localhost", "127.0.0.1"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        addrs.add(socket.gethostbyname(hostname))
+    except OSError:
+        pass
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    return addrs
+
+
+def find_local_rank(machines: List[Tuple[str, int]],
+                    config: Config) -> int:
+    """The reference matches the local interface list against the
+    machine list (linkers_socket.cpp:75-108); env overrides
+    (LIGHTGBM_TPU_RANK / JAX_PROCESS_ID) win for containerized runs
+    where interface addresses are unreliable."""
+    for env in ("LIGHTGBM_TPU_RANK", "JAX_PROCESS_ID"):
+        if os.environ.get(env):
+            return int(os.environ[env])
+    local = _local_addresses()
+    port = int(config.local_listen_port)
+    candidates = [i for i, (host, p) in enumerate(machines)
+                  if host in local]
+    if len(candidates) == 1:
+        return candidates[0]
+    if len(candidates) > 1:
+        # same host multiple times: disambiguate by listen port
+        for i in candidates:
+            if machines[i][1] == port:
+                return i
+        return candidates[0]
+    log_fatal("Could not locate this host in the machine list; set "
+              "LIGHTGBM_TPU_RANK explicitly")
+
+
+def init_distributed(config: Config,
+                     process_id: Optional[int] = None) -> bool:
+    """Network::Init analog: bootstrap jax.distributed over DCN from
+    the reference's machine-list configuration. Returns True when a
+    multi-process runtime was initialized (idempotent)."""
+    import jax
+    machines = parse_machines(config)
+    if len(machines) < 2:
+        return False
+    # NOTE: never touch jax.process_count()/devices() here — any such
+    # call initializes the XLA backend, after which
+    # jax.distributed.initialize refuses to run
+    if jax.distributed.is_initialized():
+        return True  # already up
+    if process_id is None:
+        process_id = find_local_rank(machines, config)
+    coordinator = f"{machines[0][0]}:{machines[0][1]}"
+    log_info(f"Initializing distributed runtime: {len(machines)} "
+             f"processes, coordinator {coordinator}, rank {process_id}")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(machines),
+        process_id=process_id,
+        initialization_timeout=int(config.time_out) * 60)
+    return True
+
+
+# ----------------------------------------------------------------------
+def gather_bin_sample(sample: np.ndarray) -> np.ndarray:
+    """Allgather the per-host bin-finding samples so every host derives
+    IDENTICAL BinMappers (the invariant of dataset_loader.cpp:824-1001).
+    Identity in single-process runs. Handles unequal per-host sample
+    sizes by padding to the max and trimming with the gathered counts.
+    """
+    if not _multi_process():
+        return sample
+    from jax.experimental import multihost_utils
+    cnt = np.int64(sample.shape[0])
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([cnt]))).reshape(-1)
+    m = int(counts.max())
+    if m > sample.shape[0]:
+        pad = np.zeros((m - sample.shape[0], sample.shape[1]),
+                       sample.dtype)
+        sample = np.concatenate([sample, pad])
+    gathered = np.asarray(multihost_utils.process_allgather(sample))
+    parts = [gathered[p, :int(counts[p])]
+             for p in range(gathered.shape[0])]
+    return np.concatenate(parts)
+
+
+def maybe_gather_bin_sample(sample: np.ndarray, config: Config,
+                            num_data_local: int):
+    """Distributed bin finding applies when hosts hold different data
+    shards (pre_partition, config.h) in a multi-process runtime.
+    Returns ``(sample, num_data_global)`` — the global row count keeps
+    sample-proportional thresholds (feature_pre_filter) scaled the way
+    the reference scales them by the GLOBAL num_data."""
+    import jax
+    if not config.pre_partition or not _multi_process():
+        return sample, num_data_local
+    from jax.experimental import multihost_utils
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([np.int64(num_data_local)]))).reshape(-1)
+    return gather_bin_sample(sample), int(counts.sum())
+
+
+def _multi_process() -> bool:
+    import jax
+    try:
+        if not jax.distributed.is_initialized():
+            return False
+    except AttributeError:
+        pass
+    return jax.process_count() > 1

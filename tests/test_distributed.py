@@ -1,0 +1,146 @@
+"""Multi-host bootstrap + distributed bin finding
+(parallel/distributed.py; Network::Init and
+dataset_loader.cpp:824-1001 analogs)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import distributed as dist
+
+
+def test_parse_machines_string():
+    cfg = Config.from_params({"machines": "10.0.0.1:12400,10.0.0.2:12400,"
+                                          "10.0.0.3"})
+    m = dist.parse_machines(cfg)
+    assert m == [("10.0.0.1", 12400), ("10.0.0.2", 12400),
+                 ("10.0.0.3", 12400)]
+
+
+def test_parse_machines_file(tmp_path):
+    p = tmp_path / "mlist.txt"
+    p.write_text("10.1.0.1 12400\n10.1.0.2 12401\n\n10.1.0.3:12402\n")
+    cfg = Config.from_params({"machine_list_filename": str(p)})
+    m = dist.parse_machines(cfg)
+    assert m == [("10.1.0.1", 12400), ("10.1.0.2", 12401),
+                 ("10.1.0.3", 12402)]
+
+
+def test_find_local_rank_env_override(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_RANK", "2")
+    cfg = Config.from_params({})
+    assert dist.find_local_rank(
+        [("a", 1), ("b", 2), ("c", 3)], cfg) == 2
+
+
+def test_find_local_rank_by_address(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_RANK", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    cfg = Config.from_params({})
+    machines = [("10.9.9.9", 12400), ("127.0.0.1", 12400)]
+    assert dist.find_local_rank(machines, cfg) == 1
+
+
+def test_find_local_rank_port_disambiguation(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_RANK", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    cfg = Config.from_params({"local_listen_port": 12401})
+    machines = [("127.0.0.1", 12400), ("127.0.0.1", 12401)]
+    assert dist.find_local_rank(machines, cfg) == 1
+
+
+def test_init_distributed_wires_jax(monkeypatch):
+    calls = {}
+
+    class FakeDist:
+        @staticmethod
+        def is_initialized():
+            return False
+
+        @staticmethod
+        def initialize(coordinator_address, num_processes, process_id,
+                       initialization_timeout):
+            calls.update(addr=coordinator_address, n=num_processes,
+                         pid=process_id, timeout=initialization_timeout)
+
+    import jax
+    monkeypatch.setattr(jax, "distributed", FakeDist)
+    cfg = Config.from_params(
+        {"machines": "10.0.0.1:12400,127.0.0.1:12400", "time_out": 5})
+    assert dist.init_distributed(cfg) is True
+    assert calls == {"addr": "10.0.0.1:12400", "n": 2, "pid": 1,
+                     "timeout": 300}
+
+
+def test_init_distributed_single_machine_noop():
+    cfg = Config.from_params({"machines": "127.0.0.1:12400"})
+    assert dist.init_distributed(cfg) is False
+    assert dist.init_distributed(Config.from_params({})) is False
+
+
+def test_gather_bin_sample_single_process_identity():
+    x = np.random.RandomState(0).randn(50, 4)
+    np.testing.assert_array_equal(dist.gather_bin_sample(x), x)
+
+
+def test_gather_bin_sample_multi_process(monkeypatch):
+    """Emulate 2 hosts with unequal sample sizes via a fake
+    process_allgather; the merged sample must be the concatenation."""
+    import jax
+    rng = np.random.RandomState(1)
+    local = rng.randn(30, 3)
+    other = rng.randn(20, 3)
+
+    monkeypatch.setattr(dist, "_multi_process", lambda: True)
+
+    def fake_allgather(x):
+        x = np.asarray(x)
+        if x.ndim == 1:  # the counts gather
+            return np.stack([x, np.asarray([other.shape[0]])])
+        pad = np.zeros((x.shape[0] - other.shape[0], x.shape[1]))
+        return np.stack([x, np.concatenate([other, pad])])
+
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+    merged = dist.gather_bin_sample(local)
+    np.testing.assert_array_equal(
+        merged, np.concatenate([local, other]))
+
+
+def test_distributed_bins_match_pooled_bins(monkeypatch):
+    """Two pre-partitioned shards must derive the same BinMappers as a
+    single host holding all the data — via the sample gather."""
+    import jax
+    from lightgbm_tpu.data.dataset import Dataset as InnerDataset
+
+    rng = np.random.RandomState(3)
+    full = rng.randn(600, 5)
+    shard_a, shard_b = full[:300], full[300:]
+
+    cfg = Config.from_params({"objective": "regression",
+                              "pre_partition": True, "verbosity": -1})
+
+    # host A's view: gather returns the full pooled sample
+    monkeypatch.setattr(dist, "_multi_process", lambda: True)
+    from jax.experimental import multihost_utils
+
+    def fake_allgather(x):
+        x = np.asarray(x)
+        if x.ndim == 1:
+            return np.stack([x, np.asarray([shard_b.shape[0]])])
+        return np.stack([x, shard_b])
+
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+    ds_a = InnerDataset.from_numpy(shard_a, cfg,
+                                   label=np.zeros(300))
+
+    monkeypatch.setattr(dist, "_multi_process", lambda: False)
+    ds_full = InnerDataset.from_numpy(full, cfg, label=np.zeros(600))
+
+    for j in range(5):
+        ma = ds_a.feature_mapper(j)
+        mf = ds_full.feature_mapper(j)
+        np.testing.assert_allclose(ma.bin_upper_bound,
+                                   mf.bin_upper_bound)
