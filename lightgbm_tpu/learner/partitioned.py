@@ -34,9 +34,8 @@ import numpy as np
 from ..config import Config
 from ..data.dataset import Dataset
 from ..models.tree import Tree, TreeArrays
-from ..ops.hist_pallas import (build_matrix, combine_planes,
-                               extract_row_ids, histogram_segment_raw,
-                               pack_gh)
+from ..ops.hist_pallas import (build_matrix, extract_row_ids,
+                               histogram_segment, pack_gh)
 from ..ops.partition_pallas import bitset_to_lut, partition_segment
 from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
 from .serial import (GrowResult, NodeRandMixin,
@@ -138,10 +137,8 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     mat = pack_gh(mat, f, gp, hp, cp)
 
     def seg_hist(m, begin, count):
-        raw = histogram_segment_raw(m, begin, count, num_features=f,
-                                    num_bins=b, blk=HIST_BLK,
-                                    interpret=interpret)
-        return combine_planes(raw, f)
+        return histogram_segment(m, begin, count, b, f, blk=HIST_BLK,
+                                 interpret=interpret)
 
     inf = jnp.float32(jnp.inf)
     node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
