@@ -49,6 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 ALIGN = 8          # Mosaic offset granule for u8 2-D row slices
 GH_COLS = 13       # payload columns appended after the features
+RID_OFF = 9        # row-id bytes start at column F + RID_OFF
 
 
 def _round_up(x: int, m: int) -> int:
@@ -82,7 +83,7 @@ def build_matrix(binned, blk: int = 2048) -> jnp.ndarray:
     mat = mat.at[:n, :f].set(binned.astype(jnp.uint8))
     rid = jnp.arange(n, dtype=jnp.uint32)
     for k in range(4):
-        mat = mat.at[:n, f + 9 + k].set(
+        mat = mat.at[:n, f + RID_OFF + k].set(
             ((rid >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)).astype(
                 jnp.uint8))
     return mat
@@ -105,7 +106,8 @@ def pack_gh(mat, num_features: int, grad, hess, cnt) -> jnp.ndarray:
 def extract_row_ids(mat, num_features: int, n: int) -> jnp.ndarray:
     """Recover i32 row ids from the payload columns (rows [0, n))."""
     f = num_features
-    b = [mat[:n, f + 9 + k].astype(jnp.uint32) for k in range(4)]
+    b = [mat[:n, f + RID_OFF + k].astype(jnp.uint32)
+         for k in range(4)]
     return (b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)).astype(
         jnp.int32)
 

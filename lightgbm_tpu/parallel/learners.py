@@ -303,7 +303,132 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
         self._fn = functools.partial(sharded, self.binned)
 
 
-from ..learner.partitioned import PartitionedTreeLearner
+from ..learner.partitioned import (HIST_BLK, PartitionedLearnerBase,
+                                   PartitionedTreeLearner,
+                                   grow_partitioned)
+from ..ops.hist_pallas import RID_OFF, matrix_cols, matrix_rows
+
+
+class MeshPartitionedTreeLearner(PartitionedLearnerBase):
+    """Data- or voting-parallel learner on the SEGMENT KERNELS: each
+    shard keeps its row block physically partitioned by leaf (one
+    training matrix per device) and runs the partitioned grow loop
+    (learner/partitioned.py) with the parallel Comm hooks injected —
+    Pallas histogram/partition per shard, psum / voting collectives
+    across the mesh. This is the multi-chip TPU production path; the
+    einsum-based learners above remain the wide-bin / CPU fallbacks.
+
+    Reference analog: data_parallel_tree_learner.cpp (mode="data") and
+    voting_parallel_tree_learner.cpp (mode="voting") layered over the
+    GPU device path — a combination the reference never shipped.
+    """
+
+    def __init__(self, dataset: Dataset, config: Config,
+                 mesh: Optional[Mesh] = None, mode: str = "data",
+                 interpret: Optional[bool] = None):
+        from ..learner.comm import (make_data_parallel_comm,
+                                    make_voting_parallel_comm)
+        self._setup_partitioned(dataset, config, interpret)
+        self.mesh = mesh if mesh is not None else mesh_from_config(config)
+        d = self.num_shards = int(np.prod(list(self.mesh.shape.values())))
+        n = dataset.num_data
+        self._n_pad = _round_up(n, d)
+        self.n_local = self._n_pad // d
+
+        if mode == "voting":
+            _reject_bundled(dataset, "voting")
+            if self.forced_plan:
+                from ..utils.log import log_warning
+                log_warning("forcedsplits_filename is not supported by "
+                            "the voting-parallel learner; ignoring it")
+                self.forced_plan = ()
+            params_local = self.params._replace(
+                min_data_in_leaf=self.params.min_data_in_leaf / d,
+                min_sum_hessian_in_leaf=(
+                    self.params.min_sum_hessian_in_leaf / d))
+            self.comm = make_voting_parallel_comm(
+                AXIS, d, int(config.top_k), params_local)
+        else:
+            self.comm = make_data_parallel_comm(AXIS)
+        self.mode = mode
+
+        # one training matrix per shard, rows carrying GLOBAL ids
+        rows_local = matrix_rows(self.n_local, HIST_BLK)
+        cols = matrix_cols(self.num_groups)
+        mats = np.zeros((d, rows_local, cols), np.uint8)
+        binned = np.asarray(dataset.binned, np.uint8)
+        g0 = self.num_groups
+        for s in range(d):
+            lo = s * self.n_local
+            hi = min(lo + self.n_local, n)
+            if hi > lo:
+                mats[s, :hi - lo, :g0] = binned[lo:hi]
+            rid = (lo + np.arange(self.n_local)).astype(np.uint32)
+            for kk in range(4):
+                mats[s, :self.n_local, g0 + RID_OFF + kk] = \
+                    ((rid >> np.uint32(8 * kk)) & 0xFF).astype(np.uint8)
+        # device_put straight from numpy: shards transfer host->device
+        # individually, never materializing the full matrix in one HBM
+        sh = NamedSharding(self.mesh, P(AXIS, None, None))
+        self.mat = jax.device_put(mats, sh)
+        self.ws = jax.device_put(np.zeros_like(mats), sh)
+        self._build()
+
+    def _build(self):
+        n_local = self.n_local
+        n_pad = self._n_pad
+        comm = self.comm
+
+        def body(mat3, ws3, grad, hess, bag, fmask, rkey):
+            base = jax.lax.axis_index(AXIS) * n_local
+            mat_l, ws_l, tree, leaf_id = grow_partitioned(
+                mat3[0], ws3[0], grad, hess, bag, fmask, self.meta,
+                rand_key=rkey, params=self.params,
+                num_leaves=self.num_leaves, max_depth=self.max_depth,
+                num_bins_max=self.num_bins_max,
+                num_features=self.num_features,
+                num_groups=self.num_groups, n=n_local,
+                bundled=self.bundled, interpret=self.interpret,
+                extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
+                bynode_count=self.bynode_count,
+                forced_plan=self.forced_plan, comm=comm,
+                row_id_base=base, n_total=n_pad)
+            return mat_l[None], ws_l[None], tree, leaf_id
+
+        mapped = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(AXIS, None, None), P(AXIS, None, None),
+                      P(AXIS), P(AXIS), P(AXIS), P(), P()),
+            out_specs=(P(AXIS, None, None), P(AXIS, None, None),
+                       TreeArrays_spec(), P(AXIS)),
+            check_rep=False)
+        self._fn = jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train(self, grad, hess, bag_weight=None, feature_mask=None
+              ) -> GrowResult:
+        n = self.dataset.num_data
+        if bag_weight is None:
+            bag_weight = jnp.ones((n,), jnp.float32)
+        if feature_mask is None:
+            feature_mask = jnp.ones((self.num_features,), bool)
+        pad = self._n_pad - n
+        if pad:
+            grad = jnp.pad(grad, (0, pad))
+            hess = jnp.pad(hess, (0, pad))
+            bag_weight = jnp.pad(bag_weight, (0, pad))
+        rkey = self.next_tree_key()
+        if rkey is None:
+            rkey = jnp.zeros((2, 2), jnp.uint32)
+        self.mat, self.ws, tree, leaf_id = self._fn(
+            self.mat, self.ws, grad, hess, bag_weight, feature_mask,
+            rkey)
+        return GrowResult(tree=tree, leaf_id=leaf_id[:n])
+
+def TreeArrays_spec():
+    """Replicated out_spec for every TreeArrays field."""
+    from ..models.tree import TreeArrays
+    return TreeArrays(*([P()] * len(TreeArrays._fields)))
+
 
 _LEARNERS = {"serial": SerialTreeLearner,
              "partitioned": PartitionedTreeLearner,
@@ -316,19 +441,27 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
                         mesh: Optional[Mesh] = None,
                         hist_method: str = "auto"):
     """TreeLearner::CreateTreeLearner (src/treelearner/tree_learner.cpp:
-    13-38). device_type does not fork the implementation here — the same
-    XLA program serves CPU and TPU."""
+    13-38). On TPU the partitioned segment-kernel learners are the
+    production path (serial -> PartitionedTreeLearner; data/voting ->
+    MeshPartitionedTreeLearner); >256-bin datasets and CPU runs use the
+    XLA einsum learners."""
     cls = _LEARNERS.get(learner_type)
     if cls is None:
         raise ValueError(f"unknown tree_learner {learner_type}")
+    on_device = jax.default_backend() in ("tpu", "axon")
+    fits_u8 = int(dataset.num_bins_array().max(initial=2)) <= 256
     if cls is SerialTreeLearner:
         # on TPU the partitioned learner IS the serial algorithm, with
         # O(leaf rows) per-split cost (the production single-chip path);
         # it packs bins as uint8, so >256-bin datasets fall back
-        if jax.default_backend() in ("tpu", "axon") \
-                and int(dataset.num_bins_array().max(initial=2)) <= 256:
+        if on_device and fits_u8:
             return PartitionedTreeLearner(dataset, config)
         return SerialTreeLearner(dataset, config, hist_method=hist_method)
     if cls is PartitionedTreeLearner:
         return PartitionedTreeLearner(dataset, config)
+    if on_device and fits_u8 and learner_type in ("data", "voting") \
+            and not (learner_type == "voting"
+                     and dataset.feature_offset is not None):
+        return MeshPartitionedTreeLearner(dataset, config, mesh=mesh,
+                                          mode=learner_type)
     return cls(dataset, config, mesh=mesh, hist_method=hist_method)

@@ -166,3 +166,47 @@ def test_num_machines_limits_mesh():
     g, h = _grad_hess(y)
     tree = learner.to_host_tree(learner.train(g, h))
     assert tree.num_leaves > 1
+
+
+# ---------------------------------------------------------------------------
+# Mesh learners on the segment (Pallas) kernels, interpret mode on CPU
+def test_mesh_partitioned_data_matches_serial(setup):
+    from lightgbm_tpu.parallel.learners import MeshPartitionedTreeLearner
+    X, y, cfg, ds, g, h, ref, ref_tree = setup
+    learner = MeshPartitionedTreeLearner(ds, cfg, mode="data",
+                                         interpret=True)
+    res = learner.train(g, h)
+    tree = learner.to_host_tree(res)
+    _assert_same_tree(tree, ref_tree)
+    np.testing.assert_array_equal(np.asarray(res.leaf_id),
+                                  np.asarray(ref.leaf_id))
+    # matrices persist across trees: a second tree must still agree
+    res2 = learner.train(g, h)
+    _assert_same_tree(learner.to_host_tree(res2), ref_tree)
+
+
+def test_mesh_partitioned_voting_close_to_serial(setup):
+    from lightgbm_tpu.parallel.learners import MeshPartitionedTreeLearner
+    X, y, cfg, ds, g, h, ref, ref_tree = setup
+    cfg2 = Config.from_params({"objective": "binary", "num_leaves": 15,
+                               "top_k": 8, "verbosity": -1})
+    learner = MeshPartitionedTreeLearner(ds, cfg2, mode="voting",
+                                         interpret=True)
+    res = learner.train(g, h)
+    tree = learner.to_host_tree(res)
+    # voting is approximate: the root split (clear margin) must agree
+    assert tree.num_leaves == ref_tree.num_leaves
+    assert tree.split_feature_inner[0] == ref_tree.split_feature_inner[0]
+
+
+def test_mesh_partitioned_data_with_bagging(setup):
+    from lightgbm_tpu.parallel.learners import MeshPartitionedTreeLearner
+    X, y, cfg, ds, g, h, ref, ref_tree = setup
+    rng = np.random.RandomState(3)
+    bag = jnp.asarray((rng.rand(len(y)) < 0.7).astype(np.float32))
+    serial = SerialTreeLearner(ds, cfg)
+    rs = serial.train(g, h, bag_weight=bag)
+    learner = MeshPartitionedTreeLearner(ds, cfg, mode="data",
+                                         interpret=True)
+    rp = learner.train(g, h, bag_weight=bag)
+    _assert_same_tree(learner.to_host_tree(rp), serial.to_host_tree(rs))
