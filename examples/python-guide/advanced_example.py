@@ -1,0 +1,44 @@
+"""Advanced features: categorical splits, continued training, SHAP,
+ranking. Run: python examples/python-guide/advanced_example.py
+"""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(7)
+n = 6000
+cat = rng.randint(0, 12, n)
+X = np.column_stack([cat.astype(float), rng.randn(n, 6)])
+y = (np.isin(cat, [2, 5, 9]).astype(float) + 0.5 * X[:, 1]
+     + 0.2 * rng.randn(n) > 0.5).astype(np.float32)
+
+train = lgb.Dataset(X[:5000], label=y[:5000], categorical_feature=[0])
+params = {"objective": "binary", "num_leaves": 31, "verbosity": -1}
+
+# stage 1 + continued training (init_model)
+b1 = lgb.train(params, train, num_boost_round=20)
+b1.save_model("stage1.txt")
+b2 = lgb.train(params, train, num_boost_round=20, init_model="stage1.txt")
+print(f"trees after continued training: {b2.num_trees()}")
+
+# SHAP contributions sum to the raw prediction
+contrib = b2.predict(X[5000:5010], pred_contrib=True)
+raw = b2.predict(X[5000:5010], raw_score=True)
+assert np.allclose(contrib.sum(axis=1), raw, atol=1e-4)
+print("SHAP rows sum to raw predictions")
+
+# leaf indices for stacking / refit
+leaves = b2.predict(X[5000:5100], pred_leaf=True)
+print(f"pred_leaf shape: {leaves.shape}")
+
+# lambdarank on grouped data
+q = np.repeat(np.arange(100), 10)   # 100 queries x 10 docs
+Xr = rng.randn(1000, 5)
+rel = (2 * Xr[:, 0] + rng.randn(1000) > 1).astype(np.float32)
+rank_train = lgb.Dataset(Xr, label=rel, group=np.bincount(q))
+rk = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                "ndcg_eval_at": [5], "num_leaves": 15, "verbosity": -1},
+               rank_train, num_boost_round=20,
+               valid_sets=[rank_train], valid_names=["train"])
+print("lambdarank trained; ndcg@5 recorded")

@@ -1,0 +1,29 @@
+"""scikit-learn API: estimators, early stopping, grid search.
+
+Run: python examples/python-guide/sklearn_example.py
+"""
+
+import numpy as np
+from sklearn.model_selection import GridSearchCV, train_test_split
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(5000, 15)
+y = 3.0 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3] \
+    + 0.3 * rng.randn(5000)
+X_train, X_test, y_train, y_test = train_test_split(
+    X, y, test_size=0.2, random_state=42)
+
+reg = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.1,
+                        n_estimators=60, verbosity=-1)
+reg.fit(X_train, y_train, eval_set=[(X_test, y_test)], eval_metric="l2",
+        early_stopping_rounds=8, verbose=False)
+print(f"best_iteration_: {reg.best_iteration_}")
+print(f"R^2 on test: {reg.score(X_test, y_test):.4f}")
+
+grid = GridSearchCV(
+    lgb.LGBMRegressor(n_estimators=20, verbosity=-1),
+    {"num_leaves": [15, 31], "learning_rate": [0.05, 0.1]}, cv=3)
+grid.fit(X_train, y_train)
+print(f"best params: {grid.best_params_}")

@@ -19,9 +19,18 @@ from .engine import CVBooster, cv, train
 from .parallel.distributed import init_distributed
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
+try:  # plotting needs matplotlib (reference: python-package __init__.py)
+    from .plotting import (create_tree_digraph, plot_importance,
+                           plot_metric, plot_split_value_histogram,
+                           plot_tree)
+    _PLOT = ["plot_importance", "plot_split_value_histogram",
+             "plot_metric", "plot_tree", "create_tree_digraph"]
+except ImportError:  # pragma: no cover
+    _PLOT = []
+
 __all__ = ["Dataset", "Booster", "LightGBMError", "Config",
            "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "record_evaluation",
            "reset_parameter",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
-           "init_distributed"]
+           "init_distributed"] + _PLOT

@@ -280,6 +280,7 @@ def _parse_tree_block(lines: Dict[str, str]) -> Tree:
     tree.shrinkage = float(lines.get("shrinkage", "1"))
     tree.leaf_parent = np.full(n, -1, np.int32)
     tree.leaf_depth = np.zeros(n, np.int32)
+    tree.ensure_leaf_depth()  # text format carries neither depth nor parent
     tree._missing_code = np.asarray(
         [_missing_code_from_bits(int(d)) for d in tree.decision_type],
         np.int32)

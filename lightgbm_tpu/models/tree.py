@@ -288,6 +288,25 @@ class Tree:
     def leaf_depth_of(self, leaf: int) -> int:
         return int(self.leaf_depth[leaf])
 
+    def ensure_leaf_depth(self) -> None:
+        """Reconstruct ``leaf_depth``/``leaf_parent`` from the child
+        arrays when the source didn't carry them (the model text format
+        doesn't; TreeSHAP sizes its path arena from depth). Children
+        always have a larger node index than their parent (creation
+        order), so one forward pass suffices."""
+        if self.num_leaves <= 1 or self.leaf_depth.max(initial=0) > 0:
+            return
+        nodes = len(self.left_child)
+        node_depth = np.zeros(nodes, np.int32)
+        for s in range(nodes):
+            for child in (int(self.left_child[s]),
+                          int(self.right_child[s])):
+                if child >= 0:
+                    node_depth[child] = node_depth[s] + 1
+                else:
+                    self.leaf_depth[~child] = node_depth[s] + 1
+                    self.leaf_parent[~child] = s
+
     def num_nodes(self) -> int:
         return max(self.num_leaves - 1, 0)
 

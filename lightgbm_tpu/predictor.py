@@ -196,6 +196,7 @@ def _predict_contrib(models, data: np.ndarray, k: int) -> np.ndarray:
         cls = i % k
         out[:, cls, f] += _expected_value(tree)
         if tree.num_leaves > 1:
+            tree.ensure_leaf_depth()  # arena sizing needs real depths
             for row in range(n):
                 _tree_shap(tree, data[row], out[row, cls])
     return out.reshape(n, k * (f + 1)) if k > 1 else out[:, 0, :]

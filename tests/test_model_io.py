@@ -149,3 +149,25 @@ def test_model_file_roundtrip(tmp_path):
     loaded = load_model_from_file(path)
     np.testing.assert_allclose(loaded.predict_raw(X)[:, 0],
                                booster.predict_raw(X), rtol=1e-9)
+
+
+def test_loaded_model_shap_deep_tree(tmp_path):
+    """Text-loaded trees must reconstruct leaf_depth: TreeSHAP sizes
+    its path arena from it (regression: undersized arena crashed
+    pred_contrib on any reloaded model deeper than 1)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(5)
+    X = rng.randn(600, 6)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] > 0).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=5)
+    path = tmp_path / "m.txt"
+    b.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    contrib = loaded.predict(X[:20], pred_contrib=True)
+    raw = loaded.predict(X[:20], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-4)
+    # depths reconstructed, not zero
+    t = loaded._loaded.models[0]
+    assert t.leaf_depth.max() >= 2

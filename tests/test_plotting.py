@@ -1,0 +1,99 @@
+"""Plotting tests (Agg backend; reference test_plotting.py strategy:
+assert axes content, not pixels)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 7)
+    X[:, 6] = 1.0  # constant: never split on (pre-filtered)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.randn(500) > 0).astype(float)
+    train = lgb.Dataset(X[:400], label=y[:400],
+                        feature_name=[f"f{i}" for i in range(7)])
+    valid = train.create_valid(X[400:], label=y[400:])
+    evals = {}
+    booster = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "metric": "binary_logloss",
+         "verbosity": -1}, train, num_boost_round=8,
+        valid_sets=[train, valid], valid_names=["train", "valid"],
+        evals_result=evals, verbose_eval=False)
+    return booster, evals
+
+
+def test_plot_importance(trained):
+    booster, _ = trained
+    ax = lgb.plot_importance(booster)
+    assert ax.get_title() == "Feature importance"
+    assert len(ax.patches) > 0
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert "f0" in labels
+    ax2 = lgb.plot_importance(booster, importance_type="gain",
+                              max_num_features=2, title="G")
+    assert ax2.get_title() == "G"
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_split_value_histogram(trained):
+    booster, _ = trained
+    ax = lgb.plot_split_value_histogram(booster, "f0")
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_split_value_histogram(booster, 0)
+    assert len(ax2.patches) > 0
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        # a feature never split on
+        lgb.plot_split_value_histogram(booster, "f6")
+
+
+def test_plot_metric(trained):
+    booster, evals = trained
+    ax = lgb.plot_metric(evals)
+    assert len(ax.lines) == 2  # train + valid curves
+    assert ax.get_ylabel() == "binary_logloss"
+    clf = lgb.LGBMClassifier(n_estimators=3, num_leaves=5, verbosity=-1)
+    rng = np.random.RandomState(2)
+    Xs = rng.randn(300, 4); ys = (Xs[:, 0] > 0).astype(int)
+    clf.fit(Xs, ys, eval_set=[(Xs, ys)], eval_metric="binary_logloss",
+            verbose=False)
+    ax2 = lgb.plot_metric(clf)
+    assert len(ax2.lines) >= 1
+
+
+def test_create_tree_digraph(trained):
+    booster, _ = trained
+    g = lgb.create_tree_digraph(booster, tree_index=1,
+                                show_info=["split_gain", "leaf_count"])
+    src = g.source
+    assert "yes" in src and "no" in src
+    assert "leaf" in src
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.create_tree_digraph(booster, tree_index=99)
+
+
+def test_plot_tree(trained):
+    booster, _ = trained
+    try:
+        ax = lgb.plot_tree(booster, tree_index=0)
+    except Exception as e:  # graphviz binary missing in some images
+        pytest.skip(f"graphviz render unavailable: {e}")
+    assert len(ax.images) == 1
+
+
+def test_sklearn_wrapper_accepted(trained):
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=3, num_leaves=5, verbosity=-1)
+    clf.fit(X, y)
+    ax = lgb.plot_importance(clf)
+    assert len(ax.patches) > 0
