@@ -352,9 +352,11 @@ class Config:
     # params that are accepted but NOT implemented yet: setting a
     # non-default value warns loudly instead of silently ignoring.
     # Structurally-meaningless-on-TPU params (num_threads,
-    # force_col_wise/row_wise, histogram_pool_size, is_enable_sparse,
-    # pre_partition, two_round, gpu_*) are accepted silently for config
-    # compatibility — XLA owns threading/layout/memory.
+    # force_col_wise/row_wise, is_enable_sparse, pre_partition,
+    # two_round, gpu_*) are accepted silently for config compatibility
+    # — XLA owns threading/layout/memory. histogram_pool_size IS
+    # honored: when the per-leaf histogram cache would exceed it, the
+    # grow loops run pool-bounded (learner/serial.py:use_hist_cache).
 
     @classmethod
     def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":

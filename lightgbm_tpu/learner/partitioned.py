@@ -81,6 +81,9 @@ class PartitionedLearnerBase(NodeRandMixin):
         if interpret is None:
             interpret = jax.default_backend() not in ("tpu", "axon")
         self.interpret = interpret
+        from .serial import use_hist_cache
+        self.cache_hists = use_hist_cache(
+            config, self.num_leaves, self.num_groups, self.num_bins_max)
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -116,7 +119,7 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             n=self.num_data, bundled=self.bundled,
             interpret=self.interpret, extra_trees=self.extra_trees,
             ff_bynode=self.ff_bynode, bynode_count=self.bynode_count,
-            forced_plan=self.forced_plan)
+            forced_plan=self.forced_plan, cache_hists=self.cache_hists)
         return GrowResult(tree=tree, leaf_id=leaf_id)
 
 
@@ -125,13 +128,13 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
                               "num_bins_max", "num_features",
                               "num_groups", "n", "bundled", "interpret",
                               "extra_trees", "ff_bynode", "bynode_count",
-                              "forced_plan"),
+                              "forced_plan", "cache_hists"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       rand_key=None, *, params, num_leaves, max_depth,
                       num_bins_max, num_features, num_groups, n, bundled,
                       interpret, extra_trees=False, ff_bynode=1.0,
-                      bynode_count=2, forced_plan=()):
+                      bynode_count=2, forced_plan=(), cache_hists=True):
     return grow_partitioned(
         mat, ws, grad, hess, bag_weight, feature_mask, meta,
         rand_key=rand_key, params=params, num_leaves=num_leaves,
@@ -139,7 +142,7 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         num_features=num_features, num_groups=num_groups, n=n,
         bundled=bundled, interpret=interpret, extra_trees=extra_trees,
         ff_bynode=ff_bynode, bynode_count=bynode_count,
-        forced_plan=forced_plan)
+        forced_plan=forced_plan, cache_hists=cache_hists)
 
 
 def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
@@ -147,7 +150,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      num_bins_max, num_features, num_groups, n, bundled,
                      interpret, extra_trees=False, ff_bynode=1.0,
                      bynode_count=2, forced_plan=(), comm=None,
-                     row_id_base=0, n_total=None):
+                     row_id_base=0, n_total=None, cache_hists=True):
     """Traceable partitioned grow loop.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py)
@@ -224,7 +227,6 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         mat=mat, ws=ws,
         leaf_begin=jnp.zeros((big_l,), jnp.int32),
         leaf_cnt=at0(jnp.zeros((big_l,), jnp.int32), jnp.int32(n)),
-        hist=at0(jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist),
         leaf_g=at0(jnp.zeros((big_l,), jnp.float32), root_g),
         leaf_h=at0(jnp.zeros((big_l,), jnp.float32), root_h),
         leaf_c=at0(jnp.zeros((big_l,), jnp.float32), root_c),
@@ -262,8 +264,17 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         leaf_parent=jnp.full((big_l,), -1, jnp.int32),
         leaf_depth=jnp.zeros((big_l,), jnp.int32),
     )
+    if cache_hists:
+        state["hist"] = at0(
+            jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
 
     leaf_range = jnp.arange(big_l)
+
+    def leaf_hist_seg(st, leaf):
+        """Pool-bounded mode: rebuild one leaf's histogram from its
+        contiguous segment on demand."""
+        return seg_hist(st["mat"], st["leaf_begin"][leaf],
+                        st["leaf_cnt"][leaf])
 
     def cond(st):
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
@@ -271,7 +282,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
 
     kEps = 1e-15
 
-    def body(st, forced=None):
+    def body(st, forced=None, forced_hist=None):
         k = st["k"]
         new = k
         s = k - 1
@@ -293,9 +304,13 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
             lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
         else:
+            fh = forced_hist if forced_hist is not None \
+                else st["hist"][forced[0]] if cache_hists \
+                else leaf_hist_seg(st, forced[0])
             (leaf, feat, thr, dleft, gain, is_cat, bitset,
              lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
-                forced_split_override(st, forced, params, meta, bundled)
+                forced_split_override(fh, st, forced, params, meta,
+                                      bundled)
 
         begin = st["leaf_begin"][leaf]
         cnt = st["leaf_cnt"][leaf]
@@ -338,14 +353,19 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         # which side is "smaller" must be decided from the GLOBAL
         # (reduced) counts so every shard streams the same side of its
         # local segment and the reduced histograms stay consistent
-        parent_hist = st["hist"][leaf]
-        left_small = lc <= rc
-        sb = jnp.where(left_small, begin, begin + nl)
-        sc = jnp.where(left_small, nl, nr)
-        hist_small = seg_hist(mat2, sb, sc)
-        hist_other = parent_hist - hist_small
-        hist_left = jnp.where(left_small, hist_small, hist_other)
-        hist_right = jnp.where(left_small, hist_other, hist_small)
+        # (pool-bounded mode: no parent cache -> build both directly)
+        if cache_hists:
+            parent_hist = st["hist"][leaf]
+            left_small = lc <= rc
+            sb = jnp.where(left_small, begin, begin + nl)
+            sc = jnp.where(left_small, nl, nr)
+            hist_small = seg_hist(mat2, sb, sc)
+            hist_other = parent_hist - hist_small
+            hist_left = jnp.where(left_small, hist_small, hist_other)
+            hist_right = jnp.where(left_small, hist_other, hist_small)
+        else:
+            hist_left = seg_hist(mat2, begin, nl)
+            hist_right = seg_hist(mat2, begin + nl, nr)
 
         # ---- tree arrays (same bookkeeping as learner/serial.py) -----
         dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
@@ -387,12 +407,14 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             return arr.at[leaf].set(va).at[new].set(vb)
 
         st2 = dict(st)
+        if cache_hists:
+            st2["hist"] = st["hist"].at[leaf].set(hist_left) \
+                .at[new].set(hist_right)
         st2.update(
             k=k + 1,
             mat=mat2, ws=ws2,
             leaf_begin=set2(st["leaf_begin"], begin, begin + nl),
             leaf_cnt=set2(st["leaf_cnt"], nl, nr),
-            hist=st["hist"].at[leaf].set(hist_left).at[new].set(hist_right),
             leaf_g=set2(st["leaf_g"], lg, rg),
             leaf_h=set2(st["leaf_h"], lh, rh),
             leaf_c=set2(st["leaf_c"], lc, rc),
@@ -439,13 +461,15 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        lg_f, lh_f, _ = forced_left_sums(st, step, meta, bundled)
+        fh0 = st["hist"][step[0]] if cache_hists \
+            else leaf_hist_seg(st, step[0])
+        lg_f, lh_f, _ = forced_left_sums(fh0, st, step, meta, bundled)
         ph_f = st["leaf_h"][step[0]]
         force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
             & (st["k"] < big_l)
         st = jax.lax.cond(
             force_ok,
-            functools.partial(body, forced=step),
+            functools.partial(body, forced=step, forced_hist=fh0),
             lambda s: s, st)
 
     st = jax.lax.while_loop(cond, body, st)

@@ -152,13 +152,13 @@ def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
     return tuple(plan)
 
 
-def forced_left_sums(st, forced, meta_scan, bundled: bool):
-    """Left sums of a STATIC forced split read off the leaf's cached
-    histogram — the GatherInfoForThreshold analog. Missing bins are
+def forced_left_sums(hist_leaf, st, forced, meta_scan, bundled: bool):
+    """Left sums of a STATIC forced split read off the leaf's
+    histogram (``hist_leaf`` — cached or rebuilt on demand in pool-
+    bounded mode) — the GatherInfoForThreshold analog. Missing bins are
     routed exactly like the partition routes the rows: NaN bin
     (num_bin-1) by default_left, zero-missing default bin right."""
     fleaf, ffeat, fthr, fdleft, fmiss, fdbin, fnbin = forced
-    hist_leaf = st["hist"][fleaf]
     if bundled:
         from ..ops.histogram import debundle_hist
         pg0, ph0, pc0 = (st["leaf_g"][fleaf], st["leaf_h"][fleaf],
@@ -174,8 +174,8 @@ def forced_left_sums(st, forced, meta_scan, bundled: bool):
     return cum[0], cum[1], cum[2]
 
 
-def forced_split_override(st, forced, params: SplitParams, meta_scan,
-                          bundled: bool):
+def forced_split_override(hist_leaf, st, forced, params: SplitParams,
+                          meta_scan, bundled: bool):
     """All split-site quantities of a static forced split, shared by
     the serial and partitioned grow bodies: returns
     (leaf, feat, thr, dleft, gain, is_cat, bitset,
@@ -189,7 +189,8 @@ def forced_split_override(st, forced, params: SplitParams, meta_scan,
     dleft = jnp.bool_(fdleft)
     is_cat = jnp.bool_(False)
     bitset = jnp.zeros((MAX_CAT_WORDS,), jnp.uint32)
-    lg, lh, lc = forced_left_sums(st, forced, meta_scan, bundled)
+    lg, lh, lc = forced_left_sums(hist_leaf, st, forced, meta_scan,
+                                  bundled)
     pg, ph, pc = (st["leaf_g"][leaf], st["leaf_h"][leaf],
                   st["leaf_c"][leaf])
     rg, rh, rc = pg - lg, ph - lh, pc - lc
@@ -210,6 +211,19 @@ def forced_split_override(st, forced, params: SplitParams, meta_scan,
             - shift - params.min_gain_to_split)
     return (leaf, feat, thr, dleft, gain, is_cat, bitset,
             lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout)
+
+
+def use_hist_cache(config: Config, num_leaves: int, f: int,
+                   b: int) -> bool:
+    """histogram_pool_size (MB) semantics (config.h:244, HistogramPool
+    serial_tree_learner.cpp:313-353): cache per-leaf histograms only if
+    the full [num_leaves, F, B, 3] f32 cache fits the budget; otherwise
+    the grow loops run pool-bounded (rebuild both children per split).
+    <= 0 means unlimited, like the reference default."""
+    pool = float(config.histogram_pool_size)
+    if pool <= 0:
+        return True
+    return num_leaves * f * b * 3 * 4 <= pool * 1024 * 1024
 
 
 def split_params_from_config(config: Config) -> SplitParams:
@@ -347,6 +361,9 @@ class SerialTreeLearner(NodeRandMixin):
         self.num_leaves = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         self.hist_method = hist_method
+        self.cache_hists = use_hist_cache(
+            config, self.num_leaves, self.binned.shape[1],
+            self.num_bins_max)
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_weight: Optional[jnp.ndarray] = None,
@@ -368,7 +385,8 @@ class SerialTreeLearner(NodeRandMixin):
                          extra_trees=self.extra_trees,
                          ff_bynode=self.ff_bynode,
                          bynode_count=self.bynode_count,
-                         forced_plan=self.forced_plan)
+                         forced_plan=self.forced_plan,
+                         cache_hists=self.cache_hists)
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -382,19 +400,19 @@ class SerialTreeLearner(NodeRandMixin):
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "hist_method", "bundled",
                               "extra_trees", "ff_bynode", "bynode_count",
-                              "forced_plan"))
+                              "forced_plan", "cache_hists"))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
               rand_key=None, *, params, num_leaves, max_depth,
               num_bins_max, hist_method, bundled=False,
               extra_trees=False, ff_bynode=1.0, bynode_count=2,
-              forced_plan=()):
+              forced_plan=(), cache_hists=True):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
                      hist_method=hist_method, bundled=bundled,
                      rand_key=rand_key, extra_trees=extra_trees,
                      ff_bynode=ff_bynode, bynode_count=bynode_count,
-                     forced_plan=forced_plan)
+                     forced_plan=forced_plan, cache_hists=cache_hists)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -404,13 +422,22 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               bundled: bool = False, rand_key=None,
               extra_trees: bool = False, ff_bynode: float = 1.0,
               bynode_count=2, bynode_cap: int | None = None,
-              forced_plan: tuple = ()) -> GrowResult:
+              forced_plan: tuple = (), cache_hists: bool = True
+              ) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
     ``binned_hist``/``meta_hist`` override the histogram-build inputs for
     feature-parallel mode (feature-sharded) while ``binned``/``meta``
     stay global for row partitioning and the tree arrays.
+
+    ``cache_hists=False`` is the pool-bounded mode (the reference's
+    ``histogram_pool_size`` LRU, serial_tree_learner.cpp:313-353,
+    taken to its TPU-shaped limit): no [num_leaves, F, B, 3] HBM cache
+    — each split rebuilds BOTH children's histograms directly instead
+    of deriving the sibling by subtraction. Costs one extra histogram
+    pass per split, bounds grow-loop HBM by O(F*B) regardless of
+    num_leaves.
     """
     if comm is None:
         from .comm import SERIAL_COMM
@@ -465,8 +492,6 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     state = dict(
         k=jnp.int32(1),
         leaf_id=jnp.zeros((n,), jnp.int32),
-        hist=at0(jnp.zeros((big_l, num_features_hist, b, 3), jnp.float32),
-                 root_hist),
         leaf_g=at0(jnp.zeros((big_l,), jnp.float32), root_g),
         leaf_h=at0(jnp.zeros((big_l,), jnp.float32), root_h),
         leaf_c=at0(jnp.zeros((big_l,), jnp.float32), root_c),
@@ -509,14 +534,25 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         leaf_parent=jnp.full((big_l,), -1, jnp.int32),
         leaf_depth=jnp.zeros((big_l,), jnp.int32),
     )
+    if cache_hists:
+        state["hist"] = at0(
+            jnp.zeros((big_l, num_features_hist, b, 3), jnp.float32),
+            root_hist)
 
     leaf_range = jnp.arange(big_l)
+
+    def leaf_hist_masked(st, leaf):
+        """Pool-bounded mode: rebuild one leaf's histogram on demand."""
+        ghc_leaf = ghc * (st["leaf_id"] == leaf).astype(
+            jnp.float32)[:, None]
+        return comm.reduce_hist(
+            build_histogram(binned_hist, ghc_leaf, b, method=hist_method))
 
     def cond(st):
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
         return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
 
-    def body(st, forced=None):
+    def body(st, forced=None, forced_hist=None):
         k = st["k"]
         new = k
         s = k - 1  # internal node index for this split
@@ -538,9 +574,12 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
             lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
         else:
+            fh = forced_hist if forced_hist is not None \
+                else st["hist"][forced[0]] if cache_hists \
+                else leaf_hist_masked(st, forced[0])
             (leaf, feat, thr, dleft, gain, is_cat, bitset,
              lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
-                forced_split_override(st, forced, params, meta_hist,
+                forced_split_override(fh, st, forced, params, meta_hist,
                                       bundled)
 
         # ---- partition rows of `leaf` ---------------------------------
@@ -572,16 +611,23 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
             params.max_delta_step)
 
-        # ---- histograms: smaller child built, sibling by subtraction --
-        parent_hist = st["hist"][leaf]
-        small = jnp.where(lc <= rc, leaf, new)
-        ghc_small = ghc * (leaf_id == small).astype(jnp.float32)[:, None]
-        hist_small = comm.reduce_hist(
-            build_histogram(binned_hist, ghc_small, b, method=hist_method))
-        hist_other = parent_hist - hist_small
-        left_small = lc <= rc
-        hist_left = jnp.where(left_small, hist_small, hist_other)
-        hist_right = jnp.where(left_small, hist_other, hist_small)
+        # ---- histograms: smaller child built, sibling by subtraction
+        # (pool-bounded mode: no parent cache -> build both directly) --
+        if cache_hists:
+            parent_hist = st["hist"][leaf]
+            small = jnp.where(lc <= rc, leaf, new)
+            ghc_small = ghc * (leaf_id == small).astype(
+                jnp.float32)[:, None]
+            hist_small = comm.reduce_hist(build_histogram(
+                binned_hist, ghc_small, b, method=hist_method))
+            hist_other = parent_hist - hist_small
+            left_small = lc <= rc
+            hist_left = jnp.where(left_small, hist_small, hist_other)
+            hist_right = jnp.where(left_small, hist_other, hist_small)
+        else:
+            st_after = dict(st, leaf_id=leaf_id)
+            hist_left = leaf_hist_masked(st_after, leaf)
+            hist_right = leaf_hist_masked(st_after, new)
 
         # ---- monotone constraint propagation -------------------------
         # (LeafConstraints::UpdateConstraints monotone_constraints.hpp:44)
@@ -608,10 +654,12 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             return arr.at[leaf].set(va).at[new].set(vb)
 
         st2 = dict(st)
+        if cache_hists:
+            st2["hist"] = st["hist"].at[leaf].set(hist_left) \
+                .at[new].set(hist_right)
         st2.update(
             k=k + 1,
             leaf_id=leaf_id,
-            hist=st["hist"].at[leaf].set(hist_left).at[new].set(hist_right),
             leaf_g=set2(st["leaf_g"], lg, rg),
             leaf_h=set2(st["leaf_h"], lh, rh),
             leaf_c=set2(st["leaf_c"], lc, rc),
@@ -658,13 +706,15 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        lg_f, lh_f, _ = forced_left_sums(st, step, meta_hist, bundled)
+        fh0 = st["hist"][step[0]] if cache_hists \
+            else leaf_hist_masked(st, step[0])
+        lg_f, lh_f, _ = forced_left_sums(fh0, st, step, meta_hist, bundled)
         ph_f = st["leaf_h"][step[0]]
         force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
             & (st["k"] < big_l)
         st = jax.lax.cond(
             force_ok,
-            functools.partial(body, forced=step),
+            functools.partial(body, forced=step, forced_hist=fh0),
             lambda s: s, st)
 
     st = jax.lax.while_loop(cond, body, st)

@@ -25,7 +25,8 @@ from ..config import Config
 from ..data.dataset import Dataset
 from ..metric import create_metrics
 from ..objective import create_objective
-from ..utils.log import log_fatal, log_info, log_warning
+from ..utils.log import (annotate, global_timer, log_fatal, log_info,
+                         log_warning, maybe_profile)
 from .tree import DeferredTree, Tree, traverse_tree_arrays
 
 kEpsilon = 1e-15
@@ -511,7 +512,16 @@ class GBDT:
         self.iter -= n_iters
 
     def train(self, num_iterations: Optional[int] = None) -> None:
-        """Full training loop (GBDT::Train, gbdt.cpp:245-264)."""
+        """Full training loop (GBDT::Train, gbdt.cpp:245-264).
+
+        Profiling: set ``LGBM_TPU_PROFILE_DIR`` to capture an xprof
+        device trace of the whole loop (phases named via
+        TraceAnnotation) plus host-side Timer totals (the reference's
+        -DTIMETAG global_timer analog, utils/log.py)."""
+        with maybe_profile():
+            self._train_impl(num_iterations)
+
+    def _train_impl(self, num_iterations: Optional[int] = None) -> None:
         iters = num_iterations if num_iterations is not None \
             else self.config.num_iterations
         use_async = self._async_supported()
@@ -534,9 +544,11 @@ class GBDT:
         stopped = False
         for it in range(self.iter, iters):
             if use_async and self.models:
-                pending.append(self._train_one_iter_async())
+                with global_timer.scope("boosting"), annotate("boost_iter"):
+                    pending.append(self._train_one_iter_async())
                 if len(pending) >= flush_every or it == iters - 1:
-                    flags = [bool(v) for v in jax.device_get(pending)]
+                    with global_timer.scope("device_sync"):
+                        flags = [bool(v) for v in jax.device_get(pending)]
                     pending.clear()
                     if not all(flags):
                         self._truncate_surplus(
@@ -550,10 +562,14 @@ class GBDT:
             else:
                 # first iteration (boost-from-average, constant-tree
                 # fallback) and non-async boosters take the sync path
-                if self.train_one_iter():
+                with global_timer.scope("boosting"), annotate("boost_iter"):
+                    if self.train_one_iter():
+                        break
+            if has_eval:
+                with global_timer.scope("eval"), annotate("eval"):
+                    stop_early = self._eval_and_check_early_stopping()
+                if stop_early:
                     break
-            if has_eval and self._eval_and_check_early_stopping():
-                break
         if pending:
             flags = [bool(v) for v in jax.device_get(pending)]
             if not all(flags):

@@ -164,7 +164,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                 bundled=self.bundled, rand_key=rkey,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=self.bynode_count,
-                forced_plan=self.forced_plan)  # hist cache is psum'ed
+                forced_plan=self.forced_plan,  # hist cache is psum'ed
+                cache_hists=self.cache_hists)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -231,7 +232,8 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 hist_method=self.hist_method, comm=comm,
                 binned_hist=binned_h, meta_hist=meta_hist, rand_key=rkey,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
-                bynode_count=bn_local, bynode_cap=bn_cap)
+                bynode_count=bn_local, bynode_cap=bn_cap,
+                cache_hists=self.cache_hists)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -292,7 +294,8 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
                 hist_method=self.hist_method, comm=comm,
                 bundled=self.bundled, rand_key=rkey,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
-                bynode_count=self.bynode_count)
+                bynode_count=self.bynode_count,
+                cache_hists=self.cache_hists)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -392,7 +395,8 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=self.bynode_count,
                 forced_plan=self.forced_plan, comm=comm,
-                row_id_base=base, n_total=n_pad)
+                row_id_base=base, n_total=n_pad,
+                cache_hists=self.cache_hists)
             return mat_l[None], ws_l[None], tree, leaf_id
 
         mapped = shard_map(

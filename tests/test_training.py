@@ -278,3 +278,71 @@ def test_custom_grad_reference_layout():
     assert pred.shape == (n, 3)
     acc = (pred.argmax(axis=1) == y).mean()
     assert acc > 0.8
+
+
+def test_histogram_pool_bounded_matches_cached():
+    """histogram_pool_size small enough to evict the cache switches the
+    grow loops to rebuild-both-children mode; trees must match the
+    cached mode (float association aside)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.learner.partitioned import PartitionedTreeLearner
+    from lightgbm_tpu.learner.serial import SerialTreeLearner
+
+    rng = np.random.RandomState(9)
+    n = 1200
+    X = rng.randn(n, 8)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.3 * rng.randn(n) > 0).astype(
+        np.float32)
+    grad = jnp.asarray(y - 0.5)
+    hess = jnp.full((n,), 0.25, jnp.float32)
+
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    cfg = Config.from_params(base)
+    cfg_pool = Config.from_params(dict(base, histogram_pool_size=0.001))
+    ds = Dataset.from_numpy(X, cfg, label=y)
+
+    ref = SerialTreeLearner(ds, cfg)
+    assert ref.cache_hists
+    bounded = SerialTreeLearner(ds, cfg_pool)
+    assert not bounded.cache_hists
+    t_ref = ref.to_host_tree(ref.train(grad, hess))
+    t_b = bounded.to_host_tree(bounded.train(grad, hess))
+    assert t_b.num_leaves == t_ref.num_leaves
+    np.testing.assert_array_equal(t_b.split_feature_inner,
+                                  t_ref.split_feature_inner)
+    np.testing.assert_allclose(t_b.leaf_value, t_ref.leaf_value,
+                               rtol=2e-4, atol=2e-6)
+
+    pb = PartitionedTreeLearner(ds, cfg_pool, interpret=True)
+    assert not pb.cache_hists
+    t_p = pb.to_host_tree(pb.train(grad, hess))
+    assert t_p.num_leaves == t_ref.num_leaves
+    np.testing.assert_array_equal(t_p.split_feature_inner,
+                                  t_ref.split_feature_inner)
+
+
+def test_profile_capture(tmp_path, monkeypatch):
+    """LGBM_TPU_PROFILE_DIR captures an xprof trace of GBDT.train and
+    reports the host-side phase timers."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    monkeypatch.setenv("LGBM_TPU_PROFILE_DIR", str(tmp_path))
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 5,
+                              "num_iterations": 3, "verbosity": -1})
+    booster = GBDT(cfg, Dataset.from_numpy(X, cfg, label=y))
+    booster.train()
+    from lightgbm_tpu.utils.log import Timer
+    assert not Timer._enabled  # enable state restored after the trace
+    # a trace was written and the boosting timer accumulated
+    import os
+    found = [f for _, _, fs in os.walk(tmp_path) for f in fs]
+    assert any(f.endswith((".pb", ".json.gz", ".xplane.pb"))
+               for f in found), found
+    from lightgbm_tpu.utils.log import global_timer
+    assert global_timer.acc.get("boosting", 0) > 0
