@@ -109,13 +109,27 @@ def load_file(path: str, config: Config) -> Tuple[
     if fmt == "libsvm":
         X, label = _load_libsvm(path)
     else:
-        import pandas as pd
         sep = "\t" if fmt == "tsv" else ","
-        df = pd.read_csv(path, sep=sep,
-                         header=0 if config.header else None)
+        quoted = False
+        with open(path) as f:
+            head_line = f.readline().rstrip("\n")
+            quoted = '"' in head_line or '"' in f.readline()
         if config.header:
-            names = [str(c) for c in df.columns]
-        mat = df.to_numpy(np.float64)
+            names = [c.strip() for c in head_line.split(sep)]
+        # native C++ parser (native/fast_parser.cpp) first; pandas
+        # handles quoting and is the no-compiler fallback. Note the
+        # native tokenizer matches the REFERENCE's tolerant Atof
+        # (junk -> NaN), not pandas' strictness.
+        from ..native import parse_dense_file
+        mat = None if quoted else parse_dense_file(
+            path, sep, skip_rows=1 if config.header else 0)
+        if mat is None:
+            import pandas as pd
+            df = pd.read_csv(path, sep=sep,
+                             header=0 if config.header else None)
+            if config.header:
+                names = [str(c) for c in df.columns]
+            mat = df.to_numpy(np.float64)
 
         label_idx = _resolve_column(config.label_column, names)
         if label_idx is None:
@@ -160,7 +174,16 @@ def load_file(path: str, config: Config) -> Tuple[
 def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
     """LibSVM sparse text -> dense matrix (LibSVMParser,
     src/io/parser.hpp:84-122). Zero-based or one-based indices are kept
-    as-is (the reference treats indices as given)."""
+    as-is (the reference treats indices as given). Native C++ fast path
+    (native/fast_parser.cpp) with a pure-Python fallback."""
+    from ..native import parse_libsvm_file
+    parsed = parse_libsvm_file(path)
+    if parsed is not None:
+        labels_a, rowptr, cols, vals, max_idx = parsed
+        X = np.zeros((len(labels_a), max_idx + 1))
+        rows_idx = np.repeat(np.arange(len(labels_a)), np.diff(rowptr))
+        X[rows_idx, cols] = vals
+        return X, labels_a
     labels: List[float] = []
     rows: List[List[Tuple[int, float]]] = []
     max_idx = -1

@@ -1,0 +1,167 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference ships its IO hot paths (src/io/parser.cpp) as C++; this
+package does the same: ``fast_parser.cpp`` is compiled once per machine
+with the system g++ (no pybind11 dependency — plain ``extern "C"`` +
+ctypes) and cached next to the source. Everything degrades gracefully:
+if no compiler is available the pure-Python/pandas paths take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_parser.cpp")
+_SO = os.path.join(_HERE, "_fast_parser.so")
+
+
+def _compile() -> Optional[str]:
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    # per-pid temp: concurrent processes (multi-host training) must not
+    # interleave g++ output into one file before the atomic replace
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Compile-on-first-use + load; None when unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("LGBM_TPU_NO_NATIVE"):
+            return None
+        so = _compile()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        L, C, D, I = (ctypes.c_long, ctypes.c_char, ctypes.c_double,
+                      ctypes.c_int)
+        LP, DP = ctypes.POINTER(L), ctypes.POINTER(D)
+        lib.lgbm_scan_dense.restype = L
+        lib.lgbm_scan_dense.argtypes = [ctypes.c_char_p, L, C, L, LP, LP]
+        lib.lgbm_parse_dense.restype = L
+        lib.lgbm_parse_dense.argtypes = [ctypes.c_char_p, L, C, L, DP,
+                                         L, L, I]
+        lib.lgbm_scan_libsvm.restype = L
+        lib.lgbm_scan_libsvm.argtypes = [ctypes.c_char_p, L, LP, LP, LP]
+        lib.lgbm_parse_libsvm.restype = L
+        lib.lgbm_parse_libsvm.argtypes = [ctypes.c_char_p, L, DP, LP, LP,
+                                          DP, L, L, I]
+        _LIB = lib
+        return _LIB
+
+
+def _mmap_file(path: str):
+    f = open(path, "rb")
+    try:
+        if os.path.getsize(path) == 0:
+            return f, b""
+        # ACCESS_COPY: pages stay file-backed until written (we never
+        # write) but the mapping counts as writable, which
+        # ctypes.from_buffer requires
+        return f, mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+    except (OSError, ValueError):
+        return f, f.read()
+
+
+def parse_dense_file(path: str, delim: str,
+                     skip_rows: int = 0) -> Optional[np.ndarray]:
+    """[rows, cols] float64 matrix, or None when native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    f, buf = _mmap_file(path)
+    try:
+        blen = len(buf)
+        cbuf = buf if isinstance(buf, bytes) \
+            else (ctypes.c_char * blen).from_buffer(buf)
+        rows = ctypes.c_long()
+        cols = ctypes.c_long()
+        d = ctypes.c_char(delim.encode())
+        lib.lgbm_scan_dense(cbuf, blen, d, skip_rows,
+                            ctypes.byref(rows), ctypes.byref(cols))
+        if rows.value <= 0 or cols.value <= 0:
+            return None  # degenerate file: defer to the pandas path
+        out = np.empty((rows.value, cols.value), np.float64)
+        got = lib.lgbm_parse_dense(
+            cbuf, blen, d, skip_rows,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            rows.value, cols.value, 0)
+        if got != rows.value:
+            return None
+        return out
+    finally:
+        cbuf = None  # release the exported buffer before closing
+        if isinstance(buf, mmap.mmap):
+            buf.close()
+        f.close()
+
+
+def parse_libsvm_file(path: str) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                                   np.ndarray, np.ndarray,
+                                                   int]]:
+    """(labels, rowptr, col_idx, values, max_idx) CSR triple, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    f, buf = _mmap_file(path)
+    try:
+        blen = len(buf)
+        cbuf = buf if isinstance(buf, bytes) \
+            else (ctypes.c_char * blen).from_buffer(buf)
+        rows = ctypes.c_long()
+        nnz = ctypes.c_long()
+        max_idx = ctypes.c_long()
+        lib.lgbm_scan_libsvm(cbuf, blen, ctypes.byref(rows),
+                             ctypes.byref(nnz), ctypes.byref(max_idx))
+        n, z = rows.value, nnz.value
+        if n <= 0:
+            return None
+        labels = np.empty(n, np.float64)
+        rowptr = np.empty(n + 1, np.int64)
+        cols = np.empty(max(z, 1), np.int64)
+        vals = np.empty(max(z, 1), np.float64)
+        DP = ctypes.POINTER(ctypes.c_double)
+        LP = ctypes.POINTER(ctypes.c_long)
+        got = lib.lgbm_parse_libsvm(
+            cbuf, blen, labels.ctypes.data_as(DP),
+            rowptr.ctypes.data_as(LP), cols.ctypes.data_as(LP),
+            vals.ctypes.data_as(DP), n, z, 0)
+        if got != n:
+            return None
+        return labels, rowptr, cols[:z], vals[:z], int(max_idx.value)
+    finally:
+        cbuf = None  # release the exported buffer before closing
+        if isinstance(buf, mmap.mmap):
+            buf.close()
+        f.close()

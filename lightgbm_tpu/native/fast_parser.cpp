@@ -1,0 +1,290 @@
+// Native text parser: dense CSV/TSV and sparse LibSVM.
+//
+// Reference analog: src/io/parser.cpp (CSVParser/TSVParser/LibSVMParser)
+// + Common::Atof — the reference parses with hand-rolled C++ on OpenMP
+// threads; this is the same idea for the TPU package: one serial memchr
+// sweep indexes line starts, then std::thread workers parse rows with
+// C++17 std::from_chars (locale-free, no allocation), writing straight
+// into numpy-owned buffers handed over via ctypes. Python keeps the
+// pandas path as fallback when the shared object is unavailable.
+//
+// Contract notes:
+//  * tokens that fail to parse (na, NA, empty, '?') become NaN —
+//    matching Common::Atof's tolerant behavior;
+//  * '\r' before '\n' is stripped; a trailing unterminated line counts;
+//  * LibSVM indices are kept as given (0- or 1-based, like the
+//    reference's LibSVMParser).
+
+#include <atomic>
+#include <cctype>
+#include <charconv>
+#include <cmath>
+#include <cstring>
+#include <limits>
+#include <thread>
+#include <vector>
+
+namespace {
+
+const double kNaN = std::numeric_limits<double>::quiet_NaN();
+
+double parse_token(const char* b, const char* e) {
+  while (b < e && (*b == ' ' || *b == '\t')) ++b;
+  while (e > b && (e[-1] == ' ' || e[-1] == '\r' || e[-1] == '\t')) --e;
+  if (b >= e) return kNaN;
+  double v;
+  auto res = std::from_chars(b, e, v);
+  if (res.ec == std::errc() && res.ptr == e) return v;
+  // from_chars rejects leading '+' and some spellings; normalize cheaply
+  if (*b == '+') {
+    res = std::from_chars(b + 1, e, v);
+    if (res.ec == std::errc() && res.ptr == e) return v;
+  }
+  return kNaN;
+}
+
+// line-start offsets of buf[0, len); always appends len as a sentinel
+std::vector<long> index_lines(const char* buf, long len) {
+  std::vector<long> starts;
+  starts.reserve(1024);
+  long pos = 0;
+  while (pos < len) {
+    starts.push_back(pos);
+    const char* nl =
+        static_cast<const char*>(memchr(buf + pos, '\n', len - pos));
+    if (!nl) break;
+    pos = (nl - buf) + 1;
+  }
+  starts.push_back(len);
+  return starts;
+}
+
+bool blank_line(const char* b, const char* e) {
+  for (; b < e; ++b)
+    if (*b != ' ' && *b != '\t' && *b != '\r' && *b != '\n') return false;
+  return true;
+}
+
+int clamp_threads(int nthreads, long rows) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 1;
+  long t = nthreads > 0 ? nthreads : static_cast<long>(hw);
+  if (t > rows) t = rows > 0 ? rows : 1;
+  if (t > 64) t = 64;
+  return static_cast<int>(t);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count data rows and columns. Returns 0 on success.
+long lgbm_scan_dense(const char* buf, long len, char delim, long skip,
+                     long* out_rows, long* out_cols) {
+  auto starts = index_lines(buf, len);
+  long nlines = static_cast<long>(starts.size()) - 1;
+  long rows = 0, cols = 0;
+  for (long i = 0; i < nlines; ++i) {
+    const char* b = buf + starts[i];
+    const char* e = buf + starts[i + 1];
+    if (blank_line(b, e)) continue;
+    if (skip > 0) { --skip; continue; }
+    if (rows == 0) {
+      cols = 1;
+      for (const char* p = b; p < e && *p != '\n'; ++p)
+        if (*p == delim) ++cols;
+    }
+    ++rows;
+  }
+  *out_rows = rows;
+  *out_cols = cols;
+  return 0;
+}
+
+// Parse into out[rows * cols] (row-major). Returns rows parsed, <0 error.
+long lgbm_parse_dense(const char* buf, long len, char delim, long skip,
+                      double* out, long rows, long cols, int nthreads) {
+  auto starts = index_lines(buf, len);
+  long nlines = static_cast<long>(starts.size()) - 1;
+  // data-line index (skip header/blank lines once, serially)
+  std::vector<long> data_lines;
+  data_lines.reserve(rows);
+  for (long i = 0; i < nlines; ++i) {
+    const char* b = buf + starts[i];
+    const char* e = buf + starts[i + 1];
+    if (blank_line(b, e)) continue;
+    if (skip > 0) { --skip; continue; }
+    data_lines.push_back(i);
+    if (static_cast<long>(data_lines.size()) == rows) break;
+  }
+  if (static_cast<long>(data_lines.size()) != rows) return -1;
+
+  int t = clamp_threads(nthreads, rows);
+  std::atomic<long> bad{0};
+  auto worker = [&](long lo, long hi) {
+    for (long r = lo; r < hi; ++r) {
+      long li = data_lines[r];
+      const char* p = buf + starts[li];
+      const char* e = buf + starts[li + 1];
+      if (e > p && e[-1] == '\n') --e;
+      double* row = out + r * cols;
+      long c = 0;
+      const char* tok = p;
+      for (const char* q = p;; ++q) {
+        if (q == e || *q == delim) {
+          if (c < cols) row[c] = parse_token(tok, q);
+          ++c;
+          tok = q + 1;
+          if (q == e) break;
+        }
+      }
+      if (c != cols) {
+        for (long j = c; j < cols; ++j) row[j] = kNaN;
+        bad.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  };
+  if (t <= 1) {
+    worker(0, rows);
+  } else {
+    std::vector<std::thread> ths;
+    long chunk = (rows + t - 1) / t;
+    for (int k = 0; k < t; ++k) {
+      long lo = k * chunk, hi = std::min(rows, lo + chunk);
+      if (lo >= hi) break;
+      ths.emplace_back(worker, lo, hi);
+    }
+    for (auto& th : ths) th.join();
+  }
+  // ragged rows are a parse FAILURE (the pandas fallback raises loudly
+  // for them); report via a negative return so the caller falls back
+  long nbad = bad.load(std::memory_order_relaxed);
+  return nbad > 0 ? -(2 + nbad) : rows;
+}
+
+// LibSVM pass 1: rows, non-zeros, max feature index. Returns 0.
+long lgbm_scan_libsvm(const char* buf, long len, long* out_rows,
+                      long* out_nnz, long* out_max_idx) {
+  auto starts = index_lines(buf, len);
+  long nlines = static_cast<long>(starts.size()) - 1;
+  long rows = 0, nnz = 0, max_idx = -1;
+  for (long i = 0; i < nlines; ++i) {
+    const char* b = buf + starts[i];
+    const char* e = buf + starts[i + 1];
+    if (blank_line(b, e)) continue;
+    ++rows;
+    for (const char* p = b; p < e; ++p) {
+      if (*p == ':') {
+        // a feature token iff the chars before ':' are a whole digit
+        // run starting at a separator (skips qid:1 etc. — the same
+        // rule lgbm_parse_libsvm applies)
+        const char* d = p;
+        while (d > b && std::isdigit(static_cast<unsigned char>(d[-1])))
+          --d;
+        if (d == p) continue;                    // no digits
+        if (d != b && d[-1] != ' ' && d[-1] != '\t') continue;
+        ++nnz;
+        long idx = 0;
+        std::from_chars(d, p, idx);
+        if (idx > max_idx) max_idx = idx;
+      }
+    }
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  *out_max_idx = max_idx;
+  return 0;
+}
+
+// LibSVM pass 2: labels[rows], rowptr[rows+1], cols[nnz], vals[nnz]
+// (CSR). rowptr must be pre-filled by this call; single allocation-free
+// sweep per thread with a serial prefix pass for rowptr.
+long lgbm_parse_libsvm(const char* buf, long len, double* labels,
+                       long* rowptr, long* cols, double* vals, long rows,
+                       long nnz, int nthreads) {
+  auto starts = index_lines(buf, len);
+  long nlines = static_cast<long>(starts.size()) - 1;
+  std::vector<long> data_lines;
+  data_lines.reserve(rows);
+  for (long i = 0; i < nlines; ++i) {
+    if (!blank_line(buf + starts[i], buf + starts[i + 1]))
+      data_lines.push_back(i);
+  }
+  if (static_cast<long>(data_lines.size()) != rows) return -1;
+
+  // serial rowptr pass (same feature-token rule as the scan)
+  rowptr[0] = 0;
+  for (long r = 0; r < rows; ++r) {
+    long li = data_lines[r];
+    const char* b = buf + starts[li];
+    long cnt = 0;
+    for (const char* p = b; p < buf + starts[li + 1]; ++p) {
+      if (*p != ':') continue;
+      const char* d = p;
+      while (d > b && std::isdigit(static_cast<unsigned char>(d[-1])))
+        --d;
+      if (d == p) continue;
+      if (d != b && d[-1] != ' ' && d[-1] != '\t') continue;
+      ++cnt;
+    }
+    rowptr[r + 1] = rowptr[r] + cnt;
+  }
+  if (rowptr[rows] != nnz) return -2;
+
+  int t = clamp_threads(nthreads, rows);
+  auto worker = [&](long lo, long hi) {
+    for (long r = lo; r < hi; ++r) {
+      long li = data_lines[r];
+      const char* p = buf + starts[li];
+      const char* e = buf + starts[li + 1];
+      if (e > p && e[-1] == '\n') --e;
+      // label = first whitespace-delimited token
+      const char* q = p;
+      while (q < e && *q != ' ' && *q != '\t') ++q;
+      labels[r] = parse_token(p, q);
+      long w = rowptr[r];
+      while (q < e) {
+        while (q < e && (*q == ' ' || *q == '\t')) ++q;
+        const char* tok = q;
+        while (q < e && *q != ' ' && *q != '\t') ++q;
+        const char* colon =
+            static_cast<const char*>(memchr(tok, ':', q - tok));
+        if (!colon || colon == tok) continue;  // qid:/comments: skip
+        // EXACT same token rule as the scan/rowptr passes (pure digit
+        // run): from_chars alone would also accept '-1:5', desyncing w
+        // from rowptr and overflowing the caller's CSR buffers
+        bool all_digits = true;
+        for (const char* d = tok; d < colon; ++d)
+          if (!std::isdigit(static_cast<unsigned char>(*d))) {
+            all_digits = false;
+            break;
+          }
+        if (!all_digits || w >= rowptr[r + 1]) continue;
+        long idx = 0;
+        auto rc = std::from_chars(tok, colon, idx);
+        if (rc.ec != std::errc() || rc.ptr != colon) continue;
+        cols[w] = idx;
+        vals[w] = parse_token(colon + 1, q);
+        ++w;
+      }
+      // rows whose trailing tokens were skipped: pad (shouldn't happen,
+      // scan counted ':' the same way)
+      while (w < rowptr[r + 1]) { cols[w] = 0; vals[w] = 0.0; ++w; }
+    }
+  };
+  if (t <= 1) {
+    worker(0, rows);
+  } else {
+    std::vector<std::thread> ths;
+    long chunk = (rows + t - 1) / t;
+    for (int k = 0; k < t; ++k) {
+      long lo = k * chunk, hi = std::min(rows, lo + chunk);
+      if (lo >= hi) break;
+      ths.emplace_back(worker, lo, hi);
+    }
+    for (auto& th : ths) th.join();
+  }
+  return rows;
+}
+
+}  // extern "C"

@@ -1,0 +1,103 @@
+"""Native C++ parser vs the Python/pandas paths (Parser::CreateParser
+family, src/io/parser.cpp). Skips when no compiler is available."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.native import (get_lib, parse_dense_file,
+                                 parse_libsvm_file)
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="no native toolchain")
+
+
+def test_dense_tsv_matches_numpy(tmp_path):
+    rng = np.random.RandomState(0)
+    M = rng.randn(500, 7)
+    M[::17, 3] = np.nan
+    p = tmp_path / "d.tsv"
+    lines = []
+    for r in M:
+        lines.append("\t".join("na" if np.isnan(v) else f"{v:.10g}"
+                               for v in r))
+    p.write_text("\n".join(lines) + "\n")
+    out = parse_dense_file(str(p), "\t")
+    assert out.shape == M.shape
+    np.testing.assert_allclose(out, M, rtol=1e-9, equal_nan=True)
+
+
+def test_dense_csv_header_skip(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a,b,c\n1,2,3\n4,,6\n+7,8e-2,inf\n")
+    out = parse_dense_file(str(p), ",", skip_rows=1)
+    assert out.shape == (3, 3)
+    assert np.isnan(out[1, 1])
+    assert out[2, 0] == 7 and out[2, 1] == 0.08 and np.isinf(out[2, 2])
+
+
+def test_libsvm_csr(tmp_path):
+    p = tmp_path / "d.svm"
+    p.write_text("1 0:1.5 3:-2.25\n"
+                 "0 qid:7 1:4\n"
+                 "\n"
+                 "-1 2:1e3 4:0.5\n")
+    labels, rowptr, cols, vals, max_idx = parse_libsvm_file(str(p))
+    np.testing.assert_array_equal(labels, [1, 0, -1])
+    np.testing.assert_array_equal(rowptr, [0, 2, 3, 5])
+    np.testing.assert_array_equal(cols, [0, 3, 1, 2, 4])
+    np.testing.assert_allclose(vals, [1.5, -2.25, 4, 1e3, 0.5])
+    assert max_idx == 4
+
+
+def test_file_loader_roundtrip_native_vs_pandas(tmp_path, monkeypatch):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.file_loader import load_file
+    rng = np.random.RandomState(1)
+    M = np.column_stack([rng.randint(0, 2, 200).astype(float),
+                         rng.randn(200, 5)])
+    p = tmp_path / "t.tsv"
+    p.write_text("\n".join("\t".join(f"{v:.8g}" for v in r) for r in M))
+    cfg = Config.from_params({"header": False})
+    Xn, yn, *_ = load_file(str(p), cfg)
+    monkeypatch.setenv("LGBM_TPU_NO_NATIVE", "1")
+    import lightgbm_tpu.native as nat
+    monkeypatch.setattr(nat, "_TRIED", False)
+    monkeypatch.setattr(nat, "_LIB", None)
+    Xp, yp, *_ = load_file(str(p), cfg)
+    np.testing.assert_allclose(Xn, Xp, rtol=1e-7)
+    np.testing.assert_allclose(yn, yp)
+
+
+def test_libsvm_negative_index_token_skipped(tmp_path):
+    """'-1:5' must be skipped by BOTH passes (regression: the worker
+    accepted it and overflowed the CSR buffers)."""
+    p = tmp_path / "neg.svm"
+    p.write_text("1 -1:5 0:2\n0 1:3\n")
+    labels, rowptr, cols, vals, _ = parse_libsvm_file(str(p))
+    np.testing.assert_array_equal(rowptr, [0, 1, 2])
+    np.testing.assert_array_equal(cols, [0, 1])
+    np.testing.assert_allclose(vals, [2, 3])
+
+
+def test_ragged_rows_fall_back(tmp_path):
+    """Ragged rows are a parse failure -> None (pandas then raises)."""
+    p = tmp_path / "r.csv"
+    p.write_text("1,2,3\n4,5\n6,7,8\n")
+    assert parse_dense_file(str(p), ",") is None
+
+
+def test_header_only_file_falls_back(tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("a,b,c\n")
+    assert parse_dense_file(str(p), ",", skip_rows=1) is None
+
+
+def test_quoted_csv_uses_pandas(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.file_loader import load_file
+    p = tmp_path / "q.csv"
+    p.write_text('"y","x1"\n"1","2.5"\n"0","3.5"\n')
+    cfg = Config.from_params({"header": True})
+    X, y, *_ = load_file(str(p), cfg)
+    np.testing.assert_allclose(y, [1, 0])
+    np.testing.assert_allclose(X[:, 0], [2.5, 3.5])
