@@ -159,6 +159,23 @@ def run_refit(params: Dict[str, str]) -> None:
     log_info(f"Finished refit; model saved to {out}")
 
 
+def run_convert_model(params: Dict[str, str]) -> None:
+    """``task=convert_model``: model text -> standalone C++ if-else
+    source (GBDT::ModelToIfElse, gbdt_model_text.cpp:117-299)."""
+    from .config import Config
+    from .io.codegen import convert_model_file
+    cfg = Config.from_params(params)
+    if not cfg.input_model:
+        log_fatal("task=convert_model requires input_model=<model file>")
+    lang = cfg.convert_model_language or "cpp"
+    if lang not in ("cpp", "c++"):
+        log_fatal(f"convert_model_language={lang} is not supported "
+                  "(only cpp)")
+    out = cfg.convert_model or "gbdt_prediction.cpp"
+    convert_model_file(cfg.input_model, out)
+    log_info(f"Finished converting model; source saved to {out}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     params = parse_cli_params(argv)
@@ -170,7 +187,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif task == "refit":
         run_refit(params)
     elif task == "convert_model":
-        log_fatal("task=convert_model is not implemented")
+        run_convert_model(params)
     else:
         log_fatal(f"Unknown task: {task}")
     return 0

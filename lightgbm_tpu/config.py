@@ -189,8 +189,6 @@ _UNIMPLEMENTED_PARAMS = {
                        "batched device prediction has no row loop)",
     "pred_early_stop_freq": "prediction early stopping",
     "pred_early_stop_margin": "prediction early stopping",
-    "convert_model": "model-to-C conversion",
-    "convert_model_language": "model-to-C conversion",
     "forcedbins_filename": "forced bin bounds file",
 }
 

@@ -193,14 +193,25 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
             if not line:
                 continue
             toks = line.replace("\t", " ").split()
-            labels.append(float(toks[0]))
+            try:
+                labels.append(float(toks[0]))
+            except ValueError:
+                labels.append(float("nan"))
             row = []
             for t in toks[1:]:
                 if ":" not in t:
                     continue
                 i, v = t.split(":", 1)
+                # same token rule as the native parser
+                # (native/fast_parser.cpp): the index must be a pure
+                # digit run — skips qid:7, comments, negative indices
+                if not i.isdigit():
+                    continue
                 i = int(i)
-                row.append((i, float(v)))
+                try:
+                    row.append((i, float(v)))
+                except ValueError:
+                    row.append((i, float("nan")))
                 max_idx = max(max_idx, i)
             rows.append(row)
     X = np.zeros((len(rows), max_idx + 1))

@@ -129,16 +129,25 @@ long lgbm_parse_dense(const char* buf, long len, char delim, long skip,
       if (e > p && e[-1] == '\n') --e;
       double* row = out + r * cols;
       long c = 0;
+      bool quoted = false;
       const char* tok = p;
       for (const char* q = p;; ++q) {
         if (q == e || *q == delim) {
-          if (c < cols) row[c] = parse_token(tok, q);
+          if (c < cols) {
+            const char* tb = tok;
+            while (tb < q && (*tb == ' ' || *tb == '\t')) ++tb;
+            // a quoted field means this file needs a CSV-quoting
+            // parser; flag it as bad so the caller falls back instead
+            // of silently storing NaN
+            if (tb < q && *tb == '"') quoted = true;
+            row[c] = parse_token(tb, q);
+          }
           ++c;
           tok = q + 1;
           if (q == e) break;
         }
       }
-      if (c != cols) {
+      if (c != cols || quoted) {
         for (long j = c; j < cols; ++j) row[j] = kNaN;
         bad.fetch_add(1, std::memory_order_relaxed);
       }
@@ -173,6 +182,9 @@ long lgbm_scan_libsvm(const char* buf, long len, long* out_rows,
     const char* e = buf + starts[i + 1];
     if (blank_line(b, e)) continue;
     ++rows;
+    // leading whitespace must not turn the label into a "feature
+    // token" (the first-token-is-label rule keys off line start)
+    while (b < e && (*b == ' ' || *b == '\t')) ++b;
     for (const char* p = b; p < e; ++p) {
       if (*p == ':') {
         // a feature token iff the chars before ':' are a whole digit
@@ -182,7 +194,10 @@ long lgbm_scan_libsvm(const char* buf, long len, long* out_rows,
         while (d > b && std::isdigit(static_cast<unsigned char>(d[-1])))
           --d;
         if (d == p) continue;                    // no digits
-        if (d != b && d[-1] != ' ' && d[-1] != '\t') continue;
+        // the line's first token is always the label, never a feature
+        // (the parse worker consumes it unconditionally)
+        if (d == b) continue;
+        if (d[-1] != ' ' && d[-1] != '\t') continue;
         ++nnz;
         long idx = 0;
         std::from_chars(d, p, idx);
@@ -212,32 +227,57 @@ long lgbm_parse_libsvm(const char* buf, long len, double* labels,
   }
   if (static_cast<long>(data_lines.size()) != rows) return -1;
 
-  // serial rowptr pass (same feature-token rule as the scan)
-  rowptr[0] = 0;
-  for (long r = 0; r < rows; ++r) {
-    long li = data_lines[r];
-    const char* b = buf + starts[li];
-    long cnt = 0;
-    for (const char* p = b; p < buf + starts[li + 1]; ++p) {
-      if (*p != ':') continue;
-      const char* d = p;
-      while (d > b && std::isdigit(static_cast<unsigned char>(d[-1])))
-        --d;
-      if (d == p) continue;
-      if (d != b && d[-1] != ' ' && d[-1] != '\t') continue;
-      ++cnt;
+  // rowptr pass (same feature-token rule as the scan): per-row counts
+  // in parallel, then a rows-long serial prefix sum — the byte scan is
+  // the expensive part, so it must not run single-threaded
+  int tc = clamp_threads(nthreads, rows);
+  {
+    auto count_worker = [&](long lo, long hi) {
+      for (long r = lo; r < hi; ++r) {
+        long li = data_lines[r];
+        const char* b = buf + starts[li];
+        const char* e = buf + starts[li + 1];
+        while (b < e && (*b == ' ' || *b == '\t')) ++b;  // see scan
+        long cnt = 0;
+        for (const char* p = b; p < e; ++p) {
+          if (*p != ':') continue;
+          const char* d = p;
+          while (d > b &&
+                 std::isdigit(static_cast<unsigned char>(d[-1])))
+            --d;
+          if (d == p) continue;
+          if (d == b) continue;    // first token = label (see scan)
+          if (d[-1] != ' ' && d[-1] != '\t') continue;
+          ++cnt;
+        }
+        rowptr[r + 1] = cnt;       // prefix-summed below
+      }
+    };
+    if (tc <= 1) {
+      count_worker(0, rows);
+    } else {
+      std::vector<std::thread> ths;
+      long chunk = (rows + tc - 1) / tc;
+      for (int k = 0; k < tc; ++k) {
+        long lo = k * chunk, hi = std::min(rows, lo + chunk);
+        if (lo >= hi) break;
+        ths.emplace_back(count_worker, lo, hi);
+      }
+      for (auto& th : ths) th.join();
     }
-    rowptr[r + 1] = rowptr[r] + cnt;
   }
+  rowptr[0] = 0;
+  for (long r = 0; r < rows; ++r) rowptr[r + 1] += rowptr[r];
   if (rowptr[rows] != nnz) return -2;
 
-  int t = clamp_threads(nthreads, rows);
+  int t = tc;
   auto worker = [&](long lo, long hi) {
     for (long r = lo; r < hi; ++r) {
       long li = data_lines[r];
       const char* p = buf + starts[li];
       const char* e = buf + starts[li + 1];
       if (e > p && e[-1] == '\n') --e;
+      while (p < e && (*p == ' ' || *p == '\t')) ++p;   // see scan
       // label = first whitespace-delimited token
       const char* q = p;
       while (q < e && *q != ' ' && *q != '\t') ++q;

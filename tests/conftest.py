@@ -9,6 +9,11 @@ import os
 
 # Force CPU even when the session environment preselects a TPU platform
 # (JAX_PLATFORMS=axon): tests must be hermetic and multi-device.
+# Also drop the axon pool var: the sitecustomize hook dials the TPU
+# tunnel whenever it is set (even under JAX_PLATFORMS=cpu), and a
+# concurrent tunnel client wedges any real-TPU job (e.g. the driver's
+# bench) running alongside the tests.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,3 +1,5 @@
+import os
+
 import pytest
 
 from lightgbm_tpu.config import Config
@@ -79,3 +81,20 @@ def test_num_class_validation():
         Config.from_params({"objective": "multiclass"})
     cfg = Config.from_params({"objective": "multiclass", "num_class": 3})
     assert cfg.num_tree_per_iteration() == 3
+
+
+def test_params_doc_in_sync():
+    """docs/Parameters.md is generated from the Config dataclass; the
+    committed file must match (the reference CI's parameter-docs
+    consistency check, .ci/test.sh:34-39)."""
+    import subprocess
+    import sys
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}   # never dial the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_params_doc.py"),
+         "--check"],
+        capture_output=True, text=True, env=env)
+    assert res.returncode == 0, res.stderr

@@ -101,3 +101,61 @@ def test_quoted_csv_uses_pandas(tmp_path):
     X, y, *_ = load_file(str(p), cfg)
     np.testing.assert_allclose(y, [1, 0])
     np.testing.assert_allclose(X[:, 0], [2.5, 3.5])
+
+
+def test_quoted_field_past_line_two_falls_back(tmp_path):
+    """Quote sniffing samples only the head; a quoted field deeper in
+    the file must still be flagged by the parser itself (regression:
+    it silently parsed '"3.5"' as NaN)."""
+    p = tmp_path / "deep.csv"
+    p.write_text("a,b\n1,2\n\"3.5\",4\n")
+    assert parse_dense_file(str(p), ",", skip_rows=1) is None
+    # and the full loader gets pandas' answer
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.file_loader import load_file
+    X, y, *_ = load_file(str(p), Config.from_params({"header": True}))
+    np.testing.assert_allclose(y, [1, 3.5])     # label col 0 default
+    np.testing.assert_allclose(X[:, 0], [2, 4])
+
+
+def test_libsvm_line_start_colon_token_is_label(tmp_path):
+    """A 'digits:value' token at line START is the label slot, not a
+    feature (regression: scan counted it, worker didn't, desyncing
+    rowptr and padding garbage into the CSR arrays)."""
+    p = tmp_path / "lab.svm"
+    p.write_text("0:1.5 1:2\n1 0:7\n")
+    labels, rowptr, cols, vals, _ = parse_libsvm_file(str(p))
+    # row 0: '0:1.5' consumed as (unparseable) label; one real feature
+    assert np.isnan(labels[0]) and labels[1] == 1
+    np.testing.assert_array_equal(rowptr, [0, 1, 2])
+    np.testing.assert_array_equal(cols, [1, 0])
+    np.testing.assert_allclose(vals, [2, 7])
+
+
+def test_libsvm_leading_whitespace(tmp_path):
+    """Leading whitespace must not swallow the label (regression: the
+    label scan stopped at the first char and stored NaN)."""
+    p = tmp_path / "ws.svm"
+    p.write_text(" 1 0:2\n\t0 1:3\n")
+    labels, rowptr, cols, vals, _ = parse_libsvm_file(str(p))
+    np.testing.assert_allclose(labels, [1, 0])
+    np.testing.assert_array_equal(cols, [0, 1])
+    np.testing.assert_allclose(vals, [2, 3])
+
+
+def test_libsvm_python_fallback_matches_native(tmp_path, monkeypatch):
+    """The no-compiler fallback must follow the SAME token rules as the
+    native parser (regression: it crashed on qid: and wrapped -1:5
+    into the last column)."""
+    from lightgbm_tpu.data.file_loader import _load_libsvm
+    p = tmp_path / "q.svm"
+    p.write_text("1 qid:7 0:1.5 -1:5 3:-2.25\n0 2:4.5\n")
+    Xn, yn = _load_libsvm(str(p))
+    monkeypatch.setenv("LGBM_TPU_NO_NATIVE", "1")
+    import lightgbm_tpu.native as nat
+    monkeypatch.setattr(nat, "_TRIED", False)
+    monkeypatch.setattr(nat, "_LIB", None)
+    Xp, yp = _load_libsvm(str(p))
+    np.testing.assert_allclose(Xn, Xp)
+    np.testing.assert_allclose(yn, yp)
+    assert Xn.shape == (2, 4) and Xn[0, 3] == -2.25 and Xn[0, 0] == 1.5
