@@ -9,6 +9,8 @@ stable across NumPy versions) and compare our loader's predictions
 against the reference's recorded predictions.
 """
 
+import functools
+
 import numpy as np
 
 FIXDIR_NAME = "fixtures/golden"
@@ -54,6 +56,54 @@ def categorical_data():
     return X[:ntr], target[:ntr], X[ntr:], target[ntr:]
 
 
+@functools.lru_cache(maxsize=None)
+def _rank_all():
+    """Synthetic learning-to-rank: 120 train / 40 test queries of 5-25
+    docs, graded relevance 0-4 driven by two features + noise."""
+    rng = np.random.RandomState(90210)
+
+    def make_split(n_queries):
+        sizes = rng.randint(5, 26, n_queries)
+        n = int(sizes.sum())
+        X = rng.randn(n, 12)
+        rel_score = 1.4 * X[:, 0] + X[:, 1] - 0.5 * X[:, 2] \
+            + 0.8 * rng.randn(n)
+        y = np.zeros(n)
+        pos = 0
+        for s in sizes:                       # per-query grade buckets
+            seg = rel_score[pos:pos + s]
+            ranks = seg.argsort().argsort()
+            y[pos:pos + s] = np.minimum(4, (5 * ranks) // max(s, 1))
+            pos += s
+        return X, y, sizes
+
+    Xtr, ytr, qtr = make_split(120)
+    Xte, yte, qte = make_split(40)
+    return Xtr, ytr, Xte, yte, qtr, qte
+
+
+def rank_data():
+    return _rank_all()[:4]
+
+
+def rank_query_sizes():
+    """The query-boundary sidecars for rank_data."""
+    out = _rank_all()
+    return out[4], out[5]
+
+
+def regression_l1_data():
+    """L1 objective exercises RenewTreeOutput (weighted-median leaf
+    refit, regression_objective.hpp) — a strong parity check."""
+    rng = np.random.RandomState(1231)
+    n, f = 900, 9
+    X = rng.randn(n, f)
+    target = (2.0 * X[:, 0] - X[:, 1] + 0.5 * np.abs(X[:, 2])
+              + rng.standard_cauchy(n) * 0.3)   # heavy-tailed noise
+    ntr = 700
+    return X[:ntr], target[:ntr], X[ntr:], target[ntr:]
+
+
 DATASETS = {
     "binary": dict(
         make=binary_data,
@@ -74,6 +124,20 @@ DATASETS = {
                       "num_leaves=31", "learning_rate=0.1",
                       "min_data_in_leaf=20",
                       "categorical_feature=0,1", "verbosity=-1"],
+    ),
+    "rank": dict(
+        make=rank_data,
+        make_query=rank_query_sizes,
+        train_params=["objective=lambdarank", "num_trees=20",
+                      "num_leaves=15", "learning_rate=0.1",
+                      "min_data_in_leaf=5", "metric=ndcg",
+                      "eval_at=5", "verbosity=-1"],
+    ),
+    "regression_l1": dict(
+        make=regression_l1_data,
+        train_params=["objective=regression_l1", "num_trees=20",
+                      "num_leaves=31", "learning_rate=0.15",
+                      "min_data_in_leaf=20", "verbosity=-1"],
     ),
 }
 

@@ -58,3 +58,40 @@ def test_reference_model_roundtrips_through_our_writer():
     again = load_model_from_string(text)
     np.testing.assert_allclose(again.predict(Xte), booster.predict(Xte),
                                rtol=1e-12, atol=1e-12)
+
+
+def test_lambdarank_training_quality_vs_reference():
+    """Train OUR lambdarank with the reference model's exact params on
+    the same data; held-out NDCG@5 must match the reference model's
+    within a small margin (tree tie-breaks differ, so this is a
+    quality-parity check, not bit parity — test_consistency.py spirit).
+    """
+    import lightgbm_tpu as lgb
+    from golden_common import rank_data, rank_query_sizes
+    from lightgbm_tpu.metric.rank_metrics import NDCGMetric
+
+    _, Xte, ref_pred = _load("rank")
+    Xtr, ytr, _, yte = rank_data()
+    qtr, qte = rank_query_sizes()
+
+    # the exact params the reference model was trained with
+    spec = dict(kv.split("=", 1) for kv in DATASETS["rank"]["train_params"])
+    n_trees = int(spec.pop("num_trees"))
+    ours = lgb.train(spec, lgb.Dataset(Xtr, label=ytr, group=qtr),
+                     num_boost_round=n_trees)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import Metadata
+    meta = Metadata(len(yte))
+    meta.set_label(yte)
+    meta.set_query(qte)
+    metric = NDCGMetric(Config.from_params(
+        {"objective": "lambdarank", "eval_at": [5]}))
+    metric.init(meta, len(yte))
+
+    def ndcg5(score):
+        return float(metric.eval(score, None)[0])
+
+    ndcg_ref = ndcg5(ref_pred)
+    ndcg_ours = ndcg5(np.asarray(ours.predict(Xte)).reshape(-1))
+    assert ndcg_ours > ndcg_ref - 0.02, (ndcg_ours, ndcg_ref)

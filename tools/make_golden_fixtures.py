@@ -45,6 +45,13 @@ def main():
         test = os.path.join(scratch, f"{name}.test")
         golden_common.write_tsv(train, Xtr, ytr)
         golden_common.write_tsv(test, Xte, yte)
+        if "make_query" in spec:
+            qtr, qte = spec["make_query"]()
+            # reference query sidecars (Metadata::LoadQueryBoundaries)
+            with open(train + ".query", "w") as fh:
+                fh.write("\n".join(str(int(q)) for q in qtr) + "\n")
+            with open(test + ".query", "w") as fh:
+                fh.write("\n".join(str(int(q)) for q in qte) + "\n")
         model = os.path.join(FIXDIR, f"model_{name}.txt")
         pred = os.path.join(FIXDIR, f"pred_{name}.txt")
         run(binary, ["task=train", f"data={train}",
