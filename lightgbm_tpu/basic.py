@@ -74,6 +74,9 @@ def _resolve_categorical(categorical_feature, feature_name,
     return sorted(set(out))
 
 
+from .data.dataset import is_sparse as _is_sparse
+
+
 def _to_matrix(data):
     if isinstance(data, np.ndarray):
         return data if data.ndim == 2 else data.reshape(len(data), -1)
@@ -158,6 +161,14 @@ class Dataset:
             data, feature_name, cat_idx, self.pandas_categorical = \
                 _data_from_pandas(data, feature_name,
                                   self.categorical_feature)
+        elif _is_sparse(data):
+            # stays sparse end to end (Dataset.from_scipy): the raw
+            # matrix is never densified (reference CSR/CSC push path,
+            # c_api.cpp LGBM_DatasetCreateFromCSR/CSC)
+            if feature_name == "auto":
+                feature_name = None
+            cat_idx = _resolve_categorical(
+                self.categorical_feature, feature_name, data.shape[1])
         else:
             data = _to_matrix(data)
             if feature_name == "auto":
@@ -167,7 +178,9 @@ class Dataset:
 
         ref_inner = self.reference._inner if self.reference is not None \
             else None
-        self._inner = _InnerDataset.from_numpy(
+        ctor = _InnerDataset.from_scipy if _is_sparse(data) \
+            else _InnerDataset.from_numpy
+        self._inner = ctor(
             data, cfg, label=self.label, weight=self.weight,
             group=self.group, init_score=self.init_score,
             feature_names=feature_name if feature_name != "auto"

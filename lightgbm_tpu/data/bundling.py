@@ -158,17 +158,22 @@ def plan_bundles(binned: np.ndarray, num_bins: np.ndarray,
     else:
         sample = binned
     total = sample.shape[0]
-    max_conflict = total // 10000
+    nz_idx: List[Optional[np.ndarray]] = [
+        np.nonzero(sample[:, j])[0] if eligible[j] else None
+        for j in range(f)]
+    return plan_bundles_from_nonzeros(nz_idx, num_bins, total, seed)
 
-    nz_idx: List[Optional[np.ndarray]] = []
-    nnz = np.zeros(f, np.int64)
-    for j in range(f):
-        if eligible[j]:
-            idx = np.nonzero(sample[:, j])[0]
-            nz_idx.append(idx)
-            nnz[j] = len(idx)
-        else:
-            nz_idx.append(None)
+
+def plan_bundles_from_nonzeros(nz_idx: List[Optional[np.ndarray]],
+                               num_bins: np.ndarray, total: int,
+                               seed: int = 0) -> BundlePlan:
+    """Plan from per-feature non-default row-index lists directly —
+    the sparse path feeds CSC column indices here so the full binned
+    sample matrix never materializes (memory O(sample nnz))."""
+    f = len(nz_idx)
+    nnz = np.asarray([0 if ix is None else len(ix) for ix in nz_idx],
+                     np.int64)
+    max_conflict = total // 10000
 
     natural = np.arange(f)
     by_cnt = np.argsort(-nnz, kind="stable")

@@ -26,6 +26,15 @@ from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
                       kZeroThreshold)
 
 
+def is_sparse(data) -> bool:
+    """True for scipy sparse matrices (guarded import)."""
+    try:
+        import scipy.sparse as sp
+        return sp.issparse(data)
+    except ImportError:  # pragma: no cover
+        return False
+
+
 class Metadata:
     """Labels and side information (dataset.h:41-249)."""
 
@@ -290,6 +299,9 @@ class Dataset:
                 forced_upper_bounds=fb)
             self.bin_mappers.append(mapper)
 
+        self._finalize_used_features()
+
+    def _finalize_used_features(self) -> None:
         self.used_feature_map = []
         self.real_feature_idx = []
         for j, m in enumerate(self.bin_mappers):
@@ -358,6 +370,215 @@ class Dataset:
         self.binned = out
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_scipy(cls, data, config: Config,
+                   label: Optional[Sequence[float]] = None,
+                   weight: Optional[Sequence[float]] = None,
+                   group: Optional[Sequence[int]] = None,
+                   init_score: Optional[Sequence[float]] = None,
+                   feature_names: Optional[List[str]] = None,
+                   categorical_features: Sequence[int] = (),
+                   forced_bins: Optional[Dict[int, List[float]]] = None,
+                   reference: Optional["Dataset"] = None) -> "Dataset":
+        """Bin a scipy sparse matrix without densifying the raw values.
+
+        The SparseBin / MultiValSparseBin story TPU-style
+        (src/io/sparse_bin.hpp, multi_val_sparse_bin.hpp): the raw
+        float matrix never materializes — bin finding samples each
+        CSC column's stored entries (zeros are implicit, exactly the
+        reference's sparse sampler), extraction writes binned nonzeros
+        straight into the (EFB-bundled) uint8 training matrix, and the
+        bundling plan itself is computed from a row sample. Peak extra
+        memory is O(nnz + N * num_groups) — for a Bosch-shaped matrix
+        that is ~F/G * 64x smaller than densifying to float64.
+        """
+        import scipy.sparse as sp
+        if not sp.issparse(data):
+            log_fatal("Dataset.from_scipy requires a scipy.sparse matrix")
+        csc = data.tocsc()
+        if not csc.has_canonical_format:
+            # scipy semantics: duplicate entries SUM. Canonicalize on a
+            # copy when tocsc() aliased the caller's arrays — the
+            # user's matrix must never be mutated behind their back.
+            if csc is data:
+                csc = csc.copy()
+            csc.sum_duplicates()
+        n, num_features = csc.shape
+        self = cls()
+        self.num_data = n
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        self.bin_construct_sample_cnt = config.bin_construct_sample_cnt
+        self.min_data_in_bin = config.min_data_in_bin
+        self.use_missing = config.use_missing
+        self.zero_as_missing = config.zero_as_missing
+        self.feature_names = feature_names or [
+            f"Column_{i}" for i in range(num_features)]
+
+        if reference is not None:
+            self.bin_mappers = reference.bin_mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_feature_idx = reference.real_feature_idx
+            self.max_bin = reference.max_bin
+            self.feature_names = reference.feature_names
+            self.monotone_types = reference.monotone_types
+            self.feature_penalty = reference.feature_penalty
+            self.feature_group = reference.feature_group
+            self.feature_offset = reference.feature_offset
+            self.group_num_bins = reference.group_num_bins
+        else:
+            self._find_bins_sparse(csc, config, categorical_features,
+                                   forced_bins)
+            self._resolve_monotone_and_penalty(config)
+        self._extract_sparse(csc, config, reference)
+        self.metadata.num_data = n
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weights(weight)
+        self.metadata.set_query(group)
+        self.metadata.set_init_score(init_score)
+        return self
+
+    def _find_bins_sparse(self, csc, config: Config,
+                          categorical_features: Sequence[int],
+                          forced_bins) -> None:
+        """Per-column FindBin over the CSC nonzeros of a row sample
+        (the sparse branch of dataset_loader.cpp sampling: only stored
+        values are pushed, zeros ride total_sample_cnt)."""
+        n, num_features = csc.shape
+        sample_cnt = min(n, self.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed)
+        in_sample = None
+        if sample_cnt < n:
+            sample_idx = rng.choice(n, sample_cnt, replace=False)
+            in_sample = np.zeros(n, bool)
+            in_sample[sample_idx] = True
+        if int(config.num_machines) > 1:
+            log_warning("Distributed bin finding is not implemented for "
+                        "sparse input; each host bins from its local "
+                        "sample")
+        cat_set = set(int(c) for c in categorical_features)
+        filter_cnt = int(max(
+            config.min_data_in_leaf * sample_cnt / max(n, 1), 1)) \
+            if config.feature_pre_filter else 0
+
+        indptr, indices, vals = csc.indptr, csc.indices, csc.data
+        self.bin_mappers = []
+        for j in range(num_features):
+            colv = vals[indptr[j]:indptr[j + 1]]
+            if in_sample is not None:
+                rows_j = indices[indptr[j]:indptr[j + 1]]
+                colv = colv[in_sample[rows_j]]
+            colv = np.asarray(colv, np.float64)
+            nonzero = colv[(np.abs(colv) > kZeroThreshold)
+                           | np.isnan(colv)]
+            mapper = BinMapper()
+            bt = BIN_TYPE_CATEGORICAL if j in cat_set \
+                else BIN_TYPE_NUMERICAL
+            fb = (forced_bins or {}).get(j, ())
+            mapper.find_bin(
+                nonzero, total_sample_cnt=sample_cnt,
+                max_bin=_max_bin_for(config, j),
+                min_data_in_bin=self.min_data_in_bin,
+                min_split_data=filter_cnt,
+                pre_filter=config.feature_pre_filter,
+                bin_type=bt, use_missing=self.use_missing,
+                zero_as_missing=self.zero_as_missing,
+                forced_upper_bounds=fb)
+            self.bin_mappers.append(mapper)
+        self._finalize_used_features()
+
+    def _extract_sparse(self, csc, config: Config, reference) -> None:
+        """CSC nonzeros -> (bundled) binned matrix, no [N, F]
+        intermediate: the EFB plan comes from a row SAMPLE; the full
+        matrix is written group-column by group-column."""
+        from .bundling import BundlePlan, plan_bundles_from_nonzeros
+        n = csc.shape[0]
+        f_used = self.num_features
+        indptr, indices = csc.indptr, csc.indices
+        vals = csc.data
+
+        nbins = self.num_bins_array()
+        max_b = int(nbins.max(initial=2))
+        dtype = np.uint8 if max_b <= 256 else np.uint16
+
+        zero_bin = np.zeros(max(f_used, 1), np.int64)
+        bins_nz: List[np.ndarray] = []
+        for inner, orig in enumerate(self.real_feature_idx):
+            m = self.bin_mappers[orig]
+            zero_bin[inner] = int(m.values_to_bins(np.zeros(1))[0])
+            bins_nz.append(m.values_to_bins(np.asarray(
+                vals[indptr[orig]:indptr[orig + 1]],
+                np.float64)).astype(dtype))
+
+        plan = None
+        if reference is not None:
+            if self.feature_group is not None:
+                plan = BundlePlan(self.feature_group, self.feature_offset,
+                                  len(self.group_num_bins),
+                                  self.group_num_bins)
+        elif config.enable_bundle and f_used >= 2 \
+                and config.tree_learner not in ("feature", "voting"):
+            # the planner only needs per-feature NON-DEFAULT row sets
+            # within a row sample — taken straight from the CSC
+            # structure, O(sample nnz), no binned sample matrix
+            take = min(n, self.bin_construct_sample_cnt)
+            if take < n:
+                rows = np.sort(np.random.RandomState(
+                    config.data_random_seed).choice(n, take,
+                                                    replace=False))
+                pos_of_row = np.full(n, -1, np.int32)
+                pos_of_row[rows] = np.arange(take, dtype=np.int32)
+            else:
+                pos_of_row = None
+            nz_idx: List[Optional[np.ndarray]] = []
+            for inner, orig in enumerate(self.real_feature_idx):
+                m = self.bin_mappers[orig]
+                ok = (m.bin_type == BIN_TYPE_NUMERICAL
+                      and m.most_freq_bin == 0 and m.default_bin == 0
+                      and m.num_bin <= 256)
+                if not ok:
+                    nz_idx.append(None)
+                    continue
+                rows_j = indices[indptr[orig]:indptr[orig + 1]]
+                nz = bins_nz[inner] != 0    # stored but bin-0 excluded
+                if pos_of_row is None:
+                    nz_idx.append(rows_j[nz].astype(np.int32))
+                else:
+                    pos = pos_of_row[rows_j[nz]]
+                    nz_idx.append(pos[pos >= 0])
+            if any(ix is not None for ix in nz_idx):
+                cand = plan_bundles_from_nonzeros(
+                    nz_idx, nbins, take, seed=config.data_random_seed)
+                if cand.num_groups < f_used:
+                    from ..utils.log import log_info
+                    log_info(f"EFB: bundled {f_used} sparse features "
+                             f"into {cand.num_groups} columns")
+                    plan = cand
+
+        g_count = plan.num_groups if plan is not None else max(f_used, 1)
+        out = np.zeros((n, g_count), dtype)
+        for inner in range(f_used):
+            orig = self.real_feature_idx[inner]
+            rows_j = indices[indptr[orig]:indptr[orig + 1]]
+            bj = bins_nz[inner]
+            if plan is None or plan.feature_offset[inner] == 0:
+                g = inner if plan is None else plan.feature_group[inner]
+                if zero_bin[inner]:
+                    out[:, g] = dtype(zero_bin[inner])
+                out[rows_j, g] = bj.astype(dtype)
+            else:
+                g = plan.feature_group[inner]
+                off = int(plan.feature_offset[inner])
+                nz = bj != 0
+                out[rows_j[nz], g] = (bj[nz].astype(np.int64) + off
+                                      - 1).astype(dtype)
+        self.binned = out
+        if plan is not None and reference is None:
+            self.feature_group = plan.feature_group
+            self.feature_offset = plan.feature_offset
+            self.group_num_bins = plan.group_num_bins
+
     def create_valid(self, data: np.ndarray,
                      label: Optional[Sequence[float]] = None,
                      weight: Optional[Sequence[float]] = None,
@@ -369,9 +590,10 @@ class Dataset:
                      min_data_in_bin=self.min_data_in_bin,
                      use_missing=self.use_missing,
                      zero_as_missing=self.zero_as_missing)
-        return Dataset.from_numpy(data, cfg, label=label, weight=weight,
-                                  group=group, init_score=init_score,
-                                  reference=self)
+        ctor = Dataset.from_scipy if is_sparse(data) \
+            else Dataset.from_numpy
+        return ctor(data, cfg, label=label, weight=weight,
+                    group=group, init_score=init_score, reference=self)
 
     def subset(self, indices: np.ndarray) -> "Dataset":
         """CopySubset (dataset.cpp) for bagging-style row subsets."""

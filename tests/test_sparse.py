@@ -1,0 +1,157 @@
+"""Sparse input path (Dataset.from_scipy): the raw float matrix is
+never densified; binned output is bit-identical to the dense path and
+trains identically (SparseBin / MultiValSparseBin story,
+src/io/sparse_bin.hpp + multi_val_sparse_bin.hpp, via the zero-bin +
+EFB design instead of delta-encoded pairs).
+"""
+
+import numpy as np
+import pytest
+
+sp = pytest.importorskip("scipy.sparse")
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data import Dataset
+
+
+def _bosch_like(n=2500, f=120, density=0.04, seed=11):
+    """Wide mostly-zero matrix with a learnable signal."""
+    rng = np.random.RandomState(seed)
+    M = rng.randn(n, f) * (rng.rand(n, f) < density)
+    # a few dense informative columns
+    M[:, 0] = rng.randn(n)
+    M[:, 1] = rng.randn(n)
+    y = (1.2 * M[:, 0] - M[:, 1] + 2.0 * (M[:, 5] != 0)
+         + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return M, y
+
+
+def test_sparse_binned_matches_dense():
+    M, y = _bosch_like()
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds_dense = Dataset.from_numpy(M, cfg, label=y)
+    ds_sparse = Dataset.from_scipy(sp.csr_matrix(M), cfg, label=y)
+    # identical mappers, bundling plan and binned bytes
+    assert ds_sparse.num_features == ds_dense.num_features
+    assert ds_sparse.num_groups == ds_dense.num_groups
+    np.testing.assert_array_equal(ds_sparse.binned, ds_dense.binned)
+    g_d, o_d, b_d = ds_dense.bundle_maps()
+    g_s, o_s, b_s = ds_sparse.bundle_maps()
+    np.testing.assert_array_equal(g_s, g_d)
+    np.testing.assert_array_equal(o_s, o_d)
+
+
+def test_sparse_bundles_wide_data():
+    """One-hot blocks (the canonical EFB shape: mutually exclusive
+    within a block) collapse to ~one group column per block."""
+    rng = np.random.RandomState(7)
+    n, blocks, card = 2500, 12, 10
+    cats = rng.randint(0, card, (n, blocks))
+    M = np.zeros((n, blocks * card))
+    M[np.arange(n)[:, None],
+      np.arange(blocks) * card + cats] = 1.0
+    y = (cats[:, 0] % 2 == 0).astype(np.float64)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = Dataset.from_scipy(sp.csr_matrix(M), cfg, label=y)
+    assert ds.num_groups <= blocks + 2, \
+        (ds.num_groups, ds.num_features)
+    assert ds.binned.dtype == np.uint8
+    # and it matches the dense path exactly
+    ds_d = Dataset.from_numpy(M, cfg, label=y)
+    np.testing.assert_array_equal(ds.binned, ds_d.binned)
+
+
+def test_sparse_trains_identically_to_dense():
+    M, y = _bosch_like(n=1500, f=60)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b_dense = lgb.train(params, lgb.Dataset(M, label=y),
+                        num_boost_round=8)
+    b_sparse = lgb.train(params, lgb.Dataset(sp.csr_matrix(M), label=y),
+                         num_boost_round=8)
+    np.testing.assert_allclose(b_sparse.predict(M), b_dense.predict(M),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_valid_set_aligned():
+    M, y = _bosch_like(n=2000, f=40)
+    Xtr, ytr, Xte, yte = M[:1500], y[:1500], M[1500:], y[1500:]
+    params = {"objective": "binary", "num_leaves": 15,
+              "metric": "binary_logloss", "verbosity": -1}
+    train = lgb.Dataset(sp.csr_matrix(Xtr), label=ytr)
+    valid = train.create_valid(sp.csr_matrix(Xte), label=yte)
+    evals = {}
+    bst = lgb.train(params, train, num_boost_round=10,
+                    valid_sets=[valid], valid_names=["va"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    curve = evals["va"]["binary_logloss"]
+    assert curve[-1] < curve[0]          # actually learned
+    # sparse valid predicts like dense valid
+    np.testing.assert_allclose(bst.predict(Xte),
+                               bst.predict(sp.csr_matrix(Xte).toarray()),
+                               rtol=1e-12)
+
+
+def test_sparse_nan_entries():
+    """Explicitly stored NaNs follow missing-value semantics."""
+    rng = np.random.RandomState(3)
+    M = rng.randn(800, 10) * (rng.rand(800, 10) < 0.3)
+    nan_rows = rng.rand(800) < 0.1
+    M[nan_rows, 2] = np.nan
+    y = (np.nan_to_num(M[:, 2]) + M[:, 0] > 0).astype(np.float64)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds_d = Dataset.from_numpy(M, cfg, label=y)
+    Ms = sp.csr_matrix(M)          # NaN is nonzero -> stored explicitly
+    ds_s = Dataset.from_scipy(Ms, cfg, label=y)
+    np.testing.assert_array_equal(ds_s.binned, ds_d.binned)
+
+
+def test_sparse_subset_for_bagging():
+    M, y = _bosch_like(n=1200, f=30)
+    cfg = Config.from_params({"objective": "binary",
+                              "bagging_fraction": 0.5, "bagging_freq": 1,
+                              "verbosity": -1})
+    bst = lgb.train({"objective": "binary", "bagging_fraction": 0.5,
+                     "bagging_freq": 1, "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(sp.csr_matrix(M), label=y),
+                    num_boost_round=5)
+    assert bst.current_iteration() == 5
+
+
+def test_sparse_duplicate_entries_sum():
+    """scipy semantics: duplicate stored entries SUM — must bin the
+    summed value exactly like the dense path (regression: last write
+    won instead)."""
+    rng = np.random.RandomState(9)
+    M = rng.randn(300, 4) * (rng.rand(300, 4) < 0.5)
+    coo = sp.coo_matrix(M)
+    # duplicate every stored entry, split in half
+    row = np.concatenate([coo.row, coo.row])
+    col = np.concatenate([coo.col, coo.col])
+    dat = np.concatenate([coo.data * 0.25, coo.data * 0.75])
+    dup = sp.csc_matrix((dat, (row, col)), shape=M.shape)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    y = np.zeros(300)
+    ds_d = Dataset.from_numpy(dup.toarray(), cfg, label=y)
+    ds_s = Dataset.from_scipy(dup, cfg, label=y)
+    np.testing.assert_array_equal(ds_s.binned, ds_d.binned)
+
+
+def test_sparse_does_not_mutate_caller():
+    """from_scipy must not reorder/canonicalize the caller's arrays."""
+    row = np.array([2, 0, 1, 0])
+    col = np.array([0, 0, 1, 1])
+    dat = np.array([1.0, 2.0, 3.0, 4.0])
+    X = sp.csc_matrix((dat, (row, col)), shape=(3, 2))
+    # force a non-canonical CSC the user holds references into
+    X.indices[:] = X.indices[::-1].copy()
+    X.data[:] = X.data[::-1].copy()
+    X.has_sorted_indices = False
+    ind_before = X.indices.copy()
+    dat_before = X.data.copy()
+    Dataset.from_scipy(X, Config.from_params({"objective": "binary",
+                                              "verbosity": -1}),
+                       label=np.zeros(3))
+    np.testing.assert_array_equal(X.indices, ind_before)
+    np.testing.assert_array_equal(X.data, dat_before)
