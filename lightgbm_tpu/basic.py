@@ -473,10 +473,13 @@ class Booster:
         if num_iteration is None:
             num_iteration = self.best_iteration \
                 if self.best_iteration > 0 else -1
+        es_kw = {k: v for k, v in kwargs.items()
+                 if k in ("pred_early_stop", "pred_early_stop_freq",
+                          "pred_early_stop_margin")}
         from .predictor import predict as _predict
         return _predict(self._src(), data, num_iteration=num_iteration,
                         raw_score=raw_score, pred_leaf=pred_leaf,
-                        pred_contrib=pred_contrib)
+                        pred_contrib=pred_contrib, **es_kw)
 
     # ------------------------------------------------------------------
     def refit(self, data, label, decay_rate: float = 0.9,

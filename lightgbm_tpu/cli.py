@@ -122,6 +122,11 @@ def run_predict(params: Dict[str, str]) -> None:
     X = _load_predict_data(cfg.data, cfg)
     ni = int(cfg.num_iteration_predict)
     kwargs = dict(num_iteration=ni if ni > 0 else -1)
+    if cfg.pred_early_stop:
+        kwargs.update(
+            pred_early_stop=True,
+            pred_early_stop_freq=int(cfg.pred_early_stop_freq),
+            pred_early_stop_margin=float(cfg.pred_early_stop_margin))
     if cfg.predict_leaf_index:
         pred = booster.predict(X, pred_leaf=True, **kwargs)
     elif cfg.predict_contrib:

@@ -185,10 +185,6 @@ _UNIMPLEMENTED_PARAMS = {
     "cegb_penalty_split": "cost-effective gradient boosting",
     "cegb_penalty_feature_lazy": "cost-effective gradient boosting",
     "cegb_penalty_feature_coupled": "cost-effective gradient boosting",
-    "pred_early_stop": "prediction early stopping (documented skip: "
-                       "batched device prediction has no row loop)",
-    "pred_early_stop_freq": "prediction early stopping",
-    "pred_early_stop_margin": "prediction early stopping",
     "forcedbins_filename": "forced bin bounds file",
 }
 

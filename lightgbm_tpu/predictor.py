@@ -22,7 +22,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-from .utils.log import log_fatal
+from .utils.log import log_fatal, log_warning
 
 
 def _model_list(src, num_iteration: int) -> List:
@@ -58,7 +58,9 @@ def _convert(src, raw: np.ndarray) -> np.ndarray:
 
 def predict(src, data: np.ndarray, num_iteration: int = -1,
             raw_score: bool = False, pred_leaf: bool = False,
-            pred_contrib: bool = False) -> np.ndarray:
+            pred_contrib: bool = False, pred_early_stop: bool = False,
+            pred_early_stop_freq: int = 10,
+            pred_early_stop_margin: float = 10.0) -> np.ndarray:
     """Unified prediction entry (Predictor closure dispatch,
     predictor.hpp:39-131)."""
     data = np.asarray(data, np.float64)
@@ -78,13 +80,19 @@ def predict(src, data: np.ndarray, num_iteration: int = -1,
     dataset = None
     if getattr(src, "learner", None) is not None:
         dataset = src.learner.dataset
-    if dataset is not None and models \
-            and n * len(models) >= (1 << 16):
-        raw = _device_predict(models, data, dataset, k)
-    else:
-        raw = np.zeros((n, k))
-        for i, t in enumerate(models):
-            raw[:, i % k] += t.predict(data)
+    raw = None
+    if pred_early_stop:
+        raw = _predict_raw_early_stop(src, models, data, k,
+                                      pred_early_stop_freq,
+                                      pred_early_stop_margin)
+    if raw is None:
+        if dataset is not None and models \
+                and n * len(models) >= (1 << 16):
+            raw = _device_predict(models, data, dataset, k)
+        else:
+            raw = np.zeros((n, k))
+            for i, t in enumerate(models):
+                raw[:, i % k] += t.predict(data)
     if getattr(src, "average_output", False) and models:
         raw /= max(len(models) // k, 1)
     raw = raw if k > 1 else raw[:, 0]
@@ -94,6 +102,59 @@ def predict(src, data: np.ndarray, num_iteration: int = -1,
 
 
 # ----------------------------------------------------------------------
+def _predict_raw_early_stop(src, models, data, k: int, freq: int,
+                            margin: float) -> np.ndarray:
+    """Margin-based prediction early stopping
+    (src/boosting/prediction_early_stop.cpp:13-88 +
+    GBDT::PredictRaw round_period loop, gbdt_prediction.cpp:13-31).
+
+    Rows whose margin crosses the threshold stop accumulating trees:
+    binary margin = 2*|score| (= |log-odds gap|), multiclass margin =
+    top1 - top2. Only meaningful for binary / multiclass — the
+    reference Fatals on other objectives; here anything else warns and
+    predicts normally (returns None so the caller uses its regular
+    dispatch, including the batched device path).
+    """
+    obj = getattr(src, "objective", None)
+    if obj is not None and not isinstance(obj, str):
+        try:
+            name = obj.name().split(" ")[0]
+        except NotImplementedError:
+            name = ""
+    else:
+        name = getattr(src, "objective_str", "").split(" ")[0]
+    binary_like = k == 1 and name in ("binary", "cross_entropy",
+                                      "cross_entropy_lambda")
+    if not binary_like and k < 2:
+        log_warning("pred_early_stop is only supported for binary and "
+                    "multiclass objectives; predicting normally")
+        return None
+    if getattr(src, "average_output", False):
+        # RF averages raw scores over all trees; a per-row early stop
+        # would divide a partial sum by the full tree count
+        log_warning("pred_early_stop is not supported with "
+                    "average_output (random forest); predicting "
+                    "normally")
+        return None
+
+    n = data.shape[0]
+    raw = np.zeros((n, k))
+    active = np.arange(n)
+    period = max(int(freq), 1) * k
+    for i, t in enumerate(models):
+        if len(active) == 0:
+            break
+        raw[active, i % k] += t.predict(data[active])
+        if (i + 1) % period == 0 and (i + 1) < len(models):
+            if k == 1:
+                m = 2.0 * np.abs(raw[active, 0])
+            else:
+                top2 = np.partition(raw[active], k - 2, axis=1)
+                m = top2[:, k - 1] - top2[:, k - 2]
+            active = active[m < margin]
+    return raw
+
+
 def _device_predict(models, data, dataset, k: int) -> np.ndarray:
     """All trees x all rows in ONE device dispatch: re-bin the input
     with the training mappers (exact semantics — the raw threshold of

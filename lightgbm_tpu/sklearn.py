@@ -229,7 +229,7 @@ class LGBMModel(BaseEstimator):
                                 "before exploiting the model.")
         return self._Booster.predict(
             X, raw_score=raw_score, num_iteration=num_iteration,
-            pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib, **kwargs)
 
     @property
     def booster_(self) -> Booster:

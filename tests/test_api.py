@@ -290,3 +290,77 @@ def test_dataset_subset_and_sidecars():
     sub.construct()
     assert sub.num_data() == 300
     assert ds.num_data() == 600
+
+
+def test_pred_early_stop_binary():
+    """Margin-based prediction early stop
+    (prediction_early_stop.cpp): margin=inf reproduces the exact
+    prediction; a small margin freezes confident rows early (an
+    approximation) while hard labels stay the same."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 6)
+    y = (2.5 * X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=60)
+    full = bst.predict(X, raw_score=True)
+    huge = bst.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=5,
+                       pred_early_stop_margin=np.inf)
+    np.testing.assert_allclose(huge, full, rtol=1e-12)
+    approx = bst.predict(X, raw_score=True, pred_early_stop=True,
+                         pred_early_stop_freq=5,
+                         pred_early_stop_margin=2.0)
+    assert not np.allclose(approx, full)          # it actually engaged
+    assert ((approx > 0) == (full > 0)).mean() > 0.98
+
+
+def test_pred_early_stop_multiclass_and_warn():
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(5)
+    X = rng.randn(500, 5)
+    y = np.argmax(np.stack([X[:, 0], X[:, 1], -X[:, 0]], 1), 1)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y.astype(float)),
+                    num_boost_round=30)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=3,
+                     pred_early_stop_margin=3.0)
+    assert (np.argmax(es, 1) == np.argmax(full, 1)).mean() > 0.98
+    # regression booster: warns and predicts normally
+    yb = X[:, 0]
+    breg = lgb.train({"objective": "regression", "verbosity": -1},
+                     lgb.Dataset(X, label=yb), num_boost_round=5)
+    np.testing.assert_allclose(
+        breg.predict(X, pred_early_stop=True), breg.predict(X),
+        rtol=1e-12)
+
+
+def test_pred_early_stop_rf_disabled_and_sklearn_forwarding():
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(6)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(float)
+    rf = lgb.train({"objective": "binary", "boosting": "rf",
+                    "bagging_fraction": 0.7, "bagging_freq": 1,
+                    "num_leaves": 15, "verbosity": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    # RF averages over ALL trees; early stop must be refused, result
+    # identical to the normal prediction
+    np.testing.assert_allclose(
+        rf.predict(X, pred_early_stop=True,
+                   pred_early_stop_margin=0.1),
+        rf.predict(X), rtol=1e-12)
+    # sklearn wrapper forwards the kwargs to Booster.predict
+    clf = lgb.LGBMClassifier(n_estimators=40, verbosity=-1)
+    clf.fit(X, y.astype(int))
+    full = clf.predict_proba(X)
+    es = clf.predict_proba(X, pred_early_stop=True,
+                           pred_early_stop_freq=4,
+                           pred_early_stop_margin=2.0)
+    assert not np.allclose(es, full)       # kwargs actually reached it
