@@ -245,6 +245,16 @@ class Dataset:
             self._inner.metadata.set_init_score(init_score)
         return self
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append the other dataset's features to this one in place
+        (reference basic.py Dataset.add_features_from ->
+        Dataset::AddFeaturesFrom). Both must be constructed and hold
+        the same rows; this dataset keeps its label/weight/group."""
+        self.construct()
+        other.construct()
+        self._inner.add_features_from(other._inner)
+        return self
+
     def set_reference(self, reference: "Dataset") -> "Dataset":
         self.reference = reference
         return self

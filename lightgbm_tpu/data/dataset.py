@@ -595,6 +595,63 @@ class Dataset:
         return ctor(data, cfg, label=label, weight=weight,
                     group=group, init_score=init_score, reference=self)
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append another dataset's features in place
+        (``Dataset::AddFeaturesFrom``, src/io/dataset.cpp /
+        dataset.h:497). Both datasets must hold the same rows; ``self``
+        keeps its metadata (label/weight/query). Bundled layouts are
+        preserved — the other dataset's group columns are appended with
+        shifted group ids."""
+        if other.num_data != self.num_data:
+            log_fatal("Cannot add features from a dataset with "
+                      f"{other.num_data} rows to one with "
+                      f"{self.num_data} rows")
+        f_self = self.num_features
+        base_orig = self.num_total_features
+
+        if self.feature_group is not None \
+                or other.feature_group is not None:
+            g_s, o_s, b_s = self.bundle_maps()
+            g_o, o_o, b_o = other.bundle_maps()
+            self.feature_group = np.concatenate(
+                [g_s, g_o + len(b_s)]).astype(np.int32)
+            self.feature_offset = np.concatenate([o_s, o_o]).astype(
+                np.int32)
+            self.group_num_bins = np.concatenate([b_s, b_o]).astype(
+                np.int32)
+
+        dtype = self.binned.dtype \
+            if self.binned.dtype.itemsize >= other.binned.dtype.itemsize \
+            else other.binned.dtype
+        self.binned = np.concatenate(
+            [self.binned.astype(dtype, copy=False),
+             other.binned.astype(dtype, copy=False)], axis=1)
+        self._binned_device = None
+
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.used_feature_map = list(self.used_feature_map) + [
+            (-1 if m < 0 else m + f_self) for m in other.used_feature_map]
+        self.real_feature_idx = list(self.real_feature_idx) + [
+            r + base_orig for r in other.real_feature_idx]
+        self.num_total_features += other.num_total_features
+        self.feature_names = list(self.feature_names) + \
+            list(other.feature_names)
+
+        def _ext(a, b, fill, n_a, n_b):
+            a = list(a) if a else [fill] * n_a
+            b = list(b) if b else [fill] * n_b
+            return a + b
+        f_other = other.num_features
+        if self.monotone_types or other.monotone_types:
+            self.monotone_types = _ext(self.monotone_types,
+                                       other.monotone_types, 0,
+                                       f_self, f_other)
+        if self.feature_penalty or other.feature_penalty:
+            self.feature_penalty = _ext(self.feature_penalty,
+                                        other.feature_penalty, 1.0,
+                                        f_self, f_other)
+        return self
+
     def subset(self, indices: np.ndarray) -> "Dataset":
         """CopySubset (dataset.cpp) for bagging-style row subsets."""
         indices = np.asarray(indices)

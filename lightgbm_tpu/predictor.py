@@ -139,19 +139,30 @@ def _predict_raw_early_stop(src, models, data, k: int, freq: int,
 
     n = data.shape[0]
     raw = np.zeros((n, k))
-    active = np.arange(n)
+    # while every row is still live, use whole-matrix writes — the
+    # fancy-indexed path would copy [n, F] per tree for nothing
+    active = None
     period = max(int(freq), 1) * k
     for i, t in enumerate(models):
-        if len(active) == 0:
+        if active is None:
+            raw[:, i % k] += t.predict(data)
+        elif len(active) == 0:
             break
-        raw[active, i % k] += t.predict(data[active])
+        else:
+            raw[active, i % k] += t.predict(data[active])
         if (i + 1) % period == 0 and (i + 1) < len(models):
+            sub = raw if active is None else raw[active]
             if k == 1:
-                m = 2.0 * np.abs(raw[active, 0])
+                m = 2.0 * np.abs(sub[:, 0])
             else:
-                top2 = np.partition(raw[active], k - 2, axis=1)
+                top2 = np.partition(sub, k - 2, axis=1)
                 m = top2[:, k - 1] - top2[:, k - 2]
-            active = active[m < margin]
+            live = m < margin
+            if active is None:
+                if not live.all():
+                    active = np.nonzero(live)[0]
+            else:
+                active = active[live]
     return raw
 
 

@@ -364,3 +364,64 @@ def test_pred_early_stop_rf_disabled_and_sklearn_forwarding():
                            pred_early_stop_freq=4,
                            pred_early_stop_margin=2.0)
     assert not np.allclose(es, full)       # kwargs actually reached it
+
+
+def test_add_features_from():
+    """Dataset.add_features_from appends columns in place
+    (Dataset::AddFeaturesFrom): training on the merged dataset equals
+    training on the hstacked matrix."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(12)
+    A = rng.randn(700, 4)
+    B = rng.randn(700, 3)
+    y = (A[:, 0] + B[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+
+    da = lgb.Dataset(A, label=y)
+    db = lgb.Dataset(B)
+    da.add_features_from(db)
+    merged = lgb.train(params, da, num_boost_round=8)
+
+    ref = lgb.train(params, lgb.Dataset(np.hstack([A, B]), label=y),
+                    num_boost_round=8)
+    X = np.hstack([A, B])
+    np.testing.assert_allclose(merged.predict(X), ref.predict(X),
+                               rtol=1e-7)
+
+    # row mismatch is fatal
+    import pytest
+    from lightgbm_tpu.utils import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(A, label=y).add_features_from(
+            lgb.Dataset(rng.randn(100, 2)))
+
+
+def test_add_features_from_sparse_bundled():
+    """Merging a bundled (sparse one-hot) dataset keeps its EFB plan
+    with shifted group ids."""
+    import numpy as np
+    import pytest
+    sps = pytest.importorskip("scipy.sparse")
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(13)
+    n = 1000
+    A = rng.randn(n, 3)
+    cats = rng.randint(0, 8, (n, 6))
+    H = np.zeros((n, 48))
+    H[np.arange(n)[:, None], np.arange(6) * 8 + cats] = 1.0
+    y = ((cats[:, 0] % 2 == 0) & (A[:, 0] > 0)).astype(float)
+
+    da = lgb.Dataset(A, label=y).construct()
+    db = lgb.Dataset(sps.csr_matrix(H)).construct()
+    groups_b = db._inner.num_groups
+    da.add_features_from(db)
+    inner = da._inner
+    assert inner.num_features == 3 + db._inner.num_features
+    assert inner.num_groups == 3 + groups_b       # plans concatenated
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, da, num_boost_round=10)
+    X = np.hstack([A, H])
+    pred = bst.predict(X)
+    auc = (pred[y == 1][:, None] > pred[y == 0][None, :]).mean()
+    assert auc > 0.9, auc
