@@ -181,10 +181,9 @@ def _parse_list(value: Any, typ) -> list:
 
 
 _UNIMPLEMENTED_PARAMS = {
-    "cegb_tradeoff": "cost-effective gradient boosting",
-    "cegb_penalty_split": "cost-effective gradient boosting",
-    "cegb_penalty_feature_lazy": "cost-effective gradient boosting",
-    "cegb_penalty_feature_coupled": "cost-effective gradient boosting",
+    "cegb_penalty_feature_lazy": "CEGB per-datum lazy feature penalty "
+                                 "(split + coupled penalties ARE "
+                                 "implemented)",
     "forcedbins_filename": "forced bin bounds file",
 }
 

@@ -38,7 +38,7 @@ from ..ops.hist_pallas import (build_matrix, extract_row_ids,
                                histogram_segment, pack_gh)
 from ..ops.partition_pallas import bitset_to_lut, partition_segment
 from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
-from .serial import (GrowResult, NodeRandMixin,
+from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
                      feature_meta_from_dataset, forced_left_sums,
                      forced_split_override, make_node_rand,
                      split_params_from_config)
@@ -47,7 +47,7 @@ HIST_BLK = 2048
 PART_BLK = 512
 
 
-class PartitionedLearnerBase(NodeRandMixin):
+class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
     """Shared setup / host-tree conversion for the single-device and
     mesh partitioned learners (one source of truth for the uint8 bin
     cap, categorical params and interpret default)."""
@@ -84,6 +84,7 @@ class PartitionedLearnerBase(NodeRandMixin):
         from .serial import use_hist_cache
         self.cache_hists = use_hist_cache(
             config, self.num_leaves, self.num_groups, self.num_bins_max)
+        self._init_cegb()
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -112,7 +113,7 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
         rand_key = self.next_tree_key()
         self.mat, self.ws, tree, leaf_id = _grow_partitioned(
             self.mat, self.ws, grad, hess, bag_weight, feature_mask,
-            self.meta, rand_key,
+            self.meta, rand_key, getattr(self, "_cegb_used", None),
             params=self.params, num_leaves=self.num_leaves,
             max_depth=self.max_depth, num_bins_max=self.num_bins_max,
             num_features=self.num_features, num_groups=self.num_groups,
@@ -120,7 +121,9 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             interpret=self.interpret, extra_trees=self.extra_trees,
             ff_bynode=self.ff_bynode, bynode_count=self.bynode_count,
             forced_plan=self.forced_plan, cache_hists=self.cache_hists)
-        return GrowResult(tree=tree, leaf_id=leaf_id)
+        res = GrowResult(tree=tree, leaf_id=leaf_id)
+        self._cegb_after_tree(res)
+        return res
 
 
 @functools.partial(
@@ -131,9 +134,10 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
                               "forced_plan", "cache_hists"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
-                      rand_key=None, *, params, num_leaves, max_depth,
-                      num_bins_max, num_features, num_groups, n, bundled,
-                      interpret, extra_trees=False, ff_bynode=1.0,
+                      rand_key=None, cegb_used0=None, *, params,
+                      num_leaves, max_depth, num_bins_max, num_features,
+                      num_groups, n, bundled, interpret,
+                      extra_trees=False, ff_bynode=1.0,
                       bynode_count=2, forced_plan=(), cache_hists=True):
     return grow_partitioned(
         mat, ws, grad, hess, bag_weight, feature_mask, meta,
@@ -142,7 +146,8 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         num_features=num_features, num_groups=num_groups, n=n,
         bundled=bundled, interpret=interpret, extra_trees=extra_trees,
         ff_bynode=ff_bynode, bynode_count=bynode_count,
-        forced_plan=forced_plan, cache_hists=cache_hists)
+        forced_plan=forced_plan, cache_hists=cache_hists,
+        cegb_used0=cegb_used0)
 
 
 def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
@@ -150,7 +155,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      num_bins_max, num_features, num_groups, n, bundled,
                      interpret, extra_trees=False, ff_bynode=1.0,
                      bynode_count=2, forced_plan=(), comm=None,
-                     row_id_base=0, n_total=None, cache_hists=True):
+                     row_id_base=0, n_total=None, cache_hists=True,
+                     cegb_used0=None):
     """Traceable partitioned grow loop.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py)
@@ -194,7 +200,11 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
                                meta.num_bins, extra_trees, ff_bynode)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
+    if params.cegb_on and cegb_used0 is None:
+        cegb_used0 = jnp.zeros((num_features,), bool)
+
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt,
+                  cegb_used=None):
         if bundled:
             from ..ops.histogram import debundle_hist
             hist = debundle_hist(hist, meta.group, meta.offset,
@@ -202,7 +212,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta, params,
-                                cmin, cmax, fm, rand_bins=rb)
+                                cmin, cmax, fm, rand_bins=rb,
+                                cegb_used=cegb_used)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
@@ -214,7 +225,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     root_hist = comm.reduce_hist(local_root)
     root_g, root_h, root_c = sums[0], sums[1], sums[2]
     root_split = scan_leaf(root_hist, root_g, root_h, root_c,
-                           jnp.int32(0), -inf, inf, jnp.int32(0))
+                           jnp.int32(0), -inf, inf, jnp.int32(0),
+                           cegb_used=cegb_used0)
     root_out = leaf_output_no_constraint(
         root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
         params.max_delta_step)
@@ -267,6 +279,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     if cache_hists:
         state["hist"] = at0(
             jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
+    if params.cegb_on:
+        state["cegb_used"] = cegb_used0
 
     leaf_range = jnp.arange(big_l)
 
@@ -398,10 +412,12 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         cmax_r = jnp.where(numerical & (mono < 0),
                            jnp.minimum(pcmax, mid), pcmax)
 
+        cu = st["cegb_used"].at[feat].set(True) if params.cegb_on \
+            else None
         split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
-                            2 * k + 1)
+                            2 * k + 1, cegb_used=cu)
         split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
-                            2 * k + 2)
+                            2 * k + 2, cegb_used=cu)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)
@@ -410,6 +426,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         if cache_hists:
             st2["hist"] = st["hist"].at[leaf].set(hist_left) \
                 .at[new].set(hist_right)
+        if params.cegb_on:
+            st2["cegb_used"] = cu
         st2.update(
             k=k + 1,
             mat=mat2, ws=ws2,

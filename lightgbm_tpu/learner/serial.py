@@ -68,6 +68,11 @@ def feature_meta_from_dataset(dataset: Dataset,
     penalty = np.asarray(dataset.feature_penalty, np.float32) \
         if dataset.feature_penalty else np.ones(f, np.float32)
     group, offset, _ = dataset.bundle_maps()
+    coupled_cfg = list(config.cegb_penalty_feature_coupled)
+    cegb_coupled = np.zeros(f, np.float32)
+    for inner, orig in enumerate(dataset.real_feature_idx):
+        if orig < len(coupled_cfg):
+            cegb_coupled[inner] = float(coupled_cfg[orig])
     return FeatureMeta(
         num_bins=jnp.asarray(num_bins), missing=jnp.asarray(missing),
         default_bin=jnp.asarray(default_bin),
@@ -75,7 +80,8 @@ def feature_meta_from_dataset(dataset: Dataset,
         monotone=jnp.asarray(monotone), penalty=jnp.asarray(penalty),
         is_categorical=jnp.asarray(is_cat),
         group=jnp.asarray(np.asarray(group, np.int32)),
-        offset=jnp.asarray(np.asarray(offset, np.int32)))
+        offset=jnp.asarray(np.asarray(offset, np.int32)),
+        cegb_coupled_penalty=jnp.asarray(cegb_coupled))
 
 
 def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
@@ -227,7 +233,14 @@ def use_hist_cache(config: Config, num_leaves: int, f: int,
 
 
 def split_params_from_config(config: Config) -> SplitParams:
+    coupled = list(config.cegb_penalty_feature_coupled)
+    cegb_on = float(config.cegb_tradeoff) > 0.0 and (
+        float(config.cegb_penalty_split) > 0.0
+        or any(float(c) > 0.0 for c in coupled))
     return SplitParams(
+        cegb_on=cegb_on,
+        cegb_tradeoff=float(config.cegb_tradeoff),
+        cegb_penalty_split=float(config.cegb_penalty_split),
         lambda_l1=float(config.lambda_l1),
         lambda_l2=float(config.lambda_l2),
         max_delta_step=float(config.max_delta_step),
@@ -339,7 +352,41 @@ def make_node_rand(rand_keys, feature_mask, bynode_count, num_bins,
     return node_rand
 
 
-class SerialTreeLearner(NodeRandMixin):
+class CegbStateMixin:
+    """Cross-tree CEGB feature-acquisition state: the coupled penalty
+    applies until a feature's FIRST use anywhere in the model
+    (CostEfficientGradientBoosting::UpdateUsedFeature); the used set
+    persists across iterations on the learner."""
+
+    def _init_cegb(self) -> None:
+        self._cegb_used = (
+            jnp.zeros((self.dataset.num_features,), bool)
+            if self.params.cegb_on else None)
+
+    def _drop_cegb(self) -> None:
+        """CEGB's cross-split feature-used state is indexed by global
+        feature id; the feature-sharded mesh learners scan local
+        shards, so penalties are not supported on the mesh learners
+        (the reference ties CEGB to the serial learner too)."""
+        if self.params.cegb_on:
+            from ..utils.log import log_warning
+            log_warning("cegb_* penalties are not supported by parallel "
+                        "tree learners; ignoring them")
+            self.params = self.params._replace(cegb_on=False)
+            self._cegb_used = None
+
+    def _cegb_after_tree(self, result: "GrowResult") -> None:
+        if getattr(self, "_cegb_used", None) is None:
+            return
+        ta = result.tree
+        valid = jnp.arange(ta.split_feature.shape[0]) \
+            < (ta.num_leaves - 1)
+        upd = jnp.zeros_like(self._cegb_used) \
+            .at[ta.split_feature].max(valid)
+        self._cegb_used = self._cegb_used | upd
+
+
+class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
     """Owns the device copy of the dataset and the compiled grow program."""
 
     def __init__(self, dataset: Dataset, config: Config,
@@ -364,6 +411,7 @@ class SerialTreeLearner(NodeRandMixin):
         self.cache_hists = use_hist_cache(
             config, self.num_leaves, self.binned.shape[1],
             self.num_bins_max)
+        self._init_cegb()
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_weight: Optional[jnp.ndarray] = None,
@@ -374,19 +422,22 @@ class SerialTreeLearner(NodeRandMixin):
             feature_mask = jnp.ones((self.dataset.num_features,), bool)
         # module-level jit: learners with equal shapes/params share the
         # compiled executable (tests and per-class trainers hit the cache)
-        return _grow_jit(self.binned, grad, hess, bag_weight, feature_mask,
-                         self.meta, rand_key=self.next_tree_key(),
-                         params=self.params,
-                         num_leaves=self.num_leaves,
-                         max_depth=self.max_depth,
-                         num_bins_max=self.num_bins_max,
-                         hist_method=self.hist_method,
-                         bundled=self.bundled,
-                         extra_trees=self.extra_trees,
-                         ff_bynode=self.ff_bynode,
-                         bynode_count=self.bynode_count,
-                         forced_plan=self.forced_plan,
-                         cache_hists=self.cache_hists)
+        res = _grow_jit(self.binned, grad, hess, bag_weight, feature_mask,
+                        self.meta, rand_key=self.next_tree_key(),
+                        cegb_used0=getattr(self, "_cegb_used", None),
+                        params=self.params,
+                        num_leaves=self.num_leaves,
+                        max_depth=self.max_depth,
+                        num_bins_max=self.num_bins_max,
+                        hist_method=self.hist_method,
+                        bundled=self.bundled,
+                        extra_trees=self.extra_trees,
+                        ff_bynode=self.ff_bynode,
+                        bynode_count=self.bynode_count,
+                        forced_plan=self.forced_plan,
+                        cache_hists=self.cache_hists)
+        self._cegb_after_tree(res)
+        return res
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:
@@ -402,8 +453,8 @@ class SerialTreeLearner(NodeRandMixin):
                               "extra_trees", "ff_bynode", "bynode_count",
                               "forced_plan", "cache_hists"))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
-              rand_key=None, *, params, num_leaves, max_depth,
-              num_bins_max, hist_method, bundled=False,
+              rand_key=None, cegb_used0=None, *, params, num_leaves,
+              max_depth, num_bins_max, hist_method, bundled=False,
               extra_trees=False, ff_bynode=1.0, bynode_count=2,
               forced_plan=(), cache_hists=True):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
@@ -412,7 +463,8 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
                      hist_method=hist_method, bundled=bundled,
                      rand_key=rand_key, extra_trees=extra_trees,
                      ff_bynode=ff_bynode, bynode_count=bynode_count,
-                     forced_plan=forced_plan, cache_hists=cache_hists)
+                     forced_plan=forced_plan, cache_hists=cache_hists,
+                     cegb_used0=cegb_used0)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -422,8 +474,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               bundled: bool = False, rand_key=None,
               extra_trees: bool = False, ff_bynode: float = 1.0,
               bynode_count=2, bynode_cap: int | None = None,
-              forced_plan: tuple = (), cache_hists: bool = True
-              ) -> GrowResult:
+              forced_plan: tuple = (), cache_hists: bool = True,
+              cegb_used0=None) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -465,7 +517,11 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                                meta_hist.num_bins, extra_trees, ff_bynode,
                                bynode_cap=bynode_cap)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
+    if params.cegb_on and cegb_used0 is None:
+        cegb_used0 = jnp.zeros((meta_hist.num_bins.shape[0],), bool)
+
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt,
+                  cegb_used=None):
         if bundled:
             # EFB: group histograms -> per-feature histograms
             from ..ops.histogram import debundle_hist
@@ -474,12 +530,14 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta_hist, params,
-                                cmin, cmax, fm, rand_bins=rb)
+                                cmin, cmax, fm, rand_bins=rb,
+                                cegb_used=cegb_used)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
     root_split = scan_leaf(root_hist, root_g, root_h, root_c,
-                           jnp.int32(0), -inf, inf, jnp.int32(0))
+                           jnp.int32(0), -inf, inf, jnp.int32(0),
+                           cegb_used=cegb_used0)
 
     def at0(arr, val):
         return arr.at[0].set(val)
@@ -538,6 +596,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         state["hist"] = at0(
             jnp.zeros((big_l, num_features_hist, b, 3), jnp.float32),
             root_hist)
+    if params.cegb_on:
+        state["cegb_used"] = cegb_used0
 
     leaf_range = jnp.arange(big_l)
 
@@ -645,10 +705,14 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                            jnp.minimum(pcmax, mid), pcmax)
 
         # ---- child best splits ---------------------------------------
+        # CEGB: the feature just split is "acquired" for the children's
+        # scans and every later split (OnSplit marking)
+        cu = st["cegb_used"].at[feat].set(True) if params.cegb_on \
+            else None
         split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
-                            2 * k + 1)
+                            2 * k + 1, cegb_used=cu)
         split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
-                            2 * k + 2)
+                            2 * k + 2, cegb_used=cu)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)
@@ -657,6 +721,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         if cache_hists:
             st2["hist"] = st["hist"].at[leaf].set(hist_left) \
                 .at[new].set(hist_right)
+        if params.cegb_on:
+            st2["cegb_used"] = cu
         st2.update(
             k=k + 1,
             leaf_id=leaf_id,

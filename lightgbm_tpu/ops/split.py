@@ -54,6 +54,8 @@ class FeatureMeta(NamedTuple):
     # each feature and its value offset inside it (0 = raw bins)
     group: jnp.ndarray = None    # int32
     offset: jnp.ndarray = None   # int32
+    # CEGB per-feature coupled acquisition penalty (zeros when off)
+    cegb_coupled_penalty: jnp.ndarray = None  # float32
 
 
 class SplitParams(NamedTuple):
@@ -73,6 +75,11 @@ class SplitParams(NamedTuple):
     # static gate: compile the categorical scan only when the dataset
     # has categorical features (set by the learner)
     has_categorical: bool = False
+    # CEGB (cost_effective_gradient_boosting.hpp:50-61): static gate +
+    # scalar penalties; the per-feature coupled penalty rides FeatureMeta
+    cegb_on: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
 
 
 class SplitResult(NamedTuple):
@@ -302,7 +309,8 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                        meta: FeatureMeta, params: SplitParams,
                        constraint_min=None, constraint_max=None,
                        feature_mask: jnp.ndarray | None = None,
-                       rand_bins: jnp.ndarray | None = None
+                       rand_bins: jnp.ndarray | None = None,
+                       cegb_used: jnp.ndarray | None = None
                        ) -> PerFeatureSplits:
     """Numerical + categorical per-feature scan, merged per feature.
 
@@ -320,29 +328,46 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     pf = per_feature_numerical(hist, parent_g, parent_h, parent_c, meta,
                                params, constraint_min, constraint_max,
                                feature_mask, rand_bins)
-    if not params.has_categorical:
-        return pf
-    from .split_categorical import per_feature_categorical
-    cat = per_feature_categorical(hist, parent_g, parent_h, parent_c, meta,
-                                  params, constraint_min, constraint_max,
-                                  feature_mask)
-    use = meta.is_categorical
+    if params.has_categorical:
+        from .split_categorical import per_feature_categorical
+        cat = per_feature_categorical(hist, parent_g, parent_h, parent_c,
+                                      meta, params, constraint_min,
+                                      constraint_max, feature_mask)
+        use = meta.is_categorical
 
-    def sel(a, b):
-        return jnp.where(use, a, b) if a.ndim == 1 \
-            else jnp.where(use[:, None], a, b)
+        def sel(a, b):
+            return jnp.where(use, a, b) if a.ndim == 1 \
+                else jnp.where(use[:, None], a, b)
 
-    return PerFeatureSplits(
-        score=sel(cat["score"], pf.score),
-        threshold=pf.threshold,
-        left_g=sel(cat["left_g"], pf.left_g),
-        left_h=sel(cat["left_h"], pf.left_h),
-        left_c=sel(cat["left_c"], pf.left_c),
-        default_left=jnp.where(use, False, pf.default_left),
-        left_output=sel(cat["left_output"], pf.left_output),
-        right_output=sel(cat["right_output"], pf.right_output),
-        is_cat=use & jnp.isfinite(cat["score"]),
-        cat_bitset=sel(cat["bitset"], pf.cat_bitset))
+        pf = PerFeatureSplits(
+            score=sel(cat["score"], pf.score),
+            threshold=pf.threshold,
+            left_g=sel(cat["left_g"], pf.left_g),
+            left_h=sel(cat["left_h"], pf.left_h),
+            left_c=sel(cat["left_c"], pf.left_c),
+            default_left=jnp.where(use, False, pf.default_left),
+            left_output=sel(cat["left_output"], pf.left_output),
+            right_output=sel(cat["right_output"], pf.right_output),
+            is_cat=use & jnp.isfinite(cat["score"]),
+            cat_bitset=sel(cat["bitset"], pf.cat_bitset))
+    if params.cegb_on:
+        # CEGB DetlaGain (cost_effective_gradient_boosting.hpp:50-61):
+        # gain -= tradeoff * (penalty_split * leaf rows
+        #                     + coupled penalty if feature unused).
+        # A candidate whose penalized gain drops <= 0 is no longer a
+        # split (the reference stops on best gain <= 0).
+        delta = jnp.float32(params.cegb_tradeoff
+                            * params.cegb_penalty_split) * parent_c
+        cp = meta.cegb_coupled_penalty
+        if cp is not None:
+            unused = jnp.ones(pf.score.shape[0], bool) \
+                if cegb_used is None else ~cegb_used
+            delta = delta + params.cegb_tradeoff * cp * unused
+        penalized = pf.score - delta
+        pf = pf._replace(score=jnp.where(
+            jnp.isfinite(pf.score) & (penalized > 0.0),
+            penalized, NEG_INF))
+    return pf
 
 
 def assemble_split(pf: PerFeatureSplits, best_f,
@@ -388,7 +413,8 @@ def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                meta: FeatureMeta, params: SplitParams,
                constraint_min=None, constraint_max=None,
                feature_mask: jnp.ndarray | None = None,
-               rand_bins: jnp.ndarray | None = None) -> SplitResult:
+               rand_bins: jnp.ndarray | None = None,
+               cegb_used: jnp.ndarray | None = None) -> SplitResult:
     """Best split (numerical + categorical) over all features of one
     leaf — the full FindBestThreshold dispatch
     (feature_histogram.hpp:84-148)."""
@@ -398,6 +424,7 @@ def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         constraint_max = jnp.float32(jnp.inf)
     pf = per_feature_splits(hist, parent_g, parent_h, parent_c, meta,
                             params, constraint_min, constraint_max,
-                            feature_mask, rand_bins)
+                            feature_mask, rand_bins,
+                            cegb_used=cegb_used)
     best_f = _argmax_first(pf.score).astype(jnp.int32)
     return assemble_split(pf, best_f)

@@ -95,6 +95,7 @@ class _MeshLearnerBase(SerialTreeLearner):
     def __init__(self, dataset: Dataset, config: Config,
                  mesh: Optional[Mesh] = None, hist_method: str = "auto"):
         super().__init__(dataset, config, hist_method=hist_method)
+        self._drop_cegb()
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
         self.num_shards = int(np.prod(list(self.mesh.shape.values())))
         self._build()
@@ -207,7 +208,9 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                                 constant_values=1.0),
                 is_categorical=jnp.pad(meta.is_categorical, (0, fpad)),
                 group=jnp.pad(meta.group, (0, fpad)),
-                offset=jnp.pad(meta.offset, (0, fpad)))
+                offset=jnp.pad(meta.offset, (0, fpad)),
+                cegb_coupled_penalty=jnp.pad(
+                    meta.cegb_coupled_penalty, (0, fpad)))
         else:
             meta_h = meta
         comm = make_feature_parallel_comm(AXIS, self._f_local)
@@ -332,6 +335,7 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         from ..learner.comm import (make_data_parallel_comm,
                                     make_voting_parallel_comm)
         self._setup_partitioned(dataset, config, interpret)
+        self._drop_cegb()
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
         d = self.num_shards = int(np.prod(list(self.mesh.shape.values())))
         n = dataset.num_data

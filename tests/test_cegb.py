@@ -1,0 +1,129 @@
+"""Cost-Effective Gradient Boosting (CEGB) — split and coupled
+feature-acquisition penalties subtracted from split gains
+(src/treelearner/cost_effective_gradient_boosting.hpp:50-61). The
+per-datum lazy penalty remains unimplemented (warned)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data import Dataset
+from lightgbm_tpu.learner.partitioned import PartitionedTreeLearner
+from lightgbm_tpu.learner.serial import SerialTreeLearner
+
+
+def _data(n=1200, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5)
+    y = (1.5 * X[:, 0] - X[:, 1] + 0.4 * X[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def test_cegb_off_matches_baseline():
+    X, y = _data()
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    b1 = lgb.train({**base, "cegb_tradeoff": 1.0,
+                    "cegb_penalty_split": 0.0},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_array_equal(b0.predict(X), b1.predict(X))
+
+
+def test_cegb_split_penalty_shrinks_tree():
+    """The split penalty scales with leaf rows, so growth stops once no
+    leaf's gain clears it — trees get strictly smaller."""
+    X, y = _data()
+    base = {"objective": "binary", "num_leaves": 63, "verbosity": -1}
+    free = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=3)
+    taxed = lgb.train({**base, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_split": 0.05},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    n_free = sum(t.num_leaves for t in free._src().models)
+    n_taxed = sum(t.num_leaves for t in taxed._src().models)
+    assert n_taxed < n_free, (n_taxed, n_free)
+    assert n_taxed > len(taxed._src().models)  # still split something
+
+
+def test_cegb_coupled_penalty_steers_feature_choice():
+    """Feature 1 is a near-copy of feature 0 with slightly more signal;
+    a large coupled penalty on feature 1 makes the model acquire
+    feature 0 instead."""
+    rng = np.random.RandomState(7)
+    n = 1500
+    f0 = rng.randn(n)
+    f1 = f0 + 0.02 * rng.randn(n)       # marginally cleaner below
+    y = (f1 + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    X = np.column_stack([f0, f1, rng.randn(n, 2)])
+    base = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+
+    free = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=3)
+    used_free = {int(f) for t in free._src().models
+                 for f in t.split_feature[:t.num_leaves - 1]}
+    assert 1 in used_free                # without penalty it picks f1
+
+    taxed = lgb.train({**base, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_coupled": [0, 1e9, 0, 0]},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    used_taxed = {int(f) for t in taxed._src().models
+                  for f in t.split_feature[:t.num_leaves - 1]}
+    assert 1 not in used_taxed, used_taxed
+    assert 0 in used_taxed
+
+
+def test_cegb_coupled_state_persists_across_trees():
+    """A feature pays the coupled penalty at most ONCE per model: the
+    learner's used set accumulates across iterations."""
+    X, y = _data()
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 7,
+                              "cegb_tradeoff": 1.0,
+                              "cegb_penalty_feature_coupled":
+                                  [0.5, 0.5, 0.5, 0.5, 0.5],
+                              "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    learner = SerialTreeLearner(ds, cfg)
+    import jax.numpy as jnp
+    grad = jnp.asarray(y - 0.5, jnp.float32)
+    hess = jnp.full((len(y),), 0.25, jnp.float32)
+    r1 = learner.train(grad, hess)
+    used1 = np.asarray(learner._cegb_used)
+    t1 = learner.to_host_tree(r1)
+    for f in t1.split_feature_inner[:t1.num_leaves - 1]:
+        assert used1[int(f)]
+    learner.train(grad, hess)
+    used2 = np.asarray(learner._cegb_used)
+    assert (used2 | used1 == used2).all()     # monotone growth
+
+
+def test_cegb_partitioned_matches_serial():
+    X, y = _data(n=800)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 15,
+                              "cegb_tradeoff": 1.0,
+                              "cegb_penalty_split": 0.01,
+                              "cegb_penalty_feature_coupled":
+                                  [0.2, 0.0, 0.4, 0.0, 0.0],
+                              "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    import jax.numpy as jnp
+    grad = jnp.asarray(y - 0.5, jnp.float32)
+    hess = jnp.full((len(y),), 0.25, jnp.float32)
+    rs = SerialTreeLearner(ds, cfg).train(grad, hess)
+    rp = PartitionedTreeLearner(ds, cfg, interpret=True).train(grad, hess)
+    import jax
+    ts, tp = jax.device_get(rs.tree), jax.device_get(rp.tree)
+    assert int(ts.num_leaves) == int(tp.num_leaves)
+    k = int(ts.num_leaves)
+    np.testing.assert_array_equal(ts.split_feature[:k - 1],
+                                  tp.split_feature[:k - 1])
+    np.testing.assert_allclose(ts.leaf_value[:k], tp.leaf_value[:k],
+                               rtol=1e-5)
+
+
+def test_cegb_warned_on_mesh_learners():
+    X, y = _data(n=600)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "tree_learner": "data", "num_machines": 2,
+                     "cegb_tradeoff": 1.0, "cegb_penalty_split": 0.01,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst.current_iteration() == 2   # trains, penalties ignored
