@@ -180,12 +180,18 @@ class Dataset:
             else None
         ctor = _InnerDataset.from_scipy if _is_sparse(data) \
             else _InnerDataset.from_numpy
+        from .data.dataset import load_forced_bins
+        # reference-bound datasets copy the reference's mappers;
+        # forced bins only matter when bins are found here
+        forced = {} if ref_inner is not None \
+            else load_forced_bins(cfg.forcedbins_filename)
         self._inner = ctor(
             data, cfg, label=self.label, weight=self.weight,
             group=self.group, init_score=self.init_score,
             feature_names=feature_name if feature_name != "auto"
             else None,
-            categorical_features=cat_idx, reference=ref_inner)
+            categorical_features=cat_idx, reference=ref_inner,
+            forced_bins=forced)
         if self.free_raw_data:
             self.data = None
         return self

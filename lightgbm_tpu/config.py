@@ -184,7 +184,6 @@ _UNIMPLEMENTED_PARAMS = {
     "cegb_penalty_feature_lazy": "CEGB per-datum lazy feature penalty "
                                  "(split + coupled penalties ARE "
                                  "implemented)",
-    "forcedbins_filename": "forced bin bounds file",
 }
 
 

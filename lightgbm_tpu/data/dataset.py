@@ -15,6 +15,7 @@ sizes (metadata.cpp).
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -24,6 +25,26 @@ from ..utils.log import log_fatal, log_info, log_warning
 from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
                       MISSING_NAN, MISSING_NONE, MISSING_ZERO,
                       kZeroThreshold)
+
+
+def load_forced_bins(path: str) -> Dict[int, List[float]]:
+    """Parse a forced-bin-bounds JSON file
+    (``forcedbins_filename``; DatasetLoader::GetForcedBins,
+    src/io/dataset_loader.cpp:1203-1236): a list of
+    ``{"feature": i, "bin_upper_bound": [...]}`` entries."""
+    import json
+    if not path:
+        return {}
+    if not os.path.exists(path):
+        log_warning(f"Forced bins file {path} does not exist")
+        return {}
+    with open(path) as fh:
+        entries = json.load(fh)
+    out: Dict[int, List[float]] = {}
+    for e in entries:
+        out[int(e["feature"])] = [float(v)
+                                  for v in e["bin_upper_bound"]]
+    return out
 
 
 def is_sparse(data) -> bool:

@@ -48,11 +48,10 @@ class Comm(NamedTuple):
 
 
 def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask,
-                   rand_bins=None, cegb_used=None):
+                   rand_bins=None):
     return best_split(hist, g, h, c, meta, params,
                       constraint_min=cmin, constraint_max=cmax,
-                      feature_mask=fmask, rand_bins=rand_bins,
-                      cegb_used=cegb_used)
+                      feature_mask=fmask, rand_bins=rand_bins)
 
 
 SERIAL_COMM = Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
@@ -75,10 +74,9 @@ def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
     (the Allreduce of SplitInfo, parallel_tree_learner.h:190-213)."""
 
     def select(hist, g, h, c, meta_local, params, cmin, cmax, fmask,
-               rand_bins=None, cegb_used=None):
+               rand_bins=None):
         pf = per_feature_splits(hist, g, h, c, meta_local, params,
-                                cmin, cmax, fmask, rand_bins,
-                                cegb_used=cegb_used)
+                                cmin, cmax, fmask, rand_bins)
         lb = _argmax_first(pf.score).astype(jnp.int32)
         gid = jax.lax.axis_index(axis) * f_local + lb
         res = assemble_split(pf, lb, feature_id=gid)
@@ -102,14 +100,14 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
     scan on the aggregated columns -> replicated winner."""
 
     def select(hist_local, g, h, c, meta, params, cmin, cmax, fmask,
-               rand_bins=None, cegb_used=None):
+               rand_bins=None):
         f = hist_local.shape[0]
         k = min(top_k, f)
         # local leaf totals (every feature's bins sum to the leaf)
         loc = hist_local[0].sum(axis=0)
         pf = per_feature_splits(hist_local, loc[0], loc[1], loc[2],
                                 meta, params_local, cmin, cmax, fmask,
-                                rand_bins, cegb_used=cegb_used)
+                                rand_bins)
         top_gain, top_ids = jax.lax.top_k(pf.score, k)
         # weighted gain: local leaf count relative to the mean shard count
         mean_cnt = c / num_machines
@@ -127,10 +125,9 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         meta_sel = FeatureMeta(*[m[win_ids] for m in meta])
         fmask_sel = None if fmask is None else fmask[win_ids]
         rb_sel = None if rand_bins is None else rand_bins[win_ids]
-        cu_sel = None if cegb_used is None else cegb_used[win_ids]
         pf_glob = per_feature_splits(hist_sel, g, h, c, meta_sel,
                                      params, cmin, cmax, fmask_sel,
-                                     rb_sel, cegb_used=cu_sel)
+                                     rb_sel)
         b = _argmax_first(pf_glob.score).astype(jnp.int32)
         return assemble_split(pf_glob, b, feature_id=win_ids[b])
 

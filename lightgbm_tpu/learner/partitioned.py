@@ -37,11 +37,14 @@ from ..models.tree import Tree, TreeArrays
 from ..ops.hist_pallas import (build_matrix, extract_row_ids,
                                histogram_segment, pack_gh)
 from ..ops.partition_pallas import bitset_to_lut, partition_segment
-from ..ops.split import MAX_CAT_WORDS, best_split, leaf_output_no_constraint
+from ..ops.split import (MAX_CAT_WORDS, _argmax_first, assemble_split,
+                         best_split, leaf_output_no_constraint,
+                         per_feature_splits)
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
-                     feature_meta_from_dataset, forced_left_sums,
-                     forced_split_override, make_node_rand,
-                     split_params_from_config)
+                     cegb_pf_state, cegb_rebuild_best, cegb_refund,
+                     cegb_store_row, feature_meta_from_dataset,
+                     forced_left_sums, forced_split_override,
+                     make_node_rand, split_params_from_config)
 
 HIST_BLK = 2048
 PART_BLK = 512
@@ -203,8 +206,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     if params.cegb_on and cegb_used0 is None:
         cegb_used0 = jnp.zeros((num_features,), bool)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt,
-                  cegb_used=None):
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
             from ..ops.histogram import debundle_hist
             hist = debundle_hist(hist, meta.group, meta.offset,
@@ -212,10 +214,27 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta, params,
-                                cmin, cmax, fm, rand_bins=rb,
-                                cegb_used=cegb_used)
+                                cmin, cmax, fm, rand_bins=rb)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+
+    def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used):
+        # CEGB candidate-cache scan (see learner/serial.py): only the
+        # serial / data-parallel comms reach here
+        if bundled:
+            from ..ops.histogram import debundle_hist
+            hist = debundle_hist(hist, meta.group, meta.offset,
+                                 meta.num_bins, g, h, c)
+        rb, nm = node_rand(salt)
+        fm = feature_mask if nm is None else nm
+        pf = per_feature_splits(hist, g, h, c, meta, params,
+                                cmin, cmax, fm, rb, cegb_used=cegb_used)
+        res = assemble_split(pf, _argmax_first(pf.score).astype(
+            jnp.int32))
+        blocked = (max_depth > 0) & (depth >= max_depth)
+        return (res._replace(gain=jnp.where(blocked, -jnp.inf,
+                                            res.gain)),
+                pf, blocked)
 
     # root sums reduce from the LOCAL histogram (voting keeps hists
     # local, so reduce_hist alone would leave the sums shard-local)
@@ -224,9 +243,13 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     sums = comm.reduce_sums(local_root[0].sum(axis=0))
     root_hist = comm.reduce_hist(local_root)
     root_g, root_h, root_c = sums[0], sums[1], sums[2]
-    root_split = scan_leaf(root_hist, root_g, root_h, root_c,
-                           jnp.int32(0), -inf, inf, jnp.int32(0),
-                           cegb_used=cegb_used0)
+    if params.cegb_on:
+        root_split, root_pf, root_blocked = scan_leaf_pf(
+            root_hist, root_g, root_h, root_c, jnp.int32(0), -inf, inf,
+            jnp.int32(0), cegb_used0)
+    else:
+        root_split = scan_leaf(root_hist, root_g, root_h, root_c,
+                               jnp.int32(0), -inf, inf, jnp.int32(0))
     root_out = leaf_output_no_constraint(
         root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
         params.max_delta_step)
@@ -281,6 +304,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
     if params.cegb_on:
         state["cegb_used"] = cegb_used0
+        state.update(cegb_pf_state(big_l, num_features))
+        cegb_store_row(state, 0, root_pf, root_blocked)
 
     leaf_range = jnp.arange(big_l)
 
@@ -292,7 +317,9 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
 
     def cond(st):
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
-        return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
+        # best gain <= 0 stops training (equivalent to the old
+        # isfinite check for unpenalized gains)
+        return (st["k"] < big_l) & (open_gain.max() > 0.0)
 
     kEps = 1e-15
 
@@ -412,12 +439,20 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         cmax_r = jnp.where(numerical & (mono < 0),
                            jnp.minimum(pcmax, mid), pcmax)
 
-        cu = st["cegb_used"].at[feat].set(True) if params.cegb_on \
-            else None
-        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
-                            2 * k + 1, cegb_used=cu)
-        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
-                            2 * k + 2, cegb_used=cu)
+        if params.cegb_on:
+            cu = st["cegb_used"].at[feat].set(True)
+            split_l, pf_l, blk_l = scan_leaf_pf(
+                hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
+                2 * k + 1, cu)
+            split_r, pf_r, blk_r = scan_leaf_pf(
+                hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
+                2 * k + 2, cu)
+        else:
+            cu = None
+            split_l = scan_leaf(hist_left, lg, lh, lc, depth,
+                                cmin_l, cmax_l, 2 * k + 1)
+            split_r = scan_leaf(hist_right, rg, rh, rc, depth,
+                                cmin_r, cmax_r, 2 * k + 2)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)
@@ -428,6 +463,9 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                 .at[new].set(hist_right)
         if params.cegb_on:
             st2["cegb_used"] = cu
+            cegb_refund(st2, feat, st["cegb_used"][feat], meta, params)
+            cegb_store_row(st2, leaf, pf_l, blk_l)
+            cegb_store_row(st2, new, pf_r, blk_r)
         st2.update(
             k=k + 1,
             mat=mat2, ws=ws2,
@@ -472,6 +510,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             leaf_parent=set2(st["leaf_parent"], s, s),
             leaf_depth=set2(st["leaf_depth"], depth, depth),
         )
+        if params.cegb_on:
+            cegb_rebuild_best(st2, big_l)
         return st2
 
     # forced splits: unrolled static pre-pass (ForceSplits analog);

@@ -38,7 +38,9 @@ from ..models.tree import Tree, TreeArrays
 from ..ops.histogram import build_histogram, make_ghc
 from ..ops.partition import split_leaf
 from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
-                         MISSING_ZERO_CODE, FeatureMeta, SplitParams)
+                         MISSING_ZERO_CODE, FeatureMeta, SplitParams,
+                         _argmax_first, assemble_split,
+                         per_feature_splits)
 
 _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
                  MISSING_ZERO: MISSING_ZERO_CODE,
@@ -69,6 +71,11 @@ def feature_meta_from_dataset(dataset: Dataset,
         if dataset.feature_penalty else np.ones(f, np.float32)
     group, offset, _ = dataset.bundle_maps()
     coupled_cfg = list(config.cegb_penalty_feature_coupled)
+    if coupled_cfg and len(coupled_cfg) != dataset.num_total_features:
+        from ..utils.log import log_fatal
+        log_fatal("cegb_penalty_feature_coupled should be the same size "
+                  f"as feature number ({len(coupled_cfg)} vs "
+                  f"{dataset.num_total_features})")
     cegb_coupled = np.zeros(f, np.float32)
     for inner, orig in enumerate(dataset.real_feature_idx):
         if orig < len(coupled_cfg):
@@ -352,6 +359,74 @@ def make_node_rand(rand_keys, feature_mask, bynode_count, num_bins,
     return node_rand
 
 
+_PF_FIELDS = (("pf_score", "score"), ("pf_thr", "threshold"),
+              ("pf_lg", "left_g"), ("pf_lh", "left_h"),
+              ("pf_lc", "left_c"), ("pf_dleft", "default_left"),
+              ("pf_lout", "left_output"), ("pf_rout", "right_output"),
+              ("pf_iscat", "is_cat"), ("pf_bitset", "cat_bitset"))
+
+
+def cegb_pf_state(big_l: int, f: int) -> dict:
+    """Per-(leaf, feature) penalized candidate cache — the reference's
+    ``splits_per_leaf_`` (cost_effective_gradient_boosting.hpp:35,114),
+    needed so a coupled-penalty refund can upgrade OTHER leaves' cached
+    best splits (UpdateLeafBestSplits, :63-80)."""
+    return dict(
+        pf_score=jnp.full((big_l, f), -jnp.inf, jnp.float32),
+        pf_thr=jnp.zeros((big_l, f), jnp.int32),
+        pf_lg=jnp.zeros((big_l, f), jnp.float32),
+        pf_lh=jnp.zeros((big_l, f), jnp.float32),
+        pf_lc=jnp.zeros((big_l, f), jnp.float32),
+        pf_dleft=jnp.zeros((big_l, f), bool),
+        pf_lout=jnp.zeros((big_l, f), jnp.float32),
+        pf_rout=jnp.zeros((big_l, f), jnp.float32),
+        pf_iscat=jnp.zeros((big_l, f), bool),
+        pf_bitset=jnp.zeros((big_l, f, MAX_CAT_WORDS), jnp.uint32),
+        leaf_blocked=jnp.zeros((big_l,), bool),
+    )
+
+
+def cegb_store_row(st: dict, row, pf, blocked) -> None:
+    for key, attr in _PF_FIELDS:
+        st[key] = st[key].at[row].set(getattr(pf, attr))
+    st["leaf_blocked"] = st["leaf_blocked"].at[row].set(blocked)
+
+
+def cegb_refund(st: dict, feat, was_used, meta, params) -> None:
+    """On FIRST acquisition of ``feat``, add the coupled penalty back
+    to every leaf's cached candidate on that feature
+    (UpdateLeafBestSplits, cost_effective_gradient_boosting.hpp:63-80).
+    Must run BEFORE the fresh children's rows are stored — their scans
+    already saw the feature as acquired."""
+    refund = jnp.where(was_used, 0.0,
+                       jnp.float32(params.cegb_tradeoff)
+                       * meta.cegb_coupled_penalty[feat])
+    col = st["pf_score"][:, feat]
+    st["pf_score"] = st["pf_score"].at[:, feat].set(
+        jnp.where(jnp.isfinite(col), col + refund, col))
+
+
+def cegb_rebuild_best(st: dict, big_l: int) -> None:
+    """Rebuild the per-leaf best-split cache by argmax over the
+    (refunded) candidate rows."""
+    rows = jnp.arange(big_l)
+    bf = jnp.argmax(st["pf_score"], axis=1).astype(jnp.int32)
+    gain = st["pf_score"][rows, bf]
+    st.update(
+        bs_gain=jnp.where(st["leaf_blocked"], -jnp.inf, gain),
+        bs_feat=bf,
+        bs_thr=st["pf_thr"][rows, bf],
+        bs_dleft=st["pf_dleft"][rows, bf],
+        bs_lg=st["pf_lg"][rows, bf],
+        bs_lh=st["pf_lh"][rows, bf],
+        bs_lc=st["pf_lc"][rows, bf],
+        bs_lout=st["pf_lout"][rows, bf],
+        bs_rout=st["pf_rout"][rows, bf],
+        bs_iscat=st["pf_iscat"][rows, bf],
+        bs_bitset=st["pf_bitset"][rows, bf],
+    )
+
+
 class CegbStateMixin:
     """Cross-tree CEGB feature-acquisition state: the coupled penalty
     applies until a feature's FIRST use anywhere in the model
@@ -520,8 +595,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     if params.cegb_on and cegb_used0 is None:
         cegb_used0 = jnp.zeros((meta_hist.num_bins.shape[0],), bool)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt,
-                  cegb_used=None):
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
             # EFB: group histograms -> per-feature histograms
             from ..ops.histogram import debundle_hist
@@ -530,14 +604,37 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta_hist, params,
-                                cmin, cmax, fm, rand_bins=rb,
-                                cegb_used=cegb_used)
+                                cmin, cmax, fm, rand_bins=rb)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
-    root_split = scan_leaf(root_hist, root_g, root_h, root_c,
-                           jnp.int32(0), -inf, inf, jnp.int32(0),
-                           cegb_used=cegb_used0)
+    def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used):
+        """CEGB path: the full per-feature candidate row is kept for
+        the refund bookkeeping (splits_per_leaf_). Only the serial /
+        data-parallel comms reach here (their select IS the local
+        argmax over the reduced histogram)."""
+        if bundled:
+            from ..ops.histogram import debundle_hist
+            hist = debundle_hist(hist, meta_hist.group, meta_hist.offset,
+                                 meta_hist.num_bins, g, h, c)
+        rb, nm = node_rand(salt)
+        fm = feature_mask if nm is None else nm
+        pf = per_feature_splits(hist, g, h, c, meta_hist, params,
+                                cmin, cmax, fm, rb, cegb_used=cegb_used)
+        res = assemble_split(pf, _argmax_first(pf.score).astype(
+            jnp.int32))
+        blocked = (max_depth > 0) & (depth >= max_depth)
+        return (res._replace(gain=jnp.where(blocked, -jnp.inf,
+                                            res.gain)),
+                pf, blocked)
+
+    if params.cegb_on:
+        root_split, root_pf, root_blocked = scan_leaf_pf(
+            root_hist, root_g, root_h, root_c, jnp.int32(0), -inf, inf,
+            jnp.int32(0), cegb_used0)
+    else:
+        root_split = scan_leaf(root_hist, root_g, root_h, root_c,
+                               jnp.int32(0), -inf, inf, jnp.int32(0))
 
     def at0(arr, val):
         return arr.at[0].set(val)
@@ -598,6 +695,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             root_hist)
     if params.cegb_on:
         state["cegb_used"] = cegb_used0
+        state.update(cegb_pf_state(big_l, meta_hist.num_bins.shape[0]))
+        cegb_store_row(state, 0, root_pf, root_blocked)
 
     leaf_range = jnp.arange(big_l)
 
@@ -610,7 +709,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
 
     def cond(st):
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
-        return (st["k"] < big_l) & jnp.isfinite(open_gain.max())
+        # best gain <= 0 stops training (serial_tree_learner.cpp Train;
+        # equivalent to the old isfinite check for unpenalized gains,
+        # which are strictly positive when valid)
+        return (st["k"] < big_l) & (open_gain.max() > 0.0)
 
     def body(st, forced=None, forced_hist=None):
         k = st["k"]
@@ -707,12 +809,20 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         # ---- child best splits ---------------------------------------
         # CEGB: the feature just split is "acquired" for the children's
         # scans and every later split (OnSplit marking)
-        cu = st["cegb_used"].at[feat].set(True) if params.cegb_on \
-            else None
-        split_l = scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
-                            2 * k + 1, cegb_used=cu)
-        split_r = scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
-                            2 * k + 2, cegb_used=cu)
+        if params.cegb_on:
+            cu = st["cegb_used"].at[feat].set(True)
+            split_l, pf_l, blk_l = scan_leaf_pf(
+                hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
+                2 * k + 1, cu)
+            split_r, pf_r, blk_r = scan_leaf_pf(
+                hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
+                2 * k + 2, cu)
+        else:
+            cu = None
+            split_l = scan_leaf(hist_left, lg, lh, lc, depth,
+                                cmin_l, cmax_l, 2 * k + 1)
+            split_r = scan_leaf(hist_right, rg, rh, rc, depth,
+                                cmin_r, cmax_r, 2 * k + 2)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)
@@ -723,6 +833,13 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 .at[new].set(hist_right)
         if params.cegb_on:
             st2["cegb_used"] = cu
+            # refund BEFORE the children's rows land (their scans
+            # already saw `feat` acquired), then rebuild every cached
+            # best from the candidate rows
+            cegb_refund(st2, feat, st["cegb_used"][feat], meta_hist,
+                        params)
+            cegb_store_row(st2, leaf, pf_l, blk_l)
+            cegb_store_row(st2, new, pf_r, blk_r)
         st2.update(
             k=k + 1,
             leaf_id=leaf_id,
@@ -764,6 +881,11 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             leaf_parent=set2(st["leaf_parent"], s, s),
             leaf_depth=set2(st["leaf_depth"], depth, depth),
         )
+        if params.cegb_on:
+            # the refunded candidate cache is the source of truth for
+            # every leaf's best (overrides the set2 child writes with
+            # identical values, plus any refund-upgraded leaves)
+            cegb_rebuild_best(st2, big_l)
         return st2
 
     # ---- forced splits: unrolled static pre-pass (ForceSplits,

@@ -354,8 +354,9 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         # CEGB DetlaGain (cost_effective_gradient_boosting.hpp:50-61):
         # gain -= tradeoff * (penalty_split * leaf rows
         #                     + coupled penalty if feature unused).
-        # A candidate whose penalized gain drops <= 0 is no longer a
-        # split (the reference stops on best gain <= 0).
+        # Penalized gains stay FINITE (possibly negative): the grow
+        # loop stops on best gain <= 0, and a later coupled-penalty
+        # refund (UpdateLeafBestSplits) can resurrect a leaf.
         delta = jnp.float32(params.cegb_tradeoff
                             * params.cegb_penalty_split) * parent_c
         cp = meta.cegb_coupled_penalty
@@ -363,10 +364,8 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
             unused = jnp.ones(pf.score.shape[0], bool) \
                 if cegb_used is None else ~cegb_used
             delta = delta + params.cegb_tradeoff * cp * unused
-        penalized = pf.score - delta
         pf = pf._replace(score=jnp.where(
-            jnp.isfinite(pf.score) & (penalized > 0.0),
-            penalized, NEG_INF))
+            jnp.isfinite(pf.score), pf.score - delta, pf.score))
     return pf
 
 

@@ -92,13 +92,26 @@ def _reject_bundled(dataset: Dataset, learner_type: str) -> None:
 class _MeshLearnerBase(SerialTreeLearner):
     """Shared setup: mesh, padding, shard_map-wrapped grow program."""
 
+    # data-parallel keeps a GLOBAL feature axis, so CEGB's feature-used
+    # state works unchanged; the feature-sharded learners scan local
+    # shards and drop it (learner/serial.py CegbStateMixin._drop_cegb)
+    _supports_cegb = False
+
     def __init__(self, dataset: Dataset, config: Config,
                  mesh: Optional[Mesh] = None, hist_method: str = "auto"):
         super().__init__(dataset, config, hist_method=hist_method)
-        self._drop_cegb()
+        if not self._supports_cegb:
+            self._drop_cegb()
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
         self.num_shards = int(np.prod(list(self.mesh.shape.values())))
         self._build()
+
+    def _cegb_arg(self):
+        """Replicated [F] used-features vector fed through shard_map
+        (a dummy when CEGB is off — specs stay shape-stable)."""
+        if getattr(self, "_cegb_used", None) is not None:
+            return self._cegb_used
+        return jnp.zeros((self.dataset.num_features,), bool)
 
     # subclasses define _build() producing self._fn and padding info
 
@@ -118,9 +131,11 @@ class _MeshLearnerBase(SerialTreeLearner):
         if rkey is None:  # shard_map needs a concrete array either way
             rkey = jnp.zeros((2, 2), jnp.uint32)  # shape of a key pair
         res = self._fn(grad, hess, bag_weight,
-                       self._pad_feature_mask(feature_mask), rkey)
+                       self._pad_feature_mask(feature_mask), rkey,
+                       self._cegb_arg())
         if pad:
             res = GrowResult(tree=res.tree, leaf_id=res.leaf_id[:n])
+        self._cegb_after_tree(res)
         return res
 
     def _pad_feature_mask(self, fmask):
@@ -140,6 +155,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
     """Rows sharded over the mesh; per-leaf histograms psum'ed; split
     selection replicated (data_parallel_tree_learner.cpp semantics)."""
 
+    _supports_cegb = True
+
     def _build(self):
         d = self.num_shards
         n = self.dataset.num_data
@@ -153,7 +170,7 @@ class DataParallelTreeLearner(_MeshLearnerBase):
         comm = make_data_parallel_comm(AXIS)
         meta = self.meta
 
-        def body(binned_l, grad, hess, bag, fmask, rkey):
+        def body(binned_l, grad, hess, bag, fmask, rkey, cegb0):
             # key replicated: every shard draws identical node randomness
             # (the feature axis is global here), like the reference's
             # identically-seeded per-machine samplers
@@ -166,11 +183,13 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=self.bynode_count,
                 forced_plan=self.forced_plan,  # hist cache is psum'ed
-                cache_hists=self.cache_hists)
+                cache_hists=self.cache_hists,
+                cegb_used0=cegb0 if self.params.cegb_on else None)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P(),
+                      P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
         sharded = jax.jit(mapped)
@@ -223,7 +242,8 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
         bn_cap = bn_floor + (1 if bn_rem else 0)
 
         def body(binned_g, binned_h, meta_hist, grad, hess, bag, fmask,
-                 rkey):
+                 rkey, cegb0):
+            del cegb0          # CEGB dropped for feature-sharded scans
             idx = jax.lax.axis_index(AXIS)
             rkey = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                 rkey, idx)
@@ -241,7 +261,7 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
         mapped = shard_map(
             body, mesh=self.mesh,
             in_specs=(P(), P(None, AXIS), P(AXIS), P(), P(), P(), P(AXIS),
-                      P()),
+                      P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P()),
             check_rep=False)
         sharded = jax.jit(mapped)
@@ -289,7 +309,8 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
             AXIS, d, int(self.config.top_k), params_local)
         meta = self.meta
 
-        def body(binned_l, grad, hess, bag, fmask, rkey):
+        def body(binned_l, grad, hess, bag, fmask, rkey, cegb0):
+            del cegb0          # CEGB dropped for the voting learner
             return grow_tree(
                 binned_l, grad, hess, bag, fmask, meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
@@ -302,7 +323,8 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P(),
+                      P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
         sharded = jax.jit(mapped)
@@ -335,7 +357,10 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         from ..learner.comm import (make_data_parallel_comm,
                                     make_voting_parallel_comm)
         self._setup_partitioned(dataset, config, interpret)
-        self._drop_cegb()
+        if mode == "voting":
+            # voting's local pre-scan uses shard-local leaf counts; the
+            # split penalty would be mis-scaled -> keep CEGB off there
+            self._drop_cegb()
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
         d = self.num_shards = int(np.prod(list(self.mesh.shape.values())))
         n = dataset.num_data
@@ -386,7 +411,7 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         n_pad = self._n_pad
         comm = self.comm
 
-        def body(mat3, ws3, grad, hess, bag, fmask, rkey):
+        def body(mat3, ws3, grad, hess, bag, fmask, rkey, cegb0):
             base = jax.lax.axis_index(AXIS) * n_local
             mat_l, ws_l, tree, leaf_id = grow_partitioned(
                 mat3[0], ws3[0], grad, hess, bag, fmask, self.meta,
@@ -400,13 +425,14 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                 bynode_count=self.bynode_count,
                 forced_plan=self.forced_plan, comm=comm,
                 row_id_base=base, n_total=n_pad,
-                cache_hists=self.cache_hists)
+                cache_hists=self.cache_hists,
+                cegb_used0=cegb0 if self.params.cegb_on else None)
             return mat_l[None], ws_l[None], tree, leaf_id
 
         mapped = shard_map(
             body, mesh=self.mesh,
             in_specs=(P(AXIS, None, None), P(AXIS, None, None),
-                      P(AXIS), P(AXIS), P(AXIS), P(), P()),
+                      P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
             out_specs=(P(AXIS, None, None), P(AXIS, None, None),
                        TreeArrays_spec(), P(AXIS)),
             check_rep=False)
@@ -427,10 +453,15 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         rkey = self.next_tree_key()
         if rkey is None:
             rkey = jnp.zeros((2, 2), jnp.uint32)
+        cegb0 = self._cegb_used \
+            if getattr(self, "_cegb_used", None) is not None \
+            else jnp.zeros((self.num_features,), bool)
         self.mat, self.ws, tree, leaf_id = self._fn(
             self.mat, self.ws, grad, hess, bag_weight, feature_mask,
-            rkey)
-        return GrowResult(tree=tree, leaf_id=leaf_id[:n])
+            rkey, cegb0)
+        res = GrowResult(tree=tree, leaf_id=leaf_id[:n])
+        self._cegb_after_tree(res)
+        return res
 
 def TreeArrays_spec():
     """Replicated out_spec for every TreeArrays field."""

@@ -180,3 +180,29 @@ def test_metadata_query_boundaries():
     assert md.num_queries() == 3
     md.set_weights(np.ones(10))
     assert md.query_weights.tolist() == [1.0, 1.0, 1.0]
+
+
+def test_forcedbins_filename_end_to_end(tmp_path):
+    """forcedbins_filename JSON (DatasetLoader::GetForcedBins) pins bin
+    upper bounds; trained split thresholds on that feature land exactly
+    on the forced boundaries."""
+    import json
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = rng.rand(2000, 3)
+    y = (X[:, 0] > 0.31).astype(np.float64)
+    fb = tmp_path / "forced_bins.json"
+    fb.write_text(json.dumps(
+        [{"feature": 0, "bin_upper_bound": [0.1, 0.31, 0.5]}]))
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "forcedbins_filename": str(fb), "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    thresholds = {round(float(t), 6)
+                  for tree in bst._src().models
+                  for s, t in zip(range(tree.num_leaves - 1),
+                                  tree.threshold)
+                  if tree.split_feature[s] == 0}
+    assert 0.31 in thresholds, thresholds
+    pred = bst.predict(X)
+    assert ((pred > 0.5) == (y > 0.5)).mean() > 0.99

@@ -127,3 +127,45 @@ def test_cegb_warned_on_mesh_learners():
                      "verbosity": -1},
                     lgb.Dataset(X, label=y), num_boost_round=2)
     assert bst.current_iteration() == 2   # trains, penalties ignored
+
+
+def test_cegb_refund_resurrects_penalized_leaf():
+    """UpdateLeafBestSplits semantics: when one leaf acquires feature
+    F, the coupled penalty is refunded to every OTHER leaf's cached
+    F-candidate — a leaf whose best split was penalized below zero
+    must come back to life and split once F is paid for elsewhere."""
+    rng = np.random.RandomState(21)
+    n = 1200
+    g_col = np.repeat([0.0, 1.0], n // 2) + 0.01 * rng.randn(n)
+    f_col = rng.randn(n)
+    seg_a = g_col > 0.5
+    # root splits on G (large offset); F's gain is strong in segment A,
+    # moderate in segment B
+    y = (10.0 * seg_a
+         + np.where(seg_a, 2.0, 0.5) * (f_col > 0)
+         + 0.05 * rng.randn(n))
+    X = np.column_stack([f_col, g_col])
+    base = {"objective": "regression", "num_leaves": 4,
+            "min_data_in_leaf": 20, "verbosity": -1}
+
+    # measure the two unpenalized F-split gains under the root G-split
+    free = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=1)
+    t = free._src().models[0]
+    assert t.num_leaves == 4
+    f_gains = sorted(float(t.split_gain[s])
+                     for s in range(t.num_leaves - 1)
+                     if t.split_feature[s] == 0)
+    assert len(f_gains) == 2, "expected both segments to split on F"
+    low, high = f_gains
+    penalty = (low + high) / 2.0        # kills B's candidate, not A's
+
+    taxed = lgb.train({**base, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_coupled": [penalty, 0.0]},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    tt = taxed._src().models[0]
+    # without the refund the low-gain segment stays unsplit (3 leaves);
+    # with it the tree reaches 4 and both segments split on F
+    assert tt.num_leaves == 4, tt.num_leaves
+    f_splits = [s for s in range(tt.num_leaves - 1)
+                if tt.split_feature[s] == 0]
+    assert len(f_splits) == 2
