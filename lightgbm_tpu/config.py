@@ -181,9 +181,6 @@ def _parse_list(value: Any, typ) -> list:
 
 
 _UNIMPLEMENTED_PARAMS = {
-    "cegb_penalty_feature_lazy": "CEGB per-datum lazy feature penalty "
-                                 "(split + coupled penalties ARE "
-                                 "implemented)",
 }
 
 

@@ -88,6 +88,8 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
         self.cache_hists = use_hist_cache(
             config, self.num_leaves, self.num_groups, self.num_bins_max)
         self._init_cegb()
+        self._drop_cegb_lazy("partitioned learners keep rows "
+                             "physically reordered")
 
     def to_host_tree(self, result: GrowResult,
                      shrinkage: float = 1.0) -> Tree:

@@ -76,10 +76,19 @@ def feature_meta_from_dataset(dataset: Dataset,
         log_fatal("cegb_penalty_feature_coupled should be the same size "
                   f"as feature number ({len(coupled_cfg)} vs "
                   f"{dataset.num_total_features})")
+    lazy_cfg = list(config.cegb_penalty_feature_lazy)
+    if lazy_cfg and len(lazy_cfg) != dataset.num_total_features:
+        from ..utils.log import log_fatal
+        log_fatal("cegb_penalty_feature_lazy should be the same size "
+                  f"as feature number ({len(lazy_cfg)} vs "
+                  f"{dataset.num_total_features})")
     cegb_coupled = np.zeros(f, np.float32)
+    cegb_lazy = np.zeros(f, np.float32)
     for inner, orig in enumerate(dataset.real_feature_idx):
         if orig < len(coupled_cfg):
             cegb_coupled[inner] = float(coupled_cfg[orig])
+        if orig < len(lazy_cfg):
+            cegb_lazy[inner] = float(lazy_cfg[orig])
     return FeatureMeta(
         num_bins=jnp.asarray(num_bins), missing=jnp.asarray(missing),
         default_bin=jnp.asarray(default_bin),
@@ -88,7 +97,8 @@ def feature_meta_from_dataset(dataset: Dataset,
         is_categorical=jnp.asarray(is_cat),
         group=jnp.asarray(np.asarray(group, np.int32)),
         offset=jnp.asarray(np.asarray(offset, np.int32)),
-        cegb_coupled_penalty=jnp.asarray(cegb_coupled))
+        cegb_coupled_penalty=jnp.asarray(cegb_coupled),
+        cegb_lazy_penalty=jnp.asarray(cegb_lazy))
 
 
 def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
@@ -241,11 +251,15 @@ def use_hist_cache(config: Config, num_leaves: int, f: int,
 
 def split_params_from_config(config: Config) -> SplitParams:
     coupled = list(config.cegb_penalty_feature_coupled)
-    cegb_on = float(config.cegb_tradeoff) > 0.0 and (
+    lazy = list(config.cegb_penalty_feature_lazy)
+    lazy_on = float(config.cegb_tradeoff) > 0.0 \
+        and any(float(c) > 0.0 for c in lazy)
+    cegb_on = lazy_on or (float(config.cegb_tradeoff) > 0.0 and (
         float(config.cegb_penalty_split) > 0.0
-        or any(float(c) > 0.0 for c in coupled))
+        or any(float(c) > 0.0 for c in coupled)))
     return SplitParams(
         cegb_on=cegb_on,
+        cegb_lazy_on=lazy_on,
         cegb_tradeoff=float(config.cegb_tradeoff),
         cegb_penalty_split=float(config.cegb_penalty_split),
         lambda_l1=float(config.lambda_l1),
@@ -264,6 +278,9 @@ def split_params_from_config(config: Config) -> SplitParams:
 class GrowResult(NamedTuple):
     tree: TreeArrays
     leaf_id: object  # i32 [N]
+    # CEGB lazy-penalty charged state [N, F] bool (None unless
+    # cegb_penalty_feature_lazy is active; persists on the learner)
+    cegb_charged: object = None
 
 
 def bynode_feature_count(num_features: int, feature_fraction: float,
@@ -437,6 +454,28 @@ class CegbStateMixin:
         self._cegb_used = (
             jnp.zeros((self.dataset.num_features,), bool)
             if self.params.cegb_on else None)
+        self._cegb_charged = (
+            jnp.zeros((self.dataset.num_data,
+                       self.dataset.num_features), bool)
+            if self.params.cegb_lazy_on else None)
+
+    def _drop_cegb_lazy(self, why: str) -> None:
+        if self.params.cegb_lazy_on:
+            from ..utils.log import log_warning
+            log_warning("cegb_penalty_feature_lazy is only supported by "
+                        f"the serial tree learner ({why}); ignoring the "
+                        "lazy penalty")
+            # recompute the master gate: lazy may have been the ONLY
+            # penalty — don't run zero-delta CEGB machinery
+            coupled = list(self.config.cegb_penalty_feature_coupled)
+            still_on = float(self.config.cegb_tradeoff) > 0.0 and (
+                float(self.config.cegb_penalty_split) > 0.0
+                or any(float(c) > 0.0 for c in coupled))
+            self.params = self.params._replace(cegb_lazy_on=False,
+                                               cegb_on=still_on)
+            self._cegb_charged = None
+            if not still_on:
+                self._cegb_used = None
 
     def _drop_cegb(self) -> None:
         """CEGB's cross-split feature-used state is indexed by global
@@ -447,8 +486,10 @@ class CegbStateMixin:
             from ..utils.log import log_warning
             log_warning("cegb_* penalties are not supported by parallel "
                         "tree learners; ignoring them")
-            self.params = self.params._replace(cegb_on=False)
+            self.params = self.params._replace(cegb_on=False,
+                                               cegb_lazy_on=False)
             self._cegb_used = None
+            self._cegb_charged = None
 
     def _cegb_after_tree(self, result: "GrowResult") -> None:
         if getattr(self, "_cegb_used", None) is None:
@@ -500,6 +541,8 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
         res = _grow_jit(self.binned, grad, hess, bag_weight, feature_mask,
                         self.meta, rand_key=self.next_tree_key(),
                         cegb_used0=getattr(self, "_cegb_used", None),
+                        cegb_charged0=getattr(self, "_cegb_charged",
+                                              None),
                         params=self.params,
                         num_leaves=self.num_leaves,
                         max_depth=self.max_depth,
@@ -512,6 +555,8 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
                         forced_plan=self.forced_plan,
                         cache_hists=self.cache_hists)
         self._cegb_after_tree(res)
+        if res.cegb_charged is not None:
+            self._cegb_charged = res.cegb_charged
         return res
 
     def to_host_tree(self, result: GrowResult,
@@ -528,10 +573,10 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
                               "extra_trees", "ff_bynode", "bynode_count",
                               "forced_plan", "cache_hists"))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
-              rand_key=None, cegb_used0=None, *, params, num_leaves,
-              max_depth, num_bins_max, hist_method, bundled=False,
-              extra_trees=False, ff_bynode=1.0, bynode_count=2,
-              forced_plan=(), cache_hists=True):
+              rand_key=None, cegb_used0=None, cegb_charged0=None, *,
+              params, num_leaves, max_depth, num_bins_max, hist_method,
+              bundled=False, extra_trees=False, ff_bynode=1.0,
+              bynode_count=2, forced_plan=(), cache_hists=True):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
@@ -539,7 +584,7 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
                      rand_key=rand_key, extra_trees=extra_trees,
                      ff_bynode=ff_bynode, bynode_count=bynode_count,
                      forced_plan=forced_plan, cache_hists=cache_hists,
-                     cegb_used0=cegb_used0)
+                     cegb_used0=cegb_used0, cegb_charged0=cegb_charged0)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -550,7 +595,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               extra_trees: bool = False, ff_bynode: float = 1.0,
               bynode_count=2, bynode_cap: int | None = None,
               forced_plan: tuple = (), cache_hists: bool = True,
-              cegb_used0=None) -> GrowResult:
+              cegb_used0=None, cegb_charged0=None) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -592,8 +637,19 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                                meta_hist.num_bins, extra_trees, ff_bynode,
                                bynode_cap=bynode_cap)
 
+    f_logical = meta_hist.num_bins.shape[0]
     if params.cegb_on and cegb_used0 is None:
-        cegb_used0 = jnp.zeros((meta_hist.num_bins.shape[0],), bool)
+        cegb_used0 = jnp.zeros((f_logical,), bool)
+    used_rows = bag_weight > 0
+    if params.cegb_lazy_on and cegb_charged0 is None:
+        cegb_charged0 = jnp.zeros((n, f_logical), bool)
+
+    def lazy_uncharged(charged, mask):
+        """Per-feature count of leaf rows not yet charged for the
+        feature (CalculateOndemandCosts loop)."""
+        m = mask.astype(jnp.float32)
+        return m.sum() - (charged.astype(jnp.float32)
+                          * m[:, None]).sum(axis=0)
 
     def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
@@ -608,7 +664,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
-    def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used):
+    def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used,
+                     uncharged=None):
         """CEGB path: the full per-feature candidate row is kept for
         the refund bookkeeping (splits_per_leaf_). Only the serial /
         data-parallel comms reach here (their select IS the local
@@ -620,7 +677,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm
         pf = per_feature_splits(hist, g, h, c, meta_hist, params,
-                                cmin, cmax, fm, rb, cegb_used=cegb_used)
+                                cmin, cmax, fm, rb, cegb_used=cegb_used,
+                                cegb_uncharged=uncharged)
         res = assemble_split(pf, _argmax_first(pf.score).astype(
             jnp.int32))
         blocked = (max_depth > 0) & (depth >= max_depth)
@@ -629,9 +687,11 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 pf, blocked)
 
     if params.cegb_on:
+        unch_root = lazy_uncharged(cegb_charged0, used_rows) \
+            if params.cegb_lazy_on else None
         root_split, root_pf, root_blocked = scan_leaf_pf(
             root_hist, root_g, root_h, root_c, jnp.int32(0), -inf, inf,
-            jnp.int32(0), cegb_used0)
+            jnp.int32(0), cegb_used0, unch_root)
     else:
         root_split = scan_leaf(root_hist, root_g, root_h, root_c,
                                jnp.int32(0), -inf, inf, jnp.int32(0))
@@ -695,8 +755,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             root_hist)
     if params.cegb_on:
         state["cegb_used"] = cegb_used0
-        state.update(cegb_pf_state(big_l, meta_hist.num_bins.shape[0]))
+        state.update(cegb_pf_state(big_l, f_logical))
         cegb_store_row(state, 0, root_pf, root_blocked)
+        if params.cegb_lazy_on:
+            state["cegb_charged"] = cegb_charged0
 
     leaf_range = jnp.arange(big_l)
 
@@ -811,12 +873,23 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         # scans and every later split (OnSplit marking)
         if params.cegb_on:
             cu = st["cegb_used"].at[feat].set(True)
+            unch_l = unch_r = None
+            if params.cegb_lazy_on:
+                # charge the PARENT leaf's rows for the split feature
+                # (UpdateLeafBestSplits runs before the partition)
+                m_parent = (st["leaf_id"] == leaf) & used_rows
+                charged2 = st["cegb_charged"].at[:, feat].set(
+                    st["cegb_charged"][:, feat] | m_parent)
+                unch_l = lazy_uncharged(
+                    charged2, (leaf_id == leaf) & used_rows)
+                unch_r = lazy_uncharged(
+                    charged2, (leaf_id == new) & used_rows)
             split_l, pf_l, blk_l = scan_leaf_pf(
                 hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
-                2 * k + 1, cu)
+                2 * k + 1, cu, unch_l)
             split_r, pf_r, blk_r = scan_leaf_pf(
                 hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
-                2 * k + 2, cu)
+                2 * k + 2, cu, unch_r)
         else:
             cu = None
             split_l = scan_leaf(hist_left, lg, lh, lc, depth,
@@ -833,6 +906,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 .at[new].set(hist_right)
         if params.cegb_on:
             st2["cegb_used"] = cu
+            if params.cegb_lazy_on:
+                st2["cegb_charged"] = charged2
             # refund BEFORE the children's rows land (their scans
             # already saw `feat` acquired), then rebuild every cached
             # best from the candidate rows
@@ -925,4 +1000,5 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         leaf_depth=st["leaf_depth"],
         cat_bitsets=st["cat_bitsets"],
     )
-    return GrowResult(tree=tree, leaf_id=st["leaf_id"])
+    return GrowResult(tree=tree, leaf_id=st["leaf_id"],
+                      cegb_charged=st.get("cegb_charged"))

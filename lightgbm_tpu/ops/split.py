@@ -56,6 +56,8 @@ class FeatureMeta(NamedTuple):
     offset: jnp.ndarray = None   # int32
     # CEGB per-feature coupled acquisition penalty (zeros when off)
     cegb_coupled_penalty: jnp.ndarray = None  # float32
+    # CEGB per-datum lazy penalty (zeros when off)
+    cegb_lazy_penalty: jnp.ndarray = None     # float32
 
 
 class SplitParams(NamedTuple):
@@ -80,6 +82,7 @@ class SplitParams(NamedTuple):
     cegb_on: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
+    cegb_lazy_on: bool = False
 
 
 class SplitResult(NamedTuple):
@@ -310,7 +313,8 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                        constraint_min=None, constraint_max=None,
                        feature_mask: jnp.ndarray | None = None,
                        rand_bins: jnp.ndarray | None = None,
-                       cegb_used: jnp.ndarray | None = None
+                       cegb_used: jnp.ndarray | None = None,
+                       cegb_uncharged: jnp.ndarray | None = None
                        ) -> PerFeatureSplits:
     """Numerical + categorical per-feature scan, merged per feature.
 
@@ -364,6 +368,11 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
             unused = jnp.ones(pf.score.shape[0], bool) \
                 if cegb_used is None else ~cegb_used
             delta = delta + params.cegb_tradeoff * cp * unused
+        if params.cegb_lazy_on and cegb_uncharged is not None:
+            # lazy: charge each (row, feature) pair once
+            # (CalculateOndemandCosts: penalty * uncharged rows in leaf)
+            delta = delta + params.cegb_tradeoff \
+                * meta.cegb_lazy_penalty * cegb_uncharged
         pf = pf._replace(score=jnp.where(
             jnp.isfinite(pf.score), pf.score - delta, pf.score))
     return pf
@@ -413,7 +422,8 @@ def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                constraint_min=None, constraint_max=None,
                feature_mask: jnp.ndarray | None = None,
                rand_bins: jnp.ndarray | None = None,
-               cegb_used: jnp.ndarray | None = None) -> SplitResult:
+               cegb_used: jnp.ndarray | None = None,
+               cegb_uncharged: jnp.ndarray | None = None) -> SplitResult:
     """Best split (numerical + categorical) over all features of one
     leaf — the full FindBestThreshold dispatch
     (feature_histogram.hpp:84-148)."""
@@ -424,6 +434,7 @@ def best_split(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     pf = per_feature_splits(hist, parent_g, parent_h, parent_c, meta,
                             params, constraint_min, constraint_max,
                             feature_mask, rand_bins,
-                            cegb_used=cegb_used)
+                            cegb_used=cegb_used,
+                            cegb_uncharged=cegb_uncharged)
     best_f = _argmax_first(pf.score).astype(jnp.int32)
     return assemble_split(pf, best_f)

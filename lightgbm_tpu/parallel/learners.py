@@ -158,6 +158,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
     _supports_cegb = True
 
     def _build(self):
+        self._drop_cegb_lazy("row-sharded learners would need a "
+                             "sharded charged-state matrix")
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = _round_up(n, d)
@@ -229,7 +231,9 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 group=jnp.pad(meta.group, (0, fpad)),
                 offset=jnp.pad(meta.offset, (0, fpad)),
                 cegb_coupled_penalty=jnp.pad(
-                    meta.cegb_coupled_penalty, (0, fpad)))
+                    meta.cegb_coupled_penalty, (0, fpad)),
+                cegb_lazy_penalty=jnp.pad(
+                    meta.cegb_lazy_penalty, (0, fpad)))
         else:
             meta_h = meta
         comm = make_feature_parallel_comm(AXIS, self._f_local)
@@ -489,11 +493,14 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
         raise ValueError(f"unknown tree_learner {learner_type}")
     on_device = jax.default_backend() in ("tpu", "axon")
     fits_u8 = int(dataset.num_bins_array().max(initial=2)) <= 256
+    lazy_on = split_params_from_config(config).cegb_lazy_on
     if cls is SerialTreeLearner:
         # on TPU the partitioned learner IS the serial algorithm, with
         # O(leaf rows) per-split cost (the production single-chip path);
-        # it packs bins as uint8, so >256-bin datasets fall back
-        if on_device and fits_u8:
+        # it packs bins as uint8, so >256-bin datasets fall back.
+        # CEGB's lazy penalty needs the leaf_id-vector layout (charged
+        # rows stay in place), so it pins the serial learner.
+        if on_device and fits_u8 and not lazy_on:
             return PartitionedTreeLearner(dataset, config)
         return SerialTreeLearner(dataset, config, hist_method=hist_method)
     if cls is PartitionedTreeLearner:

@@ -1,7 +1,8 @@
-"""Cost-Effective Gradient Boosting (CEGB) — split and coupled
+"""Cost-Effective Gradient Boosting (CEGB) — split, coupled and lazy
 feature-acquisition penalties subtracted from split gains
-(src/treelearner/cost_effective_gradient_boosting.hpp:50-61). The
-per-datum lazy penalty remains unimplemented (warned)."""
+(src/treelearner/cost_effective_gradient_boosting.hpp:50-61), with
+coupled-penalty refunds to cached best splits (UpdateLeafBestSplits)
+and the per-(row, feature) lazy charging bitset."""
 
 import numpy as np
 
@@ -169,3 +170,63 @@ def test_cegb_refund_resurrects_penalized_leaf():
     f_splits = [s for s in range(tt.num_leaves - 1)
                 if tt.split_feature[s] == 0]
     assert len(f_splits) == 2
+
+
+def test_cegb_lazy_penalty_root_gain_oracle():
+    """Lazy delta at the root = tradeoff * penalty * used rows
+    (CalculateOndemandCosts over an empty charged bitset)."""
+    X, y = _data(n=1000)
+    base = {"objective": "binary", "num_leaves": 4, "verbosity": -1}
+    free = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=1)
+    g_free = float(free._src().models[0].split_gain[0])
+    pen = 0.01
+    taxed = lgb.train({**base, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_lazy": [pen] * 5},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    g_taxed = float(taxed._src().models[0].split_gain[0])
+    np.testing.assert_allclose(g_taxed, g_free - pen * 1000, rtol=1e-4)
+
+
+def test_cegb_lazy_charging_within_tree():
+    """Once a leaf's rows are charged for a feature, re-splitting the
+    SAME feature deeper costs only the still-uncharged rows — with one
+    feature the whole tree re-uses it freely after the root split."""
+    rng = np.random.RandomState(5)
+    n = 1000
+    X = rng.randn(n, 1)
+    y = np.abs(X[:, 0])            # needs several splits on feature 0
+    base = {"objective": "regression", "num_leaves": 6,
+            "min_data_in_leaf": 20, "verbosity": -1}
+    free = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=1)
+    g_root = float(free._src().models[0].split_gain[0])
+    # penalty small enough that the root still splits; every row is
+    # then charged, so the rest of the tree grows exactly like free
+    pen = g_root / n * 0.5
+    taxed = lgb.train({**base, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_lazy": [pen]},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    tf, tt = free._src().models[0], taxed._src().models[0]
+    assert tt.num_leaves == tf.num_leaves
+    np.testing.assert_array_equal(tt.threshold_bin[:tt.num_leaves - 1],
+                                  tf.threshold_bin[:tf.num_leaves - 1])
+    # gains differ ONLY on splits of leaves with uncharged rows (root)
+    np.testing.assert_allclose(
+        tt.split_gain[1:tt.num_leaves - 1],
+        tf.split_gain[1:tf.num_leaves - 1], rtol=1e-4)
+
+
+def test_cegb_lazy_charging_persists_across_trees():
+    """The charged (row, feature) bitset lives on the learner: tree 2
+    pays nothing for rows already charged in tree 1."""
+    X, y = _data(n=800)
+    pen = 0.05
+    taxed = lgb.train({"objective": "binary", "num_leaves": 7,
+                       "cegb_tradeoff": 1.0, "verbosity": -1,
+                       "cegb_penalty_feature_lazy": [pen] * 5},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    models = taxed._src().models
+    assert len(models) == 3
+    # tree 1 pays the full root charge; tree 2+ roots reuse charged
+    # features (gain not re-penalized by pen*n)
+    assert models[1].num_leaves > 1
+    assert models[2].num_leaves > 1
