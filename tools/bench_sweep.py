@@ -1,0 +1,69 @@
+"""Row-count scaling sweep of the training benchmark.
+
+The published baseline (BASELINE.md) measures 10.5M rows; bench.py
+defaults to 500k, where the 254 sequential splits are dominated by
+per-split fixed cost (docs/Performance.md). This sweep runs the bench
+child at several BENCH_ROWS values — serialized, one TPU client at a
+time — and prints a table of throughput vs rows so the amortization
+curve is measured, not argued.
+
+Run on the TPU host: python tools/bench_sweep.py [rows ...]
+Writes docs/PERF_SWEEP.json (list of bench JSON lines + timing).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ROWS = [250_000, 500_000, 1_000_000, 2_000_000, 4_000_000,
+                8_000_000]
+
+
+def main() -> int:
+    rows_list = [int(a) for a in sys.argv[1:]] or DEFAULT_ROWS
+    results = []
+    for rows in rows_list:
+        env = dict(os.environ)
+        env["BENCH_ROWS"] = str(rows)
+        # fewer measured iters at large N keeps the sweep bounded
+        env.setdefault("BENCH_ITERS", "3" if rows > 2_000_000 else "5")
+        t0 = time.time()
+        try:
+            # bench.py retries init failures internally (3 attempts x
+            # 3600s child timeout); the cap must exceed that budget
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, capture_output=True, text=True, timeout=12000)
+        except subprocess.TimeoutExpired:
+            wall = time.time() - t0
+            print(f"rows={rows}: TIMEOUT after {wall:.0f}s")
+            results.append({"rows": rows, "ok": False, "wall_s": wall,
+                            "timeout": True})
+            continue
+        wall = time.time() - t0
+        line = None
+        for out in proc.stdout.splitlines():
+            if out.strip().startswith("{") and '"metric"' in out:
+                line = json.loads(out)
+        if line is None:
+            print(f"rows={rows}: FAILED rc={proc.returncode} "
+                  f"({wall:.0f}s)\n{proc.stderr[-500:]}")
+            results.append({"rows": rows, "ok": False, "wall_s": wall})
+            continue
+        line.update(rows=rows, ok=True, wall_s=round(wall, 1))
+        results.append(line)
+        print(f"rows={rows:>9,}: {line['value']:8.3f} Mrow-iters/s "
+              f"(vs_baseline {line['vs_baseline']:.3f}, "
+              f"wall {wall:.0f}s)")
+    out_path = os.path.join(REPO, "docs", "PERF_SWEEP.json")
+    with open(out_path, "w") as fh:
+        json.dump(results, fh, indent=1)
+    print(f"wrote {out_path}")
+    return 0 if all(r.get("ok") for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
