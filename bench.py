@@ -67,6 +67,20 @@ def measure():
         "vs_baseline": round(throughput / BASELINE_ROW_ITERS_PER_S, 4)}))
 
 
+def find_result_line(stdout: str):
+    """Locate and parse the single JSON result line in bench output
+    (shared with tools/bench_sweep.py)."""
+    found = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                found = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return found
+
+
 def main():
     if os.environ.get("_BENCH_CHILD") == "1":
         measure()
@@ -87,11 +101,10 @@ def main():
         except subprocess.TimeoutExpired as e:
             last = ("timeout", str(e.stdout)[-2000:], str(e.stderr)[-2000:])
             continue
-        for line in proc.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{") and '"metric"' in line:
-                print(line)
-                return
+        parsed = find_result_line(proc.stdout)
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return
         last = (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
         time.sleep(15 * (attempt + 1))
     sys.stderr.write(

@@ -13,13 +13,24 @@ Writes docs/PERF_SWEEP.json (list of bench JSON lines + timing).
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from bench import find_result_line  # noqa: E402  (shared parser)
+
 DEFAULT_ROWS = [250_000, 500_000, 1_000_000, 2_000_000, 4_000_000,
                 8_000_000]
+OUT_PATH = os.path.join(REPO, "docs", "PERF_SWEEP.json")
+
+
+def _save(results) -> None:
+    # incremental: a crash mid-sweep must not discard finished rows
+    with open(OUT_PATH, "w") as fh:
+        json.dump(results, fh, indent=1)
 
 
 def main() -> int:
@@ -31,37 +42,44 @@ def main() -> int:
         # fewer measured iters at large N keeps the sweep bounded
         env.setdefault("BENCH_ITERS", "3" if rows > 2_000_000 else "5")
         t0 = time.time()
+        # own session: on timeout the WHOLE process group dies (the
+        # _BENCH_CHILD grandchild holds the sole TPU client slot; an
+        # orphan would wedge every later row)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True)
         try:
             # bench.py retries init failures internally (3 attempts x
             # 3600s child timeout); the cap must exceed that budget
-            proc = subprocess.run(
-                [sys.executable, os.path.join(REPO, "bench.py")],
-                env=env, capture_output=True, text=True, timeout=12000)
+            stdout, stderr = proc.communicate(timeout=12000)
         except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
             wall = time.time() - t0
             print(f"rows={rows}: TIMEOUT after {wall:.0f}s")
             results.append({"rows": rows, "ok": False, "wall_s": wall,
                             "timeout": True})
+            _save(results)
             continue
         wall = time.time() - t0
-        line = None
-        for out in proc.stdout.splitlines():
-            if out.strip().startswith("{") and '"metric"' in out:
-                line = json.loads(out)
+        line = find_result_line(stdout)
         if line is None:
             print(f"rows={rows}: FAILED rc={proc.returncode} "
-                  f"({wall:.0f}s)\n{proc.stderr[-500:]}")
+                  f"({wall:.0f}s)\n{stderr[-500:]}")
             results.append({"rows": rows, "ok": False, "wall_s": wall})
+            _save(results)
             continue
         line.update(rows=rows, ok=True, wall_s=round(wall, 1))
         results.append(line)
+        _save(results)
         print(f"rows={rows:>9,}: {line['value']:8.3f} Mrow-iters/s "
               f"(vs_baseline {line['vs_baseline']:.3f}, "
               f"wall {wall:.0f}s)")
-    out_path = os.path.join(REPO, "docs", "PERF_SWEEP.json")
-    with open(out_path, "w") as fh:
-        json.dump(results, fh, indent=1)
-    print(f"wrote {out_path}")
+    print(f"wrote {OUT_PATH}")
     return 0 if all(r.get("ok") for r in results) else 1
 
 

@@ -80,6 +80,8 @@ def main() -> int:
                     jnp.int32(0), jnp.int32(b), jnp.int32(0), lut)
             m_c, _, nl_c = partition_segment(mat, ws, *args, blk=512,
                                              interpret=False)
+            m_i, _, nl_i = partition_segment(
+                mat, jnp.zeros_like(mat), *args, blk=512, interpret=True)
             sl = slice(begin, begin + count)
             go_left = binned[sl, col] <= thr
             nl_o = int(go_left.sum())
@@ -90,8 +92,11 @@ def main() -> int:
             want_left = set(rid_orig[go_left].tolist())
             got_left = set(rid_seg[:nl_o].tolist())
             got_right = set(rid_seg[nl_o:count].tolist())
-            ok = (int(nl_c[0]) == nl_o and got_left == want_left
-                  and got_right == set(rid_orig.tolist()) - want_left)
+            ok = (int(nl_c[0]) == int(nl_i[0]) == nl_o
+                  and got_left == want_left
+                  and got_right == set(rid_orig.tolist()) - want_left
+                  and np.array_equal(np.asarray(m_c)[sl],
+                                     np.asarray(m_i)[sl]))
             print(f"partition [{n}x{f}] seg=({begin},{count}): "
                   f"{'ok ' if ok else 'FAIL'} left={int(nl_c[0])}/{nl_o}")
             failures += 0 if ok else 1
