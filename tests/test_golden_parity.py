@@ -95,3 +95,43 @@ def test_lambdarank_training_quality_vs_reference():
     ndcg_ref = ndcg5(ref_pred)
     ndcg_ours = ndcg5(np.asarray(ours.predict(Xte)).reshape(-1))
     assert ndcg_ours > ndcg_ref - 0.02, (ndcg_ours, ndcg_ref)
+
+
+@pytest.mark.parametrize("name,metric_tol", [
+    ("binary", 0.03), ("multiclass", 0.05), ("regression_l1", 0.05),
+    ("categorical", 0.05)])
+def test_training_quality_parity(name, metric_tol):
+    """Train OURS with the reference model's exact params on the same
+    data; held-out loss must match the reference predictions' loss
+    within a small relative margin (config-parity in the
+    test_consistency.py:69-118 spirit — tree tie-breaks differ, so
+    this is quality parity, not bit parity)."""
+    import lightgbm_tpu as lgb
+
+    _, Xte, ref_pred = _load(name)
+    Xtr, ytr, _, yte = DATASETS[name]["make"]()
+    spec = dict(kv.split("=", 1)
+                for kv in DATASETS[name]["train_params"])
+    n_trees = int(spec.pop("num_trees"))
+    cats = spec.pop("categorical_feature", None)
+    kw = {}
+    if cats is not None:
+        kw["categorical_feature"] = [int(c) for c in cats.split(",")]
+    ours = lgb.train(spec, lgb.Dataset(Xtr, label=ytr, **kw),
+                     num_boost_round=n_trees)
+    pred = np.asarray(ours.predict(Xte))
+
+    def loss(p):
+        p = np.asarray(p)
+        if name == "binary":
+            p = np.clip(p.reshape(-1), 1e-12, 1 - 1e-12)
+            return -np.mean(yte * np.log(p) + (1 - yte) * np.log(1 - p))
+        if name == "multiclass":
+            p = np.clip(p.reshape(len(yte), -1), 1e-12, None)
+            return -np.mean(np.log(p[np.arange(len(yte)),
+                                     yte.astype(int)]))
+        return np.mean(np.abs(p.reshape(-1) - yte))   # L1-style
+
+    l_ref = loss(ref_pred)
+    l_ours = loss(pred)
+    assert l_ours < l_ref * (1 + metric_tol), (l_ours, l_ref)

@@ -21,7 +21,14 @@ def big_problem():
 
 
 def _auc(pred, y):
-    return (pred[y == 1][:, None] > pred[y == 0][None, :]).mean()
+    # rank-based (O(n log n), no pairwise matrix)
+    order = np.argsort(pred, kind="mergesort")
+    ranks = np.empty(len(pred))
+    ranks[order] = np.arange(1, len(pred) + 1)
+    n_pos = int((y == 1).sum())
+    n_neg = len(y) - n_pos
+    return (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) \
+        / (n_pos * n_neg)
 
 
 def test_scale_serial_train_and_device_predict(big_problem):
@@ -33,9 +40,8 @@ def test_scale_serial_train_and_device_predict(big_problem):
     # path must agree (same re-binned semantics)
     pred_dev = bst.predict(X, raw_score=True)
     pred_host = np.zeros(len(X))
-    k = bst._src().num_tree_per_iteration
-    for i, t in enumerate(bst._src().models):
-        pred_host += t.predict(X[:, :])
+    for t in bst._src().models:
+        pred_host += t.predict(X)
     np.testing.assert_allclose(pred_dev, pred_host, rtol=2e-4,
                                atol=2e-5)
     assert _auc(bst.predict(X[:20000]), y[:20000]) > 0.9
