@@ -45,6 +45,10 @@ class Comm(NamedTuple):
     reduce_hist: Callable
     reduce_sums: Callable
     select_split: Callable
+    # True when select_split is a pure local computation the grow loop
+    # may jax.vmap over both children at once (serial / data-parallel);
+    # the collective-bearing selects (feature/voting) stay unbatched
+    vmap_safe: bool = True
 
 
 def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask,
@@ -86,7 +90,7 @@ def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
         return jax.tree.map(lambda x: x[w], stacked)
 
     return Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
-                select_split=select)
+                select_split=select, vmap_safe=False)
 
 
 def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
@@ -133,4 +137,4 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
 
     return Comm(reduce_hist=lambda x: x,
                 reduce_sums=lambda x: jax.lax.psum(x, axis),
-                select_split=select)
+                select_split=select, vmap_safe=False)

@@ -444,6 +444,26 @@ def cegb_rebuild_best(st: dict, big_l: int) -> None:
     )
 
 
+def vmapped_child_scan(scan_leaf, hist_left, hist_right, lg, lh, lc,
+                       rg, rh, rc, depth, cmin_l, cmax_l, cmin_r,
+                       cmax_r, k):
+    """ONE vmapped scan for both children: same math, half the op
+    count inside the while_loop body (each [F, B] scan op is tiny;
+    per-op overhead dominates at bench shapes). Shared by the serial
+    and partitioned grow loops; only vmap_safe comms may use it."""
+    res2 = jax.vmap(
+        lambda hh, g_, h_, c_, cm, cx, s_: scan_leaf(
+            hh, g_, h_, c_, depth, cm, cx, s_))(
+        jnp.stack([hist_left, hist_right]),
+        jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+        jnp.stack([lc, rc]),
+        jnp.stack([cmin_l, cmin_r]),
+        jnp.stack([cmax_l, cmax_r]),
+        jnp.stack([2 * k + 1, 2 * k + 2]))
+    return (jax.tree.map(lambda x: x[0], res2),
+            jax.tree.map(lambda x: x[1], res2))
+
+
 class CegbStateMixin:
     """Cross-tree CEGB feature-acquisition state: the coupled penalty
     applies until a feature's FIRST use anywhere in the model
@@ -892,10 +912,16 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 2 * k + 2, cu, unch_r)
         else:
             cu = None
-            split_l = scan_leaf(hist_left, lg, lh, lc, depth,
-                                cmin_l, cmax_l, 2 * k + 1)
-            split_r = scan_leaf(hist_right, rg, rh, rc, depth,
-                                cmin_r, cmax_r, 2 * k + 2)
+            if comm.vmap_safe:
+                split_l, split_r = vmapped_child_scan(
+                    scan_leaf, hist_left, hist_right, lg, lh, lc,
+                    rg, rh, rc, depth, cmin_l, cmax_l, cmin_r,
+                    cmax_r, k)
+            else:
+                split_l = scan_leaf(hist_left, lg, lh, lc, depth,
+                                    cmin_l, cmax_l, 2 * k + 1)
+                split_r = scan_leaf(hist_right, rg, rh, rc, depth,
+                                    cmin_r, cmax_r, 2 * k + 2)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)

@@ -21,10 +21,9 @@ def big_problem():
 
 
 def _auc(pred, y):
-    # rank-based (O(n log n), no pairwise matrix)
-    order = np.argsort(pred, kind="mergesort")
-    ranks = np.empty(len(pred))
-    ranks[order] = np.arange(1, len(pred) + 1)
+    # rank-based with average-rank tie handling (O(n log n))
+    from scipy.stats import rankdata
+    ranks = rankdata(pred)
     n_pos = int((y == 1).sum())
     n_neg = len(y) - n_pos
     return (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) \
