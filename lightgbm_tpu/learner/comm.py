@@ -46,9 +46,10 @@ class Comm(NamedTuple):
     reduce_sums: Callable
     select_split: Callable
     # True when select_split is a pure local computation the grow loop
-    # may jax.vmap over both children at once (serial / data-parallel);
-    # the collective-bearing selects (feature/voting) stay unbatched
-    vmap_safe: bool = True
+    # may jax.vmap over both children at once. OPT-IN: a comm whose
+    # select carries mesh collectives must never be batched, so the
+    # default fails safe
+    vmap_safe: bool = False
 
 
 def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask,
@@ -59,7 +60,7 @@ def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask,
 
 
 SERIAL_COMM = Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
-                   select_split=_serial_select)
+                   select_split=_serial_select, vmap_safe=True)
 
 
 def make_data_parallel_comm(axis: str) -> Comm:
@@ -68,7 +69,7 @@ def make_data_parallel_comm(axis: str) -> Comm:
     return Comm(
         reduce_hist=lambda x: jax.lax.psum(x, axis),
         reduce_sums=lambda x: jax.lax.psum(x, axis),
-        select_split=_serial_select)
+        select_split=_serial_select, vmap_safe=True)
 
 
 def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
@@ -90,7 +91,7 @@ def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
         return jax.tree.map(lambda x: x[w], stacked)
 
     return Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
-                select_split=select, vmap_safe=False)
+                select_split=select)
 
 
 def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
@@ -137,4 +138,4 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
 
     return Comm(reduce_hist=lambda x: x,
                 reduce_sums=lambda x: jax.lax.psum(x, axis),
-                select_split=select, vmap_safe=False)
+                select_split=select)

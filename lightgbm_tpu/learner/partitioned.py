@@ -45,7 +45,7 @@ from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
                      cegb_store_row, feature_meta_from_dataset,
                      forced_left_sums, forced_split_override,
                      make_node_rand, split_params_from_config,
-                     vmapped_child_scan)
+                     scan_children)
 
 HIST_BLK = 2048
 PART_BLK = 512
@@ -452,16 +452,9 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                 2 * k + 2, cu)
         else:
             cu = None
-            if comm.vmap_safe:
-                split_l, split_r = vmapped_child_scan(
-                    scan_leaf, hist_left, hist_right, lg, lh, lc,
-                    rg, rh, rc, depth, cmin_l, cmax_l, cmin_r,
-                    cmax_r, k)
-            else:
-                split_l = scan_leaf(hist_left, lg, lh, lc, depth,
-                                    cmin_l, cmax_l, 2 * k + 1)
-                split_r = scan_leaf(hist_right, rg, rh, rc, depth,
-                                    cmin_r, cmax_r, 2 * k + 2)
+            split_l, split_r = scan_children(
+                comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
+                rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)

@@ -444,13 +444,18 @@ def cegb_rebuild_best(st: dict, big_l: int) -> None:
     )
 
 
-def vmapped_child_scan(scan_leaf, hist_left, hist_right, lg, lh, lc,
-                       rg, rh, rc, depth, cmin_l, cmax_l, cmin_r,
-                       cmax_r, k):
-    """ONE vmapped scan for both children: same math, half the op
-    count inside the while_loop body (each [F, B] scan op is tiny;
-    per-op overhead dominates at bench shapes). Shared by the serial
-    and partitioned grow loops; only vmap_safe comms may use it."""
+def scan_children(comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
+                  rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k):
+    """Best splits of both fresh children. For vmap_safe comms this is
+    ONE vmapped scan: same math, half the op count inside the
+    while_loop body (each [F, B] scan op is tiny; per-op overhead
+    dominates at bench shapes). Collective-bearing selects stay
+    unbatched. Shared by the serial and partitioned grow loops."""
+    if not comm.vmap_safe:
+        return (scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
+                          2 * k + 1),
+                scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
+                          2 * k + 2))
     res2 = jax.vmap(
         lambda hh, g_, h_, c_, cm, cx, s_: scan_leaf(
             hh, g_, h_, c_, depth, cm, cx, s_))(
@@ -912,16 +917,9 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 2 * k + 2, cu, unch_r)
         else:
             cu = None
-            if comm.vmap_safe:
-                split_l, split_r = vmapped_child_scan(
-                    scan_leaf, hist_left, hist_right, lg, lh, lc,
-                    rg, rh, rc, depth, cmin_l, cmax_l, cmin_r,
-                    cmax_r, k)
-            else:
-                split_l = scan_leaf(hist_left, lg, lh, lc, depth,
-                                    cmin_l, cmax_l, 2 * k + 1)
-                split_r = scan_leaf(hist_right, rg, rh, rc, depth,
-                                    cmin_r, cmax_r, 2 * k + 2)
+            split_l, split_r = scan_children(
+                comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
+                rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k)
 
         def set2(arr, va, vb):
             return arr.at[leaf].set(va).at[new].set(vb)
