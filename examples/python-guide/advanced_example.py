@@ -2,6 +2,12 @@
 ranking. Run: python examples/python-guide/advanced_example.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from anywhere
+
 import numpy as np
 
 import lightgbm_tpu as lgb

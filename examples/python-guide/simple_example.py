@@ -5,6 +5,12 @@ synthetic data (no bundled datasets — everything generates locally).
 Run: python examples/python-guide/simple_example.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from anywhere
+
 import numpy as np
 
 import lightgbm_tpu as lgb

@@ -3,6 +3,12 @@
 Run: python examples/python-guide/sklearn_example.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))  # run from anywhere
+
 import numpy as np
 from sklearn.model_selection import GridSearchCV, train_test_split
 
