@@ -363,6 +363,19 @@ class Config:
                 continue
             f = known[key]
             kwargs[key] = _coerce(value, f)
+        if "seed" in kwargs:
+            # the master seed derives every sub-seed not explicitly set
+            # (Config::Set, src/io/config.cpp:187-196) using the exact
+            # reference LCG (Random::RandInt16, utils/random.h) so
+            # config dumps match the reference for the same seed;
+            # explicit sub-seed params override the derived values
+            x = int(kwargs["seed"]) & 0xFFFFFFFF
+            for sub in ("data_random_seed", "bagging_seed", "drop_seed",
+                        "feature_fraction_seed", "objective_seed",
+                        "extra_seed"):
+                x = (214013 * x + 2531011) & 0xFFFFFFFF
+                if sub not in kwargs:
+                    kwargs[sub] = (x >> 16) & 0x7FFF
         cfg = cls(**kwargs)
         cfg._warn_unimplemented(kwargs)
         cfg.check_param_conflict()

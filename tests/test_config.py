@@ -98,3 +98,49 @@ def test_params_doc_in_sync():
          "--check"],
         capture_output=True, text=True, env=env)
     assert res.returncode == 0, res.stderr
+
+
+def test_master_seed_derives_sub_seeds():
+    """`seed` (alias random_state) derives every sub-seed not set
+    explicitly (Config::Set, src/io/config.cpp:187-196)."""
+    a = Config.from_params({"seed": 42})
+    b = Config.from_params({"random_state": 42})
+    c = Config.from_params({"seed": 43})
+    d = Config.from_params({})
+    subs = ("data_random_seed", "bagging_seed", "drop_seed",
+            "feature_fraction_seed", "objective_seed", "extra_seed")
+    for s in subs:
+        assert getattr(a, s) == getattr(b, s)      # alias equivalent
+    assert any(getattr(a, s) != getattr(c, s) for s in subs)
+    assert any(getattr(a, s) != getattr(d, s) for s in subs)
+    # explicit sub-seed wins over derivation
+    e = Config.from_params({"seed": 42, "bagging_seed": 777})
+    assert e.bagging_seed == 777
+    assert e.data_random_seed == a.data_random_seed
+    # EXACT values the reference CLI derives for seed=42 (read from a
+    # reference model dump's parameters section)
+    ref = {"data_random_seed": 175, "bagging_seed": 400,
+           "drop_seed": 17869, "feature_fraction_seed": 30056,
+           "objective_seed": 16083, "extra_seed": 12879}
+    for s, want in ref.items():
+        assert getattr(a, s) == want, (s, getattr(a, s), want)
+
+
+def test_master_seed_changes_bagged_training():
+    """Different random_state values produce different bagged models —
+    the sklearn-style determinism contract."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 5)
+    y = (X[:, 0] > 0).astype(float)
+    def train(seed):
+        return lgb.train({"objective": "binary", "bagging_fraction": 0.5,
+                          "bagging_freq": 1, "num_leaves": 15,
+                          "random_state": seed, "verbosity": -1},
+                         lgb.Dataset(X, label=y),
+                         num_boost_round=5).predict(X)
+    p1, p1b, p2 = train(1), train(1), train(2)
+    np.testing.assert_array_equal(p1, p1b)         # reproducible
+    assert not np.array_equal(p1, p2)              # seed matters
