@@ -1,21 +1,21 @@
 """Benchmark: Higgs-like binary GBDT training throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line per successful measurement; the LAST line is the
+headline result (the driver parses the last valid JSON line).
 
 Baseline: the reference's published Higgs run — 10.5M rows x 28 features,
 500 iterations, num_leaves=255, lr=0.1 in 238.505 s on 2x E5-2670v3
 (docs/Experiments.rst:103-117) = 22.01M row-iterations/second. We measure
 the same quantity (rows * boosting-iterations / wall-clock second) on a
-synthetic Higgs-shaped problem — at the SAME 10.5M rows by default, so
-per-split fixed cost amortizes exactly as in the reference experiment —
-and vs_baseline = our_throughput / 22.01e6 (>1 means faster than the
-reference CPU run).
+synthetic Higgs-shaped problem and vs_baseline = our_throughput / 22.01e6
+(>1 means faster than the reference CPU run).
 
-Robustness: the measurement runs in a child process; transient TPU
-backend init failures are retried (BENCH_INIT_RETRIES, default 3), and
-each retry DEGRADES the row count (10.5M -> 2M -> 500k) so an OOM or
-timeout at full scale still yields a measurement. BENCH_ROWS pins the
-size explicitly.
+Fail-fast strategy (round-4 redesign): sizes ESCALATE smallest-first
+(500k -> 2M -> 10.5M). The 500k attempt gets a short timeout so a valid
+JSON line exists within minutes even on a cold cache; each larger size
+only runs if wall budget remains (BENCH_BUDGET_S, default 1500 s total).
+Every success prints immediately, so a timeout or OOM at a larger size
+never erases the smaller-size number. BENCH_ROWS pins a single size.
 """
 
 import json
@@ -26,8 +26,15 @@ import time
 
 BASELINE_ROW_ITERS_PER_S = 10_500_000 * 500 / 238.505
 
-
-ROWS_PLAN = [10_500_000, 2_000_000, 500_000]
+# escalation order: smallest first so SOME number prints fast
+ROWS_PLAN = [500_000, 2_000_000, 10_500_000]
+# per-size child timeout caps (seconds); the first must cover one cold
+# compile (~20-40 s) plus data gen + a few iterations with slack
+SIZE_TIMEOUT = {500_000: 600, 2_000_000: 900, 10_500_000: 1800}
+# minimum remaining budget worth STARTING a size at (data gen + compile
+# + measurement floor) — below this a child is guaranteed to be killed
+# mid-run, wasting the budget tail
+SIZE_MIN_BUDGET = {500_000: 60, 2_000_000: 180, 10_500_000: 420}
 
 
 def measure():
@@ -77,7 +84,7 @@ def measure():
 
 
 def find_result_line(stdout: str):
-    """Locate and parse the single JSON result line in bench output
+    """Locate and parse the last JSON result line in bench output
     (shared with tools/bench_sweep.py)."""
     found = None
     for line in stdout.splitlines():
@@ -90,50 +97,74 @@ def find_result_line(stdout: str):
     return found
 
 
+def _run_child(env, rows, timeout):
+    env["BENCH_ROWS"] = str(rows)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        return None, ("timeout", str(e.stdout)[-2000:], str(e.stderr)[-2000:])
+    parsed = find_result_line(proc.stdout)
+    if parsed is not None:
+        return parsed, None
+    return None, (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
+
+
 def main():
     if os.environ.get("_BENCH_CHILD") == "1":
         measure()
         return
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    budget = float(os.environ.get("BENCH_BUDGET_S", 1500))
+    t_start = time.monotonic()
     env = dict(os.environ)
     env["_BENCH_CHILD"] = "1"
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".jax_cache_tpu"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-    last = None
+
     pinned = os.environ.get("BENCH_ROWS")
-    plan_idx = 0
-    for attempt in range(retries):
-        # degrade the problem size on capacity failures (OOM/timeout)
-        # unless explicitly pinned; TRANSIENT backend-init failures
-        # retry at the SAME size — the result JSON carries "rows" so a
-        # degraded number is never mistaken for the full-scale one
-        env["BENCH_ROWS"] = pinned if pinned is not None \
-            else str(ROWS_PLAN[min(plan_idx, len(ROWS_PLAN) - 1)])
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True, timeout=3600)
-        except subprocess.TimeoutExpired as e:
-            last = ("timeout", str(e.stdout)[-2000:], str(e.stderr)[-2000:])
-            plan_idx += 1
-            continue
-        parsed = find_result_line(proc.stdout)
-        if parsed is not None:
-            print(json.dumps(parsed))
-            return
-        last = (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
-        err = (proc.stderr or "")
-        init_flake = "Unavailable" in err or "UNAVAILABLE" in err \
-            or "initialize backend" in err
-        if not init_flake:
-            plan_idx += 1
-        time.sleep(15 * (attempt + 1))
-    sys.stderr.write(
-        f"bench failed after {retries} attempts; last rc={last[0]}\n"
-        f"stdout:\n{last[1]}\nstderr:\n{last[2]}\n")
-    sys.exit(1)
+    plan = [int(pinned)] if pinned is not None else list(ROWS_PLAN)
+    init_retries = int(os.environ.get("BENCH_INIT_RETRIES", 2))
+    last_err = None
+    printed_any = False
+
+    for rows in plan:
+        remaining = budget - (time.monotonic() - t_start)
+        if printed_any and remaining < SIZE_MIN_BUDGET.get(rows, 60):
+            break  # keep what we have; don't start a run we can't finish
+        # pinned single-size runs (tools/bench_sweep.py) get the whole
+        # budget; the per-size caps only shape the escalation plan
+        cap = budget if pinned is not None else SIZE_TIMEOUT.get(rows, 1800)
+        timeout = max(60.0, min(cap, remaining))
+        attempt = 0
+        while True:
+            parsed, err = _run_child(env, rows, timeout)
+            if parsed is not None:
+                print(json.dumps(parsed), flush=True)
+                printed_any = True
+                break
+            last_err = err
+            stderr = (err[2] or "") if err else ""
+            init_flake = ("Unavailable" in stderr or "UNAVAILABLE" in stderr
+                          or "initialize backend" in stderr)
+            attempt += 1
+            if not init_flake or attempt > init_retries:
+                break  # capacity failure at this size -> keep smaller result
+            remaining = budget - (time.monotonic() - t_start)
+            if remaining < 90:
+                break
+            time.sleep(10 * attempt)
+            timeout = max(60.0, min(cap, budget - (time.monotonic() - t_start)))
+        if parsed is None:
+            break  # a size failed; larger sizes would fail harder
+
+    if not printed_any:
+        e = last_err or ("?", "", "")
+        sys.stderr.write(
+            f"bench failed; last rc={e[0]}\nstdout:\n{e[1]}\nstderr:\n{e[2]}\n")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -375,7 +375,9 @@ class Config:
                         "extra_seed"):
                 x = (214013 * x + 2531011) & 0xFFFFFFFF
                 if sub not in kwargs:
-                    kwargs[sub] = (x >> 16) & 0x7FFF
+                    # NextShort(0, 32767) = RandInt16() % 32767, so a
+                    # raw 15-bit draw of exactly 32767 wraps to 0
+                    kwargs[sub] = ((x >> 16) & 0x7FFF) % 32767
         cfg = cls(**kwargs)
         cfg._warn_unimplemented(kwargs)
         cfg.check_param_conflict()

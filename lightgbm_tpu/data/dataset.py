@@ -342,9 +342,6 @@ class Dataset:
         from .binning import BIN_TYPE_NUMERICAL
         if not config.enable_bundle or self.num_features < 2:
             return
-        if config.tree_learner in ("feature", "voting"):
-            # column-sharded learners slice per-feature columns
-            return
         from .bundling import bundle_matrix, plan_bundles
         nb = self.num_bins_array()
         eligible = np.asarray([
@@ -538,8 +535,7 @@ class Dataset:
                 plan = BundlePlan(self.feature_group, self.feature_offset,
                                   len(self.group_num_bins),
                                   self.group_num_bins)
-        elif config.enable_bundle and f_used >= 2 \
-                and config.tree_learner not in ("feature", "voting"):
+        elif config.enable_bundle and f_used >= 2:
             # the planner only needs per-feature NON-DEFAULT row sets
             # within a row sample — taken straight from the CSC
             # structure, O(sample nnz), no binned sample matrix

@@ -50,6 +50,12 @@ class Comm(NamedTuple):
     # select carries mesh collectives must never be batched, so the
     # default fails safe
     vmap_safe: bool = False
+    # True when the histogram handed to select_split is shard-LOCAL
+    # (voting keeps hists local until the winners' psum). The grow
+    # loop's EFB debundle must then reconstruct most-freq-bin counts
+    # from LOCAL leaf totals (derived from the local group hist), not
+    # the globally reduced g/h/c
+    local_hist: bool = False
 
 
 def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask,
@@ -72,22 +78,30 @@ def make_data_parallel_comm(axis: str) -> Comm:
         select_split=_serial_select, vmap_safe=True)
 
 
-def make_feature_parallel_comm(axis: str, f_local: int) -> Comm:
+def make_feature_parallel_comm(axis: str) -> Comm:
     """Every device holds all rows but scans only its feature shard
-    (contiguous blocks, so tie-breaking matches the serial first-index
-    rule); winners are compared via all_gather of the tiny SplitResult
-    (the Allreduce of SplitInfo, parallel_tree_learner.h:190-213)."""
+    (contiguous blocks for raw features, whole EFB bundle groups for
+    bundled datasets — meta_local.global_id maps the local scan slot
+    back to the global feature); winners are compared via all_gather of
+    the tiny SplitResult (the Allreduce of SplitInfo,
+    parallel_tree_learner.h:190-213)."""
 
     def select(hist, g, h, c, meta_local, params, cmin, cmax, fmask,
                rand_bins=None):
         pf = per_feature_splits(hist, g, h, c, meta_local, params,
                                 cmin, cmax, fmask, rand_bins)
         lb = _argmax_first(pf.score).astype(jnp.int32)
-        gid = jax.lax.axis_index(axis) * f_local + lb
+        gid = meta_local.global_id[lb]
         res = assemble_split(pf, lb, feature_id=gid)
         stacked = jax.tree.map(
             lambda x: jax.lax.all_gather(x, axis), res)
-        w = jnp.argmax(stacked.gain)
+        # winner: max gain, ties broken by LOWER global feature id so
+        # equal-gain splits match serial's first-index rule even when
+        # bundled group blocks scramble the shard<->feature-id order
+        best = jnp.max(stacked.gain)
+        tied_id = jnp.where(stacked.gain >= best, stacked.feature,
+                            jnp.iinfo(jnp.int32).max)
+        w = jnp.argmin(tied_id)
         return jax.tree.map(lambda x: x[w], stacked)
 
     return Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
@@ -138,4 +152,4 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
 
     return Comm(reduce_hist=lambda x: x,
                 reduce_sums=lambda x: jax.lax.psum(x, axis),
-                select_split=select)
+                select_split=select, local_hist=True)

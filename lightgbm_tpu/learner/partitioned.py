@@ -41,8 +41,8 @@ from ..ops.split import (MAX_CAT_WORDS, _argmax_first, assemble_split,
                          best_split, leaf_output_no_constraint,
                          per_feature_splits)
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
-                     cegb_pf_state, cegb_rebuild_best, cegb_refund,
-                     cegb_store_row, feature_meta_from_dataset,
+                     cegb_pf_state, cegb_refund, cegb_store_row,
+                     cegb_upgrade_best, feature_meta_from_dataset,
                      forced_left_sums, forced_split_override,
                      make_node_rand, split_params_from_config,
                      scan_children)
@@ -211,9 +211,9 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
 
     def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
-            from ..ops.histogram import debundle_hist
-            hist = debundle_hist(hist, meta.group, meta.offset,
-                                 meta.num_bins, g, h, c)
+            from ..ops.histogram import debundle_leaf_hist
+            hist = debundle_leaf_hist(hist, meta, g, h, c,
+                                      comm.local_hist)
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta, params,
@@ -222,22 +222,25 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
 
     def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used):
-        # CEGB candidate-cache scan (see learner/serial.py): only the
+        # CEGB candidate-cache scan (see learner/serial.py): best from
+        # PENALIZED scores, cache row keeps RAW gains; only the
         # serial / data-parallel comms reach here
         if bundled:
-            from ..ops.histogram import debundle_hist
-            hist = debundle_hist(hist, meta.group, meta.offset,
-                                 meta.num_bins, g, h, c)
+            from ..ops.histogram import debundle_leaf_hist
+            hist = debundle_leaf_hist(hist, meta, g, h, c,
+                                      comm.local_hist)
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm
-        pf = per_feature_splits(hist, g, h, c, meta, params,
-                                cmin, cmax, fm, rb, cegb_used=cegb_used)
+        pf, raw = per_feature_splits(hist, g, h, c, meta, params,
+                                     cmin, cmax, fm, rb,
+                                     cegb_used=cegb_used,
+                                     return_raw=True)
         res = assemble_split(pf, _argmax_first(pf.score).astype(
             jnp.int32))
         blocked = (max_depth > 0) & (depth >= max_depth)
         return (res._replace(gain=jnp.where(blocked, -jnp.inf,
                                             res.gain)),
-                pf, blocked)
+                pf._replace(score=raw), blocked)
 
     # root sums reduce from the LOCAL histogram (voting keeps hists
     # local, so reduce_hist alone would leave the sums shard-local)
@@ -513,7 +516,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             leaf_depth=set2(st["leaf_depth"], depth, depth),
         )
         if params.cegb_on:
-            cegb_rebuild_best(st2, big_l)
+            cegb_upgrade_best(st2, feat, st["cegb_used"][feat], leaf,
+                              new, big_l)
         return st2
 
     # forced splits: unrolled static pre-pass (ForceSplits analog);

@@ -98,7 +98,8 @@ def feature_meta_from_dataset(dataset: Dataset,
         group=jnp.asarray(np.asarray(group, np.int32)),
         offset=jnp.asarray(np.asarray(offset, np.int32)),
         cegb_coupled_penalty=jnp.asarray(cegb_coupled),
-        cegb_lazy_penalty=jnp.asarray(cegb_lazy))
+        cegb_lazy_penalty=jnp.asarray(cegb_lazy),
+        global_id=jnp.arange(f, dtype=jnp.int32))
 
 
 def build_forced_plan(dataset: Dataset, config: Config) -> tuple:
@@ -384,10 +385,17 @@ _PF_FIELDS = (("pf_score", "score"), ("pf_thr", "threshold"),
 
 
 def cegb_pf_state(big_l: int, f: int) -> dict:
-    """Per-(leaf, feature) penalized candidate cache — the reference's
-    ``splits_per_leaf_`` (cost_effective_gradient_boosting.hpp:35,114),
-    needed so a coupled-penalty refund can upgrade OTHER leaves' cached
-    best splits (UpdateLeafBestSplits, :63-80)."""
+    """Per-(leaf, feature) RAW candidate cache — the reference's
+    ``splits_per_leaf_`` (cost_effective_gradient_boosting.hpp:35,114).
+    The cached gains are UNpenalized (DetlaGain receives split_info by
+    value before the caller subtracts the delta,
+    serial_tree_learner.cpp:767-776), so a coupled-penalty refund can
+    upgrade OTHER leaves' cached best splits with raw+coupled gains
+    (UpdateLeafBestSplits, :63-80).
+
+    Divergence from the reference: rows reset to -inf at every tree
+    start; the reference never clears ``splits_per_leaf_``, letting
+    stale candidates from earlier trees leak into refund upgrades."""
     return dict(
         pf_score=jnp.full((big_l, f), -jnp.inf, jnp.float32),
         pf_thr=jnp.zeros((big_l, f), jnp.int32),
@@ -423,25 +431,34 @@ def cegb_refund(st: dict, feat, was_used, meta, params) -> None:
         jnp.where(jnp.isfinite(col), col + refund, col))
 
 
-def cegb_rebuild_best(st: dict, big_l: int) -> None:
-    """Rebuild the per-leaf best-split cache by argmax over the
-    (refunded) candidate rows."""
+def cegb_upgrade_best(st: dict, feat, was_used, leaf, new,
+                      big_l: int) -> None:
+    """On FIRST acquisition of ``feat``, replace another leaf's cached
+    best with its (refunded) raw+coupled candidate on ``feat`` where
+    that candidate wins (UpdateLeafBestSplits,
+    cost_effective_gradient_boosting.hpp:67-78). Upgrade-only — the
+    reference compares the single refunded candidate against the
+    current best and never downgrades; the two fresh children are
+    excluded (``i == best_leaf`` skip + the new leaf's reset gain)."""
     rows = jnp.arange(big_l)
-    bf = jnp.argmax(st["pf_score"], axis=1).astype(jnp.int32)
-    gain = st["pf_score"][rows, bf]
-    st.update(
-        bs_gain=jnp.where(st["leaf_blocked"], -jnp.inf, gain),
-        bs_feat=bf,
-        bs_thr=st["pf_thr"][rows, bf],
-        bs_dleft=st["pf_dleft"][rows, bf],
-        bs_lg=st["pf_lg"][rows, bf],
-        bs_lh=st["pf_lh"][rows, bf],
-        bs_lc=st["pf_lc"][rows, bf],
-        bs_lout=st["pf_lout"][rows, bf],
-        bs_rout=st["pf_rout"][rows, bf],
-        bs_iscat=st["pf_iscat"][rows, bf],
-        bs_bitset=st["pf_bitset"][rows, bf],
-    )
+    cand = st["pf_score"][:, feat]
+    # SplitInfo::operator> (split_info.hpp:126-152): higher gain wins,
+    # exact ties go to the SMALLER feature id
+    beats = (cand > st["bs_gain"]) | (
+        (cand == st["bs_gain"]) & (feat < st["bs_feat"]))
+    do = (~was_used) & (rows != leaf) & (rows != new) \
+        & ~st["leaf_blocked"] & jnp.isfinite(st["bs_gain"]) \
+        & jnp.isfinite(cand) & beats
+    pick2 = (("bs_thr", "pf_thr"), ("bs_dleft", "pf_dleft"),
+             ("bs_lg", "pf_lg"), ("bs_lh", "pf_lh"),
+             ("bs_lc", "pf_lc"), ("bs_lout", "pf_lout"),
+             ("bs_rout", "pf_rout"), ("bs_iscat", "pf_iscat"))
+    st["bs_gain"] = jnp.where(do, cand, st["bs_gain"])
+    st["bs_feat"] = jnp.where(do, feat, st["bs_feat"])
+    for bs_key, pf_key in pick2:
+        st[bs_key] = jnp.where(do, st[pf_key][:, feat], st[bs_key])
+    st["bs_bitset"] = jnp.where(do[:, None], st["pf_bitset"][:, feat],
+                                st["bs_bitset"])
 
 
 def scan_children(comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
@@ -665,7 +682,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     f_logical = meta_hist.num_bins.shape[0]
     if params.cegb_on and cegb_used0 is None:
         cegb_used0 = jnp.zeros((f_logical,), bool)
-    used_rows = bag_weight > 0
+    used_rows = jnp.ones((n,), bool) if bag_weight is None \
+        else bag_weight > 0
     if params.cegb_lazy_on and cegb_charged0 is None:
         cegb_charged0 = jnp.zeros((n, f_logical), bool)
 
@@ -679,9 +697,9 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
             # EFB: group histograms -> per-feature histograms
-            from ..ops.histogram import debundle_hist
-            hist = debundle_hist(hist, meta_hist.group, meta_hist.offset,
-                                 meta_hist.num_bins, g, h, c)
+            from ..ops.histogram import debundle_leaf_hist
+            hist = debundle_leaf_hist(hist, meta_hist, g, h, c,
+                                      comm.local_hist)
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
         res = comm.select_split(hist, g, h, c, meta_hist, params,
@@ -692,24 +710,28 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used,
                      uncharged=None):
         """CEGB path: the full per-feature candidate row is kept for
-        the refund bookkeeping (splits_per_leaf_). Only the serial /
-        data-parallel comms reach here (their select IS the local
-        argmax over the reduced histogram)."""
+        the refund bookkeeping (splits_per_leaf_). The leaf's own best
+        is picked from PENALIZED scores, but the cached row keeps the
+        RAW gains (DetlaGain stores split_info pre-subtraction). Only
+        the serial / data-parallel comms reach here (their select IS
+        the local argmax over the reduced histogram)."""
         if bundled:
-            from ..ops.histogram import debundle_hist
-            hist = debundle_hist(hist, meta_hist.group, meta_hist.offset,
-                                 meta_hist.num_bins, g, h, c)
+            from ..ops.histogram import debundle_leaf_hist
+            hist = debundle_leaf_hist(hist, meta_hist, g, h, c,
+                                      comm.local_hist)
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm
-        pf = per_feature_splits(hist, g, h, c, meta_hist, params,
-                                cmin, cmax, fm, rb, cegb_used=cegb_used,
-                                cegb_uncharged=uncharged)
+        pf, raw = per_feature_splits(hist, g, h, c, meta_hist, params,
+                                     cmin, cmax, fm, rb,
+                                     cegb_used=cegb_used,
+                                     cegb_uncharged=uncharged,
+                                     return_raw=True)
         res = assemble_split(pf, _argmax_first(pf.score).astype(
             jnp.int32))
         blocked = (max_depth > 0) & (depth >= max_depth)
         return (res._replace(gain=jnp.where(blocked, -jnp.inf,
                                             res.gain)),
-                pf, blocked)
+                pf._replace(score=raw), blocked)
 
     if params.cegb_on:
         unch_root = lazy_uncharged(cegb_charged0, used_rows) \
@@ -933,8 +955,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             if params.cegb_lazy_on:
                 st2["cegb_charged"] = charged2
             # refund BEFORE the children's rows land (their scans
-            # already saw `feat` acquired), then rebuild every cached
-            # best from the candidate rows
+            # already saw `feat` acquired)
             cegb_refund(st2, feat, st["cegb_used"][feat], meta_hist,
                         params)
             cegb_store_row(st2, leaf, pf_l, blk_l)
@@ -981,10 +1002,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             leaf_depth=set2(st["leaf_depth"], depth, depth),
         )
         if params.cegb_on:
-            # the refunded candidate cache is the source of truth for
-            # every leaf's best (overrides the set2 child writes with
-            # identical values, plus any refund-upgraded leaves)
-            cegb_rebuild_best(st2, big_l)
+            # refund-upgrade other leaves' cached bests (the children's
+            # fresh writes above are excluded from the comparison)
+            cegb_upgrade_best(st2, feat, st["cegb_used"][feat], leaf,
+                              new, big_l)
         return st2
 
     # ---- forced splits: unrolled static pre-pass (ForceSplits,

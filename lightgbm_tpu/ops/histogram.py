@@ -101,6 +101,27 @@ def build_histogram(binned: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
     raise ValueError(f"unknown histogram method {method}")
 
 
+def debundle_totals(hist_g: jnp.ndarray, g, h, c, local_hist: bool):
+    """Leaf totals for debundle_hist's bin-0 reconstruction. A comm
+    that keeps histograms shard-LOCAL (voting) must debundle with
+    LOCAL totals — any one group's bins sum to the shard's leaf rows —
+    while globally-reduced histograms use the global g/h/c."""
+    if local_hist:
+        t = hist_g[0].sum(axis=0)
+        return t[0], t[1], t[2]
+    return g, h, c
+
+
+def debundle_leaf_hist(hist_g: jnp.ndarray, meta, g, h, c,
+                       local_hist: bool) -> jnp.ndarray:
+    """One-call EFB debundle for a leaf scan: pick the right totals
+    (shard-local vs global) and expand group histograms to per-feature
+    histograms. The single entry point for every grow loop."""
+    tg, th, tc = debundle_totals(hist_g, g, h, c, local_hist)
+    return debundle_hist(hist_g, meta.group, meta.offset, meta.num_bins,
+                         tg, th, tc)
+
+
 def debundle_hist(hist_g: jnp.ndarray, group: jnp.ndarray,
                   offset: jnp.ndarray, num_bins: jnp.ndarray,
                   leaf_g, leaf_h, leaf_c) -> jnp.ndarray:

@@ -58,6 +58,11 @@ class FeatureMeta(NamedTuple):
     cegb_coupled_penalty: jnp.ndarray = None  # float32
     # CEGB per-datum lazy penalty (zeros when off)
     cegb_lazy_penalty: jnp.ndarray = None     # float32
+    # global logical feature id of each scan slot (arange(F) except in
+    # the feature-parallel shard metas, where the scan axis is a
+    # permuted/padded slice of the global features; padding slots hold
+    # F — an out-of-range id — and are masked off the scan)
+    global_id: jnp.ndarray = None             # int32
 
 
 class SplitParams(NamedTuple):
@@ -314,8 +319,8 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                        feature_mask: jnp.ndarray | None = None,
                        rand_bins: jnp.ndarray | None = None,
                        cegb_used: jnp.ndarray | None = None,
-                       cegb_uncharged: jnp.ndarray | None = None
-                       ) -> PerFeatureSplits:
+                       cegb_uncharged: jnp.ndarray | None = None,
+                       return_raw: bool = False):
     """Numerical + categorical per-feature scan, merged per feature.
 
     The categorical scan compiles only when ``params.has_categorical``
@@ -324,6 +329,12 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     random threshold each; categorical features keep the full scan
     (documented divergence: the reference also randomizes categorical
     candidates in IS_RAND mode).
+
+    ``return_raw=True`` also returns the pre-CEGB-penalty scores as a
+    second value: the reference caches the UNpenalized SplitInfo
+    (``new_split`` is passed by value to DetlaGain BEFORE the caller
+    subtracts the delta, serial_tree_learner.cpp:767-776), so the
+    coupled-penalty refund later lands on top of raw gains.
     """
     if constraint_min is None:
         constraint_min = jnp.float32(-jnp.inf)
@@ -354,6 +365,7 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
             right_output=sel(cat["right_output"], pf.right_output),
             is_cat=use & jnp.isfinite(cat["score"]),
             cat_bitset=sel(cat["bitset"], pf.cat_bitset))
+    raw_score = pf.score
     if params.cegb_on:
         # CEGB DetlaGain (cost_effective_gradient_boosting.hpp:50-61):
         # gain -= tradeoff * (penalty_split * leaf rows
@@ -375,6 +387,8 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                 * meta.cegb_lazy_penalty * cegb_uncharged
         pf = pf._replace(score=jnp.where(
             jnp.isfinite(pf.score), pf.score - delta, pf.score))
+    if return_raw:
+        return pf, raw_score
     return pf
 
 

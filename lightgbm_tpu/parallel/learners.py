@@ -77,16 +77,25 @@ def _round_up(n: int, d: int) -> int:
     return (n + d - 1) // d * d
 
 
-def _reject_bundled(dataset: Dataset, learner_type: str) -> None:
-    """Column-sharded learners cannot consume an EFB-bundled matrix.
-    Dataset construction skips bundling when tree_learner is set up
-    front; this guards reuse of a dataset built for another learner."""
-    if dataset.feature_offset is not None:
-        from ..utils.log import log_fatal
-        log_fatal(
-            f"{learner_type}-parallel training cannot use an EFB-bundled "
-            "Dataset; reconstruct it with enable_bundle=false or with "
-            f"tree_learner={learner_type} set in the dataset params")
+def _pad_meta(meta: FeatureMeta, fpad: int, f: int) -> FeatureMeta:
+    """Pad a per-feature meta with never-splittable dummy features
+    (2 bins, no missing, masked off by the padded feature mask)."""
+    if not fpad:
+        return meta
+    return FeatureMeta(
+        num_bins=jnp.pad(meta.num_bins, (0, fpad), constant_values=2),
+        missing=jnp.pad(meta.missing, (0, fpad)),
+        default_bin=jnp.pad(meta.default_bin, (0, fpad)),
+        most_freq_bin=jnp.pad(meta.most_freq_bin, (0, fpad)),
+        monotone=jnp.pad(meta.monotone, (0, fpad)),
+        penalty=jnp.pad(meta.penalty, (0, fpad), constant_values=1.0),
+        is_categorical=jnp.pad(meta.is_categorical, (0, fpad)),
+        group=jnp.pad(meta.group, (0, fpad)),
+        offset=jnp.pad(meta.offset, (0, fpad)),
+        cegb_coupled_penalty=jnp.pad(meta.cegb_coupled_penalty, (0, fpad)),
+        cegb_lazy_penalty=jnp.pad(meta.cegb_lazy_penalty, (0, fpad)),
+        global_id=jnp.pad(meta.global_id, (0, fpad),
+                          constant_values=f))
 
 
 class _MeshLearnerBase(SerialTreeLearner):
@@ -204,39 +213,78 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
     (feature_parallel_tree_learner.cpp semantics)."""
 
     def _build(self):
-        _reject_bundled(self.dataset, "feature")
         self._drop_forced_plan("feature")
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = n  # rows are replicated, no row padding
         f = self.dataset.num_features
-        self._f_pad = _round_up(f, d)
-        self._f_local = self._f_pad // d
-        fpad = self._f_pad - f
-        binned_hist = self.binned
         meta = self.meta
-        if fpad:
-            binned_hist = jnp.pad(binned_hist, ((0, 0), (0, fpad)))
-            # padded features: 2 bins, no missing, never valid to split
+        if self.bundled:
+            # EFB: shard whole bundle GROUPS (a bundle's features must
+            # stay together — its group histogram debundles locally).
+            # The scan axis becomes a per-shard permuted/padded feature
+            # list; meta_h.group holds LOCAL group indices and
+            # meta_h.global_id maps winners back to global feature ids
+            # (dataset.cpp:97-314 bundles; feature_parallel_tree_
+            # learner.cpp partitions raw columns — bundling there is
+            # disabled for distributed runs, ours keeps it).
+            groups = np.asarray(self.meta.group)           # [F] global
+            g_total = self.binned.shape[1]
+            gp = _round_up(g_total, d)
+            g_local = gp // d
+            shard_of_feat = groups // g_local
+            feat_lists = [np.where(shard_of_feat == s)[0] for s in
+                          range(d)]
+            self._f_local = max(1, max(len(fl) for fl in feat_lists))
+            self._f_pad = d * self._f_local
+            perm = np.full(self._f_pad, -1, np.int64)
+            for s, fl in enumerate(feat_lists):
+                perm[s * self._f_local:s * self._f_local + len(fl)] = fl
+            live = perm >= 0
+            safe = np.where(live, perm, 0)
+
+            def permute(arr, pad_value, dtype=None):
+                a = np.asarray(arr)
+                out = np.where(live, a[safe], pad_value)
+                return jnp.asarray(out if dtype is None
+                                   else out.astype(dtype))
+
             meta_h = FeatureMeta(
-                num_bins=jnp.pad(meta.num_bins, (0, fpad),
-                                 constant_values=2),
-                missing=jnp.pad(meta.missing, (0, fpad)),
-                default_bin=jnp.pad(meta.default_bin, (0, fpad)),
-                most_freq_bin=jnp.pad(meta.most_freq_bin, (0, fpad)),
-                monotone=jnp.pad(meta.monotone, (0, fpad)),
-                penalty=jnp.pad(meta.penalty, (0, fpad),
-                                constant_values=1.0),
-                is_categorical=jnp.pad(meta.is_categorical, (0, fpad)),
-                group=jnp.pad(meta.group, (0, fpad)),
-                offset=jnp.pad(meta.offset, (0, fpad)),
-                cegb_coupled_penalty=jnp.pad(
-                    meta.cegb_coupled_penalty, (0, fpad)),
-                cegb_lazy_penalty=jnp.pad(
-                    meta.cegb_lazy_penalty, (0, fpad)))
+                num_bins=permute(meta.num_bins, 2),
+                missing=permute(meta.missing, 0),
+                default_bin=permute(meta.default_bin, 0),
+                most_freq_bin=permute(meta.most_freq_bin, 0),
+                monotone=permute(meta.monotone, 0),
+                penalty=permute(meta.penalty, 1.0, np.float32),
+                is_categorical=permute(meta.is_categorical, False),
+                # LOCAL group index inside the shard's histogram slice
+                group=jnp.asarray(np.where(
+                    live, groups[safe] - (np.arange(self._f_pad)
+                                          // self._f_local) * g_local,
+                    0).astype(np.int32)),
+                offset=permute(meta.offset, 0),
+                cegb_coupled_penalty=permute(
+                    meta.cegb_coupled_penalty, 0.0, np.float32),
+                cegb_lazy_penalty=permute(
+                    meta.cegb_lazy_penalty, 0.0, np.float32),
+                global_id=jnp.asarray(
+                    np.where(live, perm, f).astype(np.int32)))
+            self._fmask_perm = (jnp.asarray(live),
+                                jnp.asarray(safe.astype(np.int32)))
+            binned_hist = self.binned
+            if gp != g_total:
+                binned_hist = jnp.pad(binned_hist,
+                                      ((0, 0), (0, gp - g_total)))
         else:
-            meta_h = meta
-        comm = make_feature_parallel_comm(AXIS, self._f_local)
+            self._f_pad = _round_up(f, d)
+            self._f_local = self._f_pad // d
+            self._fmask_perm = None
+            meta_h = _pad_meta(meta, self._f_pad - f, f)
+            binned_hist = self.binned
+            if self._f_pad != f:
+                binned_hist = jnp.pad(binned_hist,
+                                      ((0, 0), (0, self._f_pad - f)))
+        comm = make_feature_parallel_comm(AXIS)
 
         # the scan axis is the LOCAL feature shard: each shard draws its
         # own stream (fold in the shard index) over its exact slice of
@@ -258,6 +306,7 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
                 hist_method=self.hist_method, comm=comm,
                 binned_hist=binned_h, meta_hist=meta_hist, rand_key=rkey,
+                bundled=self.bundled,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=bn_local, bynode_cap=bn_cap,
                 cache_hists=self.cache_hists)
@@ -280,6 +329,9 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                                      meta_h)
 
     def _pad_feature_mask(self, fmask):
+        if self._fmask_perm is not None:  # bundled: permuted scan axis
+            live, safe = self._fmask_perm
+            return jnp.where(live, fmask[safe], False)
         fpad = self._f_pad - self.dataset.num_features
         if fpad:
             fmask = jnp.pad(fmask, (0, fpad))  # padded features masked off
@@ -291,9 +343,9 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
     sharded; only top-k candidate features' histograms are aggregated."""
 
     def _build(self):
-        # voting debundles per shard BEFORE its gather/reduce, so the
-        # bin-0 totals reconstruction would double count across shards
-        _reject_bundled(self.dataset, "voting")
+        # EFB-bundled input is fine: each shard debundles its LOCAL
+        # group hist with LOCAL leaf totals (Comm.local_hist) before
+        # the top-k vote, so the winning features' psum is exact
         self._drop_forced_plan("voting")
         d = self.num_shards
         n = self.dataset.num_data
@@ -372,7 +424,6 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         self.n_local = self._n_pad // d
 
         if mode == "voting":
-            _reject_bundled(dataset, "voting")
             if self.forced_plan:
                 from ..utils.log import log_warning
                 log_warning("forcedsplits_filename is not supported by "
@@ -505,9 +556,7 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
         return SerialTreeLearner(dataset, config, hist_method=hist_method)
     if cls is PartitionedTreeLearner:
         return PartitionedTreeLearner(dataset, config)
-    if on_device and fits_u8 and learner_type in ("data", "voting") \
-            and not (learner_type == "voting"
-                     and dataset.feature_offset is not None):
+    if on_device and fits_u8 and learner_type in ("data", "voting"):
         return MeshPartitionedTreeLearner(dataset, config, mesh=mesh,
                                           mode=learner_type)
     return cls(dataset, config, mesh=mesh, hist_method=hist_method)

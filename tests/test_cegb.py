@@ -170,6 +170,13 @@ def test_cegb_refund_resurrects_penalized_leaf():
     f_splits = [s for s in range(tt.num_leaves - 1)
                 if tt.split_feature[s] == 0]
     assert len(f_splits) == 2
+    # reference refund arithmetic: the cache keeps RAW gains (DetlaGain
+    # stores split_info before the delta is subtracted), so the
+    # refund-upgraded split records raw + coupled — the acquiring split
+    # records its penalized gain (raw - coupled)
+    taxed_gains = sorted(float(tt.split_gain[s]) for s in f_splits)
+    np.testing.assert_allclose(taxed_gains[0], high - penalty, rtol=1e-5)
+    np.testing.assert_allclose(taxed_gains[1], low + penalty, rtol=1e-5)
 
 
 def test_cegb_lazy_penalty_root_gain_oracle():

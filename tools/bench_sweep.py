@@ -41,6 +41,9 @@ def main() -> int:
         env["BENCH_ROWS"] = str(rows)
         # fewer measured iters at large N keeps the sweep bounded
         env.setdefault("BENCH_ITERS", "3" if rows > 2_000_000 else "5")
+        # pinned-mode bench.py caps its child timeout at BENCH_BUDGET_S
+        # (escalation plan + per-size caps only apply unpinned)
+        env.setdefault("BENCH_BUDGET_S", "3600")
         t0 = time.time()
         # own session: on timeout the WHOLE process group dies (the
         # _BENCH_CHILD grandchild holds the sole TPU client slot; an
@@ -50,9 +53,9 @@ def main() -> int:
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, start_new_session=True)
         try:
-            # bench.py retries init failures internally (3 attempts x
-            # 3600s child timeout); the cap must exceed that budget
-            stdout, stderr = proc.communicate(timeout=12000)
+            # bench.py retries init failures internally within its
+            # BENCH_BUDGET_S (3600 s here); the cap must exceed that
+            stdout, stderr = proc.communicate(timeout=4500)
         except subprocess.TimeoutExpired:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
