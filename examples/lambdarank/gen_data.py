@@ -1,0 +1,38 @@
+"""Generate rank.train / rank.test with .query sidecars (reference CLI
+example format: TSV, integer relevance 0..4 first column, no header;
+query sizes one-per-line in <data>.query;
+/root/reference/examples/lambdarank). Run once before train.conf.
+
+Shared by examples/xendcg (the reference ships the same data shape for
+both ranking objectives)."""
+
+import os
+
+import numpy as np
+
+rng = np.random.RandomState(42)
+
+
+def write(path, n_queries, docs_lo=10, docs_hi=30):
+    rows = []
+    qsizes = []
+    for _ in range(n_queries):
+        m = rng.randint(docs_lo, docs_hi)
+        qsizes.append(m)
+        X = rng.randn(m, 20).astype(np.float32)
+        score = 1.5 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] \
+            + 0.3 * rng.randn(m)
+        # graded relevance 0..4 by within-query quantile
+        order = np.argsort(np.argsort(score))
+        rel = (order * 5 // m).clip(0, 4)
+        rows.append(np.column_stack([rel, X]))
+    data = np.vstack(rows)
+    np.savetxt(path, data, fmt="%.6g", delimiter="\t")
+    np.savetxt(path + ".query", np.asarray(qsizes, np.int64), fmt="%d")
+    print(f"wrote {path} ({len(data)} rows, {n_queries} queries)")
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    write(os.path.join(here, "rank.train"), 200)
+    write(os.path.join(here, "rank.test"), 30)

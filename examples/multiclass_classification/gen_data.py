@@ -1,0 +1,26 @@
+"""Generate multiclass.train / multiclass.test (reference CLI example
+format: TSV, integer label 0..4 first column, no header;
+/root/reference/examples/multiclass_classification). Run once before
+train.conf."""
+
+import os
+
+import numpy as np
+
+rng = np.random.RandomState(42)
+
+K = 5
+
+
+def write(path, n):
+    X = rng.randn(n, 28).astype(np.float32)
+    centers = rng.randn(K, 28) * 1.5
+    y = rng.randint(0, K, size=n)
+    X += centers[y] * 0.8
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+    print(f"wrote {path} ({n} rows)")
+
+
+here = os.path.dirname(os.path.abspath(__file__))
+write(os.path.join(here, "multiclass.train"), 7000)
+write(os.path.join(here, "multiclass.test"), 500)

@@ -1,0 +1,24 @@
+"""Generate binary.train / binary.test for the parallel-learning
+example (same format as examples/binary_classification;
+/root/reference/examples/parallel_learning ships the binary data).
+Run once before train.conf."""
+
+import os
+
+import numpy as np
+
+rng = np.random.RandomState(42)
+
+
+def write(path, n):
+    X = rng.randn(n, 28).astype(np.float32)
+    logit = 2 * X[:, 0] - 1.5 * X[:, 1] + X[:, 2] * X[:, 3] - X[:, 6]
+    y = (logit + rng.randn(n) > 0).astype(int)
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+    print(f"wrote {path} ({n} rows)")
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    write(os.path.join(here, "binary.train"), 7000)
+    write(os.path.join(here, "binary.test"), 500)

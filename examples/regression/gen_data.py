@@ -1,0 +1,31 @@
+"""Generate regression.train / regression.test (+ .init init-score
+sidecars) in the reference CLI example format: TSV, label first column,
+no header (/root/reference/examples/regression). Run once before
+train.conf."""
+
+import os
+
+import numpy as np
+
+rng = np.random.RandomState(42)
+
+
+def make(n):
+    X = rng.randn(n, 28).astype(np.float32)
+    y = (3.0 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]
+         + np.sin(X[:, 4]) + 0.5 * rng.randn(n))
+    return X, y
+
+
+def write(path, n, with_init=False):
+    X, y = make(n)
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+    if with_init:
+        # optional init-score sidecar (<data>.init), one score per row
+        np.savetxt(path + ".init", np.full(n, y.mean()), fmt="%.6g")
+    print(f"wrote {path} ({n} rows)")
+
+
+here = os.path.dirname(os.path.abspath(__file__))
+write(os.path.join(here, "regression.train"), 7000, with_init=True)
+write(os.path.join(here, "regression.test"), 500, with_init=True)

@@ -1,0 +1,13 @@
+"""Generate rank.train / rank.test with .query sidecars for the
+XE_NDCG ranking objective (/root/reference/examples/xendcg ships the
+same data shape as lambdarank). Run once before train.conf."""
+
+import os
+import runpy
+
+here = os.path.dirname(os.path.abspath(__file__))
+lambdarank = os.path.join(here, "..", "lambdarank", "gen_data.py")
+# reuse the lambdarank generator, writing into THIS directory
+g = runpy.run_path(lambdarank, run_name="__gen__")
+g["write"](os.path.join(here, "rank.train"), 200)
+g["write"](os.path.join(here, "rank.test"), 30)

@@ -21,25 +21,32 @@ import numpy as np
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+# separate lock: a cold treeshap compile (up to 120 s) must not stall
+# concurrent fast-parser users
+_SHAP_LOCK = threading.Lock()
+_SHAP_LIB: Optional[ctypes.CDLL] = None
+_SHAP_TRIED = False
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fast_parser.cpp")
 _SO = os.path.join(_HERE, "_fast_parser.so")
+_SHAP_SRC = os.path.join(_HERE, "treeshap.cpp")
+_SHAP_SO = os.path.join(_HERE, "_treeshap.so")
 
 
-def _compile() -> Optional[str]:
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
+def _compile(src: str = _SRC, so: str = _SO) -> Optional[str]:
+    if os.path.exists(so) and \
+            os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
     # per-pid temp: concurrent processes (multi-host training) must not
     # interleave g++ output into one file before the atomic replace
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+    tmp = f"{so}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", tmp]
+           src, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
+        os.replace(tmp, so)
+        return so
     except (OSError, subprocess.SubprocessError):
         try:
             os.unlink(tmp)
@@ -79,6 +86,38 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                           DP, L, L, I]
         _LIB = lib
         return _LIB
+
+
+def get_shap_lib() -> Optional[ctypes.CDLL]:
+    """Native TreeSHAP (treeshap.cpp), compile-on-first-use; None when
+    unavailable (LGBM_TPU_NO_NATIVE or no compiler)."""
+    global _SHAP_LIB, _SHAP_TRIED
+    with _SHAP_LOCK:
+        if _SHAP_TRIED:
+            return _SHAP_LIB
+        _SHAP_TRIED = True
+        if os.environ.get("LGBM_TPU_NO_NATIVE"):
+            return None
+        so = _compile(_SHAP_SRC, _SHAP_SO)
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        L, D, I = ctypes.c_long, ctypes.c_double, ctypes.c_int
+        DP = ctypes.POINTER(D)
+        IP = ctypes.POINTER(ctypes.c_int32)
+        LP = ctypes.POINTER(ctypes.c_int64)
+        lib.lgbm_tree_shap.restype = L
+        lib.lgbm_tree_shap.argtypes = [
+            DP, L, L,            # data, n_rows, n_cols
+            L, IP, IP, IP, DP,   # num_leaves, lc, rc, split_feature, thr
+            IP, IP, DP, DP, DP,  # dec_type, missing, leaf_v, leaf_c, int_c
+            LP, LP,              # cat_offsets, cat_vals
+            L, DP, L, I]         # max_path, phi, phi_stride, n_threads
+        _SHAP_LIB = lib
+        return _SHAP_LIB
 
 
 def _mmap_file(path: str):

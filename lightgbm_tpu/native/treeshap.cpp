@@ -1,0 +1,216 @@
+// Native TreeSHAP: per-row recursive path attribution, threaded over
+// rows. Reference analog: Tree::TreeSHAP / ExtendPath / UnwindPath /
+// UnwoundPathSum (src/io/tree.cpp:631-737) — the reference computes
+// SHAP contributions in compiled C++ (tree.h:143 PredictContrib);
+// this is the same role for the TPU package's host prediction path.
+// The algorithm mirrors lightgbm_tpu/predictor.py:_tree_shap (the
+// pure-Python fallback, kept as the golden reference for tests).
+//
+// Plain extern "C" + ctypes (no pybind11), like fast_parser.cpp.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct PathElem {
+  int fidx;
+  double zero_f;
+  double one_f;
+  double pweight;
+};
+
+struct TreeView {
+  long num_leaves;
+  const int32_t* left_child;
+  const int32_t* right_child;
+  const int32_t* split_feature;  // REAL feature index per node
+  const double* threshold;
+  const int32_t* decision_type;  // bit0 categorical, bit1 default-left
+  const int32_t* missing_code;   // 0 none, 1 zero, 2 nan
+  const double* leaf_value;
+  const double* leaf_count;
+  const double* internal_count;
+  const int64_t* cat_offsets;    // [n_nodes + 1] prefix into cat_vals
+  const int64_t* cat_vals;       // sorted member categories per node
+};
+
+inline double node_count(const TreeView& t, int node) {
+  return node < 0 ? t.leaf_count[~node] : t.internal_count[node];
+}
+
+// NumericalDecision / CategoricalDecision; must match
+// models/tree.py:_decide exactly (tree.h:250-300 semantics)
+inline bool decide(const TreeView& t, const double* x, int node) {
+  double fval = x[t.split_feature[node]];
+  const int miss = t.missing_code[node];
+  const bool nan_in = std::isnan(fval);
+  if (nan_in && miss != 2) fval = 0.0;  // NaN -> 0 unless nan-typed
+  if (t.decision_type[node] & 1) {      // categorical
+    if (std::isnan(fval)) return false;
+    const double floored = std::trunc(fval);
+    if (floored < 0) return false;
+    const int64_t v = static_cast<int64_t>(floored);
+    const int64_t* lo = t.cat_vals + t.cat_offsets[node];
+    const int64_t* hi = t.cat_vals + t.cat_offsets[node + 1];
+    return std::binary_search(lo, hi, v);
+  }
+  bool is_missing = false;
+  if (miss == 1) is_missing = std::fabs(fval) <= 1e-35;
+  else if (miss == 2) is_missing = nan_in;
+  if (is_missing) return (t.decision_type[node] & 2) != 0;
+  return fval <= t.threshold[node];
+}
+
+// ExtendPath (tree.cpp:631-643)
+inline void extend(PathElem* path, int depth, double zero_f,
+                   double one_f, int fidx) {
+  path[depth] = {fidx, zero_f, one_f, depth == 0 ? 1.0 : 0.0};
+  for (int i = depth - 1; i >= 0; --i) {
+    path[i + 1].pweight +=
+        one_f * path[i].pweight * (i + 1) / (depth + 1);
+    path[i].pweight = zero_f * path[i].pweight * (depth - i) / (depth + 1);
+  }
+}
+
+// UnwindPath (tree.cpp:645-668)
+inline void unwind(PathElem* path, int depth, int pidx) {
+  const double zero_f = path[pidx].zero_f;
+  const double one_f = path[pidx].one_f;
+  double next_one = path[depth].pweight;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_f != 0) {
+      const double tmp = path[i].pweight;
+      path[i].pweight = next_one * (depth + 1) / ((i + 1) * one_f);
+      next_one = tmp - path[i].pweight * zero_f * (depth - i) / (depth + 1);
+    } else {
+      path[i].pweight = path[i].pweight * (depth + 1)
+          / (zero_f * (depth - i));
+    }
+  }
+  for (int i = pidx; i < depth; ++i) {
+    path[i].fidx = path[i + 1].fidx;
+    path[i].zero_f = path[i + 1].zero_f;
+    path[i].one_f = path[i + 1].one_f;
+  }
+}
+
+// UnwoundPathSum (tree.cpp:670-688)
+inline double unwound_sum(const PathElem* path, int depth, int pidx) {
+  const double zero_f = path[pidx].zero_f;
+  const double one_f = path[pidx].one_f;
+  double next_one = path[depth].pweight;
+  double total = 0.0;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_f != 0) {
+      const double tmp = next_one * (depth + 1) / ((i + 1) * one_f);
+      total += tmp;
+      next_one = path[i].pweight - tmp * zero_f * (depth - i) / (depth + 1);
+    } else {
+      total += (path[i].pweight / zero_f)
+          / (static_cast<double>(depth - i) / (depth + 1));
+    }
+  }
+  return total;
+}
+
+void shap_recurse(const TreeView& t, const double* x, double* phi,
+                  PathElem* arena, int node, int depth, int parent_off,
+                  double parent_zero, double parent_one, int parent_fidx) {
+  const int off = parent_off + depth;
+  PathElem* path = arena + off;
+  if (depth > 0)
+    std::memcpy(path, arena + parent_off, sizeof(PathElem) * depth);
+  extend(path, depth, parent_zero, parent_one, parent_fidx);
+  if (node < 0) {
+    const double leaf = t.leaf_value[~node];
+    for (int i = 1; i <= depth; ++i) {
+      const double w = unwound_sum(path, depth, i);
+      phi[path[i].fidx] += w * (path[i].one_f - path[i].zero_f) * leaf;
+    }
+    return;
+  }
+  const int left = t.left_child[node];
+  const int right = t.right_child[node];
+  const int hot = decide(t, x, node) ? left : right;
+  const int cold = hot == left ? right : left;
+  const double w = node_count(t, node);
+  const double hot_zero = node_count(t, hot) / w;
+  const double cold_zero = node_count(t, cold) / w;
+  double inc_zero = 1.0, inc_one = 1.0;
+  const int fidx_node = t.split_feature[node];
+  int pidx = 0;
+  while (pidx <= depth && path[pidx].fidx != fidx_node) ++pidx;
+  if (pidx != depth + 1) {
+    inc_zero = path[pidx].zero_f;
+    inc_one = path[pidx].one_f;
+    unwind(path, depth, pidx);
+    --depth;
+  }
+  shap_recurse(t, x, phi, arena, hot, depth + 1, off,
+               hot_zero * inc_zero, inc_one, fidx_node);
+  shap_recurse(t, x, phi, arena, cold, depth + 1, off,
+               cold_zero * inc_zero, 0.0, fidx_node);
+}
+
+}  // namespace
+
+extern "C" {
+
+// SHAP contributions of ONE tree, ADDED into phi for every row.
+// data: [n_rows, n_cols] float64 C-order; phi: rows of phi_stride
+// doubles (feature slots at [0, n_cols), caller owns the expected-
+// value slot). max_path = max leaf depth + 2 (arena sizing).
+long lgbm_tree_shap(const double* data, long n_rows, long n_cols,
+                    long num_leaves, const int32_t* left_child,
+                    const int32_t* right_child,
+                    const int32_t* split_feature, const double* threshold,
+                    const int32_t* decision_type,
+                    const int32_t* missing_code, const double* leaf_value,
+                    const double* leaf_count, const double* internal_count,
+                    const int64_t* cat_offsets, const int64_t* cat_vals,
+                    long max_path, double* phi, long phi_stride,
+                    int n_threads) {
+  if (num_leaves <= 1 || n_rows <= 0) return n_rows;
+  TreeView t{num_leaves, left_child,  right_child,   split_feature,
+             threshold,  decision_type, missing_code, leaf_value,
+             leaf_count, internal_count, cat_offsets, cat_vals};
+  const long arena_len = (max_path + 1) * (max_path + 2) / 2 + max_path;
+  int workers = n_threads > 0
+      ? n_threads
+      : static_cast<int>(std::thread::hardware_concurrency());
+  if (workers < 1) workers = 1;
+  if (workers > n_rows) workers = static_cast<int>(n_rows);
+
+  std::atomic<long> next_block(0);
+  const long kBlock = 256;
+  auto work = [&]() {
+    std::vector<PathElem> arena(arena_len);
+    for (;;) {
+      const long b = next_block.fetch_add(1);
+      const long lo = b * kBlock;
+      if (lo >= n_rows) break;
+      const long hi = std::min(lo + kBlock, n_rows);
+      for (long r = lo; r < hi; ++r) {
+        shap_recurse(t, data + r * n_cols, phi + r * phi_stride,
+                     arena.data(), 0, 0, 0, 1.0, 1.0, -1);
+      }
+    }
+  };
+  if (workers == 1) {
+    work();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(workers);
+    for (int i = 0; i < workers; ++i) pool.emplace_back(work);
+    for (auto& th : pool) th.join();
+  }
+  return n_rows;
+}
+
+}  // extern "C"

@@ -222,23 +222,46 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
         if self.bundled:
             # EFB: shard whole bundle GROUPS (a bundle's features must
             # stay together — its group histogram debundles locally).
-            # The scan axis becomes a per-shard permuted/padded feature
-            # list; meta_h.group holds LOCAL group indices and
+            # Groups are assigned largest-first to the least-loaded
+            # shard (by feature count) and the histogram matrix columns
+            # are permuted so each shard's groups are contiguous; the
+            # scan axis becomes a per-shard permuted/padded feature
+            # list. meta_h.group holds LOCAL group (column) indices and
             # meta_h.global_id maps winners back to global feature ids
             # (dataset.cpp:97-314 bundles; feature_parallel_tree_
             # learner.cpp partitions raw columns — bundling there is
             # disabled for distributed runs, ours keeps it).
             groups = np.asarray(self.meta.group)           # [F] global
             g_total = self.binned.shape[1]
-            gp = _round_up(g_total, d)
-            g_local = gp // d
-            shard_of_feat = groups // g_local
-            feat_lists = [np.where(shard_of_feat == s)[0] for s in
-                          range(d)]
-            self._f_local = max(1, max(len(fl) for fl in feat_lists))
+            feat_of_group = [np.where(groups == g)[0]
+                             for g in range(g_total)]
+            order = np.argsort([-len(fg) for fg in feat_of_group],
+                               kind="stable")
+            shard_groups: list = [[] for _ in range(d)]
+            load = [0] * d
+            for g in order:
+                s = min(range(d), key=lambda i: (load[i], i))
+                shard_groups[s].append(int(g))
+                load[s] += len(feat_of_group[int(g)])
+            g_local = max(1, max(len(sg) for sg in shard_groups))
+            self._f_local = max(1, max(load))
             self._f_pad = d * self._f_local
+            # column permutation of the histogram matrix
+            col_perm = np.zeros(d * g_local, np.int64)
+            col_live = np.zeros(d * g_local, bool)
+            local_col_of_group = np.zeros(g_total, np.int32)
+            for s, sg in enumerate(shard_groups):
+                for j, g in enumerate(sg):
+                    col_perm[s * g_local + j] = g
+                    col_live[s * g_local + j] = True
+                    local_col_of_group[g] = j
+            # per-shard feature slots: ascending global id inside each
+            # shard (keeps serial's first-index tie-break within shard)
             perm = np.full(self._f_pad, -1, np.int64)
-            for s, fl in enumerate(feat_lists):
+            for s, sg in enumerate(shard_groups):
+                fl = np.sort(np.concatenate(
+                    [feat_of_group[g] for g in sg]).astype(np.int64)) \
+                    if sg else np.zeros(0, np.int64)
                 perm[s * self._f_local:s * self._f_local + len(fl)] = fl
             live = perm >= 0
             safe = np.where(live, perm, 0)
@@ -257,10 +280,9 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 monotone=permute(meta.monotone, 0),
                 penalty=permute(meta.penalty, 1.0, np.float32),
                 is_categorical=permute(meta.is_categorical, False),
-                # LOCAL group index inside the shard's histogram slice
+                # LOCAL column index inside the shard's histogram slice
                 group=jnp.asarray(np.where(
-                    live, groups[safe] - (np.arange(self._f_pad)
-                                          // self._f_local) * g_local,
+                    live, local_col_of_group[groups[safe]],
                     0).astype(np.int32)),
                 offset=permute(meta.offset, 0),
                 cegb_coupled_penalty=permute(
@@ -271,10 +293,12 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                     np.where(live, perm, f).astype(np.int32)))
             self._fmask_perm = (jnp.asarray(live),
                                 jnp.asarray(safe.astype(np.int32)))
-            binned_hist = self.binned
-            if gp != g_total:
-                binned_hist = jnp.pad(binned_hist,
-                                      ((0, 0), (0, gp - g_total)))
+            binned_hist = jnp.where(
+                jnp.asarray(col_live)[None, :],
+                jnp.take(self.binned,
+                         jnp.asarray(np.where(col_live, col_perm, 0)),
+                         axis=1),
+                jnp.zeros((), self.binned.dtype))
         else:
             self._f_pad = _round_up(f, d)
             self._f_local = self._f_pad // d

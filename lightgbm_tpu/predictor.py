@@ -261,17 +261,74 @@ def _bin_data(data: np.ndarray, dataset) -> np.ndarray:
 # SHAP (TreeSHAP, src/io/tree.cpp:631-737)
 def _predict_contrib(models, data: np.ndarray, k: int) -> np.ndarray:
     """[N, k*(F+1)] SHAP values; last slot per class is the expected
-    value (Tree::PredictContrib, tree.h:512-527)."""
+    value (Tree::PredictContrib, tree.h:512-527).
+
+    The row loop runs in native threaded C++ (native/treeshap.cpp,
+    the analog of the reference's compiled TreeSHAP tree.cpp:631-737);
+    the recursive Python _tree_shap below is the fallback and the
+    golden reference for tests."""
+    from .native import get_shap_lib
     n, f = data.shape
     out = np.zeros((n, k, f + 1))
+    lib = get_shap_lib() if n else None
+    cdata = np.ascontiguousarray(data, np.float64) \
+        if lib is not None else None
     for i, tree in enumerate(models):
         cls = i % k
         out[:, cls, f] += _expected_value(tree)
-        if tree.num_leaves > 1:
-            tree.ensure_leaf_depth()  # arena sizing needs real depths
+        if tree.num_leaves <= 1:
+            continue
+        tree.ensure_leaf_depth()  # arena sizing needs real depths
+        if lib is not None:
+            _tree_shap_native(lib, tree, cdata, out, cls, f, k)
+        else:
             for row in range(n):
                 _tree_shap(tree, data[row], out[row, cls])
     return out.reshape(n, k * (f + 1)) if k > 1 else out[:, 0, :]
+
+
+def _tree_shap_native(lib, tree, cdata: np.ndarray, out: np.ndarray,
+                      cls: int, f: int, k: int) -> None:
+    """One lgbm_tree_shap call: all rows of one tree, threaded."""
+    import ctypes
+    n = cdata.shape[0]
+    nn = len(tree.split_feature)
+    cat_offsets = np.zeros(nn + 1, np.int64)
+    for j, cats in enumerate(tree.cat_threshold):
+        cat_offsets[j + 1] = cat_offsets[j] + len(cats)
+    cat_vals = np.concatenate(  # sorted WITHIN each node's span
+        [np.sort(np.asarray(c, np.int64)) for c in tree.cat_threshold]
+    ).astype(np.int64) if cat_offsets[-1] else np.zeros(1, np.int64)
+    # materialize every array for the call's duration (ctypes pointers
+    # do not keep temporaries alive on old numpy)
+    arrs = dict(
+        lc=np.ascontiguousarray(tree.left_child, np.int32),
+        rc=np.ascontiguousarray(tree.right_child, np.int32),
+        sf=np.ascontiguousarray(tree.split_feature, np.int32),
+        thr=np.ascontiguousarray(tree.threshold, np.float64),
+        dec=np.ascontiguousarray(tree.decision_type, np.int32),
+        miss=np.ascontiguousarray(tree._missing_code, np.int32),
+        lv=np.ascontiguousarray(tree.leaf_value, np.float64),
+        lcnt=np.ascontiguousarray(tree.leaf_count, np.float64),
+        icnt=np.ascontiguousarray(tree.internal_count, np.float64),
+        coff=cat_offsets, cvals=cat_vals)
+    DP = ctypes.POINTER(ctypes.c_double)
+    IP = ctypes.POINTER(ctypes.c_int32)
+    LP = ctypes.POINTER(ctypes.c_int64)
+    max_path = int(tree.leaf_depth.max(initial=0)) + 2
+    # class slice of the [N, k, F+1] buffer: offset cls*(F+1), row
+    # stride k*(F+1) doubles
+    phi_ptr = ctypes.cast(out.ctypes.data + cls * (f + 1) * 8, DP)
+    lib.lgbm_tree_shap(
+        cdata.ctypes.data_as(DP), n, f, tree.num_leaves,
+        arrs["lc"].ctypes.data_as(IP), arrs["rc"].ctypes.data_as(IP),
+        arrs["sf"].ctypes.data_as(IP), arrs["thr"].ctypes.data_as(DP),
+        arrs["dec"].ctypes.data_as(IP), arrs["miss"].ctypes.data_as(IP),
+        arrs["lv"].ctypes.data_as(DP), arrs["lcnt"].ctypes.data_as(DP),
+        arrs["icnt"].ctypes.data_as(DP),
+        arrs["coff"].ctypes.data_as(LP), arrs["cvals"].ctypes.data_as(LP),
+        max_path, phi_ptr, k * (f + 1), 0)
+    del arrs
 
 
 def _expected_value(tree) -> float:

@@ -425,3 +425,40 @@ def test_add_features_from_sparse_bundled():
     pred = bst.predict(X)
     auc = (pred[y == 1][:, None] > pred[y == 0][None, :]).mean()
     assert auc > 0.9, auc
+
+
+def test_contrib_native_matches_python_fallback():
+    """native/treeshap.cpp must reproduce the recursive Python
+    TreeSHAP exactly (same arithmetic order), incl. categorical
+    splits and NaN handling."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import predictor as pred_mod
+    from lightgbm_tpu.native import get_shap_lib
+    if get_shap_lib() is None:
+        import pytest
+        pytest.skip("no native toolchain")
+    rng = np.random.RandomState(5)
+    n, f = 300, 6
+    X = rng.randn(n, f)
+    X[:, 3] = rng.randint(0, 5, size=n)       # categorical
+    X[rng.rand(n) < 0.1, 0] = np.nan          # missing
+    y = (X[:, 0] > 0).astype(float) + (X[:, 3] == 2) \
+        + 0.3 * rng.randn(n)
+    booster = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "categorical_feature": [3], "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+    native = booster.predict(X, pred_contrib=True)
+    models = booster._src().models
+    k = 1
+    out = np.zeros((n, k, f + 1))
+    for i, tree in enumerate(models):
+        out[:, 0, f] += pred_mod._expected_value(tree)
+        if tree.num_leaves > 1:
+            tree.ensure_leaf_depth()
+            for row in range(n):
+                pred_mod._tree_shap(tree, X[row], out[row, 0])
+    np.testing.assert_allclose(native, out[:, 0, :], rtol=1e-9,
+                               atol=1e-12)
+    # contribs + expected value still sum to the raw prediction
+    raw = booster.predict(X, raw_score=True)
+    np.testing.assert_allclose(native.sum(axis=1), raw, rtol=1e-6)

@@ -300,3 +300,29 @@ def test_bundled_full_training_voting():
     pred = booster.predict(X)
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, pred) > 0.9
+
+
+def test_feature_parallel_bundled_unbalanced_groups():
+    """Fewer groups than shards + uneven bundle sizes: the balanced
+    group->shard assignment must still reproduce serial exactly."""
+    rng = np.random.RandomState(11)
+    n = 1500
+    # one 8-feature exclusive bundle + 3 dense singleton features
+    Xb = np.zeros((n, 8))
+    which = rng.randint(0, 9, size=n)
+    rows = np.where(which < 8)[0]
+    Xb[rows, which[rows]] = rng.randint(1, 6, size=len(rows)) * 1.0
+    Xd = rng.randn(n, 3)
+    X = np.column_stack([Xb, Xd])
+    y = (Xd[:, 0] + Xb[:, 0] - Xb[:, 1] + 0.2 * rng.randn(n) > 0
+         ).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 11,
+                              "min_data_in_leaf": 5, "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    assert ds.feature_offset is not None
+    serial = SerialTreeLearner(ds, cfg)
+    g, h = _grad_hess(y)
+    ref_tree = serial.to_host_tree(serial.train(g, h))
+    learner = FeatureParallelTreeLearner(ds, cfg, mesh=default_mesh())
+    tree = learner.to_host_tree(learner.train(g, h))
+    _assert_same_tree(tree, ref_tree)

@@ -54,8 +54,10 @@ def main() -> int:
             text=True, start_new_session=True)
         try:
             # bench.py retries init failures internally within its
-            # BENCH_BUDGET_S (3600 s here); the cap must exceed that
-            stdout, stderr = proc.communicate(timeout=4500)
+            # BENCH_BUDGET_S; the kill cap must exceed whatever budget
+            # is in effect (incl. an operator override via env)
+            cap = float(env["BENCH_BUDGET_S"]) + 900
+            stdout, stderr = proc.communicate(timeout=cap)
         except subprocess.TimeoutExpired:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
