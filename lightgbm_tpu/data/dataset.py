@@ -471,31 +471,36 @@ class Dataset:
             sample_idx = rng.choice(n, sample_cnt, replace=False)
             in_sample = np.zeros(n, bool)
             in_sample[sample_idx] = True
-        if int(config.num_machines) > 1:
-            log_warning("Distributed bin finding is not implemented for "
-                        "sparse input; each host bins from its local "
-                        "sample")
         cat_set = set(int(c) for c in categorical_features)
-        filter_cnt = int(max(
-            config.min_data_in_leaf * sample_cnt / max(n, 1), 1)) \
-            if config.feature_pre_filter else 0
 
         indptr, indices, vals = csc.indptr, csc.indices, csc.data
-        self.bin_mappers = []
+        col_samples: List[np.ndarray] = []
         for j in range(num_features):
             colv = vals[indptr[j]:indptr[j + 1]]
             if in_sample is not None:
                 rows_j = indices[indptr[j]:indptr[j + 1]]
                 colv = colv[in_sample[rows_j]]
             colv = np.asarray(colv, np.float64)
-            nonzero = colv[(np.abs(colv) > kZeroThreshold)
-                           | np.isnan(colv)]
+            col_samples.append(colv[(np.abs(colv) > kZeroThreshold)
+                                    | np.isnan(colv)])
+        # distributed bin finding (dataset_loader.cpp:824-1001, sparse
+        # branch): pre-partitioned hosts merge their per-feature
+        # nonzero samples so every host derives IDENTICAL BinMappers
+        from ..parallel.distributed import maybe_gather_sparse_bin_sample
+        col_samples, sample_cnt, n_global = maybe_gather_sparse_bin_sample(
+            col_samples, sample_cnt, config, n)
+        filter_cnt = int(max(
+            config.min_data_in_leaf * sample_cnt / max(n_global, 1), 1)) \
+            if config.feature_pre_filter else 0
+
+        self.bin_mappers = []
+        for j in range(num_features):
             mapper = BinMapper()
             bt = BIN_TYPE_CATEGORICAL if j in cat_set \
                 else BIN_TYPE_NUMERICAL
             fb = (forced_bins or {}).get(j, ())
             mapper.find_bin(
-                nonzero, total_sample_cnt=sample_cnt,
+                col_samples[j], total_sample_cnt=sample_cnt,
                 max_bin=_max_bin_for(config, j),
                 min_data_in_bin=self.min_data_in_bin,
                 min_split_data=filter_cnt,

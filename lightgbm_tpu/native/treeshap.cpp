@@ -184,11 +184,12 @@ long lgbm_tree_shap(const double* data, long n_rows, long n_cols,
   int workers = n_threads > 0
       ? n_threads
       : static_cast<int>(std::thread::hardware_concurrency());
+  const long kBlock = 256;
+  const long n_blocks = (n_rows + kBlock - 1) / kBlock;
   if (workers < 1) workers = 1;
-  if (workers > n_rows) workers = static_cast<int>(n_rows);
+  if (workers > n_blocks) workers = static_cast<int>(n_blocks);
 
   std::atomic<long> next_block(0);
-  const long kBlock = 256;
   auto work = [&]() {
     std::vector<PathElem> arena(arena_len);
     for (;;) {

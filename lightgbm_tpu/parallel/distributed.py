@@ -171,6 +171,45 @@ def maybe_gather_bin_sample(sample: np.ndarray, config: Config,
     return gather_bin_sample(sample), int(counts.sum())
 
 
+def maybe_gather_sparse_bin_sample(col_values: List[np.ndarray],
+                                   sample_cnt: int, config: Config,
+                                   num_data_local: int):
+    """Sparse analog of ``maybe_gather_bin_sample``: allgather the
+    per-feature sampled NONZERO value lists (zeros ride the summed
+    total_sample_cnt) so every pre-partitioned host derives IDENTICAL
+    BinMappers from its sparse shard (the sparse branch of
+    dataset_loader.cpp:824-1001). Returns
+    ``(col_values, total_sample_cnt, num_data_global)``."""
+    if not config.pre_partition or not _multi_process():
+        return col_values, sample_cnt, num_data_local
+    from jax.experimental import multihost_utils
+    ag = multihost_utils.process_allgather
+    counts = np.asarray([len(c) for c in col_values], np.int64)
+    flat = (np.concatenate([np.asarray(c, np.float64)
+                            for c in col_values])
+            if counts.sum() else np.zeros(0, np.float64))
+    meta = np.asarray([sample_cnt, num_data_local, flat.shape[0]],
+                      np.int64)
+    metas = np.asarray(ag(meta)).reshape(-1, 3)
+    n_proc = metas.shape[0]
+    counts_g = np.asarray(ag(counts)).reshape(n_proc, -1)
+    m = int(metas[:, 2].max())
+    if m > flat.shape[0]:
+        flat = np.concatenate([flat,
+                               np.zeros(m - flat.shape[0], np.float64)])
+    flats = np.asarray(ag(flat)).reshape(n_proc, -1)
+    merged: List[np.ndarray] = []
+    offs = np.zeros(n_proc, np.int64)
+    for j in range(len(col_values)):
+        parts = []
+        for p in range(n_proc):
+            c = int(counts_g[p, j])
+            parts.append(flats[p, offs[p]:offs[p] + c])
+            offs[p] += c
+        merged.append(np.concatenate(parts))
+    return merged, int(metas[:, 0].sum()), int(metas[:, 1].sum())
+
+
 def _multi_process() -> bool:
     import jax
     try:
