@@ -149,9 +149,13 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
         if interpret is None:
             interpret = jax.default_backend() not in ("tpu", "axon")
         self.interpret = interpret
-        from .serial import use_hist_cache
-        self.cache_hists = use_hist_cache(
+        from .serial import hist_pool_slots
+        # bounded LRU pool (single-device path only; the mesh learners
+        # keep full-cache/rebuild because their seg_hist carries
+        # collectives that must not sit under a lax.cond)
+        self.hist_slots = hist_pool_slots(
             config, self.num_leaves, self.num_groups, self.num_bins_max)
+        self.cache_hists = self.hist_slots >= self.num_leaves
         self._init_cegb()
         self._drop_cegb_lazy("partitioned learners keep rows "
                              "physically reordered")
@@ -190,7 +194,7 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             n=self.num_data, bundled=self.bundled,
             interpret=self.interpret, extra_trees=self.extra_trees,
             ff_bynode=self.ff_bynode, bynode_count=self.bynode_count,
-            forced_plan=self.forced_plan, cache_hists=self.cache_hists)
+            forced_plan=self.forced_plan, hist_slots=self.hist_slots)
         res = GrowResult(tree=tree, leaf_id=leaf_id)
         self._cegb_after_tree(res)
         return res
@@ -201,14 +205,15 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
                               "num_bins_max", "num_features",
                               "num_groups", "n", "bundled", "interpret",
                               "extra_trees", "ff_bynode", "bynode_count",
-                              "forced_plan", "cache_hists"),
+                              "forced_plan", "cache_hists", "hist_slots"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       rand_key=None, cegb_used0=None, *, params,
                       num_leaves, max_depth, num_bins_max, num_features,
                       num_groups, n, bundled, interpret,
                       extra_trees=False, ff_bynode=1.0,
-                      bynode_count=2, forced_plan=(), cache_hists=True):
+                      bynode_count=2, forced_plan=(), cache_hists=True,
+                      hist_slots=None):
     return grow_partitioned(
         mat, ws, grad, hess, bag_weight, feature_mask, meta,
         rand_key=rand_key, params=params, num_leaves=num_leaves,
@@ -217,7 +222,7 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         bundled=bundled, interpret=interpret, extra_trees=extra_trees,
         ff_bynode=ff_bynode, bynode_count=bynode_count,
         forced_plan=forced_plan, cache_hists=cache_hists,
-        cegb_used0=cegb_used0)
+        cegb_used0=cegb_used0, hist_slots=hist_slots)
 
 
 def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
@@ -226,7 +231,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      interpret, extra_trees=False, ff_bynode=1.0,
                      bynode_count=2, forced_plan=(), comm=None,
                      row_id_base=0, n_total=None, cache_hists=True,
-                     cegb_used0=None):
+                     cegb_used0=None, hist_slots=None):
     """Traceable partitioned grow loop.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py)
@@ -265,6 +270,22 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     def seg_hist(m, begin, count):
         return comm.reduce_hist(histogram_segment(
             m, begin, count, b, f, blk=HIST_BLK, interpret=interpret))
+
+    # histogram-memory modes (HistogramPool,
+    # serial_tree_learner.cpp:313-353): full per-leaf cache / bounded
+    # LRU pool of `pool_slots` slots with parent-slot reuse / rebuild
+    # both children on demand. The pool engages only on the serial
+    # comm: its seg_hist is collective-free, so the cached-parent
+    # branch can sit under a lax.cond
+    if hist_slots is None:
+        hist_slots = big_l if cache_hists else 0
+    from .comm import SERIAL_COMM as _SER
+    pool_mode = (2 <= hist_slots < big_l) and comm is _SER
+    if pool_mode:
+        cache_hists = False
+        pool_slots = int(hist_slots)
+    else:
+        cache_hists = hist_slots >= big_l
 
     inf = jnp.float32(jnp.inf)
     node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
@@ -372,6 +393,18 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     if cache_hists:
         fields["hist"] = at0(
             jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
+    if pool_mode:
+        # bounded LRU pool: slot 0 holds the root; slot_used carries
+        # the split tick of the last touch (-1 = empty, filled first)
+        fields.update(
+            pool=at0(jnp.zeros((pool_slots, f, b, 3), jnp.float32),
+                     root_hist),
+            slot_of_leaf=at0(jnp.full((big_l,), -1, jnp.int32),
+                             jnp.int32(0)),
+            leaf_of_slot=at0(jnp.full((pool_slots,), -1, jnp.int32),
+                             jnp.int32(0)),
+            slot_used=at0(jnp.full((pool_slots,), -1, jnp.int32),
+                          jnp.int32(0)))
     if params.cegb_on:
         fields["cegb_used"] = cegb_used0
         fields.update(cegb_pf_state(big_l, num_features))
@@ -379,6 +412,17 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     state = pack_state(fields)
 
     leaf_range = jnp.arange(big_l)
+
+    def leaf_hist_any(v, leaf):
+        """Forced-split path: one leaf's histogram from the pool when
+        present, else rebuilt from its segment."""
+        if not pool_mode:
+            return leaf_hist_seg(v, leaf)
+        slot = v["slot_of_leaf"][leaf]
+        return jax.lax.cond(
+            slot >= 0,
+            lambda _: v["pool"][jnp.clip(slot, 0)],
+            lambda _: leaf_hist_seg(v, leaf), None)
 
     def leaf_hist_seg(v, leaf):
         """Pool-bounded mode: rebuild one leaf's histogram from its
@@ -425,7 +469,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         else:
             fh = forced_hist if forced_hist is not None \
                 else st["hist"][forced[0]] if cache_hists \
-                else leaf_hist_seg(st, forced[0])
+                else leaf_hist_any(st, forced[0])
             (leaf, feat, thr, dleft, gain, is_cat, bitset,
              lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
                 forced_split_override(fh, st, forced, params, meta,
@@ -484,6 +528,29 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             hist_other = parent_hist - hist_small
             hist_left = jnp.where(left_small, hist_small, hist_other)
             hist_right = jnp.where(left_small, hist_other, hist_small)
+        elif pool_mode:
+            # parent pooled: stream only the smaller child + subtract;
+            # evicted: both children directly (cheaper than rebuilding
+            # the parent first — cnt rows vs 1.5*cnt)
+            slot = st["slot_of_leaf"][leaf]
+            have_parent = slot >= 0
+
+            def _from_pool(_):
+                parent_hist = st["pool"][jnp.clip(slot, 0)]
+                left_small = lc <= rc
+                sb = jnp.where(left_small, begin, begin + nl)
+                sc = jnp.where(left_small, nl, nr)
+                hist_small = seg_hist(mat2, sb, sc)
+                hist_other = parent_hist - hist_small
+                return (jnp.where(left_small, hist_small, hist_other),
+                        jnp.where(left_small, hist_other, hist_small))
+
+            def _rebuild_children(_):
+                return (seg_hist(mat2, begin, nl),
+                        seg_hist(mat2, begin + nl, nr))
+
+            hist_left, hist_right = jax.lax.cond(
+                have_parent, _from_pool, _rebuild_children, None)
         else:
             hist_left = seg_hist(mat2, begin, nl)
             hist_right = seg_hist(mat2, begin + nl, nr)
@@ -574,6 +641,32 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         if cache_hists:
             st2["hist"] = st["hist"].at[leaf].set(hist_left) \
                 .at[new].set(hist_right)
+        elif pool_mode:
+            # children claim slots: the left child reuses the parent's
+            # slot (HistogramPool::Move semantics), the right evicts
+            # the LRU slot; evicted owners fall back to rebuild
+            tick = k  # strictly increasing per split
+            used0 = st["slot_used"]
+            sol = st["slot_of_leaf"]
+            los = st["leaf_of_slot"]
+            slot_l = jnp.where(have_parent, slot,
+                               jnp.argmin(used0).astype(jnp.int32))
+            own1 = los[slot_l]
+            sol = sol.at[jnp.clip(own1, 0)].set(
+                jnp.where(own1 >= 0, -1, sol[jnp.clip(own1, 0)]))
+            used1 = used0.at[slot_l].set(tick)
+            slot_r = jnp.argmin(used1).astype(jnp.int32)  # != slot_l
+            own2 = los[slot_r]
+            sol = sol.at[jnp.clip(own2, 0)].set(
+                jnp.where(own2 >= 0, -1, sol[jnp.clip(own2, 0)]))
+            st2.update(
+                slot_of_leaf=sol.at[leaf].set(slot_l)
+                .at[new].set(slot_r),
+                leaf_of_slot=los.at[slot_l].set(leaf)
+                .at[slot_r].set(new),
+                slot_used=used1.at[slot_r].set(tick),
+                pool=st["pool"].at[slot_l].set(hist_left)
+                .at[slot_r].set(hist_right))
         if params.cegb_on:
             # shared CEGB helpers mutate whole rows on a view dict;
             # repack writes them back as static-index row updates
@@ -594,7 +687,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     for step in forced_plan:
         v0 = view_state(st)
         fh0 = v0["hist"][step[0]] if cache_hists \
-            else leaf_hist_seg(v0, step[0])
+            else leaf_hist_any(v0, step[0])
         lg_f, lh_f, _ = forced_left_sums(fh0, v0, step, meta, bundled)
         ph_f = v0["leaf_h"][step[0]]
         force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \

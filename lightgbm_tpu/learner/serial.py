@@ -242,12 +242,26 @@ def use_hist_cache(config: Config, num_leaves: int, f: int,
     """histogram_pool_size (MB) semantics (config.h:244, HistogramPool
     serial_tree_learner.cpp:313-353): cache per-leaf histograms only if
     the full [num_leaves, F, B, 3] f32 cache fits the budget; otherwise
-    the grow loops run pool-bounded (rebuild both children per split).
-    <= 0 means unlimited, like the reference default."""
+    the grow loops run pool-bounded. <= 0 means unlimited, like the
+    reference default. (One source of truth: hist_pool_slots.)"""
+    return hist_pool_slots(config, num_leaves, f, b) >= num_leaves
+
+
+def hist_pool_slots(config: Config, num_leaves: int, f: int,
+                    b: int) -> int:
+    """Slot count for the partitioned learner's bounded LRU histogram
+    pool (HistogramPool, serial_tree_learner.cpp:313-353): the full
+    [num_leaves, F, B, 3] cache when it fits histogram_pool_size MB
+    (<= 0 = unlimited, the reference default), else as many whole
+    slots as fit (>= 2 needed for parent+sibling), else 0 =
+    rebuild-both-children-on-demand."""
     pool = float(config.histogram_pool_size)
     if pool <= 0:
-        return True
-    return num_leaves * f * b * 3 * 4 <= pool * 1024 * 1024
+        return num_leaves
+    slots = int(pool * 1024 * 1024 // (f * b * 3 * 4))
+    if slots >= num_leaves:
+        return num_leaves
+    return slots if slots >= 2 else 0
 
 
 def split_params_from_config(config: Config) -> SplitParams:

@@ -346,3 +346,46 @@ def test_profile_capture(tmp_path, monkeypatch):
                for f in found), found
     from lightgbm_tpu.utils.log import global_timer
     assert global_timer.acc.get("boosting", 0) > 0
+
+
+def test_histogram_pool_lru_matches_cached():
+    """A bounded LRU pool (2 <= slots < num_leaves) with parent-slot
+    reuse must reproduce the fully-cached trees (HistogramPool,
+    serial_tree_learner.cpp:313-353): cached parents use the
+    subtraction trick, evicted leaves rebuild both children."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.learner.partitioned import PartitionedTreeLearner
+    from lightgbm_tpu.learner.serial import SerialTreeLearner
+
+    rng = np.random.RandomState(9)
+    n = 1500
+    X = rng.randn(n, 8)
+    y = (X[:, 0] - 0.5 * X[:, 1] + X[:, 2] * X[:, 3]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    grad = jnp.asarray(y - 0.5)
+    hess = jnp.full((n,), 0.25, jnp.float32)
+
+    base = {"objective": "binary", "num_leaves": 31,
+            "min_data_in_leaf": 5, "verbosity": -1}
+    cfg = Config.from_params(base)
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    ref = SerialTreeLearner(ds, cfg)
+    t_ref = ref.to_host_tree(ref.train(grad, hess))
+
+    # slot = f*b*3*4 bytes; 0.1 MB -> a handful of slots, << 31 leaves
+    cfg_pool = Config.from_params(dict(base, histogram_pool_size=0.1))
+    pl = PartitionedTreeLearner(ds, cfg_pool, interpret=True)
+    assert 2 <= pl.hist_slots < 31, pl.hist_slots
+    t_p = pl.to_host_tree(pl.train(grad, hess))
+    assert t_p.num_leaves == t_ref.num_leaves
+    np.testing.assert_array_equal(t_p.split_feature_inner,
+                                  t_ref.split_feature_inner)
+    np.testing.assert_array_equal(t_p.threshold_bin, t_ref.threshold_bin)
+    np.testing.assert_allclose(t_p.leaf_value, t_ref.leaf_value,
+                               rtol=2e-4, atol=2e-6)
+    # second tree reuses the donated matrices + pool state
+    t_p2 = pl.to_host_tree(pl.train(grad, hess))
+    np.testing.assert_array_equal(t_p2.split_feature_inner,
+                                  t_ref.split_feature_inner)
