@@ -75,12 +75,25 @@ def measure():
     dt = time.perf_counter() - t0
 
     throughput = n * iters / dt
-    print(json.dumps({
+    result = {
         "metric": "higgs_like_train_throughput",
         "value": round(throughput / 1e6, 4),
         "unit": "Mrow-iters/s",
         "vs_baseline": round(throughput / BASELINE_ROW_ITERS_PER_S, 4),
-        "rows": n}))
+        "rows": n}
+    if os.environ.get("BENCH_EVAL") == "1":
+        # training-quality gate (Experiments.rst:120-148 accuracy
+        # table analog): in-sample AUC on a bounded slice. Never let a
+        # failed eval erase the measured throughput
+        try:
+            from sklearn.metrics import roc_auc_score
+            m = min(n, 500_000)
+            pred = booster.predict_raw(X[:m])
+            result["auc"] = round(float(roc_auc_score(y[:m], pred)), 5)
+            result["auc_iters"] = warmup + iters
+        except Exception as e:  # noqa: BLE001
+            result["auc_error"] = str(e)[:200]
+    print(json.dumps(result))
 
 
 def find_result_line(stdout: str):

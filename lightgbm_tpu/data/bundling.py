@@ -63,20 +63,42 @@ def encode_feature_bin(out_col: np.ndarray, bins: np.ndarray,
 
 
 class BundlePlan:
-    """Result of bundling: per-inner-feature column/offset maps."""
+    """Result of bundling: per-inner-feature column/offset maps.
+
+    Multi-val (dataset.cpp:186-231 second round, multi_val_sparse_bin
+    .hpp): features whose combined conflicts overflow the shared-
+    column budget live in PSEUDO-groups — group ids >= mv_group_start
+    that have NO physical matrix column; their per-row values ride a
+    padded row-wise slot matrix (Dataset.mv_slots) encoded as
+    pseudo_local * 256 + in-group value, and their histograms are
+    scatter-accumulated then concatenated after the dense groups'.
+    """
 
     def __init__(self, feature_group: np.ndarray,
                  feature_offset: np.ndarray, num_groups: int,
-                 group_num_bins: np.ndarray):
+                 group_num_bins: np.ndarray,
+                 mv_group_start: Optional[int] = None):
         self.feature_group = feature_group    # [F] i32 matrix column
         self.feature_offset = feature_offset  # [F] i32, 0 = raw bins
-        self.num_groups = num_groups
+        self.num_groups = num_groups          # incl. mv pseudo-groups
         self.group_num_bins = group_num_bins  # [G] i32
+        # first mv pseudo-group id; == num_groups when no multi-val
+        self.mv_group_start = (num_groups if mv_group_start is None
+                               else mv_group_start)
+
+    @property
+    def num_dense_groups(self) -> int:
+        return self.mv_group_start
+
+    @property
+    def has_multival(self) -> bool:
+        return self.mv_group_start < self.num_groups
 
     @property
     def is_identity(self) -> bool:
         return self.num_groups == len(self.feature_group) \
-            and (self.feature_offset == 0).all()
+            and (self.feature_offset == 0).all() \
+            and not self.has_multival
 
 
 def _find_groups(nz_idx: List[Optional[np.ndarray]], nbins: np.ndarray,
@@ -138,7 +160,38 @@ def _find_groups(nz_idx: List[Optional[np.ndarray]], nbins: np.ndarray,
             total_cnt.append(nnz)
             used_cnt.append(nnz)
             nbin.append(1 + add_bins)
-    return groups + singletons
+    # SECOND round (dataset.cpp:186-231): dissolve groups whose used-
+    # row density is below 0.4 — their features are candidates for the
+    # row-wise multi-val representation when their combined conflicts
+    # overflow the single-column budget
+    DENSE_THRESHOLD = 0.4
+    kept: List[List[int]] = []
+    second: List[int] = []
+    second_nnz = 0
+    for g, feats in enumerate(groups):
+        if used_cnt[g] >= DENSE_THRESHOLD * total:
+            kept.append(feats)
+        else:
+            second.extend(feats)
+            second_nnz += total_cnt[g]
+    multival: List[int] = []
+    if second:
+        # conflicts of one shared column = sum(nnz) - distinct rows;
+        # within budget -> ONE shared column (the reference's second-
+        # round group); over budget -> the whole set goes multi-val
+        # (row-wise). Documented divergence: we also require the
+        # shared column to fit the u8 bin budget, the reference lets
+        # second-round groups grow wider bins
+        mark = np.zeros(total, bool)
+        for fidx in second:
+            mark[nz_idx[fidx]] = True
+        conflicts = second_nnz - int(mark.sum())
+        bins2 = 1 + sum(int(nbins[fidx]) - 1 for fidx in second)
+        if conflicts > max_conflict or bins2 > MAX_BIN_PER_GROUP:
+            multival = sorted(second)
+        else:
+            kept.append(sorted(second))
+    return kept + singletons, multival
 
 
 def plan_bundles(binned: np.ndarray, num_bins: np.ndarray,
@@ -177,15 +230,37 @@ def plan_bundles_from_nonzeros(nz_idx: List[Optional[np.ndarray]],
 
     natural = np.arange(f)
     by_cnt = np.argsort(-nnz, kind="stable")
-    g1 = _find_groups(nz_idx, num_bins, natural, total, max_conflict, seed)
-    g2 = _find_groups(nz_idx, num_bins, by_cnt, total, max_conflict, seed)
-    groups = g2 if len(g2) < len(g1) else g1
+    g1, mv1 = _find_groups(nz_idx, num_bins, natural, total, max_conflict,
+                           seed)
+    g2, mv2 = _find_groups(nz_idx, num_bins, by_cnt, total, max_conflict,
+                           seed)
+    if len(g2) + (1 if mv2 else 0) < len(g1) + (1 if mv1 else 0):
+        groups, multival = g2, mv2
+    else:
+        groups, multival = g1, mv1
+
+    # multi-val pseudo-groups: first-fit features into <=256-value
+    # slots appended after the dense groups (no physical column)
+    mv_groups: List[List[int]] = []
+    mv_bins: List[int] = []
+    for fidx in multival:
+        add = int(num_bins[fidx]) - 1
+        for gi in range(len(mv_groups)):
+            if mv_bins[gi] + add <= MAX_BIN_PER_GROUP:
+                mv_groups[gi].append(fidx)
+                mv_bins[gi] += add
+                break
+        else:
+            mv_groups.append([fidx])
+            mv_bins.append(1 + add)
+    groups = groups + mv_groups
+    mv_group_start = len(groups) - len(mv_groups)
 
     feature_group = np.zeros(f, np.int32)
     feature_offset = np.zeros(f, np.int32)
     group_num_bins = np.zeros(len(groups), np.int32)
     for gid, feats in enumerate(groups):
-        if len(feats) == 1:
+        if len(feats) == 1 and gid < mv_group_start:
             feature_group[feats[0]] = gid
             feature_offset[feats[0]] = 0  # raw bins pass through
             group_num_bins[gid] = num_bins[feats[0]]
@@ -196,20 +271,34 @@ def plan_bundles_from_nonzeros(nz_idx: List[Optional[np.ndarray]],
                 feature_offset[fidx] = off
                 off += int(num_bins[fidx]) - 1
             group_num_bins[gid] = off
+    if mv_groups and mv_group_start == 0:
+        # every feature went multi-val: keep ONE dummy dense group so
+        # the physical matrix has a column and group ids stay aligned
+        # with binned.shape[1] == mv_group_start
+        feature_group += 1
+        group_num_bins = np.concatenate(
+            [np.asarray([2], np.int32), group_num_bins])
+        mv_group_start = 1
+        groups = [[]] + groups
     return BundlePlan(feature_group, feature_offset, len(groups),
-                      group_num_bins)
+                      group_num_bins, mv_group_start)
 
 
 def bundle_matrix(binned: np.ndarray, plan: BundlePlan) -> np.ndarray:
-    """[N, F] raw bins -> [N, G] bundled columns (FeatureGroup::PushData
-    semantics: non-default values land at their offset; ties resolved
-    by feature order, bounded by the conflict budget)."""
+    """[N, F] raw bins -> [N, G_dense] bundled columns
+    (FeatureGroup::PushData semantics: non-default values land at their
+    offset; ties resolved by feature order, bounded by the conflict
+    budget). Multi-val pseudo-groups get no column — their values ride
+    the slot matrix (build_mv_slots)."""
     n, f = binned.shape
-    max_b = int(plan.group_num_bins.max(initial=2))
+    g_dense = plan.num_dense_groups
+    max_b = int(plan.group_num_bins[:g_dense].max(initial=2))
     dtype = np.uint8 if max_b <= 256 else np.uint16
-    out = np.zeros((n, max(plan.num_groups, 1)), dtype)
+    out = np.zeros((n, max(g_dense, 1)), dtype)
     for j in range(f):
         g = plan.feature_group[j]
+        if g >= g_dense:
+            continue
         off = plan.feature_offset[j]
         col = binned[:, j]
         if off == 0:
@@ -217,3 +306,44 @@ def bundle_matrix(binned: np.ndarray, plan: BundlePlan) -> np.ndarray:
         else:
             encode_feature_bin(out[:, g], col, int(off))
     return out
+
+
+def dense_feature_bins(raw: np.ndarray):
+    """``feature_bins`` callback for build_mv_slots over a dense raw-
+    bins matrix: (nonzero rows, their bins > 0) of column j — the slot
+    encoding contract (only non-default bins are stored)."""
+    def feature_bins(j):
+        col = raw[:, j]
+        rows = np.nonzero(col)[0]
+        return rows, col[rows]
+    return feature_bins
+
+
+def build_mv_slots(plan: BundlePlan, n: int,
+                   feature_bins) -> np.ndarray:
+    """Row-wise padded slot matrix for the multi-val pseudo-groups
+    (MultiValSparseBin analog, multi_val_sparse_bin.hpp:26): slot value
+    = (pseudo_local * 256 + offset + bin - 1), 0-padded. Bin 0 of each
+    pseudo-group is never encoded (offsets start at 1), so padding
+    lands in slots the debundle never reads.
+
+    ``feature_bins(j)`` -> (row_idx, bins) of feature j's non-default
+    sampled rows (bins in the feature's own space, > 0)."""
+    counts = np.zeros(n, np.int64)
+    encoded: List[Tuple[np.ndarray, np.ndarray]] = []
+    for j in range(len(plan.feature_group)):
+        g = plan.feature_group[j]
+        if g < plan.mv_group_start:
+            continue
+        rows, bins = feature_bins(j)
+        enc = ((g - plan.mv_group_start) * 256
+               + plan.feature_offset[j] + bins.astype(np.int64) - 1)
+        encoded.append((rows, enc))
+        np.add.at(counts, rows, 1)
+    k = int(counts.max(initial=0))
+    slots = np.zeros((n, max(k, 1)), np.int32)
+    fill = np.zeros(n, np.int64)
+    for rows, enc in encoded:
+        slots[rows, fill[rows]] = enc
+        np.add.at(fill, rows, 1)
+    return slots

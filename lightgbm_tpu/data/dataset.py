@@ -172,7 +172,13 @@ class Dataset:
         self.feature_group: Optional[np.ndarray] = None   # [F] i32
         self.feature_offset: Optional[np.ndarray] = None  # [F] i32
         self.group_num_bins: Optional[np.ndarray] = None  # [G] i32
+        # multi-val (row-wise) pseudo-groups: slot matrix [N, K] i32 of
+        # (pseudo_local * 256 + offset + bin - 1), 0-padded; groups >=
+        # mv_group_start have no physical column (data/bundling.py)
+        self.mv_slots: Optional[np.ndarray] = None
+        self.mv_group_start: Optional[int] = None
         self._binned_device = None
+        self._mv_slots_device = None
 
     # ------------------------------------------------------------------
     @property
@@ -184,15 +190,35 @@ class Dataset:
         return self._binned_device
 
     @property
+    def mv_slots_device(self):
+        """Lazy device copy of the multi-val slot matrix."""
+        if self._mv_slots_device is None and self.mv_slots is not None:
+            import jax.numpy as jnp
+            self._mv_slots_device = jnp.asarray(self.mv_slots)
+        return self._mv_slots_device
+
+    @property
+    def has_multival(self) -> bool:
+        return self.mv_slots is not None
+
+    @property
     def num_features(self) -> int:
         return len(self.real_feature_idx)
 
     @property
     def num_groups(self) -> int:
-        """Physical matrix columns (== num_features when unbundled)."""
+        """Histogram groups incl. multi-val pseudo-groups
+        (== num_features when unbundled)."""
         if self.group_num_bins is not None:
             return len(self.group_num_bins)
         return self.num_features
+
+    @property
+    def num_dense_groups(self) -> int:
+        """Physical matrix columns (groups below mv_group_start)."""
+        if self.mv_group_start is not None:
+            return self.mv_group_start
+        return self.num_groups
 
     def bundle_maps(self):
         """(feature_group, feature_offset, group_num_bins) with identity
@@ -256,6 +282,7 @@ class Dataset:
             self.feature_group = reference.feature_group
             self.feature_offset = reference.feature_offset
             self.group_num_bins = reference.group_num_bins
+            self.mv_group_start = reference.mv_group_start
         else:
             self._find_bins(data, config, categorical_features, forced_bins)
             self._resolve_monotone_and_penalty(config)
@@ -264,11 +291,18 @@ class Dataset:
         if reference is None:
             self._maybe_bundle(config)
         elif self.feature_group is not None:
-            from .bundling import BundlePlan, bundle_matrix
+            from .bundling import (BundlePlan, build_mv_slots,
+                                   bundle_matrix)
             plan = BundlePlan(self.feature_group, self.feature_offset,
                               len(self.group_num_bins),
-                              self.group_num_bins)
-            self.binned = bundle_matrix(self.binned, plan)
+                              self.group_num_bins,
+                              mv_group_start=self.mv_group_start)
+            raw = self.binned
+            self.binned = bundle_matrix(raw, plan)
+            if plan.has_multival:
+                from .bundling import dense_feature_bins
+                self.mv_slots = build_mv_slots(plan, raw.shape[0],
+                                               dense_feature_bins(raw))
         self.metadata.num_data = n
         if label is not None:
             self.metadata.set_label(label)
@@ -354,12 +388,21 @@ class Dataset:
         plan = plan_bundles(self.binned, nb, eligible,
                             sample_cnt=self.bin_construct_sample_cnt,
                             seed=config.data_random_seed)
-        if plan.num_groups >= self.num_features:
+        if plan.num_groups >= self.num_features \
+                and not plan.has_multival:
             return
         from ..utils.log import log_info
         log_info(f"EFB: bundled {self.num_features} features into "
-                 f"{plan.num_groups} columns")
-        self.binned = bundle_matrix(self.binned, plan)
+                 f"{plan.num_groups} columns"
+                 + (f" ({plan.num_groups - plan.mv_group_start} "
+                    "multi-val)" if plan.has_multival else ""))
+        raw = self.binned
+        self.binned = bundle_matrix(raw, plan)
+        if plan.has_multival:
+            from .bundling import build_mv_slots, dense_feature_bins
+            self.mv_slots = build_mv_slots(plan, raw.shape[0],
+                                           dense_feature_bins(raw))
+            self.mv_group_start = plan.mv_group_start
         self.feature_group = plan.feature_group
         self.feature_offset = plan.feature_offset
         self.group_num_bins = plan.group_num_bins
@@ -444,6 +487,7 @@ class Dataset:
             self.feature_group = reference.feature_group
             self.feature_offset = reference.feature_offset
             self.group_num_bins = reference.group_num_bins
+            self.mv_group_start = reference.mv_group_start
         else:
             self._find_bins_sparse(csc, config, categorical_features,
                                    forced_bins)
@@ -539,7 +583,8 @@ class Dataset:
             if self.feature_group is not None:
                 plan = BundlePlan(self.feature_group, self.feature_offset,
                                   len(self.group_num_bins),
-                                  self.group_num_bins)
+                                  self.group_num_bins,
+                                  mv_group_start=self.mv_group_start)
         elif config.enable_bundle and f_used >= 2:
             # the planner only needs per-feature NON-DEFAULT row sets
             # within a row sample — taken straight from the CSC
@@ -572,16 +617,23 @@ class Dataset:
             if any(ix is not None for ix in nz_idx):
                 cand = plan_bundles_from_nonzeros(
                     nz_idx, nbins, take, seed=config.data_random_seed)
-                if cand.num_groups < f_used:
+                if cand.num_groups < f_used or cand.has_multival:
                     from ..utils.log import log_info
-                    log_info(f"EFB: bundled {f_used} sparse features "
-                             f"into {cand.num_groups} columns")
+                    log_info(
+                        f"EFB: bundled {f_used} sparse features into "
+                        f"{cand.num_groups} columns"
+                        + (f" ({cand.num_groups - cand.mv_group_start}"
+                           " multi-val)" if cand.has_multival else ""))
                     plan = cand
 
-        g_count = plan.num_groups if plan is not None else max(f_used, 1)
-        out = np.zeros((n, g_count), dtype)
+        g_dense = plan.num_dense_groups if plan is not None \
+            else max(f_used, 1)
+        out = np.zeros((n, max(g_dense, 1)), dtype)
         for inner in range(f_used):
             orig = self.real_feature_idx[inner]
+            if plan is not None \
+                    and plan.feature_group[inner] >= g_dense:
+                continue  # multi-val: rides the slot matrix below
             rows_j = indices[indptr[orig]:indptr[orig + 1]]
             bj = bins_nz[inner]
             if plan is None or plan.feature_offset[inner] == 0:
@@ -596,6 +648,18 @@ class Dataset:
                 out[rows_j[nz], g] = (bj[nz].astype(np.int64) + off
                                       - 1).astype(dtype)
         self.binned = out
+        if plan is not None and plan.has_multival:
+            from .bundling import build_mv_slots
+
+            def feature_bins(inner):
+                orig = self.real_feature_idx[inner]
+                rows_j = indices[indptr[orig]:indptr[orig + 1]]
+                bj = bins_nz[inner]
+                nz = bj != 0
+                return rows_j[nz], bj[nz].astype(np.int64)
+
+            self.mv_slots = build_mv_slots(plan, n, feature_bins)
+            self.mv_group_start = plan.mv_group_start
         if plan is not None and reference is None:
             self.feature_group = plan.feature_group
             self.feature_offset = plan.feature_offset
@@ -628,6 +692,9 @@ class Dataset:
             log_fatal("Cannot add features from a dataset with "
                       f"{other.num_data} rows to one with "
                       f"{self.num_data} rows")
+        if self.has_multival or other.has_multival:
+            log_fatal("add_features_from is not supported for multi-val "
+                      "datasets (pseudo-group ids cannot be appended)")
         f_self = self.num_features
         base_orig = self.num_total_features
 
@@ -678,9 +745,15 @@ class Dataset:
         """CopySubset (dataset.cpp) for bagging-style row subsets."""
         indices = np.asarray(indices)
         out = Dataset()
-        out.__dict__.update({k: v for k, v in self.__dict__.items()
-                             if k not in ("binned", "metadata", "num_data")})
+        out.__dict__.update({
+            k: v for k, v in self.__dict__.items()
+            if k not in ("binned", "metadata", "num_data", "mv_slots",
+                         "_binned_device", "_mv_slots_device")})
         out.binned = self.binned[indices]
+        out._binned_device = None
+        out._mv_slots_device = None
+        out.mv_slots = self.mv_slots[indices] \
+            if self.mv_slots is not None else None
         out.num_data = len(indices)
         out.metadata = self.metadata.subset(indices)
         return out
@@ -705,9 +778,12 @@ class Dataset:
             else [int(v) for v in self.feature_offset],
             "group_num_bins": None if self.group_num_bins is None
             else [int(v) for v in self.group_num_bins],
+            "mv_group_start": self.mv_group_start,
         }
         np.savez_compressed(
             path, binned=self.binned,
+            mv_slots=self.mv_slots if self.mv_slots is not None
+            else np.zeros((0, 0), np.int32),
             label=self.metadata.label if self.metadata.label is not None
             else np.zeros(0, np.float32),
             weights=self.metadata.weights
@@ -745,6 +821,9 @@ class Dataset:
                 self.group_num_bins = np.asarray(meta["group_num_bins"],
                                                  np.int32)
             self.binned = z["binned"]
+            if meta.get("mv_group_start") is not None:
+                self.mv_group_start = meta["mv_group_start"]
+                self.mv_slots = z["mv_slots"]
             self.num_data = len(self.binned)
             md = Metadata(self.num_data)
             if len(z["label"]):

@@ -140,6 +140,10 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
                 f"{type(self).__name__} packs bins as uint8 and supports "
                 f"max 256 bins per feature, got {self.num_bins_max}; use "
                 "max_bin<=255 or tree_learner='serial'")
+        if dataset.has_multival:
+            raise ValueError(
+                f"{type(self).__name__} needs a physical column per "
+                "group; multi-val datasets run on the XLA learners")
         self.num_leaves = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         self.num_features = dataset.num_features

@@ -572,6 +572,9 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
                 for i in range(dataset.num_features)))
         self.binned = jnp.asarray(dataset.binned)
+        # multi-val pseudo-groups (no physical column; bundling.py)
+        self.mv_slots = dataset.mv_slots_device
+        self.mv_groups = dataset.num_groups - dataset.num_dense_groups
         _, _, group_bins = dataset.bundle_maps()
         self.num_bins_max = max(
             int(dataset.num_bins_array().max(initial=2)),
@@ -581,7 +584,7 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
         self.max_depth = int(config.max_depth)
         self.hist_method = hist_method
         self.cache_hists = use_hist_cache(
-            config, self.num_leaves, self.binned.shape[1],
+            config, self.num_leaves, dataset.num_groups,
             self.num_bins_max)
         self._init_cegb()
 
@@ -609,7 +612,9 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
                         ff_bynode=self.ff_bynode,
                         bynode_count=self.bynode_count,
                         forced_plan=self.forced_plan,
-                        cache_hists=self.cache_hists)
+                        cache_hists=self.cache_hists,
+                        mv_slots=self.mv_slots,
+                        mv_groups=self.mv_groups)
         self._cegb_after_tree(res)
         if res.cegb_charged is not None:
             self._cegb_charged = res.cegb_charged
@@ -627,12 +632,14 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "hist_method", "bundled",
                               "extra_trees", "ff_bynode", "bynode_count",
-                              "forced_plan", "cache_hists"))
+                              "forced_plan", "cache_hists", "mv_groups"))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
-              rand_key=None, cegb_used0=None, cegb_charged0=None, *,
+              rand_key=None, cegb_used0=None, cegb_charged0=None,
+              mv_slots=None, *,
               params, num_leaves, max_depth, num_bins_max, hist_method,
               bundled=False, extra_trees=False, ff_bynode=1.0,
-              bynode_count=2, forced_plan=(), cache_hists=True):
+              bynode_count=2, forced_plan=(), cache_hists=True,
+              mv_groups=0):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
@@ -640,7 +647,8 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
                      rand_key=rand_key, extra_trees=extra_trees,
                      ff_bynode=ff_bynode, bynode_count=bynode_count,
                      forced_plan=forced_plan, cache_hists=cache_hists,
-                     cegb_used0=cegb_used0, cegb_charged0=cegb_charged0)
+                     cegb_used0=cegb_used0, cegb_charged0=cegb_charged0,
+                     mv_slots=mv_slots, mv_groups=mv_groups)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -651,7 +659,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               extra_trees: bool = False, ff_bynode: float = 1.0,
               bynode_count=2, bynode_cap: int | None = None,
               forced_plan: tuple = (), cache_hists: bool = True,
-              cegb_used0=None, cegb_charged0=None) -> GrowResult:
+              cegb_used0=None, cegb_charged0=None,
+              mv_slots=None, mv_groups: int = 0) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -675,13 +684,24 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     if meta_hist is None:
         meta_hist = meta
     n = binned.shape[0]
-    num_features_hist = binned_hist.shape[1]
+    num_features_hist = binned_hist.shape[1] + mv_groups
     big_l = num_leaves
     b = num_bins_max
 
+    def full_hist(ghc_arr):
+        """Dense-group histograms + multi-val pseudo-group histograms
+        concatenated on the group axis (one [G_total, B, 3] tensor —
+        the cache/subtraction/debundle machinery is layout-blind)."""
+        h = build_histogram(binned_hist, ghc_arr, b, method=hist_method)
+        if mv_groups:
+            from ..ops.histogram import multival_hist
+            h = jnp.concatenate(
+                [h, multival_hist(mv_slots, ghc_arr, mv_groups, b)],
+                axis=0)
+        return h
+
     ghc = make_ghc(grad, hess, bag_weight)
-    root_hist = comm.reduce_hist(
-        build_histogram(binned_hist, ghc, b, method=hist_method))
+    root_hist = comm.reduce_hist(full_hist(ghc))
     root_sums = comm.reduce_sums(ghc.sum(axis=0))
     root_g, root_h, root_c = root_sums[0], root_sums[1], root_sums[2]
 
@@ -827,8 +847,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         """Pool-bounded mode: rebuild one leaf's histogram on demand."""
         ghc_leaf = ghc * (st["leaf_id"] == leaf).astype(
             jnp.float32)[:, None]
-        return comm.reduce_hist(
-            build_histogram(binned_hist, ghc_leaf, b, method=hist_method))
+        return comm.reduce_hist(full_hist(ghc_leaf))
 
     def cond(st):
         open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
@@ -868,12 +887,33 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                                       bundled)
 
         # ---- partition rows of `leaf` ---------------------------------
-        bin_col = jnp.take(binned, meta.group[feat], axis=1)
-        if bundled:
-            from ..data.bundling import decode_feature_bin
-            bin_col = decode_feature_bin(
-                bin_col.astype(jnp.int32), meta.offset[feat],
-                meta.num_bins[feat]).astype(bin_col.dtype)
+        grp = meta.group[feat]
+        if mv_groups:
+            g_dense = binned.shape[1]
+
+            def _mv_bins(_):
+                from ..ops.histogram import multival_feature_bins
+                base = (grp - g_dense) * 256 + meta.offset[feat]
+                return multival_feature_bins(
+                    mv_slots, base, meta.num_bins[feat]).astype(jnp.int32)
+
+            def _dense_bins(_):
+                from ..data.bundling import decode_feature_bin
+                col = jnp.take(binned, jnp.clip(grp, 0, g_dense - 1),
+                               axis=1).astype(jnp.int32)
+                return decode_feature_bin(col, meta.offset[feat],
+                                          meta.num_bins[feat]) \
+                    .astype(jnp.int32)
+
+            bin_col = jax.lax.cond(grp >= g_dense, _mv_bins,
+                                   _dense_bins, None)
+        else:
+            bin_col = jnp.take(binned, meta.group[feat], axis=1)
+            if bundled:
+                from ..data.bundling import decode_feature_bin
+                bin_col = decode_feature_bin(
+                    bin_col.astype(jnp.int32), meta.offset[feat],
+                    meta.num_bins[feat]).astype(bin_col.dtype)
         leaf_id = split_leaf(
             st["leaf_id"], bin_col, leaf, new, thr, dleft,
             meta.missing[feat], meta.default_bin[feat],
@@ -903,8 +943,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             small = jnp.where(lc <= rc, leaf, new)
             ghc_small = ghc * (leaf_id == small).astype(
                 jnp.float32)[:, None]
-            hist_small = comm.reduce_hist(build_histogram(
-                binned_hist, ghc_small, b, method=hist_method))
+            hist_small = comm.reduce_hist(full_hist(ghc_small))
             hist_other = parent_hist - hist_small
             left_small = lc <= rc
             hist_left = jnp.where(left_small, hist_small, hist_other)

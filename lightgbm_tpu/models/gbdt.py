@@ -282,7 +282,8 @@ class GBDT:
         self.train_score = self.train_score.at[:, tid].add(add)
         # valid: jitted bin-space traversal on device
         for i, vd in enumerate(self.valid_sets):
-            vadd = tree.predict_binned_device(vd.binned_device)
+            vadd = tree.predict_binned_device(vd.binned_device,
+                                              vd.mv_slots_device)
             self.valid_scores[i] = \
                 self.valid_scores[i].at[:, tid].add(vadd)
 
@@ -375,10 +376,12 @@ class GBDT:
             tree.shrink(-1.0)
             if self.train_data is not None:
                 tadd = tree.predict_binned_device(
-                    self.train_data.binned_device)
+                    self.train_data.binned_device,
+                    self.train_data.mv_slots_device)
                 self.train_score = self.train_score.at[:, tid].add(tadd)
             for i, vd in enumerate(self.valid_sets):
-                vadd = tree.predict_binned_device(vd.binned_device)
+                vadd = tree.predict_binned_device(vd.binned_device,
+                                              vd.mv_slots_device)
                 self.valid_scores[i] = \
                     self.valid_scores[i].at[:, tid].add(vadd)
         del self.models[-k:]
@@ -593,11 +596,13 @@ class GBDT:
                 for tid in range(k):
                     tree = self.models[-(es - j) * k + tid]
                     tadd = tree.predict_binned_device(
-                        self.train_data.binned_device)
+                        self.train_data.binned_device,
+                        self.train_data.mv_slots_device)
                     self.train_score = \
                         self.train_score.at[:, tid].add(tadd)
                     for i, vd in enumerate(self.valid_sets):
-                        vadd = tree.predict_binned_device(vd.binned_device)
+                        vadd = tree.predict_binned_device(vd.binned_device,
+                                              vd.mv_slots_device)
                         self.valid_scores[i] = \
                             self.valid_scores[i].at[:, tid].add(vadd)
             del self.models[-es * k:]

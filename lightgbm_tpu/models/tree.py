@@ -202,18 +202,28 @@ class Tree:
             return np.where(is_cat, cat, numeric)
         return numeric
 
-    def predict_binned(self, binned: np.ndarray) -> np.ndarray:
+    def predict_binned(self, binned: np.ndarray,
+                       mv_slots: Optional[np.ndarray] = None
+                       ) -> np.ndarray:
         """Prediction over a train-aligned BINNED matrix [N, F_inner].
 
         Mirrors Dataset-side decisions (bin-space): used for valid-set
         score updates (ScoreUpdater::AddScore on valid data).
         """
-        return self.leaf_value[self.predict_leaf_index_binned(binned)]
+        return self.leaf_value[
+            self.predict_leaf_index_binned(binned, mv_slots)]
 
-    def predict_leaf_index_binned(self, binned: np.ndarray) -> np.ndarray:
+    def predict_leaf_index_binned(self, binned: np.ndarray,
+                                  mv_slots: Optional[np.ndarray] = None
+                                  ) -> np.ndarray:
         n = binned.shape[0]
         if self.num_leaves <= 1:
             return np.zeros(n, np.int32)
+        g_dense = binned.shape[1]
+        if mv_slots is None and (self._col >= g_dense).any():
+            raise ValueError(
+                "tree splits on multi-val pseudo-groups; bin-space "
+                "prediction needs the dataset's mv_slots matrix")
         node = np.zeros(n, np.int32)
         out = np.full(n, -1, np.int32)
         active = np.ones(n, bool)
@@ -224,8 +234,19 @@ class Tree:
             nd = node[idx]
             from ..data.bundling import decode_feature_bin
             b = decode_feature_bin(
-                binned[idx, self._col[nd]].astype(np.int32),
+                binned[idx, np.clip(self._col[nd], 0, g_dense - 1)]
+                .astype(np.int32),
                 self._offset[nd], self._num_bin[nd])
+            if mv_slots is not None:
+                is_mv = self._col[nd] >= g_dense
+                if is_mv.any():
+                    base = ((self._col[nd] - g_dense) * 256
+                            + self._offset[nd])[:, None]
+                    sl = mv_slots[idx]
+                    inr = (sl >= base) \
+                        & (sl < base + self._num_bin[nd][:, None] - 1)
+                    b_mv = np.where(inr, sl - base + 1, 0).sum(axis=1)
+                    b = np.where(is_mv, b_mv, b)
             miss = self._missing_code[nd]
             dleft = (self.decision_type[nd] & kDefaultLeftMask) != 0
             is_cat = (self.decision_type[nd] & kCategoricalMask) != 0
@@ -247,7 +268,8 @@ class Tree:
             active[idx[is_leaf]] = False
         return out
 
-    def predict_binned_device(self, binned_dev) -> jnp.ndarray:
+    def predict_binned_device(self, binned_dev,
+                              mv_slots_dev=None) -> jnp.ndarray:
         """Device (jitted) bin-space prediction: f32 leaf values [N].
 
         Used wherever a past tree must be re-scored against a device-
@@ -260,6 +282,11 @@ class Tree:
         n = binned_dev.shape[0]
         if self.num_leaves <= 1:
             return jnp.full((n,), jnp.float32(self.leaf_value[0]))
+        if mv_slots_dev is None \
+                and (self._col >= binned_dev.shape[1]).any():
+            raise ValueError(
+                "tree splits on multi-val pseudo-groups; bin-space "
+                "prediction needs the dataset's mv_slots matrix")
         s = len(self.split_feature_inner)
         cap = 1
         while cap < s:
@@ -283,7 +310,9 @@ class Tree:
             jnp.asarray(pad(self._default_bin)),
             jnp.asarray(pad(self._num_bin)),
             jnp.asarray(pad(self.cat_bitsets)),
-            jnp.asarray(leaf_vals))
+            jnp.asarray(leaf_vals),
+            mv_slots=mv_slots_dev,
+            mv_present=mv_slots_dev is not None)
 
     def leaf_depth_of(self, leaf: int) -> int:
         return int(self.leaf_depth[leaf])
@@ -311,15 +340,18 @@ class Tree:
         return max(self.num_leaves - 1, 0)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("mv_present",))
 def _traverse_binned_jax(binned, col, offset, thr, dec, left, right, miss,
-                         default_bin, num_bin, cat_bitsets, leaf_vals):
+                         default_bin, num_bin, cat_bitsets, leaf_vals,
+                         mv_slots=None, mv_present: bool = False):
     """Vectorized bin-space tree walk (NumericalDecision semantics of
     predict_leaf_index_binned, in one lax.while_loop). ``col``/``offset``
     are the EFB physical column + value offset per node (offset 0 =
-    raw bins)."""
+    raw bins; columns >= the dense width are multi-val pseudo-groups
+    decoded from the row-wise slot matrix)."""
     n = binned.shape[0]
     rows = jnp.arange(n)
+    g_dense = binned.shape[1]
 
     def cond(state):
         return ~jnp.all(state[2])
@@ -328,8 +360,15 @@ def _traverse_binned_jax(binned, col, offset, thr, dec, left, right, miss,
         node, out, done = state
         nd = jnp.where(done, 0, node)
         from ..data.bundling import decode_feature_bin
-        b = decode_feature_bin(binned[rows, col[nd]].astype(jnp.int32),
-                               offset[nd], num_bin[nd])
+        b = decode_feature_bin(
+            binned[rows, jnp.clip(col[nd], 0, g_dense - 1)]
+            .astype(jnp.int32), offset[nd], num_bin[nd])
+        if mv_present:
+            from ..ops.histogram import multival_feature_bins
+            base = ((col[nd] - g_dense) * 256 + offset[nd])[:, None]
+            b_mv = multival_feature_bins(mv_slots, base,
+                                         num_bin[nd][:, None])
+            b = jnp.where(col[nd] >= g_dense, b_mv, b)
         m = miss[nd]
         dleft = (dec[nd] & kDefaultLeftMask) != 0
         is_cat = (dec[nd] & kCategoricalMask) != 0

@@ -101,6 +101,38 @@ def build_histogram(binned: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
     raise ValueError(f"unknown histogram method {method}")
 
 
+def multival_hist(slots: jnp.ndarray, ghc: jnp.ndarray, g_mv: int,
+                  b: int) -> jnp.ndarray:
+    """[G_mv, B, 3] histograms of the multi-val pseudo-groups
+    (Dataset::ConstructHistogramsMultiVal, dataset.cpp:1170-1273, done
+    the XLA way): K scatter-adds over the flat (pseudo*256 + value)
+    space, one per slot column. Padding slots (0) accumulate into
+    pseudo 0 / value 0, which the debundle never reads — bin 0 is
+    always reconstructed from leaf totals."""
+    flat = jnp.zeros((g_mv * 256, 3), jnp.float32)
+    n, k = slots.shape
+    if n * k <= 4_000_000:
+        # one scatter over the flattened slots (no serialization)
+        src = jnp.broadcast_to(ghc[:, None, :], (n, k, 3))
+        flat = flat.at[slots.reshape(-1)].add(src.reshape(-1, 3))
+    else:
+        # large inputs: K chained scatters avoid the [N*K, 3] temp
+        for j in range(k):
+            flat = flat.at[slots[:, j]].add(ghc)
+    hist = flat.reshape(g_mv, 256, 3)
+    if b <= 256:
+        return hist[:, :b, :]
+    return jnp.pad(hist, ((0, 0), (0, b - 256), (0, 0)))
+
+
+def multival_feature_bins(slots: jnp.ndarray, base, nbins):
+    """Per-row bins of ONE multi-val feature: the slot holding an
+    encoded value in [base, base + nbins - 1) decodes to bins 1.., all
+    other rows read the default bin 0 (MultiValBin row scan)."""
+    inr = (slots >= base) & (slots < base + nbins - 1)
+    return jnp.where(inr, slots - base + 1, 0).sum(axis=1)
+
+
 def debundle_totals(hist_g: jnp.ndarray, g, h, c, local_hist: bool):
     """Leaf totals for debundle_hist's bin-0 reconstruction. A comm
     that keeps histograms shard-LOCAL (voting) must debundle with

@@ -122,6 +122,22 @@ class _MeshLearnerBase(SerialTreeLearner):
             return self._cegb_used
         return jnp.zeros((self.dataset.num_features,), bool)
 
+    def _mv_sharded(self):
+        """Row-sharded multi-val slot matrix (a 1-wide dummy when the
+        dataset has none, so shard_map specs stay shape-stable)."""
+        mv = self.dataset.mv_slots_device
+        if mv is None:
+            mv = jnp.zeros((self.dataset.num_data, 1), jnp.int32)
+        if self._n_pad != self.dataset.num_data:
+            mv = jnp.pad(mv, ((0, self._n_pad - self.dataset.num_data),
+                              (0, 0)))
+        return jax.device_put(mv, NamedSharding(self.mesh, P(AXIS, None)))
+
+    @property
+    def _mv_groups(self):
+        return (self.dataset.num_groups
+                - self.dataset.num_dense_groups)
+
     # subclasses define _build() producing self._fn and padding info
 
     def train(self, grad, hess, bag_weight=None, feature_mask=None
@@ -180,8 +196,9 @@ class DataParallelTreeLearner(_MeshLearnerBase):
             binned, NamedSharding(self.mesh, P(AXIS, None)))
         comm = make_data_parallel_comm(AXIS)
         meta = self.meta
+        mv_groups = self._mv_groups
 
-        def body(binned_l, grad, hess, bag, fmask, rkey, cegb0):
+        def body(binned_l, mv_l, grad, hess, bag, fmask, rkey, cegb0):
             # key replicated: every shard draws identical node randomness
             # (the feature axis is global here), like the reference's
             # identically-seeded per-machine samplers
@@ -195,16 +212,18 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                 bynode_count=self.bynode_count,
                 forced_plan=self.forced_plan,  # hist cache is psum'ed
                 cache_hists=self.cache_hists,
-                cegb_used0=cegb0 if self.params.cegb_on else None)
+                cegb_used0=cegb0 if self.params.cegb_on else None,
+                mv_slots=mv_l, mv_groups=mv_groups)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P(),
-                      P()),
+            in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS),
+                      P(AXIS), P(), P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
         sharded = jax.jit(mapped)
-        self._fn = functools.partial(sharded, self.binned)
+        self._fn = functools.partial(sharded, self.binned,
+                                     self._mv_sharded())
 
 
 class FeatureParallelTreeLearner(_MeshLearnerBase):
@@ -213,6 +232,12 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
     (feature_parallel_tree_learner.cpp semantics)."""
 
     def _build(self):
+        if self.dataset.has_multival:
+            from ..utils.log import log_fatal
+            log_fatal("feature-parallel training does not support "
+                      "multi-val datasets (row-wise slots span the "
+                      "column shards); use tree_learner=serial/data/"
+                      "voting")
         self._drop_forced_plan("feature")
         d = self.num_shards
         n = self.dataset.num_data
@@ -388,8 +413,9 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
         comm = make_voting_parallel_comm(
             AXIS, d, int(self.config.top_k), params_local)
         meta = self.meta
+        mv_groups = self._mv_groups
 
-        def body(binned_l, grad, hess, bag, fmask, rkey, cegb0):
+        def body(binned_l, mv_l, grad, hess, bag, fmask, rkey, cegb0):
             del cegb0          # CEGB dropped for the voting learner
             return grow_tree(
                 binned_l, grad, hess, bag, fmask, meta=meta,
@@ -399,16 +425,18 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
                 bundled=self.bundled, rand_key=rkey,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=self.bynode_count,
-                cache_hists=self.cache_hists)
+                cache_hists=self.cache_hists,
+                mv_slots=mv_l, mv_groups=mv_groups)
 
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(), P(),
-                      P()),
+            in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS),
+                      P(AXIS), P(), P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
         sharded = jax.jit(mapped)
-        self._fn = functools.partial(sharded, self.binned)
+        self._fn = functools.partial(sharded, self.binned,
+                                     self._mv_sharded())
 
 
 from ..learner.partitioned import (HIST_BLK, PartitionedLearnerBase,
@@ -569,18 +597,20 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
     on_device = jax.default_backend() in ("tpu", "axon")
     fits_u8 = int(dataset.num_bins_array().max(initial=2)) <= 256
     lazy_on = split_params_from_config(config).cegb_lazy_on
+    mv = dataset.has_multival  # row-wise slots need the XLA learners
     if cls is SerialTreeLearner:
         # on TPU the partitioned learner IS the serial algorithm, with
         # O(leaf rows) per-split cost (the production single-chip path);
         # it packs bins as uint8, so >256-bin datasets fall back.
         # CEGB's lazy penalty needs the leaf_id-vector layout (charged
         # rows stay in place), so it pins the serial learner.
-        if on_device and fits_u8 and not lazy_on:
+        if on_device and fits_u8 and not lazy_on and not mv:
             return PartitionedTreeLearner(dataset, config)
         return SerialTreeLearner(dataset, config, hist_method=hist_method)
     if cls is PartitionedTreeLearner:
         return PartitionedTreeLearner(dataset, config)
-    if on_device and fits_u8 and learner_type in ("data", "voting"):
+    if on_device and fits_u8 and not mv \
+            and learner_type in ("data", "voting"):
         return MeshPartitionedTreeLearner(dataset, config, mesh=mesh,
                                           mode=learner_type)
     return cls(dataset, config, mesh=mesh, hist_method=hist_method)

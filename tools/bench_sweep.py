@@ -41,6 +41,8 @@ def main() -> int:
         env["BENCH_ROWS"] = str(rows)
         # fewer measured iters at large N keeps the sweep bounded
         env.setdefault("BENCH_ITERS", "3" if rows > 2_000_000 else "5")
+        # training-quality gate: the result line carries in-sample AUC
+        env.setdefault("BENCH_EVAL", "1")
         # pinned-mode bench.py caps its child timeout at BENCH_BUDGET_S
         # (escalation plan + per-size caps only apply unpinned)
         env.setdefault("BENCH_BUDGET_S", "3600")
@@ -79,13 +81,19 @@ def main() -> int:
             _save(results)
             continue
         line.update(rows=rows, ok=True, wall_s=round(wall, 1))
+        # quality gate: a few boosting iterations on the Higgs-shaped
+        # problem must already separate classes clearly; a lower AUC
+        # means the fast path broke training, not just slowed it
+        if "auc" in line:
+            line["quality_ok"] = bool(line["auc"] >= 0.80)
         results.append(line)
         _save(results)
         print(f"rows={rows:>9,}: {line['value']:8.3f} Mrow-iters/s "
               f"(vs_baseline {line['vs_baseline']:.3f}, "
-              f"wall {wall:.0f}s)")
+              f"auc {line.get('auc', 'n/a')}, wall {wall:.0f}s)")
     print(f"wrote {OUT_PATH}")
-    return 0 if all(r.get("ok") for r in results) else 1
+    return 0 if all(r.get("ok") and r.get("quality_ok", True)
+                    for r in results) else 1
 
 
 if __name__ == "__main__":
