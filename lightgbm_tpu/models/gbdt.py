@@ -486,7 +486,8 @@ class GBDT:
                 leaf_vals[result.leaf_id])
             for i, vd in enumerate(self.valid_sets):
                 vadd = traverse_tree_arrays(ta, vd.binned_device,
-                                            self.learner.meta, scale)
+                                            self.learner.meta, scale,
+                                            vd.mv_slots_device)
                 self.valid_scores[i] = \
                     self.valid_scores[i].at[:, tid].add(vadd)
             self.models.append(DeferredTree(

@@ -446,12 +446,13 @@ class DeferredTree:
 
 
 def traverse_tree_arrays(arrays: TreeArrays, binned_dev, meta,
-                         scale) -> jnp.ndarray:
+                         scale, mv_slots_dev=None) -> jnp.ndarray:
     """Device bin-space traversal straight off ``TreeArrays`` — no host
     round trip. Per-node missing metadata is gathered from the learner's
     FeatureMeta; ``scale`` multiplies leaf values (shrinkage; pass 0 to
-    nullify an un-splittable tree). Fixed shapes: one compile per
-    (num_leaves_max, N)."""
+    nullify an un-splittable tree). ``mv_slots_dev`` carries the
+    dataset's multi-val slot matrix when pseudo-group splits exist.
+    Fixed shapes: one compile per (num_leaves_max, N)."""
     feat = arrays.split_feature
     miss = meta.missing[feat]
     dbin = meta.default_bin[feat]
@@ -463,18 +464,21 @@ def traverse_tree_arrays(arrays: TreeArrays, binned_dev, meta,
     return _traverse_arrays_jax(
         binned_dev, col, off, arrays.threshold_bin, arrays.decision_type,
         arrays.left_child, arrays.right_child, miss, dbin, nbin,
-        arrays.cat_bitsets, leaf_vals, arrays.num_leaves)
+        arrays.cat_bitsets, leaf_vals, arrays.num_leaves,
+        mv_slots=mv_slots_dev, mv_present=mv_slots_dev is not None)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("mv_present",))
 def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right, miss,
                          default_bin, num_bin, cat_bitsets, leaf_vals,
-                         num_leaves):
+                         num_leaves, mv_slots=None,
+                         mv_present: bool = False):
     """Like ``_traverse_binned_jax`` but over full-size (num_leaves_max)
     node arrays with a live ``num_leaves`` scalar: 1-leaf trees resolve
     to leaf 0 immediately (whose value the caller scaled)."""
     n = binned.shape[0]
     rows = jnp.arange(n)
+    g_dense = binned.shape[1]
     fuel_max = leaf_vals.shape[0] + 1
 
     def cond(state):
@@ -485,8 +489,15 @@ def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right, miss,
         node, out, done, fuel = state
         nd = jnp.where(done, 0, node)
         from ..data.bundling import decode_feature_bin
-        b = decode_feature_bin(binned[rows, col[nd]].astype(jnp.int32),
-                               offset[nd], num_bin[nd])
+        b = decode_feature_bin(
+            binned[rows, jnp.clip(col[nd], 0, g_dense - 1)]
+            .astype(jnp.int32), offset[nd], num_bin[nd])
+        if mv_present:
+            from ..ops.histogram import multival_feature_bins
+            base = ((col[nd] - g_dense) * 256 + offset[nd])[:, None]
+            b_mv = multival_feature_bins(mv_slots, base,
+                                         num_bin[nd][:, None])
+            b = jnp.where(col[nd] >= g_dense, b_mv, b)
         m = miss[nd]
         dleft = (dec[nd] & kDefaultLeftMask) != 0
         is_cat = (dec[nd] & kCategoricalMask) != 0

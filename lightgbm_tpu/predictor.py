@@ -174,7 +174,7 @@ def _device_predict(models, data, dataset, k: int) -> np.ndarray:
     import jax
     import jax.numpy as jnp
 
-    binned = _bin_data(data, dataset)
+    binned, mv_slots = _bin_data(data, dataset)
     t = len(models)
     s_max = max(max(len(m.split_feature_inner) for m in models), 1)
 
@@ -210,13 +210,16 @@ def _device_predict(models, data, dataset, k: int) -> np.ndarray:
         jnp.asarray(dec), jnp.asarray(left), jnp.asarray(right),
         jnp.asarray(miss), jnp.asarray(dbin), jnp.asarray(nbin),
         jnp.asarray(cat), jnp.asarray(leaf_vals), jnp.asarray(n_leaves),
-        jnp.asarray(tree_class), k)
+        jnp.asarray(tree_class), k,
+        None if mv_slots is None else jnp.asarray(mv_slots),
+        mv_slots is not None)
     return np.asarray(jax.device_get(out), np.float64)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "mv_present"))
 def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
-                cat, leaf_vals, n_leaves, tree_class, k):
+                cat, leaf_vals, n_leaves, tree_class, k, mv_slots=None,
+                mv_present=False):
     import jax.numpy as jnp
     from .models.tree import _traverse_arrays_jax
 
@@ -225,7 +228,8 @@ def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
     def body(acc, tree):
         (c, o, th, d, l, r, mi, db, nb, ct, lv, nl, cls) = tree
         add = _traverse_arrays_jax(binned, c, o, th, d, l, r, mi, db, nb,
-                                   ct, lv, nl)
+                                   ct, lv, nl, mv_slots=mv_slots,
+                                   mv_present=mv_present)
         return acc.at[:, cls].add(add), None
 
     acc0 = jnp.zeros((n, k), jnp.float32)
@@ -236,25 +240,43 @@ def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
     return acc
 
 
-def _bin_data(data: np.ndarray, dataset) -> np.ndarray:
+def _bin_data(data: np.ndarray, dataset):
     """Re-bin raw features with the training BinMappers (ValueToBin,
     bin.h:504-540) — vectorized per feature, into the dataset's
-    (possibly EFB-bundled) column layout."""
+    (possibly EFB-bundled) column layout. Returns
+    ``(dense_binned [N, G_dense], mv_slots or None)`` — multi-val
+    features ride a freshly built slot matrix, never dense columns."""
     n = data.shape[0]
     f_used = dataset.num_features
     dtype = dataset.binned.dtype
     group, offset, _ = dataset.bundle_maps()
-    out = np.zeros((n, dataset.num_groups), dtype)
+    g_dense = dataset.num_dense_groups
+    out = np.zeros((n, max(g_dense, 1)), dtype)
     from .data.bundling import encode_feature_bin
+    mv_bins = {}
     for inner in range(f_used):
         mapper = dataset.feature_mapper(inner)
         vb = mapper.values_to_bins(data[:, dataset.real_feature_idx[inner]])
         g, off = int(group[inner]), int(offset[inner])
+        if g >= g_dense:
+            rows = np.nonzero(vb)[0]
+            mv_bins[inner] = (rows, vb[rows].astype(np.int64))
+            continue
         if off == 0:
             out[:, g] = vb.astype(dtype)
         else:
             encode_feature_bin(out[:, g], vb, off)
-    return out
+    mv_slots = None
+    if dataset.has_multival:
+        from .data.bundling import BundlePlan, build_mv_slots
+        plan = BundlePlan(np.asarray(group), np.asarray(offset),
+                          dataset.num_groups,
+                          np.asarray(dataset.group_num_bins),
+                          mv_group_start=g_dense)
+        mv_slots = build_mv_slots(
+            plan, n, lambda j: mv_bins.get(j, (np.zeros(0, np.int64),
+                                               np.zeros(0, np.int64))))
+    return out, mv_slots
 
 
 # ----------------------------------------------------------------------

@@ -133,3 +133,24 @@ def test_multival_subset_and_bagging():
     b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, b.predict(X)) > 0.75
+
+
+def test_multival_async_valid_scoring():
+    """The ASYNC training path's valid scoring (traverse_tree_arrays)
+    must decode multi-val pseudo-group splits from the slot matrix —
+    regression for the silent clipped-column read. metric=\"\" keeps
+    per-iteration eval off so the async path engages."""
+    X, y = _bosch_like(n=2000)
+    params = {"objective": "binary", "num_leaves": 31,
+              "min_data_in_leaf": 5, "metric": "", "verbosity": -1}
+    dtrain = lgb.Dataset(X[:1600], label=y[:1600])
+    dvalid = dtrain.create_valid(X[1600:], label=y[1600:])
+    booster = lgb.train(params, dtrain, num_boost_round=10,
+                        valid_sets=[dvalid])
+    src = booster._src()
+    assert dtrain.construct()._inner.has_multival
+    # the accumulated valid scores must equal a fresh raw prediction
+    import numpy as np
+    want = booster.predict(X[1600:], raw_score=True)
+    got = np.asarray(src.valid_scores[0]).sum(axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
