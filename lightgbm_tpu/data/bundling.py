@@ -39,6 +39,9 @@ import numpy as np
 
 MAX_BIN_PER_GROUP = 256
 MAX_SEARCH_GROUP = 100
+# multi-val slot encoding stride: slot = pseudo_local * MV_SLOT_STRIDE
+# + offset + bin - 1 (build_mv_slots); every decoder must use this
+MV_SLOT_STRIDE = MAX_BIN_PER_GROUP
 
 
 def decode_feature_bin(col, off, nbf):
@@ -179,18 +182,25 @@ def _find_groups(nz_idx: List[Optional[np.ndarray]], nbins: np.ndarray,
         # conflicts of one shared column = sum(nnz) - distinct rows;
         # within budget -> ONE shared column (the reference's second-
         # round group); over budget -> the whole set goes multi-val
-        # (row-wise). Documented divergence: we also require the
-        # shared column to fit the u8 bin budget, the reference lets
-        # second-round groups grow wider bins
-        mark = np.zeros(total, bool)
+        # (row-wise). Documented divergences from dataset.cpp:210-231:
+        # (a) the shared column must fit the u8 bin budget (the
+        # reference lets second-round groups grow wider bins), and
+        # (b) multi-val must actually SHRINK the matrix — our slot
+        # matrix pads to the max per-row count (i32), unlike the
+        # reference's CSR row_ptr, so mid-sparsity sets where
+        # 4*max_nnz_per_row >= n_features stay dense singletons
+        row_cnt = np.zeros(total, np.int64)
         for fidx in second:
-            mark[nz_idx[fidx]] = True
-        conflicts = second_nnz - int(mark.sum())
+            np.add.at(row_cnt, nz_idx[fidx], 1)
+        conflicts = second_nnz - int((row_cnt > 0).sum())
         bins2 = 1 + sum(int(nbins[fidx]) - 1 for fidx in second)
-        if conflicts > max_conflict or bins2 > MAX_BIN_PER_GROUP:
+        k_est = int(row_cnt.max(initial=0))
+        if conflicts <= max_conflict and bins2 <= MAX_BIN_PER_GROUP:
+            kept.append(sorted(second))
+        elif 4 * k_est < len(second):
             multival = sorted(second)
         else:
-            kept.append(sorted(second))
+            kept.extend([fidx] for fidx in sorted(second))
     return kept + singletons, multival
 
 
@@ -336,7 +346,7 @@ def build_mv_slots(plan: BundlePlan, n: int,
         if g < plan.mv_group_start:
             continue
         rows, bins = feature_bins(j)
-        enc = ((g - plan.mv_group_start) * 256
+        enc = ((g - plan.mv_group_start) * MV_SLOT_STRIDE
                + plan.feature_offset[j] + bins.astype(np.int64) - 1)
         encoded.append((rows, enc))
         np.add.at(counts, rows, 1)

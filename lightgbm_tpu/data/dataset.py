@@ -229,6 +229,17 @@ class Dataset:
                     np.zeros(f, np.int32), self.num_bins_array())
         return self.feature_group, self.feature_offset, self.group_num_bins
 
+    def bundle_plan(self):
+        """The dataset's stored bundling as a BundlePlan (the ONE
+        reconstruction shared by valid-set extraction and the
+        predictor's re-binning), or None when unbundled."""
+        if self.feature_group is None:
+            return None
+        from .bundling import BundlePlan
+        return BundlePlan(self.feature_group, self.feature_offset,
+                          len(self.group_num_bins), self.group_num_bins,
+                          mv_group_start=self.mv_group_start)
+
     def num_bin(self, inner_feature: int) -> int:
         return self.bin_mappers[self.real_feature_idx[inner_feature]].num_bin
 
@@ -291,12 +302,8 @@ class Dataset:
         if reference is None:
             self._maybe_bundle(config)
         elif self.feature_group is not None:
-            from .bundling import (BundlePlan, build_mv_slots,
-                                   bundle_matrix)
-            plan = BundlePlan(self.feature_group, self.feature_offset,
-                              len(self.group_num_bins),
-                              self.group_num_bins,
-                              mv_group_start=self.mv_group_start)
+            from .bundling import build_mv_slots, bundle_matrix
+            plan = self.bundle_plan()
             raw = self.binned
             self.binned = bundle_matrix(raw, plan)
             if plan.has_multival:
@@ -559,7 +566,7 @@ class Dataset:
         """CSC nonzeros -> (bundled) binned matrix, no [N, F]
         intermediate: the EFB plan comes from a row SAMPLE; the full
         matrix is written group-column by group-column."""
-        from .bundling import BundlePlan, plan_bundles_from_nonzeros
+        from .bundling import plan_bundles_from_nonzeros
         n = csc.shape[0]
         f_used = self.num_features
         indptr, indices = csc.indptr, csc.indices
@@ -580,11 +587,7 @@ class Dataset:
 
         plan = None
         if reference is not None:
-            if self.feature_group is not None:
-                plan = BundlePlan(self.feature_group, self.feature_offset,
-                                  len(self.group_num_bins),
-                                  self.group_num_bins,
-                                  mv_group_start=self.mv_group_start)
+            plan = self.bundle_plan()
         elif config.enable_bundle and f_used >= 2:
             # the planner only needs per-feature NON-DEFAULT row sets
             # within a row sample — taken straight from the CSC

@@ -41,78 +41,26 @@ from ..ops.split import (MAX_CAT_WORDS, _argmax_first, assemble_split,
                          best_split, leaf_output_no_constraint,
                          per_feature_splits)
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
-                     cegb_pf_state, cegb_refund, cegb_store_row,
-                     cegb_upgrade_best, feature_meta_from_dataset,
-                     forced_left_sums, forced_split_override,
-                     make_node_rand, split_params_from_config,
-                     scan_children)
+                     StatePack, cegb_pf_state, cegb_refund,
+                     cegb_store_row, cegb_upgrade_best,
+                     feature_meta_from_dataset, forced_left_sums,
+                     forced_split_override, make_node_rand,
+                     split_params_from_config, scan_children)
 
 HIST_BLK = 2048
 PART_BLK = 512
 
-# ---------------------------------------------------------------------
-# Packed grow-loop state. The reference's hot loop mutates a handful of
-# per-leaf scalars in place (serial_tree_learner.cpp:145-192); a naive
-# dict-of-[L]-arrays carry costs ~44 tiny dynamic-update-slice ops per
-# split plus a 30+-buffer while_loop carry. Packing the float and int
-# leaf state into [K, L] matrices (column = leaf) turns each split's
-# state writes into TWO column updates per matrix, and the tree arrays
-# into ONE column update per matrix — the per-split fixed cost the
-# round-3 profile flagged. Field rows:
-SF_FIELDS = ("leaf_g", "leaf_h", "leaf_c", "bs_gain", "bs_lg", "bs_lh",
-             "bs_lc", "bs_lout", "bs_rout", "leaf_cmin", "leaf_cmax",
-             "leaf_value", "leaf_weight", "leaf_count")
-SI_FIELDS = ("leaf_begin", "leaf_cnt", "bs_feat", "bs_thr", "bs_dleft",
-             "bs_iscat", "ref_node", "ref_side", "leaf_parent",
-             "leaf_depth")  # bools ride as int32
-TF_FIELDS = ("split_gain_arr", "internal_value", "internal_weight",
-             "internal_count")
-TI_FIELDS = ("split_feature", "threshold_bin", "decision_type",
-             "left_child", "right_child")
-SF_IDX = {k: i for i, k in enumerate(SF_FIELDS)}
-SI_IDX = {k: i for i, k in enumerate(SI_FIELDS)}
-TF_IDX = {k: i for i, k in enumerate(TF_FIELDS)}
-TI_IDX = {k: i for i, k in enumerate(TI_FIELDS)}
-_BOOL_FIELDS = ("bs_dleft", "bs_iscat")
-
-
-def pack_state(fields: dict) -> dict:
-    """Plain per-field dict -> packed carry (one-time, outside the
-    while_loop). Unlisted keys pass through."""
-    st = {k: v for k, v in fields.items()
-          if k not in SF_IDX and k not in SI_IDX
-          and k not in TF_IDX and k not in TI_IDX}
-    st["SF"] = jnp.stack([fields[k].astype(jnp.float32)
-                          for k in SF_FIELDS])
-    st["SI"] = jnp.stack([fields[k].astype(jnp.int32)
-                          for k in SI_FIELDS])
-    st["TF"] = jnp.stack([fields[k].astype(jnp.float32)
-                          for k in TF_FIELDS])
-    st["TI"] = jnp.stack([fields[k].astype(jnp.int32)
-                          for k in TI_FIELDS])
-    return st
-
-
-def view_state(st: dict) -> dict:
-    """Packed carry -> per-field dict of row VIEWS (static-index
-    slices XLA folds away); shared helpers (forced_split_override,
-    cegb_*) consume this unchanged."""
-    v = {k: val for k, val in st.items()
-         if k not in ("SF", "SI", "TF", "TI")}
-    for k, i in SF_IDX.items():
-        v[k] = st["SF"][i]
-    for k, i in SI_IDX.items():
-        v[k] = st["SI"][i].astype(bool) if k in _BOOL_FIELDS \
-            else st["SI"][i]
-    for k, i in TF_IDX.items():
-        v[k] = st["TF"][i]
-    for k, i in TI_IDX.items():
-        v[k] = st["TI"][i]
-    return v
-
-
-# (a mutated view repacks via pack_state — the stacks rebuild the
-# matrices wholesale, which XLA handles as 4 concatenates)
+# Packed grow-loop state (serial.py:StatePack): the partitioned loop's
+# int matrix additionally carries the physical segment bounds
+SF_FIELDS = StatePack.GROW_SF
+SI_FIELDS = ("leaf_begin", "leaf_cnt") + StatePack.GROW_SI
+TF_FIELDS = StatePack.GROW_TF
+TI_FIELDS = StatePack.GROW_TI
+_PACK = StatePack(SF_FIELDS, SI_FIELDS, TF_FIELDS, TI_FIELDS)
+SF_IDX, SI_IDX = _PACK.sf_idx, _PACK.si_idx
+TF_IDX, TI_IDX = _PACK.tf_idx, _PACK.ti_idx
+pack_state = _PACK.pack
+view_state = _PACK.view
 
 
 class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):

@@ -398,6 +398,77 @@ _PF_FIELDS = (("pf_score", "score"), ("pf_thr", "threshold"),
               ("pf_iscat", "is_cat"), ("pf_bitset", "cat_bitset"))
 
 
+class StatePack:
+    """Packed grow-loop state: [K, L] matrices (column = leaf) for the
+    float/int per-leaf state and [K, L-1] matrices for the tree arrays.
+    A naive dict-of-[L]-arrays while_loop carry costs ~44 tiny
+    dynamic-update-slice ops per split plus a 30+-buffer carry; packed,
+    each split issues two column writes per state matrix, one column
+    write per tree matrix, and two column gathers for the split-site
+    reads (the per-split fixed cost the round-3 profile flagged).
+    Bool fields ride the int matrix; unlisted keys pass through."""
+
+    def __init__(self, sf, si, tf, ti,
+                 bools=("bs_dleft", "bs_iscat")):
+        self.sf_fields, self.si_fields = sf, si
+        self.tf_fields, self.ti_fields = tf, ti
+        self.sf_idx = {k: i for i, k in enumerate(sf)}
+        self.si_idx = {k: i for i, k in enumerate(si)}
+        self.tf_idx = {k: i for i, k in enumerate(tf)}
+        self.ti_idx = {k: i for i, k in enumerate(ti)}
+        self.bools = frozenset(bools)
+        self._packed = set(sf) | set(si) | set(tf) | set(ti)
+
+    # field layouts shared by the serial (leaf_id) and partitioned
+    # (segment) grow loops; the partitioned loop prepends its physical
+    # segment bounds to the int matrix
+    GROW_SF = ("leaf_g", "leaf_h", "leaf_c", "bs_gain", "bs_lg",
+               "bs_lh", "bs_lc", "bs_lout", "bs_rout", "leaf_cmin",
+               "leaf_cmax", "leaf_value", "leaf_weight", "leaf_count")
+    GROW_SI = ("bs_feat", "bs_thr", "bs_dleft", "bs_iscat", "ref_node",
+               "ref_side", "leaf_parent", "leaf_depth")
+    GROW_TF = ("split_gain_arr", "internal_value", "internal_weight",
+               "internal_count")
+    GROW_TI = ("split_feature", "threshold_bin", "decision_type",
+               "left_child", "right_child")
+
+    def pack(self, fields: dict) -> dict:
+        """Plain per-field dict -> packed carry (one-time, outside the
+        while_loop; a mutated view repacks the same way — the stacks
+        rebuild the matrices wholesale as 4 concatenates)."""
+        st = {k: v for k, v in fields.items() if k not in self._packed}
+        st["SF"] = jnp.stack([fields[k].astype(jnp.float32)
+                              for k in self.sf_fields])
+        st["SI"] = jnp.stack([fields[k].astype(jnp.int32)
+                              for k in self.si_fields])
+        st["TF"] = jnp.stack([fields[k].astype(jnp.float32)
+                              for k in self.tf_fields])
+        st["TI"] = jnp.stack([fields[k].astype(jnp.int32)
+                              for k in self.ti_fields])
+        return st
+
+    def view(self, st: dict) -> dict:
+        """Packed carry -> per-field dict of row VIEWS (static-index
+        slices XLA folds away); shared helpers (forced_split_override,
+        cegb_*) consume this unchanged."""
+        v = {k: val for k, val in st.items()
+             if k not in ("SF", "SI", "TF", "TI")}
+        for k, i in self.sf_idx.items():
+            v[k] = st["SF"][i]
+        for k, i in self.si_idx.items():
+            v[k] = st["SI"][i].astype(bool) if k in self.bools \
+                else st["SI"][i]
+        for k, i in self.tf_idx.items():
+            v[k] = st["TF"][i]
+        for k, i in self.ti_idx.items():
+            v[k] = st["TI"][i]
+        return v
+
+
+_SERIAL_PACK = StatePack(StatePack.GROW_SF, StatePack.GROW_SI,
+                         StatePack.GROW_TF, StatePack.GROW_TI)
+
+
 def cegb_pf_state(big_l: int, f: int) -> dict:
     """Per-(leaf, feature) RAW candidate cache — the reference's
     ``splits_per_leaf_`` (cost_effective_gradient_boosting.hpp:35,114).
@@ -785,9 +856,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
         params.max_delta_step)
 
-    state = dict(
-        k=jnp.int32(1),
-        leaf_id=jnp.zeros((n,), jnp.int32),
+    fields = dict(
         leaf_g=at0(jnp.zeros((big_l,), jnp.float32), root_g),
         leaf_h=at0(jnp.zeros((big_l,), jnp.float32), root_h),
         leaf_c=at0(jnp.zeros((big_l,), jnp.float32), root_c),
@@ -804,8 +873,6 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         bs_rout=at0(jnp.zeros((big_l,), jnp.float32),
                     root_split.right_output),
         bs_iscat=at0(jnp.zeros((big_l,), bool), root_split.is_cat),
-        bs_bitset=at0(jnp.zeros((big_l, MAX_CAT_WORDS), jnp.uint32),
-                      root_split.cat_bitset),
         # pointer-fixing bookkeeping: which node references each leaf
         ref_node=jnp.full((big_l,), -1, jnp.int32),
         ref_side=jnp.zeros((big_l,), jnp.int32),
@@ -823,40 +890,51 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         internal_value=jnp.zeros((big_l - 1,), jnp.float32),
         internal_weight=jnp.zeros((big_l - 1,), jnp.float32),
         internal_count=jnp.zeros((big_l - 1,), jnp.float32),
-        cat_bitsets=jnp.zeros((big_l - 1, MAX_CAT_WORDS), jnp.uint32),
         leaf_value=at0(jnp.zeros((big_l,), jnp.float32), root_out),
         leaf_weight=at0(jnp.zeros((big_l,), jnp.float32), root_h),
         leaf_count=at0(jnp.zeros((big_l,), jnp.float32), root_c),
         leaf_parent=jnp.full((big_l,), -1, jnp.int32),
         leaf_depth=jnp.zeros((big_l,), jnp.int32),
     )
+    fields.update(
+        k=jnp.int32(1),
+        leaf_id=jnp.zeros((n,), jnp.int32),
+        bs_bitset=at0(jnp.zeros((big_l, MAX_CAT_WORDS), jnp.uint32),
+                      root_split.cat_bitset),
+        cat_bitsets=jnp.zeros((big_l - 1, MAX_CAT_WORDS), jnp.uint32))
     if cache_hists:
-        state["hist"] = at0(
+        fields["hist"] = at0(
             jnp.zeros((big_l, num_features_hist, b, 3), jnp.float32),
             root_hist)
     if params.cegb_on:
-        state["cegb_used"] = cegb_used0
-        state.update(cegb_pf_state(big_l, f_logical))
-        cegb_store_row(state, 0, root_pf, root_blocked)
+        fields["cegb_used"] = cegb_used0
+        fields.update(cegb_pf_state(big_l, f_logical))
+        cegb_store_row(fields, 0, root_pf, root_blocked)
         if params.cegb_lazy_on:
-            state["cegb_charged"] = cegb_charged0
+            fields["cegb_charged"] = cegb_charged0
+    state = _SERIAL_PACK.pack(fields)
 
     leaf_range = jnp.arange(big_l)
+    SF_IDX = _SERIAL_PACK.sf_idx
+    SI_IDX = _SERIAL_PACK.si_idx
+    TI_IDX = _SERIAL_PACK.ti_idx
 
-    def leaf_hist_masked(st, leaf):
+    def leaf_hist_masked(v, leaf):
         """Pool-bounded mode: rebuild one leaf's histogram on demand."""
-        ghc_leaf = ghc * (st["leaf_id"] == leaf).astype(
+        ghc_leaf = ghc * (v["leaf_id"] == leaf).astype(
             jnp.float32)[:, None]
         return comm.reduce_hist(full_hist(ghc_leaf))
 
     def cond(st):
-        open_gain = jnp.where(leaf_range < st["k"], st["bs_gain"], -jnp.inf)
+        bs_gain = st["SF"][SF_IDX["bs_gain"]]
+        open_gain = jnp.where(leaf_range < st["k"], bs_gain, -jnp.inf)
         # best gain <= 0 stops training (serial_tree_learner.cpp Train;
         # equivalent to the old isfinite check for unpenalized gains,
         # which are strictly positive when valid)
         return (st["k"] < big_l) & (open_gain.max() > 0.0)
 
-    def body(st, forced=None, forced_hist=None):
+    def body(st_packed, forced=None, forced_hist=None):
+        st = _SERIAL_PACK.view(st_packed)  # row views, folded by XLA
         k = st["k"]
         new = k
         s = k - 1  # internal node index for this split
@@ -865,18 +943,23 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             open_gain = jnp.where(leaf_range < k, st["bs_gain"],
                                   -jnp.inf)
             leaf = jnp.argmax(open_gain).astype(jnp.int32)
-            feat = st["bs_feat"][leaf]
-            thr = st["bs_thr"][leaf]
-            dleft = st["bs_dleft"][leaf]
-            gain = st["bs_gain"][leaf]
-            is_cat = st["bs_iscat"][leaf]
+            # TWO column gathers replace ~22 per-field scalar reads
+            colf = st_packed["SF"][:, leaf]
+            coli = st_packed["SI"][:, leaf]
+            feat = coli[SI_IDX["bs_feat"]]
+            thr = coli[SI_IDX["bs_thr"]]
+            dleft = coli[SI_IDX["bs_dleft"]].astype(bool)
+            gain = colf[SF_IDX["bs_gain"]]
+            is_cat = coli[SI_IDX["bs_iscat"]].astype(bool)
             bitset = st["bs_bitset"][leaf]
-            lg, lh, lc = (st["bs_lg"][leaf], st["bs_lh"][leaf],
-                          st["bs_lc"][leaf])
-            pg, ph, pc = st["leaf_g"][leaf], st["leaf_h"][leaf], \
-                st["leaf_c"][leaf]
+            lg, lh, lc = (colf[SF_IDX["bs_lg"]], colf[SF_IDX["bs_lh"]],
+                          colf[SF_IDX["bs_lc"]])
+            pg, ph, pc = (colf[SF_IDX["leaf_g"]],
+                          colf[SF_IDX["leaf_h"]],
+                          colf[SF_IDX["leaf_c"]])
             rg, rh, rc = pg - lg, ph - lh, pc - lc
-            lout, rout = st["bs_lout"][leaf], st["bs_rout"][leaf]
+            lout, rout = (colf[SF_IDX["bs_lout"]],
+                          colf[SF_IDX["bs_rout"]])
         else:
             fh = forced_hist if forced_hist is not None \
                 else st["hist"][forced[0]] if cache_hists \
@@ -885,6 +968,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
              lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
                 forced_split_override(fh, st, forced, params, meta_hist,
                                       bundled)
+            colf = st_packed["SF"][:, leaf]
+            coli = st_packed["SI"][:, leaf]
 
         # ---- partition rows of `leaf` ---------------------------------
         grp = meta.group[feat]
@@ -892,8 +977,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             g_dense = binned.shape[1]
 
             def _mv_bins(_):
+                from ..data.bundling import MV_SLOT_STRIDE
                 from ..ops.histogram import multival_feature_bins
-                base = (grp - g_dense) * 256 + meta.offset[feat]
+                base = (grp - g_dense) * MV_SLOT_STRIDE \
+                    + meta.offset[feat]
                 return multival_feature_bins(
                     mv_slots, base, meta.num_bins[feat]).astype(jnp.int32)
 
@@ -921,17 +1008,12 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
 
         # ---- tree arrays ---------------------------------------------
         dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
-        upd = st["ref_node"][leaf] >= 0
-        pnode = jnp.where(upd, st["ref_node"][leaf], 0)
-        pside = st["ref_side"][leaf]
-        left_child = st["left_child"].at[pnode].set(
-            jnp.where(upd & (pside == 0), s, st["left_child"][pnode]))
-        right_child = st["right_child"].at[pnode].set(
-            jnp.where(upd & (pside == 1), s, st["right_child"][pnode]))
-        left_child = left_child.at[s].set(~leaf)
-        right_child = right_child.at[s].set(~new)
+        ref_node = coli[SI_IDX["ref_node"]]
+        upd = ref_node >= 0
+        pnode = jnp.where(upd, ref_node, 0)
+        pside = coli[SI_IDX["ref_side"]]
 
-        depth = st["leaf_depth"][leaf] + 1
+        depth = coli[SI_IDX["leaf_depth"]] + 1
         parent_out = leaf_output_no_constraint(
             pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
             params.max_delta_step)
@@ -957,7 +1039,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         # (LeafConstraints::UpdateConstraints monotone_constraints.hpp:44)
         mono = meta.monotone[feat]
         mid = (lout + rout) * 0.5
-        pcmin, pcmax = st["leaf_cmin"][leaf], st["leaf_cmax"][leaf]
+        pcmin = colf[SF_IDX["leaf_cmin"]]
+        pcmax = colf[SF_IDX["leaf_cmax"]]
         numerical = ~is_cat
         cmin_l = jnp.where(numerical & (mono < 0),
                            jnp.maximum(pcmin, mid), pcmin)
@@ -996,69 +1079,66 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
                 rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k)
 
-        def set2(arr, va, vb):
-            return arr.at[leaf].set(va).at[new].set(vb)
+        # ---- packed column writes: 2 columns per state matrix, one
+        # column per tree matrix (see learner/partitioned.py) ----------
+        i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
+        f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+        uf_leaf = jnp.stack([
+            lg, lh, lc, split_l.gain, split_l.left_g, split_l.left_h,
+            split_l.left_c, split_l.left_output, split_l.right_output,
+            cmin_l, cmax_l, lout, f32(lh), f32(lc)])
+        uf_new = jnp.stack([
+            rg, rh, rc, split_r.gain, split_r.left_g, split_r.left_h,
+            split_r.left_c, split_r.left_output, split_r.right_output,
+            cmin_r, cmax_r, rout, f32(rh), f32(rc)])
+        ui_leaf = jnp.stack([
+            split_l.feature, split_l.threshold,
+            i32(split_l.default_left), i32(split_l.is_cat), s,
+            jnp.int32(0), s, depth])
+        ui_new = jnp.stack([
+            split_r.feature, split_r.threshold,
+            i32(split_r.default_left), i32(split_r.is_cat), s,
+            jnp.int32(1), s, depth])
+        sf = st_packed["SF"].at[:, leaf].set(uf_leaf) \
+            .at[:, new].set(uf_new)
+        si = st_packed["SI"].at[:, leaf].set(ui_leaf) \
+            .at[:, new].set(ui_new)
+        tf = st_packed["TF"].at[:, s].set(
+            jnp.stack([gain, parent_out, ph, pc]))
+        ti = st_packed["TI"].at[:, s].set(
+            jnp.stack([feat, thr, dec, ~leaf, ~new]))
+        # pointer fixups on the parent node's child slots
+        lc_row, rc_row = TI_IDX["left_child"], TI_IDX["right_child"]
+        ti = ti.at[lc_row, pnode].set(
+            jnp.where(upd & (pside == 0), s, ti[lc_row, pnode]))
+        ti = ti.at[rc_row, pnode].set(
+            jnp.where(upd & (pside == 1), s, ti[rc_row, pnode]))
 
-        st2 = dict(st)
+        st2 = {kk: vv for kk, vv in st_packed.items()
+               if kk not in ("SF", "SI", "TF", "TI")}
+        st2.update(
+            k=k + 1, leaf_id=leaf_id, SF=sf, SI=si, TF=tf, TI=ti,
+            bs_bitset=st["bs_bitset"].at[leaf].set(split_l.cat_bitset)
+            .at[new].set(split_r.cat_bitset),
+            cat_bitsets=st["cat_bitsets"].at[s].set(bitset))
         if cache_hists:
             st2["hist"] = st["hist"].at[leaf].set(hist_left) \
                 .at[new].set(hist_right)
         if params.cegb_on:
-            st2["cegb_used"] = cu
+            # shared CEGB helpers mutate whole rows on a view dict;
+            # repacking writes them back (refund BEFORE the children's
+            # rows land — their scans already saw `feat` acquired)
+            vv = _SERIAL_PACK.view(st2)
+            vv["cegb_used"] = cu
             if params.cegb_lazy_on:
-                st2["cegb_charged"] = charged2
-            # refund BEFORE the children's rows land (their scans
-            # already saw `feat` acquired)
-            cegb_refund(st2, feat, st["cegb_used"][feat], meta_hist,
+                vv["cegb_charged"] = charged2
+            cegb_refund(vv, feat, st["cegb_used"][feat], meta_hist,
                         params)
-            cegb_store_row(st2, leaf, pf_l, blk_l)
-            cegb_store_row(st2, new, pf_r, blk_r)
-        st2.update(
-            k=k + 1,
-            leaf_id=leaf_id,
-            leaf_g=set2(st["leaf_g"], lg, rg),
-            leaf_h=set2(st["leaf_h"], lh, rh),
-            leaf_c=set2(st["leaf_c"], lc, rc),
-            bs_gain=set2(st["bs_gain"], split_l.gain, split_r.gain),
-            bs_feat=set2(st["bs_feat"], split_l.feature, split_r.feature),
-            bs_thr=set2(st["bs_thr"], split_l.threshold, split_r.threshold),
-            bs_dleft=set2(st["bs_dleft"], split_l.default_left,
-                          split_r.default_left),
-            bs_lg=set2(st["bs_lg"], split_l.left_g, split_r.left_g),
-            bs_lh=set2(st["bs_lh"], split_l.left_h, split_r.left_h),
-            bs_lc=set2(st["bs_lc"], split_l.left_c, split_r.left_c),
-            bs_lout=set2(st["bs_lout"], split_l.left_output,
-                         split_r.left_output),
-            bs_rout=set2(st["bs_rout"], split_l.right_output,
-                         split_r.right_output),
-            bs_iscat=set2(st["bs_iscat"], split_l.is_cat, split_r.is_cat),
-            bs_bitset=set2(st["bs_bitset"], split_l.cat_bitset,
-                           split_r.cat_bitset),
-            ref_node=set2(st["ref_node"], s, s),
-            ref_side=set2(st["ref_side"], 0, 1),
-            leaf_cmin=set2(st["leaf_cmin"], cmin_l, cmin_r),
-            leaf_cmax=set2(st["leaf_cmax"], cmax_l, cmax_r),
-            split_feature=st["split_feature"].at[s].set(feat),
-            threshold_bin=st["threshold_bin"].at[s].set(thr),
-            decision_type=st["decision_type"].at[s].set(dec),
-            left_child=left_child,
-            right_child=right_child,
-            split_gain_arr=st["split_gain_arr"].at[s].set(gain),
-            internal_value=st["internal_value"].at[s].set(parent_out),
-            internal_weight=st["internal_weight"].at[s].set(ph),
-            internal_count=st["internal_count"].at[s].set(pc),
-            cat_bitsets=st["cat_bitsets"].at[s].set(bitset),
-            leaf_value=set2(st["leaf_value"], lout, rout),
-            leaf_weight=set2(st["leaf_weight"], lh, rh),
-            leaf_count=set2(st["leaf_count"], lc, rc),
-            leaf_parent=set2(st["leaf_parent"], s, s),
-            leaf_depth=set2(st["leaf_depth"], depth, depth),
-        )
-        if params.cegb_on:
-            # refund-upgrade other leaves' cached bests (the children's
-            # fresh writes above are excluded from the comparison)
-            cegb_upgrade_best(st2, feat, st["cegb_used"][feat], leaf,
+            cegb_store_row(vv, leaf, pf_l, blk_l)
+            cegb_store_row(vv, new, pf_r, blk_r)
+            cegb_upgrade_best(vv, feat, st["cegb_used"][feat], leaf,
                               new, big_l)
+            st2 = _SERIAL_PACK.pack(vv)
         return st2
 
     # ---- forced splits: unrolled static pre-pass (ForceSplits,
@@ -1067,10 +1147,12 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        fh0 = st["hist"][step[0]] if cache_hists \
-            else leaf_hist_masked(st, step[0])
-        lg_f, lh_f, _ = forced_left_sums(fh0, st, step, meta_hist, bundled)
-        ph_f = st["leaf_h"][step[0]]
+        v0 = _SERIAL_PACK.view(st)
+        fh0 = v0["hist"][step[0]] if cache_hists \
+            else leaf_hist_masked(v0, step[0])
+        lg_f, lh_f, _ = forced_left_sums(fh0, v0, step, meta_hist,
+                                         bundled)
+        ph_f = v0["leaf_h"][step[0]]
         force_ok = force_ok & (lh_f > kEps) & (ph_f - lh_f > kEps) \
             & (st["k"] < big_l)
         st = jax.lax.cond(
@@ -1079,24 +1161,25 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             lambda s: s, st)
 
     st = jax.lax.while_loop(cond, body, st)
+    vf = _SERIAL_PACK.view(st)
 
     tree = TreeArrays(
         num_leaves=st["k"],
-        split_feature=st["split_feature"],
-        threshold_bin=st["threshold_bin"],
-        decision_type=st["decision_type"],
-        left_child=st["left_child"],
-        right_child=st["right_child"],
-        split_gain=st["split_gain_arr"],
-        internal_value=st["internal_value"],
-        internal_weight=st["internal_weight"],
-        internal_count=st["internal_count"],
-        leaf_value=st["leaf_value"],
-        leaf_weight=st["leaf_weight"],
-        leaf_count=st["leaf_count"],
-        leaf_parent=st["leaf_parent"],
-        leaf_depth=st["leaf_depth"],
-        cat_bitsets=st["cat_bitsets"],
+        split_feature=vf["split_feature"],
+        threshold_bin=vf["threshold_bin"],
+        decision_type=vf["decision_type"],
+        left_child=vf["left_child"],
+        right_child=vf["right_child"],
+        split_gain=vf["split_gain_arr"],
+        internal_value=vf["internal_value"],
+        internal_weight=vf["internal_weight"],
+        internal_count=vf["internal_count"],
+        leaf_value=vf["leaf_value"],
+        leaf_weight=vf["leaf_weight"],
+        leaf_count=vf["leaf_count"],
+        leaf_parent=vf["leaf_parent"],
+        leaf_depth=vf["leaf_depth"],
+        cat_bitsets=vf["cat_bitsets"],
     )
     return GrowResult(tree=tree, leaf_id=st["leaf_id"],
                       cegb_charged=st.get("cegb_charged"))

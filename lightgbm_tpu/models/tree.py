@@ -240,7 +240,8 @@ class Tree:
             if mv_slots is not None:
                 is_mv = self._col[nd] >= g_dense
                 if is_mv.any():
-                    base = ((self._col[nd] - g_dense) * 256
+                    from ..data.bundling import MV_SLOT_STRIDE
+                    base = ((self._col[nd] - g_dense) * MV_SLOT_STRIDE
                             + self._offset[nd])[:, None]
                     sl = mv_slots[idx]
                     inr = (sl >= base) \
@@ -364,10 +365,9 @@ def _traverse_binned_jax(binned, col, offset, thr, dec, left, right, miss,
             binned[rows, jnp.clip(col[nd], 0, g_dense - 1)]
             .astype(jnp.int32), offset[nd], num_bin[nd])
         if mv_present:
-            from ..ops.histogram import multival_feature_bins
-            base = ((col[nd] - g_dense) * 256 + offset[nd])[:, None]
-            b_mv = multival_feature_bins(mv_slots, base,
-                                         num_bin[nd][:, None])
+            from ..ops.histogram import multival_node_bins
+            b_mv = multival_node_bins(mv_slots, col[nd], offset[nd],
+                                      num_bin[nd], g_dense)
             b = jnp.where(col[nd] >= g_dense, b_mv, b)
         m = miss[nd]
         dleft = (dec[nd] & kDefaultLeftMask) != 0
@@ -493,10 +493,9 @@ def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right, miss,
             binned[rows, jnp.clip(col[nd], 0, g_dense - 1)]
             .astype(jnp.int32), offset[nd], num_bin[nd])
         if mv_present:
-            from ..ops.histogram import multival_feature_bins
-            base = ((col[nd] - g_dense) * 256 + offset[nd])[:, None]
-            b_mv = multival_feature_bins(mv_slots, base,
-                                         num_bin[nd][:, None])
+            from ..ops.histogram import multival_node_bins
+            b_mv = multival_node_bins(mv_slots, col[nd], offset[nd],
+                                      num_bin[nd], g_dense)
             b = jnp.where(col[nd] >= g_dense, b_mv, b)
         m = miss[nd]
         dleft = (dec[nd] & kDefaultLeftMask) != 0

@@ -109,7 +109,8 @@ def multival_hist(slots: jnp.ndarray, ghc: jnp.ndarray, g_mv: int,
     space, one per slot column. Padding slots (0) accumulate into
     pseudo 0 / value 0, which the debundle never reads — bin 0 is
     always reconstructed from leaf totals."""
-    flat = jnp.zeros((g_mv * 256, 3), jnp.float32)
+    from ..data.bundling import MV_SLOT_STRIDE
+    flat = jnp.zeros((g_mv * MV_SLOT_STRIDE, 3), jnp.float32)
     n, k = slots.shape
     if n * k <= 4_000_000:
         # one scatter over the flattened slots (no serialization)
@@ -119,7 +120,7 @@ def multival_hist(slots: jnp.ndarray, ghc: jnp.ndarray, g_mv: int,
         # large inputs: K chained scatters avoid the [N*K, 3] temp
         for j in range(k):
             flat = flat.at[slots[:, j]].add(ghc)
-    hist = flat.reshape(g_mv, 256, 3)
+    hist = flat.reshape(g_mv, MV_SLOT_STRIDE, 3)
     if b <= 256:
         return hist[:, :b, :]
     return jnp.pad(hist, ((0, 0), (0, b - 256), (0, 0)))
@@ -131,6 +132,16 @@ def multival_feature_bins(slots: jnp.ndarray, base, nbins):
     other rows read the default bin 0 (MultiValBin row scan)."""
     inr = (slots >= base) & (slots < base + nbins - 1)
     return jnp.where(inr, slots - base + 1, 0).sum(axis=1)
+
+
+def multival_node_bins(mv_slots, col, offset, num_bin, g_dense: int):
+    """Per-row bins for per-row NODE vectors (the device tree
+    traversals): decode each row's current node's multi-val feature
+    from the slot matrix. Shares the encoding with build_mv_slots
+    (data/bundling.py: MV_SLOT_STRIDE)."""
+    from ..data.bundling import MV_SLOT_STRIDE
+    base = ((col - g_dense) * MV_SLOT_STRIDE + offset)[:, None]
+    return multival_feature_bins(mv_slots, base, num_bin[:, None])
 
 
 def debundle_totals(hist_g: jnp.ndarray, g, h, c, local_hist: bool):

@@ -268,14 +268,11 @@ def _bin_data(data: np.ndarray, dataset):
             encode_feature_bin(out[:, g], vb, off)
     mv_slots = None
     if dataset.has_multival:
-        from .data.bundling import BundlePlan, build_mv_slots
-        plan = BundlePlan(np.asarray(group), np.asarray(offset),
-                          dataset.num_groups,
-                          np.asarray(dataset.group_num_bins),
-                          mv_group_start=g_dense)
+        from .data.bundling import build_mv_slots
         mv_slots = build_mv_slots(
-            plan, n, lambda j: mv_bins.get(j, (np.zeros(0, np.int64),
-                                               np.zeros(0, np.int64))))
+            dataset.bundle_plan(), n,
+            lambda j: mv_bins.get(j, (np.zeros(0, np.int64),
+                                      np.zeros(0, np.int64))))
     return out, mv_slots
 
 

@@ -154,3 +154,18 @@ def test_multival_async_valid_scoring():
     want = booster.predict(X[1600:], raw_score=True)
     got = np.asarray(src.valid_scores[0]).sum(axis=1)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_midsparsity_stays_dense():
+    """~20%-density conflicting features would pad a slot matrix
+    LARGER than their dense columns (4 * max-nnz-per-row >= F), so the
+    planner must keep them as dense singletons, not multi-val."""
+    rng = np.random.RandomState(5)
+    n, f = 2000, 30
+    X = np.where(rng.rand(n, f) < 0.2,
+                 rng.randint(1, 9, size=(n, f)) * 0.5, 0.0)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.randn(n) > 0).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    assert not ds.has_multival
+    assert ds.binned.shape[1] == f  # dense singletons
