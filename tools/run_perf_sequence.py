@@ -1,0 +1,88 @@
+"""One-shot on-chip measurement sequence (round-4 staging).
+
+Runs, in order and with ONE tunnel client at a time (each step is a
+separate child process; the axon tunnel wedges under concurrent
+clients):
+
+  1. a 60 s device probe (abort early if the tunnel is down)
+  2. tools/profile_tree.py 500000      -- per-stage split timings
+  3. bench.py                          -- 500k -> 2M -> 10.5M escalation
+  4. tools/check_kernels_on_chip.py    -- compiled-vs-interpret parity
+  5. tools/bench_sweep.py              -- amortization curve + AUC gate
+                                          into docs/PERF_SWEEP.json
+
+Writes a combined log to docs/PERF_RUN.log and exits non-zero if the
+probe or every measurement step fails. Budget knobs:
+PERF_SEQ_BUDGET_S (default 5400) total; bench/sweep get the remainder
+split as documented below.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "docs", "PERF_RUN.log")
+
+
+def run(tag, cmd, timeout, env=None):
+    t0 = time.time()
+    print(f"== {tag}: {' '.join(cmd)} (timeout {timeout:.0f}s)",
+          flush=True)
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env or dict(os.environ),
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc, out, err = 124, str(e.stdout or "")[-4000:], \
+            str(e.stderr or "")[-4000:]
+    wall = time.time() - t0
+    with open(LOG, "a") as fh:
+        fh.write(f"\n===== {tag} rc={rc} wall={wall:.0f}s =====\n")
+        fh.write(out[-8000:] + "\n--- stderr ---\n" + err[-4000:] + "\n")
+    print(out[-2000:], flush=True)
+    if rc != 0:
+        print(f"== {tag} FAILED rc={rc}\n{err[-1500:]}", flush=True)
+    return rc == 0
+
+
+def main():
+    budget = float(os.environ.get("PERF_SEQ_BUDGET_S", 5400))
+    t0 = time.time()
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    with open(LOG, "a") as fh:
+        fh.write(f"\n######## perf sequence {time.ctime()} ########\n")
+
+    def left():
+        return budget - (time.time() - t0)
+
+    if not run("probe", [sys.executable, "-c",
+                         "import jax; print(jax.devices())"], 90):
+        print("TPU unreachable; aborting sequence")
+        return 2
+
+    ok = []
+    ok.append(run("profile_tree",
+                  [sys.executable, "tools/profile_tree.py", "500000"],
+                  min(900, left())))
+    env = dict(os.environ)
+    env.setdefault("BENCH_BUDGET_S", str(int(min(1800, left() - 1200))))
+    ok.append(run("bench", [sys.executable, "bench.py"],
+                  float(env["BENCH_BUDGET_S"]) + 120, env))
+    ok.append(run("check_kernels",
+                  [sys.executable, "tools/check_kernels_on_chip.py"],
+                  min(600, max(left() - 900, 120))))
+    env2 = dict(os.environ)
+    env2["BENCH_BUDGET_S"] = str(int(max(left() - 60, 300)))
+    ok.append(run("bench_sweep",
+                  [sys.executable, "tools/bench_sweep.py"],
+                  max(left(), 120), env2))
+    print(f"sequence done: {sum(ok)}/{len(ok)} steps ok "
+          f"({time.time() - t0:.0f}s); log: {LOG}")
+    return 0 if any(ok) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
