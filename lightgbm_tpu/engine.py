@@ -73,10 +73,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set.categorical_feature = categorical_feature
 
     booster = Booster(params=params, train_set=train_set)
-    is_valid_contain_train = False
-    train_data_name = "training"
-    reduced_valid_sets = []
-    name_valid_sets = []
+    eval_on_train = False
+    train_name = "training"
+    extra_valid_sets = []
     if valid_sets is not None:
         if isinstance(valid_sets, Dataset):
             valid_sets = [valid_sets]
@@ -84,18 +83,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
             valid_names = [valid_names]
         for i, valid_data in enumerate(valid_sets):
             if valid_data is train_set:
-                is_valid_contain_train = True
+                eval_on_train = True
                 if valid_names is not None:
-                    train_data_name = valid_names[i]
+                    train_name = valid_names[i]
                 continue
             if not isinstance(valid_data, Dataset):
                 raise TypeError("Training only accepts Dataset object")
             name = valid_names[i] if valid_names is not None \
                 else f"valid_{i}"
-            reduced_valid_sets.append(valid_data)
-            name_valid_sets.append(name)
+            extra_valid_sets.append(valid_data)
             booster.add_valid(valid_data, name)
-    booster._train_data_name = train_data_name
+    booster._train_data_name = train_name
 
     if init_models:
         k = booster._gbdt.num_tree_per_iteration
@@ -134,7 +132,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             return out
         booster._gbdt.init_from_models(
             init_models, _raw_add(train_set),
-            [_raw_add(v) for v in reduced_valid_sets])
+            [_raw_add(v) for v in extra_valid_sets])
 
     # callback assembly (engine.py:186-204)
     callbacks = set(callbacks) if callbacks is not None else set()
@@ -158,7 +156,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_after = sorted(
         callbacks_after, key=lambda cb: getattr(cb, "order", 0))
 
-    need_eval = bool(reduced_valid_sets) or is_valid_contain_train \
+    need_eval = bool(extra_valid_sets) or eval_on_train \
         or feval is not None
     # print/record callbacks are inert without evaluation results; only
     # before-iteration callbacks (reset_parameter) and early stopping
@@ -190,9 +188,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
         evaluation_result_list = []
         if need_eval:
-            if is_valid_contain_train:
+            if eval_on_train:
                 evaluation_result_list.extend(booster.eval_train(feval))
-            if reduced_valid_sets:
+            if extra_valid_sets:
                 evaluation_result_list.extend(booster.eval_valid(feval))
         try:
             for cb in callbacks_after:

@@ -18,6 +18,7 @@ split as documented below.
 """
 
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -28,23 +29,35 @@ LOG = os.path.join(REPO, "docs", "PERF_RUN.log")
 
 def run(tag, cmd, timeout, env=None):
     t0 = time.time()
+    timeout = max(float(timeout), 30.0)
     print(f"== {tag}: {' '.join(cmd)} (timeout {timeout:.0f}s)",
           flush=True)
+    # own session: a step timeout must kill the WHOLE process tree —
+    # bench.py's _BENCH_CHILD grandchild would otherwise keep holding
+    # the tunnel and wedge every later step
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env or dict(os.environ),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
     try:
-        proc = subprocess.run(cmd, cwd=REPO, env=env or dict(os.environ),
-                              capture_output=True, text=True,
-                              timeout=timeout)
-        rc, out, err = proc.returncode, proc.stdout, proc.stderr
-    except subprocess.TimeoutExpired as e:
-        rc, out, err = 124, str(e.stdout or "")[-4000:], \
-            str(e.stderr or "")[-4000:]
+        out, err = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, err = proc.communicate()
+        rc = 124
     wall = time.time() - t0
     with open(LOG, "a") as fh:
         fh.write(f"\n===== {tag} rc={rc} wall={wall:.0f}s =====\n")
-        fh.write(out[-8000:] + "\n--- stderr ---\n" + err[-4000:] + "\n")
-    print(out[-2000:], flush=True)
+        fh.write((out or "")[-8000:] + "\n--- stderr ---\n"
+                 + (err or "")[-4000:] + "\n")
+    print((out or "")[-2000:], flush=True)
     if rc != 0:
-        print(f"== {tag} FAILED rc={rc}\n{err[-1500:]}", flush=True)
+        print(f"== {tag} FAILED rc={rc}\n{(err or '')[-1500:]}",
+              flush=True)
     return rc == 0
 
 
@@ -68,17 +81,22 @@ def main():
                   [sys.executable, "tools/profile_tree.py", "500000"],
                   min(900, left())))
     env = dict(os.environ)
-    env.setdefault("BENCH_BUDGET_S", str(int(min(1800, left() - 1200))))
+    # the sequence's budgets always OVERRIDE any inherited
+    # BENCH_BUDGET_S (a stale shell export must not burst the cap)
+    bench_budget = int(max(min(1800.0, left() - 1200.0), 300.0))
+    env["BENCH_BUDGET_S"] = str(bench_budget)
     ok.append(run("bench", [sys.executable, "bench.py"],
-                  float(env["BENCH_BUDGET_S"]) + 120, env))
+                  bench_budget + 120, env))
     ok.append(run("check_kernels",
                   [sys.executable, "tools/check_kernels_on_chip.py"],
                   min(600, max(left() - 900, 120))))
     env2 = dict(os.environ)
-    env2["BENCH_BUDGET_S"] = str(int(max(left() - 60, 300)))
+    # the sweep's kill deadline must EXCEED the budget it is handed
+    sweep_budget = int(max(left() - 120.0, 300.0))
+    env2["BENCH_BUDGET_S"] = str(sweep_budget)
     ok.append(run("bench_sweep",
                   [sys.executable, "tools/bench_sweep.py"],
-                  max(left(), 120), env2))
+                  sweep_budget + 90, env2))
     print(f"sequence done: {sum(ok)}/{len(ok)} steps ok "
           f"({time.time() - t0:.0f}s); log: {LOG}")
     return 0 if any(ok) else 1
