@@ -104,6 +104,65 @@ def regression_l1_data():
     return X[:ntr], target[:ntr], X[ntr:], target[ntr:]
 
 
+def monotone_data():
+    """Monotone constraints (+1 on f0, -1 on f1) — deterministic
+    parity for the constraint propagation."""
+    rng = np.random.RandomState(555)
+    n, f = 900, 6
+    X = rng.randn(n, f)
+    target = (1.5 * X[:, 0] - 1.2 * X[:, 1] + 0.4 * X[:, 2]
+              + 0.3 * rng.randn(n))
+    ntr = 700
+    return X[:ntr], target[:ntr], X[ntr:], target[ntr:]
+
+
+def weighted_data():
+    """Per-row training weights via the <data>.weight sidecar."""
+    rng = np.random.RandomState(31337)
+    n, f = 900, 8
+    X = rng.randn(n, f)
+    logit = 1.2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2]
+    y = (logit + 0.6 * rng.randn(n) > 0).astype(np.float64)
+    ntr = 700
+    return X[:ntr], y[:ntr], X[ntr:], y[ntr:]
+
+
+def weighted_weights():
+    rng = np.random.RandomState(99)
+    w = rng.exponential(1.0, 900) + 0.1
+    return w[:700]
+
+
+def sparse_efb_data():
+    """Mutually-exclusive sparse features (the EFB shape): the
+    reference bundles internally; parity covers bin boundaries,
+    thresholds and zero-bin handling on bundled columns."""
+    rng = np.random.RandomState(2024)
+    n, f, bs = 1100, 24, 4
+    X = np.zeros((n, f))
+    for b0 in range(0, f, bs):
+        which = rng.randint(0, bs + 1, size=n)
+        rows = np.where(which < bs)[0]
+        X[rows, b0 + which[rows]] = rng.randint(1, 8, len(rows)) * 0.5
+    logit = 2.0 * X[:, 0] - 1.5 * X[:, 1] + X[:, 4] - 0.5 * X[:, 8]
+    y = (logit + 0.3 * rng.randn(n) > 0.1).astype(np.float64)
+    ntr = 850
+    return X[:ntr], y[:ntr], X[ntr:], y[ntr:]
+
+
+def tweedie_data():
+    """Tweedie objective (compound Poisson-gamma shaped target)."""
+    rng = np.random.RandomState(808)
+    n, f = 900, 7
+    X = rng.randn(n, f)
+    mu = np.exp(0.6 * X[:, 0] - 0.4 * X[:, 1] + 0.2 * X[:, 2])
+    counts = rng.poisson(mu * 0.8)
+    target = np.asarray([rng.gamma(2.0, 0.7 * max(c, 0)) if c > 0
+                         else 0.0 for c in counts])
+    ntr = 700
+    return X[:ntr], target[:ntr], X[ntr:], target[ntr:]
+
+
 DATASETS = {
     "binary": dict(
         make=binary_data,
@@ -137,6 +196,34 @@ DATASETS = {
         make=regression_l1_data,
         train_params=["objective=regression_l1", "num_trees=20",
                       "num_leaves=31", "learning_rate=0.15",
+                      "min_data_in_leaf=20", "verbosity=-1"],
+    ),
+    "monotone": dict(
+        make=monotone_data,
+        train_params=["objective=regression", "num_trees=20",
+                      "num_leaves=31", "learning_rate=0.1",
+                      "min_data_in_leaf=20",
+                      "monotone_constraints=1,-1,0,0,0,0",
+                      "verbosity=-1"],
+    ),
+    "weighted": dict(
+        make=weighted_data,
+        make_weight=weighted_weights,
+        train_params=["objective=binary", "num_trees=20",
+                      "num_leaves=31", "learning_rate=0.1",
+                      "min_data_in_leaf=20", "verbosity=-1"],
+    ),
+    "sparse_efb": dict(
+        make=sparse_efb_data,
+        train_params=["objective=binary", "num_trees=20",
+                      "num_leaves=15", "learning_rate=0.1",
+                      "min_data_in_leaf=10", "verbosity=-1"],
+    ),
+    "tweedie": dict(
+        make=tweedie_data,
+        train_params=["objective=tweedie",
+                      "tweedie_variance_power=1.3", "num_trees=20",
+                      "num_leaves=31", "learning_rate=0.1",
                       "min_data_in_leaf=20", "verbosity=-1"],
     ),
 }

@@ -99,7 +99,8 @@ def test_lambdarank_training_quality_vs_reference():
 
 @pytest.mark.parametrize("name,metric_tol", [
     ("binary", 0.03), ("multiclass", 0.05), ("regression_l1", 0.05),
-    ("categorical", 0.05)])
+    ("categorical", 0.05), ("monotone", 0.05), ("sparse_efb", 0.05),
+    ("weighted", 0.05), ("tweedie", 0.05)])
 def test_training_quality_parity(name, metric_tol):
     """Train OURS with the reference model's exact params on the same
     data; held-out loss must match the reference predictions' loss
@@ -117,19 +118,32 @@ def test_training_quality_parity(name, metric_tol):
     kw = {}
     if cats is not None:
         kw["categorical_feature"] = [int(c) for c in cats.split(",")]
+    if "make_weight" in DATASETS[name]:
+        kw["weight"] = DATASETS[name]["make_weight"]()
     ours = lgb.train(spec, lgb.Dataset(Xtr, label=ytr, **kw),
                      num_boost_round=n_trees)
     pred = np.asarray(ours.predict(Xte))
+    objective = spec["objective"]  # scorer follows the dataset's spec
 
     def loss(p):
         p = np.asarray(p)
-        if name == "binary":
+        if objective == "binary":
             p = np.clip(p.reshape(-1), 1e-12, 1 - 1e-12)
             return -np.mean(yte * np.log(p) + (1 - yte) * np.log(1 - p))
-        if name == "multiclass":
+        if objective == "multiclass":
             p = np.clip(p.reshape(len(yte), -1), 1e-12, None)
             return -np.mean(np.log(p[np.arange(len(yte)),
                                      yte.astype(int)]))
+        if objective == "tweedie":
+            rho = float(spec.get("tweedie_variance_power", 1.5))
+            mu = np.clip(p.reshape(-1), 1e-9, None)
+            # Tweedie deviance for 1 < rho < 2 (y == 0 terms vanish)
+            return np.mean(2 * (
+                np.where(yte > 0,
+                         np.maximum(yte, 1e-9) ** (2 - rho)
+                         / ((1 - rho) * (2 - rho)), 0.0)
+                - yte * mu ** (1 - rho) / (1 - rho)
+                + mu ** (2 - rho) / (2 - rho)))
         return np.mean(np.abs(p.reshape(-1) - yte))   # L1-style
 
     l_ref = loss(ref_pred)

@@ -36,10 +36,17 @@ def run(binary, args, cwd):
 
 def main():
     binary = sys.argv[1]
+    only = set(sys.argv[2:])  # optional subset of dataset names
+    unknown = only - set(golden_common.DATASETS)
+    if unknown:
+        raise SystemExit(f"unknown dataset name(s): {sorted(unknown)}; "
+                         f"choose from {sorted(golden_common.DATASETS)}")
     os.makedirs(FIXDIR, exist_ok=True)
     scratch = "/tmp/golden_scratch"
     os.makedirs(scratch, exist_ok=True)
     for name, spec in golden_common.DATASETS.items():
+        if only and name not in only:
+            continue
         Xtr, ytr, Xte, yte = spec["make"]()
         train = os.path.join(scratch, f"{name}.train")
         test = os.path.join(scratch, f"{name}.test")
@@ -52,6 +59,11 @@ def main():
                 fh.write("\n".join(str(int(q)) for q in qtr) + "\n")
             with open(test + ".query", "w") as fh:
                 fh.write("\n".join(str(int(q)) for q in qte) + "\n")
+        if "make_weight" in spec:
+            # reference weight sidecar (Metadata::LoadWeights)
+            wtr = spec["make_weight"]()
+            with open(train + ".weight", "w") as fh:
+                fh.write("\n".join(f"{w:.17g}" for w in wtr) + "\n")
         model = os.path.join(FIXDIR, f"model_{name}.txt")
         pred = os.path.join(FIXDIR, f"pred_{name}.txt")
         run(binary, ["task=train", f"data={train}",

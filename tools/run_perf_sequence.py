@@ -85,18 +85,19 @@ def main():
     # BENCH_BUDGET_S (a stale shell export must not burst the cap)
     bench_budget = int(max(min(1800.0, left() - 1200.0), 300.0))
     env["BENCH_BUDGET_S"] = str(bench_budget)
+    # kill deadlines never exceed the sequence's remaining wall (an
+    # exhausted budget means a fast kill, not a 300 s floor overrun)
     ok.append(run("bench", [sys.executable, "bench.py"],
-                  bench_budget + 120, env))
+                  max(min(bench_budget + 120.0, left()), 60.0), env))
     ok.append(run("check_kernels",
                   [sys.executable, "tools/check_kernels_on_chip.py"],
                   min(600, max(left() - 900, 120))))
     env2 = dict(os.environ)
-    # the sweep's kill deadline must EXCEED the budget it is handed
     sweep_budget = int(max(left() - 120.0, 300.0))
     env2["BENCH_BUDGET_S"] = str(sweep_budget)
     ok.append(run("bench_sweep",
                   [sys.executable, "tools/bench_sweep.py"],
-                  sweep_budget + 90, env2))
+                  max(min(sweep_budget + 90.0, left()), 60.0), env2))
     print(f"sequence done: {sum(ok)}/{len(ok)} steps ok "
           f"({time.time() - t0:.0f}s); log: {LOG}")
     return 0 if any(ok) else 1
