@@ -79,7 +79,10 @@ class DART(GBDT):
 
     def _setup_train(self, train_data, hist_method):
         super()._setup_train(train_data, hist_method)
-        self._drop_rng = np.random.RandomState(self.config.drop_seed)
+        # the reference's exact LCG so drop sets (and thus whole DART
+        # training trajectories) bit-match the reference CLI
+        from ..utils.ref_random import RefRandom
+        self._drop_rng = RefRandom(self.config.drop_seed)
         self._tree_weight: List[float] = []
         self._sum_weight = 0.0
         self._drop_index: List[int] = []
@@ -100,7 +103,7 @@ class DART(GBDT):
         """DroppingTrees (dart.hpp:100-146)."""
         cfg = self.config
         self._drop_index = []
-        if self._drop_rng.rand() >= cfg.skip_drop:
+        if self._drop_rng.next_float() >= cfg.skip_drop:
             drop_rate = cfg.drop_rate
             if not cfg.uniform_drop and self._sum_weight > 0:
                 inv_avg = len(self._tree_weight) / self._sum_weight
@@ -108,7 +111,7 @@ class DART(GBDT):
                     drop_rate = min(
                         drop_rate, cfg.max_drop * inv_avg / self._sum_weight)
                 for i in range(self.iter):
-                    if self._drop_rng.rand() < (
+                    if self._drop_rng.next_float() < (
                             drop_rate * self._tree_weight[i] * inv_avg):
                         self._drop_index.append(i)
                         if len(self._drop_index) >= cfg.max_drop > 0:
@@ -117,7 +120,7 @@ class DART(GBDT):
                 if cfg.max_drop > 0 and self.iter > 0:
                     drop_rate = min(drop_rate, cfg.max_drop / self.iter)
                 for i in range(self.iter):
-                    if self._drop_rng.rand() < drop_rate:
+                    if self._drop_rng.next_float() < drop_rate:
                         self._drop_index.append(i)
                         if len(self._drop_index) >= cfg.max_drop > 0:
                             break

@@ -1,0 +1,37 @@
+"""The reference's deterministic PRNG, bit-for-bit.
+
+Reference analog: ``LightGBM::Random``
+(include/LightGBM/utils/random.h:95-113) — the 214013/2531011 LCG used
+for seed derivation (Config::Set), DART tree dropping
+(dart.hpp:97-130), and bagging index sampling. Host-side control flow
+(drop-set selection etc.) uses this class so RNG-dependent training
+trajectories can be golden-tested against reference CLI outputs; the
+per-row device sampling paths use JAX keys instead (documented
+divergence — those never need bit parity with a host PRNG)."""
+
+from __future__ import annotations
+
+
+class RefRandom:
+    """uint32 LCG: x = 214013 * x + 2531011."""
+
+    def __init__(self, seed: int = 123456789):
+        self.x = int(seed) & 0xFFFFFFFF
+
+    def rand_int16(self) -> int:
+        self.x = (214013 * self.x + 2531011) & 0xFFFFFFFF
+        return (self.x >> 16) & 0x7FFF
+
+    def rand_int32(self) -> int:
+        self.x = (214013 * self.x + 2531011) & 0xFFFFFFFF
+        return self.x & 0x7FFFFFFF
+
+    def next_float(self) -> float:
+        """Random::NextFloat — 15-bit draw scaled to [0, 1)."""
+        return self.rand_int16() / 32768.0
+
+    def next_short(self, lo: int, hi: int) -> int:
+        return self.rand_int16() % (hi - lo) + lo
+
+    def next_int(self, lo: int, hi: int) -> int:
+        return self.rand_int32() % (hi - lo) + lo
