@@ -75,10 +75,12 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
         self.config = config
         self._init_node_rand(dataset, config)
         self.meta = feature_meta_from_dataset(dataset, config)
+        from .serial import dataset_any_missing
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
-                for i in range(dataset.num_features)))
+                for i in range(dataset.num_features)),
+            any_missing=dataset_any_missing(dataset))
         _, _, group_bins = dataset.bundle_maps()
         self.num_bins_max = max(
             int(dataset.num_bins_array().max(initial=2)),
@@ -126,6 +128,15 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
     def __init__(self, dataset: Dataset, config: Config,
                  hist_method: str = "auto", interpret: Optional[bool] = None):
         self._setup_partitioned(dataset, config, interpret)
+        # single-device scans are collective-free: route eligible ones
+        # through the fused Pallas scan kernel (split_scan_pallas.py).
+        # Compiled path only — interpret mode (CPU tests) keeps the XLA
+        # scan so serial-vs-partitioned parity stays bit-exact there;
+        # the kernel's own math is covered by test_split_scan_pallas.
+        # Like the reference's GPU learner, the fused scan may differ
+        # from the XLA scan in f32 rounding (gpu_tree_learner.cpp:299).
+        if not self.interpret:
+            self.params = self.params._replace(use_scan_kernel=True)
         self.mat = build_matrix(jnp.asarray(dataset.binned), HIST_BLK)
         self.ws = jnp.zeros_like(self.mat)
 

@@ -49,6 +49,14 @@ _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
 kEps = 1e-15
 
 
+def dataset_any_missing(dataset: Dataset) -> bool:
+    """Static gate for SplitParams.any_missing: True when any feature's
+    bin mapper recorded a missing-value convention (two-scan split
+    search needed)."""
+    return any(dataset.feature_mapper(i).missing_type != MISSING_NONE
+               for i in range(dataset.num_features))
+
+
 def feature_meta_from_dataset(dataset: Dataset,
                               config: Config) -> FeatureMeta:
     """Build the static per-feature metadata arrays."""
@@ -641,7 +649,8 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
-                for i in range(dataset.num_features)))
+                for i in range(dataset.num_features)),
+            any_missing=dataset_any_missing(dataset))
         self.binned = jnp.asarray(dataset.binned)
         # multi-val pseudo-groups (no physical column; bundling.py)
         self.mv_slots = dataset.mv_slots_device

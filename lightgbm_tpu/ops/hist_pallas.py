@@ -314,16 +314,19 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
         for p in range(PAY - 2, -1, -1):             # [win, m_lhs]
             pay_b = jnp.where(lhs_p == p, pay[p], pay_b)
 
-        def group_body(gidx, _):
-            # per-feature bin columns of this group (clamped: the tail
-            # group may run past F; garbage lanes are sliced off later)
+        # group loop unrolled with STATIC column indices: a traced
+        # index would force each feature column out of the [win, C]
+        # tile via a one-hot lane reduction (~full-width VPU pass per
+        # feature per block); a static slice is free. Program size is
+        # bounded: MAX_NIBBLE_F caps this kernel at 64 groups (wider
+        # datasets take the per-bin kernel), so the unroll cannot blow
+        # up Mosaic compile time on wide data
+        for gidx in range(ngroups):
+            # clamped: the tail group may run past F; garbage lanes
+            # are sliced off later
             def fcol(j):
-                c = jnp.minimum(gidx * GRP + j, feat0 - 1)
-                sel = jnp.where(
-                    jax.lax.broadcasted_iota(jnp.int32, (1, cols), 1)
-                    == c, 1, 0)
-                return jnp.sum(mat_i32 * sel, axis=1,
-                               keepdims=True)        # [win, 1]
+                c = min(gidx * GRP + j, feat0 - 1)
+                return mat_i32[:, c:c + 1]           # [win, 1]
 
             f0, f1, f2 = fcol(0), fcol(1), fcol(2)
 
@@ -340,9 +343,6 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
             out_ref[gidx] += jax.lax.dot_general(
                 lhs, rhs, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [m_lhs, n_rhs]
-            return 0
-
-        jax.lax.fori_loop(0, ngroups, group_body, 0)
         return 0
 
     jax.lax.fori_loop(0, nblk, block_body, 0)

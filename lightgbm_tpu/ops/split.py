@@ -82,6 +82,17 @@ class SplitParams(NamedTuple):
     # static gate: compile the categorical scan only when the dataset
     # has categorical features (set by the learner)
     has_categorical: bool = False
+    # static gate: when NO feature has missing values the dir=+1 scan
+    # can never win (two_scan is all-False), so skip compiling it —
+    # halves the per-split scan op count in the common dense case
+    # (mirrors the reference's one-scan path for MissingType::None,
+    # feature_histogram.hpp:555-709)
+    any_missing: bool = True
+    # static gate: route eligible numerical scans through the fused
+    # Pallas kernel (ops/split_scan_pallas.py) — set by learners whose
+    # scan runs collective-free (see scan_kernel_ok for the per-call
+    # eligibility: no categorical, no CEGB, no rand_bins)
+    use_scan_kernel: bool = False
     # CEGB (cost_effective_gradient_boosting.hpp:50-61): static gate +
     # scalar penalties; the per-feature coupled penalty rides FeatureMeta
     cegb_on: bool = False
@@ -210,13 +221,6 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     monotone = meta.monotone[:, None]
 
     parent_h_eps = parent_h + 2.0 * kEpsilon
-    # reference runs the two-scan path only when num_bin > 2 and missing
-    two_scan = (missing != MISSING_NONE_CODE) & (nb > 2)
-    skip_default = two_scan & (missing == MISSING_ZERO_CODE) \
-        & (bins == default_bin)
-    na_excl = two_scan & (missing == MISSING_NAN_CODE)
-    is_na_bin = na_excl & (bins == nb - 1)
-
     gain_shift = leaf_split_gain(parent_g, parent_h_eps, p.lambda_l1,
                                  p.lambda_l2, p.max_delta_step)
     min_gain_shift = gain_shift + p.min_gain_to_split
@@ -224,30 +228,47 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     def masked(x, m):
         return jnp.where(m, 0.0, x)
 
-    # ---- dir=+1: left-to-right; default/NaN implicitly go right --------
-    lg_p = jnp.cumsum(masked(g, skip_default), axis=1)
-    lh_p = jnp.cumsum(masked(h, skip_default), axis=1)
-    lc_p = jnp.cumsum(masked(c, skip_default), axis=1)
-    hl_p = lh_p + kEpsilon
-    hr_p = parent_h_eps - hl_p
-    gr_p = parent_g - lg_p
-    cr_p = parent_c - lc_p
-    valid_p = two_scan & (bins <= nb - 2) & ~skip_default
-    if rand_bins is not None:
-        valid_p &= bins == rand_bins[:, None]
-    valid_p &= (lc_p >= p.min_data_in_leaf) & (cr_p >= p.min_data_in_leaf)
-    valid_p &= (hl_p >= p.min_sum_hessian_in_leaf) \
-        & (hr_p >= p.min_sum_hessian_in_leaf)
-    gains_p = _split_gains(lg_p, hl_p, gr_p, hr_p, p, monotone,
-                           constraint_min, constraint_max)
-    score_p = jnp.where(valid_p & (gains_p > min_gain_shift), gains_p,
-                        NEG_INF)
+    if p.any_missing:
+        # reference runs the two-scan path only when num_bin > 2 and
+        # missing
+        two_scan = (missing != MISSING_NONE_CODE) & (nb > 2)
+        skip_default = two_scan & (missing == MISSING_ZERO_CODE) \
+            & (bins == default_bin)
+        na_excl = two_scan & (missing == MISSING_NAN_CODE)
+        is_na_bin = na_excl & (bins == nb - 1)
+
+        # ---- dir=+1: left-to-right; default/NaN implicitly go right ----
+        lg_p = jnp.cumsum(masked(g, skip_default), axis=1)
+        lh_p = jnp.cumsum(masked(h, skip_default), axis=1)
+        lc_p = jnp.cumsum(masked(c, skip_default), axis=1)
+        hl_p = lh_p + kEpsilon
+        hr_p = parent_h_eps - hl_p
+        gr_p = parent_g - lg_p
+        cr_p = parent_c - lc_p
+        valid_p = two_scan & (bins <= nb - 2) & ~skip_default
+        if rand_bins is not None:
+            valid_p &= bins == rand_bins[:, None]
+        valid_p &= (lc_p >= p.min_data_in_leaf) \
+            & (cr_p >= p.min_data_in_leaf)
+        valid_p &= (hl_p >= p.min_sum_hessian_in_leaf) \
+            & (hr_p >= p.min_sum_hessian_in_leaf)
+        gains_p = _split_gains(lg_p, hl_p, gr_p, hr_p, p, monotone,
+                               constraint_min, constraint_max)
+        score_p = jnp.where(valid_p & (gains_p > min_gain_shift),
+                            gains_p, NEG_INF)
+        mask_m = skip_default | is_na_bin
+        g_m = masked(g, mask_m)
+        h_m = masked(h, mask_m)
+        c_m = masked(c, mask_m)
+    else:
+        # static no-missing fast path (set by the learner from the bin
+        # mappers): two_scan would be all-False, so the dir=+1 scan can
+        # never record a split and every missing mask vanishes — only
+        # the dir=-1 scan below compiles (the reference's one-scan path
+        # for MissingType::None, feature_histogram.hpp:555-709)
+        g_m, h_m, c_m = g, h, c
 
     # ---- dir=-1: right-to-left; default/NaN implicitly go left ---------
-    mask_m = skip_default | is_na_bin
-    g_m = masked(g, mask_m)
-    h_m = masked(h, mask_m)
-    c_m = masked(c, mask_m)
     # right side at threshold t = sum of masked bins > t
     rg_m = g_m.sum(axis=1, keepdims=True) - jnp.cumsum(g_m, axis=1)
     rh_m = h_m.sum(axis=1, keepdims=True) - jnp.cumsum(h_m, axis=1)
@@ -256,13 +277,18 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     hl_m = parent_h_eps - hr_m
     gl_m = parent_g - rg_m
     cl_m = parent_c - rc_m
-    valid_m = bins <= nb - 2 - na_excl.astype(jnp.int32)
+    if p.any_missing:
+        valid_m = bins <= nb - 2 - na_excl.astype(jnp.int32)
+    else:
+        valid_m = bins <= nb - 2
     if rand_bins is not None:
         valid_m &= bins == rand_bins[:, None]
-    # zero-missing skips threshold default_bin-1 (the `continue` skips the
-    # iteration that would have recorded it, feature_histogram.hpp:577)
-    valid_m &= ~(two_scan & (missing == MISSING_ZERO_CODE)
-                 & (bins == default_bin - 1))
+    if p.any_missing:
+        # zero-missing skips threshold default_bin-1 (the `continue`
+        # skips the iteration that would have recorded it,
+        # feature_histogram.hpp:577)
+        valid_m &= ~(two_scan & (missing == MISSING_ZERO_CODE)
+                     & (bins == default_bin - 1))
     valid_m &= (cl_m >= p.min_data_in_leaf) & (rc_m >= p.min_data_in_leaf)
     valid_m &= (hl_m >= p.min_sum_hessian_in_leaf) \
         & (hr_m >= p.min_sum_hessian_in_leaf)
@@ -274,11 +300,17 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     # ---- per-feature best with reference iteration-order tie-breaks ----
     t_m = _argmax_last(score_m, axis=1)                      # [F]
     v_m = jnp.take_along_axis(score_m, t_m[:, None], axis=1)[:, 0]
-    t_p = jnp.argmax(score_p, axis=1)
-    v_p = jnp.take_along_axis(score_p, t_p[:, None], axis=1)[:, 0]
-    use_m = v_m >= v_p                                       # -1 scan first
-    feat_gain = jnp.where(use_m, v_m, v_p)
-    feat_t = jnp.where(use_m, t_m, t_p).astype(jnp.int32)
+    fr = jnp.arange(f)
+    if p.any_missing:
+        t_p = jnp.argmax(score_p, axis=1)
+        v_p = jnp.take_along_axis(score_p, t_p[:, None], axis=1)[:, 0]
+        use_m = v_m >= v_p                                   # -1 scan first
+        feat_gain = jnp.where(use_m, v_m, v_p)
+        feat_t = jnp.where(use_m, t_m, t_p).astype(jnp.int32)
+    else:
+        use_m = jnp.ones((f,), bool)
+        feat_gain = v_m
+        feat_t = t_m.astype(jnp.int32)
 
     feat_valid = jnp.isfinite(feat_gain) & ~meta.is_categorical
     if feature_mask is not None:
@@ -287,10 +319,12 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         feat_valid, (feat_gain - min_gain_shift) * meta.penalty, NEG_INF)
 
     # left-side sums at each feature's winning threshold
-    fr = jnp.arange(f)
-    lg_f = jnp.where(use_m, gl_m[fr, t_m], lg_p[fr, t_p])
-    lh_f = jnp.where(use_m, hl_m[fr, t_m], hl_p[fr, t_p])
-    lc_f = jnp.where(use_m, cl_m[fr, t_m], lc_p[fr, t_p])
+    if p.any_missing:
+        lg_f = jnp.where(use_m, gl_m[fr, t_m], lg_p[fr, t_p])
+        lh_f = jnp.where(use_m, hl_m[fr, t_m], hl_p[fr, t_p])
+        lc_f = jnp.where(use_m, cl_m[fr, t_m], lc_p[fr, t_p])
+    else:
+        lg_f, lh_f, lc_f = gl_m[fr, t_m], hl_m[fr, t_m], cl_m[fr, t_m]
 
     # default direction: -1 scan => left; 2-bin NaN fix goes right
     # (feature_histogram.hpp:127-130)
@@ -340,6 +374,15 @@ def per_feature_splits(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         constraint_min = jnp.float32(-jnp.inf)
     if constraint_max is None:
         constraint_max = jnp.float32(jnp.inf)
+    if params.use_scan_kernel:
+        from .split_scan_pallas import (per_feature_numerical_pallas,
+                                        scan_kernel_ok)
+        if scan_kernel_ok(params, rand_bins, cegb_uncharged):
+            pf = per_feature_numerical_pallas(
+                hist, parent_g, parent_h, parent_c, meta, params,
+                constraint_min, constraint_max, feature_mask)
+            # no CEGB on this path, so raw == penalized score
+            return (pf, pf.score) if return_raw else pf
     pf = per_feature_numerical(hist, parent_g, parent_h, parent_c, meta,
                                params, constraint_min, constraint_max,
                                feature_mask, rand_bins)

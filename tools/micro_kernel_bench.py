@@ -1,0 +1,154 @@
+"""In-program kernel microbenchmark (round-4 perf work).
+
+Separates host-dispatch latency (the axon tunnel adds ~10+ ms per
+host->device call, polluting single-call timings) from the true
+in-program cost of each kernel by chaining K calls inside ONE jitted
+lax.fori_loop and dividing. Reports:
+
+  - host dispatch floor (trivial jit)
+  - histogram_segment: per-call cost vs segment size -> fixed overhead
+    + streaming Mrow/s
+  - partition_segment: same
+  - best-split scan: per-call cost
+
+Run: python tools/micro_kernel_bench.py [rows]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def timeit(fn, *args, warmup=2, iters=5):
+    import jax
+    for _ in range(warmup):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    f = 28
+    b = 256
+    k_chain = 20
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import hist_pallas as hp
+    from lightgbm_tpu.ops import partition_pallas as pp
+
+    print(f"backend={jax.default_backend()} n={n} f={f}")
+
+    rng = np.random.RandomState(0)
+    binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = np.abs(rng.randn(n)).astype(np.float32) + 0.1
+    c = np.ones(n, np.float32)
+
+    mat = hp.build_matrix(jnp.asarray(binned), 2048)
+    mat = hp.pack_gh(mat, f, jnp.asarray(g), jnp.asarray(h),
+                     jnp.asarray(c))
+    mat = jax.block_until_ready(mat)
+    ws = jnp.zeros_like(mat)
+
+    # 1. dispatch floor
+    @jax.jit
+    def triv(x):
+        return x + 1.0
+    x0 = jnp.zeros((8,), jnp.float32)
+    t = timeit(triv, x0, warmup=3, iters=10)
+    print(f"dispatch floor (trivial jit): {t*1e3:8.3f} ms")
+
+    # 2. chained histogram_segment
+    def chain_hist(m, count):
+        def body(i, acc):
+            # begin depends on the carry so XLA cannot hoist the
+            # loop-invariant kernel call (i % 2 stays 8-aligned -> same
+            # work per iteration, different operand)
+            begin = (acc.astype(jnp.int32) % 2) * 8
+            hh = hp.histogram_segment(m, begin, count, b, f,
+                                      blk=2048, interpret=False)
+            return acc + hh[0, 0, 0]
+        return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
+    chain_hist_j = jax.jit(chain_hist)
+
+    print(f"histogram_segment, {k_chain}x chained in one jit:")
+    for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
+        t = timeit(chain_hist_j, mat, jnp.int32(count))
+        per = t / k_chain
+        print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
+              f"({count/per/1e6:8.1f} Mrow/s)")
+
+    # 3. chained partition_segment
+    def chain_part(m, w, count):
+        lut = jnp.zeros((1, 256), jnp.float32)
+        def body(i, carry):
+            m2, w2, acc = carry
+            m3, w3, nl = pp.partition_segment(
+                m2, w2, jnp.int32(0), count, jnp.int32(3), jnp.int32(128),
+                jnp.int32(1), jnp.int32(0), jnp.int32(0), jnp.int32(b),
+                jnp.int32(0), lut, blk=512, interpret=False)
+            return m3, w3, acc + nl[0]
+        _, _, acc = jax.lax.fori_loop(0, k_chain, body,
+                                      (m, w, jnp.int32(0)))
+        return acc
+    chain_part_j = jax.jit(chain_part, donate_argnums=(0, 1))
+
+    print(f"partition_segment, {k_chain}x chained in one jit:")
+    for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
+        m2 = jnp.array(mat)  # fresh donation each measure
+        w2 = jnp.array(ws)
+        for _ in range(1):
+            r = chain_part_j(m2, w2, jnp.int32(count))
+        jax.block_until_ready(r)
+        m2 = jnp.array(mat)
+        w2 = jnp.array(ws)
+        t0 = time.perf_counter()
+        r = chain_part_j(m2, w2, jnp.int32(count))
+        jax.block_until_ready(r)
+        t = time.perf_counter() - t0
+        per = t / k_chain
+        print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
+              f"({count/per/1e6:8.1f} Mrow/s)")
+
+    # 4. chained best-split scan
+    from lightgbm_tpu.learner.serial import (feature_meta_from_dataset,
+                                             split_params_from_config)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.ops.split import best_split
+
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 255,
+                              "max_bin": 255, "verbosity": -1})
+    Xs = rng.randn(4096, f).astype(np.float32)
+    ds = Dataset.from_numpy(Xs, cfg, label=np.zeros(4096, np.float32))
+    meta = feature_meta_from_dataset(ds, cfg)
+    params = split_params_from_config(cfg)
+
+    hist = jnp.asarray(rng.rand(f, b, 3).astype(np.float32))
+    inf = jnp.float32(np.inf)
+    fm = jnp.ones((f,), bool)
+
+    def chain_scan(hh):
+        def body(i, acc):
+            res = best_split(hh + acc * 1e-9, jnp.float32(100.0),
+                             jnp.float32(200.0), jnp.float32(4096.0),
+                             meta, params, -inf, inf, fm)
+            return acc + res.gain
+        return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
+    chain_scan_j = jax.jit(chain_scan)
+    t = timeit(chain_scan_j, hist)
+    print(f"best_split scan chained: {t/k_chain*1e3:8.3f} ms/call")
+
+
+if __name__ == "__main__":
+    main()
