@@ -36,7 +36,22 @@ from ..data.dataset import Dataset
 from ..models.tree import Tree, TreeArrays
 from ..ops.hist_pallas import (build_matrix, extract_row_ids,
                                histogram_segment, pack_gh)
-from ..ops.partition_pallas import bitset_to_lut, partition_segment
+from ..ops.partition_pallas import bitset_to_lut
+from ..ops.partition_pallas import partition_segment as _partition_v1
+
+# opt-in sub-tiled partition kernel (ops/partition_pallas_v2.py);
+# flipped by env until validated on hardware, then becomes the default.
+# Block size is width-dependent (pick_blk) so VMEM scratch stays
+# bounded on wide matrices.
+import os as _os
+USE_PART_V2 = _os.environ.get("LGBM_TPU_PART_V2", "0") == "1"
+if USE_PART_V2:
+    from ..ops.partition_pallas_v2 import (pick_blk as _pick_blk,
+                                           partition_segment_v2
+                                           as partition_segment)
+else:
+    partition_segment = _partition_v1
+    _pick_blk = None
 from ..ops.split import (MAX_CAT_WORDS, _argmax_first, assemble_split,
                          best_split, leaf_output_no_constraint,
                          per_feature_splits)
@@ -76,11 +91,23 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
         self._init_node_rand(dataset, config)
         self.meta = feature_meta_from_dataset(dataset, config)
         from .serial import dataset_any_missing
+        if interpret is None:
+            interpret = jax.default_backend() not in ("tpu", "axon")
+        # the fused Pallas split-scan kernel engages on compiled
+        # backends only (interpret mode / CPU tests keep the XLA scan
+        # so cross-learner parity stays bit-exact there; the kernel's
+        # math is covered by test_split_scan_pallas). Like the
+        # reference's GPU learner, the fused scan may differ from the
+        # XLA scan at f32-rounding level (gpu_tree_learner.cpp:299).
+        # Scan calls are collective-free in every comm (collectives
+        # wrap the scan, never sit inside it), so this is safe for the
+        # mesh learners too.
         self.params = split_params_from_config(config)._replace(
             has_categorical=any(
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
                 for i in range(dataset.num_features)),
-            any_missing=dataset_any_missing(dataset))
+            any_missing=dataset_any_missing(dataset),
+            use_scan_kernel=not interpret)
         _, _, group_bins = dataset.bundle_maps()
         self.num_bins_max = max(
             int(dataset.num_bins_array().max(initial=2)),
@@ -100,8 +127,6 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
         self.num_groups = dataset.num_groups
         self.bundled = dataset.feature_offset is not None
         self.num_data = dataset.num_data
-        if interpret is None:
-            interpret = jax.default_backend() not in ("tpu", "axon")
         self.interpret = interpret
         from .serial import hist_pool_slots
         # bounded LRU pool (single-device path only; the mesh learners
@@ -128,15 +153,6 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
     def __init__(self, dataset: Dataset, config: Config,
                  hist_method: str = "auto", interpret: Optional[bool] = None):
         self._setup_partitioned(dataset, config, interpret)
-        # single-device scans are collective-free: route eligible ones
-        # through the fused Pallas scan kernel (split_scan_pallas.py).
-        # Compiled path only — interpret mode (CPU tests) keeps the XLA
-        # scan so serial-vs-partitioned parity stays bit-exact there;
-        # the kernel's own math is covered by test_split_scan_pallas.
-        # Like the reference's GPU learner, the fused scan may differ
-        # from the XLA scan in f32 rounding (gpu_tree_learner.cpp:299).
-        if not self.interpret:
-            self.params = self.params._replace(use_scan_kernel=True)
         self.mat = build_matrix(jnp.asarray(dataset.binned), HIST_BLK)
         self.ws = jnp.zeros_like(self.mat)
 
@@ -472,7 +488,9 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             st["mat"], st["ws"], begin, cnt, grp_col, thr,
             dleft.astype(jnp.int32), meta.missing[feat],
             meta.default_bin[feat], meta.num_bins[feat],
-            use_lut.astype(jnp.int32), lut, blk=PART_BLK,
+            use_lut.astype(jnp.int32), lut,
+            blk=_pick_blk(st["mat"].shape[1]) if USE_PART_V2
+            else PART_BLK,
             interpret=interpret)
         nl = nl1[0]
         nr = cnt - nl

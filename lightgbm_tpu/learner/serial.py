@@ -650,7 +650,12 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
             has_categorical=any(
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
                 for i in range(dataset.num_features)),
-            any_missing=dataset_any_missing(dataset))
+            any_missing=dataset_any_missing(dataset),
+            # fused Pallas split scan on compiled backends (see
+            # learner/partitioned.py rationale; scans are
+            # collective-free in every comm, so the mesh learners
+            # built on this base get it too)
+            use_scan_kernel=jax.default_backend() in ("tpu", "axon"))
         self.binned = jnp.asarray(dataset.binned)
         # multi-val pseudo-groups (no physical column; bundling.py)
         self.mv_slots = dataset.mv_slots_device

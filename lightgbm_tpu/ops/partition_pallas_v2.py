@@ -1,0 +1,345 @@
+"""Pallas TPU kernel: sub-tiled in-place stable partition (v2).
+
+Same contract as ``partition_pallas.partition_segment`` (stable
+partition of training-matrix rows [begin, begin+count) by a split
+decision; reference analog ``DataPartition::Split``,
+data_partition.hpp:101-120) with a throughput-oriented redesign:
+
+v1 cost model (blk=512): the stable compaction runs ONE permutation
+matmul per block whose destination axis spans the whole window, so MXU
+cycles/row grow linearly with blk (O(blk) dst tiles x O(blk) K) — and
+every block pays 5 serialized DMAs (read + 2x read-merge-write), so
+small blocks are DMA-latency-bound and large blocks are MXU-bound.
+
+v2 removes both walls:
+  * **sub-tiled compaction**: each 128-row sub-tile compacts with a
+    [128 x 136] one-hot matmul into a VMEM staging stream at its
+    running offset — MXU cycles/row are constant in blk, so blocks can
+    be 2048 rows;
+  * **write streaming**: compacted rows accumulate in VMEM staging
+    (one stream per side); whole ``blk``-row 8-aligned chunks flush
+    with a single pure DMA write — no read-merge-write during the
+    stream. Only the final partial 8-granule of the left stream does
+    one read-merge-write; the right stream drains straight into the
+    workspace (scratch beyond its end, so granule writes are safe).
+  * **double-buffered input DMA**: block k+1's read overlaps block k's
+    compute (safe: left-stream writes never pass the read head, and
+    granule-overlap bytes are bit-identical).
+
+Phase 2 (rights back behind the lefts) streams the workspace through
+the SAME staging machinery with an all-valid mask (a pure shifted copy,
+no decision), continuing the left stream's carry so unaligned
+boundaries cost nothing extra.
+
+Enabled process-wide by setting LGBM_TPU_PART_V2=1 BEFORE
+``learner/partitioned.py`` is first imported (the learner binds the
+kernel at import; ``pick_blk`` sizes the block to the matrix width so
+VMEM scratch stays bounded). Keep it off until
+``tools/check_kernels_on_chip.py`` has validated the COMPILED kernel on
+hardware — the DMA-overlap behavior only exists compiled;
+interpret-mode parity is covered by tests/test_partition_v2.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .partition_pallas import (MISSING_NAN_CODE, MISSING_ZERO_CODE,
+                               S_BEGIN, S_COUNT, S_FEAT, S_THR, S_DLEFT,
+                               S_MISS, S_DEFBIN, S_NBINS, S_ISCAT)
+
+ALIGN = 8
+SUB = 128                    # compaction sub-tile rows
+VMEM_BUDGET = 6_000_000      # scratch bytes the kernel may claim
+
+
+def pick_blk(cols: int) -> int:
+    """Largest block size whose VMEM scratch (two f32 staging streams +
+    double input buffer + flush buffers) fits the budget at this matrix
+    width. Width scales scratch linearly, so wide datasets get smaller
+    blocks instead of failing to compile."""
+    for blk in (2048, 1024, 512, 256, SUB):
+        scratch = cols * (2 * 4 * (2 * blk + 2 * ALIGN + SUB)   # stages
+                          + 2 * (blk + ALIGN)                   # inbuf
+                          + blk + ALIGN)                        # u8+gran
+        if scratch <= VMEM_BUDGET:
+            return blk
+    return SUB
+
+
+def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
+                         mat_hbm, ws_hbm, nl_ref,
+                         inbuf, stage_l, stage_r, u8buf, gran8, sems,
+                         *, blk: int, cols: int):
+    del mat_in, ws_in
+    begin = scal_ref[S_BEGIN]
+    count = scal_ref[S_COUNT]
+    feat = scal_ref[S_FEAT]
+    thr = scal_ref[S_THR]
+    dleft = scal_ref[S_DLEFT]
+    miss = scal_ref[S_MISS]
+    defbin = scal_ref[S_DEFBIN]
+    nbins = scal_ref[S_NBINS]
+    iscat = scal_ref[S_ISCAT]
+
+    win = blk + ALIGN
+    nsub = -(-win // SUB)                  # python int
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (1, cols), 1)
+    row_w = jax.lax.broadcasted_iota(jnp.int32, (win, 1), 0)
+    # per-sub-tile constants
+    tri = {}
+    for rows in {SUB, win - (nsub - 1) * SUB}:
+        t = (jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0)
+             <= jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1))
+        tri[rows] = jnp.where(t, jnp.float32(1), 0.0).astype(jnp.bfloat16)
+    dst_iota = jax.lax.broadcasted_iota(jnp.int32, (1, SUB + ALIGN), 1)
+    mrow = jax.lax.broadcasted_iota(jnp.int32, (SUB + ALIGN, 1), 0)
+    grow = jax.lax.broadcasted_iota(jnp.int32, (ALIGN, 1), 0)
+
+    def in_dma(slot, src_hbm, base, i):
+        start = pl.multiple_of(base + i * blk, ALIGN)
+        return pltpu.make_async_copy(
+            src_hbm.at[pl.ds(start, win), :], inbuf.at[slot],
+            sems.at[slot])
+
+    def stage_append(stage, sub_rows_bf, sel, t_level, rows: int):
+        """Stable-append sel rows of one sub-tile to a staging stream
+        at fill level t_level. Returns new fill level."""
+        cs = jax.lax.dot_general(
+            tri[rows], sel.astype(jnp.float32).astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [rows,1] incl
+        n = cs[rows - 1, 0].astype(jnp.int32)
+        al = pl.multiple_of((t_level // ALIGN) * ALIGN, ALIGN)
+        rel = t_level - al
+        slot = jnp.where(sel > 0, rel + cs.astype(jnp.int32) - 1, -1)
+        # one-hot [rows, SUB+ALIGN]: dst position within the window
+        pt = jnp.where(slot == dst_iota, jnp.float32(1),
+                       jnp.float32(0)).astype(jnp.bfloat16)
+        staged = jax.lax.dot_general(
+            pt, sub_rows_bf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [SUB+ALIGN, C]
+        old = stage[pl.ds(al, SUB + ALIGN), :]
+        keep = (mrow >= rel) & (mrow < rel + n)
+        stage[pl.ds(al, SUB + ALIGN), :] = jnp.where(keep, staged, old)
+        return t_level + n
+
+    def flush_chunk(stage, t_level, w0, dst_hbm, sem):
+        """If the stream holds >= blk rows, DMA-write the first blk
+        (8-aligned at both ends) and slide the stage down."""
+        do = t_level >= blk
+
+        @pl.when(do)
+        def _():
+            u8buf[...] = stage[0:blk, :].astype(jnp.uint8)
+            cp = pltpu.make_async_copy(
+                u8buf, dst_hbm.at[pl.ds(pl.multiple_of(w0, ALIGN), blk),
+                                  :], sem)
+            cp.start()
+            cp.wait()
+            stage[0:blk + 2 * ALIGN, :] = \
+                stage[blk:2 * blk + 2 * ALIGN, :]
+
+        return (jnp.where(do, t_level - blk, t_level),
+                jnp.where(do, w0 + blk, w0))
+
+    def drain(stage, t_level, w0, dst_hbm, sem, merge_tail: bool):
+        """Write out all remaining rows: whole granules as pure writes,
+        then (merge_tail) one read-merge-write for the partial
+        granule, or a full-granule write when the tail is scratch."""
+        ngran = t_level // ALIGN
+
+        def gbody(g, _):
+            gran8[...] = stage[pl.ds(g * ALIGN, ALIGN), :].astype(
+                jnp.uint8)
+            cp = pltpu.make_async_copy(
+                gran8, dst_hbm.at[pl.ds(
+                    pl.multiple_of(w0, ALIGN) + g * ALIGN, ALIGN), :],
+                sem)
+            cp.start()
+            cp.wait()
+            return 0
+
+        jax.lax.fori_loop(0, ngran, gbody, 0)
+        rem = t_level - ngran * ALIGN
+
+        @pl.when(rem > 0)
+        def _():
+            tail_start = pl.multiple_of(w0, ALIGN) + ngran * ALIGN
+            if merge_tail:
+                cp = pltpu.make_async_copy(
+                    dst_hbm.at[pl.ds(tail_start, ALIGN), :], gran8, sem)
+                cp.start()
+                cp.wait()
+                old = gran8[...].astype(jnp.int32)
+            else:
+                old = jnp.zeros((ALIGN, cols), jnp.int32)
+            new = stage[pl.ds(ngran * ALIGN, ALIGN), :].astype(jnp.int32)
+            gran8[...] = jnp.where(grow < rem, new, old).astype(jnp.uint8)
+            cp = pltpu.make_async_copy(
+                gran8, dst_hbm.at[pl.ds(tail_start, ALIGN), :], sem)
+            cp.start()
+            cp.wait()
+
+    # ---- init: left stream continues the granule containing `begin`;
+    # right stream starts 0-aligned in the workspace
+    l_base0 = (begin // ALIGN) * ALIGN
+    shift = begin - l_base0
+    cp0 = pltpu.make_async_copy(
+        mat_hbm.at[pl.ds(pl.multiple_of(l_base0, ALIGN), ALIGN), :],
+        gran8, sems.at[2])
+    cp0.start()
+    cp0.wait()
+    stage_l[0:ALIGN, :] = gran8[...].astype(jnp.float32)
+
+    nblk1 = pl.cdiv(count, blk)
+
+    @pl.when(nblk1 > 0)
+    def _():
+        in_dma(0, mat_hbm, l_base0, 0).start()
+
+    def decide(mat_i32):
+        fsel = jnp.where(lane_w == feat, 1, 0)
+        bv = jnp.sum(mat_i32 * fsel, axis=1, keepdims=True)  # [win,1]
+        is_missing = jnp.where(
+            miss == MISSING_ZERO_CODE,
+            jnp.where(bv == defbin, 1, 0),
+            jnp.where(miss == MISSING_NAN_CODE,
+                      jnp.where(bv == nbins - 1, 1, 0), 0))
+        num_left = is_missing * dleft \
+            + (1 - is_missing) * jnp.where(bv <= thr, 1, 0)
+        onehot = jnp.where(
+            bv == jax.lax.broadcasted_iota(jnp.int32, (win, 256), 1),
+            jnp.float32(1), jnp.float32(0)).astype(jnp.bfloat16)
+        cat_left = jnp.where(jax.lax.dot_general(
+            onehot, lut_ref[...].reshape(256, 1).astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) > 0.5, 1, 0)
+        return jnp.where(iscat > 0, cat_left, num_left)
+
+    def block1(k, carry):
+        t_l, w_l, t_r, w_r = carry
+        slot = jax.lax.rem(k, 2)
+
+        @pl.when(k + 1 < nblk1)
+        def _():
+            in_dma(1 - slot, mat_hbm, l_base0, k + 1).start()
+
+        in_dma(slot, mat_hbm, l_base0, k).wait()
+        mat_i32 = inbuf[slot].astype(jnp.int32)
+        mat_bf = mat_i32.astype(jnp.float32).astype(jnp.bfloat16)
+        rem = jnp.minimum(count - k * blk, blk)
+        valid = jnp.where((row_w >= shift) & (row_w < shift + rem), 1, 0)
+        go_left = decide(mat_i32)
+        sel_l = (valid * go_left).astype(jnp.float32)
+        sel_r = (valid * (1 - go_left)).astype(jnp.float32)
+        for s in range(nsub):
+            rows = min(SUB, win - s * SUB)
+            sub_bf = mat_bf[s * SUB:s * SUB + rows, :]
+            t_l = stage_append(stage_l, sub_bf,
+                               sel_l[s * SUB:s * SUB + rows], t_l, rows)
+            t_r = stage_append(stage_r, sub_bf,
+                               sel_r[s * SUB:s * SUB + rows], t_r, rows)
+        t_l, w_l = flush_chunk(stage_l, t_l, w_l, mat_hbm, sems.at[2])
+        t_r, w_r = flush_chunk(stage_r, t_r, w_r, ws_hbm, sems.at[2])
+        return t_l, w_l, t_r, w_r
+
+    t_l, w_l, t_r, w_r = jax.lax.fori_loop(
+        0, nblk1, block1, (shift, l_base0, jnp.int32(0), jnp.int32(0)))
+
+    nl_total = (w_l + t_l) - begin
+    nl_ref[0, 0] = nl_total
+    nr_total = count - nl_total
+
+    # rights staging -> workspace (beyond-the-end rows are scratch, so
+    # plain granule writes suffice)
+    drain(stage_r, t_r, w_r, ws_hbm, sems.at[2], merge_tail=False)
+
+    # ---- phase 2: stream rights from the workspace into the left
+    # stream's tail (pure shifted copy through the same staging)
+    nblk2 = pl.cdiv(nr_total, blk)
+
+    @pl.when(nblk2 > 0)
+    def _():
+        in_dma(0, ws_hbm, 0, 0).start()
+
+    def block2(j, carry):
+        t_l, w_l = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk2)
+        def _():
+            in_dma(1 - slot, ws_hbm, 0, j + 1).start()
+
+        in_dma(slot, ws_hbm, 0, j).wait()
+        mat_bf = inbuf[slot].astype(jnp.int32).astype(
+            jnp.float32).astype(jnp.bfloat16)
+        cnt_j = jnp.minimum(nr_total - j * blk, blk)
+        sel = jnp.where((row_w >= 0) & (row_w < cnt_j), 1.0, 0.0)
+        for s in range(nsub):
+            rows = min(SUB, win - s * SUB)
+            t_l = stage_append(stage_l, mat_bf[s * SUB:s * SUB + rows, :],
+                               sel[s * SUB:s * SUB + rows], t_l, rows)
+        t_l, w_l = flush_chunk(stage_l, t_l, w_l, mat_hbm, sems.at[2])
+        return t_l, w_l
+
+    t_l, w_l = jax.lax.fori_loop(0, nblk2, block2, (t_l, w_l))
+    drain(stage_l, t_l, w_l, mat_hbm, sems.at[2], merge_tail=True)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("blk", "interpret"))
+def partition_segment_v2(mat, ws, begin, count, feat, thr, default_left,
+                         missing_code, default_bin, num_bins_f, is_cat,
+                         cat_lut, *, blk: int = 2048,
+                         interpret: bool = False):
+    """Drop-in for ``partition_pallas.partition_segment`` (v2 design,
+    see module docstring)."""
+    if blk % SUB:
+        raise ValueError(f"blk must be a multiple of {SUB}")
+    _, cols = mat.shape
+    to32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
+    scal = jnp.stack([
+        to32(begin), to32(count), to32(feat), to32(thr),
+        to32(default_left), to32(missing_code), to32(default_bin),
+        to32(num_bins_f), to32(is_cat)])
+    kernel = functools.partial(_partition_kernel_v2, blk=blk, cols=cols)
+    win = blk + ALIGN
+    mat2, ws2, nl = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(mat.shape, jnp.uint8),
+            jax.ShapeDtypeStruct(ws.shape, jnp.uint8),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, win, cols), jnp.uint8),               # inbuf
+            pltpu.VMEM((2 * blk + 2 * ALIGN + SUB, cols),
+                       jnp.float32),                             # stage_l
+            pltpu.VMEM((2 * blk + 2 * ALIGN + SUB, cols),
+                       jnp.float32),                             # stage_r
+            pltpu.VMEM((blk, cols), jnp.uint8),                  # u8buf
+            pltpu.VMEM((ALIGN, cols), jnp.uint8),                # gran8
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(scal, cat_lut, mat, ws)
+    return mat2, ws2, nl.reshape(1)

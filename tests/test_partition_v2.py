@@ -1,0 +1,100 @@
+"""Sub-tiled partition kernel (v2) vs oracle + v1, interpret mode."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.hist_pallas import (build_matrix, extract_row_ids,
+                                          pack_gh)
+from lightgbm_tpu.ops.partition_pallas import (bitset_to_lut,
+                                               partition_segment)
+from lightgbm_tpu.ops.partition_pallas_v2 import partition_segment_v2
+
+
+def _mk(n, f, b, seed=0):
+    rng = np.random.RandomState(seed)
+    binned = rng.randint(0, b, (n, f)).astype(np.uint8)
+    mat = build_matrix(jnp.asarray(binned), 2048)
+    mat = pack_gh(mat, f, jnp.asarray(rng.randn(n).astype(np.float32)),
+                  jnp.asarray(rng.rand(n).astype(np.float32)),
+                  jnp.asarray(np.ones(n, np.float32)))
+    return binned, mat
+
+
+@pytest.mark.parametrize("begin,count", [
+    (0, 3000), (8, 2992), (13, 2048), (517, 997), (2989, 11), (5, 3)])
+def test_v2_matches_oracle_numerical(begin, count):
+    n, f, b = 3000, 7, 64
+    binned, mat = _mk(n, f, b)
+    col, thr = 3, 30
+    lut = jnp.zeros((1, 256), jnp.float32)
+    args = (jnp.int32(begin), jnp.int32(count), jnp.int32(col),
+            jnp.int32(thr), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            jnp.int32(b), jnp.int32(0), lut)
+    m2, _, nl = partition_segment_v2(mat, jnp.zeros_like(mat), *args,
+                                     blk=256, interpret=True)
+    sl = slice(begin, begin + count)
+    go_left = binned[sl, col] <= thr
+    assert int(nl[0]) == int(go_left.sum())
+    rid = np.asarray(extract_row_ids(m2, f, mat.shape[0]))
+    rid_orig = np.arange(n)
+    # stability: left rows in original order, then right rows in order
+    want = np.concatenate([rid_orig[sl][go_left], rid_orig[sl][~go_left]])
+    np.testing.assert_array_equal(rid[sl], want)
+    # rows outside the segment untouched
+    np.testing.assert_array_equal(rid[:begin], rid_orig[:begin])
+    np.testing.assert_array_equal(rid[begin + count:n],
+                                  rid_orig[begin + count:n])
+    # full payload bytes preserved (not just ids)
+    m1, _, nl1 = partition_segment(mat, jnp.zeros_like(mat), *args,
+                                   blk=512, interpret=True)
+    assert int(nl1[0]) == int(nl[0])
+    np.testing.assert_array_equal(np.asarray(m2)[:n], np.asarray(m1)[:n])
+
+
+def test_v2_missing_and_categorical():
+    n, f, b = 2000, 5, 32
+    binned, mat = _mk(n, f, b, seed=3)
+    # NaN-missing: bin b-1 is the NaN bin, default_left=1
+    col = 2
+    args = (jnp.int32(100), jnp.int32(1500), jnp.int32(col),
+            jnp.int32(10), jnp.int32(1), jnp.int32(2), jnp.int32(0),
+            jnp.int32(b), jnp.int32(0), jnp.zeros((1, 256), jnp.float32))
+    m2, _, nl = partition_segment_v2(mat, jnp.zeros_like(mat), *args,
+                                     blk=256, interpret=True)
+    sl = slice(100, 1600)
+    bv = binned[sl, col]
+    go_left = np.where(bv == b - 1, True, bv <= 10)
+    assert int(nl[0]) == int(go_left.sum())
+
+    # categorical via bitset LUT
+    cats = np.array([1, 7, 19], np.int64)
+    bits = np.zeros(8, np.uint32)
+    for cv in cats:
+        bits[cv // 32] |= np.uint32(1) << np.uint32(cv % 32)
+    lut = bitset_to_lut(jnp.asarray(bits))
+    args = (jnp.int32(0), jnp.int32(n), jnp.int32(col), jnp.int32(0),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(b),
+            jnp.int32(1), lut)
+    m3, _, nl3 = partition_segment_v2(mat, jnp.zeros_like(mat), *args,
+                                      blk=256, interpret=True)
+    left = np.isin(binned[:, col], cats)
+    assert int(nl3[0]) == int(left.sum())
+    rid = np.asarray(extract_row_ids(m3, f, mat.shape[0]))[:n]
+    np.testing.assert_array_equal(
+        rid, np.concatenate([np.arange(n)[left], np.arange(n)[~left]]))
+
+
+def test_v2_all_one_side():
+    n, f, b = 1500, 4, 16
+    binned, mat = _mk(n, f, b, seed=5)
+    lut = jnp.zeros((1, 256), jnp.float32)
+    for thr, side in [(b, "left"), (-1, "right")]:
+        m2, _, nl = partition_segment_v2(
+            mat, jnp.zeros_like(mat), jnp.int32(11), jnp.int32(1200),
+            jnp.int32(1), jnp.int32(thr), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0), jnp.int32(b), jnp.int32(0), lut,
+            blk=256, interpret=True)
+        assert int(nl[0]) == (1200 if side == "left" else 0)
+        rid = np.asarray(extract_row_ids(m2, f, mat.shape[0]))
+        np.testing.assert_array_equal(rid[:1500], np.arange(1500))

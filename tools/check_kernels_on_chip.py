@@ -101,6 +101,101 @@ def main() -> int:
                   f"{'ok ' if ok else 'FAIL'} left={int(nl_c[0])}/{nl_o}")
             failures += 0 if ok else 1
 
+    # partition v2 (sub-tiled staging, ops/partition_pallas_v2.py):
+    # COMPILED membership/stability check — the double-buffered DMA
+    # overlap and granule-flush behavior only exist compiled, so this
+    # is the promotion gate for LGBM_TPU_PART_V2
+    from lightgbm_tpu.ops.partition_pallas_v2 import (
+        partition_segment_v2, pick_blk)
+    for n, f, b in [(20000, 28, 256), (5000, 12, 64)]:
+        binned = rng.randint(0, b, (n, f))
+        mat = build_matrix(jnp.asarray(binned), 2048)
+        mat = pack_gh(mat, f, jnp.asarray(rng.randn(n).astype(np.float32)),
+                      jnp.asarray(rng.rand(n).astype(np.float32) + 0.1),
+                      jnp.asarray(np.ones(n, np.float32)))
+        col, thr = f // 2, b // 2
+        lut = jnp.zeros((1, 256), jnp.float32)
+        blk = pick_blk(mat.shape[1])
+        for begin, count in [(0, n), (13, n - 13), (1234, 2048),
+                             (n - 517, 517)]:
+            m_c, _, nl_c = partition_segment_v2(
+                mat, jnp.zeros_like(mat), jnp.int32(begin),
+                jnp.int32(count), col, jnp.int32(thr), jnp.int32(0),
+                jnp.int32(0), jnp.int32(0), jnp.int32(b), jnp.int32(0),
+                lut, blk=blk, interpret=False)
+            sl = slice(begin, begin + count)
+            go_left = binned[sl, col] <= thr
+            nl_o = int(go_left.sum())
+            rid_seg = np.asarray(
+                extract_row_ids(m_c, f, mat.shape[0]))[sl]
+            rid_orig = np.arange(n)[sl]
+            want = np.concatenate([rid_orig[go_left],
+                                   rid_orig[~go_left]])
+            ok = (int(nl_c[0]) == nl_o
+                  and np.array_equal(rid_seg[:count], want))
+            print(f"partition-v2 [{n}x{f} blk={blk}] "
+                  f"seg=({begin},{count}): "
+                  f"{'ok ' if ok else 'FAIL'} left={int(nl_c[0])}/{nl_o}")
+            failures += 0 if ok else 1
+
+    # fused split-scan kernel (ops/split_scan_pallas.py): compiled vs
+    # the XLA reference scan — validates the Mosaic lowering (cumsum
+    # lane-shift ladder, SMEM scalars, [F, 8] packed output) that CI
+    # only exercises in interpret mode
+    from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams,
+                                        per_feature_numerical)
+    from lightgbm_tpu.ops.split_scan_pallas import \
+        per_feature_numerical_pallas
+    for f, b, any_missing in [(28, 256, False), (11, 64, True)]:
+        meta = FeatureMeta(
+            num_bins=jnp.asarray(rng.randint(3, b, f), jnp.int32),
+            missing=jnp.asarray(
+                rng.randint(0, 3 if any_missing else 1, f), jnp.int32),
+            default_bin=jnp.asarray(rng.randint(0, 5, f), jnp.int32),
+            most_freq_bin=jnp.zeros(f, jnp.int32),
+            monotone=jnp.zeros(f, jnp.int32),
+            penalty=jnp.ones(f, jnp.float32),
+            is_categorical=jnp.zeros(f, bool),
+            global_id=jnp.arange(f, dtype=jnp.int32))
+        params = SplitParams(
+            lambda_l1=0.0, lambda_l2=0.5, max_delta_step=0.0,
+            min_data_in_leaf=5.0, min_sum_hessian_in_leaf=1e-3,
+            min_gain_to_split=0.0, any_missing=any_missing,
+            use_scan_kernel=True)
+        hist = np.zeros((f, b, 3), np.float32)
+        for j in range(f):
+            nb = int(meta.num_bins[j])
+            hist[j, :nb, 2] = rng.randint(0, 50, nb)
+            hist[j, :nb, 0] = rng.randn(nb) * hist[j, :nb, 2]
+            hist[j, :nb, 1] = np.abs(rng.randn(nb)) * hist[j, :nb, 2]
+        pg, ph, pc = (float(hist[0, :, j].sum()) for j in range(3))
+        args = (jnp.asarray(hist), jnp.float32(pg), jnp.float32(ph),
+                jnp.float32(pc), meta, params, jnp.float32(-np.inf),
+                jnp.float32(np.inf), jnp.ones(f, bool))
+        ref = per_feature_numerical(*args)
+        got = per_feature_numerical_pallas(*args)  # compiled on chip
+        # the production path always calls the kernel under jax.vmap
+        # (scan_children) — check the compiled BATCHED lowering too
+        gotv = jax.vmap(lambda hh: per_feature_numerical_pallas(
+            hh, *args[1:]))(jnp.stack([args[0], args[0] * 0.5]))
+        sc_r, sc_g = np.asarray(ref.score), np.asarray(got.score)
+        sc_v = np.asarray(gotv.score)[0]
+        fin = np.isfinite(sc_r)
+        ok = (np.array_equal(fin, np.isfinite(sc_g))
+              and np.allclose(sc_g[fin], sc_r[fin], rtol=5e-5,
+                              atol=1e-3)
+              and np.array_equal(fin, np.isfinite(sc_v))
+              and np.allclose(sc_v[fin], sc_r[fin], rtol=5e-5,
+                              atol=1e-3))
+        thr_agree = float((np.asarray(ref.threshold)
+                           == np.asarray(got.threshold))[fin].mean()) \
+            if fin.any() else 1.0
+        ok = ok and thr_agree > 0.9
+        print(f"split-scan [F={f} B={b} missing={any_missing}] "
+              f"compiled-vs-xla (+vmap): {'ok ' if ok else 'FAIL'} "
+              f"thr_agree={thr_agree:.2f}")
+        failures += 0 if ok else 1
+
     print("PASS" if failures == 0 else f"{failures} FAILURES")
     return 0 if failures == 0 else 1
 

@@ -88,37 +88,45 @@ def main():
         print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
               f"({count/per/1e6:8.1f} Mrow/s)")
 
-    # 3. chained partition_segment
-    def chain_part(m, w, count):
-        lut = jnp.zeros((1, 256), jnp.float32)
-        def body(i, carry):
-            m2, w2, acc = carry
-            m3, w3, nl = pp.partition_segment(
-                m2, w2, jnp.int32(0), count, jnp.int32(3), jnp.int32(128),
-                jnp.int32(1), jnp.int32(0), jnp.int32(0), jnp.int32(b),
-                jnp.int32(0), lut, blk=512, interpret=False)
-            return m3, w3, acc + nl[0]
-        _, _, acc = jax.lax.fori_loop(0, k_chain, body,
-                                      (m, w, jnp.int32(0)))
-        return acc
-    chain_part_j = jax.jit(chain_part, donate_argnums=(0, 1))
+    # 3. chained partition_segment: v1 vs v2 (sub-tiled)
+    from lightgbm_tpu.ops import partition_pallas_v2 as pp2
 
-    print(f"partition_segment, {k_chain}x chained in one jit:")
-    for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
-        m2 = jnp.array(mat)  # fresh donation each measure
-        w2 = jnp.array(ws)
-        for _ in range(1):
+    def mk_chain_part(fn, blk):
+        def chain_part(m, w, count):
+            lut = jnp.zeros((1, 256), jnp.float32)
+            def body(i, carry):
+                m2, w2, acc = carry
+                # thr varies with the carry so no call can be folded
+                thr = jnp.int32(120) + acc % 8
+                m3, w3, nl = fn(
+                    m2, w2, jnp.int32(0), count, jnp.int32(3), thr,
+                    jnp.int32(1), jnp.int32(0), jnp.int32(0),
+                    jnp.int32(b), jnp.int32(0), lut, blk=blk,
+                    interpret=False)
+                return m3, w3, acc + nl[0]
+            _, _, acc = jax.lax.fori_loop(0, k_chain, body,
+                                          (m, w, jnp.int32(0)))
+            return acc
+        return jax.jit(chain_part, donate_argnums=(0, 1))
+
+    for tag, fn, blk in (("v1 blk=512", pp.partition_segment, 512),
+                         ("v2 blk=2048", pp2.partition_segment_v2, 2048)):
+        chain_part_j = mk_chain_part(fn, blk)
+        print(f"partition_segment {tag}, {k_chain}x chained in one jit:")
+        for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
+            m2 = jnp.array(mat)  # fresh donation each measure
+            w2 = jnp.array(ws)
             r = chain_part_j(m2, w2, jnp.int32(count))
-        jax.block_until_ready(r)
-        m2 = jnp.array(mat)
-        w2 = jnp.array(ws)
-        t0 = time.perf_counter()
-        r = chain_part_j(m2, w2, jnp.int32(count))
-        jax.block_until_ready(r)
-        t = time.perf_counter() - t0
-        per = t / k_chain
-        print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
-              f"({count/per/1e6:8.1f} Mrow/s)")
+            jax.block_until_ready(r)
+            m2 = jnp.array(mat)
+            w2 = jnp.array(ws)
+            t0 = time.perf_counter()
+            r = chain_part_j(m2, w2, jnp.int32(count))
+            jax.block_until_ready(r)
+            t = time.perf_counter() - t0
+            per = t / k_chain
+            print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
+                  f"({count/per/1e6:8.1f} Mrow/s)")
 
     # 4. chained best-split scan
     from lightgbm_tpu.learner.serial import (feature_meta_from_dataset,
@@ -147,7 +155,37 @@ def main():
         return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
     chain_scan_j = jax.jit(chain_scan)
     t = timeit(chain_scan_j, hist)
-    print(f"best_split scan chained: {t/k_chain*1e3:8.3f} ms/call")
+    print(f"best_split scan (XLA) chained: {t/k_chain*1e3:8.3f} ms/call")
+
+    # 5. fused Pallas scan kernel, same chaining
+    from lightgbm_tpu.ops.split_scan_pallas import \
+        per_feature_numerical_pallas
+    pk = params._replace(use_scan_kernel=True)
+
+    def chain_scan_pl(hh):
+        def body(i, acc):
+            pf = per_feature_numerical_pallas(
+                hh + acc * 1e-9, jnp.float32(100.0), jnp.float32(200.0),
+                jnp.float32(4096.0), meta, pk, -inf, inf, fm)
+            return acc + pf.score.max()
+        return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
+    chain_scan_pl_j = jax.jit(chain_scan_pl)
+    t = timeit(chain_scan_pl_j, hist)
+    print(f"best_split scan (Pallas) chained: {t/k_chain*1e3:8.3f} ms/call")
+
+    # 6. both-children vmapped Pallas scan (the grow-loop shape)
+    def chain_scan_pl2(hh2):
+        def body(i, acc):
+            pf = jax.vmap(lambda hh: per_feature_numerical_pallas(
+                hh + acc * 1e-9, jnp.float32(100.0), jnp.float32(200.0),
+                jnp.float32(4096.0), meta, pk, -inf, inf, fm))(hh2)
+            return acc + pf.score.max()
+        return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
+    chain_scan_pl2_j = jax.jit(chain_scan_pl2)
+    hist2 = jnp.stack([hist, hist * 0.5])
+    t = timeit(chain_scan_pl2_j, hist2)
+    print(f"both-children scan (Pallas vmap) chained: "
+          f"{t/k_chain*1e3:8.3f} ms/call-pair")
 
 
 if __name__ == "__main__":
