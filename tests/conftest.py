@@ -17,8 +17,14 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# cap CPU codegen at AVX2: XLA's host-feature detection in this VM
+# reports ISA extensions (AVX512/AMX families) the host cannot actually
+# execute, and the generated code then dies with SIGILL/SIGSEGV inside
+# backend_compile_and_load on big programs. AVX2 is universally safe.
+if "xla_cpu_max_isa" not in flags:
+    flags = (flags + " --xla_cpu_max_isa=AVX2").strip()
+os.environ["XLA_FLAGS"] = flags
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # NO persistent compile cache for the CPU suite: XLA:CPU AOT cache
 # entries embed a target-machine feature set that does not reliably
