@@ -38,6 +38,7 @@ from ..ops.hist_pallas import (build_matrix, extract_row_ids,
                                histogram_segment, pack_gh)
 from ..ops.partition_pallas import bitset_to_lut
 from ..ops.partition_pallas import partition_segment as _partition_v1
+from ..ops.split_scan_pallas import scan_kernel_default as _scan_default
 
 # opt-in sub-tiled partition kernel (ops/partition_pallas_v2.py);
 # flipped by env until validated on hardware, then becomes the default.
@@ -107,7 +108,7 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
                 dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
                 for i in range(dataset.num_features)),
             any_missing=dataset_any_missing(dataset),
-            use_scan_kernel=not interpret)
+            use_scan_kernel=not interpret and _scan_default())
         _, _, group_bins = dataset.bundle_maps()
         self.num_bins_max = max(
             int(dataset.num_bins_array().max(initial=2)),

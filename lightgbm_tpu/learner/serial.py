@@ -41,6 +41,8 @@ from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
                          MISSING_ZERO_CODE, FeatureMeta, SplitParams,
                          _argmax_first, assemble_split,
                          per_feature_splits)
+from ..ops.split_scan_pallas import \
+    scan_kernel_default as _scan_kernel_default
 
 _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
                  MISSING_ZERO: MISSING_ZERO_CODE,
@@ -655,7 +657,7 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
             # learner/partitioned.py rationale; scans are
             # collective-free in every comm, so the mesh learners
             # built on this base get it too)
-            use_scan_kernel=jax.default_backend() in ("tpu", "axon"))
+            use_scan_kernel=_scan_kernel_default())
         self.binned = jnp.asarray(dataset.binned)
         # multi-val pseudo-groups (no physical column; bundling.py)
         self.mv_slots = dataset.mv_slots_device

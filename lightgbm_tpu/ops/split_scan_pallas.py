@@ -34,6 +34,7 @@ grow loop scans both fresh children in one call, learner/serial.py
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -223,6 +224,16 @@ def _scan_call(scal, imeta, fmeta, hg, hh, hc, *, params: SplitParams,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
     )(scal, imeta, fmeta, hg, hh, hc)
+
+
+def scan_kernel_default() -> bool:
+    """Learner-level default for SplitParams.use_scan_kernel: compiled
+    backend AND not disabled by the LGBM_TPU_NO_SCAN_KERNEL kill
+    switch (escape hatch if a Mosaic release rejects the kernel;
+    any non-empty value disables, like LGBM_TPU_NO_NATIVE)."""
+    if os.environ.get("LGBM_TPU_NO_SCAN_KERNEL"):
+        return False
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def scan_kernel_ok(params: SplitParams, rand_bins, cegb_uncharged) -> bool:
