@@ -77,6 +77,10 @@ def main():
         return 2
 
     ok = []
+    ok.append(run("micro_kernel_bench",
+                  [sys.executable, "tools/micro_kernel_bench.py",
+                   "500000"],
+                  min(900, left())))
     ok.append(run("profile_tree",
                   [sys.executable, "tools/profile_tree.py", "500000"],
                   min(900, left())))
@@ -89,9 +93,19 @@ def main():
     # exhausted budget means a fast kill, not a 300 s floor overrun)
     ok.append(run("bench", [sys.executable, "bench.py"],
                   max(min(bench_budget + 120.0, left()), 60.0), env))
-    ok.append(run("check_kernels",
-                  [sys.executable, "tools/check_kernels_on_chip.py"],
-                  min(600, max(left() - 900, 120))))
+    kernels_ok = run("check_kernels",
+                     [sys.executable, "tools/check_kernels_on_chip.py"],
+                     min(600, max(left() - 900, 120)))
+    ok.append(kernels_ok)
+    if kernels_ok and left() > 900:
+        # compiled v2 partition validated -> measure it end-to-end at
+        # the 500k point for a direct v1-vs-v2 comparison
+        envp = dict(os.environ)
+        envp["LGBM_TPU_PART_V2"] = "1"
+        envp["BENCH_ROWS"] = "500000"
+        envp["BENCH_BUDGET_S"] = "600"
+        ok.append(run("bench_part_v2", [sys.executable, "bench.py"],
+                      min(700.0, left()), envp))
     env2 = dict(os.environ)
     sweep_budget = int(max(left() - 120.0, 300.0))
     env2["BENCH_BUDGET_S"] = str(sweep_budget)
