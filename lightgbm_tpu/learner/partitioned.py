@@ -103,12 +103,15 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
         # Scan calls are collective-free in every comm (collectives
         # wrap the scan, never sit inside it), so this is safe for the
         # mesh learners too.
-        self.params = split_params_from_config(config)._replace(
-            has_categorical=any(
-                dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
-                for i in range(dataset.num_features)),
+        base_params = split_params_from_config(config)
+        has_cat = any(
+            dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
+            for i in range(dataset.num_features))
+        self.params = base_params._replace(
+            has_categorical=has_cat,
             any_missing=dataset_any_missing(dataset),
-            use_scan_kernel=not interpret and _scan_default())
+            use_scan_kernel=not interpret and _scan_default(
+                eligible=not has_cat and not base_params.cegb_on))
         _, _, group_bins = dataset.bundle_maps()
         self.num_bins_max = max(
             int(dataset.num_bins_array().max(initial=2)),

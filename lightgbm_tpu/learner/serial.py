@@ -648,16 +648,20 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
         self.config = config
         self._init_node_rand(dataset, config)
         self.meta = feature_meta_from_dataset(dataset, config)
-        self.params = split_params_from_config(config)._replace(
-            has_categorical=any(
-                dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
-                for i in range(dataset.num_features)),
+        base_params = split_params_from_config(config)
+        has_cat = any(
+            dataset.feature_mapper(i).bin_type == BIN_TYPE_CATEGORICAL
+            for i in range(dataset.num_features))
+        self.params = base_params._replace(
+            has_categorical=has_cat,
             any_missing=dataset_any_missing(dataset),
             # fused Pallas split scan on compiled backends (see
             # learner/partitioned.py rationale; scans are
             # collective-free in every comm, so the mesh learners
-            # built on this base get it too)
-            use_scan_kernel=_scan_kernel_default())
+            # built on this base get it too). Ineligible configs
+            # (categorical/CEGB) skip the probe compile entirely.
+            use_scan_kernel=_scan_kernel_default(
+                eligible=not has_cat and not base_params.cegb_on))
         self.binned = jnp.asarray(dataset.binned)
         # multi-val pseudo-groups (no physical column; bundling.py)
         self.mv_slots = dataset.mv_slots_device

@@ -226,14 +226,84 @@ def _scan_call(scal, imeta, fmeta, hg, hh, hc, *, params: SplitParams,
     )(scal, imeta, fmeta, hg, hh, hc)
 
 
-def scan_kernel_default() -> bool:
-    """Learner-level default for SplitParams.use_scan_kernel: compiled
-    backend AND not disabled by the LGBM_TPU_NO_SCAN_KERNEL kill
-    switch (escape hatch if a Mosaic release rejects the kernel;
-    any non-empty value disables, like LGBM_TPU_NO_NATIVE)."""
+_PROBE_OK = None
+_PROBE_LOCK = None
+
+
+def _probe_meta(f: int, with_missing: bool):
+    from .split import FeatureMeta
+    zi = jnp.zeros((f,), jnp.int32)
+    missing = zi.at[0].set(MISSING_NAN_CODE).at[1].set(
+        MISSING_ZERO_CODE) if with_missing else zi
+    return FeatureMeta(
+        num_bins=jnp.full((f,), 256, jnp.int32), missing=missing,
+        default_bin=zi, most_freq_bin=zi, monotone=zi,
+        penalty=jnp.ones((f,), jnp.float32),
+        is_categorical=jnp.zeros((f,), bool),
+        global_id=jnp.arange(f, dtype=jnp.int32))
+
+
+def _probe_compile() -> bool:
+    """One-time compile-and-run of BOTH kernel variants (any_missing
+    True/False trace structurally different programs) at the bench
+    shape (28 features x 256 bins). If Mosaic rejects either, every
+    learner silently falls back to the XLA scan — the driver's
+    unattended entry-check/bench must never be bricked by a kernel
+    regression on a new compiler release. Transient device errors
+    (UNAVAILABLE — e.g. a tunnel flake at init) do not pin the verdict;
+    the next learner retries."""
+    global _PROBE_OK, _PROBE_LOCK
+    if _PROBE_LOCK is None:
+        import threading
+        _PROBE_LOCK = threading.Lock()
+    with _PROBE_LOCK:
+        if _PROBE_OK is not None:
+            return _PROBE_OK
+        try:
+            import numpy as np
+            f, b = 28, 256
+            hist = jnp.asarray(
+                np.random.RandomState(0).rand(f, b, 3).astype(
+                    np.float32))
+            for with_missing in (False, True):
+                params = SplitParams(
+                    lambda_l1=0.0, lambda_l2=1.0, max_delta_step=0.0,
+                    min_data_in_leaf=1.0, min_sum_hessian_in_leaf=1e-3,
+                    min_gain_to_split=0.0, any_missing=with_missing,
+                    use_scan_kernel=True)
+                pf = per_feature_numerical_pallas(
+                    hist, jnp.float32(1.0), jnp.float32(100.0),
+                    jnp.float32(200.0), _probe_meta(f, with_missing),
+                    params, jnp.float32(float("-inf")),
+                    jnp.float32(float("inf")), jnp.ones((f,), bool))
+                jax.block_until_ready(pf.score)
+            _PROBE_OK = True
+        except Exception as e:  # noqa: BLE001 - any compile failure
+            from ..utils.log import log_warning
+            log_warning("fused split-scan kernel probe failed on this "
+                        f"backend ({type(e).__name__}); falling back "
+                        "to the XLA scan. Set LGBM_TPU_NO_SCAN_KERNEL=1 "
+                        f"to silence this probe. Error: {str(e)[:300]}")
+            if "UNAVAILABLE" not in str(e):
+                _PROBE_OK = False
+            return False
+    return _PROBE_OK
+
+
+def scan_kernel_default(eligible: bool = True) -> bool:
+    """Learner-level default for SplitParams.use_scan_kernel: the
+    learner could actually use the kernel (pass ``eligible=False`` for
+    categorical/CEGB configs so they skip the probe compile entirely),
+    the backend is compiled, the LGBM_TPU_NO_SCAN_KERNEL kill switch is
+    unset (any non-empty value disables, like LGBM_TPU_NO_NATIVE), and
+    the one-time probe compile succeeded."""
+    if not eligible:
+        return False
     if os.environ.get("LGBM_TPU_NO_SCAN_KERNEL"):
         return False
-    return jax.default_backend() in ("tpu", "axon")
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    return _probe_compile()
 
 
 def scan_kernel_ok(params: SplitParams, rand_bins, cegb_uncharged) -> bool:
