@@ -55,8 +55,12 @@ def measure():
 
     rng = np.random.RandomState(42)
     X = rng.randn(n, f).astype(np.float32)
-    logit = (2.0 * X[:, 0] - 1.5 * X[:, 1] + X[:, 2] * X[:, 3]
-             + 0.8 * X[:, 4] * X[:, 5] - X[:, 6])
+
+    def c(i):
+        return X[:, i % f]   # modulo: BENCH_FEATURES may be < 7
+
+    logit = (2.0 * c(0) - 1.5 * c(1) + c(2) * c(3)
+             + 0.8 * c(4) * c(5) - c(6))
     y = (logit + rng.randn(n).astype(np.float32) > 0).astype(np.float32)
 
     cfg = Config.from_params({
