@@ -11,12 +11,9 @@ import os
 import subprocess
 import sys
 
-import pytest
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.timeout(600)
 def test_bench_child_prints_valid_json_line():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the tunnel
