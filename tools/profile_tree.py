@@ -125,7 +125,11 @@ def main():
             "max_bin": 255, "metric": "", "verbosity": -1})
         ds2 = Dataset.from_numpy(X, cfg2, label=y)
         b2 = GBDT(cfg2, ds2)
-        t = timeit(lambda: b2.train_one_iter(), warmup=1, iters=2)
+        # warmup >= 3: early iterations take distinct compile paths
+        # (boost-from-average iter 0, then the first real grow); with
+        # warmup=1 a leftover compile landed inside the timed region
+        # (the 63-leaf "35 s" outlier in the round-4 log)
+        t = timeit(lambda: b2.train_one_iter(), warmup=3, iters=2)
         print(f"iter @ leaves={nl:>4}:   {t*1e3:9.2f} ms "
               f"({t/(nl-1)*1e3:7.3f} ms/split)")
 
