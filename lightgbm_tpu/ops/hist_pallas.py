@@ -51,6 +51,14 @@ ALIGN = 8          # Mosaic offset granule for u8 2-D row slices
 GH_COLS = 13       # payload columns appended after the features
 RID_OFF = 9        # row-id bytes start at column F + RID_OFF
 
+# Mosaic's default scoped-VMEM budget is 16 MB; the nibble kernel's
+# statically-unrolled group loop stacks ~34 MB of block intermediates
+# at blk=2048 (measured on v5e: "scoped allocation with size 33.93M").
+# v5e has 128 MB of VMEM — raise the ceiling rather than shrink the
+# block (smaller blocks double the DMA count per row).
+VMEM_LIMIT = 100 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
+
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -234,6 +242,7 @@ def histogram_segment_raw(mat, begin, count, *, num_features: int,
             pltpu.VMEM((2, blk + ALIGN, cols), jnp.uint8),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(scal, mat)
 
@@ -379,6 +388,7 @@ def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
             pltpu.VMEM((2, blk + ALIGN, cols), jnp.uint8),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(scal, mat)
     # [NG, (fl, lo, p), (fr, hi)] -> diagonal fl == fr -> [F, B, 3]

@@ -57,11 +57,15 @@ def _scan_kernel(scal_ref, imeta_ref, fmeta_ref, hg_ref, hh_ref, hc_ref,
     g = hg_ref[...]                                  # [F, B] f32
     h = hh_ref[...]
     c = hc_ref[...]
-    pg = scal_ref[0]
-    ph = scal_ref[1]
-    pc = scal_ref[2]
-    cmin = scal_ref[3]
-    cmax = scal_ref[4]
+    # scal is [1, 5]: a 1-D SMEM operand would batch to an illegal
+    # (1, 5)-block-over-(K, 5) spec under vmap (Mosaic requires the
+    # trailing two block dims to equal the array dims); with the
+    # explicit leading 1 the vmapped block (1, 1, 5) stays legal
+    pg = scal_ref[0, 0]
+    ph = scal_ref[0, 1]
+    pc = scal_ref[0, 2]
+    cmin = scal_ref[0, 3]
+    cmax = scal_ref[0, 4]
 
     nb = imeta_ref[:, 0:1]                           # [F, 1] i32
     missing = imeta_ref[:, 1:2]
@@ -271,12 +275,27 @@ def _probe_compile() -> bool:
                     min_data_in_leaf=1.0, min_sum_hessian_in_leaf=1e-3,
                     min_gain_to_split=0.0, any_missing=with_missing,
                     use_scan_kernel=True)
+                meta = _probe_meta(f, with_missing)
                 pf = per_feature_numerical_pallas(
                     hist, jnp.float32(1.0), jnp.float32(100.0),
-                    jnp.float32(200.0), _probe_meta(f, with_missing),
+                    jnp.float32(200.0), meta,
                     params, jnp.float32(float("-inf")),
                     jnp.float32(float("inf")), jnp.ones((f,), bool))
                 jax.block_until_ready(pf.score)
+                # the grow loop calls the kernel VMAPPED over both fresh
+                # children (learner/serial.py scan_children); vmap
+                # rewrites the block specs, so an unbatched compile
+                # passing does NOT imply the batched one does — probe
+                # the exact form the learner runs
+                pf2 = jax.vmap(
+                    lambda hh, g_: per_feature_numerical_pallas(
+                        hh, g_, jnp.float32(100.0), jnp.float32(200.0),
+                        meta, params, jnp.float32(float("-inf")),
+                        jnp.float32(float("inf")),
+                        jnp.ones((f,), bool)))(
+                    jnp.stack([hist, hist]),
+                    jnp.asarray([1.0, -1.0], jnp.float32))
+                jax.block_until_ready(pf2.score)
             _PROBE_OK = True
         except Exception as e:  # noqa: BLE001 - any compile failure
             from ..utils.log import log_warning
@@ -327,7 +346,7 @@ def per_feature_numerical_pallas(hist, parent_g, parent_h, parent_c,
         jnp.asarray(parent_h, jnp.float32),
         jnp.asarray(parent_c, jnp.float32),
         jnp.asarray(constraint_min, jnp.float32),
-        jnp.asarray(constraint_max, jnp.float32)])
+        jnp.asarray(constraint_max, jnp.float32)])[None, :]
     imeta = jnp.stack([meta.num_bins, meta.missing, meta.default_bin,
                        meta.monotone], axis=1).astype(jnp.int32)
     fmask = ~meta.is_categorical
