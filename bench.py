@@ -43,9 +43,13 @@ def measure():
     n = int(os.environ.get("BENCH_ROWS", ROWS_PLAN[0]))
     f = int(os.environ.get("BENCH_FEATURES", 28))
     num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
-    warmup = int(os.environ.get("BENCH_WARMUP_ITERS", 2))
     iters = int(os.environ.get("BENCH_ITERS",
-                               3 if n > 2_000_000 else 5))
+                               3 if n > 2_000_000 else 8))
+    # warmup mirrors the measured phase: its first iteration goes
+    # through the sync boost-from-average path, so warmup = iters + 1
+    # leaves the SAME power-of-2 fused-block ladder for both phases and
+    # the timed region never contains a compile even on a cold cache
+    warmup = int(os.environ.get("BENCH_WARMUP_ITERS", iters + 1))
 
     import jax
 
@@ -70,12 +74,18 @@ def measure():
     ds = Dataset.from_numpy(X, cfg, label=y)
     booster = GBDT(cfg, ds)
 
+    from lightgbm_tpu.utils.sync import fetch_one
+
+    def sync():
+        # fetch ONE score element as the real barrier (utils/sync.py)
+        return fetch_one(booster.train_score[:1])
+
     booster.train(warmup)  # compile sync (iter 0) + async paths
-    jax.block_until_ready(booster.train_score)
+    sync()
 
     t0 = time.perf_counter()
     booster.train(warmup + iters)
-    jax.block_until_ready(booster.train_score)
+    sync()
     dt = time.perf_counter() - t0
 
     throughput = n * iters / dt

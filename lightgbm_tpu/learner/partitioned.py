@@ -182,6 +182,32 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
         self._cegb_after_tree(res)
         return res
 
+    # -- fused-scan training hook (models/gbdt.py _train_fused_blocks) --
+    supports_fused_scan = True
+
+    def fused_scan_ok(self) -> bool:
+        """The grow call is RNG-free and state-free per tree, so it can
+        sit inside a lax.scan over boosting iterations (per-tree host
+        PRNG draws or CEGB cross-tree host state would break that)."""
+        return (not self.params.cegb_on and not self.extra_trees
+                and self.ff_bynode >= 1.0
+                and getattr(self, "_cegb_used", None) is None)
+
+    def traceable_grow(self, mat, ws, grad, hess):
+        """One tree grown inside an enclosing trace (no jit boundary,
+        no host state updates). Caller owns the mat/ws carry."""
+        bag = jnp.ones_like(grad)
+        fmask = jnp.ones((self.num_features,), bool)
+        return grow_partitioned(
+            mat, ws, grad, hess, bag, fmask, self.meta,
+            rand_key=None, params=self.params,
+            num_leaves=self.num_leaves, max_depth=self.max_depth,
+            num_bins_max=self.num_bins_max,
+            num_features=self.num_features, num_groups=self.num_groups,
+            n=self.num_data, bundled=self.bundled,
+            interpret=self.interpret, forced_plan=self.forced_plan,
+            cache_hists=self.cache_hists, hist_slots=self.hist_slots)
+
 
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",

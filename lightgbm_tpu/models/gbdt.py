@@ -15,6 +15,8 @@ score update / constant-tree fallback (gbdt.cpp:301-419), RollbackOneIter
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,9 +29,33 @@ from ..metric import create_metrics
 from ..objective import create_objective
 from ..utils.log import (annotate, global_timer, log_fatal, log_info,
                          log_warning, maybe_profile)
-from .tree import DeferredTree, Tree, traverse_tree_arrays
+from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
+                   traverse_tree_arrays)
 
 kEpsilon = 1e-15
+
+
+def _fused_iter_block(mat, ws, score, lr, *, learner, grad_fn, m):
+    """``m`` boosting iterations as one device program (lax.scan over
+    gradients -> grow -> score update). NOT module-jitted: the learner
+    and grad_fn capture device state (training matrix layout, objective
+    label arrays), so each booster wraps this in its OWN jax.jit
+    (``GBDT._train_fused_blocks``) — the compiled-program cache then
+    dies with the booster instead of pinning its device buffers in a
+    process-lifetime module cache."""
+    def body(carry, _):
+        mat, ws, score = carry
+        grad, hess = grad_fn(score[:, 0])
+        mat, ws, tree, leaf_id = learner.traceable_grow(
+            mat, ws, grad, hess)
+        ok = tree.num_leaves > 1
+        scale = jnp.where(ok, lr, jnp.float32(0.0))
+        score = score.at[:, 0].add((tree.leaf_value * scale)[leaf_id])
+        return (mat, ws, score), (tree, ok)
+
+    (mat, ws, score), (trees, oks) = jax.lax.scan(
+        body, (mat, ws, score), None, length=m)
+    return mat, ws, score, trees, oks
 
 
 class GBDT:
@@ -515,6 +541,72 @@ class GBDT:
         del self.models[-n_iters * k:]
         self.iter -= n_iters
 
+    # ------------------------------------------------------------------
+    # Fused-scan path: whole boosting ITERATIONS chained on device.
+    # The async path above still pays ~6-8 host->device dispatches per
+    # iteration (gradients, grow, score-update ops); through the axon
+    # tunnel each dispatch costs ~10-25 ms, a ~165 ms/iteration fixed
+    # tax that dwarfs the device time at bench shapes. Scanning M
+    # iterations inside ONE jitted program (gradients -> grow -> score
+    # update per scan step, stacked TreeArrays out) drops that to one
+    # dispatch + one stop-flag fetch per block.
+    _FUSED_BLOCK = 64
+
+    def _fused_scan_supported(self) -> bool:
+        ln = getattr(self, "learner", None)
+        on_device = jax.default_backend() in ("tpu", "axon") \
+            or os.environ.get("LGBM_TPU_FUSE_ITERS") == "1"
+        return (on_device
+                and self.num_tree_per_iteration == 1
+                and not self.valid_sets
+                # subclasses with their own sampling (GOSS/RF) must go
+                # through the per-iteration path
+                and type(self)._bagging_weight is GBDT._bagging_weight
+                and type(self)._feature_mask is GBDT._feature_mask
+                and getattr(ln, "supports_fused_scan", False)
+                and ln.fused_scan_ok())
+
+    def _train_fused_blocks(self, iters: int) -> None:
+        """Run [self.iter, iters) in <=_FUSED_BLOCK-iteration scanned
+        blocks, one device dispatch per block. Over-run iterations
+        after a no-split stop are zero-contribution no-ops, truncated
+        exactly like the async flush path."""
+        ln = self.learner
+        lr = jnp.float32(self.shrinkage_rate)
+        fused = getattr(self, "_fused_jit", None)
+        if fused is None:
+            fused = jax.jit(
+                functools.partial(_fused_iter_block, learner=ln,
+                                  grad_fn=self._grad_fn),
+                static_argnames=("m",), donate_argnums=(0, 1, 2))
+            self._fused_jit = fused
+        while self.iter < iters:
+            # largest power-of-2 block <= remaining (capped): the set of
+            # compiled scan lengths stays O(log) regardless of how the
+            # caller slices its train() calls, so a warmed persistent
+            # cache covers every phase of a run
+            remaining = iters - self.iter
+            m = self._FUSED_BLOCK
+            while m > remaining:
+                m //= 2
+            with global_timer.scope("boosting"), annotate("boost_block"):
+                ln.mat, ln.ws, self.train_score, trees, oks = fused(
+                    ln.mat, ln.ws, self.train_score, lr, m=m)
+            stack = TreeStack(trees)
+            for j in range(m):
+                self.models.append(DeferredStackTree(
+                    stack, j, ln.dataset,
+                    shrinkage=self.shrinkage_rate))
+            self.iter += m
+            with global_timer.scope("device_sync"):
+                flags = [bool(v) for v in np.asarray(oks)]
+            if not all(flags):
+                self._truncate_surplus(len(flags) - flags.index(False))
+                log_warning(
+                    "Stopped training because there are no more "
+                    "leaves that meet the split requirements")
+                return
+
     def train(self, num_iterations: Optional[int] = None) -> None:
         """Full training loop (GBDT::Train, gbdt.cpp:245-264).
 
@@ -544,6 +636,19 @@ class GBDT:
             or cfg.feature_fraction_bynode < 1.0
         flush_every = 1 if (has_eval or host_rng_per_iter) \
             else self._ASYNC_FLUSH
+        if use_async and not has_eval and not host_rng_per_iter \
+                and self._fused_scan_supported():
+            if not self.models and self.iter < iters:
+                # boost-from-average + constant-tree fallback need the
+                # sync first iteration, exactly like the async path
+                with global_timer.scope("boosting"), \
+                        annotate("boost_iter"):
+                    if self.train_one_iter():
+                        self.finalize_trees()
+                        return
+            self._train_fused_blocks(iters)
+            self.finalize_trees()
+            return
         pending: List = []
         stopped = False
         for it in range(self.iter, iters):

@@ -440,9 +440,46 @@ class DeferredTree:
     def __getattr__(self, name):
         # Tree's private per-node arrays (_missing_code etc.) must also
         # delegate; only this wrapper's own slots terminate the lookup
-        if name in ("_arrays", "_dataset", "_pending_shrink", "_tree"):
+        if name in ("_arrays", "_dataset", "_pending_shrink", "_tree",
+                    "_stack", "_idx"):
             raise AttributeError(name)
         return getattr(self.materialize(), name)
+
+
+class TreeStack:
+    """M trees stacked on their leading axis (the fused-scan training
+    path emits one stacked ``TreeArrays`` per dispatched block). The
+    host pull happens at most ONCE per stack, shared by every
+    ``DeferredStackTree`` that points into it."""
+
+    def __init__(self, arrays: TreeArrays):
+        self.arrays = arrays
+        self._host: Optional[TreeArrays] = None
+
+    def host(self) -> TreeArrays:
+        if self._host is None:
+            self._host = jax.device_get(self.arrays)
+            self.arrays = None
+        return self._host
+
+
+class DeferredStackTree(DeferredTree):
+    """A DeferredTree that materializes by indexing a shared
+    ``TreeStack`` row instead of holding its own device arrays."""
+
+    def __init__(self, stack: TreeStack, idx: int, dataset=None,
+                 shrinkage: float = 1.0):
+        super().__init__(None, dataset, shrinkage)
+        self._stack = stack
+        self._idx = int(idx)
+
+    def materialize(self, host_arrays: Optional[TreeArrays] = None) -> Tree:
+        if self._tree is None and host_arrays is None:
+            h = self._stack.host()
+            host_arrays = jax.tree.map(lambda x: x[self._idx], h)
+        t = super().materialize(host_arrays)
+        self._stack = None
+        return t
 
 
 def traverse_tree_arrays(arrays: TreeArrays, binned_dev, meta,

@@ -195,7 +195,8 @@ def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
         gran8, sems.at[2])
     cp0.start()
     cp0.wait()
-    stage_l[0:ALIGN, :] = gran8[...].astype(jnp.float32)
+    # Mosaic only lowers casts to/from 32-bit types: u8 hops via i32
+    stage_l[0:ALIGN, :] = gran8[...].astype(jnp.int32).astype(jnp.float32)
 
     nblk1 = pl.cdiv(count, blk)
 

@@ -1,0 +1,23 @@
+"""Device synchronization helpers.
+
+Under the axon TPU tunnel ``jax.block_until_ready`` returns before the
+device work retires, so wall-clock timing and hard barriers must fetch
+a VALUE instead. One element only — callers time hot loops and must not
+add an O(result) tunnel transfer to the timed region.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def fetch_one(tree):
+    """Real device barrier: pull one element of the first non-empty
+    array leaf of ``tree`` to host. Returns that element (or None when
+    the tree has no non-empty array leaves, e.g. an empty carry)."""
+    leaves = [x for x in jax.tree.leaves(tree)
+              if hasattr(x, "ravel") and getattr(x, "size", 0)]
+    if not leaves:
+        return None
+    return np.asarray(leaves[0]).ravel()[0]

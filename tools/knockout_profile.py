@@ -67,16 +67,18 @@ def main():
                 num_bins_max=learner.num_bins_max,
                 num_features=learner.num_features, n=n,
                 interpret=learner.interpret))
+        from lightgbm_tpu.utils.sync import fetch_one as fetch
+
         mat, ws = learner.mat, learner.ws
         t_c0 = time.perf_counter()
         r = fn(mat, ws, grad, hess)
-        jax.block_until_ready(r)
+        fetch(r)
         compile_s = time.perf_counter() - t_c0
         t0 = time.perf_counter()
         iters = 3
         for _ in range(iters):
             r = fn(mat, ws, grad, hess)
-            jax.block_until_ready(r)
+            fetch(r)
         dt = (time.perf_counter() - t0) / iters
         print(f"{tag:10s}: {dt*1e3:9.2f} ms/tree  (compile {compile_s:.0f}s)",
               flush=True)

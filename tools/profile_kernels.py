@@ -42,13 +42,15 @@ def main():
     b = learner.num_bins_max
     meta, params = learner.meta, learner.params
 
+    from lightgbm_tpu.utils.sync import fetch_one as fetch
+
     def bench(make_loop, name):
         fn = jax.jit(make_loop)
         r = fn()
-        jax.block_until_ready(r)
+        fetch(r)
         t0 = time.perf_counter()
         r = fn()
-        jax.block_until_ready(r)
+        fetch(r)
         dt = (time.perf_counter() - t0) / reps
         print(f"{name::<46} {dt*1e3:9.3f} ms/call")
         return dt

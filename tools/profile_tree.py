@@ -22,14 +22,14 @@ sys.path.insert(0, ".")
 
 
 def timeit(fn, *args, warmup=2, iters=5, **kw):
-    import jax
+    from lightgbm_tpu.utils.sync import fetch_one
     for _ in range(warmup):
         r = fn(*args, **kw)
-    jax.block_until_ready(r)
+    fetch_one(r)
     t0 = time.perf_counter()
     for _ in range(iters):
         r = fn(*args, **kw)
-    jax.block_until_ready(r)
+    fetch_one(r)
     return (time.perf_counter() - t0) / iters
 
 
