@@ -521,7 +521,10 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             use_lut.astype(jnp.int32), lut,
             blk=_pick_blk(st["mat"].shape[1]) if USE_PART_V2
             else PART_BLK,
-            interpret=interpret)
+            interpret=interpret,
+            # STATIC: only categorical or EFB-bundled splits consult
+            # the LUT; compile it out otherwise (hot bench path)
+            use_lut_path=bool(params.has_categorical) or bundled)
         nl = nl1[0]
         nr = cnt - nl
 

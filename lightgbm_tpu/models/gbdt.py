@@ -35,26 +35,38 @@ from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
 kEpsilon = 1e-15
 
 
-def _fused_iter_block(mat, ws, score, lr, *, learner, grad_fn, m):
+def _fused_iter_block(mat, ws, score, lr, *, learner, grad_fn, m, k):
     """``m`` boosting iterations as one device program (lax.scan over
-    gradients -> grow -> score update). NOT module-jitted: the learner
-    and grad_fn capture device state (training matrix layout, objective
-    label arrays), so each booster wraps this in its OWN jax.jit
+    gradients -> grow -> score update; ``k`` trees per iteration for
+    multiclass). NOT module-jitted: the learner and grad_fn capture
+    device state (training matrix layout, objective label arrays), so
+    each booster wraps this in its OWN jax.jit
     (``GBDT._train_fused_blocks``) — the compiled-program cache then
     dies with the booster instead of pinning its device buffers in a
     process-lifetime module cache."""
     def body(carry, _):
         mat, ws, score = carry
-        grad, hess = grad_fn(score[:, 0])
-        mat, ws, tree, leaf_id = learner.traceable_grow(
-            mat, ws, grad, hess)
-        ok = tree.num_leaves > 1
-        scale = jnp.where(ok, lr, jnp.float32(0.0))
-        score = score.at[:, 0].add((tree.leaf_value * scale)[leaf_id])
-        return (mat, ws, score), (tree, ok)
+        grad, hess = grad_fn(score if k > 1 else score[:, 0])
+        if k == 1:
+            grad = grad[:, None]
+            hess = hess[:, None]
+        trees_k = []
+        ok = None
+        for tid in range(k):
+            mat, ws, tree, leaf_id = learner.traceable_grow(
+                mat, ws, grad[:, tid], hess[:, tid])
+            ok_t = tree.num_leaves > 1
+            scale = jnp.where(ok_t, lr, jnp.float32(0.0))
+            score = score.at[:, tid].add(
+                (tree.leaf_value * scale)[leaf_id])
+            trees_k.append(tree)
+            ok = ok_t if ok is None else (ok | ok_t)
+        trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_k)
+        return (mat, ws, score), (trees, ok)
 
     (mat, ws, score), (trees, oks) = jax.lax.scan(
         body, (mat, ws, score), None, length=m)
+    # trees: TreeArrays stacked [m, k, ...]
     return mat, ws, score, trees, oks
 
 
@@ -557,7 +569,6 @@ class GBDT:
         on_device = jax.default_backend() in ("tpu", "axon") \
             or os.environ.get("LGBM_TPU_FUSE_ITERS") == "1"
         return (on_device
-                and self.num_tree_per_iteration == 1
                 and not self.valid_sets
                 # subclasses with their own sampling (GOSS/RF) must go
                 # through the per-iteration path
@@ -573,11 +584,12 @@ class GBDT:
         exactly like the async flush path."""
         ln = self.learner
         lr = jnp.float32(self.shrinkage_rate)
+        k = self.num_tree_per_iteration
         fused = getattr(self, "_fused_jit", None)
         if fused is None:
             fused = jax.jit(
                 functools.partial(_fused_iter_block, learner=ln,
-                                  grad_fn=self._grad_fn),
+                                  grad_fn=self._grad_fn, k=k),
                 static_argnames=("m",), donate_argnums=(0, 1, 2))
             self._fused_jit = fused
         while self.iter < iters:
@@ -592,11 +604,12 @@ class GBDT:
             with global_timer.scope("boosting"), annotate("boost_block"):
                 ln.mat, ln.ws, self.train_score, trees, oks = fused(
                     ln.mat, ln.ws, self.train_score, lr, m=m)
-            stack = TreeStack(trees)
+            stack = TreeStack(trees)      # TreeArrays [m, k, ...]
             for j in range(m):
-                self.models.append(DeferredStackTree(
-                    stack, j, ln.dataset,
-                    shrinkage=self.shrinkage_rate))
+                for tid in range(k):
+                    self.models.append(DeferredStackTree(
+                        stack, (j, tid), ln.dataset,
+                        shrinkage=self.shrinkage_rate))
             self.iter += m
             with global_timer.scope("device_sync"):
                 flags = [bool(v) for v in np.asarray(oks)]

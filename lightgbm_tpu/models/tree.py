@@ -465,13 +465,15 @@ class TreeStack:
 
 class DeferredStackTree(DeferredTree):
     """A DeferredTree that materializes by indexing a shared
-    ``TreeStack`` row instead of holding its own device arrays."""
+    ``TreeStack`` row instead of holding its own device arrays.
+    ``idx`` may be an int (stack [M, ...]) or a tuple (stack
+    [M, K, ...], multiclass fused blocks)."""
 
-    def __init__(self, stack: TreeStack, idx: int, dataset=None,
+    def __init__(self, stack: TreeStack, idx, dataset=None,
                  shrinkage: float = 1.0):
         super().__init__(None, dataset, shrinkage)
         self._stack = stack
-        self._idx = int(idx)
+        self._idx = idx
 
     def materialize(self, host_arrays: Optional[TreeArrays] = None) -> Tree:
         if self._tree is None and host_arrays is None:

@@ -120,10 +120,9 @@ def extract_row_ids(mat, num_features: int, n: int) -> jnp.ndarray:
         jnp.int32)
 
 
-GRP = 3            # features per MXU tile in the nibble kernel
 LO = 8             # low-nibble size (bin = hi * LO + lo)
 PAY = 5            # payload planes: g_hi, g_lo, h_hi, h_lo, cnt
-MAX_NIBBLE_F = 192  # nibble-kernel accumulator cap (~3.6 MB VMEM)
+MAX_NIBBLE_F = 192  # nibble-kernel unroll cap (program size; ~1 MB VMEM)
 
 
 def _decode_block(mat_i32, feat0: int, shift, rem, win: int):
@@ -249,29 +248,32 @@ def histogram_segment_raw(mat, begin, count, *, num_features: int,
 
 def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
                         mat_hbm,        # ANY  [N_pad, C] u8
-                        out_ref,        # VMEM [NG, GRP*LO*PAY, GRP*H] f32
+                        out_ref,        # VMEM [F, LO*PAY, H] f32
                         buf, sems,      # VMEM [2, win, C] u8, DMA sems [2]
                         *, blk: int, cols: int, feat0: int,
-                        ngroups: int, hi_n: int):
+                        hi_n: int):
     """Hierarchical (hi/lo nibble) histogram build.
 
     The per-bin one-hot matmul (``_hist_seg_kernel``) issues
     ``num_bins`` MXU calls per block with an 8-row output tile — ~6% of
     the systolic array. This kernel decomposes ``bin = hi*LO + lo`` and
-    contracts, per group of GRP features,
+    contracts, per feature,
 
-        out[(f, lo, p), (f', hi)] += lhs[win, GRP*LO*PAY]^T
-                                     @ rhs[win, GRP*H]
+        out[f, (lo, p), hi] += lhs_f[win, LO*PAY]^T @ rhs_f[win, H]
 
-    where ``lhs[r, (f,lo,p)] = payload_p[r] * [lo(bin_f[r]) == lo]``
-    and ``rhs[r, (f,hi)] = [hi(bin_f[r]) == hi]``. The f == f' diagonal
-    blocks are the histogram (hist[f, hi*LO+lo, p]); cross-feature
-    products land in otherwise-idle MXU lanes and are discarded. With
-    GRP=3, LO=8, PAY=5 the tile is [120, <=96] — ONE MXU call per 3
-    features per block vs one call per BIN: ~25x fewer MXU cycles at
-    255 bins. Payload stays exact: lhs entries are the bf16 hi/lo halves
-    of the f32 grad/hess, accumulated in f32 (same fidelity story as the
-    per-bin kernel).
+    where ``lhs_f[r, (lo,p)] = payload_p[r] * [lo(bin_f[r]) == lo]``
+    and ``rhs_f[r, hi] = [hi(bin_f[r]) == hi]``. Payload stays exact:
+    lhs entries are the bf16 hi/lo halves of the f32 grad/hess,
+    accumulated in f32 (same fidelity story as the per-bin kernel).
+
+    VPU cost note (this kernel is VPU-mask-bound, not MXU-bound): the
+    per-feature lo/hi values are extracted on NARROW [win, 1] columns
+    and broadcast against STATIC lane patterns, so each of the
+    LO*PAY + H mask lanes costs one compare + one select — an earlier
+    variant grouped 3 features per tile and paid 2 extra selects plus a
+    div/mod per lane routing features into lanes, ~3x the VPU work,
+    for MXU utilization this kernel doesn't need (measured
+    dispatch-free on v5e: the MXU side has >10x headroom).
     """
     begin = scal_ref[0]
     count = scal_ref[1]
@@ -280,8 +282,7 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
     shift = begin - base
     win = blk + ALIGN
 
-    m_lhs = GRP * LO * PAY                           # 120
-    n_rhs = GRP * hi_n
+    m_lhs = LO * PAY                                 # 40
 
     def dma(slot, i):
         start = pl.multiple_of(base + i * blk, ALIGN)
@@ -292,12 +293,9 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
 
     # static lane patterns
     lane_l = jax.lax.broadcasted_iota(jnp.int32, (1, m_lhs), 1)
-    lhs_f = lane_l // (LO * PAY)                     # feature-in-group
-    lhs_lo = (lane_l % (LO * PAY)) // PAY            # lo value
+    lhs_lo = lane_l // PAY                           # lo value
     lhs_p = lane_l % PAY                             # payload plane
-    lane_r = jax.lax.broadcasted_iota(jnp.int32, (1, n_rhs), 1)
-    rhs_f = lane_r // hi_n
-    rhs_hi = lane_r % hi_n
+    rhs_hi = jax.lax.broadcasted_iota(jnp.int32, (1, hi_n), 1)
 
     @pl.when(nblk > 0)
     def _():
@@ -316,42 +314,30 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
         rem = jnp.minimum(count - i * blk, blk)
         _, g_hi, g_lo, h_hi, h_lo, cnt = _decode_block(
             mat_i32, feat0, shift, rem, win)
-        # payload lane pattern is group-independent: build once
+        # payload lane pattern is feature-independent: build once
         pay = [g_hi.astype(jnp.float32), g_lo.astype(jnp.float32),
                h_hi.astype(jnp.float32), h_lo.astype(jnp.float32), cnt]
         pay_b = pay[PAY - 1]
         for p in range(PAY - 2, -1, -1):             # [win, m_lhs]
             pay_b = jnp.where(lhs_p == p, pay[p], pay_b)
 
-        # group loop unrolled with STATIC column indices: a traced
+        # feature loop unrolled with STATIC column indices: a traced
         # index would force each feature column out of the [win, C]
         # tile via a one-hot lane reduction (~full-width VPU pass per
         # feature per block); a static slice is free. Program size is
-        # bounded: MAX_NIBBLE_F caps this kernel at 64 groups (wider
-        # datasets take the per-bin kernel), so the unroll cannot blow
-        # up Mosaic compile time on wide data
-        for gidx in range(ngroups):
-            # clamped: the tail group may run past F; garbage lanes
-            # are sliced off later
-            def fcol(j):
-                c = min(gidx * GRP + j, feat0 - 1)
-                return mat_i32[:, c:c + 1]           # [win, 1]
-
-            f0, f1, f2 = fcol(0), fcol(1), fcol(2)
-
-            def pick3(fl):
-                x = jnp.where(fl == 1, f1, f0)
-                return jnp.where(fl == 2, f2, x)
-
-            binl = pick3(lhs_f)                      # [win, m_lhs]
-            lhs = jnp.where(binl - (binl // LO) * LO == lhs_lo,
-                            pay_b, 0.0).astype(jnp.bfloat16)
-            binr = pick3(rhs_f)                      # [win, n_rhs]
-            rhs = jnp.where(binr // LO == rhs_hi, jnp.float32(1),
+        # bounded by MAX_NIBBLE_F (wider datasets take the per-bin
+        # kernel), so the unroll cannot blow up Mosaic compile time
+        for f in range(feat0):
+            fcol = mat_i32[:, f:f + 1]               # [win, 1]
+            flo = fcol - (fcol // LO) * LO           # narrow; & and >>
+            fhi = fcol // LO                         # miscompile (i32)
+            lhs = jnp.where(flo == lhs_lo, pay_b,
+                            0.0).astype(jnp.bfloat16)    # [win, 40]
+            rhs = jnp.where(fhi == rhs_hi, jnp.float32(1),
                             jnp.float32(0)).astype(jnp.bfloat16)
-            out_ref[gidx] += jax.lax.dot_general(
+            out_ref[f] += jax.lax.dot_general(
                 lhs, rhs, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [m_lhs, n_rhs]
+                preferred_element_type=jnp.float32)  # [m_lhs, hi_n]
         return 0
 
     jax.lax.fori_loop(0, nblk, block_body, 0)
@@ -369,16 +355,14 @@ def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
     _, cols = mat.shape
     f = num_features
     hi_n = -(-num_bins // LO)                        # ceil(B / LO)
-    ngroups = -(-f // GRP)
     scal = jnp.stack([jnp.asarray(begin, jnp.int32),
                       jnp.asarray(count, jnp.int32)])
     kernel = functools.partial(_hist_nibble_kernel, blk=blk,
-                               cols=cols, feat0=f,
-                               ngroups=ngroups, hi_n=hi_n)
+                               cols=cols, feat0=f, hi_n=hi_n)
     raw = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(
-            (ngroups, GRP * LO * PAY, GRP * hi_n), jnp.float32),
+            (f, LO * PAY, hi_n), jnp.float32),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -391,10 +375,10 @@ def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(scal, mat)
-    # [NG, (fl, lo, p), (fr, hi)] -> diagonal fl == fr -> [F, B, 3]
-    raw = raw.reshape(ngroups, GRP, LO, PAY, GRP, hi_n)
-    diag = jnp.einsum("gjlpjh->gjhlp", raw)          # [NG, GRP, H, LO, P]
-    hist = diag.reshape(ngroups * GRP, hi_n * LO, PAY)[:f, :num_bins]
+    # [F, (lo, p), hi] -> [F, B, 3]
+    raw = raw.reshape(f, LO, PAY, hi_n)
+    hist = raw.transpose(0, 3, 1, 2).reshape(
+        f, hi_n * LO, PAY)[:, :num_bins]
     g = hist[..., 0] + hist[..., 1]
     h = hist[..., 2] + hist[..., 3]
     return jnp.stack([g, h, hist[..., 4]], axis=-1)  # [F, B, 3]
@@ -414,10 +398,9 @@ def histogram_segment(mat, begin, count, num_bins: int, num_features: int,
                       ) -> jnp.ndarray:
     """Histogram of rows [begin, begin+count) -> [F, B, 3] f32.
 
-    Dispatches to the nibble kernel (one MXU call per 3 features per
-    block) unless F is wide enough that its [NG, 120, GRP*H] VMEM
-    accumulator would not fit, where the per-bin kernel's [B, 8, C]
-    accumulator scales better.
+    Dispatches to the nibble kernel (one MXU call per feature per
+    block) unless F exceeds its unroll cap (MAX_NIBBLE_F), where the
+    per-bin kernel's [B, 8, C] accumulator scales better.
     """
     if num_features <= MAX_NIBBLE_F:
         return _histogram_segment_nibble(

@@ -48,7 +48,7 @@ MISSING_NAN_CODE = 2
 def _partition_kernel(scal_ref, lut_ref, mat_in, ws_in,
                       mat_hbm, ws_hbm, nl_ref,
                       inbuf, staged, flushbuf, rbuf, sems,
-                      *, blk: int, cols: int):
+                      *, blk: int, cols: int, use_lut_path: bool):
     # mat_in/ws_in alias mat_hbm/ws_hbm (input_output_aliases); all
     # reads and writes go through the output refs
     del mat_in, ws_in
@@ -140,14 +140,22 @@ def _partition_kernel(scal_ref, lut_ref, mat_in, ws_in,
                       jnp.where(bv == nbins - 1, 1, 0), 0))
         num_left = is_missing * dleft \
             + (1 - is_missing) * jnp.where(bv <= thr, 1, 0)
-        onehot = jnp.where(
-            bv == jax.lax.broadcasted_iota(jnp.int32, (win, 256), 1),
-            jnp.float32(1), jnp.float32(0)).astype(jnp.bfloat16)
-        cat_left = jnp.where(jax.lax.dot_general(
-            onehot, lut_ref[...].reshape(256, 1).astype(jnp.bfloat16),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) > 0.5, 1, 0)  # [win, 1]
-        go_left = jnp.where(iscat > 0, cat_left, num_left)
+        if use_lut_path:
+            # categorical bitset / bundled-group membership via a
+            # 256-entry LUT matmul; statically compiled out for
+            # cat-free unbundled datasets (the [win, 256] one-hot is
+            # ~800 VPU lane-ops/row the bench path must not pay)
+            onehot = jnp.where(
+                bv == jax.lax.broadcasted_iota(jnp.int32, (win, 256), 1),
+                jnp.float32(1), jnp.float32(0)).astype(jnp.bfloat16)
+            cat_left = jnp.where(jax.lax.dot_general(
+                onehot,
+                lut_ref[...].reshape(256, 1).astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) > 0.5, 1, 0)
+            go_left = jnp.where(iscat > 0, cat_left, num_left)
+        else:
+            go_left = num_left
 
         gl = valid * go_left
         gr = valid * (1 - go_left)
@@ -180,17 +188,20 @@ def _partition_kernel(scal_ref, lut_ref, mat_in, ws_in,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("blk", "interpret"))
+    jax.jit, static_argnames=("blk", "interpret", "use_lut_path"))
 def partition_segment(mat, ws, begin, count, feat, thr, default_left,
                       missing_code, default_bin, num_bins_f, is_cat,
                       cat_lut, *, blk: int = 512,
-                      interpret: bool = False):
+                      interpret: bool = False,
+                      use_lut_path: bool = True):
     """Stable-partition rows [begin, begin+count) of the training
     matrix by the split decision. Returns (mat', ws', nl) where nl is
     the left-child row count (shape [1] i32).
 
     ``cat_lut``: [1, 256] f32 0/1 membership of each BIN on the left
     side (from the split's bin bitset); all-zero for numerical splits.
+    ``use_lut_path=False`` (static) compiles the LUT machinery out —
+    only valid when no split can be categorical or bundled.
     ``ws`` is a scratch buffer of the same shape as ``mat``.
     """
     if blk % ALIGN:
@@ -201,7 +212,8 @@ def partition_segment(mat, ws, begin, count, feat, thr, default_left,
         to32(begin), to32(count), to32(feat), to32(thr),
         to32(default_left), to32(missing_code), to32(default_bin),
         to32(num_bins_f), to32(is_cat)])
-    kernel = functools.partial(_partition_kernel, blk=blk, cols=cols)
+    kernel = functools.partial(_partition_kernel, blk=blk, cols=cols,
+                               use_lut_path=use_lut_path)
     win = blk + ALIGN
     mat2, ws2, nl = pl.pallas_call(
         kernel,

@@ -75,7 +75,7 @@ def pick_blk(cols: int) -> int:
 def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
                          mat_hbm, ws_hbm, nl_ref,
                          inbuf, stage_l, stage_r, u8buf, gran8, sems,
-                         *, blk: int, cols: int):
+                         *, blk: int, cols: int, use_lut_path: bool):
     del mat_in, ws_in
     begin = scal_ref[S_BEGIN]
     count = scal_ref[S_COUNT]
@@ -214,6 +214,10 @@ def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
                       jnp.where(bv == nbins - 1, 1, 0), 0))
         num_left = is_missing * dleft \
             + (1 - is_missing) * jnp.where(bv <= thr, 1, 0)
+        if not use_lut_path:
+            # statically compiled out for cat-free unbundled datasets
+            # (the [win, 256] one-hot costs ~800 VPU lane-ops/row)
+            return num_left
         onehot = jnp.where(
             bv == jax.lax.broadcasted_iota(jnp.int32, (win, 256), 1),
             jnp.float32(1), jnp.float32(0)).astype(jnp.bfloat16)
@@ -294,11 +298,12 @@ def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("blk", "interpret"))
+    jax.jit, static_argnames=("blk", "interpret", "use_lut_path"))
 def partition_segment_v2(mat, ws, begin, count, feat, thr, default_left,
                          missing_code, default_bin, num_bins_f, is_cat,
                          cat_lut, *, blk: int = 2048,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         use_lut_path: bool = True):
     """Drop-in for ``partition_pallas.partition_segment`` (v2 design,
     see module docstring)."""
     if blk % SUB:
@@ -309,7 +314,8 @@ def partition_segment_v2(mat, ws, begin, count, feat, thr, default_left,
         to32(begin), to32(count), to32(feat), to32(thr),
         to32(default_left), to32(missing_code), to32(default_bin),
         to32(num_bins_f), to32(is_cat)])
-    kernel = functools.partial(_partition_kernel_v2, blk=blk, cols=cols)
+    kernel = functools.partial(_partition_kernel_v2, blk=blk, cols=cols,
+                               use_lut_path=use_lut_path)
     win = blk + ALIGN
     mat2, ws2, nl = pl.pallas_call(
         kernel,

@@ -80,6 +80,30 @@ def test_fused_no_split_stop_truncates(monkeypatch):
                                   np.asarray(b1.predict_raw(X)))
 
 
+def test_fused_multiclass_matches(monkeypatch):
+    rng = np.random.RandomState(7)
+    X = rng.randn(1200, 6).astype(np.float32)
+    y = (rng.rand(1200) * 3).astype(int).astype(np.float32)
+    p = {"objective": "multiclass", "num_class": 3}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, iters=5,
+                params=p)
+    b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, iters=5,
+                params=p)
+    assert len(b0.models) == len(b1.models) == 15
+    # structure must be identical; leaf values may drift at f32 LSB
+    # level (~1e-7): the fused program lets XLA fuse the softmax
+    # gradient with the previous iteration's score update, reassociating
+    # float ops across what used to be a dispatch boundary
+    for t0, t1 in zip(b0.models, b1.models):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
+        np.testing.assert_array_equal(np.asarray(t0.threshold_bin),
+                                      np.asarray(t1.threshold_bin))
+    np.testing.assert_allclose(np.asarray(b0.predict_raw(X)),
+                               np.asarray(b1.predict_raw(X)),
+                               rtol=1e-5, atol=2e-6)
+
+
 def test_fused_declines_when_unsupported(monkeypatch):
     # bagging draws host RNG per iteration -> the fused path must stay
     # off and results still match the reference semantics of the

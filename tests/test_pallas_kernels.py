@@ -74,6 +74,23 @@ def test_partition_stable_and_payload(packed):
     assert np.array_equal(g_rec, np.asarray(ghc[:, 0])[got])
 
 
+def test_partition_no_lut_path_matches(packed):
+    # the static use_lut_path=False compile (cat-free unbundled
+    # datasets) must partition identically on numerical splits
+    binned, ghc, mat, n, f, b = packed
+    ws = jnp.zeros_like(mat)
+    zlut = jnp.zeros((1, 256), jnp.float32)
+    begin, count, feat, thr = 100, 2500, 3, 20
+    m1, _, nl1 = partition_segment(
+        mat, ws, begin, count, feat, thr, 0, 0, 0, b, 0, zlut,
+        interpret=True)
+    m2, _, nl2 = partition_segment(
+        mat, ws, begin, count, feat, thr, 0, 0, 0, b, 0, zlut,
+        interpret=True, use_lut_path=False)
+    assert int(nl1[0]) == int(nl2[0])
+    assert np.array_equal(np.asarray(m1), np.asarray(m2))
+
+
 def test_partition_categorical_bitset(packed):
     binned, ghc, mat, n, f, b = packed
     ws = jnp.zeros_like(mat)
