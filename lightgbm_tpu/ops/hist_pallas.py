@@ -122,7 +122,20 @@ def extract_row_ids(mat, num_features: int, n: int) -> jnp.ndarray:
 
 LO = 8             # low-nibble size (bin = hi * LO + lo)
 PAY = 5            # payload planes: g_hi, g_lo, h_hi, h_lo, cnt
+GRP = 3            # features per MXU tile in the GROUPED nibble variant
 MAX_NIBBLE_F = 192  # nibble-kernel unroll cap (program size; ~1 MB VMEM)
+
+# Two nibble-kernel mask layouts, selectable for on-chip comparison
+# (tools/micro_kernel_bench.py measures both):
+#   grouped (default) — 3 features per [120, 96] MXU tile. VPU op cost
+#     scales with op COUNT x sublanes, not lanes, so packing 3
+#     features' masks into one ~full-width tile amortizes each
+#     compare/select across 3 features (~10 ops/group/block).
+#   perfeat — one [40, 32] tile per feature; fewer lanes per op buys
+#     nothing on the VPU, but kept for measurement and as the simpler
+#     reference implementation.
+import os as _os
+HIST_VARIANT = _os.environ.get("LGBM_TPU_HIST_VARIANT", "grouped")
 
 
 def _decode_block(mat_i32, feat0: int, shift, rem, win: int):
@@ -246,6 +259,100 @@ def histogram_segment_raw(mat, begin, count, *, num_features: int,
     )(scal, mat)
 
 
+def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
+                                mat_hbm,   # ANY [N_pad, C] u8
+                                out_ref,   # VMEM [NG, 120, GRP*H] f32
+                                buf, sems,
+                                *, blk: int, cols: int, feat0: int,
+                                ngroups: int, hi_n: int):
+    """Grouped nibble variant: per group of GRP features,
+
+        out[(f, lo, p), (f', hi)] += lhs[win, GRP*LO*PAY]^T
+                                     @ rhs[win, GRP*H]
+
+    diagonal f == f' blocks are the histogram; cross-feature products
+    land in otherwise-idle MXU lanes and are discarded. lo/hi are
+    precomputed FULL-WIDTH once per block (3 VPU ops for all features)
+    and routed into mask lanes with two selects per group — the VPU op
+    count per block is ~10 x ngroups + constants, the lowest of the
+    variants when features pack ~120 lanes full.
+    """
+    begin = scal_ref[0]
+    count = scal_ref[1]
+    nblk = pl.cdiv(count, blk)
+    base = (begin // ALIGN) * ALIGN
+    shift = begin - base
+    win = blk + ALIGN
+
+    m_lhs = GRP * LO * PAY                           # 120
+    n_rhs = GRP * hi_n
+
+    def dma(slot, i):
+        start = pl.multiple_of(base + i * blk, ALIGN)
+        return pltpu.make_async_copy(
+            mat_hbm.at[pl.ds(start, win), :], buf.at[slot], sems.at[slot])
+
+    out_ref[...] = jnp.zeros_like(out_ref)
+
+    lane_l = jax.lax.broadcasted_iota(jnp.int32, (1, m_lhs), 1)
+    lhs_f = lane_l // (LO * PAY)                     # feature-in-group
+    lhs_lo = (lane_l % (LO * PAY)) // PAY            # lo value
+    lhs_p = lane_l % PAY                             # payload plane
+    lane_r = jax.lax.broadcasted_iota(jnp.int32, (1, n_rhs), 1)
+    rhs_f = lane_r // hi_n
+    rhs_hi = lane_r % hi_n
+
+    @pl.when(nblk > 0)
+    def _():
+        dma(0, 0).start()
+
+    def block_body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < nblk)
+        def _():
+            dma(1 - slot, i + 1).start()
+
+        dma(slot, i).wait()
+        mat_i32 = buf[slot].astype(jnp.int32)        # [win, C]
+        # full-width nibble split ONCE for every feature column
+        mat_hi = mat_i32 // LO                       # [win, C]
+        mat_lo = mat_i32 - mat_hi * LO
+
+        rem = jnp.minimum(count - i * blk, blk)
+        _, g_hi, g_lo, h_hi, h_lo, cnt = _decode_block(
+            mat_i32, feat0, shift, rem, win)
+        pay = [g_hi.astype(jnp.float32), g_lo.astype(jnp.float32),
+               h_hi.astype(jnp.float32), h_lo.astype(jnp.float32), cnt]
+        pay_b = pay[PAY - 1]
+        for p in range(PAY - 2, -1, -1):             # [win, m_lhs]
+            pay_b = jnp.where(lhs_p == p, pay[p], pay_b)
+
+        for gidx in range(ngroups):
+            # tail group clamps past-F columns onto the last feature;
+            # garbage lanes are sliced off in the epilogue
+            def fcol(m, j):
+                c = min(gidx * GRP + j, feat0 - 1)
+                return m[:, c:c + 1]                 # [win, 1]
+
+            def pick3(m, fl):
+                x = jnp.where(fl == 1, fcol(m, 1), fcol(m, 0))
+                return jnp.where(fl == 2, fcol(m, 2), x)
+
+            binlo = pick3(mat_lo, lhs_f)             # [win, m_lhs]
+            lhs = jnp.where(binlo == lhs_lo, pay_b,
+                            0.0).astype(jnp.bfloat16)
+            binhi = pick3(mat_hi, rhs_f)             # [win, n_rhs]
+            rhs = jnp.where(binhi == rhs_hi, jnp.float32(1),
+                            jnp.float32(0)).astype(jnp.bfloat16)
+            out_ref[gidx] += jax.lax.dot_general(
+                lhs, rhs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [m_lhs, n_rhs]
+        return 0
+
+    jax.lax.fori_loop(0, nblk, block_body, 0)
+
+
 def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
                         mat_hbm,        # ANY  [N_pad, C] u8
                         out_ref,        # VMEM [F, LO*PAY, H] f32
@@ -345,24 +452,28 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_features", "num_bins", "blk", "interpret"))
+    static_argnames=("num_features", "num_bins", "blk", "interpret",
+                     "variant"))
 def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
                               num_bins: int, blk: int = 2048,
-                              interpret: bool = False):
-    """Nibble-kernel call -> [F, B, 3] histogram."""
+                              interpret: bool = False,
+                              variant: str | None = None):
+    """Nibble-kernel call -> [F, B, 3] histogram.
+
+    ``variant`` must be resolved by the CALLER (histogram_segment):
+    a None default resolved here would freeze the module global into
+    the jit cache on first trace.
+    """
     if blk % ALIGN:
         raise ValueError(f"blk must be a multiple of {ALIGN}, got {blk}")
+    if variant is None:
+        variant = HIST_VARIANT
     _, cols = mat.shape
     f = num_features
     hi_n = -(-num_bins // LO)                        # ceil(B / LO)
     scal = jnp.stack([jnp.asarray(begin, jnp.int32),
                       jnp.asarray(count, jnp.int32)])
-    kernel = functools.partial(_hist_nibble_kernel, blk=blk,
-                               cols=cols, feat0=f, hi_n=hi_n)
-    raw = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct(
-            (f, LO * PAY, hi_n), jnp.float32),
+    common = dict(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -374,11 +485,34 @@ def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(scal, mat)
-    # [F, (lo, p), hi] -> [F, B, 3]
-    raw = raw.reshape(f, LO, PAY, hi_n)
-    hist = raw.transpose(0, 3, 1, 2).reshape(
-        f, hi_n * LO, PAY)[:, :num_bins]
+    )
+    if variant == "grouped":
+        ngroups = -(-f // GRP)
+        raw = pl.pallas_call(
+            functools.partial(_hist_nibble_kernel_grouped, blk=blk,
+                              cols=cols, feat0=f, ngroups=ngroups,
+                              hi_n=hi_n),
+            out_shape=jax.ShapeDtypeStruct(
+                (ngroups, GRP * LO * PAY, GRP * hi_n), jnp.float32),
+            **common,
+        )(scal, mat)
+        # [NG, (fl, lo, p), (fr, hi)] -> diagonal fl == fr -> [F, B, 3]
+        raw = raw.reshape(ngroups, GRP, LO, PAY, GRP, hi_n)
+        diag = jnp.einsum("gjlpjh->gjhlp", raw)   # [NG, GRP, H, LO, P]
+        hist = diag.reshape(ngroups * GRP, hi_n * LO,
+                            PAY)[:f, :num_bins]
+    else:
+        raw = pl.pallas_call(
+            functools.partial(_hist_nibble_kernel, blk=blk,
+                              cols=cols, feat0=f, hi_n=hi_n),
+            out_shape=jax.ShapeDtypeStruct(
+                (f, LO * PAY, hi_n), jnp.float32),
+            **common,
+        )(scal, mat)
+        # [F, (lo, p), hi] -> [F, B, 3]
+        raw = raw.reshape(f, LO, PAY, hi_n)
+        hist = raw.transpose(0, 3, 1, 2).reshape(
+            f, hi_n * LO, PAY)[:, :num_bins]
     g = hist[..., 0] + hist[..., 1]
     h = hist[..., 2] + hist[..., 3]
     return jnp.stack([g, h, hist[..., 4]], axis=-1)  # [F, B, 3]
@@ -394,18 +528,19 @@ def combine_planes(raw: jnp.ndarray, num_features: int) -> jnp.ndarray:
 
 
 def histogram_segment(mat, begin, count, num_bins: int, num_features: int,
-                      blk: int = 2048, interpret: bool = False
-                      ) -> jnp.ndarray:
+                      blk: int = 2048, interpret: bool = False,
+                      variant: str | None = None) -> jnp.ndarray:
     """Histogram of rows [begin, begin+count) -> [F, B, 3] f32.
 
-    Dispatches to the nibble kernel (one MXU call per feature per
-    block) unless F exceeds its unroll cap (MAX_NIBBLE_F), where the
-    per-bin kernel's [B, 8, C] accumulator scales better.
+    Dispatches to the nibble kernel (grouped/per-feature mask variant,
+    see HIST_VARIANT) unless F exceeds its unroll cap (MAX_NIBBLE_F),
+    where the per-bin kernel's [B, 8, C] accumulator scales better.
     """
     if num_features <= MAX_NIBBLE_F:
         return _histogram_segment_nibble(
             mat, begin, count, num_features=num_features,
-            num_bins=num_bins, blk=blk, interpret=interpret)
+            num_bins=num_bins, blk=blk, interpret=interpret,
+            variant=HIST_VARIANT if variant is None else variant)
     raw = histogram_segment_raw(mat, begin, count,
                                 num_features=num_features,
                                 num_bins=num_bins, blk=blk,

@@ -32,14 +32,19 @@ def packed():
 
 @pytest.mark.parametrize("begin,count", [(0, 3000), (517, 1234),
                                          (2999, 1), (100, 0)])
-@pytest.mark.parametrize("kernel", ["nibble", "per_bin"])
+@pytest.mark.parametrize("kernel", ["nibble-grouped", "nibble-perfeat",
+                                    "per_bin"])
 def test_histogram_segment_matches_scatter(packed, begin, count, kernel,
                                            monkeypatch):
     binned, ghc, mat, n, f, b = packed
+    variant = None
     if kernel == "per_bin":  # force the wide-F fallback branch
         import lightgbm_tpu.ops.hist_pallas as hp
         monkeypatch.setattr(hp, "MAX_NIBBLE_F", 0)
-    seg = histogram_segment(mat, begin, count, b, f, interpret=True)
+    else:
+        variant = kernel.split("-")[1]
+    seg = histogram_segment(mat, begin, count, b, f, interpret=True,
+                            variant=variant)
     if count:
         ref = np.asarray(histogram_scatter(
             jnp.asarray(binned[begin:begin + count]),

@@ -68,25 +68,30 @@ def main():
     t = timeit(triv, x0, warmup=3, iters=10)
     print(f"dispatch floor (trivial jit): {t*1e3:8.3f} ms")
 
-    # 2. chained histogram_segment
-    def chain_hist(m, count):
-        def body(i, acc):
-            # begin depends on the carry so XLA cannot hoist the
-            # loop-invariant kernel call (i % 2 stays 8-aligned -> same
-            # work per iteration, different operand)
-            begin = (acc.astype(jnp.int32) % 2) * 8
-            hh = hp.histogram_segment(m, begin, count, b, f,
-                                      blk=2048, interpret=False)
-            return acc + hh[0, 0, 0]
-        return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
-    chain_hist_j = jax.jit(chain_hist)
+    # 2. chained histogram_segment (both nibble mask variants)
+    def mk_chain_hist(variant):
+        def chain_hist(m, count):
+            def body(i, acc):
+                # begin depends on the carry so XLA cannot hoist the
+                # loop-invariant kernel call (i % 2 stays 8-aligned ->
+                # same work per iteration, different operand)
+                begin = (acc.astype(jnp.int32) % 2) * 8
+                hh = hp.histogram_segment(m, begin, count, b, f,
+                                          blk=2048, interpret=False,
+                                          variant=variant)
+                return acc + hh[0, 0, 0]
+            return jax.lax.fori_loop(0, k_chain, body, jnp.float32(0))
+        return jax.jit(chain_hist)
 
-    print(f"histogram_segment, {k_chain}x chained in one jit:")
-    for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
-        t = timeit(chain_hist_j, mat, jnp.int32(count))
-        per = t / k_chain
-        print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
-              f"({count/per/1e6:8.1f} Mrow/s)")
+    for variant in ("grouped", "perfeat"):
+        chain_hist_j = mk_chain_hist(variant)
+        print(f"histogram_segment[{variant}], {k_chain}x chained "
+              "in one jit:")
+        for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
+            t = timeit(chain_hist_j, mat, jnp.int32(count))
+            per = t / k_chain
+            print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
+                  f"({count/per/1e6:8.1f} Mrow/s)")
 
     # 3. chained partition_segment: v1 vs v2 (sub-tiled)
     from lightgbm_tpu.ops import partition_pallas_v2 as pp2
@@ -113,16 +118,18 @@ def main():
                          ("v2 blk=2048", pp2.partition_segment_v2, 2048)):
         chain_part_j = mk_chain_part(fn, blk)
         print(f"partition_segment {tag}, {k_chain}x chained in one jit:")
+        from lightgbm_tpu.utils.sync import fetch_one
         for count in (2048, 8192, 32768, 131072, min(n, 500_000)):
             m2 = jnp.array(mat)  # fresh donation each measure
             w2 = jnp.array(ws)
             r = chain_part_j(m2, w2, jnp.int32(count))
-            jax.block_until_ready(r)
+            fetch_one(r)
             m2 = jnp.array(mat)
             w2 = jnp.array(ws)
+            fetch_one(w2)  # uploads must finish before the clock starts
             t0 = time.perf_counter()
             r = chain_part_j(m2, w2, jnp.int32(count))
-            jax.block_until_ready(r)
+            fetch_one(r)
             t = time.perf_counter() - t0
             per = t / k_chain
             print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
