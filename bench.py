@@ -160,6 +160,21 @@ def main():
     last_err = None
     printed_any = False
 
+    # fast tunnel probe: a WEDGED axon tunnel (observed repeatedly this
+    # round) hangs children at jax.devices() until their full per-size
+    # timeout; 90 s here decides between the TPU plan and the fallback
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, timeout=90)
+        tpu_ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        tpu_ok = False
+    if not tpu_ok:
+        sys.stderr.write("TPU probe failed/hung; skipping TPU plan\n")
+        plan = []
+        last_err = ("probe", "", "jax.devices() unreachable in 90s")
+
     for rows in plan:
         remaining = budget - (time.monotonic() - t_start)
         if printed_any and remaining < SIZE_MIN_BUDGET.get(rows, 60):
