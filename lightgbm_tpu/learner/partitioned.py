@@ -193,10 +193,11 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
                 and self.ff_bynode >= 1.0
                 and getattr(self, "_cegb_used", None) is None)
 
-    def traceable_grow(self, mat, ws, grad, hess):
+    def traceable_grow(self, mat, ws, grad, hess, bag=None):
         """One tree grown inside an enclosing trace (no jit boundary,
         no host state updates). Caller owns the mat/ws carry."""
-        bag = jnp.ones_like(grad)
+        if bag is None:
+            bag = jnp.ones_like(grad)
         fmask = jnp.ones((self.num_features,), bool)
         return grow_partitioned(
             mat, ws, grad, hess, bag, fmask, self.meta,

@@ -35,26 +35,29 @@ from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
 kEpsilon = 1e-15
 
 
-def _fused_iter_block(mat, ws, score, lr, *, learner, grad_fn, m, k):
+def _fused_iter_block(mat, ws, score, lr, it0, *, learner, grad_fn,
+                      bag_fn, m, k):
     """``m`` boosting iterations as one device program (lax.scan over
-    gradients -> grow -> score update; ``k`` trees per iteration for
-    multiclass). NOT module-jitted: the learner and grad_fn capture
-    device state (training matrix layout, objective label arrays), so
-    each booster wraps this in its OWN jax.jit
-    (``GBDT._train_fused_blocks``) — the compiled-program cache then
-    dies with the booster instead of pinning its device buffers in a
-    process-lifetime module cache."""
-    def body(carry, _):
+    gradients -> [sampling] -> grow -> score update; ``k`` trees per
+    iteration for multiclass; ``bag_fn(it, grad, hess)`` supplies
+    device-computed row weights — GOSS — or None for no sampling).
+    NOT module-jitted: the learner and grad_fn capture device state
+    (training matrix layout, objective label arrays), so each booster
+    wraps this in its OWN jax.jit (``GBDT._train_fused_blocks``) — the
+    compiled-program cache then dies with the booster instead of
+    pinning its device buffers in a process-lifetime module cache."""
+    def body(carry, it):
         mat, ws, score = carry
         grad, hess = grad_fn(score if k > 1 else score[:, 0])
         if k == 1:
             grad = grad[:, None]
             hess = hess[:, None]
+        bag = None if bag_fn is None else bag_fn(it, grad, hess)
         trees_k = []
         ok = None
         for tid in range(k):
             mat, ws, tree, leaf_id = learner.traceable_grow(
-                mat, ws, grad[:, tid], hess[:, tid])
+                mat, ws, grad[:, tid], hess[:, tid], bag=bag)
             ok_t = tree.num_leaves > 1
             scale = jnp.where(ok_t, lr, jnp.float32(0.0))
             score = score.at[:, tid].add(
@@ -65,7 +68,7 @@ def _fused_iter_block(mat, ws, score, lr, *, learner, grad_fn, m, k):
         return (mat, ws, score), (trees, ok)
 
     (mat, ws, score), (trees, oks) = jax.lax.scan(
-        body, (mat, ws, score), None, length=m)
+        body, (mat, ws, score), it0 + jnp.arange(m, dtype=jnp.int32))
     # trees: TreeArrays stacked [m, k, ...]
     return mat, ws, score, trees, oks
 
@@ -564,15 +567,23 @@ class GBDT:
     # dispatch + one stop-flag fetch per block.
     _FUSED_BLOCK = 64
 
+    def _traceable_bag_fn(self):
+        """Device-traceable per-iteration sampling hook for the fused
+        path: a function ``(it, grad, hess) -> [N] weights`` or None.
+        Base GBDT has no device sampling; GOSS overrides."""
+        return None
+
     def _fused_scan_supported(self) -> bool:
         ln = getattr(self, "learner", None)
         on_device = jax.default_backend() in ("tpu", "axon") \
             or os.environ.get("LGBM_TPU_FUSE_ITERS") == "1"
         return (on_device
                 and not self.valid_sets
-                # subclasses with their own sampling (GOSS/RF) must go
-                # through the per-iteration path
-                and type(self)._bagging_weight is GBDT._bagging_weight
+                # subclasses with their own sampling go through the
+                # per-iteration path unless it is device-traceable
+                # (GOSS); RF/host-RNG bagging stay excluded
+                and (type(self)._bagging_weight is GBDT._bagging_weight
+                     or self._traceable_bag_fn() is not None)
                 and type(self)._feature_mask is GBDT._feature_mask
                 and getattr(ln, "supports_fused_scan", False)
                 and ln.fused_scan_ok())
@@ -589,7 +600,8 @@ class GBDT:
         if fused is None:
             fused = jax.jit(
                 functools.partial(_fused_iter_block, learner=ln,
-                                  grad_fn=self._grad_fn, k=k),
+                                  grad_fn=self._grad_fn,
+                                  bag_fn=self._traceable_bag_fn(), k=k),
                 static_argnames=("m",), donate_argnums=(0, 1, 2))
             self._fused_jit = fused
         while self.iter < iters:
@@ -603,7 +615,8 @@ class GBDT:
                 m //= 2
             with global_timer.scope("boosting"), annotate("boost_block"):
                 ln.mat, ln.ws, self.train_score, trees, oks = fused(
-                    ln.mat, ln.ws, self.train_score, lr, m=m)
+                    ln.mat, ln.ws, self.train_score, lr,
+                    jnp.int32(self.iter), m=m)
             stack = TreeStack(trees)      # TreeArrays [m, k, ...]
             for j in range(m):
                 for tid in range(k):

@@ -72,6 +72,24 @@ class GOSS(GBDT):
             other_rate=float(self.config.other_rate))
         return self.bag_weight
 
+    def _traceable_bag_fn(self):
+        """Fused-path hook: the same selection with a TRACED iteration
+        index (fold_in accepts traced data; the warmup cutoff becomes a
+        select). Weight streams match ``_bagging_weight`` exactly for
+        equal ``it``."""
+        warmup = int(1.0 / self.config.learning_rate)
+        top_rate = float(self.config.top_rate)
+        other_rate = float(self.config.other_rate)
+        key0 = self._goss_key
+
+        def bag_fn(it, grad, hess):
+            key = jax.random.fold_in(key0, it)
+            w = _goss_weights(grad, hess, key, top_rate=top_rate,
+                              other_rate=other_rate)
+            return jnp.where(it < warmup, jnp.ones_like(w), w)
+
+        return bag_fn
+
 
 # ----------------------------------------------------------------------
 class DART(GBDT):

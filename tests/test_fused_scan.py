@@ -24,6 +24,7 @@ def _make(n=1500, f=8, seed=0):
 
 
 def _train(X, y, fused, monkeypatch, iters=6, params=None):
+    from lightgbm_tpu.models.variants import create_boosting
     monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1" if fused else "0")
     cfg = Config.from_params({
         "objective": "binary", "num_leaves": 7, "learning_rate": 0.1,
@@ -32,7 +33,7 @@ def _train(X, y, fused, monkeypatch, iters=6, params=None):
         "tree_learner": "partitioned",
         "verbosity": -1, "metric": "", **(params or {})})
     ds = Dataset.from_numpy(X, cfg, label=y)
-    b = GBDT(cfg, ds)
+    b = create_boosting(cfg, ds)
     b.train(iters)
     b.finalize_trees()
     return b
@@ -99,6 +100,25 @@ def test_fused_multiclass_matches(monkeypatch):
                                       np.asarray(t1.split_feature))
         np.testing.assert_array_equal(np.asarray(t0.threshold_bin),
                                       np.asarray(t1.threshold_bin))
+    np.testing.assert_allclose(np.asarray(b0.predict_raw(X)),
+                               np.asarray(b1.predict_raw(X)),
+                               rtol=1e-5, atol=2e-6)
+
+
+def test_fused_goss_matches(monkeypatch):
+    # GOSS sampling is device-traceable (weights from a traced
+    # iteration index); fused must reproduce the per-iteration stream
+    X, y = _make(n=2000, seed=11)
+    p = {"boosting": "goss", "learning_rate": 0.3, "top_rate": 0.3,
+         "other_rate": 0.2}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, iters=8,
+                params=p)
+    b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, iters=8,
+                params=p)
+    assert len(b0.models) == len(b1.models)
+    for t0, t1 in zip(b0.models, b1.models):
+        np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                      np.asarray(t1.split_feature))
     np.testing.assert_allclose(np.asarray(b0.predict_raw(X)),
                                np.asarray(b1.predict_raw(X)),
                                rtol=1e-5, atol=2e-6)
