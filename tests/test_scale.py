@@ -78,6 +78,22 @@ def test_scale_2m_training_quality():
     assert _auc(bst.predict(X[:m], raw_score=True), y[:m]) > 0.85
 
 
+def test_scale_fused_scan_path(big_problem, monkeypatch):
+    """Six-figure-row run through the FUSED multi-iteration path
+    (models/gbdt.py _train_fused_blocks): int32 row-id bytes, the
+    stacked-TreeArrays host pull and the block ladder all at a scale
+    the 2k-row fused tests cannot reach."""
+    monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1")
+    X, y = big_problem
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "tree_learner": "partitioned", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    from lightgbm_tpu.models.tree import DeferredStackTree
+    assert any(isinstance(t, DeferredStackTree)
+               for t in bst._src().models)
+    assert _auc(bst.predict(X[:20000]), y[:20000]) > 0.9
+
+
 def test_scale_multival_sparse(big_problem):
     """Six-figure-row multi-val training (slot encode at scale): the
     bulk of the features is 97% sparse and conflict-heavy (multi-val),
