@@ -575,6 +575,8 @@ class GBDT:
 
     def _fused_scan_supported(self) -> bool:
         ln = getattr(self, "learner", None)
+        if os.environ.get("LGBM_TPU_NO_FUSE_ITERS"):
+            return False  # attribution/kill switch (perf sequence)
         on_device = jax.default_backend() in ("tpu", "axon") \
             or os.environ.get("LGBM_TPU_FUSE_ITERS") == "1"
         return (on_device

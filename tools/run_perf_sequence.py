@@ -93,15 +93,18 @@ def main():
     # exhausted budget means a fast kill, not a 300 s floor overrun)
     ok.append(run("bench", [sys.executable, "bench.py"],
                   max(min(bench_budget + 120.0, left()), 60.0), env))
-    # attribution run: same 500k point with the fused scan kernel
-    # disabled, so the kernel's contribution is directly measurable
-    env_noscan = dict(env)
-    env_noscan["LGBM_TPU_NO_SCAN_KERNEL"] = "1"
-    env_noscan["BENCH_ROWS"] = "500000"
-    env_noscan["BENCH_BUDGET_S"] = "600"
-    env_noscan["BENCH_NO_CPU_FALLBACK"] = "1"
-    ok.append(run("bench_noscan", [sys.executable, "bench.py"],
-                  max(min(700.0, left()), 60.0), env_noscan))
+    # attribution runs at the 500k point: (a) fused-iteration blocks
+    # off -> the dispatch-fusion contribution; (b) fused scan kernel
+    # off -> the scan kernel's contribution
+    for tag, var in (("bench_nofuse", "LGBM_TPU_NO_FUSE_ITERS"),
+                     ("bench_noscan", "LGBM_TPU_NO_SCAN_KERNEL")):
+        env_attr = dict(env)
+        env_attr[var] = "1"
+        env_attr["BENCH_ROWS"] = "500000"
+        env_attr["BENCH_BUDGET_S"] = "600"
+        env_attr["BENCH_NO_CPU_FALLBACK"] = "1"
+        ok.append(run(tag, [sys.executable, "bench.py"],
+                      max(min(700.0, left()), 60.0), env_attr))
     kernels_ok = run("check_kernels",
                      [sys.executable, "tools/check_kernels_on_chip.py"],
                      min(600, max(left() - 900, 120)))
