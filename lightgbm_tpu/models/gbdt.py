@@ -581,6 +581,10 @@ class GBDT:
             or os.environ.get("LGBM_TPU_FUSE_ITERS") == "1"
         return (on_device
                 and not self.valid_sets
+                # non-jittable objectives (rank_xendcg) draw host
+                # randomness per gradient call; inside a scan trace
+                # that draw would be frozen into the compiled program
+                and getattr(self.objective, "jittable", True)
                 # subclasses with their own sampling go through the
                 # per-iteration path unless it is device-traceable
                 # (GOSS); RF/host-RNG bagging stay excluded

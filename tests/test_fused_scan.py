@@ -134,3 +134,26 @@ def test_fused_declines_when_unsupported(monkeypatch):
     b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, params=p)
     np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
                                   np.asarray(b1.predict_raw(X)))
+
+
+def test_fused_declines_nonjittable_objective(monkeypatch):
+    # rank_xendcg draws host randomness per gradient call; inside a
+    # scan trace that draw would freeze into the compiled program, so
+    # the fused path must decline
+    monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1")
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5).astype(np.float32)
+    y = rng.randint(0, 4, 600).astype(np.float32)
+    group = np.full(30, 20, np.int64)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    cfg = Config.from_params({
+        "objective": "rank_xendcg", "num_leaves": 7,
+        "tree_learner": "partitioned", "verbosity": -1, "metric": ""})
+    ds = Dataset.from_numpy(X, cfg, label=y, group=group)
+    b = GBDT(cfg, ds)
+    b.train(4)
+    b.finalize_trees()
+    from lightgbm_tpu.models.tree import DeferredStackTree
+    assert not any(isinstance(t, DeferredStackTree) for t in b.models)
