@@ -46,6 +46,29 @@ def test_bench_child_prints_valid_json_line():
     assert json.loads(json.dumps(line)) == line
 
 
+def test_bench_main_probe_and_pinned_plan():
+    """Full main() flow: the 90s tunnel probe (succeeds on forced
+    CPU), the pinned-size plan, and the result-line passthrough."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the tunnel
+    env.update(JAX_PLATFORMS="cpu",
+               BENCH_ROWS="3000", BENCH_FEATURES="6",
+               BENCH_LEAVES="7", BENCH_ITERS="1",
+               BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_cpu_max_isa" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], env=env,
+        capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    sys.path.insert(0, REPO)
+    from bench import find_result_line
+    line = find_result_line(proc.stdout)
+    assert line is not None, proc.stdout[-2000:]
+    assert line["rows"] == 3000 and line["backend"] == "cpu"
+
+
 def test_find_result_line_takes_last_valid():
     sys.path.insert(0, REPO)
     from bench import find_result_line
