@@ -263,18 +263,21 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     b = num_bins_max
     big_l = num_leaves
 
-    # repack the gh payload in current row order (rows carry their id)
+    # repack the gh payload in current row order (rows carry their id).
+    # ONE row gather of the stacked [N, 3] table instead of three
+    # element gathers: the random-access stream is the cost on TPU, so
+    # fetching 12 contiguous bytes per index beats three 4-byte passes
     rids = extract_row_ids(mat, f, mat.shape[0])
     local = jnp.arange(mat.shape[0]) < n        # padding rows: all-zero
     lrid = rids - row_id_base
     rid_ok = local & (lrid >= 0) & (lrid < grad.shape[0]) \
         & (rids < n_total)
     rc_idx = jnp.clip(lrid, 0, grad.shape[0] - 1)
-    gp = jnp.where(rid_ok, grad[rc_idx], 0.0)
-    hp = jnp.where(rid_ok, hess[rc_idx], 0.0)
-    cp = jnp.where(rid_ok, bag_weight[rc_idx], 0.0)
-    gp = gp * cp
-    hp = hp * cp
+    ghb = jnp.stack([grad, hess, bag_weight], axis=1)     # [N, 3]
+    vals = jnp.where(rid_ok[:, None], ghb[rc_idx], 0.0)
+    cp = vals[:, 2]
+    gp = vals[:, 0] * cp
+    hp = vals[:, 1] * cp
     mat = pack_gh(mat, f, gp, hp, cp)
 
     def seg_hist(m, begin, count):
