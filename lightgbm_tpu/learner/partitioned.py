@@ -195,7 +195,9 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
 
     def traceable_grow(self, mat, ws, grad, hess, bag=None):
         """One tree grown inside an enclosing trace (no jit boundary,
-        no host state updates). Caller owns the mat/ws carry."""
+        no host state updates). Caller owns the mat/ws carry. Returns
+        ``(mat, ws, tree, (row_ids, pos_leaf))`` — leaf parts, not a
+        materialized leaf_id (see return_leaf_parts)."""
         if bag is None:
             bag = jnp.ones_like(grad)
         fmask = jnp.ones((self.num_features,), bool)
@@ -207,7 +209,8 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             num_features=self.num_features, num_groups=self.num_groups,
             n=self.num_data, bundled=self.bundled,
             interpret=self.interpret, forced_plan=self.forced_plan,
-            cache_hists=self.cache_hists, hist_slots=self.hist_slots)
+            cache_hists=self.cache_hists, hist_slots=self.hist_slots,
+            return_leaf_parts=True)
 
 
 @functools.partial(
@@ -241,7 +244,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      interpret, extra_trees=False, ff_bynode=1.0,
                      bynode_count=2, forced_plan=(), comm=None,
                      row_id_base=0, n_total=None, cache_hists=True,
-                     cegb_used0=None, hist_slots=None):
+                     cegb_used0=None, hist_slots=None,
+                     return_leaf_parts=False):
     """Traceable partitioned grow loop.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py)
@@ -749,6 +753,12 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         jnp.int32)
     rids_final = extract_row_ids(st["mat"], f, mat.shape[0])[:n] \
         - row_id_base
+    if return_leaf_parts:
+        # fused path: (row ids, per-POSITION leaf) lets the caller do
+        # its score update with ONE scatter-add instead of this
+        # scatter + a leaf_value gather (two random [N] passes)
+        return st["mat"], st["ws"], tree, (
+            jnp.clip(rids_final, 0, n - 1), pos_leaf)
     leaf_id = jnp.zeros((n,), jnp.int32).at[
         jnp.clip(rids_final, 0, n - 1)].set(pos_leaf)
 

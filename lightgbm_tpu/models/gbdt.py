@@ -56,12 +56,14 @@ def _fused_iter_block(mat, ws, score, lr, it0, *, learner, grad_fn,
         trees_k = []
         ok = None
         for tid in range(k):
-            mat, ws, tree, leaf_id = learner.traceable_grow(
+            mat, ws, tree, (row_ids, pos_leaf) = learner.traceable_grow(
                 mat, ws, grad[:, tid], hess[:, tid], bag=bag)
             ok_t = tree.num_leaves > 1
             scale = jnp.where(ok_t, lr, jnp.float32(0.0))
-            score = score.at[:, tid].add(
-                (tree.leaf_value * scale)[leaf_id])
+            # one scatter-add in segment order: row_ids is a
+            # permutation of [0, N), pos_leaf the leaf per POSITION
+            score = score.at[row_ids, tid].add(
+                (tree.leaf_value * scale)[pos_leaf])
             trees_k.append(tree)
             ok = ok_t if ok is None else (ok | ok_t)
         trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_k)
