@@ -41,6 +41,7 @@ dynamic VMEM slicing is needed anywhere.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -134,8 +135,30 @@ MAX_NIBBLE_F = 192  # nibble-kernel unroll cap (program size; ~1 MB VMEM)
 #   perfeat — one [40, 32] tile per feature; fewer lanes per op buys
 #     nothing on the VPU, but kept for measurement and as the simpler
 #     reference implementation.
-import os as _os
-HIST_VARIANT = _os.environ.get("LGBM_TPU_HIST_VARIANT", "grouped")
+HIST_VARIANT = os.environ.get("LGBM_TPU_HIST_VARIANT", "grouped")
+
+
+def _block_dma(mat_hbm, buf, sems, base, blk, win):
+    """Shared double-buffered input-stream DMA factory (all three
+    histogram kernels stream the same 8-aligned row windows)."""
+    def dma(slot, i):
+        start = pl.multiple_of(base + i * blk, ALIGN)
+        return pltpu.make_async_copy(
+            mat_hbm.at[pl.ds(start, win), :], buf.at[slot],
+            sems.at[slot])
+    return dma
+
+
+def _payload_lanes(g_hi, g_lo, h_hi, h_lo, cnt, lhs_p):
+    """Route the 5 payload planes into their (.., p) lane pattern —
+    shared by both nibble variants (the pattern repeats per lo/feature,
+    so one build serves every mask tile of the block)."""
+    pay = [g_hi.astype(jnp.float32), g_lo.astype(jnp.float32),
+           h_hi.astype(jnp.float32), h_lo.astype(jnp.float32), cnt]
+    pay_b = pay[PAY - 1]
+    for p in range(PAY - 2, -1, -1):
+        pay_b = jnp.where(lhs_p == p, pay[p], pay_b)
+    return pay_b
 
 
 def _decode_block(mat_i32, feat0: int, shift, rem, win: int):
@@ -178,11 +201,7 @@ def _hist_seg_kernel(scal_ref,          # SMEM [2] (begin, count)
     base = (begin // ALIGN) * ALIGN
     shift = begin - base
     win = blk + ALIGN
-
-    def dma(slot, i):
-        start = pl.multiple_of(base + i * blk, ALIGN)
-        return pltpu.make_async_copy(
-            mat_hbm.at[pl.ds(start, win), :], buf.at[slot], sems.at[slot])
+    dma = _block_dma(mat_hbm, buf, sems, base, blk, win)
 
     out_ref[...] = jnp.zeros_like(out_ref)
 
@@ -286,11 +305,7 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
 
     m_lhs = GRP * LO * PAY                           # 120
     n_rhs = GRP * hi_n
-
-    def dma(slot, i):
-        start = pl.multiple_of(base + i * blk, ALIGN)
-        return pltpu.make_async_copy(
-            mat_hbm.at[pl.ds(start, win), :], buf.at[slot], sems.at[slot])
+    dma = _block_dma(mat_hbm, buf, sems, base, blk, win)
 
     out_ref[...] = jnp.zeros_like(out_ref)
 
@@ -322,11 +337,8 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
         rem = jnp.minimum(count - i * blk, blk)
         _, g_hi, g_lo, h_hi, h_lo, cnt = _decode_block(
             mat_i32, feat0, shift, rem, win)
-        pay = [g_hi.astype(jnp.float32), g_lo.astype(jnp.float32),
-               h_hi.astype(jnp.float32), h_lo.astype(jnp.float32), cnt]
-        pay_b = pay[PAY - 1]
-        for p in range(PAY - 2, -1, -1):             # [win, m_lhs]
-            pay_b = jnp.where(lhs_p == p, pay[p], pay_b)
+        pay_b = _payload_lanes(g_hi, g_lo, h_hi, h_lo, cnt,
+                               lhs_p)                # [win, m_lhs]
 
         for gidx in range(ngroups):
             # tail group clamps past-F columns onto the last feature;
@@ -390,11 +402,7 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
     win = blk + ALIGN
 
     m_lhs = LO * PAY                                 # 40
-
-    def dma(slot, i):
-        start = pl.multiple_of(base + i * blk, ALIGN)
-        return pltpu.make_async_copy(
-            mat_hbm.at[pl.ds(start, win), :], buf.at[slot], sems.at[slot])
+    dma = _block_dma(mat_hbm, buf, sems, base, blk, win)
 
     out_ref[...] = jnp.zeros_like(out_ref)
 
@@ -422,11 +430,8 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
         _, g_hi, g_lo, h_hi, h_lo, cnt = _decode_block(
             mat_i32, feat0, shift, rem, win)
         # payload lane pattern is feature-independent: build once
-        pay = [g_hi.astype(jnp.float32), g_lo.astype(jnp.float32),
-               h_hi.astype(jnp.float32), h_lo.astype(jnp.float32), cnt]
-        pay_b = pay[PAY - 1]
-        for p in range(PAY - 2, -1, -1):             # [win, m_lhs]
-            pay_b = jnp.where(lhs_p == p, pay[p], pay_b)
+        pay_b = _payload_lanes(g_hi, g_lo, h_hi, h_lo, cnt,
+                               lhs_p)                # [win, m_lhs]
 
         # feature loop unrolled with STATIC column indices: a traced
         # index would force each feature column out of the [win, C]
@@ -455,19 +460,17 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
     static_argnames=("num_features", "num_bins", "blk", "interpret",
                      "variant"))
 def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
-                              num_bins: int, blk: int = 2048,
-                              interpret: bool = False,
-                              variant: str | None = None):
+                              num_bins: int, variant: str,
+                              blk: int = 2048,
+                              interpret: bool = False):
     """Nibble-kernel call -> [F, B, 3] histogram.
 
-    ``variant`` must be resolved by the CALLER (histogram_segment):
-    a None default resolved here would freeze the module global into
-    the jit cache on first trace.
+    ``variant`` is REQUIRED and resolved by the caller
+    (histogram_segment): a None default resolved here would freeze the
+    module global into the jit cache on first trace.
     """
     if blk % ALIGN:
         raise ValueError(f"blk must be a multiple of {ALIGN}, got {blk}")
-    if variant is None:
-        variant = HIST_VARIANT
     _, cols = mat.shape
     f = num_features
     hi_n = -(-num_bins // LO)                        # ceil(B / LO)
