@@ -1,0 +1,130 @@
+"""On-chip probe: can Mosaic build one-hot masks at int8 throughput?
+
+The nibble histogram kernel is VPU-mask-bound (~120 Mrow/s modeled at
+f32: each vector op costs ~rows/8 cycles regardless of lane count).
+Mosaic's int8 tile is (32, 128) — IF u8/i8 compares+selects process 4x
+the sublanes per cycle AND the i8->bf16 route to the MXU is cheap, the
+mask ceiling rises ~4x. This probe measures three block-shaped
+candidates COMPILED on the real chip (no full kernel rewrite):
+
+  f32   — today's mask build (compare i32, select f32, cast bf16)
+  i8    — compare u8, select i8, convert i8->i32->f32->bf16 at the end
+  i8mm  — compare u8, select i8, feed an s8 x s8 -> s32 MXU matmul for
+          the COUNT plane only (payload planes stay bf16)
+
+Each candidate runs as a tiny Pallas kernel over a resident [win, C]
+u8 buffer, chained K times inside one jit so tunnel dispatch cost
+amortizes. Failures print and skip — an unsupported lowering is a
+RESULT, not an error.
+
+Run (sole tunnel client): python tools/probe_i8_masks.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+WIN = 2048
+C = 128
+LANES = 120
+K_CHAIN = 50
+REPS = 40        # mask builds per kernel invocation
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from lightgbm_tpu.utils.sync import fetch_one
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        print(f"needs the real TPU (backend={jax.default_backend()})")
+        return 2
+
+    rng = np.random.RandomState(0)
+    blk = jnp.asarray(rng.randint(0, 255, (WIN, C)), jnp.uint8)
+
+    def mk(kernel_body, out_dtype):
+        def kern(in_ref, out_ref):
+            acc = None
+            for r in range(REPS):
+                v = kernel_body(in_ref, r)
+                acc = v if acc is None else acc + v
+            out_ref[...] = acc
+
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((8, LANES), out_dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+        )
+
+    lane = None  # built inside kernels (broadcasted_iota)
+
+    def body_f32(in_ref, r):
+        import jax.lax as lax
+        m = in_ref[...].astype(jnp.int32)             # [WIN, C]
+        pat = lax.broadcasted_iota(jnp.int32, (1, LANES), 1) % 8
+        col = m[:, r % C:r % C + 1]
+        mask = jnp.where((col - (col // 8) * 8) == pat,
+                         jnp.float32(1), jnp.float32(0))
+        return mask[:8, :].astype(jnp.float32)
+
+    def body_i8(in_ref, r):
+        import jax.lax as lax
+        m = in_ref[...]                               # [WIN, C] u8
+        pat = lax.broadcasted_iota(jnp.uint8, (1, LANES), 1)
+        col = m[:, r % C:r % C + 1]
+        lo = col & jnp.uint8(7)
+        mask = jnp.where(lo == (pat & jnp.uint8(7)), jnp.uint8(1),
+                         jnp.uint8(0))
+        return mask[:8, :].astype(jnp.int32).astype(jnp.float32)
+
+    def body_i8mm(in_ref, r):
+        import jax.lax as lax
+        m = in_ref[...]
+        pat = lax.broadcasted_iota(jnp.uint8, (1, LANES), 1)
+        col = m[:, r % C:r % C + 1]
+        lo = col & jnp.uint8(7)
+        mask = jnp.where(lo == (pat & jnp.uint8(7)), jnp.int8(1),
+                         jnp.int8(0))                 # [WIN, LANES] i8
+        ones = jnp.ones((WIN, 8), jnp.int8)
+        res = lax.dot_general(ones, mask, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        return res.astype(jnp.float32)                # [8, LANES]
+
+    for name, body in (("f32", body_f32), ("i8", body_i8),
+                       ("i8mm", body_i8mm)):
+        try:
+            call = mk(body, jnp.float32)
+
+            @jax.jit
+            def chain(x, call=call):
+                def step(i, acc):
+                    return acc + call(x)[0, 0]
+                return jax.lax.fori_loop(0, K_CHAIN, step,
+                                         jnp.float32(0))
+
+            r = chain(blk)
+            fetch_one(r)                  # compile + first run
+            t0 = time.perf_counter()
+            fetch_one(chain(blk))
+            dt = (time.perf_counter() - t0) / K_CHAIN / REPS
+            rows_s = WIN / dt
+            print(f"{name:5s}: {dt*1e6:8.2f} us/mask-build "
+                  f"({rows_s/1e6:8.1f} Mrow/s per 120-lane mask)")
+        except Exception as e:  # noqa: BLE001 — unsupported IS a result
+            print(f"{name:5s}: UNSUPPORTED/FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
