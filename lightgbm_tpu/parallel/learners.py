@@ -518,9 +518,10 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         n_pad = self._n_pad
         comm = self.comm
 
-        def body(mat3, ws3, grad, hess, bag, fmask, rkey, cegb0):
+        def grow_shard(mat3, ws3, grad, hess, bag, fmask, rkey, cegb0,
+                       leaf_parts):
             base = jax.lax.axis_index(AXIS) * n_local
-            mat_l, ws_l, tree, leaf_id = grow_partitioned(
+            out = grow_partitioned(
                 mat3[0], ws3[0], grad, hess, bag, fmask, self.meta,
                 rand_key=rkey, params=self.params,
                 num_leaves=self.num_leaves, max_depth=self.max_depth,
@@ -533,17 +534,31 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                 forced_plan=self.forced_plan, comm=comm,
                 row_id_base=base, n_total=n_pad,
                 cache_hists=self.cache_hists,
-                cegb_used0=cegb0 if self.params.cegb_on else None)
+                cegb_used0=cegb0 if self.params.cegb_on else None,
+                return_leaf_parts=leaf_parts)
+            if leaf_parts:
+                mat_l, ws_l, tree, (rid_l, pos_leaf) = out
+                # GLOBAL ids: unique across shards; the caller's
+                # scatter-add drops pad ids >= num_data (JAX OOB-write
+                # semantics), so padding never aliases a real row
+                return (mat_l[None], ws_l[None], tree,
+                        rid_l + base, pos_leaf)
+            mat_l, ws_l, tree, leaf_id = out
             return mat_l[None], ws_l[None], tree, leaf_id
 
-        mapped = shard_map(
-            body, mesh=self.mesh,
-            in_specs=(P(AXIS, None, None), P(AXIS, None, None),
-                      P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
-            out_specs=(P(AXIS, None, None), P(AXIS, None, None),
-                       TreeArrays_spec(), P(AXIS)),
-            check_rep=False)
-        self._fn = jax.jit(mapped, donate_argnums=(0, 1))
+        def mk_mapped(leaf_parts):
+            out_tail = (P(AXIS), P(AXIS)) if leaf_parts else (P(AXIS),)
+            return shard_map(
+                functools.partial(grow_shard, leaf_parts=leaf_parts),
+                mesh=self.mesh,
+                in_specs=(P(AXIS, None, None), P(AXIS, None, None),
+                          P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
+                out_specs=(P(AXIS, None, None), P(AXIS, None, None),
+                           TreeArrays_spec()) + out_tail,
+                check_rep=False)
+
+        self._fn = jax.jit(mk_mapped(False), donate_argnums=(0, 1))
+        self._mapped_parts = mk_mapped(True)   # fused path (traced)
 
     def train(self, grad, hess, bag_weight=None, feature_mask=None
               ) -> GrowResult:
@@ -569,6 +584,34 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         res = GrowResult(tree=tree, leaf_id=leaf_id[:n])
         self._cegb_after_tree(res)
         return res
+
+    # -- fused-scan training hook (models/gbdt.py) ---------------------
+    supports_fused_scan = True
+
+    def fused_scan_ok(self) -> bool:
+        return (not self.params.cegb_on and not self.extra_trees
+                and self.ff_bynode >= 1.0
+                and getattr(self, "_cegb_used", None) is None)
+
+    def traceable_grow(self, mat, ws, grad, hess, bag=None):
+        """One mesh-parallel tree inside an enclosing trace. Returns
+        ``(mat, ws, tree, (global_row_ids, pos_leaf))`` with padded
+        entries carrying ids >= num_data (dropped by the caller's
+        scatter-add)."""
+        n = self.dataset.num_data
+        if bag is None:
+            bag = jnp.ones((n,), jnp.float32)
+        pad = self._n_pad - n
+        if pad:
+            grad = jnp.pad(grad, (0, pad))
+            hess = jnp.pad(hess, (0, pad))
+            bag = jnp.pad(bag, (0, pad))
+        fmask = jnp.ones((self.num_features,), bool)
+        rkey = jnp.zeros((2, 2), jnp.uint32)
+        cegb0 = jnp.zeros((self.num_features,), bool)
+        mat, ws, tree, rids, pos_leaf = self._mapped_parts(
+            mat, ws, grad, hess, bag, fmask, rkey, cegb0)
+        return mat, ws, tree, (rids, pos_leaf)
 
 def TreeArrays_spec():
     """Replicated out_spec for every TreeArrays field."""

@@ -136,6 +136,35 @@ def test_fused_declines_when_unsupported(monkeypatch):
                                   np.asarray(b1.predict_raw(X)))
 
 
+def test_fused_mesh_data_parallel_matches(monkeypatch):
+    # the mesh partitioned learner (8-device CPU mesh) fuses the same
+    # way: one shard_map'd tree per scan step, score scatter-add on
+    # GLOBAL row ids with pad ids dropped. The CPU factory never picks
+    # MeshPartitioned, so force it through the factory seam.
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs the 8-device virtual mesh")
+    import lightgbm_tpu.parallel as par
+    from lightgbm_tpu.parallel.learners import MeshPartitionedTreeLearner
+
+    def force_mesh(lt, ds, cfg, mesh=None, hist_method="auto"):
+        return MeshPartitionedTreeLearner(ds, cfg, mode="data",
+                                          interpret=True)
+
+    monkeypatch.setattr(par, "create_tree_learner", force_mesh)
+    X, y = _make(n=1900, seed=9)   # not divisible by 8: pad path
+    p = {"tree_learner": "data", "num_machines": 8}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, params=p)
+    b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, params=p)
+    assert len(b0.models) == len(b1.models)
+    from lightgbm_tpu.models.tree import DeferredStackTree
+    assert any(isinstance(t, DeferredStackTree) for t in b1.models)
+    np.testing.assert_allclose(np.asarray(b0.predict_raw(X)),
+                               np.asarray(b1.predict_raw(X)),
+                               rtol=1e-5, atol=2e-6)
+
+
 def test_fused_declines_nonjittable_objective(monkeypatch):
     # rank_xendcg draws host randomness per gradient call; inside a
     # scan trace that draw would freeze into the compiled program, so
