@@ -165,6 +165,33 @@ def test_fused_mesh_data_parallel_matches(monkeypatch):
                                rtol=1e-5, atol=2e-6)
 
 
+def test_fused_mesh_voting_matches(monkeypatch):
+    # voting-parallel: the comm carries top-k gather collectives inside
+    # the grow loop; they must trace identically under the fused scan
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs the 8-device virtual mesh")
+    import lightgbm_tpu.parallel as par
+    from lightgbm_tpu.parallel.learners import MeshPartitionedTreeLearner
+
+    def force_mesh(lt, ds, cfg, mesh=None, hist_method="auto"):
+        return MeshPartitionedTreeLearner(ds, cfg, mode="voting",
+                                          interpret=True)
+
+    monkeypatch.setattr(par, "create_tree_learner", force_mesh)
+    X, y = _make(n=1600, seed=13)
+    p = {"tree_learner": "voting", "num_machines": 8, "top_k": 10}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, iters=4,
+                params=p)
+    b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, iters=4,
+                params=p)
+    assert len(b0.models) == len(b1.models)
+    np.testing.assert_allclose(np.asarray(b0.predict_raw(X)),
+                               np.asarray(b1.predict_raw(X)),
+                               rtol=1e-5, atol=2e-6)
+
+
 def test_fused_declines_nonjittable_objective(monkeypatch):
     # rank_xendcg draws host randomness per gradient call; inside a
     # scan trace that draw would freeze into the compiled program, so
