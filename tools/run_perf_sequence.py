@@ -107,7 +107,7 @@ def main():
                       max(min(700.0, left()), 60.0), env_attr))
     kernels_ok = run("check_kernels",
                      [sys.executable, "tools/check_kernels_on_chip.py"],
-                     min(600, max(left() - 900, 120)))
+                     min(900, max(left() - 900, 120)))
     ok.append(kernels_ok)
     if kernels_ok and left() > 900:
         # compiled v2 partition validated -> measure it end-to-end at
