@@ -105,6 +105,18 @@ def test_fused_multiclass_matches(monkeypatch):
                                rtol=1e-5, atol=2e-6)
 
 
+def test_fused_bounded_hist_pool_matches(monkeypatch):
+    # the bounded LRU histogram pool nests lax.cond branches inside
+    # the grow while_loop; they must trace identically under the
+    # fused scan
+    X, y = _make(seed=21)
+    p = {"num_leaves": 15, "histogram_pool_size": 0.01}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, params=p)
+    b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, params=p)
+    np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
+                                  np.asarray(b1.predict_raw(X)))
+
+
 def test_fused_goss_matches(monkeypatch):
     # GOSS sampling is device-traceable (weights from a traced
     # iteration index); fused must reproduce the per-iteration stream
