@@ -20,4 +20,7 @@ def fetch_one(tree):
               if hasattr(x, "ravel") and getattr(x, "size", 0)]
     if not leaves:
         return None
-    return np.asarray(leaves[0]).ravel()[0]
+    # index on DEVICE first: np.asarray on the full leaf would transfer
+    # the whole array through the tunnel before slicing, an O(N) cost
+    # inside callers' timed regions
+    return np.asarray(leaves[0].ravel()[0])

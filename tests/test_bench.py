@@ -20,7 +20,7 @@ def test_bench_child_prints_valid_json_line():
     env.update(_BENCH_CHILD="1", JAX_PLATFORMS="cpu",
                BENCH_ROWS="3000", BENCH_FEATURES="6",
                BENCH_LEAVES="7", BENCH_ITERS="1",
-               BENCH_WARMUP_ITERS="1", BENCH_EVAL="1")
+               BENCH_WARMUP_ITERS="1", BENCH_MIN_AUC="0.4")
     flags = env.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
         env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
@@ -40,7 +40,8 @@ def test_bench_child_prints_valid_json_line():
     assert line["rows"] == 3000
     assert line["num_leaves"] == 7
     assert line["backend"] == "cpu"
-    assert 0.4 < line["auc"] <= 1.0   # BENCH_EVAL quality gate ran
+    assert 0.4 < line["auc"] <= 1.0   # default-on quality gate ran
+    assert line["quality_ok"] is True
     # the driver parses the LAST json line; make sure serialization
     # round-trips
     assert json.loads(json.dumps(line)) == line
@@ -54,7 +55,8 @@ def test_bench_main_probe_and_pinned_plan():
     env.update(JAX_PLATFORMS="cpu",
                BENCH_ROWS="3000", BENCH_FEATURES="6",
                BENCH_LEAVES="7", BENCH_ITERS="1",
-               BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500")
+               BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500",
+               BENCH_MIN_AUC="0.4", BENCH_ALLOW_CPU="1")
     flags = env.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
         env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
@@ -67,6 +69,31 @@ def test_bench_main_probe_and_pinned_plan():
     line = find_result_line(proc.stdout)
     assert line is not None, proc.stdout[-2000:]
     assert line["rows"] == 3000 and line["backend"] == "cpu"
+
+
+def test_bench_quality_gate_is_loud():
+    """A run whose AUC misses the bar still prints its line (honest
+    record) but exits 3 so an unattended driver can't read garbage
+    training as success."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the tunnel
+    env.update(JAX_PLATFORMS="cpu",
+               BENCH_ROWS="3000", BENCH_FEATURES="6",
+               BENCH_LEAVES="7", BENCH_ITERS="1",
+               BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500",
+               BENCH_MIN_AUC="1.01",   # unreachable bar
+               BENCH_ALLOW_CPU="1")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_cpu_max_isa" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], env=env,
+        capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-2000:])
+    sys.path.insert(0, REPO)
+    from bench import find_result_line
+    line = find_result_line(proc.stdout)
+    assert line is not None and line["quality_ok"] is False
 
 
 def test_find_result_line_takes_last_valid():

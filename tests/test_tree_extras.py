@@ -105,3 +105,41 @@ def test_extra_trees_parallel_smoke(learner):
     b = _train({**BASE, "extra_trees": True, "tree_learner": learner,
                 "num_leaves": 7}, X, y, iters=5)
     assert _mse(b, X, y) < 0.8 * float(np.var(y))
+
+
+def test_no_split_tree_materializes_to_zero():
+    """A 1-leaf tree from the async/fused paths contributed EXACTLY
+    zero to the training score (scale 0, gbdt.py); its materialized
+    root value must be zero too — through shrink — so predict matches
+    the training-score contribution (r4 advisor finding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.models.tree import (DeferredStackTree,
+                                          DeferredTree, TreeArrays,
+                                          TreeStack)
+    L = 4
+    arr = TreeArrays(
+        num_leaves=jnp.int32(1),
+        split_feature=jnp.zeros(L - 1, jnp.int32),
+        threshold_bin=jnp.zeros(L - 1, jnp.int32),
+        decision_type=jnp.zeros(L - 1, jnp.int32),
+        left_child=jnp.zeros(L - 1, jnp.int32),
+        right_child=jnp.zeros(L - 1, jnp.int32),
+        split_gain=jnp.zeros(L - 1, jnp.float32),
+        internal_value=jnp.zeros(L - 1, jnp.float32),
+        internal_weight=jnp.zeros(L - 1, jnp.float32),
+        internal_count=jnp.zeros(L - 1, jnp.float32),
+        leaf_value=jnp.full(L, 2.5, jnp.float32),   # nonzero root
+        leaf_weight=jnp.ones(L, jnp.float32),
+        leaf_count=jnp.ones(L, jnp.float32),
+        leaf_parent=jnp.zeros(L, jnp.int32),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        cat_bitsets=jnp.zeros((L - 1, 8), jnp.uint32))
+    t = DeferredTree(arr, shrinkage=0.1).materialize()
+    assert t.num_leaves == 1
+    np.testing.assert_array_equal(t.leaf_value, 0.0)
+
+    stacked = jax.tree.map(lambda x: jnp.stack([x, x]), arr)
+    ts = DeferredStackTree(TreeStack(stacked), 1, shrinkage=0.1)
+    np.testing.assert_array_equal(ts.materialize().leaf_value, 0.0)

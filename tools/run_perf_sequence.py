@@ -72,7 +72,8 @@ def main():
         return budget - (time.time() - t0)
 
     if not run("probe", [sys.executable, "-c",
-                         "import jax; print(jax.devices())"], 90):
+                         "import jax; d = jax.devices(); print(d); "
+                         "assert d and d[0].platform != 'cpu', d"], 90):
         print("TPU unreachable; aborting sequence")
         return 2
 

@@ -1,0 +1,33 @@
+#!/bin/sh
+# Tunnel watcher: poll the axon TPU tunnel every ~3 minutes with a
+# bounded single-client probe; on the FIRST healthy probe, run the full
+# measurement sequence (tools/run_perf_sequence.py) and exit.
+#
+# Run detached (no tmux on this host):
+#   setsid nohup sh tools/tpu_watch.sh > /tmp/tpu_watch.log 2>&1 &
+#
+# One tunnel client at a time (the tunnel wedges for hours under two
+# concurrent clients): never start this while another TPU process runs,
+# and the watcher itself serializes probe -> sequence.
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO" || exit 1
+MARKER=/tmp/perf_sequence_done
+i=0
+while [ ! -f "$MARKER" ]; do
+    i=$((i + 1))
+    echo "[watch] probe $i $(date -u +%H:%M:%S)"
+    if timeout 90 python -c "import jax; d = jax.devices(); print(d); assert d and d[0].platform != 'cpu', d"; then
+        echo "[watch] tunnel UP; launching perf sequence $(date -u +%H:%M:%S)"
+        PERF_SEQ_BUDGET_S="${PERF_SEQ_BUDGET_S:-5400}" \
+            timeout 7200 python tools/run_perf_sequence.py
+        rc=$?
+        echo "[watch] sequence rc=$rc $(date -u +%H:%M:%S)"
+        if [ "$rc" != 2 ]; then
+            # rc 2 = the sequence's own probe failed (tunnel died
+            # between our probe and its start): keep watching
+            touch "$MARKER"
+        fi
+    fi
+    sleep 170
+done
+echo "[watch] done"
