@@ -141,6 +141,41 @@ class Dataset:
         data = self.data
         feature_name = self.feature_name
         cat_idx: List[int] = []
+        if isinstance(data, str) and cfg.two_round:
+            # memory-bounded two-pass ingestion (dataset_loader.cpp
+            # two_round branch): the raw float matrix never
+            # materializes, so categorical indices resolve against the
+            # header names only
+            from .data.dataset import load_forced_bins
+            from .data.file_loader import TwoRoundLoader
+            names = TwoRoundLoader(data, cfg).resolve_feature_names()
+            if feature_name == "auto":
+                feature_name = None
+            ref_inner = self.reference._inner \
+                if self.reference is not None else None
+            cat_idx = _resolve_categorical(
+                self.categorical_feature, names or feature_name, None)
+            self._inner = _InnerDataset.from_file_two_round(
+                data, cfg, label=self.label, weight=self.weight,
+                group=self.group, init_score=self.init_score,
+                feature_names=feature_name,
+                categorical_features=cat_idx, reference=ref_inner,
+                forced_bins={} if ref_inner is not None
+                else load_forced_bins(cfg.forcedbins_filename))
+            # backfill from the file/sidecars like the one-round str
+            # branch, so get_label()/get_init_score() etc. see them
+            md = self._inner.metadata
+            if self.label is None:
+                self.label = md.label
+            if self.weight is None:
+                self.weight = md.weights
+            if self.group is None and md.query_boundaries is not None:
+                self.group = np.diff(md.query_boundaries)
+            if self.init_score is None:
+                self.init_score = md.init_score
+            if self.free_raw_data:
+                self.data = None
+            return self
         if isinstance(data, str):
             from .data.file_loader import load_file
             data, label, weight, group, init, fn = load_file(data, cfg)
@@ -483,6 +518,32 @@ class Booster:
         if _is_pandas_df(data):
             data = _apply_pandas_categorical(data,
                                              self.pandas_categorical)
+        elif _is_sparse(data):
+            # Bosch/Criteo-scale CSR must not densify whole
+            # (predictor.hpp:39-131 predicts sparse rows directly):
+            # stream fixed-size row chunks through the dense path —
+            # fixed so the device scan compiles ONCE; the ragged tail
+            # is zero-padded and sliced off
+            import os as _os
+            chunk = int(_os.environ.get(
+                "LGBM_TPU_SPARSE_PREDICT_CHUNK_ROWS", 65536))
+            n = data.shape[0]
+            if n > chunk:
+                csr = data.tocsr()
+                parts = []
+                for lo in range(0, n, chunk):
+                    sub = np.asarray(
+                        csr[lo:lo + chunk].todense(), np.float64)
+                    m = sub.shape[0]
+                    if m < chunk:
+                        sub = np.concatenate(
+                            [sub, np.zeros((chunk - m, sub.shape[1]))])
+                    parts.append(self.predict(
+                        sub, num_iteration=num_iteration,
+                        raw_score=raw_score, pred_leaf=pred_leaf,
+                        pred_contrib=pred_contrib, **kwargs)[:m])
+                return np.concatenate(parts)
+            data = _to_matrix(data)
         else:
             data = _to_matrix(data)
         data = np.asarray(data, np.float64)

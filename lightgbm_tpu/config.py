@@ -333,6 +333,9 @@ class Config:
 
     # internal, filled by check_param_conflict
     is_parallel: bool = False
+    # derived like the reference (config.cpp:275-295): data/voting
+    # learners find bins cooperatively (seed + sample sync)
+    is_parallel_find_bin: bool = False
 
     def __post_init__(self):
         self.objective = _OBJECTIVE_ALIASES.get(self.objective, self.objective)
@@ -342,10 +345,12 @@ class Config:
     # non-default value warns loudly instead of silently ignoring.
     # Structurally-meaningless-on-TPU params (num_threads,
     # force_col_wise/row_wise, is_enable_sparse, pre_partition,
-    # two_round, gpu_*) are accepted silently for config compatibility
+    # gpu_*) are accepted silently for config compatibility
     # — XLA owns threading/layout/memory. histogram_pool_size IS
     # honored: when the per-leaf histogram cache would exceed it, the
-    # grow loops run pool-bounded (learner/serial.py:use_hist_cache).
+    # grow loops run pool-bounded (learner/serial.py:use_hist_cache);
+    # two_round IS honored: file ingestion streams in two memory-
+    # bounded passes (data/dataset.py:from_file_two_round).
 
     @classmethod
     def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
@@ -422,6 +427,21 @@ class Config:
                 self.tree_learner = "serial"
         else:
             self.is_parallel = True
+        # is_parallel_find_bin derivation (config.cpp:283-295): data and
+        # voting learners share one bin-finding sample; the data learner
+        # also disables the histogram LRU pool to avoid paying its
+        # refetch communication on every shard
+        if self.tree_learner in ("data", "voting"):
+            self.is_parallel_find_bin = True
+            if self.histogram_pool_size >= 0 \
+                    and self.tree_learner == "data":
+                log_warning(
+                    "Histogram LRU queue was enabled "
+                    f"(histogram_pool_size={self.histogram_pool_size}).\n"
+                    "Will disable this to reduce communication costs")
+                self.histogram_pool_size = -1
+        else:
+            self.is_parallel_find_bin = False
         if self.tree_learner == "feature" and self.bagging_fraction < 1.0:
             log_warning("Found bagging_fraction with feature parallel; "
                         "bagging applies to the full data on every shard")

@@ -283,17 +283,7 @@ class Dataset:
 
         if reference is not None:
             # valid set aligned with train (CreateValid, dataset.cpp:703)
-            self.bin_mappers = reference.bin_mappers
-            self.used_feature_map = reference.used_feature_map
-            self.real_feature_idx = reference.real_feature_idx
-            self.max_bin = reference.max_bin
-            self.feature_names = reference.feature_names
-            self.monotone_types = reference.monotone_types
-            self.feature_penalty = reference.feature_penalty
-            self.feature_group = reference.feature_group
-            self.feature_offset = reference.feature_offset
-            self.group_num_bins = reference.group_num_bins
-            self.mv_group_start = reference.mv_group_start
+            self._copy_layout_from(reference)
         else:
             self._find_bins(data, config, categorical_features, forced_bins)
             self._resolve_monotone_and_penalty(config)
@@ -321,16 +311,26 @@ class Dataset:
     def _find_bins(self, data: np.ndarray, config: Config,
                    categorical_features: Sequence[int],
                    forced_bins: Optional[Dict[int, List[float]]]) -> None:
-        n, num_features = data.shape
+        n = data.shape[0]
         sample_cnt = min(n, self.bin_construct_sample_cnt)
         rng = np.random.RandomState(config.data_random_seed)
         if sample_cnt < n:
             sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
         else:
             sample_idx = np.arange(n)
+        self._find_bins_from_sample(
+            np.asarray(data[sample_idx], np.float64), n, config,
+            categorical_features, forced_bins)
+
+    def _find_bins_from_sample(
+            self, sample: np.ndarray, n: int, config: Config,
+            categorical_features: Sequence[int],
+            forced_bins: Optional[Dict[int, List[float]]]) -> None:
+        """BinMapper construction from an already-drawn row sample
+        (shared by the in-memory and two_round loaders)."""
+        num_features = sample.shape[1]
         # distributed bin finding (dataset_loader.cpp:824-1001): with
         # pre-partitioned shards the hosts agree on one global sample
-        sample = np.asarray(data[sample_idx], np.float64)
         from ..parallel.distributed import maybe_gather_bin_sample
         sample, n_global = maybe_gather_bin_sample(sample, config, n)
         sample_cnt = sample.shape[0]
@@ -362,6 +362,22 @@ class Dataset:
             self.bin_mappers.append(mapper)
 
         self._finalize_used_features()
+
+    def _copy_layout_from(self, reference: "Dataset") -> None:
+        """Adopt a constructed reference's bin/bundle layout so the new
+        dataset aligns with it bit-for-bit (CreateValid,
+        dataset.cpp:703 — shared by every loader)."""
+        self.bin_mappers = reference.bin_mappers
+        self.used_feature_map = reference.used_feature_map
+        self.real_feature_idx = reference.real_feature_idx
+        self.max_bin = reference.max_bin
+        self.feature_names = reference.feature_names
+        self.monotone_types = reference.monotone_types
+        self.feature_penalty = reference.feature_penalty
+        self.feature_group = reference.feature_group
+        self.feature_offset = reference.feature_offset
+        self.group_num_bins = reference.group_num_bins
+        self.mv_group_start = reference.mv_group_start
 
     def _finalize_used_features(self) -> None:
         self.used_feature_map = []
@@ -439,6 +455,131 @@ class Dataset:
 
     # ------------------------------------------------------------------
     @classmethod
+    def from_file_two_round(
+            cls, path: str, config: Config,
+            label=None, weight=None, group=None, init_score=None,
+            feature_names: Optional[List[str]] = None,
+            categorical_features: Sequence[int] = (),
+            forced_bins: Optional[Dict[int, List[float]]] = None,
+            reference: Optional["Dataset"] = None) -> "Dataset":
+        """Memory-bounded two-pass file ingestion (``two_round=true``,
+        DatasetLoader::LoadFromFile two_round branch,
+        dataset_loader.cpp:201-216): sample + metadata stream in pass
+        1, features bin chunk-by-chunk straight into the packed matrix
+        in pass 2. Explicit label/weight/group/init_score arguments
+        override the file's columns, like the in-memory path."""
+        from .file_loader import TwoRoundLoader
+        loader = TwoRoundLoader(path, config)
+        n = loader.count_rows()
+        self = cls()
+        self.num_data = n
+        self.max_bin = config.max_bin
+        self.bin_construct_sample_cnt = config.bin_construct_sample_cnt
+        self.min_data_in_bin = config.min_data_in_bin
+        self.use_missing = config.use_missing
+        self.zero_as_missing = config.zero_as_missing
+
+        # ---- pass 1: sample rows (same sorted-choice stream as the
+        # in-memory path -> bit-identical BinMappers) + label columns
+        sample_cnt = min(n, self.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed)
+        if sample_cnt < n:
+            sample_idx = np.sort(rng.choice(n, sample_cnt,
+                                            replace=False))
+        else:
+            sample_idx = np.arange(n)
+        sample_parts: List[np.ndarray] = []
+        labels: List[np.ndarray] = []
+        weights: List[np.ndarray] = []
+        qids: List[np.ndarray] = []
+        r = 0
+        num_features = 0
+        for X, lab, wt, qid in loader.iter_chunks():
+            m = X.shape[0]
+            num_features = X.shape[1]
+            lo = np.searchsorted(sample_idx, r)
+            hi = np.searchsorted(sample_idx, r + m)
+            if hi > lo:
+                sample_parts.append(X[sample_idx[lo:hi] - r])
+            labels.append(np.asarray(lab, np.float64))
+            if wt is not None:
+                weights.append(np.asarray(wt, np.float64))
+            if qid is not None:
+                qids.append(np.asarray(qid, np.float64))
+            r += m
+        if r != n:
+            log_fatal(f"two_round load of {path}: pass 1 saw {r} rows "
+                      f"but the file has {n}")
+        self.num_total_features = num_features
+        self.feature_names = feature_names or loader.feature_names \
+            or [f"Column_{i}" for i in range(num_features)]
+        sample = (np.concatenate(sample_parts) if sample_parts
+                  else np.zeros((0, num_features)))
+
+        if reference is not None:
+            self._copy_layout_from(reference)
+        else:
+            self._find_bins_from_sample(sample, n, config,
+                                        categorical_features,
+                                        forced_bins)
+            self._resolve_monotone_and_penalty(config)
+
+        # ---- pass 2: chunked extraction into the packed matrix
+        width = max(self.num_features, 1)
+        max_b = max([self.num_bin(f)
+                     for f in range(self.num_features)], default=2)
+        dtype = np.uint8 if max_b <= 256 else np.uint16
+        out = np.zeros((n, width), dtype=dtype)
+        r = 0
+        for X, _, _, _ in loader.iter_chunks():
+            m = X.shape[0]
+            for inner, orig in enumerate(self.real_feature_idx):
+                mapper = self.bin_mappers[orig]
+                out[r:r + m, inner] = mapper.values_to_bins(
+                    np.asarray(X[:, orig], np.float64)).astype(dtype)
+            r += m
+        self.binned = out
+
+        if reference is None:
+            self._maybe_bundle(config)
+        elif self.feature_group is not None:
+            from .bundling import build_mv_slots, bundle_matrix
+            plan = self.bundle_plan()
+            raw = self.binned
+            self.binned = bundle_matrix(raw, plan)
+            if plan.has_multival:
+                from .bundling import dense_feature_bins
+                self.mv_slots = build_mv_slots(plan, raw.shape[0],
+                                               dense_feature_bins(raw))
+
+        # ---- metadata: file columns, sidecars, explicit overrides
+        f_weight, f_group, f_init = loader.load_sidecars()
+        if label is None and labels:
+            label = np.concatenate(labels)
+        if weight is None:
+            weight = f_weight if f_weight is not None else (
+                np.concatenate(weights) if weights else None)
+        if group is None:
+            if f_group is not None:
+                group = f_group
+            elif qids:
+                from .file_loader import _qid_to_group_sizes
+                group = _qid_to_group_sizes(np.concatenate(qids))
+        if init_score is None:
+            init_score = f_init
+        self.metadata.num_data = n
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weights(weight)
+        self.metadata.set_query(
+            None if group is None else np.asarray(group, np.int64))
+        self.metadata.set_init_score(init_score)
+        log_info(f"Loaded {n} rows x {num_features} features from "
+                 f"{path} in two passes ({loader.fmt})")
+        return self
+
+    # ------------------------------------------------------------------
+    @classmethod
     def from_scipy(cls, data, config: Config,
                    label: Optional[Sequence[float]] = None,
                    weight: Optional[Sequence[float]] = None,
@@ -484,17 +625,7 @@ class Dataset:
             f"Column_{i}" for i in range(num_features)]
 
         if reference is not None:
-            self.bin_mappers = reference.bin_mappers
-            self.used_feature_map = reference.used_feature_map
-            self.real_feature_idx = reference.real_feature_idx
-            self.max_bin = reference.max_bin
-            self.feature_names = reference.feature_names
-            self.monotone_types = reference.monotone_types
-            self.feature_penalty = reference.feature_penalty
-            self.feature_group = reference.feature_group
-            self.feature_offset = reference.feature_offset
-            self.group_num_bins = reference.group_num_bins
-            self.mv_group_start = reference.mv_group_start
+            self._copy_layout_from(reference)
         else:
             self._find_bins_sparse(csc, config, categorical_features,
                                    forced_bins)

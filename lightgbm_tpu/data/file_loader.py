@@ -67,6 +67,36 @@ def _resolve_ignore(spec: str, names: Optional[List[str]]) -> List[int]:
     return out
 
 
+def _qid_to_group_sizes(qid: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> query sizes (Metadata::SetQueryId)."""
+    change = np.nonzero(np.diff(qid))[0]
+    bounds = np.concatenate([[0], change + 1, [len(qid)]])
+    return np.diff(bounds)
+
+
+def _parse_libsvm_row(toks: List[str]) -> Tuple[float, List[Tuple[int, float]]]:
+    """One LibSVM line's tokens -> (label, [(idx, value), ...]) with
+    the native parser's tolerance rules (native/fast_parser.cpp): the
+    index must be a pure digit run (skips qid:7, comments, negative
+    indices), junk values become NaN."""
+    try:
+        label = float(toks[0])
+    except ValueError:
+        label = float("nan")
+    row: List[Tuple[int, float]] = []
+    for t in toks[1:]:
+        if ":" not in t:
+            continue
+        i, v = t.split(":", 1)
+        if not i.isdigit():
+            continue
+        try:
+            row.append((int(i), float(v)))
+        except ValueError:
+            row.append((int(i), float("nan")))
+    return label, row
+
+
 def _load_sidecar(path: str, suffixes) -> Optional[np.ndarray]:
     """Metadata sidecar files (src/io/metadata.cpp LoadWeights/
     LoadQueryBoundaries: one value per line, optional 'header')."""
@@ -148,11 +178,7 @@ def load_file(path: str, config: Config) -> Tuple[
         if weight_idx is not None:
             weight = mat[:, weight_idx]
         if group_idx is not None:
-            # per-row query ids -> query sizes (Metadata::SetQueryId)
-            qid = mat[:, group_idx]
-            change = np.nonzero(np.diff(qid))[0]
-            bounds = np.concatenate([[0], change + 1, [len(qid)]])
-            group = np.diff(bounds)
+            group = _qid_to_group_sizes(mat[:, group_idx])
         X = mat[:, keep]
         if names is not None:
             names = [names[i] for i in keep]
@@ -169,6 +195,179 @@ def load_file(path: str, config: Config) -> Tuple[
     log_info(f"Loaded {X.shape[0]} rows x {X.shape[1]} features "
              f"from {path} ({fmt})")
     return X, label, weight, group, init_score, names
+
+
+class TwoRoundLoader:
+    """Memory-bounded chunked text ingestion for ``two_round=true``.
+
+    Reference analog: the ``two_round`` branch of
+    ``DatasetLoader::LoadFromFile`` (src/io/dataset_loader.cpp:201-216):
+    instead of holding the parsed text in RAM, pass 1 streams the file
+    to collect the label/weight/group columns plus the bin-construction
+    sample rows (``SampleTextDataFromFile``, dataset_loader.cpp:714),
+    and pass 2 re-streams it to bin features chunk-by-chunk into the
+    packed training matrix (``ExtractFeaturesFromFile``,
+    dataset_loader.cpp:776). Peak extra memory is one chunk of float64
+    plus the sample — the full float matrix never materializes.
+
+    Column resolution (label/weight/group/ignore + header names) is
+    identical to ``load_file``; sampling uses the same sorted
+    ``rng.choice`` as the in-memory path, so for a given seed the
+    resulting BinMappers are bit-identical to ``two_round=false``.
+    """
+
+    def __init__(self, path: str, config: Config,
+                 chunk_rows: Optional[int] = None):
+        if not os.path.exists(path):
+            log_fatal(f"Data file {path} does not exist")
+        self.path = path
+        self.config = config
+        self.chunk_rows = chunk_rows or int(os.environ.get(
+            "LGBM_TPU_TWO_ROUND_CHUNK_ROWS", 262_144))
+        self.fmt = detect_format(path)
+        self.sep = "\t" if self.fmt == "tsv" else ","
+        self.names: Optional[List[str]] = None
+        if self.fmt != "libsvm" and config.header:
+            import csv
+            with open(path) as f:
+                # csv handles quoted names containing the separator
+                self.names = [c.strip() for c in
+                              next(csv.reader(f, delimiter=self.sep))]
+        self._keep: Optional[List[int]] = None
+        self._label_idx = self._weight_idx = self._group_idx = None
+        self._max_idx = -1       # libsvm global feature width - 1
+        self.feature_names: Optional[List[str]] = None
+
+    def resolve_feature_names(self) -> Optional[List[str]]:
+        """Post-drop feature names without streaming the file: peek
+        the first data line for the column count, then run the same
+        label/weight/group/ignore resolution as the chunk iterator."""
+        if self._keep is None and self.fmt != "libsvm":
+            import csv
+            with open(self.path) as f:
+                rd = csv.reader(f, delimiter=self.sep)
+                if self.config.header:
+                    next(rd, None)
+                row = next(rd, None)
+            if row:
+                self._resolve(len(row))
+        return self.feature_names
+
+    def count_rows(self) -> int:
+        """Non-blank data lines (TextReader::CountLine analog)."""
+        n = 0
+        with open(self.path) as f:
+            for line in f:
+                if line.strip():
+                    n += 1
+        if self.fmt != "libsvm" and self.config.header and n:
+            n -= 1
+        return n
+
+    def _resolve(self, total_cols: int) -> None:
+        cfg = self.config
+        label_idx = _resolve_column(cfg.label_column, self.names)
+        self._label_idx = 0 if label_idx is None else label_idx
+        self._weight_idx = _resolve_column(cfg.weight_column, self.names)
+        self._group_idx = _resolve_column(cfg.group_column, self.names)
+        ignore = set(_resolve_ignore(cfg.ignore_column, self.names))
+        drop = {self._label_idx} | ignore
+        if self._weight_idx is not None:
+            drop.add(self._weight_idx)
+        if self._group_idx is not None:
+            drop.add(self._group_idx)
+        self._keep = [i for i in range(total_cols) if i not in drop]
+        if self.names is not None:
+            self.feature_names = [self.names[i] for i in self._keep]
+
+    def iter_chunks(self):
+        """Yield ``(X, label, weight, qid)`` per chunk in file order;
+        ``X`` is float64 ``[m, num_features]``, the rest are ``[m]`` or
+        None. Shapes are consistent across chunks and passes."""
+        if self.fmt == "libsvm":
+            yield from self._iter_libsvm_chunks()
+            return
+        import pandas as pd
+        reader = pd.read_csv(
+            self.path, sep=self.sep,
+            header=0 if self.config.header else None,
+            chunksize=self.chunk_rows, skip_blank_lines=True,
+            # exact decimal->binary parsing: the one-round path goes
+            # through std::from_chars (native/fast_parser.cpp); the
+            # default pandas parser is 1 ulp off on some values, which
+            # would shift bin boundaries vs two_round=false
+            float_precision="round_trip")
+        def exact_tolerant(values):
+            # junk -> NaN like the one-round native parser
+            # (fast_parser.cpp Atof), via Python float() — which is
+            # round-trip exact, unlike pd.to_numeric's parser
+            out = np.empty(len(values), np.float64)
+            for i, v in enumerate(values):
+                try:
+                    out[i] = float(v)
+                except (TypeError, ValueError):
+                    out[i] = np.nan
+            return out
+
+        for df in reader:
+            bad = [c for c, dt in df.dtypes.items()
+                   if not pd.api.types.is_numeric_dtype(dt)]
+            for c in bad:
+                df[c] = exact_tolerant(df[c].to_numpy())
+            mat = df.to_numpy(np.float64)
+            if self._keep is None:
+                self._resolve(mat.shape[1])
+            weight = (mat[:, self._weight_idx]
+                      if self._weight_idx is not None else None)
+            qid = (mat[:, self._group_idx]
+                   if self._group_idx is not None else None)
+            yield (mat[:, self._keep], mat[:, self._label_idx],
+                   weight, qid)
+
+    def _iter_libsvm_chunks(self):
+        if self._max_idx < 0:
+            # one cheap token scan fixes the global feature width so
+            # every chunk densifies to the same shape
+            with open(self.path) as f:
+                for line in f:
+                    for t in line.replace("\t", " ").split()[1:]:
+                        i = t.split(":", 1)[0]
+                        if ":" in t and i.isdigit():
+                            self._max_idx = max(self._max_idx, int(i))
+        width = self._max_idx + 1
+        labels: List[float] = []
+        rows: List[List[Tuple[int, float]]] = []
+
+        def flush():
+            X = np.zeros((len(rows), width))
+            for r, row in enumerate(rows):
+                for i, v in row:
+                    X[r, i] = v
+            return X, np.asarray(labels), None, None
+
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                label, row = _parse_libsvm_row(
+                    line.replace("\t", " ").split())
+                labels.append(label)
+                rows.append(row)
+                if len(rows) >= self.chunk_rows:
+                    yield flush()
+                    labels, rows = [], []
+        if rows:
+            yield flush()
+
+    def load_sidecars(self):
+        """(weight, group, init_score) overrides next to the file."""
+        weight = _load_sidecar(self.path, (".weight",))
+        group = _load_sidecar(self.path, (".query", ".group"))
+        if group is not None:
+            group = group.astype(np.int64)
+        init_score = _load_sidecar(self.path, (".init",))
+        return weight, group, init_score
 
 
 def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
@@ -192,28 +391,11 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
             line = line.strip()
             if not line:
                 continue
-            toks = line.replace("\t", " ").split()
-            try:
-                labels.append(float(toks[0]))
-            except ValueError:
-                labels.append(float("nan"))
-            row = []
-            for t in toks[1:]:
-                if ":" not in t:
-                    continue
-                i, v = t.split(":", 1)
-                # same token rule as the native parser
-                # (native/fast_parser.cpp): the index must be a pure
-                # digit run — skips qid:7, comments, negative indices
-                if not i.isdigit():
-                    continue
-                i = int(i)
-                try:
-                    row.append((i, float(v)))
-                except ValueError:
-                    row.append((i, float("nan")))
-                max_idx = max(max_idx, i)
+            label, row = _parse_libsvm_row(line.replace("\t", " ").split())
+            labels.append(label)
             rows.append(row)
+            if row:
+                max_idx = max(max_idx, max(i for i, _ in row))
     X = np.zeros((len(rows), max_idx + 1))
     for r, row in enumerate(rows):
         for i, v in row:

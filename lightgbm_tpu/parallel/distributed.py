@@ -128,7 +128,24 @@ def init_distributed(config: Config,
         num_processes=len(machines),
         process_id=process_id,
         initialization_timeout=int(config.time_out) * 60)
+    sync_bin_find_seed(config)
     return True
+
+
+def sync_bin_find_seed(config: Config) -> int:
+    """``Network::GlobalSyncUpByMin(data_random_seed)``
+    (application.cpp:96): cooperative bin finding
+    (``is_parallel_find_bin``, data/voting learners) needs every host
+    to draw the SAME bin-construction sample, so the seed is synced to
+    the fleet minimum. No-op single-process or for serial/feature
+    learners."""
+    if not config.is_parallel_find_bin or not _multi_process():
+        return config.data_random_seed
+    from jax.experimental import multihost_utils
+    seeds = np.asarray(multihost_utils.process_allgather(
+        np.asarray([np.int64(config.data_random_seed)]))).reshape(-1)
+    config.data_random_seed = int(seeds.min())
+    return config.data_random_seed
 
 
 # ----------------------------------------------------------------------

@@ -144,3 +144,26 @@ def test_master_seed_changes_bagged_training():
     p1, p1b, p2 = train(1), train(1), train(2)
     np.testing.assert_array_equal(p1, p1b)         # reproducible
     assert not np.array_equal(p1, p2)              # seed matters
+
+
+def test_is_parallel_find_bin_derivation():
+    """config.cpp:283-295: data/voting learners derive
+    is_parallel_find_bin=true; the data learner also drops an enabled
+    histogram LRU pool to avoid per-shard refetch communication."""
+    from lightgbm_tpu.config import Config
+    base = {"objective": "binary", "verbosity": -1, "num_machines": 2,
+            "machines": "127.0.0.1:121,127.0.0.1:122"}
+    assert Config.from_params(
+        {**base, "tree_learner": "data"}).is_parallel_find_bin
+    assert Config.from_params(
+        {**base, "tree_learner": "voting"}).is_parallel_find_bin
+    assert not Config.from_params(
+        {**base, "tree_learner": "feature"}).is_parallel_find_bin
+    assert not Config.from_params(
+        {"objective": "binary", "verbosity": -1}).is_parallel_find_bin
+    cfg = Config.from_params({**base, "tree_learner": "data",
+                              "histogram_pool_size": 512.0})
+    assert cfg.histogram_pool_size == -1
+    cfg = Config.from_params({**base, "tree_learner": "voting",
+                              "histogram_pool_size": 512.0})
+    assert cfg.histogram_pool_size == 512.0

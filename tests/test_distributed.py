@@ -202,3 +202,26 @@ def test_distributed_sparse_bins_match_pooled_bins(monkeypatch):
         np.testing.assert_allclose(ma.bin_upper_bound,
                                    mf.bin_upper_bound)
         assert ma.num_bin == mf.num_bin
+
+
+def test_sync_bin_find_seed(monkeypatch):
+    """application.cpp:96: cooperative bin finding syncs
+    data_random_seed to the fleet minimum; serial learners and
+    single-process runs are untouched."""
+    from jax.experimental import multihost_utils
+    base = {"machines": "10.0.0.1:1,127.0.0.1:2", "num_machines": 2,
+            "data_random_seed": 7, "verbosity": -1}
+    monkeypatch.setattr(dist, "_multi_process", lambda: True)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x: np.stack([np.asarray(x), np.asarray([3])]))
+    cfg = Config.from_params({**base, "tree_learner": "voting"})
+    assert dist.sync_bin_find_seed(cfg) == 3
+    assert cfg.data_random_seed == 3
+    # serial learner: no sync even multi-process
+    cfg = Config.from_params({**base, "tree_learner": "feature"})
+    assert dist.sync_bin_find_seed(cfg) == 7
+    # single process: no sync
+    monkeypatch.setattr(dist, "_multi_process", lambda: False)
+    cfg = Config.from_params({**base, "tree_learner": "data"})
+    assert dist.sync_bin_find_seed(cfg) == 7

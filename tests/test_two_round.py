@@ -1,0 +1,199 @@
+"""two_round memory-bounded file ingestion.
+
+``Dataset.from_file_two_round`` (dataset_loader.cpp:201-216 two_round
+branch) must produce EXACTLY the dataset the in-memory path builds:
+sampling uses the same sorted-choice stream, so BinMappers, the packed
+matrix, metadata, and trained models are bit-identical — only the peak
+memory differs.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import Dataset as InnerDataset
+
+from golden_common import write_tsv
+
+
+def _data(n=400, f=6, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.05] = np.nan      # missing values round-trip
+    y = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def _datasets(tmp_path, monkeypatch, params, X, y, chunk=64):
+    path = str(tmp_path / "two_round.train")
+    write_tsv(path, X, y)
+    # small chunks force several chunks per pass
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", str(chunk))
+    one = Dataset(path, params=dict(params)).construct()._inner
+    two = Dataset(path, params={**params,
+                                "two_round": True}).construct()._inner
+    return one, two
+
+
+def _assert_same(one, two):
+    np.testing.assert_array_equal(one.binned, two.binned)
+    assert one.num_data == two.num_data
+    assert one.real_feature_idx == two.real_feature_idx
+    for m1, m2 in zip(one.bin_mappers, two.bin_mappers):
+        np.testing.assert_array_equal(m1.bin_upper_bound,
+                                      m2.bin_upper_bound)
+        assert m1.num_bin == m2.num_bin
+        assert m1.missing_type == m2.missing_type
+    np.testing.assert_array_equal(one.metadata.label, two.metadata.label)
+
+
+def test_two_round_matches_in_memory(tmp_path, monkeypatch):
+    X, y = _data()
+    one, two = _datasets(tmp_path, monkeypatch,
+                         {"objective": "binary", "verbosity": -1}, X, y)
+    _assert_same(one, two)
+
+
+def test_two_round_with_sampling(tmp_path, monkeypatch):
+    # n > bin_construct_sample_cnt exercises the sorted-choice sample
+    # gather across chunk boundaries
+    X, y = _data(n=500)
+    one, two = _datasets(
+        tmp_path, monkeypatch,
+        {"objective": "binary", "verbosity": -1,
+         "bin_construct_sample_cnt": 120}, X, y, chunk=97)
+    _assert_same(one, two)
+
+
+def test_two_round_trains_identically(tmp_path, monkeypatch):
+    from lightgbm_tpu import engine
+    X, y = _data()
+    path = str(tmp_path / "t.train")
+    write_tsv(path, X, y)
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "64")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b1 = engine.train(dict(params), Dataset(path, params=dict(params)),
+                      num_boost_round=5)
+    b2 = engine.train({**params, "two_round": True},
+                      Dataset(path, params={**params,
+                                            "two_round": True}),
+                      num_boost_round=5)
+    np.testing.assert_array_equal(b1.predict(X), b2.predict(X))
+
+
+def test_two_round_header_weight_group_columns(tmp_path, monkeypatch):
+    rng = np.random.RandomState(3)
+    n = 150
+    X = rng.randn(n, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    w = rng.rand(n) + 0.5
+    qid = np.repeat(np.arange(10), 15).astype(np.float64)
+    mat = np.column_stack([y, w, qid, X])
+    path = str(tmp_path / "h.train")
+    header = "label\tw\tq\t" + "\t".join(f"f{i}" for i in range(4))
+    np.savetxt(path, mat, delimiter="\t", fmt="%.17g",
+               header=header, comments="")
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "40")
+    params = {"objective": "binary", "verbosity": -1, "header": True,
+              "label_column": "name:label", "weight_column": "name:w",
+              "group_column": "name:q"}
+    one = Dataset(path, params=dict(params)).construct()._inner
+    two = Dataset(path, params={**params,
+                                "two_round": True}).construct()._inner
+    _assert_same(one, two)
+    np.testing.assert_array_equal(one.metadata.weights,
+                                  two.metadata.weights)
+    np.testing.assert_array_equal(one.metadata.query_boundaries,
+                                  two.metadata.query_boundaries)
+    assert two.feature_names == [f"f{i}" for i in range(4)]
+
+
+def test_two_round_libsvm(tmp_path, monkeypatch):
+    rng = np.random.RandomState(5)
+    lines = []
+    n = 90
+    for r in range(n):
+        feats = sorted(rng.choice(8, rng.randint(1, 5), replace=False))
+        toks = [f"{int(rng.rand() > 0.5)}"]
+        toks += [f"{j}:{rng.randn():.6g}" for j in feats]
+        lines.append(" ".join(toks))
+    path = str(tmp_path / "l.train")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "32")
+    params = {"objective": "binary", "verbosity": -1,
+              "min_data_in_leaf": 5}
+    one = Dataset(path, params=dict(params)).construct()._inner
+    two = Dataset(path, params={**params,
+                                "two_round": True}).construct()._inner
+    _assert_same(one, two)
+
+
+def test_two_round_valid_aligned_with_train(tmp_path, monkeypatch):
+    X, y = _data(n=300)
+    Xv, yv = _data(n=120, seed=11)
+    tr = str(tmp_path / "v.train")
+    va = str(tmp_path / "v.valid")
+    write_tsv(tr, X, y)
+    write_tsv(va, Xv, yv)
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "50")
+    params = {"objective": "binary", "verbosity": -1,
+              "two_round": True}
+    train = Dataset(tr, params=dict(params))
+    valid = train.create_valid(va).construct()
+    train.construct()
+    ref = Dataset(va, params={**params, "two_round": False},
+                  reference=Dataset(
+                      tr, params={**params, "two_round": False})
+                  ).construct()._inner
+    np.testing.assert_array_equal(valid._inner.binned, ref.binned)
+
+
+def test_two_round_direct_inner_api(tmp_path, monkeypatch):
+    # from_file_two_round is also the documented low-level entry
+    X, y = _data(n=80)
+    path = str(tmp_path / "d.train")
+    write_tsv(path, X, y)
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "30")
+    cfg = Config.from_params({"objective": "binary", "verbosity": -1,
+                              "two_round": True})
+    ds = InnerDataset.from_file_two_round(path, cfg)
+    assert ds.num_data == 80
+    assert ds.binned.shape[0] == 80
+    np.testing.assert_array_equal(ds.metadata.label, y)
+
+
+def test_two_round_user_feature_names_and_junk_cells(tmp_path,
+                                                     monkeypatch):
+    # a junk token must load as NaN (native-parser tolerance), and an
+    # explicit feature_name list must survive the two_round path
+    X, y = _data(n=60)
+    path = str(tmp_path / "j.train")
+    write_tsv(path, X, y)
+    lines = open(path).read().splitlines()
+    lines[3] = lines[3].replace(lines[3].split("\t")[2], "junk", 1)
+    open(path, "w").write("\n".join(lines) + "\n")
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "25")
+    names = [f"col{i}" for i in range(X.shape[1])]
+    params = {"objective": "binary", "verbosity": -1,
+              "two_round": True, "min_data_in_leaf": 5}
+    ds = Dataset(path, feature_name=list(names),
+                 params=dict(params)).construct()
+    assert ds._inner.feature_names == names
+    one = Dataset(path, params={**params,
+                                "two_round": False}).construct()._inner
+    _assert_same(one, ds._inner)
+
+
+def test_two_round_backfills_metadata_accessors(tmp_path, monkeypatch):
+    X, y = _data(n=50)
+    path = str(tmp_path / "s.train")
+    write_tsv(path, X, y)
+    init = np.linspace(-1, 1, 50)
+    np.savetxt(path + ".init", init)
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "20")
+    ds = Dataset(path, params={"objective": "binary", "verbosity": -1,
+                               "two_round": True}).construct()
+    np.testing.assert_array_equal(ds.get_label(), y)
+    np.testing.assert_allclose(ds.get_init_score(), init)
