@@ -116,3 +116,57 @@ def test_scale_multival_sparse(big_problem):
                      ds, num_boost_round=8)
     assert ds.construct()._inner.has_multival
     assert _auc(bst.predict(X[:20000], raw_score=True), y[:20000]) > 0.85
+
+
+def _criteo_shaped(n, f=200, seed=9):
+    """Criteo-like: wide, mostly sparse, conflict-heavy (EFB bundles +
+    multi-val overflow groups), a few denser informative columns."""
+    rng = np.random.RandomState(seed)
+    X = np.where(rng.rand(n, f) < 0.03,
+                 rng.randint(1, 9, size=(n, f)) * 0.5, 0.0)
+    X[:, :4] = np.where(rng.rand(n, 4) < 0.5,
+                        rng.randint(1, 9, size=(n, 4)) * 0.5, 0.0)
+    y = (1.5 * X[:, 0] - X[:, 1] + X[:, 2] - 0.5 * X[:, 3]
+         + 0.3 * rng.randn(n) > 0.2).astype(np.float64)
+    return X, y
+
+
+def _voting_vs_serial(n, rounds=5, f=200):
+    """Train voting-parallel (8 shards) and serial on the same
+    Criteo-shaped data; return (auc_voting, auc_serial, ds)."""
+    X, y = _criteo_shaped(n, f)
+    params = {"objective": "binary", "num_leaves": 63,
+              "min_data_in_leaf": 20, "verbosity": -1}
+    ds_v = lgb.Dataset(X, label=y,
+                       params={**params, "tree_learner": "voting",
+                               "num_machines": 8})
+    b_v = lgb.train({**params, "tree_learner": "voting",
+                     "num_machines": 8}, ds_v, num_boost_round=rounds)
+    b_s = lgb.train(dict(params), lgb.Dataset(X, label=y,
+                                              params=dict(params)),
+                    num_boost_round=rounds)
+    m = min(n, 100_000)
+    return (_auc(b_v.predict(X[:m], raw_score=True), y[:m]),
+            _auc(b_s.predict(X[:m], raw_score=True), y[:m]), ds_v)
+
+
+def test_scale_voting_parallel_criteo_shaped():
+    """VERDICT r4 #8: voting-parallel at bench scale on the virtual
+    8-device mesh over EFB + multival data
+    (voting_parallel_tree_learner.cpp:244-348 analog). Voting is lossy
+    by design (top-k candidate features per shard), so parity is
+    quality-based: its AUC must track serial within tolerance."""
+    auc_v, auc_s, ds = _voting_vs_serial(150_000)
+    assert ds.construct()._inner.has_multival   # Criteo shape engaged
+    assert auc_s > 0.80
+    assert auc_v > auc_s - 0.02, (auc_v, auc_s)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("LGBM_TPU_SCALE_TESTS"),
+    reason="500k-row voting gate runs on TPU hosts only "
+           "(LGBM_TPU_SCALE_TESTS=1); CI keeps the 150k version")
+def test_scale_voting_parallel_500k():
+    auc_v, auc_s, _ = _voting_vs_serial(500_000)
+    assert auc_s > 0.80
+    assert auc_v > auc_s - 0.02, (auc_v, auc_s)
