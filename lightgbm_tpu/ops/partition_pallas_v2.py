@@ -136,7 +136,10 @@ def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
 
         @pl.when(do)
         def _():
-            u8buf[...] = stage[0:blk, :].astype(jnp.uint8)
+            # Mosaic lowers casts to/from 32-bit types only: f32 -> u8
+            # hops via i32 (same quirk as the read direction below)
+            u8buf[...] = stage[0:blk, :].astype(jnp.int32).astype(
+                jnp.uint8)
             cp = pltpu.make_async_copy(
                 u8buf, dst_hbm.at[pl.ds(pl.multiple_of(w0, ALIGN), blk),
                                   :], sem)
@@ -156,7 +159,7 @@ def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
 
         def gbody(g, _):
             gran8[...] = stage[pl.ds(g * ALIGN, ALIGN), :].astype(
-                jnp.uint8)
+                jnp.int32).astype(jnp.uint8)
             cp = pltpu.make_async_copy(
                 gran8, dst_hbm.at[pl.ds(
                     pl.multiple_of(w0, ALIGN) + g * ALIGN, ALIGN), :],

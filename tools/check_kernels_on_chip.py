@@ -1,58 +1,89 @@
-"""Compiled-vs-interpret kernel comparison ON the real chip.
+"""Compiled kernel validation ON the real chip, one stage at a time.
 
 The reference's GPU_DEBUG_COMPARE (gpu_tree_learner.cpp) recomputes
 device histograms on the host and compares; CI runs our Pallas kernels
-only in interpret mode on CPU. This tool closes the remaining gap: on
-the real TPU it runs the histogram and partition kernels COMPILED and
-INTERPRETED on identical inputs (multiple shapes incl. unaligned
-segment offsets) and checks agreement, plus a NumPy oracle.
+only in interpret mode on CPU, which provably catches none of Mosaic's
+hardware-compile failures (both kernels' first real-v5e compiles failed
+in round 4 after a green CPU suite). This tool runs each kernel
+COMPILED on the real TPU against a NumPy/XLA oracle.
 
-Run on the TPU host (sole tunnel client): python tools/check_kernels_on_chip.py
-Exits non-zero on any mismatch.
+Round-5 redesign (VERDICT r4 #2): the check is SPLIT into independent
+stages so a timeout or tunnel death mid-run keeps every finished
+stage's verdict. Each stage's result is cached in
+docs/KERNEL_CHECKS.json (stage -> {ok, wall_s, ts}); partial passes
+promote partially (LGBM_TPU_PART_V2 flips on a green partition_v2
+alone).
+
+Run on the TPU host (sole tunnel client):
+    python tools/check_kernels_on_chip.py [stage ...]
+Stages: hist partition_v1 partition_v2 split_scan (default: the ones
+not yet green in the cache, in that order; pass --all to force all).
+Exits non-zero if any stage it RAN failed.
 """
 
+import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+CACHE = os.path.join(os.path.dirname(__file__), "..", "docs",
+                     "KERNEL_CHECKS.json")
 
 # the kernel accumulates exact bf16 hi/lo pairs in f32; vs a NumPy
 # oracle the summation ORDER differs, so absolute error grows with the
 # magnitude of the sums (~3e-6 relative observed)
 TOL = dict(rtol=1e-4, atol=1e-3)
 
+STAGES = ("hist", "partition_v1", "partition_v2", "split_scan")
 
-def main() -> int:
-    import jax
+
+def _load_cache() -> dict:
+    try:
+        with open(CACHE) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _save_stage(stage: str, ok: bool, wall: float) -> None:
+    cache = _load_cache()
+    cache[stage] = {"ok": bool(ok), "wall_s": round(wall, 1),
+                    "ts": time.strftime("%Y-%m-%d %H:%M:%S")}
+    with open(CACHE, "w") as fh:
+        json.dump(cache, fh, indent=1)
+
+
+def _hist_inputs(rng, n, f, b):
     import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.hist_pallas import build_matrix, pack_gh
+    binned = rng.randint(0, b, (n, f))
+    g = rng.randn(n).astype("float32")
+    h = (rng.rand(n) + 0.1).astype("float32")
+    c = (rng.rand(n) > 0.1).astype("float32")
+    mat = build_matrix(jnp.asarray(binned), 2048)
+    mat = pack_gh(mat, f, jnp.asarray(g * c), jnp.asarray(h * c),
+                  jnp.asarray(c))
+    return binned, g, h, c, mat
+
+
+def stage_hist() -> int:
     import numpy as np
 
-    from lightgbm_tpu.ops.hist_pallas import (build_matrix,
-                                              histogram_segment, pack_gh)
-    from lightgbm_tpu.ops.partition_pallas import partition_segment
-
-    backend = jax.default_backend()
-    if backend not in ("tpu", "axon"):
-        print(f"needs the real TPU (backend={backend})")
-        return 2
-
+    from lightgbm_tpu.ops.hist_pallas import histogram_segment
     rng = np.random.RandomState(0)
     failures = 0
     for n, f, b in [(5000, 12, 64), (20000, 28, 256), (7333, 5, 16)]:
-        binned = rng.randint(0, b, (n, f))
-        g = rng.randn(n).astype(np.float32)
-        h = rng.rand(n).astype(np.float32) + 0.1
-        c = (rng.rand(n) > 0.1).astype(np.float32)
-        mat = build_matrix(jnp.asarray(binned), 2048)
-        mat = pack_gh(mat, f, jnp.asarray(g * c), jnp.asarray(h * c),
-                      jnp.asarray(c))
+        binned, g, h, c, mat = _hist_inputs(rng, n, f, b)
         for begin, count in [(0, n), (8, n - 8), (1234, 2048),
                              (n - 517, 517)]:
             hc = np.asarray(histogram_segment(
                 mat, begin, count, b, f, interpret=False))
             # numpy oracle (compiled-vs-interpret parity is CPU CI's
             # job — interpret mode on this 1-core host is what blew
-            # the sequence's step budget)
+            # the old monolithic step budget)
             ho = np.zeros((f, b, 3), np.float32)
             sl = slice(begin, begin + count)
             for j in range(f):
@@ -63,23 +94,44 @@ def main() -> int:
             err = np.abs(hc - ho).max()
             print(f"hist [{n}x{f} b={b}] seg=({begin},{count}) "
                   f"compiled-vs-oracle: {'ok ' if ok else 'FAIL'} "
-                  f"max|d|={err:.2e}")
+                  f"max|d|={err:.2e}", flush=True)
             failures += 0 if ok else 1
+    return failures
 
-        # partition: incl. unaligned segment starts (shift > 0 hits
-        # the read-merge-write path at non-8-aligned boundaries)
-        from lightgbm_tpu.ops.hist_pallas import extract_row_ids
+
+def _check_partition(v2: bool) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lightgbm_tpu.ops.hist_pallas import extract_row_ids
+    if v2:
+        from lightgbm_tpu.ops.partition_pallas_v2 import (
+            partition_segment_v2, pick_blk)
+    else:
+        from lightgbm_tpu.ops.partition_pallas import partition_segment
+    rng = np.random.RandomState(1)
+    failures = 0
+    for n, f, b in [(20000, 28, 256), (5000, 12, 64), (7333, 5, 16)]:
+        binned, _, _, _, mat = _hist_inputs(rng, n, f, b)
         col, thr = f // 2, b // 2
         lut = jnp.zeros((1, 256), jnp.float32)
-        for begin, count in [(0, n), (13, n - 13), (1234, 2048)]:
+        # incl. unaligned segment starts (shift > 0 hits the
+        # read-merge-write path at non-8-aligned boundaries)
+        for begin, count in [(0, n), (13, n - 13), (1234, 2048),
+                             (n - 517, 517)]:
             for use_lut in (True, False):
-                ws = jnp.zeros_like(mat)
                 args = (jnp.int32(begin), jnp.int32(count), col,
                         jnp.int32(thr), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0), jnp.int32(b), jnp.int32(0), lut)
-                m_c, _, nl_c = partition_segment(
-                    mat, ws, *args, blk=512, interpret=False,
-                    use_lut_path=use_lut)
+                if v2:
+                    blk = pick_blk(mat.shape[1])
+                    m_c, _, nl_c = partition_segment_v2(
+                        mat, jnp.zeros_like(mat), *args, blk=blk,
+                        interpret=False, use_lut_path=use_lut)
+                else:
+                    m_c, _, nl_c = partition_segment(
+                        mat, jnp.zeros_like(mat), *args, blk=512,
+                        interpret=False, use_lut_path=use_lut)
                 sl = slice(begin, begin + count)
                 go_left = binned[sl, col] <= thr
                 nl_o = int(go_left.sum())
@@ -91,59 +143,38 @@ def main() -> int:
                                        rid_orig[~go_left]])
                 ok = (int(nl_c[0]) == nl_o
                       and np.array_equal(rid_seg[:count], want))
-                print(f"partition [{n}x{f}] seg=({begin},{count}) "
-                      f"lut={use_lut}: {'ok ' if ok else 'FAIL'} "
-                      f"left={int(nl_c[0])}/{nl_o}")
-                failures += 0 if ok else 1
-
-    # partition v2 (sub-tiled staging, ops/partition_pallas_v2.py):
-    # COMPILED membership/stability check — the double-buffered DMA
-    # overlap and granule-flush behavior only exist compiled, so this
-    # is the promotion gate for LGBM_TPU_PART_V2
-    from lightgbm_tpu.ops.partition_pallas_v2 import (
-        partition_segment_v2, pick_blk)
-    for n, f, b in [(20000, 28, 256), (5000, 12, 64)]:
-        binned = rng.randint(0, b, (n, f))
-        mat = build_matrix(jnp.asarray(binned), 2048)
-        mat = pack_gh(mat, f, jnp.asarray(rng.randn(n).astype(np.float32)),
-                      jnp.asarray(rng.rand(n).astype(np.float32) + 0.1),
-                      jnp.asarray(np.ones(n, np.float32)))
-        col, thr = f // 2, b // 2
-        lut = jnp.zeros((1, 256), jnp.float32)
-        blk = pick_blk(mat.shape[1])
-        for begin, count in [(0, n), (13, n - 13), (1234, 2048),
-                             (n - 517, 517)]:
-            for use_lut in (True, False):
-                m_c, _, nl_c = partition_segment_v2(
-                    mat, jnp.zeros_like(mat), jnp.int32(begin),
-                    jnp.int32(count), col, jnp.int32(thr), jnp.int32(0),
-                    jnp.int32(0), jnp.int32(0), jnp.int32(b),
-                    jnp.int32(0), lut, blk=blk, interpret=False,
-                    use_lut_path=use_lut)
-                sl = slice(begin, begin + count)
-                go_left = binned[sl, col] <= thr
-                nl_o = int(go_left.sum())
-                rid_seg = np.asarray(
-                    extract_row_ids(m_c, f, mat.shape[0]))[sl]
-                rid_orig = np.arange(n)[sl]
-                want = np.concatenate([rid_orig[go_left],
-                                       rid_orig[~go_left]])
-                ok = (int(nl_c[0]) == nl_o
-                      and np.array_equal(rid_seg[:count], want))
-                print(f"partition-v2 [{n}x{f} blk={blk}] "
+                print(f"partition{'-v2' if v2 else ''} [{n}x{f}] "
                       f"seg=({begin},{count}) lut={use_lut}: "
                       f"{'ok ' if ok else 'FAIL'} "
-                      f"left={int(nl_c[0])}/{nl_o}")
+                      f"left={int(nl_c[0])}/{nl_o}", flush=True)
                 failures += 0 if ok else 1
+    return failures
 
-    # fused split-scan kernel (ops/split_scan_pallas.py): compiled vs
-    # the XLA reference scan — validates the Mosaic lowering (cumsum
-    # lane-shift ladder, SMEM scalars, [F, 8] packed output) that CI
-    # only exercises in interpret mode
+
+def stage_partition_v1() -> int:
+    return _check_partition(v2=False)
+
+
+def stage_partition_v2() -> int:
+    """Promotion gate for LGBM_TPU_PART_V2: the double-buffered DMA
+    overlap and granule-flush behavior only exist compiled."""
+    return _check_partition(v2=True)
+
+
+def stage_split_scan() -> int:
+    """Fused split-scan kernel compiled vs the XLA reference scan —
+    validates the Mosaic lowering (cumsum lane-shift ladder, SMEM
+    scalars, [F, 8] packed output) that CI only sees interpreted."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams,
                                         per_feature_numerical)
     from lightgbm_tpu.ops.split_scan_pallas import \
         per_feature_numerical_pallas
+    rng = np.random.RandomState(2)
+    failures = 0
     for f, b, any_missing in [(28, 256, False), (11, 64, True)]:
         meta = FeatureMeta(
             num_bins=jnp.asarray(rng.randint(3, b, f), jnp.int32),
@@ -191,11 +222,58 @@ def main() -> int:
         ok = ok and thr_agree > 0.9
         print(f"split-scan [F={f} B={b} missing={any_missing}] "
               f"compiled-vs-xla (+vmap): {'ok ' if ok else 'FAIL'} "
-              f"thr_agree={thr_agree:.2f}")
+              f"thr_agree={thr_agree:.2f}", flush=True)
         failures += 0 if ok else 1
+    return failures
 
-    print("PASS" if failures == 0 else f"{failures} FAILURES")
-    return 0 if failures == 0 else 1
+
+def main() -> int:
+    import jax
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        print(f"needs the real TPU (backend={backend})")
+        return 2
+
+    argv = [a for a in sys.argv[1:]]
+    force_all = "--all" in argv
+    unknown = [a for a in argv if a not in STAGES and a != "--all"]
+    if unknown:
+        print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}")
+        return 2
+    requested = [a for a in argv if a in STAGES]
+    if requested:
+        todo = requested
+    elif force_all:
+        todo = list(STAGES)
+    else:
+        cache = _load_cache()
+        todo = [s for s in STAGES
+                if not cache.get(s, {}).get("ok")]
+        if not todo:
+            print("all stages already green in"
+                  f" {os.path.relpath(CACHE)}; use --all to re-run")
+            return 0
+
+    fns = {"hist": stage_hist, "partition_v1": stage_partition_v1,
+           "partition_v2": stage_partition_v2,
+           "split_scan": stage_split_scan}
+    total_failures = 0
+    for stage in todo:
+        t0 = time.time()
+        print(f"== stage {stage}", flush=True)
+        try:
+            failures = fns[stage]()
+        except Exception as e:  # noqa: BLE001 - record compile crashes
+            print(f"stage {stage} CRASHED: {e!r:.500}", flush=True)
+            failures = 1
+        _save_stage(stage, failures == 0, time.time() - t0)
+        total_failures += failures
+        print(f"== stage {stage}: "
+              f"{'PASS' if failures == 0 else f'{failures} FAILURES'} "
+              f"({time.time() - t0:.0f}s)", flush=True)
+    print("PASS" if total_failures == 0
+          else f"{total_failures} FAILURES")
+    return 0 if total_failures == 0 else 1
 
 
 if __name__ == "__main__":

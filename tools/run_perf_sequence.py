@@ -5,10 +5,16 @@ separate child process; the axon tunnel wedges under concurrent
 clients):
 
   1. a 60 s device probe (abort early if the tunnel is down)
-  2. tools/profile_tree.py 500000      -- per-stage split timings
-  3. bench.py                          -- 500k -> 2M -> 10.5M escalation
-  4. tools/check_kernels_on_chip.py    -- compiled-vs-interpret parity
-  5. tools/bench_sweep.py              -- amortization curve + AUC gate
+  2. tools/micro_kernel_bench.py       -- per-kernel in-program costs
+  3. tools/profile_tree.py 500000      -- per-stage split timings
+  4. bench.py                          -- 500k -> 2M -> 10.5M escalation
+     + two attribution runs (fused blocks off / scan kernel off)
+  5. tools/check_kernels_on_chip.py    -- FOUR per-stage children
+     (hist, partition_v1, partition_v2, split_scan), each validating
+     the COMPILED kernel against a NumPy/XLA oracle and caching its
+     verdict in docs/KERNEL_CHECKS.json; a green partition_v2 from
+     THIS run promotes an LGBM_TPU_PART_V2=1 bench run
+  6. tools/bench_sweep.py              -- amortization curve + AUC gate
                                           into docs/PERF_SWEEP.json
 
 Writes a combined log to docs/PERF_RUN.log and exits non-zero if the
@@ -106,11 +112,29 @@ def main():
         env_attr["BENCH_NO_CPU_FALLBACK"] = "1"
         ok.append(run(tag, [sys.executable, "bench.py"],
                       max(min(700.0, left()), 60.0), env_attr))
-    kernels_ok = run("check_kernels",
-                     [sys.executable, "tools/check_kernels_on_chip.py"],
-                     min(900, max(left() - 900, 120)))
-    ok.append(kernels_ok)
-    if kernels_ok and left() > 900:
+    # kernel checks run ONE STAGE PER CHILD so a timeout or tunnel
+    # death mid-stage keeps every finished stage's cached verdict
+    # (docs/KERNEL_CHECKS.json); partial passes promote partially
+    for stage in ("hist", "partition_v1", "partition_v2",
+                  "split_scan"):
+        ok.append(run(f"check_{stage}",
+                      [sys.executable, "tools/check_kernels_on_chip.py",
+                       stage],
+                      min(420, max(left() - 600, 60))))
+    import json as _json
+    try:
+        with open(os.path.join(REPO, "docs",
+                               "KERNEL_CHECKS.json")) as fh:
+            entry = _json.load(fh).get("partition_v2", {})
+        # promotion needs a green verdict from THIS sequence: a stale
+        # green from a previous round would bless a since-modified
+        # kernel whose re-check was killed before it could save
+        ts = time.mktime(time.strptime(entry.get("ts", ""),
+                                       "%Y-%m-%d %H:%M:%S"))
+        part_v2_ok = bool(entry.get("ok")) and ts >= t0 - 60
+    except (OSError, ValueError, OverflowError):
+        part_v2_ok = False
+    if part_v2_ok and left() > 900:
         # compiled v2 partition validated -> measure it end-to-end at
         # the 500k point for a direct v1-vs-v2 comparison
         envp = dict(os.environ)
