@@ -19,6 +19,10 @@ Failures print and skip — an unsupported lowering is a RESULT, not an
 error.
 
 Run (sole tunnel client): python tools/probe_i8_masks.py
+Off-chip pre-check: python tools/probe_i8_masks.py --lower-only
+  runs only the Mosaic TPU lowering pass for each candidate (works on
+  any host) — an UNSUPPORTED there answers the question without
+  spending tunnel time; a LOWERS-OK still needs the on-chip timing.
 """
 
 import sys
@@ -43,8 +47,11 @@ def main() -> int:
 
     from lightgbm_tpu.utils.sync import fetch_one
 
-    if jax.default_backend() not in ("tpu", "axon"):
-        print(f"needs the real TPU (backend={jax.default_backend()})")
+    lower_only = "--lower-only" in sys.argv
+    if not lower_only \
+            and jax.default_backend() not in ("tpu", "axon"):
+        print(f"needs the real TPU (backend={jax.default_backend()}); "
+              "use --lower-only for the off-chip lowering pre-check")
         return 2
 
     rng = np.random.RandomState(0)
@@ -113,6 +120,13 @@ def main() -> int:
                        ("i8mm", body_i8mm)):
         try:
             call = mk(body)
+            if lower_only:
+                jax.jit(lambda x, call=call: call(
+                    jnp.stack([jnp.int32(3)]), x)).trace(blk).lower(
+                        lowering_platforms=("tpu",))
+                print(f"{name:5s}: LOWERS OK (timing still needs "
+                      "the chip)")
+                continue
 
             @jax.jit
             def chain(x, call=call):
