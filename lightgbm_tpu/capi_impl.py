@@ -1,0 +1,374 @@
+"""Python implementation layer of the C API.
+
+Reference analog: ``src/c_api.cpp:584-1753``. The native shim
+(``native/c_api.cpp``) embeds CPython and forwards each exported
+``LGBM_*`` symbol here; this module owns handle management, parameter
+parsing, and pointer<->numpy conversion, so the C++ layer stays a
+mechanical marshalling shim. Handles given to C are integer ids into a
+process-global registry (opaque ``void*`` on the C side).
+
+All functions either return their documented value or raise — the C
+shim converts exceptions into the reference's ``-1`` + LGBM_GetLastError
+contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# C_API_DTYPE_* (include/LightGBM/c_api.h:25-31)
+DTYPE_FLOAT32 = 0
+DTYPE_FLOAT64 = 1
+DTYPE_INT32 = 2
+DTYPE_INT64 = 3
+# C_API_PREDICT_* (c_api.h:33-38)
+PREDICT_NORMAL = 0
+PREDICT_RAW_SCORE = 1
+PREDICT_LEAF_INDEX = 2
+PREDICT_CONTRIB = 3
+
+_CTYPES = {DTYPE_FLOAT32: ctypes.c_float, DTYPE_FLOAT64: ctypes.c_double,
+           DTYPE_INT32: ctypes.c_int32, DTYPE_INT64: ctypes.c_int64}
+_NPTYPES = {DTYPE_FLOAT32: np.float32, DTYPE_FLOAT64: np.float64,
+            DTYPE_INT32: np.int32, DTYPE_INT64: np.int64}
+
+_handles: Dict[int, Any] = {}
+_next_id = 1
+# GetField hands out a raw pointer into memory WE must keep alive for
+# the handle's lifetime (c_api.cpp Dataset::GetField contract)
+_field_refs: Dict[int, Dict[str, np.ndarray]] = {}
+
+
+def _register(obj: Any) -> int:
+    global _next_id
+    h = _next_id
+    _next_id += 1
+    _handles[h] = obj
+    return h
+
+
+def _get(h: int) -> Any:
+    try:
+        return _handles[int(h)]
+    except KeyError:
+        raise ValueError(f"Invalid handle {h}") from None
+
+
+def free_handle(h: int) -> None:
+    _handles.pop(int(h), None)
+    _field_refs.pop(int(h), None)
+
+
+def _parse_params(parameters: str) -> Dict[str, str]:
+    """Reference C API parameter strings: space-separated key=value
+    (config.cpp Config::Str2Map)."""
+    out: Dict[str, str] = {}
+    for tok in (parameters or "").replace("\n", " ").split():
+        k, eq, v = tok.partition("=")
+        if eq:
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _as_array(ptr: int, n: int, dtype: int) -> np.ndarray:
+    ct = _CTYPES[int(dtype)]
+    return np.ctypeslib.as_array(
+        ctypes.cast(int(ptr), ctypes.POINTER(ct)), (int(n),))
+
+
+# ----------------------------------------------------------------------
+# Dataset
+def dataset_create_from_file(filename: str, parameters: str,
+                             ref: int) -> int:
+    from .basic import Dataset
+    params = _parse_params(parameters)
+    reference = _get(ref) if ref else None
+    ds = Dataset(filename, params=params, reference=reference)
+    ds.construct()
+    return _register(ds)
+
+
+def dataset_create_from_mat(data_ptr: int, data_type: int, nrow: int,
+                            ncol: int, is_row_major: int,
+                            parameters: str, ref: int) -> int:
+    from .basic import Dataset
+    flat = _as_array(data_ptr, nrow * ncol, data_type)
+    if int(is_row_major):
+        mat = flat.reshape(nrow, ncol).copy()
+    else:
+        mat = flat.reshape(ncol, nrow).T.copy()
+    params = _parse_params(parameters)
+    reference = _get(ref) if ref else None
+    ds = Dataset(np.asarray(mat, np.float64), params=params,
+                 reference=reference)
+    ds.construct()
+    return _register(ds)
+
+
+def dataset_set_feature_names(h: int, names: List[str]) -> None:
+    ds = _get(h)
+    ds.feature_name = list(names)
+    if ds._inner is not None:
+        ds._inner.feature_names = list(names)
+
+
+def dataset_get_feature_names(h: int) -> List[str]:
+    ds = _get(h)
+    inner = ds.construct()._inner
+    return list(inner.feature_names)
+
+
+def dataset_set_field(h: int, name: str, ptr: int, n: int,
+                      dtype: int) -> None:
+    """Metadata::SetField dispatch (c_api.cpp:1379-1415), through the
+    Dataset setters so their invariants (query-weight refresh etc.)
+    apply to the C route too."""
+    ds = _get(h)
+    data = None if n == 0 else np.array(_as_array(ptr, n, dtype))
+    ds.construct()
+    if name == "label":
+        ds.set_label(data)
+    elif name == "weight":
+        ds.set_weight(data)
+    elif name in ("group", "query"):
+        ds.set_group(None if data is None
+                     else np.asarray(data, np.int64))
+    elif name == "init_score":
+        ds.set_init_score(data)
+    else:
+        raise ValueError(f"Unknown field name: {name}")
+
+
+def dataset_get_field(h: int, name: str):
+    """-> (address, length, c_api_dtype); keeps the buffer alive for
+    the handle's lifetime."""
+    ds = _get(h)
+    md = ds.construct()._inner.metadata
+    if name == "label":
+        arr, t = md.label, DTYPE_FLOAT32
+    elif name == "weight":
+        arr, t = md.weights, DTYPE_FLOAT32
+    elif name in ("group", "query"):
+        arr, t = md.query_boundaries, DTYPE_INT32
+    elif name == "init_score":
+        arr, t = md.init_score, DTYPE_FLOAT64
+    else:
+        raise ValueError(f"Unknown field name: {name}")
+    if arr is None:
+        return 0, 0, t
+    arr = np.ascontiguousarray(arr, _NPTYPES[t])
+    _field_refs.setdefault(int(h), {})[name] = arr
+    return arr.ctypes.data, len(arr), t
+
+
+def dataset_get_num_data(h: int) -> int:
+    return int(_get(h).construct()._inner.num_data)
+
+
+def dataset_get_num_feature(h: int) -> int:
+    return int(_get(h).construct()._inner.num_total_features)
+
+
+def dataset_save_binary(h: int, filename: str) -> None:
+    _get(h).construct()._inner.save_binary(filename)
+
+
+# ----------------------------------------------------------------------
+# Booster
+def booster_create(train_h: int, parameters: str) -> int:
+    from .basic import Booster
+    params = _parse_params(parameters)
+    bst = Booster(params=params, train_set=_get(train_h))
+    return _register(bst)
+
+
+def booster_create_from_modelfile(filename: str) -> int:
+    from .basic import Booster
+    bst = Booster(model_file=filename)
+    return _register(bst), int(bst.current_iteration())
+
+
+def booster_load_model_from_string(model_str: str):
+    from .basic import Booster
+    bst = Booster(model_str=model_str)
+    return _register(bst), int(bst.current_iteration())
+
+
+def booster_add_valid_data(h: int, valid_h: int) -> None:
+    bst = _get(h)
+    bst.add_valid(_get(valid_h), f"valid_{len(bst.valid_sets)}")
+
+
+def booster_reset_parameter(h: int, parameters: str) -> None:
+    _get(h).reset_parameter(_parse_params(parameters))
+
+
+def booster_update_one_iter(h: int) -> int:
+    """-> 1 when training cannot continue (reference is_finished)."""
+    return 1 if _get(h).update() else 0
+
+
+def booster_rollback_one_iter(h: int) -> None:
+    _get(h).rollback_one_iter()
+
+
+def booster_get_current_iteration(h: int) -> int:
+    return int(_get(h).current_iteration())
+
+
+def booster_num_model_per_iteration(h: int) -> int:
+    return int(_get(h).num_model_per_iteration())
+
+
+def booster_number_of_total_model(h: int) -> int:
+    bst = _get(h)
+    return int(len(bst._src().models))
+
+
+def booster_get_num_classes(h: int) -> int:
+    bst = _get(h)
+    src = bst._src()
+    return int(getattr(src, "num_class", 1) or 1)
+
+
+def booster_get_num_feature(h: int) -> int:
+    return int(_get(h).num_feature())
+
+
+def booster_get_feature_names(h: int) -> List[str]:
+    return list(_get(h).feature_name())
+
+
+def booster_get_eval_names(h: int) -> List[str]:
+    bst = _get(h)
+    names: List[str] = []
+    for m in getattr(bst._gbdt, "training_metrics", []) or []:
+        names.extend(m.names)
+    if not names and bst._gbdt is not None:
+        for ms in bst._gbdt.valid_metrics:
+            for m in ms:
+                for nm in m.names:
+                    if nm not in names:
+                        names.append(nm)
+    return names
+
+
+def booster_get_eval(h: int, data_idx: int) -> List[float]:
+    """data_idx 0 = train, i>0 = valid_sets[i-1] (c_api.cpp:934)."""
+    bst = _get(h)
+    if data_idx == 0:
+        res = bst.eval_train()
+    else:
+        data = bst.valid_sets[data_idx - 1]
+        name = bst.name_valid_sets[data_idx - 1]
+        res = bst.eval(data, name)
+    return [float(r[2]) for r in res]
+
+
+def booster_save_model(h: int, start_iteration: int, num_iteration: int,
+                       filename: str) -> None:
+    _get(h).save_model(filename, num_iteration=num_iteration
+                       if num_iteration > 0 else None,
+                       start_iteration=start_iteration)
+
+
+def booster_save_model_to_string(h: int, start_iteration: int,
+                                 num_iteration: int) -> str:
+    return _get(h).model_to_string(
+        num_iteration=num_iteration if num_iteration > 0 else None,
+        start_iteration=start_iteration)
+
+
+def booster_dump_model(h: int, start_iteration: int,
+                       num_iteration: int) -> str:
+    return json.dumps(_get(h).dump_model(
+        num_iteration=num_iteration if num_iteration > 0 else None,
+        start_iteration=start_iteration))
+
+
+def _num_predict_per_row(bst, ncol: int, predict_type: int,
+                         num_iteration: int) -> int:
+    k = bst.num_model_per_iteration()
+    total = len(bst._src().models)
+    iters = total // max(k, 1)
+    if num_iteration > 0:
+        iters = min(iters, num_iteration)
+    if predict_type == PREDICT_LEAF_INDEX:
+        return k * iters
+    if predict_type == PREDICT_CONTRIB:
+        return k * (ncol + 1)
+    return k
+
+
+def booster_calc_num_predict(h: int, num_row: int, predict_type: int,
+                             num_iteration: int) -> int:
+    bst = _get(h)
+    return int(num_row) * _num_predict_per_row(
+        bst, bst.num_feature(), predict_type, num_iteration)
+
+
+def booster_predict_for_mat(h: int, data_ptr: int, data_type: int,
+                            nrow: int, ncol: int, is_row_major: int,
+                            predict_type: int, num_iteration: int,
+                            parameter: str, out_ptr: int) -> int:
+    """Writes predictions to out_ptr (f64, row-major); -> out_len."""
+    bst = _get(h)
+    flat = _as_array(data_ptr, nrow * ncol, data_type)
+    if int(is_row_major):
+        mat = np.asarray(flat, np.float64).reshape(nrow, ncol)
+    else:
+        mat = np.asarray(flat, np.float64).reshape(ncol, nrow).T
+    kwargs: Dict[str, Any] = dict(
+        num_iteration=num_iteration if num_iteration > 0 else None)
+    pp = _parse_params(parameter)
+    if pp.get("pred_early_stop", "").lower() in ("true", "1", "+"):
+        kwargs.update(pred_early_stop=True)
+        if "pred_early_stop_freq" in pp:
+            kwargs["pred_early_stop_freq"] = int(
+                pp["pred_early_stop_freq"])
+        if "pred_early_stop_margin" in pp:
+            kwargs["pred_early_stop_margin"] = float(
+                pp["pred_early_stop_margin"])
+    if predict_type == PREDICT_RAW_SCORE:
+        pred = bst.predict(mat, raw_score=True, **kwargs)
+    elif predict_type == PREDICT_LEAF_INDEX:
+        pred = bst.predict(mat, pred_leaf=True, **kwargs)
+    elif predict_type == PREDICT_CONTRIB:
+        pred = bst.predict(mat, pred_contrib=True, **kwargs)
+    else:
+        pred = bst.predict(mat, **kwargs)
+    pred = np.ascontiguousarray(np.asarray(pred, np.float64).reshape(-1))
+    out = _as_array(out_ptr, len(pred), DTYPE_FLOAT64)
+    out[:] = pred
+    return len(pred)
+
+
+def booster_predict_for_file(h: int, data_filename: str,
+                             data_has_header: int, predict_type: int,
+                             num_iteration: int, parameter: str,
+                             result_filename: str) -> None:
+    """Predictor file->file (c_api.cpp:1150, predictor.cpp:46-109)."""
+    from .config import Config
+    from .data.file_loader import load_file
+    bst = _get(h)
+    pp = _parse_params(parameter)
+    pp["header"] = "true" if data_has_header else "false"
+    cfg = Config.from_params(pp)
+    X, _, _, _, _, _ = load_file(data_filename, cfg)
+    kwargs: Dict[str, Any] = dict(
+        num_iteration=num_iteration if num_iteration > 0 else None)
+    if predict_type == PREDICT_RAW_SCORE:
+        pred = bst.predict(X, raw_score=True, **kwargs)
+    elif predict_type == PREDICT_LEAF_INDEX:
+        pred = bst.predict(X, pred_leaf=True, **kwargs)
+    elif predict_type == PREDICT_CONTRIB:
+        pred = bst.predict(X, pred_contrib=True, **kwargs)
+    else:
+        pred = bst.predict(X, **kwargs)
+    pred = np.asarray(pred)
+    fmt = "%d" if pred.dtype.kind in "iu" else "%.18g"
+    np.savetxt(result_filename, pred, delimiter="\t", fmt=fmt)

@@ -34,7 +34,8 @@ _SHAP_SRC = os.path.join(_HERE, "treeshap.cpp")
 _SHAP_SO = os.path.join(_HERE, "_treeshap.so")
 
 
-def _compile(src: str = _SRC, so: str = _SO) -> Optional[str]:
+def _compile(src: str = _SRC, so: str = _SO, pre_flags=(),
+             post_flags=(), timeout: float = 120) -> Optional[str]:
     if os.path.exists(so) and \
             os.path.getmtime(so) >= os.path.getmtime(src):
         return so
@@ -42,9 +43,10 @@ def _compile(src: str = _SRC, so: str = _SO) -> Optional[str]:
     # interleave g++ output into one file before the atomic replace
     tmp = f"{so}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", tmp]
+           *pre_flags, src, "-o", tmp, *post_flags]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=timeout)
         os.replace(tmp, so)
         return so
     except (OSError, subprocess.SubprocessError):
@@ -118,6 +120,31 @@ def get_shap_lib() -> Optional[ctypes.CDLL]:
             L, DP, L, I]         # max_path, phi, phi_stride, n_threads
         _SHAP_LIB = lib
         return _SHAP_LIB
+
+
+_CAPI_SRC = os.path.join(_HERE, "c_api.cpp")
+_CAPI_SO = os.path.join(_HERE, "_lightgbm_tpu_capi.so")
+
+
+def build_c_api() -> Optional[str]:
+    """Compile the embedded-CPython C API shim (c_api.cpp ->
+    _lightgbm_tpu_capi.so). C programs link this library against
+    native/c_api.h. Returns the .so path, or None when no compiler /
+    no libpython is available."""
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldver = sysconfig.get_config_var("LDVERSION")
+    pkg_dir = os.path.dirname(os.path.dirname(_HERE))
+    site_dir = sysconfig.get_paths()["purelib"]
+    return _compile(
+        _CAPI_SRC, _CAPI_SO,
+        pre_flags=[f"-I{inc}",
+                   f"-DLGBM_TPU_PKG_DIR=\"{pkg_dir}\"",
+                   f"-DLGBM_TPU_SITE_DIR=\"{site_dir}\""],
+        post_flags=[f"-L{libdir}", f"-lpython{ldver}",
+                    f"-Wl,-rpath,{libdir}"],
+        timeout=180)
 
 
 def _mmap_file(path: str):

@@ -1,0 +1,613 @@
+/*
+ * C API shim: exported LGBM_* symbols -> embedded CPython ->
+ * lightgbm_tpu.capi_impl (which owns the real semantics).
+ *
+ * Reference analog: src/c_api.cpp:584-1753 — same signatures, same
+ * 0/-1 + LGBM_GetLastError contract. The shim is deliberately
+ * mechanical: build a Python argument tuple, call the impl function,
+ * convert the result, translate exceptions into the error string.
+ *
+ * Threading: Python is initialized lazily on the first call; the GIL
+ * is released afterwards so any thread may call the API (each call
+ * takes PyGILState_Ensure).
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "c_api.h"
+
+namespace {
+
+// thread-local like the reference's LGBM_GetLastError contract: a
+// failing call on thread A must not free/replace the buffer thread B
+// is reading
+thread_local std::string g_last_error = "Everything is fine";
+
+PyObject* g_impl = nullptr;          // lightgbm_tpu.capi_impl module
+std::mutex g_init_mutex;             // guards first-call bootstrap
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_last_error = c != nullptr ? c : "unknown Python error";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown Python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+// build_c_api() compiles the host package's parent dir and
+// site-packages in, so a plain C program finds lightgbm_tpu and its
+// deps without environment setup; LIGHTGBM_TPU_PYTHONPATH prepends
+// extra entries at runtime
+#ifndef LGBM_TPU_PKG_DIR
+#define LGBM_TPU_PKG_DIR ""
+#endif
+#ifndef LGBM_TPU_SITE_DIR
+#define LGBM_TPU_SITE_DIR ""
+#endif
+
+// one-time interpreter bootstrap; returns false (with error set) when
+// Python or the package cannot be loaded. The mutex keeps two threads'
+// FIRST calls from double-initializing the interpreter.
+bool ensure_python() {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (g_impl != nullptr) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by initialization so ANY thread
+    // (including this one, via PyGILState_Ensure) can take it
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyRun_SimpleString(
+      "import os, sys\n"
+      "for _p in [os.environ.get('LIGHTGBM_TPU_PYTHONPATH', ''),\n"
+      "           '" LGBM_TPU_PKG_DIR "', '" LGBM_TPU_SITE_DIR "']:\n"
+      "    if _p and _p not in sys.path:\n"
+      "        sys.path.insert(0, _p)\n");
+  PyObject* mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+  if (mod == nullptr) {
+    set_error_from_python();
+    PyGILState_Release(st);
+    return false;
+  }
+  g_impl = mod;  // hold forever (process-lifetime module)
+  PyGILState_Release(st);
+  return true;
+}
+
+// call impl.<fn>(*args); steals `args`; returns new ref or nullptr
+PyObject* call_impl(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_impl, fn);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (res == nullptr) set_error_from_python();
+  return res;
+}
+
+// RAII GIL holder for the public entry points
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+int64_t as_int(PyObject* o, bool* ok) {
+  int64_t v = PyLong_AsLongLong(o);
+  *ok = !(v == -1 && PyErr_Occurred());
+  if (!*ok) set_error_from_python();
+  return v;
+}
+
+// copy a Python str into (buffer_len, out_len, out_str) with the
+// reference's truncation contract: out_len is the FULL length; the
+// copy is capped at buffer_len - 1 and NUL-terminated
+int copy_string_out(PyObject* s, int64_t buffer_len, int64_t* out_len,
+                    char* out_str) {
+  Py_ssize_t n = 0;
+  const char* c = PyUnicode_AsUTF8AndSize(s, &n);
+  if (c == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_len = static_cast<int64_t>(n) + 1;  // incl. NUL, like c_api.cpp
+  if (out_str != nullptr && buffer_len > 0) {
+    int64_t ncopy = n < buffer_len - 1 ? n : buffer_len - 1;
+    std::memcpy(out_str, c, static_cast<size_t>(ncopy));
+    out_str[ncopy] = '\0';
+  }
+  return 0;
+}
+
+// copy a Python list[str] into the caller's char*[ ] (each assumed
+// pre-allocated, reference convention for GetEvalNames etc.)
+int copy_strings_out(PyObject* lst, int* out_len, char** out_strs) {
+  Py_ssize_t n = PyList_Size(lst);
+  *out_len = static_cast<int>(n);
+  if (out_strs == nullptr) return 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t ln = 0;
+    const char* c = PyUnicode_AsUTF8AndSize(PyList_GetItem(lst, i), &ln);
+    if (c == nullptr) {
+      set_error_from_python();
+      return -1;
+    }
+    std::memcpy(out_strs[i], c, static_cast<size_t>(ln));
+    out_strs[i][ln] = '\0';
+  }
+  return 0;
+}
+
+#define API_BEGIN()                        \
+  if (!ensure_python()) return -1;         \
+  Gil gil;
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+/* ---------------- Dataset ---------------- */
+
+int LGBM_DatasetCreateFromFile(const char* filename,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_from_file",
+      Py_BuildValue("(ssL)", filename, parameters ? parameters : "",
+                    reinterpret_cast<long long>(reference)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                              int32_t nrow, int32_t ncol,
+                              int is_row_major, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_from_mat",
+      Py_BuildValue("(LiiiisL)",
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<int>(nrow), static_cast<int>(ncol),
+                    is_row_major, parameters ? parameters : "",
+                    reinterpret_cast<long long>(reference)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names) {
+  API_BEGIN();
+  PyObject* lst = PyList_New(num_feature_names);
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyList_SetItem(lst, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* r = call_impl(
+      "dataset_set_feature_names",
+      Py_BuildValue("(LN)", reinterpret_cast<long long>(handle), lst));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** out_strs,
+                                int* out_len) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_feature_names",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  int rc = copy_strings_out(r, out_len, out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element,
+                         int type) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_set_field",
+      Py_BuildValue("(LsLii)", reinterpret_cast<long long>(handle),
+                    field_name,
+                    reinterpret_cast<long long>(field_data),
+                    num_element, type));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_field",
+      Py_BuildValue("(Ls)", reinterpret_cast<long long>(handle),
+                    field_name));
+  if (r == nullptr) return -1;
+  long long addr = 0, n = 0, t = 0;
+  if (!PyArg_ParseTuple(r, "LLL", &addr, &n, &t)) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_ptr = reinterpret_cast<const void*>(addr);
+  *out_len = static_cast<int>(n);
+  *out_type = static_cast<int>(t);
+  return 0;
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_num_data",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = static_cast<int>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_num_feature",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = static_cast<int>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_save_binary",
+      Py_BuildValue("(Ls)", reinterpret_cast<long long>(handle),
+                    filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "free_handle",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- Booster ---------------- */
+
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters, BoosterHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_create",
+      Py_BuildValue("(Ls)", reinterpret_cast<long long>(train_data),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<BoosterHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+static int booster_from_pair(PyObject* r, int* out_num_iterations,
+                             BoosterHandle* out) {
+  long long h = 0, it = 0;
+  if (!PyArg_ParseTuple(r, "LL", &h, &it)) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = reinterpret_cast<BoosterHandle>(h);
+  if (out_num_iterations != nullptr) {
+    *out_num_iterations = static_cast<int>(it);
+  }
+  return 0;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl("booster_create_from_modelfile",
+                          Py_BuildValue("(s)", filename));
+  if (r == nullptr) return -1;
+  int rc = booster_from_pair(r, out_num_iterations, out);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl("booster_load_model_from_string",
+                          Py_BuildValue("(s)", model_str));
+  if (r == nullptr) return -1;
+  int rc = booster_from_pair(r, out_num_iterations, out);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  return LGBM_DatasetFree(handle);  // same registry
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_add_valid_data",
+      Py_BuildValue("(LL)", reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(valid_data)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_reset_parameter",
+      Py_BuildValue("(Ls)", reinterpret_cast<long long>(handle),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_update_one_iter",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *is_finished = static_cast<int>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_rollback_one_iter",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int int_getter(const char* fn, BoosterHandle handle, int* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      fn, Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = static_cast<int>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                    int* out_iteration) {
+  return int_getter("booster_get_current_iteration", handle,
+                    out_iteration);
+}
+
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration) {
+  return int_getter("booster_num_model_per_iteration", handle,
+                    out_tree_per_iteration);
+}
+
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                   int* out_models) {
+  return int_getter("booster_number_of_total_model", handle,
+                    out_models);
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  return int_getter("booster_get_num_classes", handle, out_len);
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  return int_getter("booster_get_num_feature", handle, out_len);
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_feature_names",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  int rc = copy_strings_out(r, out_len, out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_eval_names",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyList_Size(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_eval_names",
+      Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  int rc = copy_strings_out(r, out_len, out_strs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                        int* out_len, double* out_results) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_eval",
+      Py_BuildValue("(Li)", reinterpret_cast<long long>(handle),
+                    data_idx));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_calc_num_predict",
+      Py_BuildValue("(Liii)", reinterpret_cast<long long>(handle),
+                    num_row, predict_type, num_iteration));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_len = as_int(r, &ok);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_mat",
+      Py_BuildValue("(LLiiiiiisL)",
+                    reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<int>(nrow), static_cast<int>(ncol),
+                    is_row_major, predict_type, num_iteration,
+                    parameter ? parameter : "",
+                    reinterpret_cast<long long>(out_result)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_len = as_int(r, &ok);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_file",
+      Py_BuildValue("(Lsiiiss)", reinterpret_cast<long long>(handle),
+                    data_filename, data_has_header, predict_type,
+                    num_iteration, parameter ? parameter : "",
+                    result_filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_save_model",
+      Py_BuildValue("(Liis)", reinterpret_cast<long long>(handle),
+                    start_iteration, num_iteration, filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int string_out(const char* fn, BoosterHandle handle,
+                      int start_iteration, int num_iteration,
+                      int64_t buffer_len, int64_t* out_len,
+                      char* out_str) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      fn, Py_BuildValue("(Lii)", reinterpret_cast<long long>(handle),
+                        start_iteration, num_iteration));
+  if (r == nullptr) return -1;
+  int rc = copy_string_out(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                  int start_iteration,
+                                  int num_iteration,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str) {
+  return string_out("booster_save_model_to_string", handle,
+                    start_iteration, num_iteration, buffer_len,
+                    out_len, out_str);
+}
+
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int64_t buffer_len,
+                          int64_t* out_len, char* out_str) {
+  return string_out("booster_dump_model", handle, start_iteration,
+                    num_iteration, buffer_len, out_len, out_str);
+}
+
+}  // extern "C"
