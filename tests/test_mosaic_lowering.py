@@ -125,3 +125,38 @@ def test_split_scan_vmapped_lowers_for_tpu():
         return jax.vmap(lambda hh: per_feature_numerical_pallas(
             hh, pg, ph, pc, meta, params, lo, hi, fm))(hh2)
     _lowers(batched, hist2)
+
+
+@pytest.mark.parametrize("leaves,f", [(15, 12), (255, 28)])
+def test_full_fused_training_block_lowers_for_tpu(leaves, f):
+    """The ENTIRE fused-iteration device program — gradients -> grow
+    (compiled Pallas hist/partition/scan kernels) -> score update,
+    scanned over m iterations — lowers for TPU on this host. This is
+    the program bench.py dispatches; a Mosaic regression anywhere in
+    the grow loop fails HERE instead of burning the first tunnel
+    window."""
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.learner.partitioned import PartitionedTreeLearner
+    from lightgbm_tpu.models.gbdt import GBDT, _fused_iter_block
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(512, f).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": leaves,
+        "tree_learner": "partitioned", "verbosity": -1})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    b = GBDT(cfg, ds)
+    # compiled-kernel learner (interpret=False) like the real chip
+    ln = PartitionedTreeLearner(ds, cfg, interpret=False)
+    assert ln.supports_fused_scan and ln.fused_scan_ok()
+
+    fused = jax.jit(
+        functools.partial(_fused_iter_block, learner=ln,
+                          grad_fn=b._grad_fn, bag_fn=None, k=1),
+        static_argnames=("m",))
+    fused.trace(ln.mat, ln.ws, b.train_score, jnp.float32(0.1),
+                jnp.int32(0), m=4).lower(lowering_platforms=("tpu",))
