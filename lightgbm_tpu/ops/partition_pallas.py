@@ -242,7 +242,13 @@ def partition_segment(mat, ws, begin, count, feat, thr, default_left,
         ],
         input_output_aliases={2: 0, 3: 1},
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        # raise the scoped-VMEM ceiling like the histogram kernels
+        # (hist_pallas.VMEM_LIMIT): block intermediates beyond the
+        # declared scratch live on the Mosaic stack, and the default
+        # 16 MB budget OOMed the hist kernel's first v5e compile
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(scal, cat_lut, mat, ws)
     return mat2, ws2, nl.reshape(1)
 

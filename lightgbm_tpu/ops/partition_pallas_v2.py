@@ -350,6 +350,11 @@ def partition_segment_v2(mat, ws, begin, count, feat, thr, default_left,
         ],
         input_output_aliases={2: 0, 3: 1},
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        # raise the scoped-VMEM ceiling like the histogram kernels —
+        # the staging streams' declared scratch (~6 MB via pick_blk)
+        # plus Mosaic stack intermediates must clear the default 16 MB
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(scal, cat_lut, mat, ws)
     return mat2, ws2, nl.reshape(1)
