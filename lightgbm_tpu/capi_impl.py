@@ -109,6 +109,79 @@ def dataset_create_from_mat(data_ptr: int, data_type: int, nrow: int,
     return _register(ds)
 
 
+def _csr_from_ptrs(indptr_ptr: int, indptr_type: int, indices_ptr: int,
+                   data_ptr: int, data_type: int, nindptr: int,
+                   nelem: int, num_col: int):
+    import scipy.sparse as sp
+    indptr = np.array(_as_array(indptr_ptr, nindptr, indptr_type))
+    indices = np.array(_as_array(indices_ptr, nelem, DTYPE_INT32))
+    # one copy straight to f64 (Bosch/Criteo-scale value buffers)
+    vals = np.array(_as_array(data_ptr, nelem, data_type),
+                    dtype=np.float64)
+    return sp.csr_matrix((vals, indices, indptr),
+                         shape=(int(nindptr) - 1, int(num_col)))
+
+
+def _predict_to_ptr(bst, data, predict_type: int, num_iteration: int,
+                    parameter: str, out_ptr: int) -> int:
+    """Shared ForMat/ForCSR tail: predict-kind dispatch, prediction
+    parameters, and the f64 copy-out. Returns out_len."""
+    kwargs: Dict[str, Any] = dict(
+        num_iteration=num_iteration if num_iteration > 0 else None)
+    pp = _parse_params(parameter)
+    if pp.get("pred_early_stop", "").lower() in ("true", "1", "+"):
+        kwargs.update(pred_early_stop=True)
+        if "pred_early_stop_freq" in pp:
+            kwargs["pred_early_stop_freq"] = int(
+                pp["pred_early_stop_freq"])
+        if "pred_early_stop_margin" in pp:
+            kwargs["pred_early_stop_margin"] = float(
+                pp["pred_early_stop_margin"])
+    if predict_type == PREDICT_RAW_SCORE:
+        pred = bst.predict(data, raw_score=True, **kwargs)
+    elif predict_type == PREDICT_LEAF_INDEX:
+        pred = bst.predict(data, pred_leaf=True, **kwargs)
+    elif predict_type == PREDICT_CONTRIB:
+        pred = bst.predict(data, pred_contrib=True, **kwargs)
+    else:
+        pred = bst.predict(data, **kwargs)
+    pred = np.ascontiguousarray(np.asarray(pred, np.float64).reshape(-1))
+    out = _as_array(out_ptr, len(pred), DTYPE_FLOAT64)
+    out[:] = pred
+    return len(pred)
+
+
+def dataset_create_from_csr(indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, nindptr: int, nelem: int,
+                            num_col: int, parameters: str,
+                            ref: int) -> int:
+    """CSR ingestion stays sparse end-to-end (Dataset.from_scipy;
+    c_api.cpp LGBM_DatasetCreateFromCSR)."""
+    from .basic import Dataset
+    csr = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr,
+                         data_ptr, data_type, nindptr, nelem, num_col)
+    ds = Dataset(csr, params=_parse_params(parameters),
+                 reference=_get(ref) if ref else None)
+    ds.construct()
+    return _register(ds)
+
+
+def booster_predict_for_csr(h: int, indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, nindptr: int, nelem: int,
+                            num_col: int, predict_type: int,
+                            num_iteration: int, parameter: str,
+                            out_ptr: int) -> int:
+    """Sparse predict rides the chunked no-densify path
+    (basic.Booster.predict on scipy input)."""
+    bst = _get(h)
+    csr = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr,
+                         data_ptr, data_type, nindptr, nelem, num_col)
+    return _predict_to_ptr(bst, csr, predict_type, num_iteration,
+                           parameter, out_ptr)
+
+
 def dataset_set_feature_names(h: int, names: List[str]) -> None:
     ds = _get(h)
     ds.feature_name = list(names)
@@ -322,29 +395,8 @@ def booster_predict_for_mat(h: int, data_ptr: int, data_type: int,
         mat = np.asarray(flat, np.float64).reshape(nrow, ncol)
     else:
         mat = np.asarray(flat, np.float64).reshape(ncol, nrow).T
-    kwargs: Dict[str, Any] = dict(
-        num_iteration=num_iteration if num_iteration > 0 else None)
-    pp = _parse_params(parameter)
-    if pp.get("pred_early_stop", "").lower() in ("true", "1", "+"):
-        kwargs.update(pred_early_stop=True)
-        if "pred_early_stop_freq" in pp:
-            kwargs["pred_early_stop_freq"] = int(
-                pp["pred_early_stop_freq"])
-        if "pred_early_stop_margin" in pp:
-            kwargs["pred_early_stop_margin"] = float(
-                pp["pred_early_stop_margin"])
-    if predict_type == PREDICT_RAW_SCORE:
-        pred = bst.predict(mat, raw_score=True, **kwargs)
-    elif predict_type == PREDICT_LEAF_INDEX:
-        pred = bst.predict(mat, pred_leaf=True, **kwargs)
-    elif predict_type == PREDICT_CONTRIB:
-        pred = bst.predict(mat, pred_contrib=True, **kwargs)
-    else:
-        pred = bst.predict(mat, **kwargs)
-    pred = np.ascontiguousarray(np.asarray(pred, np.float64).reshape(-1))
-    out = _as_array(out_ptr, len(pred), DTYPE_FLOAT64)
-    out[:] = pred
-    return len(pred)
+    return _predict_to_ptr(bst, mat, predict_type, num_iteration,
+                           parameter, out_ptr)
 
 
 def booster_predict_for_file(h: int, data_filename: str,

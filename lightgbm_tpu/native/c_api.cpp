@@ -206,6 +206,32 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type,
   return ok ? 0 : -1;
 }
 
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_from_csr",
+      Py_BuildValue("(LiLLiLLLsL)",
+                    reinterpret_cast<long long>(indptr), indptr_type,
+                    reinterpret_cast<long long>(indices),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    parameters ? parameters : "",
+                    reinterpret_cast<long long>(reference)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                 const char** feature_names,
                                 int num_feature_names) {
@@ -542,6 +568,33 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                     static_cast<int>(nrow), static_cast<int>(ncol),
                     is_row_major, predict_type, num_iteration,
                     parameter ? parameter : "",
+                    reinterpret_cast<long long>(out_result)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_len = as_int(r, &ok);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_csr",
+      Py_BuildValue("(LLiLLiLLLiisL)",
+                    reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(indptr), indptr_type,
+                    reinterpret_cast<long long>(indices),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col), predict_type,
+                    num_iteration, parameter ? parameter : "",
                     reinterpret_cast<long long>(out_result)));
   if (r == nullptr) return -1;
   bool ok;

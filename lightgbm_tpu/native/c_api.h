@@ -45,6 +45,13 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type,
                               int is_row_major, const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                 const char** feature_names,
                                 int num_feature_names);
@@ -98,6 +105,13 @@ int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
 int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int data_type, int32_t nrow, int32_t ncol,
                               int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
 int LGBM_BoosterPredictForFile(BoosterHandle handle,

@@ -255,3 +255,61 @@ def test_capi_impl_python_layer_direct(tmp_path):
     ci.free_handle(h2)
     ci.free_handle(b)
     ci.free_handle(h)
+
+
+def test_c_api_csr_train_and_predict(capi_so):
+    """CSR ingestion + sparse predict through the compiled shim via
+    ctypes: marshalling of the 10/13-arg CSR signatures, sparse
+    end-to-end parity with the Python API."""
+    sp = pytest.importorskip("scipy.sparse")
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    M = rng.randn(500, 30) * (rng.rand(500, 30) < 0.1)
+    M[:, 0] = rng.randn(500)
+    y = (M[:, 0] > 0).astype(np.float32)
+    csr = sp.csr_matrix(M)
+    indptr = np.ascontiguousarray(csr.indptr, np.int32)
+    indices = np.ascontiguousarray(csr.indices, np.int32)
+    vals = np.ascontiguousarray(csr.data, np.float64)
+
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), 2,  # INT32
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,    # FLOAT64
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(30), b"verbosity=-1", None, ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_GetLastError()
+    yy = np.ascontiguousarray(y)
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", yy.ctypes.data_as(ctypes.c_void_p), 500, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(5):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    out = np.zeros(500, np.float64)
+    out_len = ctypes.c_int64()
+    rc = lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(30), 0, -1, b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == 500
+
+    # parity: same training through the Python API on the same CSR
+    ref = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(csr, label=np.asarray(y, np.float64)),
+                    num_boost_round=5).predict(csr)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-9)
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
