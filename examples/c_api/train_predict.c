@@ -1,0 +1,65 @@
+/* Minimal C client: dense train -> evaluate -> predict -> save.
+ * Build instructions in README.md. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "c_api.h"
+
+#define CHECK(call) do { \
+    if ((call) != 0) { \
+        fprintf(stderr, "error in %s: %s\n", #call, LGBM_GetLastError()); \
+        return 1; \
+    } } while (0)
+
+int main(void) {
+    int n = 1000, f = 8;
+    double* X = (double*)malloc(sizeof(double) * n * f);
+    float* y = (float*)malloc(sizeof(float) * n);
+    unsigned s = 7;
+    for (int i = 0; i < n; ++i) {
+        double x0 = 0;
+        for (int j = 0; j < f; ++j) {
+            s = s * 1664525u + 1013904223u;
+            X[i * f + j] = ((double)(s >> 8) / (1 << 24)) * 2.0 - 1.0;
+            if (j == 0) x0 = X[i * f + j];
+        }
+        y[i] = x0 > 0 ? 1.0f : 0.0f;
+    }
+
+    DatasetHandle ds = NULL;
+    CHECK(LGBM_DatasetCreateFromMat(X, C_API_DTYPE_FLOAT64, n, f, 1,
+                                    "verbosity=-1", NULL, &ds));
+    CHECK(LGBM_DatasetSetField(ds, "label", y, n, C_API_DTYPE_FLOAT32));
+
+    BoosterHandle bst = NULL;
+    CHECK(LGBM_BoosterCreate(
+        ds, "objective=binary num_leaves=31 metric=auc verbosity=-1",
+        &bst));
+    for (int it = 0; it < 20; ++it) {
+        int finished = 0;
+        CHECK(LGBM_BoosterUpdateOneIter(bst, &finished));
+        if (finished) break;
+    }
+
+    int eval_len = 0;
+    double auc[4];
+    CHECK(LGBM_BoosterGetEvalCounts(bst, &eval_len));
+    CHECK(LGBM_BoosterGetEval(bst, 0, &eval_len, auc));
+    printf("train auc: %.4f\n", auc[0]);
+
+    int64_t out_len = 0;
+    double* preds = (double*)malloc(sizeof(double) * n);
+    CHECK(LGBM_BoosterPredictForMat(bst, X, C_API_DTYPE_FLOAT64, n, f,
+                                    1, C_API_PREDICT_NORMAL, -1, "",
+                                    &out_len, preds));
+    printf("first predictions: %.4f %.4f %.4f\n",
+           preds[0], preds[1], preds[2]);
+
+    CHECK(LGBM_BoosterSaveModel(bst, 0, -1, "c_api_model.txt"));
+    printf("model saved to c_api_model.txt\n");
+
+    CHECK(LGBM_BoosterFree(bst));
+    CHECK(LGBM_DatasetFree(ds));
+    free(X); free(y); free(preds);
+    return 0;
+}
