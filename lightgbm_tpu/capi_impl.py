@@ -363,6 +363,39 @@ def booster_dump_model(h: int, start_iteration: int,
         start_iteration=start_iteration))
 
 
+def booster_feature_importance(h: int, num_iteration: int,
+                               importance_type: int,
+                               out_ptr: int) -> int:
+    """0 = split counts, 1 = total gain
+    (C_API_FEATURE_IMPORTANCE_*, c_api.cpp:1651-1669)."""
+    bst = _get(h)
+    imp = bst.feature_importance(
+        "gain" if importance_type == 1 else "split",
+        iteration=num_iteration if num_iteration > 0 else None)
+    out = _as_array(out_ptr, len(imp), DTYPE_FLOAT64)
+    out[:] = np.asarray(imp, np.float64)
+    return len(imp)
+
+
+def booster_get_leaf_value(h: int, tree_idx: int,
+                           leaf_idx: int) -> float:
+    return float(_get(h)._src().models[tree_idx].leaf_value[leaf_idx])
+
+
+def booster_set_leaf_value(h: int, tree_idx: int, leaf_idx: int,
+                           val: float) -> None:
+    """Tree::SetLeafOutput analog (c_api.cpp LGBM_BoosterSetLeafValue):
+    overwrite one leaf's output in the materialized model."""
+    bst = _get(h)
+    src = bst._src()
+    if hasattr(src, "finalize_trees"):
+        src.finalize_trees()
+    tree = src.models[tree_idx]
+    if hasattr(tree, "materialize"):
+        tree = tree.materialize()
+    tree.leaf_value[leaf_idx] = float(val)
+
+
 def _num_predict_per_row(bst, ncol: int, predict_type: int,
                          num_iteration: int) -> int:
     k = bst.num_model_per_iteration()

@@ -663,4 +663,48 @@ int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
                     num_iteration, buffer_len, out_len, out_str);
 }
 
+int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                  int num_iteration,
+                                  int importance_type,
+                                  double* out_results) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_feature_importance",
+      Py_BuildValue("(LiiL)", reinterpret_cast<long long>(handle),
+                    num_iteration, importance_type,
+                    reinterpret_cast<long long>(out_results)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_get_leaf_value",
+      Py_BuildValue("(Lii)", reinterpret_cast<long long>(handle),
+                    tree_idx, leaf_idx));
+  if (r == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_set_leaf_value",
+      Py_BuildValue("(Liid)", reinterpret_cast<long long>(handle),
+                    tree_idx, leaf_idx, val));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 }  // extern "C"

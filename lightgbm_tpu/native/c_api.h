@@ -129,6 +129,14 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle,
 int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
                           int num_iteration, int64_t buffer_len,
                           int64_t* out_len, char* out_str);
+int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                  int num_iteration,
+                                  int importance_type,
+                                  double* out_results);
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
 
 #ifdef __cplusplus
 }

@@ -313,3 +313,52 @@ def test_c_api_csr_train_and_predict(capi_so):
     np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-9)
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_importance_and_leaf_values(capi_so):
+    """FeatureImportance (split/gain) and leaf get/set through the
+    compiled shim; SetLeafValue visibly changes prediction."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = np.ascontiguousarray(rng.randn(300, 6))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    lib.LGBM_BoosterSetLeafValue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double]
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 300, 6, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(4):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    imp_split = np.zeros(6, np.float64)
+    imp_gain = np.zeros(6, np.float64)
+    assert lib.LGBM_BoosterFeatureImportance(
+        bst, -1, 0, imp_split.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))) == 0
+    assert lib.LGBM_BoosterFeatureImportance(
+        bst, -1, 1, imp_gain.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))) == 0
+    assert imp_split[0] == imp_split.max() > 0   # x0 drives the label
+    assert imp_gain[0] == imp_gain.max() > 0
+
+    v = ctypes.c_double()
+    assert lib.LGBM_BoosterGetLeafValue(bst, 0, 0,
+                                        ctypes.byref(v)) == 0
+    assert np.isfinite(v.value)
+    assert lib.LGBM_BoosterSetLeafValue(bst, 0, 0, v.value + 1.0) == 0
+    v2 = ctypes.c_double()
+    assert lib.LGBM_BoosterGetLeafValue(bst, 0, 0,
+                                        ctypes.byref(v2)) == 0
+    assert abs(v2.value - (v.value + 1.0)) < 1e-12
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
