@@ -84,6 +84,9 @@ def main():
         return 2
 
     ok = []
+    ok.append(run("probe_i8_masks",
+                  [sys.executable, "tools/probe_i8_masks.py"],
+                  min(420, left())))
     ok.append(run("micro_kernel_bench",
                   [sys.executable, "tools/micro_kernel_bench.py",
                    "500000"],
