@@ -182,6 +182,39 @@ def booster_predict_for_csr(h: int, indptr_ptr: int, indptr_type: int,
                            parameter, out_ptr)
 
 
+def dataset_create_from_csc(colptr_ptr: int, colptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, ncol_ptr: int, nelem: int,
+                            num_row: int, parameters: str,
+                            ref: int) -> int:
+    import scipy.sparse as sp
+    from .basic import Dataset
+    colptr = np.array(_as_array(colptr_ptr, ncol_ptr, colptr_type))
+    indices = np.array(_as_array(indices_ptr, nelem, DTYPE_INT32))
+    vals = np.array(_as_array(data_ptr, nelem, data_type),
+                    dtype=np.float64)
+    csc = sp.csc_matrix((vals, indices, colptr),
+                        shape=(int(num_row), int(ncol_ptr) - 1))
+    ds = Dataset(csc, params=_parse_params(parameters),
+                 reference=_get(ref) if ref else None)
+    ds.construct()
+    return _register(ds)
+
+
+def dataset_get_subset(h: int, indices_ptr: int, n_indices: int,
+                       parameters: str) -> int:
+    """Row subset sharing the parent's bin layout
+    (LGBM_DatasetGetSubset; used by cv folds / bagging hosts)."""
+    idx = np.array(_as_array(indices_ptr, n_indices, DTYPE_INT32))
+    sub = _get(h).subset(idx, params=_parse_params(parameters))
+    sub.construct()
+    return _register(sub)
+
+
+def dataset_add_features_from(target: int, source: int) -> None:
+    _get(target).add_features_from(_get(source))
+
+
 def dataset_set_feature_names(h: int, names: List[str]) -> None:
     ds = _get(h)
     ds.feature_name = list(names)
@@ -283,6 +316,20 @@ def booster_reset_parameter(h: int, parameters: str) -> None:
 def booster_update_one_iter(h: int) -> int:
     """-> 1 when training cannot continue (reference is_finished)."""
     return 1 if _get(h).update() else 0
+
+
+def booster_update_one_iter_custom(h: int, grad_ptr: int,
+                                   hess_ptr: int) -> int:
+    """Custom-objective step: caller-supplied f32 grad/hess over the
+    training rows (x num_class, class-major like the reference)."""
+    bst = _get(h)
+    gbdt = bst._gbdt
+    if gbdt is None:
+        raise ValueError("Cannot update a loaded-model Booster")
+    n = int(gbdt.train_data.num_data) * int(gbdt.num_tree_per_iteration)
+    grad = np.array(_as_array(grad_ptr, n, DTYPE_FLOAT32))
+    hess = np.array(_as_array(hess_ptr, n, DTYPE_FLOAT32))
+    return 1 if gbdt.train_one_iter(grad, hess) else 0
 
 
 def booster_rollback_one_iter(h: int) -> None:

@@ -232,6 +232,62 @@ int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
   return ok ? 0 : -1;
 }
 
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr,
+                              int64_t nelem, int64_t num_row,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_from_csc",
+      Py_BuildValue("(LiLLiLLLsL)",
+                    reinterpret_cast<long long>(col_ptr), col_ptr_type,
+                    reinterpret_cast<long long>(indices),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row),
+                    parameters ? parameters : "",
+                    reinterpret_cast<long long>(reference)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_get_subset",
+      Py_BuildValue("(LLis)", reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(used_row_indices),
+                    static_cast<int>(num_used_row_indices),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                DatasetHandle source) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_add_features_from",
+      Py_BuildValue("(LL)", reinterpret_cast<long long>(target),
+                    reinterpret_cast<long long>(source)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                 const char** feature_names,
                                 int num_feature_names) {
@@ -436,6 +492,23 @@ int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
   return ok ? 0 : -1;
 }
 
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                    const float* grad,
+                                    const float* hess,
+                                    int* is_finished) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_update_one_iter_custom",
+      Py_BuildValue("(LLL)", reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(grad),
+                    reinterpret_cast<long long>(hess)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *is_finished = static_cast<int>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
   API_BEGIN();
   PyObject* r = call_impl(
@@ -574,6 +647,20 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
   *out_len = as_int(r, &ok);
   Py_DECREF(r);
   return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int ncol, int is_row_major,
+                                       int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
 }
 
 int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,

@@ -52,6 +52,19 @@ int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
                               const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr,
+                              int64_t nelem, int64_t num_row,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                DatasetHandle source);
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                 const char** feature_names,
                                 int num_feature_names);
@@ -83,6 +96,10 @@ int LGBM_BoosterAddValidData(BoosterHandle handle,
 int LGBM_BoosterResetParameter(BoosterHandle handle,
                                const char* parameters);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                    const float* grad,
+                                    const float* hess,
+                                    int* is_finished);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
                                     int* out_iteration);
@@ -107,6 +124,14 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int is_row_major, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int ncol, int is_row_major,
+                                       int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
 int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int indptr_type, const int32_t* indices,
                               const void* data, int data_type,
