@@ -67,6 +67,42 @@ def _load_predict_data(path: str, config) -> np.ndarray:
     return X
 
 
+def _pred_fmt(pred: np.ndarray) -> str:
+    return "%d" if pred.dtype.kind in "iu" else "%.18g"
+
+
+def _predict_file_streaming(booster, path: str, cfg, out: str,
+                            **kwargs) -> None:
+    """two_round predict: stream the input file in bounded chunks and
+    append predictions per chunk (the reference predictor never holds
+    the parsed file either, predictor.cpp:46-109). Writes go to a temp
+    file replaced atomically at the end — a mid-stream failure must not
+    destroy a previous result or leave a partial file behind."""
+    import os
+    from .data.file_loader import TwoRoundLoader
+    loader = TwoRoundLoader(path, cfg)
+    wrote = 0
+    fmt = None
+    tmp = f"{out}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            for X, _, _, _ in loader.iter_chunks():
+                pred = np.asarray(booster.predict(X, **kwargs))
+                if fmt is None:
+                    fmt = _pred_fmt(pred)
+                np.savetxt(fh, pred, delimiter="\t", fmt=fmt)
+                wrote += X.shape[0]
+        os.replace(tmp, out)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    log_info(f"Finished prediction ({wrote} rows, streamed); "
+             f"results saved to {out}")
+
+
 def run_train(params: Dict[str, str]) -> None:
     from . import engine
     from .basic import Dataset
@@ -119,7 +155,6 @@ def run_predict(params: Dict[str, str]) -> None:
     if not cfg.data:
         log_fatal("task=predict requires data=<input file>")
     booster = Booster(model_file=cfg.input_model)
-    X = _load_predict_data(cfg.data, cfg)
     ni = int(cfg.num_iteration_predict)
     kwargs = dict(num_iteration=ni if ni > 0 else -1)
     if cfg.pred_early_stop:
@@ -128,16 +163,19 @@ def run_predict(params: Dict[str, str]) -> None:
             pred_early_stop_freq=int(cfg.pred_early_stop_freq),
             pred_early_stop_margin=float(cfg.pred_early_stop_margin))
     if cfg.predict_leaf_index:
-        pred = booster.predict(X, pred_leaf=True, **kwargs)
+        kwargs["pred_leaf"] = True
     elif cfg.predict_contrib:
-        pred = booster.predict(X, pred_contrib=True, **kwargs)
+        kwargs["pred_contrib"] = True
     else:
-        pred = booster.predict(X, raw_score=bool(cfg.predict_raw_score),
-                               **kwargs)
+        kwargs["raw_score"] = bool(cfg.predict_raw_score)
     out = cfg.output_result or "LightGBM_predict_result.txt"
-    pred = np.asarray(pred)
-    fmt = "%d" if pred.dtype.kind in "iu" else "%.18g"
-    np.savetxt(out, pred, delimiter="\t", fmt=fmt)
+    if cfg.two_round:
+        # memory-bounded streaming predict, like training ingestion
+        _predict_file_streaming(booster, cfg.data, cfg, out, **kwargs)
+        return
+    X = _load_predict_data(cfg.data, cfg)
+    pred = np.asarray(booster.predict(X, **kwargs))
+    np.savetxt(out, pred, delimiter="\t", fmt=_pred_fmt(pred))
     log_info(f"Finished prediction; results saved to {out}")
 
 

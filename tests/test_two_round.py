@@ -197,3 +197,38 @@ def test_two_round_backfills_metadata_accessors(tmp_path, monkeypatch):
                                "two_round": True}).construct()
     np.testing.assert_array_equal(ds.get_label(), y)
     np.testing.assert_allclose(ds.get_init_score(), init)
+
+
+def test_two_round_streaming_predict_cli(tmp_path, monkeypatch):
+    """task=predict with two_round=true streams the input file in
+    chunks; output is identical to the whole-file predict."""
+    from lightgbm_tpu import cli
+    X, y = _data(n=200)
+    tr = str(tmp_path / "p.train")
+    te = str(tmp_path / "p.test")
+    write_tsv(tr, X, y)
+    write_tsv(te, X[:130], y[:130])
+    model = str(tmp_path / "m.txt")
+    cli.main(["task=train", "objective=binary", f"data={tr}",
+              "num_trees=4", "num_leaves=7", "verbosity=-1",
+              f"output_model={model}", "min_data_in_leaf=5"])
+    monkeypatch.setenv("LGBM_TPU_TWO_ROUND_CHUNK_ROWS", "48")
+    out1 = str(tmp_path / "o1.txt")
+    out2 = str(tmp_path / "o2.txt")
+    cli.main(["task=predict", f"data={te}", f"input_model={model}",
+              f"output_result={out1}", "verbosity=-1"])
+    cli.main(["task=predict", f"data={te}", f"input_model={model}",
+              f"output_result={out2}", "two_round=true",
+              "verbosity=-1"])
+    np.testing.assert_allclose(np.loadtxt(out2), np.loadtxt(out1),
+                               rtol=1e-12)
+    # leaf-index streaming too (integer output path)
+    out3 = str(tmp_path / "o3.txt")
+    out4 = str(tmp_path / "o4.txt")
+    cli.main(["task=predict", f"data={te}", f"input_model={model}",
+              f"output_result={out3}", "predict_leaf_index=true",
+              "verbosity=-1"])
+    cli.main(["task=predict", f"data={te}", f"input_model={model}",
+              f"output_result={out4}", "predict_leaf_index=true",
+              "two_round=true", "verbosity=-1"])
+    np.testing.assert_array_equal(np.loadtxt(out4), np.loadtxt(out3))
