@@ -479,6 +479,25 @@ def booster_predict_for_mat(h: int, data_ptr: int, data_type: int,
                            parameter, out_ptr)
 
 
+def network_init(machines: str, local_listen_port: int,
+                 listen_time_out: int, num_machines: int) -> None:
+    """Network::Init analog over jax.distributed
+    (parallel/distributed.py; c_api.cpp LGBM_NetworkInit)."""
+    from .config import Config
+    from .parallel.distributed import init_distributed
+    cfg = Config.from_params({
+        "machines": machines, "num_machines": num_machines,
+        "local_listen_port": local_listen_port,
+        "time_out": max(int(listen_time_out), 1), "verbosity": -1})
+    init_distributed(cfg)
+
+
+def network_free() -> None:
+    import jax
+    if jax.distributed.is_initialized():
+        jax.distributed.shutdown()
+
+
 def booster_predict_for_file(h: int, data_filename: str,
                              data_has_header: int, predict_type: int,
                              num_iteration: int, parameter: str,

@@ -794,4 +794,24 @@ int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
   return 0;
 }
 
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "network_init",
+      Py_BuildValue("(siii)", machines ? machines : "",
+                    local_listen_port, listen_time_out, num_machines));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_NetworkFree() {
+  API_BEGIN();
+  PyObject* r = call_impl("network_free", Py_BuildValue("()"));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 }  // extern "C"

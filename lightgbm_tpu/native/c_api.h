@@ -163,6 +163,11 @@ int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
 int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
                              int leaf_idx, double val);
 
+/* ---- Network (distributed training over jax.distributed) ---- */
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkFree();
+
 #ifdef __cplusplus
 }
 #endif

@@ -447,3 +447,12 @@ def test_c_api_csc_subset_custom_update_single_row(capi_so):
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(sub)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_network_init_single_machine_noop(capi_so):
+    """NetworkInit with one machine is a no-op (like
+    init_distributed); NetworkFree is safe uninitialized."""
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    assert lib.LGBM_NetworkInit(b"127.0.0.1:12400", 12400, 1, 1) == 0
+    assert lib.LGBM_NetworkFree() == 0
