@@ -222,8 +222,13 @@ class TwoRoundLoader:
             log_fatal(f"Data file {path} does not exist")
         self.path = path
         self.config = config
+        # 64k rows keeps per-chunk transients (~15 MB f64 at 28 cols,
+        # plus pandas block copies) small enough that measured peak RSS
+        # beats the in-memory path at 1M rows (tools/
+        # measure_two_round_memory.py); bigger chunks buy little — the
+        # passes are parse-bound, not per-chunk-overhead-bound
         self.chunk_rows = chunk_rows or int(os.environ.get(
-            "LGBM_TPU_TWO_ROUND_CHUNK_ROWS", 262_144))
+            "LGBM_TPU_TWO_ROUND_CHUNK_ROWS", 65_536))
         self.fmt = detect_format(path)
         self.sep = "\t" if self.fmt == "tsv" else ","
         self.names: Optional[List[str]] = None
