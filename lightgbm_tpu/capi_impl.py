@@ -332,6 +332,19 @@ def booster_update_one_iter_custom(h: int, grad_ptr: int,
     return 1 if gbdt.train_one_iter(grad, hess) else 0
 
 
+def booster_refit(h: int, leaf_preds_ptr: int, nrow: int,
+                  ncol: int) -> None:
+    """RefitTree over the booster's own train data with
+    caller-supplied leaf assignments (c_api.cpp LGBM_BoosterRefit)."""
+    bst = _get(h)
+    if bst._gbdt is None:
+        raise ValueError("Cannot refit a loaded-model Booster "
+                         "without training data")
+    lp = np.array(_as_array(leaf_preds_ptr, int(nrow) * int(ncol),
+                            DTYPE_INT32)).reshape(int(nrow), int(ncol))
+    bst._gbdt.refit(lp)
+
+
 def booster_rollback_one_iter(h: int) -> None:
     _get(h).rollback_one_iter()
 

@@ -509,6 +509,19 @@ int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
   return ok ? 0 : -1;
 }
 
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_refit",
+      Py_BuildValue("(LLii)", reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(leaf_preds),
+                    static_cast<int>(nrow), static_cast<int>(ncol)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
   API_BEGIN();
   PyObject* r = call_impl(

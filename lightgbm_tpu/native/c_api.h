@@ -101,6 +101,8 @@ int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
                                     const float* hess,
                                     int* is_finished);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
                                     int* out_iteration);
 int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,

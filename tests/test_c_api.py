@@ -456,3 +456,55 @@ def test_c_api_network_init_single_machine_noop(capi_so):
     lib.LGBM_GetLastError.restype = ctypes.c_char_p
     assert lib.LGBM_NetworkInit(b"127.0.0.1:12400", 12400, 1, 1) == 0
     assert lib.LGBM_NetworkFree() == 0
+
+
+def test_c_api_refit(capi_so):
+    """LGBM_BoosterRefit keeps tree structures and refits leaf values
+    from supplied leaf assignments over the booster's train data."""
+    rng = np.random.RandomState(5)
+    X = np.ascontiguousarray(rng.randn(250, 5))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 250, 5, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 250, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # leaf assignments of the train rows in every tree
+    ntotal = ctypes.c_int()
+    assert lib.LGBM_BoosterNumberOfTotalModel(
+        bst, ctypes.byref(ntotal)) == 0
+    lp = np.zeros(250 * ntotal.value, np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, 250, 5, 1,
+        2, -1, b"", ctypes.byref(out_len),        # LEAF_INDEX
+        lp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    leaf = np.ascontiguousarray(lp.reshape(250, ntotal.value),
+                                np.int32)
+    v_before = ctypes.c_double()
+    assert lib.LGBM_BoosterGetLeafValue(
+        bst, 0, 1, ctypes.byref(v_before)) == 0
+    rc = lib.LGBM_BoosterRefit(
+        bst, leaf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        250, ntotal.value)
+    assert rc == 0, lib.LGBM_GetLastError()
+    # model still predicts sanely after refit
+    out = np.zeros(250, np.float64)
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, 250, 5, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert out[y == 1].mean() > out[y == 0].mean()
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
