@@ -10,6 +10,16 @@
  *
  * Build: see lightgbm_tpu/native/__init__.py:build_c_api() — produces
  * _lightgbm_tpu_capi.so next to this header.
+ *
+ * Not implemented from the reference header (use the Python API):
+ * streaming-push ingestion (LGBM_DatasetPushRows*,
+ * LGBM_DatasetCreateFromSampledColumn, LGBM_DatasetCreateByReference
+ * — two_round=true covers memory-bounded loading),
+ * LGBM_DatasetDumpText, LGBM_DatasetUpdateParamChecking,
+ * LGBM_BoosterMerge/ShuffleModels/ResetTrainingData,
+ * LGBM_BoosterGetUpperBoundValue/GetLowerBoundValue,
+ * LGBM_BoosterPredictForCSRSingleRow/ForCSC/ForMats,
+ * LGBM_NetworkInitWithFunctions.
  */
 #ifndef LIGHTGBM_TPU_C_API_H_
 #define LIGHTGBM_TPU_C_API_H_
