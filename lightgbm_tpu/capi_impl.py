@@ -456,6 +456,23 @@ def booster_set_leaf_value(h: int, tree_idx: int, leaf_idx: int,
     tree.leaf_value[leaf_idx] = float(val)
 
 
+def _bound_value(h: int, reduce_fn) -> float:
+    """GBDT::Get{Upper,Lower}BoundValue (gbdt.cpp:631-645): sum over
+    trees of the extreme leaf output (shrinkage already applied)."""
+    src = _get(h)._src()
+    getattr(src, "finalize_trees", lambda: None)()
+    return float(sum(float(reduce_fn(t.leaf_value))
+                     for t in src.models))
+
+
+def booster_get_upper_bound_value(h: int) -> float:
+    return _bound_value(h, np.max)
+
+
+def booster_get_lower_bound_value(h: int) -> float:
+    return _bound_value(h, np.min)
+
+
 def _num_predict_per_row(bst, ncol: int, predict_type: int,
                          num_iteration: int) -> int:
     k = bst.num_model_per_iteration()

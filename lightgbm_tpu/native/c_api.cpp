@@ -807,6 +807,33 @@ int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
   return 0;
 }
 
+static int bound_value(const char* fn, BoosterHandle handle,
+                       double* out_results) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      fn, Py_BuildValue("(L)", reinterpret_cast<long long>(handle)));
+  if (r == nullptr) return -1;
+  *out_results = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  return bound_value("booster_get_upper_bound_value", handle,
+                     out_results);
+}
+
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  return bound_value("booster_get_lower_bound_value", handle,
+                     out_results);
+}
+
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines) {
   API_BEGIN();

@@ -17,7 +17,6 @@
  * — two_round=true covers memory-bounded loading),
  * LGBM_DatasetDumpText, LGBM_DatasetUpdateParamChecking,
  * LGBM_BoosterMerge/ShuffleModels/ResetTrainingData,
- * LGBM_BoosterGetUpperBoundValue/GetLowerBoundValue,
  * LGBM_BoosterPredictForCSRSingleRow/ForCSC/ForMats,
  * LGBM_NetworkInitWithFunctions.
  */
@@ -174,6 +173,11 @@ int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
                              int leaf_idx, double* out_val);
 int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
                              int leaf_idx, double val);
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results);
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results);
 
 /* ---- Network (distributed training over jax.distributed) ---- */
 int LGBM_NetworkInit(const char* machines, int local_listen_port,

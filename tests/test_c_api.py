@@ -508,3 +508,44 @@ def test_c_api_refit(capi_so):
     assert out[y == 1].mean() > out[y == 0].mean()
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_bound_values(capi_so):
+    """Upper/lower bound = sum over trees of extreme leaf outputs
+    (gbdt.cpp:631-645); raw predictions must lie within them."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(9)
+    X = np.ascontiguousarray(rng.randn(300, 5))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 300, 5, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(4):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    hi = ctypes.c_double()
+    lo = ctypes.c_double()
+    assert lib.LGBM_BoosterGetUpperBoundValue(bst,
+                                              ctypes.byref(hi)) == 0
+    assert lib.LGBM_BoosterGetLowerBoundValue(bst,
+                                              ctypes.byref(lo)) == 0
+    assert lo.value < hi.value
+    out = np.zeros(300, np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, 300, 5, 1,
+        1, -1, b"", ctypes.byref(out_len),        # RAW_SCORE
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert out.max() <= hi.value + 1e-9
+    assert out.min() >= lo.value - 1e-9
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
