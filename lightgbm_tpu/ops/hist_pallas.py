@@ -149,6 +149,43 @@ def _block_dma(mat_hbm, buf, sems, base, blk, win):
     return dma
 
 
+PAYB = 9           # payload bytes the hist kernels decode (g4+h4+cnt)
+
+
+def _nibble_dma(mat_hbm, buf, sems, base, blk, win, *, compact: bool,
+                f_lo: int, nf: int, feat0: int):
+    """Input DMA for the nibble kernels. Non-compact streams the full
+    row window; compact (feature-sliced wide datasets) copies ONLY the
+    slice's columns plus the payload columns into a narrow buffer, so
+    HBM read traffic per slice is ~nf+9 columns instead of C — without
+    this, an Epsilon-like C=2048 would re-read the whole matrix once
+    per slice. Returns (start, wait) taking (slot, i)."""
+    def copies(slot, i):
+        s = pl.multiple_of(base + i * blk, ALIGN)
+        if not compact:
+            return [pltpu.make_async_copy(
+                mat_hbm.at[pl.ds(s, win), :], buf.at[slot],
+                sems.at[slot, 0])]
+        return [
+            pltpu.make_async_copy(
+                mat_hbm.at[pl.ds(s, win), pl.ds(f_lo, nf)],
+                buf.at[slot, :, pl.ds(0, nf)], sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                mat_hbm.at[pl.ds(s, win), pl.ds(feat0, PAYB)],
+                buf.at[slot, :, pl.ds(nf, PAYB)], sems.at[slot, 1]),
+        ]
+
+    def start(slot, i):
+        for cp in copies(slot, i):
+            cp.start()
+
+    def wait(slot, i):
+        for cp in copies(slot, i):
+            cp.wait()
+
+    return start, wait
+
+
 def _payload_lanes(g_hi, g_lo, h_hi, h_lo, cnt, lhs_p):
     """Route the 5 payload planes into their (.., p) lane pattern —
     shared by both nibble variants (the pattern repeats per lo/feature,
@@ -283,7 +320,8 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
                                 out_ref,   # VMEM [NG, 120, GRP*H] f32
                                 buf, sems,
                                 *, blk: int, cols: int, feat0: int,
-                                ngroups: int, hi_n: int):
+                                ngroups: int, hi_n: int,
+                                f_lo: int = 0, nf: int = 0):
     """Grouped nibble variant: per group of GRP features,
 
         out[(f, lo, p), (f', hi)] += lhs[win, GRP*LO*PAY]^T
@@ -295,7 +333,15 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
     and routed into mask lanes with two selects per group — the VPU op
     count per block is ~10 x ngroups + constants, the lowest of the
     variants when features pack ~120 lanes full.
+
+    ``f_lo``/``nf`` histogram the feature slice [f_lo, f_lo+nf) (see
+    the per-feature kernel's slice note).
     """
+    if nf == 0:
+        nf = feat0
+    compact = nf != feat0
+    pay0 = nf if compact else feat0      # payload col base in buf
+    col0 = 0 if compact else f_lo        # feature col base in buf
     begin = scal_ref[0]
     count = scal_ref[1]
     nblk = pl.cdiv(count, blk)
@@ -305,7 +351,9 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
 
     m_lhs = GRP * LO * PAY                           # 120
     n_rhs = GRP * hi_n
-    dma = _block_dma(mat_hbm, buf, sems, base, blk, win)
+    dma_start, dma_wait = _nibble_dma(
+        mat_hbm, buf, sems, base, blk, win, compact=compact,
+        f_lo=f_lo, nf=nf, feat0=feat0)
 
     out_ref[...] = jnp.zeros_like(out_ref)
 
@@ -319,32 +367,32 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
 
     @pl.when(nblk > 0)
     def _():
-        dma(0, 0).start()
+        dma_start(0, 0)
 
     def block_body(i, _):
         slot = jax.lax.rem(i, 2)
 
         @pl.when(i + 1 < nblk)
         def _():
-            dma(1 - slot, i + 1).start()
+            dma_start(1 - slot, i + 1)
 
-        dma(slot, i).wait()
-        mat_i32 = buf[slot].astype(jnp.int32)        # [win, C]
+        dma_wait(slot, i)
+        mat_i32 = buf[slot].astype(jnp.int32)        # [win, C']
         # full-width nibble split ONCE for every feature column
-        mat_hi = mat_i32 // LO                       # [win, C]
+        mat_hi = mat_i32 // LO                       # [win, C']
         mat_lo = mat_i32 - mat_hi * LO
 
         rem = jnp.minimum(count - i * blk, blk)
         _, g_hi, g_lo, h_hi, h_lo, cnt = _decode_block(
-            mat_i32, feat0, shift, rem, win)
+            mat_i32, pay0, shift, rem, win)
         pay_b = _payload_lanes(g_hi, g_lo, h_hi, h_lo, cnt,
                                lhs_p)                # [win, m_lhs]
 
         for gidx in range(ngroups):
-            # tail group clamps past-F columns onto the last feature;
-            # garbage lanes are sliced off in the epilogue
+            # tail group clamps past-slice columns onto the last
+            # feature; garbage lanes are sliced off in the epilogue
             def fcol(m, j):
-                c = min(gidx * GRP + j, feat0 - 1)
+                c = col0 + min(gidx * GRP + j, nf - 1)
                 return m[:, c:c + 1]                 # [win, 1]
 
             def pick3(m, fl):
@@ -367,10 +415,10 @@ def _hist_nibble_kernel_grouped(scal_ref,  # SMEM [2] (begin, count)
 
 def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
                         mat_hbm,        # ANY  [N_pad, C] u8
-                        out_ref,        # VMEM [F, LO*PAY, H] f32
+                        out_ref,        # VMEM [NF, LO*PAY, H] f32
                         buf, sems,      # VMEM [2, win, C] u8, DMA sems [2]
                         *, blk: int, cols: int, feat0: int,
-                        hi_n: int):
+                        hi_n: int, f_lo: int = 0, nf: int = 0):
     """Hierarchical (hi/lo nibble) histogram build.
 
     The per-bin one-hot matmul (``_hist_seg_kernel``) issues
@@ -393,7 +441,17 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
     div/mod per lane routing features into lanes, ~3x the VPU work,
     for MXU utilization this kernel doesn't need (measured
     dispatch-free on v5e: the MXU side has >10x headroom).
+
+    ``f_lo``/``nf`` histogram the feature SLICE [f_lo, f_lo+nf) —
+    datasets wider than MAX_NIBBLE_F dispatch one kernel call per
+    slice (program size stays bounded) instead of falling back to the
+    per-bin kernel, whose VPU mask cost scales with num_bins.
     """
+    if nf == 0:
+        nf = feat0
+    compact = nf != feat0
+    pay0 = nf if compact else feat0      # payload col base in buf
+    col0 = 0 if compact else f_lo        # feature col base in buf
     begin = scal_ref[0]
     count = scal_ref[1]
     nblk = pl.cdiv(count, blk)
@@ -402,7 +460,9 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
     win = blk + ALIGN
 
     m_lhs = LO * PAY                                 # 40
-    dma = _block_dma(mat_hbm, buf, sems, base, blk, win)
+    dma_start, dma_wait = _nibble_dma(
+        mat_hbm, buf, sems, base, blk, win, compact=compact,
+        f_lo=f_lo, nf=nf, feat0=feat0)
 
     out_ref[...] = jnp.zeros_like(out_ref)
 
@@ -414,21 +474,21 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
 
     @pl.when(nblk > 0)
     def _():
-        dma(0, 0).start()
+        dma_start(0, 0)
 
     def block_body(i, _):
         slot = jax.lax.rem(i, 2)
 
         @pl.when(i + 1 < nblk)
         def _():
-            dma(1 - slot, i + 1).start()
+            dma_start(1 - slot, i + 1)
 
-        dma(slot, i).wait()
-        mat_i32 = buf[slot].astype(jnp.int32)        # [win, C]
+        dma_wait(slot, i)
+        mat_i32 = buf[slot].astype(jnp.int32)        # [win, C']
 
         rem = jnp.minimum(count - i * blk, blk)
         _, g_hi, g_lo, h_hi, h_lo, cnt = _decode_block(
-            mat_i32, feat0, shift, rem, win)
+            mat_i32, pay0, shift, rem, win)
         # payload lane pattern is feature-independent: build once
         pay_b = _payload_lanes(g_hi, g_lo, h_hi, h_lo, cnt,
                                lhs_p)                # [win, m_lhs]
@@ -437,10 +497,11 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
         # index would force each feature column out of the [win, C]
         # tile via a one-hot lane reduction (~full-width VPU pass per
         # feature per block); a static slice is free. Program size is
-        # bounded by MAX_NIBBLE_F (wider datasets take the per-bin
-        # kernel), so the unroll cannot blow up Mosaic compile time
-        for f in range(feat0):
-            fcol = mat_i32[:, f:f + 1]               # [win, 1]
+        # bounded by the slice width (<= MAX_NIBBLE_F), so the unroll
+        # cannot blow up Mosaic compile time
+        for f in range(nf):
+            c = col0 + f
+            fcol = mat_i32[:, c:c + 1]               # [win, 1]
             flo = fcol - (fcol // LO) * LO           # narrow; & and >>
             fhi = fcol // LO                         # miscompile (i32)
             lhs = jnp.where(flo == lhs_lo, pay_b,
@@ -458,16 +519,18 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
 @functools.partial(
     jax.jit,
     static_argnames=("num_features", "num_bins", "blk", "interpret",
-                     "variant"))
+                     "variant", "nibble_cap"))
 def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
                               num_bins: int, variant: str,
+                              nibble_cap: int = MAX_NIBBLE_F,
                               blk: int = 2048,
                               interpret: bool = False):
     """Nibble-kernel call -> [F, B, 3] histogram.
 
     ``variant`` is REQUIRED and resolved by the caller
-    (histogram_segment): a None default resolved here would freeze the
-    module global into the jit cache on first trace.
+    (histogram_segment), and ``nibble_cap`` rides as a STATIC arg for
+    the same reason: a module global read here would freeze into the
+    jit cache on first trace.
     """
     if blk % ALIGN:
         raise ValueError(f"blk must be a multiple of {ALIGN}, got {blk}")
@@ -476,46 +539,62 @@ def _histogram_segment_nibble(mat, begin, count, *, num_features: int,
     hi_n = -(-num_bins // LO)                        # ceil(B / LO)
     scal = jnp.stack([jnp.asarray(begin, jnp.int32),
                       jnp.asarray(count, jnp.int32)])
-    common = dict(
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2, blk + ALIGN, cols), jnp.uint8),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
-        compiler_params=_COMPILER_PARAMS,
-        interpret=interpret,
-    )
-    if variant == "grouped":
-        ngroups = -(-f // GRP)
-        raw = pl.pallas_call(
-            functools.partial(_hist_nibble_kernel_grouped, blk=blk,
-                              cols=cols, feat0=f, ngroups=ngroups,
-                              hi_n=hi_n),
-            out_shape=jax.ShapeDtypeStruct(
-                (ngroups, GRP * LO * PAY, GRP * hi_n), jnp.float32),
-            **common,
-        )(scal, mat)
-        # [NG, (fl, lo, p), (fr, hi)] -> diagonal fl == fr -> [F, B, 3]
-        raw = raw.reshape(ngroups, GRP, LO, PAY, GRP, hi_n)
-        diag = jnp.einsum("gjlpjh->gjhlp", raw)   # [NG, GRP, H, LO, P]
-        hist = diag.reshape(ngroups * GRP, hi_n * LO,
-                            PAY)[:f, :num_bins]
-    else:
+    def specs(nf: int) -> dict:
+        # sliced (compact) calls stream only nf+PAYB columns per block
+        buf_cols = (nf + PAYB) if nf != f else cols
+        return dict(
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, blk + ALIGN, buf_cols), jnp.uint8),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+            compiler_params=_COMPILER_PARAMS,
+            interpret=interpret,
+        )
+
+    def slice_hist(f_lo: int, nf: int) -> jnp.ndarray:
+        """[nf, B, PAY] histogram of features [f_lo, f_lo+nf)."""
+        common = specs(nf)
+        if variant == "grouped":
+            ngroups = -(-nf // GRP)
+            raw = pl.pallas_call(
+                functools.partial(_hist_nibble_kernel_grouped, blk=blk,
+                                  cols=cols, feat0=f, ngroups=ngroups,
+                                  hi_n=hi_n, f_lo=f_lo, nf=nf),
+                out_shape=jax.ShapeDtypeStruct(
+                    (ngroups, GRP * LO * PAY, GRP * hi_n), jnp.float32),
+                **common,
+            )(scal, mat)
+            # [NG, (fl,lo,p), (fr,hi)] -> diagonal fl == fr -> [nf,B,P]
+            raw = raw.reshape(ngroups, GRP, LO, PAY, GRP, hi_n)
+            diag = jnp.einsum("gjlpjh->gjhlp", raw)  # [NG,GRP,H,LO,P]
+            return diag.reshape(ngroups * GRP, hi_n * LO,
+                                PAY)[:nf, :num_bins]
         raw = pl.pallas_call(
             functools.partial(_hist_nibble_kernel, blk=blk,
-                              cols=cols, feat0=f, hi_n=hi_n),
+                              cols=cols, feat0=f, hi_n=hi_n,
+                              f_lo=f_lo, nf=nf),
             out_shape=jax.ShapeDtypeStruct(
-                (f, LO * PAY, hi_n), jnp.float32),
+                (nf, LO * PAY, hi_n), jnp.float32),
             **common,
         )(scal, mat)
-        # [F, (lo, p), hi] -> [F, B, 3]
-        raw = raw.reshape(f, LO, PAY, hi_n)
-        hist = raw.transpose(0, 3, 1, 2).reshape(
-            f, hi_n * LO, PAY)[:, :num_bins]
+        # [nf, (lo, p), hi] -> [nf, B, P]
+        raw = raw.reshape(nf, LO, PAY, hi_n)
+        return raw.transpose(0, 3, 1, 2).reshape(
+            nf, hi_n * LO, PAY)[:, :num_bins]
+
+    if f <= nibble_cap:
+        hist = slice_hist(0, f)
+    else:
+        # wide datasets: one bounded-program kernel call per feature
+        # slice (at most 2 distinct compiled widths: full + tail)
+        hist = jnp.concatenate(
+            [slice_hist(lo, min(nibble_cap, f - lo))
+             for lo in range(0, f, nibble_cap)], axis=0)
     g = hist[..., 0] + hist[..., 1]
     h = hist[..., 2] + hist[..., 3]
     return jnp.stack([g, h, hist[..., 4]], axis=-1)  # [F, B, 3]
@@ -536,14 +615,18 @@ def histogram_segment(mat, begin, count, num_bins: int, num_features: int,
     """Histogram of rows [begin, begin+count) -> [F, B, 3] f32.
 
     Dispatches to the nibble kernel (grouped/per-feature mask variant,
-    see HIST_VARIANT) unless F exceeds its unroll cap (MAX_NIBBLE_F),
-    where the per-bin kernel's [B, 8, C] accumulator scales better.
+    see HIST_VARIANT); datasets wider than its unroll cap
+    (MAX_NIBBLE_F) run one kernel call per feature slice. The per-bin
+    kernel (``variant="perbin"``) is kept for on-chip comparison — its
+    VPU mask cost scales with num_bins, ~B/(LO*PAY + B/LO)x the
+    nibble decomposition's.
     """
-    if num_features <= MAX_NIBBLE_F:
+    v = HIST_VARIANT if variant is None else variant
+    if v != "perbin":
         return _histogram_segment_nibble(
             mat, begin, count, num_features=num_features,
             num_bins=num_bins, blk=blk, interpret=interpret,
-            variant=HIST_VARIANT if variant is None else variant)
+            variant=v, nibble_cap=MAX_NIBBLE_F)
     raw = histogram_segment_raw(mat, begin, count,
                                 num_features=num_features,
                                 num_bins=num_bins, blk=blk,

@@ -160,3 +160,17 @@ def test_full_fused_training_block_lowers_for_tpu(leaves, f):
         static_argnames=("m",))
     fused.trace(ln.mat, ln.ws, b.train_score, jnp.float32(0.1),
                 jnp.int32(0), m=4).lower(lowering_platforms=("tpu",))
+
+
+@pytest.mark.parametrize("variant", ["grouped", "perfeat"])
+def test_histogram_wide_slices_lower_for_tpu(variant):
+    """The sliced nibble dispatch at an Epsilon-like width (250
+    features -> 192 + 58 slices, compact two-region DMA) lowers for
+    TPU — both mask variants."""
+    from lightgbm_tpu.ops.hist_pallas import histogram_segment
+    f, b = 250, 64
+    mat = _mat(n=2048, f=f, b=b)
+    _lowers(functools.partial(histogram_segment, num_bins=b,
+                              num_features=f, interpret=False,
+                              variant=variant),
+            mat, jnp.int32(8), jnp.int32(1024))

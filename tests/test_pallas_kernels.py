@@ -32,17 +32,10 @@ def packed():
 
 @pytest.mark.parametrize("begin,count", [(0, 3000), (517, 1234),
                                          (2999, 1), (100, 0)])
-@pytest.mark.parametrize("kernel", ["nibble-grouped", "nibble-perfeat",
-                                    "per_bin"])
-def test_histogram_segment_matches_scatter(packed, begin, count, kernel,
-                                           monkeypatch):
+@pytest.mark.parametrize("variant", ["grouped", "perfeat", "perbin"])
+def test_histogram_segment_matches_scatter(packed, begin, count,
+                                           variant):
     binned, ghc, mat, n, f, b = packed
-    variant = None
-    if kernel == "per_bin":  # force the wide-F fallback branch
-        import lightgbm_tpu.ops.hist_pallas as hp
-        monkeypatch.setattr(hp, "MAX_NIBBLE_F", 0)
-    else:
-        variant = kernel.split("-")[1]
     seg = histogram_segment(mat, begin, count, b, f, interpret=True,
                             variant=variant)
     if count:
@@ -51,6 +44,28 @@ def test_histogram_segment_matches_scatter(packed, begin, count, kernel,
             ghc[begin:begin + count], b))
     else:
         ref = np.zeros((f, b, 3), np.float32)
+    assert np.abs(ref - np.asarray(seg)).max() < 2e-3
+
+
+@pytest.mark.parametrize("variant", ["grouped", "perfeat"])
+def test_histogram_wide_feature_slices(variant, monkeypatch):
+    """F > MAX_NIBBLE_F dispatches one nibble call per feature slice
+    (Epsilon-shaped dense-wide data) — parity across the slice seams."""
+    import lightgbm_tpu.ops.hist_pallas as hp
+    monkeypatch.setattr(hp, "MAX_NIBBLE_F", 7)   # tiny cap -> 3 slices
+    rng = np.random.RandomState(4)
+    n, f, b = 800, 19, 32
+    binned = rng.randint(0, b, (n, f)).astype(np.uint8)
+    ghc = make_ghc(jnp.asarray(rng.randn(n).astype(np.float32)),
+                   jnp.asarray(np.abs(rng.randn(n).astype(np.float32))
+                               + 0.1),
+                   jnp.asarray(np.ones(n, np.float32)))
+    mat = pack_gh(build_matrix(jnp.asarray(binned)), f,
+                  ghc[:, 0], ghc[:, 1], ghc[:, 2])
+    seg = hp.histogram_segment(mat, 13, 700, b, f, interpret=True,
+                               variant=variant)
+    ref = np.asarray(histogram_scatter(
+        jnp.asarray(binned[13:713]), ghc[13:713], b))
     assert np.abs(ref - np.asarray(seg)).max() < 2e-3
 
 
