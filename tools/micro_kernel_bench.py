@@ -91,7 +91,9 @@ def main():
             return jax.lax.fori_loop(0, k, body, jnp.float32(0))
         return jax.jit(chain_hist)
 
-    for variant in ("grouped", "perfeat"):
+    # "perbin" joins the comparison so the wide-dataset decision
+    # (sliced nibble vs per-bin, ops/hist_pallas.py) is measured
+    for variant in ("grouped", "perfeat", "perbin"):
         chain_long = mk_chain_hist(variant, k_chain)
         chain_short = mk_chain_hist(variant, k_short)
         print(f"histogram_segment[{variant}], {k_short}x-vs-{k_chain}x "
