@@ -549,3 +549,93 @@ def test_c_api_bound_values(capi_so):
     assert out.min() >= lo.value - 1e-9
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+THREADED_DRIVER = r"""
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include "c_api.h"
+
+static BoosterHandle g_bst;
+static double* g_X;
+static int g_n, g_f;
+
+static void* worker(void* arg) {
+    long id = (long)arg;
+    double* out = (double*)malloc(sizeof(double) * g_n);
+    int64_t out_len = 0;
+    for (int rep = 0; rep < 3; ++rep) {
+        if (LGBM_BoosterPredictForMat(g_bst, g_X, C_API_DTYPE_FLOAT64,
+                                      g_n, g_f, 1, C_API_PREDICT_NORMAL,
+                                      -1, "", &out_len, out) != 0) {
+            fprintf(stderr, "thread %ld: %s\n", id, LGBM_GetLastError());
+            free(out);
+            return (void*)1;
+        }
+    }
+    /* also exercise the error path + thread-local last-error */
+    DatasetHandle bad = NULL;
+    if (LGBM_DatasetCreateFromFile("/nonexistent", "", NULL, &bad)
+            != -1) {
+        free(out);
+        return (void*)1;
+    }
+    free(out);
+    return (void*)0;
+}
+
+int main(void) {
+    g_n = 200; g_f = 4;
+    g_X = (double*)malloc(sizeof(double) * g_n * g_f);
+    float* y = (float*)malloc(sizeof(float) * g_n);
+    unsigned s = 3;
+    for (int i = 0; i < g_n; ++i) {
+        for (int j = 0; j < g_f; ++j) {
+            s = s * 1664525u + 1013904223u;
+            g_X[i * g_f + j] = ((double)(s >> 8) / (1 << 24)) - 0.5;
+        }
+        y[i] = g_X[i * g_f] > 0 ? 1.0f : 0.0f;
+    }
+    DatasetHandle ds = NULL;
+    if (LGBM_DatasetCreateFromMat(g_X, C_API_DTYPE_FLOAT64, g_n, g_f, 1,
+                                  "verbosity=-1", NULL, &ds)) return 1;
+    if (LGBM_DatasetSetField(ds, "label", y, g_n, C_API_DTYPE_FLOAT32))
+        return 1;
+    if (LGBM_BoosterCreate(ds, "objective=binary num_leaves=7 "
+                               "verbosity=-1", &g_bst)) return 1;
+    int fin = 0;
+    if (LGBM_BoosterUpdateOneIter(g_bst, &fin)) return 1;
+
+    /* 4 threads predicting + erroring concurrently: the GIL hand-off,
+       mutex-guarded bootstrap and thread-local last-error must hold */
+    pthread_t th[4];
+    for (long t = 0; t < 4; ++t) pthread_create(&th[t], NULL, worker,
+                                                (void*)t);
+    long bad = 0;
+    for (int t = 0; t < 4; ++t) {
+        void* r; pthread_join(th[t], &r); bad += (long)r;
+    }
+    if (bad) return 1;
+    printf("THREADED-OK\n");
+    return 0;
+}
+"""
+
+
+def test_c_api_threaded_predict(capi_so, tmp_path):
+    src = tmp_path / "threaded.c"
+    src.write_text(THREADED_DRIVER)
+    exe = tmp_path / "threaded"
+    subprocess.run(
+        ["gcc", "-O1", str(src), "-o", str(exe), f"-I{NATIVE}",
+         capi_so, "-lpthread", f"-Wl,-rpath,{NATIVE}"],
+        check=True, capture_output=True, timeout=120)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([str(exe)], env=env, capture_output=True,
+                          text=True, timeout=570)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    assert "THREADED-OK" in proc.stdout
