@@ -182,19 +182,26 @@ def booster_predict_for_csr(h: int, indptr_ptr: int, indptr_type: int,
                            parameter, out_ptr)
 
 
+def _csc_from_ptrs(colptr_ptr: int, colptr_type: int, indices_ptr: int,
+                   data_ptr: int, data_type: int, ncol_ptr: int,
+                   nelem: int, num_row: int):
+    import scipy.sparse as sp
+    colptr = np.array(_as_array(colptr_ptr, ncol_ptr, colptr_type))
+    indices = np.array(_as_array(indices_ptr, nelem, DTYPE_INT32))
+    vals = np.array(_as_array(data_ptr, nelem, data_type),
+                    dtype=np.float64)
+    return sp.csc_matrix((vals, indices, colptr),
+                         shape=(int(num_row), int(ncol_ptr) - 1))
+
+
 def dataset_create_from_csc(colptr_ptr: int, colptr_type: int,
                             indices_ptr: int, data_ptr: int,
                             data_type: int, ncol_ptr: int, nelem: int,
                             num_row: int, parameters: str,
                             ref: int) -> int:
-    import scipy.sparse as sp
     from .basic import Dataset
-    colptr = np.array(_as_array(colptr_ptr, ncol_ptr, colptr_type))
-    indices = np.array(_as_array(indices_ptr, nelem, DTYPE_INT32))
-    vals = np.array(_as_array(data_ptr, nelem, data_type),
-                    dtype=np.float64)
-    csc = sp.csc_matrix((vals, indices, colptr),
-                        shape=(int(num_row), int(ncol_ptr) - 1))
+    csc = _csc_from_ptrs(colptr_ptr, colptr_type, indices_ptr,
+                         data_ptr, data_type, ncol_ptr, nelem, num_row)
     ds = Dataset(csc, params=_parse_params(parameters),
                  reference=_get(ref) if ref else None)
     ds.construct()
@@ -213,6 +220,19 @@ def dataset_get_subset(h: int, indices_ptr: int, n_indices: int,
 
 def dataset_add_features_from(target: int, source: int) -> None:
     _get(target).add_features_from(_get(source))
+
+
+def booster_predict_for_csc(h: int, colptr_ptr: int, colptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, ncol_ptr: int, nelem: int,
+                            num_row: int, predict_type: int,
+                            num_iteration: int, parameter: str,
+                            out_ptr: int) -> int:
+    bst = _get(h)
+    csc = _csc_from_ptrs(colptr_ptr, colptr_type, indices_ptr,
+                         data_ptr, data_type, ncol_ptr, nelem, num_row)
+    return _predict_to_ptr(bst, csc, predict_type, num_iteration,
+                           parameter, out_ptr)
 
 
 def dataset_set_feature_names(h: int, names: List[str]) -> None:
@@ -330,6 +350,69 @@ def booster_update_one_iter_custom(h: int, grad_ptr: int,
     grad = np.array(_as_array(grad_ptr, n, DTYPE_FLOAT32))
     hess = np.array(_as_array(hess_ptr, n, DTYPE_FLOAT32))
     return 1 if gbdt.train_one_iter(grad, hess) else 0
+
+
+def booster_merge(h: int, other_h: int) -> None:
+    """GBDT::MergeFrom (gbdt.h:61-79): the other booster's trees go
+    FIRST, then this booster's own."""
+    import copy
+    src = _get(h)._src()
+    osrc = _get(other_h)._src()
+    k = src.num_tree_per_iteration
+    if k != osrc.num_tree_per_iteration:
+        raise ValueError("cannot merge boosters with different "
+                         "num_tree_per_iteration")
+    for s in (src, osrc):
+        getattr(s, "finalize_trees", lambda: None)()
+    # the reference leaves iter_ untouched (continued training's
+    # bagging stream keeps counting from the OWN trained iterations)
+    src.models = [copy.deepcopy(t) for t in osrc.models] \
+        + list(src.models)
+
+
+def booster_shuffle_models(h: int, start_iter: int,
+                           end_iter: int) -> None:
+    """GBDT::ShuffleModels (gbdt.h:80-104): Fisher-Yates over
+    iterations [start, end) with the reference's seeded LCG — same
+    seed (17), same NextShort stream, so the permutation matches the
+    reference bit-for-bit."""
+    from .utils.ref_random import RefRandom
+    src = _get(h)._src()
+    getattr(src, "finalize_trees", lambda: None)()
+    k = max(src.num_tree_per_iteration, 1)
+    total = len(src.models) // k
+    start_iter = max(0, start_iter)
+    if end_iter <= 0:
+        end_iter = total
+    end_iter = min(total, end_iter)
+    idx = list(range(total))
+    rng = RefRandom(17)
+    for i in range(start_iter, end_iter - 1):
+        j = rng.next_short(i + 1, end_iter)
+        idx[i], idx[j] = idx[j], idx[i]
+    src.models = [src.models[i * k + j]
+                  for i in idx for j in range(k)]
+
+
+def dataset_dump_text(h: int, filename: str) -> None:
+    """Dataset::DumpTextFile (dataset.cpp:987+): debug dump of the
+    constructed dataset — header, bin bounds, binned rows."""
+    ds = _get(h).construct()._inner
+    with open(filename, "w") as fh:
+        fh.write(f"num_features: {ds.num_features}\n")
+        fh.write(f"num_total_features: {ds.num_total_features}\n")
+        fh.write(f"num_groups: {ds.num_groups}\n")
+        fh.write(f"num_data: {ds.num_data}\n")
+        fh.write("feature_names: "
+                 + ", ".join(ds.feature_names) + "\n")
+        for j, m in enumerate(ds.bin_mappers):
+            fh.write(f"feature {j} num_bin: {m.num_bin} "
+                     f"bin_upper_bound: "
+                     + ", ".join(f"{v:.17g}"
+                                 for v in np.atleast_1d(
+                                     m.bin_upper_bound)) + "\n")
+        np.savetxt(fh, np.asarray(ds.binned, np.int64),
+                   fmt="%d", delimiter="\t")
 
 
 def booster_refit(h: int, leaf_preds_ptr: int, nrow: int,

@@ -353,6 +353,17 @@ int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
   return 0;
 }
 
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_dump_text",
+      Py_BuildValue("(Ls)", reinterpret_cast<long long>(handle),
+                    filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_DatasetGetNumData(DatasetHandle handle, int* out) {
   API_BEGIN();
   PyObject* r = call_impl(
@@ -490,6 +501,30 @@ int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
   *is_finished = static_cast<int>(as_int(r, &ok));
   Py_DECREF(r);
   return ok ? 0 : -1;
+}
+
+int LGBM_BoosterMerge(BoosterHandle handle,
+                      BoosterHandle other_handle) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_merge",
+      Py_BuildValue("(LL)", reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(other_handle)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_shuffle_models",
+      Py_BuildValue("(Lii)", reinterpret_cast<long long>(handle),
+                    start_iter, end_iter));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
 }
 
 int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
@@ -694,6 +729,53 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                     static_cast<long long>(nindptr),
                     static_cast<long long>(nelem),
                     static_cast<long long>(num_col), predict_type,
+                    num_iteration, parameter ? parameter : "",
+                    reinterpret_cast<long long>(out_result)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_len = as_int(r, &ok);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr,
+                                       int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col,
+                                       int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type,
+                                   indices, data, data_type, nindptr,
+                                   nelem, num_col, predict_type,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle,
+                              const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr,
+                              int64_t nelem, int64_t num_row,
+                              int predict_type, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_csc",
+      Py_BuildValue("(LLiLLiLLLiisL)",
+                    reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(col_ptr), col_ptr_type,
+                    reinterpret_cast<long long>(indices),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row), predict_type,
                     num_iteration, parameter ? parameter : "",
                     reinterpret_cast<long long>(out_result)));
   if (r == nullptr) return -1;

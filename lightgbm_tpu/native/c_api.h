@@ -15,10 +15,8 @@
  * streaming-push ingestion (LGBM_DatasetPushRows*,
  * LGBM_DatasetCreateFromSampledColumn, LGBM_DatasetCreateByReference
  * — two_round=true covers memory-bounded loading),
- * LGBM_DatasetDumpText, LGBM_DatasetUpdateParamChecking,
- * LGBM_BoosterMerge/ShuffleModels/ResetTrainingData,
- * LGBM_BoosterPredictForCSRSingleRow/ForCSC/ForMats,
- * LGBM_NetworkInitWithFunctions.
+ * LGBM_DatasetUpdateParamChecking, LGBM_BoosterResetTrainingData,
+ * LGBM_BoosterPredictForMats, LGBM_NetworkInitWithFunctions.
  */
 #ifndef LIGHTGBM_TPU_C_API_H_
 #define LIGHTGBM_TPU_C_API_H_
@@ -85,6 +83,7 @@ int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
 int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
                          int* out_len, const void** out_ptr,
                          int* out_type);
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
 int LGBM_DatasetGetNumData(DatasetHandle handle, int* out);
 int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out);
 int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
@@ -112,6 +111,10 @@ int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
                       int32_t nrow, int32_t ncol);
+int LGBM_BoosterMerge(BoosterHandle handle,
+                      BoosterHandle other_handle);
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
                                     int* out_iteration);
 int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
@@ -150,6 +153,26 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int64_t num_col, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr,
+                                       int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col,
+                                       int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle,
+                              const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr,
+                              int64_t nelem, int64_t num_row,
+                              int predict_type, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
 int LGBM_BoosterPredictForFile(BoosterHandle handle,
                                const char* data_filename,
                                int data_has_header, int predict_type,

@@ -639,3 +639,117 @@ def test_c_api_threaded_predict(capi_so, tmp_path):
     assert proc.returncode == 0, \
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
     assert "THREADED-OK" in proc.stdout
+
+
+def test_c_api_merge_shuffle_dump_and_csc_predict(capi_so, tmp_path):
+    """Merge (other's trees first), seeded ShuffleModels, dataset text
+    dump, and CSC/CSR-single-row prediction through the shim."""
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(13)
+    X = np.ascontiguousarray(rng.randn(200, 4))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    lib.LGBM_BoosterSetLeafValue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_double]
+
+    def make_booster(rounds):
+        ds = ctypes.c_void_p()
+        assert lib.LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), 1, 200, 4, 1,
+            b"verbosity=-1", None, ctypes.byref(ds)) == 0
+        assert lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 200,
+            0) == 0
+        bst = ctypes.c_void_p()
+        assert lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)) == 0
+        fin = ctypes.c_int()
+        for _ in range(rounds):
+            assert lib.LGBM_BoosterUpdateOneIter(
+                bst, ctypes.byref(fin)) == 0
+        return ds, bst
+
+    ds1, b1 = make_booster(3)
+    ds2, b2 = make_booster(2)
+    # make b2's trees distinguishable from b1's (same data + params
+    # would otherwise grow identical trees and hide ordering bugs)
+    for t in range(2):
+        assert lib.LGBM_BoosterSetLeafValue(b2, t, 0,
+                                            100.0 + t) == 0
+
+    def leaf0(b, tree):
+        v = ctypes.c_double()
+        assert lib.LGBM_BoosterGetLeafValue(b, tree,
+                                            0, ctypes.byref(v)) == 0
+        return v.value
+
+    b1_leaves = [leaf0(b1, t) for t in range(3)]
+    assert lib.LGBM_BoosterMerge(b1, b2) == 0
+    total = ctypes.c_int()
+    assert lib.LGBM_BoosterNumberOfTotalModel(b1,
+                                              ctypes.byref(total)) == 0
+    assert total.value == 5
+    # reference order: OTHER's trees first, then own (gbdt.h:61-79)
+    merged = [leaf0(b1, t) for t in range(5)]
+    assert merged == [100.0, 101.0] + b1_leaves
+
+    assert lib.LGBM_BoosterShuffleModels(b1, 0, -1) == 0
+    assert lib.LGBM_BoosterNumberOfTotalModel(b1,
+                                              ctypes.byref(total)) == 0
+    assert total.value == 5
+    # the permutation must be the reference's seeded Fisher-Yates
+    from lightgbm_tpu.utils.ref_random import RefRandom
+    idx = list(range(5))
+    rng_ref = RefRandom(17)
+    for i in range(0, 4):
+        j = rng_ref.next_short(i + 1, 5)
+        idx[i], idx[j] = idx[j], idx[i]
+    assert [leaf0(b1, t) for t in range(5)] == [merged[i] for i in idx]
+
+    dump = str(tmp_path / "dump.txt")
+    assert lib.LGBM_DatasetDumpText(ds1, dump.encode()) == 0
+    text = open(dump).read()
+    assert "num_data: 200" in text and "num_features: 4" in text
+
+    # CSC predict parity with the dense path
+    csc = sp.csc_matrix(X)
+    colptr = np.ascontiguousarray(csc.indptr, np.int32)
+    indices = np.ascontiguousarray(csc.indices, np.int32)
+    vals = np.ascontiguousarray(csc.data, np.float64)
+    out_csc = np.zeros(200, np.float64)
+    out_dense = np.zeros(200, np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterPredictForCSC(
+        b1, colptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(colptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(200), 0, -1, b"", ctypes.byref(out_len),
+        out_csc.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert lib.LGBM_BoosterPredictForMat(
+        b1, X.ctypes.data_as(ctypes.c_void_p), 1, 200, 4, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        out_dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_array_equal(out_csc, out_dense)
+
+    # CSR single-row forwards to the CSR path
+    csr = sp.csr_matrix(X[5:6])
+    ip = np.ascontiguousarray(csr.indptr, np.int32)
+    ix = np.ascontiguousarray(csr.indices, np.int32)
+    v = np.ascontiguousarray(csr.data, np.float64)
+    one = np.zeros(1, np.float64)
+    assert lib.LGBM_BoosterPredictForCSRSingleRow(
+        b1, ip.ctypes.data_as(ctypes.c_void_p), 2,
+        ix.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        v.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(ip)), ctypes.c_int64(len(v)),
+        ctypes.c_int64(4), 0, -1, b"", ctypes.byref(out_len),
+        one.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_allclose(one[0], out_dense[5], rtol=1e-12)
+
+    for handle in (b1, b2):
+        lib.LGBM_BoosterFree(handle)
+    for handle in (ds1, ds2):
+        lib.LGBM_DatasetFree(handle)
