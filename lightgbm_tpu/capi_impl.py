@@ -182,6 +182,93 @@ def booster_predict_for_csr(h: int, indptr_ptr: int, indptr_type: int,
                            parameter, out_ptr)
 
 
+def _wrap_inner(inner, params: Dict[str, str]) -> int:
+    """Register a pre-built inner dataset behind a basic.Dataset
+    wrapper (construct() is a no-op once _inner is set)."""
+    from .basic import Dataset
+    ds = Dataset(None, params=params)
+    ds._inner = inner
+    return _register(ds)
+
+
+def dataset_create_from_sampled_column(sample_data_ptr: int,
+                                       sample_indices_ptr: int,
+                                       ncol: int, num_per_col_ptr: int,
+                                       num_sample_row: int,
+                                       num_total_row: int,
+                                       parameters: str) -> int:
+    """Streaming ingestion step 1 (c_api.cpp
+    LGBM_DatasetCreateFromSampledColumn): bin mappers + EFB plan from
+    per-column nonzero samples; rows arrive via push_rows."""
+    from .config import Config
+    from .data.dataset import Dataset as InnerDataset
+    from .data.dataset import load_forced_bins
+    params = _parse_params(parameters)
+    cfg = Config.from_params(params)
+    nper = np.array(_as_array(num_per_col_ptr, ncol, DTYPE_INT32))
+    dptr = np.array(_as_array(sample_data_ptr, ncol, DTYPE_INT64))
+    iptr = np.array(_as_array(sample_indices_ptr, ncol, DTYPE_INT64))
+    col_values = [np.array(_as_array(int(dptr[j]), int(nper[j]),
+                                     DTYPE_FLOAT64))
+                  if nper[j] else np.zeros(0) for j in range(ncol)]
+    col_indices = [np.array(_as_array(int(iptr[j]), int(nper[j]),
+                                      DTYPE_INT32))
+                   if nper[j] else np.zeros(0, np.int32)
+                   for j in range(ncol)]
+    inner = InnerDataset.from_sampled_columns(
+        col_values, col_indices, num_sample_row, num_total_row, cfg,
+        forced_bins=load_forced_bins(cfg.forcedbins_filename))
+    return _wrap_inner(inner, params)
+
+
+def dataset_create_by_reference(ref: int, num_total_row: int) -> int:
+    """Streaming ingestion aligned with an existing dataset's bin
+    layout (LGBM_DatasetCreateByReference) — valid sets built by
+    push_rows."""
+    from .data.dataset import Dataset as InnerDataset
+    parent = _get(ref)
+    pinner = parent.construct()._inner
+    if pinner.mv_group_start is not None:
+        raise ValueError("push-rows ingestion does not support "
+                         "multi-val bundled references")
+    inner = InnerDataset()
+    inner._copy_layout_from(pinner)
+    inner.num_data = int(num_total_row)
+    inner.num_total_features = pinner.num_total_features
+    inner.use_missing = pinner.use_missing
+    inner.zero_as_missing = pinner.zero_as_missing
+    inner._push_plan = inner.bundle_plan()
+    inner._push_dtype = pinner.binned.dtype.type
+    inner._push_filled = 0
+    inner.binned = np.zeros((int(num_total_row),
+                             pinner.binned.shape[1]),
+                            pinner.binned.dtype)
+    inner.metadata.num_data = int(num_total_row)
+    return _wrap_inner(inner, dict(parent.params or {}))
+
+
+def dataset_push_rows(h: int, data_ptr: int, data_type: int,
+                      nrow: int, ncol: int, start_row: int) -> None:
+    ds = _get(h).construct()._inner
+    flat = _as_array(data_ptr, int(nrow) * int(ncol), data_type)
+    ds.push_rows(np.asarray(flat, np.float64).reshape(int(nrow),
+                                                      int(ncol)),
+                 int(start_row))
+
+
+def dataset_push_rows_by_csr(h: int, indptr_ptr: int, indptr_type: int,
+                             indices_ptr: int, data_ptr: int,
+                             data_type: int, nindptr: int, nelem: int,
+                             num_col: int, start_row: int) -> None:
+    ds = _get(h).construct()._inner
+    csr = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr,
+                         data_ptr, data_type, nindptr, nelem, num_col)
+    # one block at a time: the dense expansion is bounded by the
+    # caller's push-block size, never the full dataset
+    ds.push_rows(np.asarray(csr.todense(), np.float64),
+                 int(start_row))
+
+
 def _csc_from_ptrs(colptr_ptr: int, colptr_type: int, indices_ptr: int,
                    data_ptr: int, data_type: int, ncol_ptr: int,
                    nelem: int, num_row: int):
@@ -305,10 +392,21 @@ def dataset_save_binary(h: int, filename: str) -> None:
 
 # ----------------------------------------------------------------------
 # Booster
+def _check_push_complete(ds) -> None:
+    inner = ds.construct()._inner
+    filled = getattr(inner, "_push_filled", None)
+    if filled is not None and filled < inner.num_data:
+        raise ValueError(
+            f"dataset declares {inner.num_data} rows but only "
+            f"{filled} were pushed; finish LGBM_DatasetPushRows first")
+
+
 def booster_create(train_h: int, parameters: str) -> int:
     from .basic import Booster
     params = _parse_params(parameters)
-    bst = Booster(params=params, train_set=_get(train_h))
+    train = _get(train_h)
+    _check_push_complete(train)
+    bst = Booster(params=params, train_set=train)
     return _register(bst)
 
 
@@ -326,7 +424,9 @@ def booster_load_model_from_string(model_str: str):
 
 def booster_add_valid_data(h: int, valid_h: int) -> None:
     bst = _get(h)
-    bst.add_valid(_get(valid_h), f"valid_{len(bst.valid_sets)}")
+    valid = _get(valid_h)
+    _check_push_complete(valid)
+    bst.add_valid(valid, f"valid_{len(bst.valid_sets)}")
 
 
 def booster_reset_parameter(h: int, parameters: str) -> None:

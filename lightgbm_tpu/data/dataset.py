@@ -639,6 +639,126 @@ class Dataset:
         self.metadata.set_init_score(init_score)
         return self
 
+    @classmethod
+    def from_sampled_columns(cls, col_values: List[np.ndarray],
+                             col_indices: List[np.ndarray],
+                             num_sample_row: int, num_total_row: int,
+                             config: Config,
+                             forced_bins: Optional[
+                                 Dict[int, List[float]]] = None
+                             ) -> "Dataset":
+        """Pre-allocate a dataset from per-column NONZERO value samples
+        (LGBM_DatasetCreateFromSampledColumn,
+        dataset_loader.cpp:CostructFromSampleData): bin mappers and the
+        EFB plan come from the sample; rows arrive later through
+        ``push_rows`` and are binned straight into the packed matrix —
+        the streaming-ingestion path Spark-style integrations use.
+        Conflict-overflow (multi-val) bundling is not supported here;
+        such plans fall back to unbundled columns."""
+        self = cls()
+        num_features = len(col_values)
+        self.num_data = int(num_total_row)
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        self.bin_construct_sample_cnt = config.bin_construct_sample_cnt
+        self.min_data_in_bin = config.min_data_in_bin
+        self.use_missing = config.use_missing
+        self.zero_as_missing = config.zero_as_missing
+        self.feature_names = [f"Column_{i}"
+                              for i in range(num_features)]
+        filter_cnt = int(max(
+            config.min_data_in_leaf * num_sample_row
+            / max(num_total_row, 1), 1)) \
+            if config.feature_pre_filter else 0
+        self.bin_mappers = []
+        for j in range(num_features):
+            colv = np.asarray(col_values[j], np.float64)
+            colv = colv[(np.abs(colv) > kZeroThreshold)
+                        | np.isnan(colv)]
+            mapper = BinMapper()
+            mapper.find_bin(
+                colv, total_sample_cnt=num_sample_row,
+                max_bin=_max_bin_for(config, j),
+                min_data_in_bin=self.min_data_in_bin,
+                min_split_data=filter_cnt,
+                pre_filter=config.feature_pre_filter,
+                bin_type=BIN_TYPE_NUMERICAL,
+                use_missing=self.use_missing,
+                zero_as_missing=self.zero_as_missing,
+                forced_upper_bounds=(forced_bins or {}).get(j, ()))
+            self.bin_mappers.append(mapper)
+        self._finalize_used_features()
+        self._resolve_monotone_and_penalty(config)
+
+        max_b = max([self.num_bin(f)
+                     for f in range(self.num_features)], default=2)
+        self._push_dtype = np.uint8 if max_b <= 256 else np.uint16
+
+        # EFB plan straight from the per-column nonzero samples at
+        # their TRUE sampled-row positions (plan_bundles_from_nonzeros
+        # — O(sample nnz), no dense sample materializes); multi-val
+        # overflow plans are skipped — pushed rows stay unbundled then
+        self._push_plan = None
+        if config.enable_bundle and self.num_features >= 2:
+            from .bundling import plan_bundles_from_nonzeros
+            nz_idx: List[Optional[np.ndarray]] = []
+            for inner, orig in enumerate(self.real_feature_idx):
+                m = self.bin_mappers[orig]
+                ok = (m.bin_type == BIN_TYPE_NUMERICAL
+                      and m.most_freq_bin == 0 and m.default_bin == 0
+                      and m.num_bin <= 256)
+                if not ok:
+                    nz_idx.append(None)
+                    continue
+                vals = np.asarray(col_values[orig], np.float64)
+                idx = np.asarray(col_indices[orig], np.int64)
+                bins = m.values_to_bins(vals)
+                nz_idx.append(idx[bins != 0].astype(np.int32))
+            if any(ix is not None for ix in nz_idx):
+                cand = plan_bundles_from_nonzeros(
+                    nz_idx, self.num_bins_array(), num_sample_row,
+                    seed=config.data_random_seed)
+                if cand.num_groups < self.num_features \
+                        and not cand.has_multival:
+                    self._push_plan = cand
+                    self.feature_group = cand.feature_group
+                    self.feature_offset = cand.feature_offset
+                    self.group_num_bins = cand.group_num_bins
+
+        width = max(self._push_plan.num_groups if self._push_plan
+                    else self.num_features, 1)
+        self.binned = np.zeros((int(num_total_row), width),
+                               self._push_dtype)
+        self._push_filled = 0
+        self.metadata.num_data = int(num_total_row)
+        return self
+
+    def _bin_rows_raw(self, X: np.ndarray) -> np.ndarray:
+        """Bin a raw float block into UNBUNDLED u8/u16 columns."""
+        dtype = getattr(self, "_push_dtype", np.uint8)
+        out = np.zeros((X.shape[0], max(self.num_features, 1)), dtype)
+        for inner, orig in enumerate(self.real_feature_idx):
+            out[:, inner] = self.bin_mappers[orig].values_to_bins(
+                np.asarray(X[:, orig], np.float64)).astype(dtype)
+        return out
+
+    def push_rows(self, X_block: np.ndarray, start_row: int) -> None:
+        """Bin a block of raw rows into [start_row, start_row+m)
+        (LGBM_DatasetPushRows)."""
+        if not hasattr(self, "_push_filled"):
+            log_fatal("push_rows needs a dataset created from sampled "
+                      "columns (LGBM_DatasetCreateFromSampledColumn)")
+        m = X_block.shape[0]
+        if start_row < 0 or start_row + m > self.num_data:
+            log_fatal(f"push_rows out of range: [{start_row}, "
+                      f"{start_row + m}) vs {self.num_data} rows")
+        raw = self._bin_rows_raw(np.asarray(X_block, np.float64))
+        if self._push_plan is not None:
+            from .bundling import bundle_matrix
+            raw = bundle_matrix(raw, self._push_plan)
+        self.binned[start_row:start_row + m] = raw
+        self._push_filled += m
+
     def _find_bins_sparse(self, csc, config: Config,
                           categorical_features: Sequence[int],
                           forced_bins) -> None:

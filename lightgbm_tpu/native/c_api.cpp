@@ -258,6 +258,85 @@ int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
   return ok ? 0 : -1;
 }
 
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices,
+                                        int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_from_sampled_column",
+      Py_BuildValue("(LLiLiis)",
+                    reinterpret_cast<long long>(sample_data),
+                    reinterpret_cast<long long>(sample_indices),
+                    static_cast<int>(ncol),
+                    reinterpret_cast<long long>(num_per_col),
+                    static_cast<int>(num_sample_row),
+                    static_cast<int>(num_total_row),
+                    parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_create_by_reference",
+      Py_BuildValue("(LL)", reinterpret_cast<long long>(reference),
+                    static_cast<long long>(num_total_row)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out = reinterpret_cast<DatasetHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_push_rows",
+      Py_BuildValue("(LLiiii)", reinterpret_cast<long long>(dataset),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<int>(nrow), static_cast<int>(ncol),
+                    static_cast<int>(start_row)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset,
+                              const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              int64_t start_row) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_push_rows_by_csr",
+      Py_BuildValue("(LLiLLiLLLL)",
+                    reinterpret_cast<long long>(dataset),
+                    reinterpret_cast<long long>(indptr), indptr_type,
+                    reinterpret_cast<long long>(indices),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    static_cast<long long>(start_row)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_DatasetGetSubset(const DatasetHandle handle,
                           const int32_t* used_row_indices,
                           int32_t num_used_row_indices,

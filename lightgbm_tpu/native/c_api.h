@@ -12,11 +12,11 @@
  * _lightgbm_tpu_capi.so next to this header.
  *
  * Not implemented from the reference header (use the Python API):
- * streaming-push ingestion (LGBM_DatasetPushRows*,
- * LGBM_DatasetCreateFromSampledColumn, LGBM_DatasetCreateByReference
- * — two_round=true covers memory-bounded loading),
  * LGBM_DatasetUpdateParamChecking, LGBM_BoosterResetTrainingData,
  * LGBM_BoosterPredictForMats, LGBM_NetworkInitWithFunctions.
+ * Streaming-push ingestion note: multi-val (conflict-overflow EFB)
+ * plans are not supported on the push path — such datasets fall back
+ * to unbundled columns.
  */
 #ifndef LIGHTGBM_TPU_C_API_H_
 #define LIGHTGBM_TPU_C_API_H_
@@ -66,6 +66,26 @@ int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
                               const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices,
+                                        int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out);
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset,
+                              const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              int64_t start_row);
 int LGBM_DatasetGetSubset(const DatasetHandle handle,
                           const int32_t* used_row_indices,
                           int32_t num_used_row_indices,

@@ -753,3 +753,97 @@ def test_c_api_merge_shuffle_dump_and_csc_predict(capi_so, tmp_path):
         lib.LGBM_BoosterFree(handle)
     for handle in (ds1, ds2):
         lib.LGBM_DatasetFree(handle)
+
+
+def test_c_api_streaming_push_ingestion(capi_so):
+    """CreateFromSampledColumn + PushRows (+ByCSR) + CreateByReference
+    through the compiled shim: with the sample covering every row, the
+    streamed dataset must train EXACTLY like the from-mat dataset."""
+    sp = pytest.importorskip("scipy.sparse")
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(21)
+    n, f = 300, 6
+    X = np.ascontiguousarray(rng.randn(n, f))
+    X[rng.rand(n, f) < 0.3] = 0.0            # real zeros for EFB stats
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    # per-column nonzero samples over ALL rows (num_sample_row = n)
+    col_vals, col_idx = [], []
+    for j in range(f):
+        nz = np.nonzero(X[:, j] != 0)[0].astype(np.int32)
+        col_idx.append(np.ascontiguousarray(nz))
+        col_vals.append(np.ascontiguousarray(X[nz, j], np.float64))
+    DP = ctypes.POINTER(ctypes.c_double)
+    IP = ctypes.POINTER(ctypes.c_int32)
+    data_arr = (DP * f)(*[v.ctypes.data_as(DP) for v in col_vals])
+    idx_arr = (IP * f)(*[v.ctypes.data_as(IP) for v in col_idx])
+    nper = np.ascontiguousarray(
+        [len(v) for v in col_vals], np.int32)
+
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromSampledColumn(
+        data_arr, idx_arr, f,
+        nper.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), n, n,
+        b"verbosity=-1", ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_GetLastError()
+
+    # push in three blocks: dense, dense, CSR
+    assert lib.LGBM_DatasetPushRows(
+        ds, np.ascontiguousarray(X[:100]).ctypes.data_as(
+            ctypes.c_void_p), 1, 100, f, 0) == 0
+    assert lib.LGBM_DatasetPushRows(
+        ds, np.ascontiguousarray(X[100:200]).ctypes.data_as(
+            ctypes.c_void_p), 1, 100, f, 100) == 0
+    csr = sp.csr_matrix(X[200:])
+    ip = np.ascontiguousarray(csr.indptr, np.int32)
+    ix = np.ascontiguousarray(csr.indices, np.int32)
+    v = np.ascontiguousarray(csr.data, np.float64)
+    assert lib.LGBM_DatasetPushRowsByCSR(
+        ds, ip.ctypes.data_as(ctypes.c_void_p), 2,
+        ix.ctypes.data_as(IP), v.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(ip)), ctypes.c_int64(len(v)),
+        ctypes.c_int64(f), ctypes.c_int64(200)) == 0, \
+        lib.LGBM_GetLastError()
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0) == 0
+
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(4):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    out = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+
+    # exact parity with the whole-matrix path (same rows sampled)
+    ref = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=np.asarray(y, np.float64)),
+                    num_boost_round=4).predict(X)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-9)
+
+    # aligned valid set by reference + push
+    ds2 = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateByReference(
+        ds, ctypes.c_int64(100), ctypes.byref(ds2)) == 0
+    assert lib.LGBM_DatasetPushRows(
+        ds2, np.ascontiguousarray(X[:100]).ctypes.data_as(
+            ctypes.c_void_p), 1, 100, f, 0) == 0
+    yv = np.ascontiguousarray(y[:100])
+    assert lib.LGBM_DatasetSetField(
+        ds2, b"label", yv.ctypes.data_as(ctypes.c_void_p), 100, 0) == 0
+    nd = ctypes.c_int()
+    assert lib.LGBM_DatasetGetNumData(ds2, ctypes.byref(nd)) == 0
+    assert nd.value == 100
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds2)
+    lib.LGBM_DatasetFree(ds)
