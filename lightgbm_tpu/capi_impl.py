@@ -322,6 +322,33 @@ def booster_predict_for_csc(h: int, colptr_ptr: int, colptr_type: int,
                            parameter, out_ptr)
 
 
+# params that cannot change once a Dataset is constructed
+# (Booster::CheckDatasetResetConfig, c_api.cpp:178-260)
+_DATASET_FROZEN_PARAMS = (
+    "data_random_seed", "max_bin", "max_bin_by_feature",
+    "bin_construct_sample_cnt", "min_data_in_bin", "use_missing",
+    "zero_as_missing", "categorical_feature", "feature_pre_filter",
+    "enable_bundle", "is_enable_sparse", "pre_partition", "two_round",
+    "header", "label_column", "weight_column", "group_column",
+    "ignore_column", "forcedbins_filename", "num_class", "boosting",
+    "metric")
+
+
+def dataset_update_param_checking(old_parameters: str,
+                                  new_parameters: str) -> None:
+    """LGBM_DatasetUpdateParamChecking (c_api.cpp:1160-1168): raise if
+    the new parameters change anything a constructed Dataset froze."""
+    from .config import Config
+    old_cfg = Config.from_params(_parse_params(old_parameters))
+    new_map = _parse_params(new_parameters)
+    new_cfg = Config.from_params(new_map)
+    for key in _DATASET_FROZEN_PARAMS:
+        if key in new_map and getattr(new_cfg, key, None) \
+                != getattr(old_cfg, key, None):
+            raise ValueError(f"Cannot change {key} after constructed "
+                             "Dataset handle.")
+
+
 def dataset_set_feature_names(h: int, names: List[str]) -> None:
     ds = _get(h)
     ds.feature_name = list(names)
@@ -709,6 +736,20 @@ def network_free() -> None:
     import jax
     if jax.distributed.is_initialized():
         jax.distributed.shutdown()
+
+
+def booster_predict_for_mats(h: int, rows_ptr: int, data_type: int,
+                             nrow: int, ncol: int, predict_type: int,
+                             num_iteration: int, parameter: str,
+                             out_ptr: int) -> int:
+    """Array-of-row-pointers predict (LGBM_BoosterPredictForMats)."""
+    bst = _get(h)
+    ptrs = np.array(_as_array(rows_ptr, nrow, DTYPE_INT64))
+    mat = np.empty((int(nrow), int(ncol)), np.float64)
+    for i in range(int(nrow)):
+        mat[i] = _as_array(int(ptrs[i]), ncol, data_type)
+    return _predict_to_ptr(bst, mat, predict_type, num_iteration,
+                           parameter, out_ptr)
 
 
 def booster_predict_for_file(h: int, data_filename: str,

@@ -432,6 +432,18 @@ int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
   return 0;
 }
 
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "dataset_update_param_checking",
+      Py_BuildValue("(ss)", old_parameters ? old_parameters : "",
+                    new_parameters ? new_parameters : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
   API_BEGIN();
   PyObject* r = call_impl(
@@ -856,6 +868,28 @@ int LGBM_BoosterPredictForCSC(BoosterHandle handle,
                     static_cast<long long>(nelem),
                     static_cast<long long>(num_row), predict_type,
                     num_iteration, parameter ? parameter : "",
+                    reinterpret_cast<long long>(out_result)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_len = as_int(r, &ok);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow,
+                               int32_t ncol, int predict_type,
+                               int num_iteration, const char* parameter,
+                               int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_mats",
+      Py_BuildValue("(LLiiiiisL)",
+                    reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(data), data_type,
+                    static_cast<int>(nrow), static_cast<int>(ncol),
+                    predict_type, num_iteration,
+                    parameter ? parameter : "",
                     reinterpret_cast<long long>(out_result)));
   if (r == nullptr) return -1;
   bool ok;

@@ -12,8 +12,8 @@
  * _lightgbm_tpu_capi.so next to this header.
  *
  * Not implemented from the reference header (use the Python API):
- * LGBM_DatasetUpdateParamChecking, LGBM_BoosterResetTrainingData,
- * LGBM_BoosterPredictForMats, LGBM_NetworkInitWithFunctions.
+ * LGBM_BoosterResetTrainingData, LGBM_NetworkInitWithFunctions
+ * (custom C collectives are architecturally replaced by XLA/ICI).
  * Streaming-push ingestion note: multi-val (conflict-overflow EFB)
  * plans are not supported on the push path — such datasets fall back
  * to unbundled columns.
@@ -103,6 +103,8 @@ int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
 int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
                          int* out_len, const void** out_ptr,
                          int* out_type);
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters);
 int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
 int LGBM_DatasetGetNumData(DatasetHandle handle, int* out);
 int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out);
@@ -193,6 +195,11 @@ int LGBM_BoosterPredictForCSC(BoosterHandle handle,
                               int predict_type, int num_iteration,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow,
+                               int32_t ncol, int predict_type,
+                               int num_iteration, const char* parameter,
+                               int64_t* out_len, double* out_result);
 int LGBM_BoosterPredictForFile(BoosterHandle handle,
                                const char* data_filename,
                                int data_has_header, int predict_type,

@@ -847,3 +847,50 @@ def test_c_api_streaming_push_ingestion(capi_so):
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds2)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_param_checking_and_predict_for_mats(capi_so):
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    # frozen dataset param changes must be rejected
+    assert lib.LGBM_DatasetUpdateParamChecking(
+        b"max_bin=255", b"max_bin=63") == -1
+    assert b"max_bin" in lib.LGBM_GetLastError()
+    assert lib.LGBM_DatasetUpdateParamChecking(
+        b"max_bin=255 learning_rate=0.1",
+        b"learning_rate=0.2 num_leaves=31") == 0
+
+    rng = np.random.RandomState(6)
+    X = np.ascontiguousarray(rng.randn(150, 4))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 150, 4, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 150, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # array-of-row-pointers predict == contiguous predict
+    rows = [np.ascontiguousarray(X[i]) for i in range(150)]
+    VP = ctypes.c_void_p
+    row_ptrs = (VP * 150)(*[r.ctypes.data_as(VP) for r in rows])
+    out_ptrs = np.zeros(150, np.float64)
+    out_mat = np.zeros(150, np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterPredictForMats(
+        bst, row_ptrs, 1, 150, 4, 0, -1, b"", ctypes.byref(out_len),
+        out_ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, 150, 4, 1, 0, -1,
+        b"", ctypes.byref(out_len),
+        out_mat.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    np.testing.assert_array_equal(out_ptrs, out_mat)
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
