@@ -894,3 +894,48 @@ def test_c_api_param_checking_and_predict_for_mats(capi_so):
     np.testing.assert_array_equal(out_ptrs, out_mat)
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_feature_name_round_trip(capi_so):
+    """Set/GetFeatureNames through the caller-allocated char** buffer
+    convention (reference GetEvalNames/GetFeatureNames contract)."""
+    rng = np.random.RandomState(8)
+    X = np.ascontiguousarray(rng.randn(80, 3))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 80, 3, 1,
+        b"verbosity=-1 min_data_in_leaf=5", None,
+        ctypes.byref(ds)) == 0
+    names = (ctypes.c_char_p * 3)(b"alpha", b"beta", b"gamma")
+    assert lib.LGBM_DatasetSetFeatureNames(
+        ds, ctypes.cast(names, ctypes.POINTER(ctypes.c_char_p)),
+        3) == 0
+    bufs = [ctypes.create_string_buffer(64) for _ in range(3)]
+    out_arr = (ctypes.c_char_p * 3)(
+        *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+    out_len = ctypes.c_int()
+    assert lib.LGBM_DatasetGetFeatureNames(
+        ds, ctypes.cast(out_arr, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.byref(out_len)) == 0
+    assert out_len.value == 3
+    assert [b.value for b in bufs] == [b"alpha", b"beta", b"gamma"]
+
+    # names flow into the trained model too
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 80, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=4 verbosity=-1 "
+            b"min_data_in_leaf=5", ctypes.byref(bst)) == 0
+    bufs2 = [ctypes.create_string_buffer(64) for _ in range(3)]
+    out2 = (ctypes.c_char_p * 3)(
+        *[ctypes.cast(b, ctypes.c_char_p) for b in bufs2])
+    assert lib.LGBM_BoosterGetFeatureNames(
+        bst, ctypes.byref(out_len),
+        ctypes.cast(out2, ctypes.POINTER(ctypes.c_char_p))) == 0
+    assert [b.value for b in bufs2] == [b"alpha", b"beta", b"gamma"]
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
