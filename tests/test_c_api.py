@@ -939,3 +939,33 @@ def test_c_api_feature_name_round_trip(capi_so):
     assert [b.value for b in bufs2] == [b"alpha", b"beta", b"gamma"]
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_group_field_round_trip(capi_so):
+    """SetField('group') stores query sizes; GetField returns the
+    reference's CUMULATIVE boundaries (metadata.cpp query_boundaries),
+    kept alive for the handle's lifetime."""
+    rng = np.random.RandomState(15)
+    X = np.ascontiguousarray(rng.randn(60, 3))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 60, 3, 1,
+        b"verbosity=-1 min_data_in_leaf=5", None,
+        ctypes.byref(ds)) == 0
+    groups = np.ascontiguousarray([10, 20, 30], np.int32)
+    assert lib.LGBM_DatasetSetField(
+        ds, b"group", groups.ctypes.data_as(ctypes.c_void_p), 3,
+        2) == 0    # INT32
+    out_ptr = ctypes.c_void_p()
+    out_len = ctypes.c_int()
+    out_type = ctypes.c_int()
+    assert lib.LGBM_DatasetGetField(
+        ds, b"group", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)) == 0
+    assert out_type.value == 2 and out_len.value == 4
+    bounds = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int32)), (4,))
+    np.testing.assert_array_equal(bounds, [0, 10, 30, 60])
+    lib.LGBM_DatasetFree(ds)
