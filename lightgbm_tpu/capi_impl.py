@@ -10,6 +10,10 @@ process-global registry (opaque ``void*`` on the C side).
 All functions either return their documented value or raise — the C
 shim converts exceptions into the reference's ``-1`` + LGBM_GetLastError
 contract.
+
+Pointer-array arguments (``double**`` sample columns, ``void**`` row
+pointers) are read as arrays of 64-bit addresses — the shim targets
+LP64 platforms (the only ones the TPU runtime supports).
 """
 
 from __future__ import annotations
