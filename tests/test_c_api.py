@@ -969,3 +969,45 @@ def test_c_api_group_field_round_trip(capi_so):
         ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_int32)), (4,))
     np.testing.assert_array_equal(bounds, [0, 10, 30, 60])
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_valid_set_eval(capi_so):
+    """AddValidData + GetEval(data_idx=1) return the valid metric."""
+    rng = np.random.RandomState(17)
+    X = np.ascontiguousarray(rng.randn(200, 4))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    Xv = np.ascontiguousarray(rng.randn(80, 4))
+    yv = np.ascontiguousarray((Xv[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 200, 4, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 200, 0) == 0
+    dv = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        Xv.ctypes.data_as(ctypes.c_void_p), 1, 80, 4, 1,
+        b"verbosity=-1", ds, ctypes.byref(dv)) == 0
+    assert lib.LGBM_DatasetSetField(
+        dv, b"label", yv.ctypes.data_as(ctypes.c_void_p), 80, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 "
+            b"metric=binary_logloss verbosity=-1",
+        ctypes.byref(bst)) == 0
+    assert lib.LGBM_BoosterAddValidData(bst, dv) == 0
+    fin = ctypes.c_int()
+    for _ in range(3):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    n_ev = ctypes.c_int()
+    evals = np.zeros(8, np.float64)
+    assert lib.LGBM_BoosterGetEval(
+        bst, 1, ctypes.byref(n_ev),
+        evals.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert n_ev.value == 1
+    assert 0.0 < evals[0] < 1.0          # logloss on the valid set
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(dv)
+    lib.LGBM_DatasetFree(ds)
