@@ -141,6 +141,32 @@ class Dataset:
         data = self.data
         feature_name = self.feature_name
         cat_idx: List[int] = []
+        if isinstance(data, str) \
+                and _InnerDataset.is_binary_file(data):
+            # saved binary dataset (DatasetLoader::CheckCanLoadFromBin,
+            # dataset_loader.cpp:218): load the cache instead of
+            # re-parsing/re-binning text
+            self._inner = _InnerDataset.load_binary(data)
+            md = self._inner.metadata
+            if self.label is not None:
+                md.set_label(self.label)
+            else:
+                self.label = md.label
+            if self.weight is not None:
+                md.set_weights(self.weight)
+            else:
+                self.weight = md.weights
+            if self.group is not None:
+                md.set_query(self.group)
+            elif md.query_boundaries is not None:
+                self.group = np.diff(md.query_boundaries)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
+            else:
+                self.init_score = md.init_score
+            if self.free_raw_data:
+                self.data = None
+            return self
         if isinstance(data, str) and cfg.two_round:
             # memory-bounded two-pass ingestion (dataset_loader.cpp
             # two_round branch): the raw float matrix never

@@ -1034,22 +1034,39 @@ class Dataset:
             else [int(v) for v in self.group_num_bins],
             "mv_group_start": self.mv_group_start,
         }
-        np.savez_compressed(
-            path, binned=self.binned,
-            mv_slots=self.mv_slots if self.mv_slots is not None
-            else np.zeros((0, 0), np.int32),
-            label=self.metadata.label if self.metadata.label is not None
-            else np.zeros(0, np.float32),
-            weights=self.metadata.weights
-            if self.metadata.weights is not None else np.zeros(0, np.float32),
-            query_boundaries=self.metadata.query_boundaries
-            if self.metadata.query_boundaries is not None
-            else np.zeros(0, np.int32),
-            init_score=self.metadata.init_score
-            if self.metadata.init_score is not None
-            else np.zeros(0, np.float64),
-            meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8))
+        # write to the EXACT path the caller gave (reference .bin
+        # convention) — a bare np.savez would silently append .npz
+        with open(path, "wb") as fh:
+            np.savez_compressed(
+                fh, binned=self.binned,
+                mv_slots=self.mv_slots if self.mv_slots is not None
+                else np.zeros((0, 0), np.int32),
+                label=self.metadata.label
+                if self.metadata.label is not None
+                else np.zeros(0, np.float32),
+                weights=self.metadata.weights
+                if self.metadata.weights is not None
+                else np.zeros(0, np.float32),
+                query_boundaries=self.metadata.query_boundaries
+                if self.metadata.query_boundaries is not None
+                else np.zeros(0, np.int32),
+                init_score=self.metadata.init_score
+                if self.metadata.init_score is not None
+                else np.zeros(0, np.float64),
+                meta=np.frombuffer(json.dumps(meta).encode(),
+                                   dtype=np.uint8))
         log_info(f"Saved binary dataset to {path}")
+
+    @staticmethod
+    def is_binary_file(path: str) -> bool:
+        """True when ``path`` is a saved binary dataset
+        (DatasetLoader::CheckCanLoadFromBin analog — here the npz/zip
+        magic instead of the reference's string token)."""
+        try:
+            with open(path, "rb") as fh:
+                return fh.read(2) == b"PK"
+        except OSError:
+            return False
 
     @classmethod
     def load_binary(cls, path: str) -> "Dataset":

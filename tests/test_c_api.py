@@ -1011,3 +1011,30 @@ def test_c_api_valid_set_eval(capi_so):
     lib.LGBM_BoosterFree(bst)
     lib.LGBM_DatasetFree(dv)
     lib.LGBM_DatasetFree(ds)
+
+
+def test_c_api_save_binary(capi_so, tmp_path):
+    """DatasetSaveBinary writes the npz cache a Python Dataset loads."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(19)
+    X = np.ascontiguousarray(rng.randn(120, 4))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 120, 4, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 120, 0) == 0
+    path = str(tmp_path / "ds.bin")
+    assert lib.LGBM_DatasetSaveBinary(ds, path.encode()) == 0, \
+        lib.LGBM_GetLastError()
+    assert os.path.getsize(path) > 0
+    # the Python loader reads the binary back with identical content
+    loaded = lgb.Dataset(path, params={"verbosity": -1}).construct()
+    from lightgbm_tpu import capi_impl as ci
+    np.testing.assert_array_equal(
+        loaded._inner.binned, ci._get(int(ds.value))._inner.binned)
+    np.testing.assert_array_equal(loaded.get_label(), y)
+    lib.LGBM_DatasetFree(ds)
