@@ -71,6 +71,13 @@ def measure():
         "objective": "binary", "num_leaves": num_leaves,
         "learning_rate": 0.1, "max_bin": 255, "metric": "",
         "verbosity": -1})
+    # ring-only telemetry: counters (compile time, trees) with no sink
+    # I/O in the timed region; LGBM_TPU_TELEMETRY additionally writes
+    # the JSONL trace next to the JSON result (set by the parent)
+    from lightgbm_tpu.observability.telemetry import get_telemetry
+    tel = get_telemetry()
+    tel.ensure_started(cfg)  # JSONL sink when LGBM_TPU_TELEMETRY is set
+    tel.ensure_ring()        # else ring-only counters (no sink I/O)
     ds = Dataset.from_numpy(X, cfg, label=y)
     booster = GBDT(cfg, ds)
 
@@ -80,14 +87,18 @@ def measure():
         # fetch ONE score element as the real barrier (utils/sync.py)
         return fetch_one(booster.train_score[:1])
 
+    t_w0 = time.perf_counter()
     booster.train(warmup)  # compile sync (iter 0) + async paths
     sync()
+    warmup_dt = time.perf_counter() - t_w0
+    compile_at_warmup = tel.compile_stats()
 
     t0 = time.perf_counter()
     booster.train(warmup + iters)
     sync()
     dt = time.perf_counter() - t0
 
+    compile_total = tel.compile_stats()
     throughput = n * iters / dt
     result = {
         "metric": "higgs_like_train_throughput",
@@ -97,7 +108,16 @@ def measure():
         "rows": n,
         "num_leaves": num_leaves,
         "iters": iters,
-        "backend": jax.default_backend()}
+        "backend": jax.default_backend(),
+        # compile-vs-steady-state provenance (observability layer): the
+        # warmup absorbs compiles; steady_s is the timed region and
+        # compile_in_timed_s must be ~0 for an honest throughput number
+        "warmup_s": round(warmup_dt, 3),
+        "steady_s": round(dt, 3),
+        "compile_count": compile_total["count"],
+        "compile_s": round(compile_total["seconds"], 3),
+        "compile_in_timed_s": round(
+            compile_total["seconds"] - compile_at_warmup["seconds"], 3)}
     if os.environ.get("BENCH_EVAL", "1") != "0":
         # training-quality gate, DEFAULT-ON (Experiments.rst:120-148
         # accuracy table analog): in-sample AUC on a bounded slice so a
@@ -121,6 +141,7 @@ def measure():
         except Exception as e:  # noqa: BLE001
             result["auc_error"] = str(e)[:200]
             result["quality_ok"] = False
+    tel.flush()
     print(json.dumps(result))
 
 
@@ -160,6 +181,12 @@ def main():
     t_start = time.monotonic()
     env = dict(os.environ)
     env["_BENCH_CHILD"] = "1"
+    # telemetry JSONL next to the JSON result (appended across sizes;
+    # run_start records delimit children) unless the caller disabled it
+    if not os.environ.get("BENCH_NO_TELEMETRY"):
+        env.setdefault("LGBM_TPU_TELEMETRY", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "bench_telemetry.jsonl"))
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".jax_cache_tpu"))

@@ -13,9 +13,11 @@ __version__ = "0.1.0"
 
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (early_stopping, print_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, record_telemetry,
+                       reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
+from .observability import get_telemetry
 from .parallel.distributed import init_distributed
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
@@ -31,6 +33,6 @@ except ImportError:  # pragma: no cover
 __all__ = ["Dataset", "Booster", "LightGBMError", "Config",
            "train", "cv", "CVBooster",
            "early_stopping", "print_evaluation", "record_evaluation",
-           "reset_parameter",
+           "record_telemetry", "reset_parameter", "get_telemetry",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "init_distributed"] + _PLOT

@@ -147,6 +147,22 @@ class Dataset:
             # dataset_loader.cpp:218): load the cache instead of
             # re-parsing/re-binning text
             self._inner = _InnerDataset.load_binary(data)
+            if self.reference is not None \
+                    and self.reference._inner is not None:
+                # a binary load carries its own frozen bin layout; when
+                # the set is bound to a reference (e.g. a valid set on
+                # a Booster) the layouts must MATCH — evaluating
+                # through mismatched bin boundaries silently produces
+                # wrong metrics (Dataset::CheckAlign analog)
+                ref = self.reference._inner
+                if ref.bin_layout_fingerprint() != \
+                        self._inner.bin_layout_fingerprint():
+                    log_fatal(
+                        f"binary dataset {data!r} was saved with a "
+                        "different bin layout than its reference "
+                        "(train) set; re-save it with "
+                        "reference=<train set> so the bin mappers "
+                        "align, or load the text file instead")
             md = self._inner.metadata
             if self.label is not None:
                 md.set_label(self.label)
@@ -253,6 +269,11 @@ class Dataset:
             else None,
             categorical_features=cat_idx, reference=ref_inner,
             forced_bins=forced)
+        from .observability.telemetry import get_telemetry
+        tel = get_telemetry()
+        tel.count("data.rows_binned", self._inner.num_data)
+        tel.count("data.cells_binned",
+                  self._inner.num_data * self._inner.num_features)
         if self.free_raw_data:
             self.data = None
         return self

@@ -84,6 +84,41 @@ def record_evaluation(eval_result: Dict) -> Callable:
     return _callback
 
 
+def record_telemetry(result: Dict) -> Callable:
+    """Record per-iteration telemetry into ``result`` — the
+    observability analog of ``record_evaluation`` (ISSUE: engine-level
+    ``record_telemetry`` callback).
+
+    After training, ``result["iterations"]`` holds one dict per
+    iteration ({iteration, phases, eval, ...}) and ``result["summary"]``
+    the end-of-run counters/compile stats. The in-memory ring sink is
+    enabled on creation when telemetry is otherwise off, so the
+    callback works without ``LGBM_TPU_TELEMETRY``/``telemetry_out``.
+    Its ``order`` is deliberately NOT in the inert set (engine.py):
+    requesting per-iteration telemetry forces the host-stepped loop
+    instead of the pipelined fast path.
+    """
+    if not isinstance(result, dict):
+        raise TypeError("record_telemetry expects a dictionary")
+    from .observability.telemetry import get_telemetry
+    tel = get_telemetry()
+    tel.ensure_ring()
+
+    def _callback(env: CallbackEnv) -> None:
+        rec = dict(tel.last_iter or {})
+        rec["iteration"] = env.iteration
+        if env.evaluation_result_list:
+            rec["eval"] = [[r[0], r[1], float(r[2]), bool(r[3])]
+                           for r in env.evaluation_result_list]
+        result.setdefault("iterations", []).append(rec)
+        result["summary"] = {"counters": dict(tel.counters),
+                             "compile": tel.compile_stats(),
+                             "phase_totals": tel.phase_totals()}
+
+    _callback.order = 25
+    return _callback
+
+
 def reset_parameter(**kwargs) -> Callable:
     """Reset parameters on a schedule: each value is a list (per
     iteration) or a function iteration -> value (callback.py:111-147)."""

@@ -738,7 +738,8 @@ def network_init(machines: str, local_listen_port: int,
 
 def network_free() -> None:
     import jax
-    if jax.distributed.is_initialized():
+    from .parallel.distributed import distributed_initialized
+    if distributed_initialized():
         jax.distributed.shutdown()
 
 

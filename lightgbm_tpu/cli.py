@@ -108,6 +108,10 @@ def run_train(params: Dict[str, str]) -> None:
     from .basic import Dataset
     from .config import Config
     cfg = Config.from_params(params)
+    # start telemetry before ingestion so dataset counters are captured
+    # (telemetry_out=<path.jsonl> CLI/config param or LGBM_TPU_TELEMETRY)
+    from .observability.telemetry import get_telemetry
+    get_telemetry().ensure_started(cfg)
     if cfg.machines or cfg.machine_list_filename:
         from .parallel.distributed import init_distributed
         init_distributed(cfg)
@@ -143,6 +147,7 @@ def run_train(params: Dict[str, str]) -> None:
         init_model=cfg.input_model or None,
         callbacks=callbacks or None)
     booster.save_model(output_model)
+    get_telemetry().flush()
     log_info(f"Finished training; model saved to {output_model}")
 
 

@@ -121,6 +121,8 @@ _PARAM_ALIASES: Dict[str, str] = {
     "machine_list_file": "machine_list_filename",
     "machine_list": "machine_list_filename", "mlist": "machine_list_filename",
     "workers": "machines", "nodes": "machines",
+    "telemetry": "telemetry_out", "telemetry_file": "telemetry_out",
+    "telemetry_output": "telemetry_out",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -274,6 +276,9 @@ class Config:
     categorical_feature: str = ""
     forcedbins_filename: str = ""
     save_binary: bool = False
+    # structured training telemetry (docs/Observability.md): path of a
+    # JSONL trace; empty = disabled unless LGBM_TPU_TELEMETRY is set
+    telemetry_out: str = ""
 
     # ---- predict task (config.h:675-741)
     num_iteration_predict: int = -1

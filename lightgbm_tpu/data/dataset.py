@@ -1060,13 +1060,43 @@ class Dataset:
     @staticmethod
     def is_binary_file(path: str) -> bool:
         """True when ``path`` is a saved binary dataset
-        (DatasetLoader::CheckCanLoadFromBin analog — here the npz/zip
-        magic instead of the reference's string token)."""
+        (DatasetLoader::CheckCanLoadFromBin analog). The zip magic
+        alone is not enough — any ``PK``-prefixed file (a real zip, a
+        text file starting with "PK") would be routed to the binary
+        loader; verify the expected npz members instead and fall
+        through to text parsing otherwise."""
+        import zipfile
         try:
             with open(path, "rb") as fh:
-                return fh.read(2) == b"PK"
-        except OSError:
+                if fh.read(2) != b"PK":
+                    return False
+            with np.load(path, allow_pickle=False) as z:
+                return "binned" in z.files and "meta" in z.files
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
             return False
+
+    def bin_layout_fingerprint(self) -> str:
+        """Stable digest of everything that determines where a raw
+        value lands in the binned matrix: per-feature bin mappers,
+        used-feature map and the EFB group/offset layout. Two datasets
+        with equal fingerprints produce bin-compatible matrices; the
+        binary-load alignment check (basic.py Dataset.construct, the
+        reference's ``CheckAlign``) compares these instead of silently
+        evaluating against a mismatched layout."""
+        import hashlib
+        import json
+        payload = {
+            "mappers": [m.to_dict() for m in self.bin_mappers],
+            "used_feature_map": [int(v) for v in self.used_feature_map],
+            "num_total_features": int(self.num_total_features),
+            "feature_group": None if self.feature_group is None
+            else [int(v) for v in self.feature_group],
+            "feature_offset": None if self.feature_offset is None
+            else [int(v) for v in self.feature_offset],
+            "mv_group_start": self.mv_group_start,
+        }
+        blob = json.dumps(payload, sort_keys=True, default=float)
+        return hashlib.sha1(blob.encode()).hexdigest()
 
     @classmethod
     def load_binary(cls, path: str) -> "Dataset":

@@ -97,6 +97,31 @@ def _parse_libsvm_row(toks: List[str]) -> Tuple[float, List[Tuple[int, float]]]:
     return label, row
 
 
+def _exact_tolerant(values) -> np.ndarray:
+    """junk -> NaN like the native parser (fast_parser.cpp Atof), via
+    Python float() — which is round-trip exact, unlike pd.to_numeric's
+    parser."""
+    out = np.empty(len(values), np.float64)
+    for i, v in enumerate(values):
+        try:
+            out[i] = float(v)
+        except (TypeError, ValueError):
+            out[i] = np.nan
+    return out
+
+
+def _df_to_f64(df) -> np.ndarray:
+    """DataFrame -> float64 matrix with the native parser's tolerance:
+    non-numeric (object) columns go through ``_exact_tolerant`` instead
+    of pandas' strict conversion (which raises on junk cells)."""
+    import pandas as pd
+    bad = [c for c, dt in df.dtypes.items()
+           if not pd.api.types.is_numeric_dtype(dt)]
+    for c in bad:
+        df[c] = _exact_tolerant(df[c].to_numpy())
+    return df.to_numpy(np.float64)
+
+
 def _load_sidecar(path: str, suffixes) -> Optional[np.ndarray]:
     """Metadata sidecar files (src/io/metadata.cpp LoadWeights/
     LoadQueryBoundaries: one value per line, optional 'header')."""
@@ -155,11 +180,15 @@ def load_file(path: str, config: Config) -> Tuple[
             path, sep, skip_rows=1 if config.header else 0)
         if mat is None:
             import pandas as pd
+            # round_trip: the default pandas parser is 1 ulp off on
+            # some values, which would shift bin boundaries vs the
+            # native std::from_chars path and the two_round reader
             df = pd.read_csv(path, sep=sep,
-                             header=0 if config.header else None)
+                             header=0 if config.header else None,
+                             float_precision="round_trip")
             if config.header:
                 names = [str(c) for c in df.columns]
-            mat = df.to_numpy(np.float64)
+            mat = _df_to_f64(df)
 
         label_idx = _resolve_column(config.label_column, names)
         if label_idx is None:
@@ -302,24 +331,8 @@ class TwoRoundLoader:
             # default pandas parser is 1 ulp off on some values, which
             # would shift bin boundaries vs two_round=false
             float_precision="round_trip")
-        def exact_tolerant(values):
-            # junk -> NaN like the one-round native parser
-            # (fast_parser.cpp Atof), via Python float() — which is
-            # round-trip exact, unlike pd.to_numeric's parser
-            out = np.empty(len(values), np.float64)
-            for i, v in enumerate(values):
-                try:
-                    out[i] = float(v)
-                except (TypeError, ValueError):
-                    out[i] = np.nan
-            return out
-
         for df in reader:
-            bad = [c for c, dt in df.dtypes.items()
-                   if not pd.api.types.is_numeric_dtype(dt)]
-            for c in bad:
-                df[c] = exact_tolerant(df[c].to_numpy())
-            mat = df.to_numpy(np.float64)
+            mat = _df_to_f64(df)
             if self._keep is None:
                 self._resolve(mat.shape[1])
             weight = (mat[:, self._weight_idx]

@@ -14,13 +14,16 @@ from __future__ import annotations
 
 import collections
 import copy
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (CallbackEnv, EarlyStopException, early_stopping,
-                       print_evaluation, record_evaluation)
+                       print_evaluation, record_evaluation,
+                       record_telemetry)
+from .observability.telemetry import get_telemetry
 from .utils.log import log_warning
 
 _ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
@@ -178,6 +181,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # best_iteration that predict()'s model truncation understands
     base_iter = booster._gbdt.iter
     end_iter = base_iter + num_boost_round
+    tel = get_telemetry()
+    t_train0 = time.perf_counter()
     for i in range(base_iter, end_iter):
         for cb in callbacks_before:
             cb(CallbackEnv(model=booster, params=params, iteration=i,
@@ -188,10 +193,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
         evaluation_result_list = []
         if need_eval:
-            if eval_on_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            if extra_valid_sets:
-                evaluation_result_list.extend(booster.eval_valid(feval))
+            with tel.span("eval", trace="eval"):
+                if eval_on_train:
+                    evaluation_result_list.extend(
+                        booster.eval_train(feval))
+                if extra_valid_sets:
+                    evaluation_result_list.extend(
+                        booster.eval_valid(feval))
+            tel.eval_results(i, evaluation_result_list)
         try:
             for cb in callbacks_after:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
@@ -203,6 +212,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
             booster.best_iteration = earlyStopException.best_iteration + 1
             evaluation_result_list = earlyStopException.best_score
             break
+    if tel.enabled:
+        # the host-stepped loop bypasses GBDT.train, so the train_end
+        # summary (+ one-time phase probe) is emitted here
+        booster._gbdt.emit_train_end(base_iter,
+                                     time.perf_counter() - t_train0)
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for name, metric, score, _ in evaluation_result_list or []:
         booster.best_score[name][metric] = score

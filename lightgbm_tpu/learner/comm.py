@@ -40,6 +40,21 @@ from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
                          per_feature_splits)
 
 
+def _count_collective(name: str, tree):
+    """Telemetry: add the payload bytes of a collective to counter
+    ``comm.<name>_bytes`` and return the payload unchanged. The comm
+    hooks run inside jitted grow programs, so this executes at TRACE
+    time over abstract values — the counter records bytes moved per
+    compiled-program invocation (grow-loop collectives execute once per
+    while-loop step at runtime), with zero cost inside the program."""
+    from ..observability.telemetry import get_telemetry, traced_bytes
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.count(f"comm.{name}_bytes", traced_bytes(tree))
+        tel.count(f"comm.{name}_calls", 1)
+    return tree
+
+
 class Comm(NamedTuple):
     """Static strategy object (functions close over mesh axis names)."""
     reduce_hist: Callable
@@ -73,8 +88,10 @@ def make_data_parallel_comm(axis: str) -> Comm:
     """Histograms and root sums are psum'ed; split selection then runs
     identically (and redundantly — cheap) on every device."""
     return Comm(
-        reduce_hist=lambda x: jax.lax.psum(x, axis),
-        reduce_sums=lambda x: jax.lax.psum(x, axis),
+        reduce_hist=lambda x: jax.lax.psum(
+            _count_collective("psum", x), axis),
+        reduce_sums=lambda x: jax.lax.psum(
+            _count_collective("psum", x), axis),
         select_split=_serial_select, vmap_safe=True)
 
 
@@ -94,7 +111,8 @@ def make_feature_parallel_comm(axis: str) -> Comm:
         gid = meta_local.global_id[lb]
         res = assemble_split(pf, lb, feature_id=gid)
         stacked = jax.tree.map(
-            lambda x: jax.lax.all_gather(x, axis), res)
+            lambda x: jax.lax.all_gather(x, axis),
+            _count_collective("all_gather", res))
         # winner: max gain, ties broken by LOWER global feature id so
         # equal-gain splits match serial's first-index rule even when
         # bundled group blocks scramble the shard<->feature-id order
@@ -133,14 +151,17 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         w_gain = jnp.where(jnp.isfinite(top_gain),
                            top_gain * loc[2] / jnp.maximum(mean_cnt, 1.0),
                            -jnp.inf)
-        all_ids = jax.lax.all_gather(top_ids, axis).reshape(-1)
-        all_gain = jax.lax.all_gather(w_gain, axis).reshape(-1)
+        all_ids = jax.lax.all_gather(
+            _count_collective("all_gather", top_ids), axis).reshape(-1)
+        all_gain = jax.lax.all_gather(
+            _count_collective("all_gather", w_gain), axis).reshape(-1)
         # per-feature max weighted gain over all candidates, then top-k
         feat_gain = jnp.full((f,), -jnp.inf).at[all_ids].max(
             jnp.where(jnp.isfinite(all_gain), all_gain, -jnp.inf))
         _, win_ids = jax.lax.top_k(feat_gain, k)
         # aggregate only the winning columns across the data shards
-        hist_sel = jax.lax.psum(hist_local[win_ids], axis)
+        hist_sel = jax.lax.psum(
+            _count_collective("psum", hist_local[win_ids]), axis)
         meta_sel = FeatureMeta(*[m[win_ids] for m in meta])
         fmask_sel = None if fmask is None else fmask[win_ids]
         rb_sel = None if rand_bins is None else rand_bins[win_ids]
@@ -151,5 +172,6 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         return assemble_split(pf_glob, b, feature_id=win_ids[b])
 
     return Comm(reduce_hist=lambda x: x,
-                reduce_sums=lambda x: jax.lax.psum(x, axis),
+                reduce_sums=lambda x: jax.lax.psum(
+                    _count_collective("psum", x), axis),
                 select_split=select, local_hist=True)

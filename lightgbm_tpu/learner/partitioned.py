@@ -59,9 +59,10 @@ from ..ops.split import (MAX_CAT_WORDS, _argmax_first, assemble_split,
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
                      StatePack, cegb_pf_state, cegb_refund,
                      cegb_store_row, cegb_upgrade_best,
-                     feature_meta_from_dataset, forced_left_sums,
-                     forced_split_override, make_node_rand,
-                     split_params_from_config, scan_children)
+                     count_tree_telemetry, feature_meta_from_dataset,
+                     forced_left_sums, forced_split_override,
+                     make_node_rand, split_params_from_config,
+                     scan_children)
 
 HIST_BLK = 2048
 PART_BLK = 512
@@ -83,6 +84,8 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
     """Shared setup / host-tree conversion for the single-device and
     mesh partitioned learners (one source of truth for the uint8 bin
     cap, categorical params and interpret default)."""
+
+    _count_tree_telemetry = count_tree_telemetry
 
     def _setup_partitioned(self, dataset: Dataset, config: Config,
                            interpret: Optional[bool]) -> None:
@@ -167,6 +170,7 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             bag_weight = jnp.ones_like(grad)
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), bool)
+        self._count_tree_telemetry()
         rand_key = self.next_tree_key()
         self.mat, self.ws, tree, leaf_id = _grow_partitioned(
             self.mat, self.ws, grad, hess, bag_weight, feature_mask,

@@ -639,8 +639,35 @@ class CegbStateMixin:
         self._cegb_used = self._cegb_used | upd
 
 
+def count_tree_telemetry(learner) -> None:
+    """Per-tree learner counters (observability/telemetry.py): tree
+    and row totals plus the PLANNED histogram-build count — the grow
+    loop is one fused device program, so the build count is derived
+    from its static shape (1 root + 1 per split with the sibling
+    subtraction, 2 per split in pool-bounded mode; an early stop can
+    only make the true count lower). Shared by every learner's
+    ``train`` entry point; free when telemetry is disabled."""
+    from ..observability.telemetry import get_telemetry
+    tel = get_telemetry()
+    if not tel.enabled:
+        return
+    n = learner.dataset.num_data
+    big_l = learner.num_leaves
+    cache = getattr(learner, "cache_hists", True)
+    tel.count("learner.trees", 1)
+    tel.count("learner.rows_scanned", n)
+    tel.count("learner.hist_builds_planned",
+              1 + (big_l - 1) * (1 if cache else 2))
+    tel.count("learner.splits_planned", big_l - 1)
+    shards = getattr(learner, "num_shards", 1)
+    if shards > 1:
+        tel.gauge("mesh.num_shards", shards)
+
+
 class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
     """Owns the device copy of the dataset and the compiled grow program."""
+
+    _count_tree_telemetry = count_tree_telemetry
 
     def __init__(self, dataset: Dataset, config: Config,
                  hist_method: str = "auto"):
@@ -686,6 +713,7 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
             bag_weight = jnp.ones_like(grad)
         if feature_mask is None:
             feature_mask = jnp.ones((self.dataset.num_features,), bool)
+        self._count_tree_telemetry()
         # module-level jit: learners with equal shapes/params share the
         # compiled executable (tests and per-class trainers hit the cache)
         res = _grow_jit(self.binned, grad, hess, bag_weight, feature_mask,

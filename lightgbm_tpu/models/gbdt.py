@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,8 +28,9 @@ from ..config import Config
 from ..data.dataset import Dataset
 from ..metric import create_metrics
 from ..objective import create_objective
-from ..utils.log import (annotate, global_timer, log_fatal, log_info,
-                         log_warning, maybe_profile)
+from ..observability.telemetry import get_telemetry, memory_snapshot
+from ..utils.log import (log_fatal, log_info, log_warning,
+                         maybe_profile)
 from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
                    traverse_tree_arrays)
 
@@ -108,6 +110,9 @@ class GBDT:
     # ------------------------------------------------------------------
     def _setup_train(self, train_data: Dataset, hist_method: str) -> None:
         cfg = self.config
+        tel = get_telemetry()
+        tel.ensure_started(cfg)
+        tel.count("train.rows", train_data.num_data)
         from ..parallel import create_tree_learner
         self.learner = create_tree_learner(
             cfg.tree_learner, train_data, cfg, hist_method=hist_method)
@@ -234,21 +239,24 @@ class GBDT:
         """Returns True when training should STOP (no more valid splits),
         mirroring GBDT::TrainOneIter (gbdt.cpp:337-419)."""
         k = self.num_tree_per_iteration
+        tel = get_telemetry()
         init_scores = [0.0] * k
-        if gradients is None or hessians is None:
-            for tid in range(k):
-                init_scores[tid] = self.boost_from_average(tid)
-            score = self.train_score if k > 1 else self.train_score[:, 0]
-            grad, hess = self._grad_fn(score)
-            if k == 1:
-                grad = grad[:, None]
-                hess = hess[:, None]
-        else:
-            grad = _coerce_custom_grad(gradients, self.num_data, k)
-            hess = _coerce_custom_grad(hessians, self.num_data, k)
+        with tel.span("grad", phase=True):
+            if gradients is None or hessians is None:
+                for tid in range(k):
+                    init_scores[tid] = self.boost_from_average(tid)
+                score = self.train_score if k > 1 \
+                    else self.train_score[:, 0]
+                grad, hess = self._grad_fn(score)
+                if k == 1:
+                    grad = grad[:, None]
+                    hess = hess[:, None]
+            else:
+                grad = _coerce_custom_grad(gradients, self.num_data, k)
+                hess = _coerce_custom_grad(hessians, self.num_data, k)
 
-        bag = self._bagging_weight(self.iter, grad, hess)
-        fmask = self._feature_mask()
+            bag = self._bagging_weight(self.iter, grad, hess)
+            fmask = self._feature_mask()
 
         should_continue = False
         new_trees: List[Tree] = []
@@ -256,15 +264,19 @@ class GBDT:
             tree = None
             if self.class_need_train[tid] \
                     and self.train_data.num_features > 0:
-                result = self.learner.train(grad[:, tid], hess[:, tid],
-                                            bag_weight=bag,
-                                            feature_mask=fmask)
-                tree = self.learner.to_host_tree(result)
+                with tel.span("grow", phase=True):
+                    result = self.learner.train(grad[:, tid],
+                                                hess[:, tid],
+                                                bag_weight=bag,
+                                                feature_mask=fmask)
+                with tel.span("tree", phase=True):
+                    tree = self.learner.to_host_tree(result)
             if tree is not None and tree.num_leaves > 1:
                 should_continue = True
-                self._renew_tree_output(tree, result, tid)
-                tree.shrink(self.shrinkage_rate)
-                self._update_scores(tree, result, tid)
+                with tel.span("update", phase=True):
+                    self._renew_tree_output(tree, result, tid)
+                    tree.shrink(self.shrinkage_rate)
+                    self._update_scores(tree, result, tid)
                 if abs(init_scores[tid]) > kEpsilon:
                     tree.add_bias(init_scores[tid])
             else:
@@ -295,6 +307,10 @@ class GBDT:
             return True
         self.models.extend(new_trees)
         self.iter += 1
+        tel.end_iteration(
+            self.iter - 1, trees=k, num_data=self.num_data,
+            bag_fraction=float(self.config.bagging_fraction)
+            if bag is not None else 1.0)
         return False
 
     def _renew_tree_output(self, tree: Tree, result, tid: int) -> None:
@@ -461,6 +477,7 @@ class GBDT:
         ret = ""
         msg_lines = []
         results = self.eval_metrics()
+        get_telemetry().eval_results(it, results)
         first_metric_seen: Dict[str, bool] = {}
         for ds_name, mname, value, bigger in results:
             line = f"Iteration:{it}, {ds_name} {mname} : {value:g}"
@@ -509,35 +526,45 @@ class GBDT:
         """One boosting iteration with zero host syncs. Returns a device
         bool scalar: True = a real split happened (continue)."""
         k = self.num_tree_per_iteration
-        score = self.train_score if k > 1 else self.train_score[:, 0]
-        grad, hess = self._grad_fn(score)
-        if k == 1:
-            grad = grad[:, None]
-            hess = hess[:, None]
-        bag = self._bagging_weight(self.iter, grad, hess)
-        fmask = self._feature_mask()
+        tel = get_telemetry()
+        with tel.span("grad", phase=True):
+            score = self.train_score if k > 1 else self.train_score[:, 0]
+            grad, hess = self._grad_fn(score)
+            if k == 1:
+                grad = grad[:, None]
+                hess = hess[:, None]
+            bag = self._bagging_weight(self.iter, grad, hess)
+            fmask = self._feature_mask()
         flag = None
         for tid in range(k):
-            result = self.learner.train(grad[:, tid], hess[:, tid],
-                                        bag_weight=bag, feature_mask=fmask)
-            ta = result.tree
-            ok = ta.num_leaves > 1
-            scale = jnp.where(ok, jnp.float32(self.shrinkage_rate),
-                              jnp.float32(0.0))
-            leaf_vals = ta.leaf_value * scale
-            self.train_score = self.train_score.at[:, tid].add(
-                leaf_vals[result.leaf_id])
-            for i, vd in enumerate(self.valid_sets):
-                vadd = traverse_tree_arrays(ta, vd.binned_device,
-                                            self.learner.meta, scale,
-                                            vd.mv_slots_device)
-                self.valid_scores[i] = \
-                    self.valid_scores[i].at[:, tid].add(vadd)
-            self.models.append(DeferredTree(
-                ta, self.learner.dataset,
-                shrinkage=self.shrinkage_rate))
+            with tel.span("grow", phase=True):
+                result = self.learner.train(grad[:, tid], hess[:, tid],
+                                            bag_weight=bag,
+                                            feature_mask=fmask)
+            with tel.span("update", phase=True):
+                ta = result.tree
+                ok = ta.num_leaves > 1
+                scale = jnp.where(ok, jnp.float32(self.shrinkage_rate),
+                                  jnp.float32(0.0))
+                leaf_vals = ta.leaf_value * scale
+                self.train_score = self.train_score.at[:, tid].add(
+                    leaf_vals[result.leaf_id])
+                for i, vd in enumerate(self.valid_sets):
+                    vadd = traverse_tree_arrays(ta, vd.binned_device,
+                                                self.learner.meta, scale,
+                                                vd.mv_slots_device)
+                    self.valid_scores[i] = \
+                        self.valid_scores[i].at[:, tid].add(vadd)
+                self.models.append(DeferredTree(
+                    ta, self.learner.dataset,
+                    shrinkage=self.shrinkage_rate))
             flag = ok if flag is None else (flag | ok)
         self.iter += 1
+        tel.end_iteration(
+            self.iter - 1, trees=k, mode="async",
+            num_data=self.num_data,
+            bag_fraction=float(self.config.bagging_fraction)
+            if bag is not None else 1.0)
         return flag
 
     def finalize_trees(self) -> None:
@@ -621,7 +648,9 @@ class GBDT:
             m = self._FUSED_BLOCK
             while m > remaining:
                 m //= 2
-            with global_timer.scope("boosting"), annotate("boost_block"):
+            tel = get_telemetry()
+            t_blk = time.perf_counter()
+            with tel.span("boosting", trace="boost_block"):
                 ln.mat, ln.ws, self.train_score, trees, oks = fused(
                     ln.mat, ln.ws, self.train_score, lr,
                     jnp.int32(self.iter), m=m)
@@ -632,8 +661,19 @@ class GBDT:
                         stack, (j, tid), ln.dataset,
                         shrinkage=self.shrinkage_rate))
             self.iter += m
-            with global_timer.scope("device_sync"):
+            with tel.span("device_sync"):
                 flags = [bool(v) for v in np.asarray(oks)]
+            if tel.enabled:
+                # the stop-flag fetch above is the block's real device
+                # barrier, so this wall time covers device execution
+                dur = time.perf_counter() - t_blk
+                tel.count("learner.trees", m * k)
+                tel.count("learner.row_iters", m * self.num_data)
+                tel.record("block", iter_start=self.iter - m, iters=m,
+                           num_data=self.num_data, dur_s=round(dur, 6),
+                           rows_per_s=round(
+                               m * self.num_data / dur, 3)
+                           if dur > 0 else 0.0)
             if not all(flags):
                 self._truncate_surplus(len(flags) - flags.index(False))
                 log_warning(
@@ -647,9 +687,49 @@ class GBDT:
         Profiling: set ``LGBM_TPU_PROFILE_DIR`` to capture an xprof
         device trace of the whole loop (phases named via
         TraceAnnotation) plus host-side Timer totals (the reference's
-        -DTIMETAG global_timer analog, utils/log.py)."""
+        -DTIMETAG global_timer analog, utils/log.py). Telemetry: set
+        ``LGBM_TPU_TELEMETRY=/path.jsonl`` (or ``telemetry_out``) for a
+        structured trace — see docs/Observability.md."""
+        tel = get_telemetry()
+        it0 = self.iter
+        t0 = time.perf_counter()
         with maybe_profile():
-            self._train_impl(num_iterations)
+            with tel.span("train"):
+                self._train_impl(num_iterations)
+        if tel.enabled:
+            self.emit_train_end(it0, time.perf_counter() - t0)
+
+    def emit_train_end(self, it0: int, dur: float) -> None:
+        """Emit the ``train_end`` summary record (+ the one-time phase
+        probe) after a training loop; shared with ``engine.train``'s
+        host-stepped path, which bypasses ``GBDT.train``."""
+        tel = get_telemetry()
+        if not tel.enabled:
+            return
+        iters = self.iter - it0
+        tel.record(
+            "train_end", iters=iters, num_data=self.num_data,
+            dur_s=round(dur, 6),
+            rows_per_s=round(self.num_data * max(iters, 0) / dur, 3)
+            if dur > 0 else 0.0,
+            compile=tel.compile_stats(),
+            phase_totals=tel.phase_totals(),
+            counters=dict(tel.counters),
+            memory=memory_snapshot())
+        if not getattr(self, "_tel_probed", False):
+            self._tel_probed = True
+            # the probe compiles a handful of component ops, so it only
+            # runs for full (JSONL) telemetry sessions — never in the
+            # ring-only mode bench uses for its timed region
+            from ..observability.telemetry import JsonlSink
+            if any(isinstance(s, JsonlSink) for s in tel._sinks):
+                from ..observability.probe import run_phase_probe
+                ph = run_phase_probe(self)
+                if ph:
+                    tel.record("phase_probe",
+                               learner=type(self.learner).__name__,
+                               num_data=self.num_data, phases=ph)
+        tel.flush()
 
     def _train_impl(self, num_iterations: Optional[int] = None) -> None:
         iters = num_iterations if num_iterations is not None \
@@ -670,13 +750,13 @@ class GBDT:
             or cfg.feature_fraction_bynode < 1.0
         flush_every = 1 if (has_eval or host_rng_per_iter) \
             else self._ASYNC_FLUSH
+        tel = get_telemetry()
         if use_async and not has_eval and not host_rng_per_iter \
                 and self._fused_scan_supported():
             if not self.models and self.iter < iters:
                 # boost-from-average + constant-tree fallback need the
                 # sync first iteration, exactly like the async path
-                with global_timer.scope("boosting"), \
-                        annotate("boost_iter"):
+                with tel.span("boosting", trace="boost_iter"):
                     if self.train_one_iter():
                         self.finalize_trees()
                         return
@@ -687,10 +767,10 @@ class GBDT:
         stopped = False
         for it in range(self.iter, iters):
             if use_async and self.models:
-                with global_timer.scope("boosting"), annotate("boost_iter"):
+                with tel.span("boosting", trace="boost_iter"):
                     pending.append(self._train_one_iter_async())
                 if len(pending) >= flush_every or it == iters - 1:
-                    with global_timer.scope("device_sync"):
+                    with tel.span("device_sync"):
                         flags = [bool(v) for v in jax.device_get(pending)]
                     pending.clear()
                     if not all(flags):
@@ -705,11 +785,13 @@ class GBDT:
             else:
                 # first iteration (boost-from-average, constant-tree
                 # fallback) and non-async boosters take the sync path
-                with global_timer.scope("boosting"), annotate("boost_iter"):
+                with tel.span("boosting", trace="boost_iter"):
                     if self.train_one_iter():
                         break
             if has_eval:
-                with global_timer.scope("eval"), annotate("eval"):
+                # not a phase span: end_iteration already closed this
+                # iteration's record, so eval lands in span totals only
+                with tel.span("eval", trace="eval"):
                     stop_early = self._eval_and_check_early_stopping()
                 if stop_early:
                     break

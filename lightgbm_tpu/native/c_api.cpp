@@ -120,8 +120,11 @@ int64_t as_int(PyObject* o, bool* ok) {
 }
 
 // copy a Python str into (buffer_len, out_len, out_str) with the
-// reference's truncation contract: out_len is the FULL length; the
-// copy is capped at buffer_len - 1 and NUL-terminated
+// reference contract (c_api.cpp LGBM_BoosterSaveModelToString): out_len
+// is ALWAYS the full length including the NUL; the copy happens only
+// when the whole string fits (out_len <= buffer_len). Callers probe
+// with a small/NULL buffer, read out_len, re-call with a big enough
+// one — a silently truncated model string must never look complete.
 int copy_string_out(PyObject* s, int64_t buffer_len, int64_t* out_len,
                     char* out_str) {
   Py_ssize_t n = 0;
@@ -131,10 +134,9 @@ int copy_string_out(PyObject* s, int64_t buffer_len, int64_t* out_len,
     return -1;
   }
   *out_len = static_cast<int64_t>(n) + 1;  // incl. NUL, like c_api.cpp
-  if (out_str != nullptr && buffer_len > 0) {
-    int64_t ncopy = n < buffer_len - 1 ? n : buffer_len - 1;
-    std::memcpy(out_str, c, static_cast<size_t>(ncopy));
-    out_str[ncopy] = '\0';
+  if (out_str != nullptr && *out_len <= buffer_len) {
+    std::memcpy(out_str, c, static_cast<size_t>(n));
+    out_str[n] = '\0';
   }
   return 0;
 }

@@ -8,6 +8,13 @@
  * calling thread's process. Handles are opaque and must be released
  * with the matching *Free.
  *
+ * String-out contract (SaveModelToString / DumpModel), matching the
+ * reference: *out_len is always set to the full string length
+ * INCLUDING the terminating NUL; the copy into out_str happens only
+ * when *out_len <= buffer_len. Probe with buffer_len=0 (or any small
+ * buffer), then re-call with a buffer of at least *out_len bytes —
+ * a too-small buffer leaves out_str untouched, never truncated.
+ *
  * Build: see lightgbm_tpu/native/__init__.py:build_c_api() — produces
  * _lightgbm_tpu_capi.so next to this header.
  *

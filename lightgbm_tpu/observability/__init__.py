@@ -1,0 +1,12 @@
+"""Structured training telemetry (spans, counters, JSONL traces).
+
+See docs/Observability.md. Import surface:
+
+  from lightgbm_tpu.observability import get_telemetry, telemetry_enabled
+"""
+
+from .telemetry import (JsonlSink, RingSink, Telemetry, get_telemetry,
+                        telemetry_enabled)
+
+__all__ = ["Telemetry", "RingSink", "JsonlSink", "get_telemetry",
+           "telemetry_enabled"]

@@ -1,0 +1,138 @@
+"""One-shot per-phase probe: real hist/split/partition/grad/update cost.
+
+The production grow loop compiles each tree to ONE fused XLA program,
+so per-iteration host timing can only attribute whole-program phases
+(grad / grow / tree / update). This probe times the underlying
+component ops ONCE per train run, on the trained shapes, with a real
+device barrier (``utils/sync.fetch_one``) — the honest decomposition
+of the fused ``grow`` span into hist/split/partition that the
+per-iteration records cannot provide without adding device syncs to
+the hot loop.
+
+Runs only when a JSONL telemetry sink is configured (never in
+ring-only mode, so bench timing stays untouched), once per booster,
+after the training loop has finished. Every step is best-effort: any
+failure skips the probe rather than failing training. Opt out with
+``LGBM_TPU_TELEMETRY_NO_PROBE=1``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from ..utils.log import log_debug
+
+
+def _timeit(fn, *args, warmup: int = 1, iters: int = 2) -> float:
+    from ..utils.sync import fetch_one
+    r = None
+    for _ in range(warmup):
+        r = fn(*args)
+    fetch_one(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    fetch_one(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def run_phase_probe(gbdt) -> Optional[Dict[str, float]]:
+    """Measure grad/hist/split/partition/update seconds for one
+    iteration-equivalent of work on ``gbdt``'s learner. Returns the
+    phase dict, or None when the learner shape is not probeable."""
+    if os.environ.get("LGBM_TPU_TELEMETRY_NO_PROBE"):
+        return None
+    try:
+        return _probe(gbdt)
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        log_debug(f"telemetry phase probe skipped: {e}")
+        return None
+
+
+def _probe(gbdt) -> Optional[Dict[str, float]]:
+    import jax
+    import jax.numpy as jnp
+
+    learner = getattr(gbdt, "learner", None)
+    ds = getattr(gbdt, "train_data", None)
+    if learner is None or ds is None or gbdt._grad_fn is None:
+        return None
+    if getattr(learner, "bundled", False) or ds.has_multival:
+        return None  # group-level hists need the debundle path
+    n = ds.num_data
+    k = gbdt.num_tree_per_iteration
+    phases: Dict[str, float] = {}
+
+    score = gbdt.train_score if k > 1 else gbdt.train_score[:, 0]
+    phases["grad"] = _timeit(gbdt._grad_fn, score)
+    grad, hess = gbdt._grad_fn(score)
+    if k > 1:
+        grad, hess = grad[:, 0], hess[:, 0]
+
+    # update: leaf-value gather + row scatter-add (the score update)
+    leaf_vals = jnp.zeros((gbdt.config.num_leaves,), jnp.float32)
+    leaf_id = jnp.zeros((n,), jnp.int32)
+    upd = jax.jit(lambda s, lv, li: s.at[:, 0].add(lv[li]))
+    phases["update"] = _timeit(upd, gbdt.train_score, leaf_vals, leaf_id)
+
+    b = learner.num_bins_max
+    if hasattr(learner, "mat"):  # partitioned (segment-kernel) learner
+        from ..learner.partitioned import HIST_BLK, PART_BLK
+        from ..ops.hist_pallas import histogram_segment
+        from ..ops.partition_pallas import partition_segment
+        f = learner.num_groups
+        interp = learner.interpret
+        n_loc = getattr(learner, "n_local", n)
+        # row order is probe-safe: rows carry their ids and training
+        # repacks the gh payload per iteration (tools/profile_tree.py
+        # times these kernels on the live matrix the same way)
+        mat = learner.mat[0] if learner.mat.ndim == 3 else learner.mat
+        ws = learner.ws[0] if learner.ws.ndim == 3 else learner.ws
+        phases["hist"] = _timeit(
+            lambda m: histogram_segment(m, jnp.int32(0),
+                                        jnp.int32(min(n, n_loc)), b, f,
+                                        blk=HIST_BLK, interpret=interp),
+            mat)
+        hist = histogram_segment(mat, jnp.int32(0),
+                                 jnp.int32(min(n, n_loc)), b, f,
+                                 blk=HIST_BLK, interpret=interp)
+        lut = jnp.zeros((1, 256), jnp.float32)
+        phases["partition"] = _timeit(
+            lambda m, w: partition_segment(
+                m, w, jnp.int32(0), jnp.int32(min(n, n_loc)),
+                jnp.int32(0), jnp.int32(b // 2), jnp.int32(0),
+                jnp.int32(0), jnp.int32(0), jnp.int32(b),
+                jnp.int32(0), lut, blk=PART_BLK, interpret=interp,
+                use_lut_path=False),
+            mat, ws)
+    else:  # serial XLA learner
+        from ..ops.histogram import build_histogram, make_ghc
+        from ..ops.partition import split_leaf
+        ghc = make_ghc(grad, hess, jnp.ones_like(grad))
+        hist_fn = jax.jit(lambda g: build_histogram(
+            learner.binned, g, b, method=learner.hist_method))
+        phases["hist"] = _timeit(hist_fn, ghc)
+        hist = hist_fn(ghc)
+        bin_col = jnp.take(learner.binned, 0, axis=1)
+        part = jax.jit(lambda li, bc: split_leaf(
+            li, bc, jnp.int32(0), jnp.int32(1), jnp.int32(b // 2),
+            jnp.bool_(False), learner.meta.missing[0],
+            learner.meta.default_bin[0], learner.meta.num_bins[0],
+            jnp.bool_(False),
+            jnp.zeros((8,), jnp.uint32)))
+        phases["partition"] = _timeit(part, leaf_id, bin_col)
+
+    from ..ops.split import best_split
+    sums = hist[0].sum(axis=0)  # any one feature's bins sum to the leaf
+    g0, h0, c0 = (float(sums[0]), float(sums[1]), float(sums[2]))
+    meta = learner.meta
+    fmask = jnp.ones((ds.num_features,), bool)
+    inf = jnp.float32(jnp.inf)
+    scan = jax.jit(lambda hi: best_split(
+        hi, g0, h0, c0, meta, learner.params,
+        constraint_min=-inf, constraint_max=inf, feature_mask=fmask))
+    phases["split"] = _timeit(scan, hist)
+
+    return {kk: round(vv, 6) for kk, vv in phases.items()}

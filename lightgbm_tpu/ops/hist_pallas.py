@@ -58,7 +58,9 @@ RID_OFF = 9        # row-id bytes start at column F + RID_OFF
 # v5e has 128 MB of VMEM — raise the ceiling rather than shrink the
 # block (smaller blocks double the DMA count per row).
 VMEM_LIMIT = 100 * 1024 * 1024
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
+from .pallas_compat import tpu_compiler_params  # noqa: E402
+
+_COMPILER_PARAMS = tpu_compiler_params(vmem_limit_bytes=VMEM_LIMIT)
 
 
 def _round_up(x: int, m: int) -> int:

@@ -1,0 +1,26 @@
+"""Pallas-TPU API compatibility shims.
+
+The Mosaic compiler-params class was renamed across JAX releases
+(``pltpu.TPUCompilerParams`` in jax<=0.4.x, ``pltpu.CompilerParams``
+from 0.5), and its field set drifted (``has_side_effects`` moved in
+from pallas_call kwargs). Kernel modules build their params through
+``tpu_compiler_params`` so one import works on every jax the container
+ships.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.experimental.pallas import tpu as pltpu
+
+_CLS = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+_FIELDS = {f.name for f in dataclasses.fields(_CLS)}
+
+
+def tpu_compiler_params(**kwargs):
+    """Build the Mosaic compiler-params object, dropping any kwarg the
+    installed jax's class does not know (e.g. ``has_side_effects`` on
+    0.4.x, where effects are inferred from aliasing instead)."""
+    return _CLS(**{k: v for k, v in kwargs.items() if k in _FIELDS})

@@ -49,6 +49,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import tpu_compiler_params
+
 from .partition_pallas import (MISSING_NAN_CODE, MISSING_ZERO_CODE,
                                S_BEGIN, S_COUNT, S_FEAT, S_THR, S_DLEFT,
                                S_MISS, S_DEFBIN, S_NBINS, S_ISCAT)
@@ -353,7 +355,7 @@ def partition_segment_v2(mat, ws, begin, count, feat, thr, default_left,
         # raise the scoped-VMEM ceiling like the histogram kernels —
         # the staging streams' declared scratch (~6 MB via pick_blk)
         # plus Mosaic stack intermediates must clear the default 16 MB
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             has_side_effects=True,
             vmem_limit_bytes=100 * 1024 * 1024),
     )(scal, cat_lut, mat, ws)

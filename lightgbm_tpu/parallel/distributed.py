@@ -36,6 +36,17 @@ from ..config import Config
 from ..utils.log import log_fatal, log_info, log_warning
 
 
+def distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with version drift handled
+    (absent before jax 0.5; fall back to the client attribute)."""
+    import jax
+    dist = jax.distributed
+    if hasattr(dist, "is_initialized"):
+        return bool(dist.is_initialized())
+    state = getattr(dist, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
 def parse_machines(config: Config) -> List[Tuple[str, int]]:
     """Machine list resolution (Config::Set + network.cpp:45-58):
     ``machine_list_filename`` (one ``ip port`` per line) takes
@@ -116,7 +127,7 @@ def init_distributed(config: Config,
     # NOTE: never touch jax.process_count()/devices() here — any such
     # call initializes the XLA backend, after which
     # jax.distributed.initialize refuses to run
-    if jax.distributed.is_initialized():
+    if distributed_initialized():
         return True  # already up
     if process_id is None:
         process_id = find_local_rank(machines, config)
@@ -229,9 +240,6 @@ def maybe_gather_sparse_bin_sample(col_values: List[np.ndarray],
 
 def _multi_process() -> bool:
     import jax
-    try:
-        if not jax.distributed.is_initialized():
-            return False
-    except AttributeError:
-        pass
+    if not distributed_initialized():
+        return False
     return jax.process_count() > 1

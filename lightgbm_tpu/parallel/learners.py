@@ -147,6 +147,7 @@ class _MeshLearnerBase(SerialTreeLearner):
             bag_weight = jnp.ones((n,), jnp.float32)
         if feature_mask is None:
             feature_mask = jnp.ones((self.dataset.num_features,), bool)
+        self._count_tree_telemetry()
         pad = self._n_pad - n
         if pad:
             grad = jnp.pad(grad, (0, pad))
@@ -567,6 +568,7 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
             bag_weight = jnp.ones((n,), jnp.float32)
         if feature_mask is None:
             feature_mask = jnp.ones((self.num_features,), bool)
+        self._count_tree_telemetry()
         pad = self._n_pad - n
         if pad:
             grad = jnp.pad(grad, (0, pad))

@@ -14,6 +14,9 @@ import os
 # concurrent tunnel client wedges any real-TPU job (e.g. the driver's
 # bench) running alongside the tests.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# hermetic telemetry: a driver-level LGBM_TPU_TELEMETRY must not make
+# every training test append to a shared trace file
+os.environ.pop("LGBM_TPU_TELEMETRY", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -59,3 +62,8 @@ def _clear_jax_caches_between_modules(request):
         jax.clear_caches()
     _last_module[0] = mod
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 budgeted run (-m 'not slow')")

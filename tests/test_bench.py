@@ -42,21 +42,29 @@ def test_bench_child_prints_valid_json_line():
     assert line["backend"] == "cpu"
     assert 0.4 < line["auc"] <= 1.0   # default-on quality gate ran
     assert line["quality_ok"] is True
+    # compile-vs-steady-state provenance (observability layer)
+    assert line["compile_count"] > 0
+    assert line["compile_s"] > 0
+    assert line["warmup_s"] > 0 and line["steady_s"] > 0
+    assert line["compile_in_timed_s"] <= line["compile_s"]
     # the driver parses the LAST json line; make sure serialization
     # round-trips
     assert json.loads(json.dumps(line)) == line
 
 
-def test_bench_main_probe_and_pinned_plan():
+def test_bench_main_probe_and_pinned_plan(tmp_path):
     """Full main() flow: the 90s tunnel probe (succeeds on forced
-    CPU), the pinned-size plan, and the result-line passthrough."""
+    CPU), the pinned-size plan, the result-line passthrough, and the
+    telemetry JSONL written next to the JSON output."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the tunnel
+    tel_path = str(tmp_path / "bench_telemetry.jsonl")
     env.update(JAX_PLATFORMS="cpu",
                BENCH_ROWS="3000", BENCH_FEATURES="6",
                BENCH_LEAVES="7", BENCH_ITERS="1",
                BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500",
-               BENCH_MIN_AUC="0.4", BENCH_ALLOW_CPU="1")
+               BENCH_MIN_AUC="0.4", BENCH_ALLOW_CPU="1",
+               LGBM_TPU_TELEMETRY=tel_path)
     flags = env.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
         env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
@@ -69,6 +77,9 @@ def test_bench_main_probe_and_pinned_plan():
     line = find_result_line(proc.stdout)
     assert line is not None, proc.stdout[-2000:]
     assert line["rows"] == 3000 and line["backend"] == "cpu"
+    with open(tel_path) as fh:
+        kinds = {json.loads(ln)["kind"] for ln in fh if ln.strip()}
+    assert {"run_start", "train_end"} <= kinds
 
 
 def test_bench_quality_gate_is_loud():
@@ -82,7 +93,7 @@ def test_bench_quality_gate_is_loud():
                BENCH_LEAVES="7", BENCH_ITERS="1",
                BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500",
                BENCH_MIN_AUC="1.01",   # unreachable bar
-               BENCH_ALLOW_CPU="1")
+               BENCH_ALLOW_CPU="1", BENCH_NO_TELEMETRY="1")
     flags = env.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
         env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()

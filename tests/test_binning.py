@@ -171,6 +171,74 @@ def test_dataset_binary_roundtrip(tmp_path):
         == ds.feature_mapper(0).bin_upper_bound
 
 
+def test_is_binary_file_verifies_npz_members(tmp_path):
+    """ADVICE: the two-byte PK sniff alone routed ANY zip (or a text
+    file starting with "PK") to the binary loader; the check must
+    verify the expected npz members and fall through otherwise."""
+    rng = np.random.RandomState(5)
+    cfg = Config.from_params({"max_bin": 31})
+    ds = Dataset.from_numpy(rng.randn(50, 3), cfg, label=rng.rand(50))
+    real = str(tmp_path / "cache.bin")
+    ds.save_binary(real)
+    assert Dataset.is_binary_file(real)
+
+    pk_text = str(tmp_path / "pk.train")
+    with open(pk_text, "w") as fh:
+        fh.write("PK this is actually a text training file\n1,2,3\n")
+    assert not Dataset.is_binary_file(pk_text)
+
+    other_zip = str(tmp_path / "other.npz")
+    np.savez(other_zip, foo=np.arange(3))
+    assert not Dataset.is_binary_file(other_zip)
+
+    assert not Dataset.is_binary_file(str(tmp_path / "missing.bin"))
+
+
+def test_binary_valid_set_alignment_check(tmp_path):
+    """ADVICE (basic.py:144): a binary-loaded valid set attached to a
+    Booster must fail loudly when its bin layout differs from the train
+    set's (CheckAlign analog), instead of silently evaluating through
+    mismatched bin boundaries."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import LightGBMError
+    rng = np.random.RandomState(7)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    Xv = rng.randn(100, 4)
+    yv = (Xv[:, 0] > 0).astype(np.float64)
+
+    # layout saved under DIFFERENT binning params than the train set
+    cfg_other = Config.from_params({"max_bin": 7})
+    inner = Dataset.from_numpy(Xv, cfg_other, label=yv)
+    bad = str(tmp_path / "valid_misaligned.bin")
+    inner.save_binary(bad)
+
+    train_set = lgb.Dataset(X, label=y, params={"max_bin": 255})
+    with pytest.raises(LightGBMError, match="bin layout"):
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1, "max_bin": 255,
+                   "metric": "binary_logloss"},
+                  train_set, num_boost_round=2,
+                  valid_sets=[lgb.Dataset(bad)], verbose_eval=False)
+
+    # an ALIGNED binary valid set (saved with the train set's mappers)
+    # still loads and evaluates fine
+    train_set2 = lgb.Dataset(X, label=y, params={"max_bin": 255})
+    train_set2.construct()
+    inner_ok = Dataset.from_numpy(Xv, Config.from_params(
+        {"max_bin": 255}), label=yv, reference=train_set2._inner)
+    good = str(tmp_path / "valid_aligned.bin")
+    inner_ok.save_binary(good)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "max_bin": 255,
+                     "metric": "binary_logloss"},
+                    train_set2, num_boost_round=2,
+                    valid_sets=[lgb.Dataset(good,
+                                            reference=train_set2)],
+                    verbose_eval=False)
+    assert bst.num_trees() == 2
+
+
 def test_metadata_query_boundaries():
     from lightgbm_tpu.data import Metadata
     md = Metadata(10)

@@ -364,6 +364,53 @@ def test_c_api_importance_and_leaf_values(capi_so):
     lib.LGBM_DatasetFree(ds)
 
 
+def test_c_api_string_out_skips_copy_when_buffer_too_small(capi_so):
+    """ADVICE (c_api.cpp copy_string_out): match the reference
+    contract — out_len is always the full length incl. NUL, and the
+    copy is SKIPPED entirely when it does not fit, never silently
+    truncated. Callers probe with a small buffer, then re-call."""
+    lib = ctypes.CDLL(capi_so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    rng = np.random.RandomState(9)
+    X = np.ascontiguousarray(rng.randn(200, 4))
+    y = np.ascontiguousarray((X[:, 0] > 0).astype(np.float32))
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 200, 4, 1,
+        b"verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 200, 0) == 0
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    # probe call: tiny buffer stays untouched, out_len reports the need
+    sentinel = b"\xee" * 16
+    small = ctypes.create_string_buffer(sentinel, 16)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, ctypes.c_int64(16), ctypes.byref(out_len),
+        small) == 0
+    assert out_len.value > 16          # a real model never fits 16 B
+    assert small.raw == sentinel       # NOT partially overwritten
+
+    # sized call: full string, NUL-terminated, same reported length
+    buf = ctypes.create_string_buffer(out_len.value)
+    out_len2 = ctypes.c_int64()
+    assert lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, ctypes.c_int64(out_len.value),
+        ctypes.byref(out_len2), buf) == 0
+    assert out_len2.value == out_len.value
+    text = buf.value.decode()
+    assert len(text) == out_len.value - 1
+    assert text.startswith("tree") and "Tree=0" in text
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
+
+
 def test_c_api_csc_subset_custom_update_single_row(capi_so):
     """CSC create, row subset, custom-objective update, and single-row
     predict through the compiled shim."""

@@ -1,0 +1,285 @@
+"""Structured training telemetry (lightgbm_tpu/observability/).
+
+Covers the ISSUE-1 test checklist: span nesting/accumulation, counters
+across jit boundaries, the JSONL sink round-trip through
+tools/run_report.py, zero records in disabled mode, and the
+``record_telemetry`` engine callback.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability.telemetry import (JsonlSink, Telemetry,
+                                                  get_telemetry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_run_report():
+    spec = importlib.util.spec_from_file_location(
+        "run_report", os.path.join(REPO, "tools", "run_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def tel():
+    """Fresh singleton state per test; always restored to disabled."""
+    t = get_telemetry()
+    t.reset()
+    yield t
+    t.reset()
+
+
+def _toy(n=600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+# ---------------------------------------------------------------------
+def test_spans_nest_and_accumulate(tel):
+    tel.configure(summary=False)
+    for _ in range(3):
+        with tel.span("outer"):
+            with tel.span("inner"):
+                pass
+            with tel.span("inner"):
+                pass
+    assert tel.spans["outer"][1] == 3
+    assert tel.spans["outer/inner"][1] == 6
+    # child time is contained in the parent's
+    assert tel.spans["outer"][0] >= tel.spans["outer/inner"][0]
+    # a sibling at top level gets its own path, not outer's
+    with tel.span("other"):
+        pass
+    assert "other" in tel.spans and "outer/other" not in tel.spans
+
+
+def test_phase_spans_feed_iteration_records(tel):
+    tel.configure(summary=False)
+    with tel.span("grad", phase=True):
+        pass
+    with tel.span("grow", phase=True):
+        pass
+    tel.end_iteration(0, trees=1)
+    recs = [r for r in tel.records if r["kind"] == "iter"]
+    assert len(recs) == 1
+    assert set(recs[0]["phases"]) == {"grad", "grow"}
+    # phases were flushed: the next iteration starts empty
+    tel.end_iteration(1)
+    assert tel.records[-1]["phases"] == {}
+
+
+def test_counters_survive_jit_boundaries(tel):
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learner.comm import _count_collective
+    tel.configure(summary=False)
+
+    @jax.jit
+    def f(x):
+        return _count_collective("test", x) * 2
+
+    x = jnp.ones((4, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), 2.0)
+    np.testing.assert_allclose(np.asarray(f(x)), 2.0)  # cached call
+    # counted at trace time: once per compiled program, 4*4*4 bytes
+    assert tel.counters["comm.test_bytes"] == 64
+    assert tel.counters["comm.test_calls"] == 1
+    # host-side counters accept device scalars and keep accumulating
+    tel.count("host.rows", jnp.int32(5))
+    tel.count("host.rows", 7)
+    assert tel.counters["host.rows"] == 12
+
+
+def test_disabled_mode_adds_no_records(tel):
+    assert not tel.enabled
+    with tel.span("train"):
+        with tel.span("grad", phase=True):
+            pass
+    tel.count("x", 1)
+    tel.gauge("g", 2)
+    tel.observe("d", 3.0)
+    tel.end_iteration(0)
+    tel.record("iter", iter=0)
+    assert tel.records == []
+    assert tel.spans == {} and tel.counters == {}
+    assert tel.gauges == {} and tel.dists == {}
+
+
+def test_disabled_training_emits_nothing(tel):
+    X, y = _toy()
+    booster = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=3)
+    assert booster.num_trees() == 3
+    assert tel.records == [] and tel.counters == {}
+
+
+def test_jsonl_roundtrip_through_run_report(tel, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tel.configure(jsonl_path=path, summary=False)
+    tel.ensure_started()  # run_start for an already-enabled session
+    X, y = _toy(800)
+    Xv, yv = _toy(200, seed=1)
+    train_set = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7,
+               "metric": "binary_logloss", "verbosity": -1},
+              train_set, num_boost_round=4,
+              valid_sets=[lgb.Dataset(Xv, label=yv, reference=train_set)],
+              verbose_eval=False)
+    tel.flush()
+
+    rr = _load_run_report()
+    records = rr.load(path)
+    kinds = {r["kind"] for r in records}
+    assert {"run_start", "iter", "train_end"} <= kinds
+    d = rr.digest(records)
+    assert d["iters"] == 4
+    assert d["compile"]["count"] > 0
+    assert d["compile"]["seconds"] > 0
+    assert "grow" in d["phases"] and d["phases"]["grow"]["count"] == 4
+    assert d["eval"], "eval records should surface in the digest"
+    text = rr.render(records)
+    assert "compile vs steady state" in text and "grow" in text
+    # counters made it into the record stream
+    assert d["counters"]["learner.trees"] == 4
+
+
+def test_phase_probe_decomposes_grow(tel, tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset as InnerDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.observability.probe import run_phase_probe
+    X, y = _toy(500)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 7,
+                              "metric": "", "verbosity": -1})
+    ds = InnerDataset.from_numpy(np.asarray(X, np.float32), cfg,
+                                 label=np.asarray(y, np.float32))
+    b = GBDT(cfg, ds)
+    b.train(2)
+    phases = run_phase_probe(b)
+    assert phases is not None
+    assert {"grad", "hist", "split", "partition", "update"} \
+        <= set(phases)
+    assert all(v >= 0 for v in phases.values())
+
+
+def test_train_end_record_and_summary_fields(tel, tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tel.configure(jsonl_path=path, summary=False)
+    X, y = _toy(400)
+    lgb.train({"objective": "binary", "num_leaves": 7,
+               "verbosity": -1}, lgb.Dataset(X, label=y),
+              num_boost_round=2)
+    tel.flush()
+    recs = [json.loads(ln) for ln in open(path)]
+    ends = [r for r in recs if r["kind"] == "train_end"]
+    assert ends, "pipelined path must emit train_end"
+    end = ends[-1]
+    assert end["iters"] == 2 and end["num_data"] == 400
+    assert end["dur_s"] > 0 and "memory" in end
+    assert end["compile"]["count"] >= 1
+
+
+def test_record_telemetry_callback_populates_dict(tel):
+    X, y = _toy(500)
+    Xv, yv = _toy(150, seed=2)
+    out = {}
+    train_set = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7,
+               "metric": "binary_logloss", "verbosity": -1},
+              train_set, num_boost_round=3,
+              valid_sets=[lgb.Dataset(Xv, label=yv,
+                                      reference=train_set)],
+              verbose_eval=False,
+              callbacks=[lgb.record_telemetry(out)])
+    assert len(out["iterations"]) == 3
+    for i, rec in enumerate(out["iterations"]):
+        assert rec["iteration"] == i
+        assert "phases" in rec and "grow" in rec["phases"]
+        assert rec["eval"], "eval results ride the iteration record"
+    assert out["summary"]["counters"]["learner.trees"] == 3
+    assert "compile" in out["summary"]
+
+
+def test_record_telemetry_forces_stepped_loop(tel):
+    """Without eval sets the engine would take the pipelined fast path;
+    requesting telemetry recording must force per-iteration stepping so
+    the dict really fills."""
+    X, y = _toy(300)
+    out = {}
+    lgb.train({"objective": "binary", "num_leaves": 7,
+               "verbosity": -1}, lgb.Dataset(X, label=y),
+              num_boost_round=2, callbacks=[lgb.record_telemetry(out)])
+    assert len(out["iterations"]) == 2
+
+
+def test_record_telemetry_does_not_swallow_env_jsonl(tel, tmp_path,
+                                                     monkeypatch):
+    """Creating a record_telemetry callback enables ring-only mode
+    BEFORE the engine calls ensure_started; the LGBM_TPU_TELEMETRY
+    JSONL sink must still attach instead of being silently dropped."""
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("LGBM_TPU_TELEMETRY", path)
+    X, y = _toy(300)
+    out = {}
+    lgb.train({"objective": "binary", "num_leaves": 7,
+               "verbosity": -1}, lgb.Dataset(X, label=y),
+              num_boost_round=2, callbacks=[lgb.record_telemetry(out)])
+    assert len(out["iterations"]) == 2
+    with open(path) as fh:
+        kinds = {json.loads(ln)["kind"] for ln in fh if ln.strip()}
+    assert {"run_start", "iter", "train_end"} <= kinds
+
+
+def test_jsonl_sink_tolerates_append_and_new_instance(tel, tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    s = JsonlSink(path)
+    s.emit({"kind": "x", "t": 0.0})
+    s.close()
+    s2 = JsonlSink(path)
+    s2.emit({"kind": "y", "t": 1.0})
+    s2.close()
+    rr = _load_run_report()
+    assert [r["kind"] for r in rr.load(path)] == ["x", "y"]
+
+
+def test_summary_sink_honors_verbosity(tel, capsys):
+    from lightgbm_tpu.utils.log import set_verbosity
+    tel.configure(summary=True)
+    try:
+        set_verbosity(-1)
+        tel.record("train_end", iters=1, dur_s=0.5)
+        assert "[telemetry]" not in capsys.readouterr().out
+        set_verbosity(1)
+        tel.record("train_end", iters=1, dur_s=0.5,
+                   phase_totals={"grow": 0.4})
+        out = capsys.readouterr().out
+        assert "[telemetry]" in out and "grow" in out
+    finally:
+        set_verbosity(1)
+
+
+def test_telemetry_out_param_enables_file(tel, tmp_path, monkeypatch):
+    """The ``telemetry_out`` config parameter (and its CLI form
+    telemetry_out=path) starts a JSONL session without the env var."""
+    monkeypatch.delenv("LGBM_TPU_TELEMETRY", raising=False)
+    path = str(tmp_path / "cfg.jsonl")
+    X, y = _toy(300)
+    lgb.train({"objective": "binary", "num_leaves": 7,
+               "verbosity": -1, "telemetry_out": path},
+              lgb.Dataset(X, label=y), num_boost_round=2)
+    tel.flush()
+    recs = [json.loads(ln) for ln in open(path)]
+    assert any(r["kind"] == "run_start" for r in recs)
+    assert any(r["kind"] == "train_end" for r in recs)

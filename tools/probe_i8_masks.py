@@ -45,6 +45,7 @@ def main() -> int:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from lightgbm_tpu.ops.pallas_compat import tpu_compiler_params
     from lightgbm_tpu.utils.sync import fetch_one
 
     lower_only = "--lower-only" in sys.argv
@@ -72,7 +73,7 @@ def main() -> int:
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                       pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024),
         )
 

@@ -71,6 +71,12 @@ def main():
     budget = float(os.environ.get("PERF_SEQ_BUDGET_S", 5400))
     t0 = time.time()
     os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    # every child of this sequence writes its telemetry trace next to
+    # the combined log, so a perf regression always ships its evidence
+    # (docs/Observability.md; render with tools/run_report.py)
+    os.environ.setdefault("LGBM_TPU_TELEMETRY",
+                          os.path.join(REPO, "docs",
+                                       "PERF_TELEMETRY.jsonl"))
     with open(LOG, "a") as fh:
         fh.write(f"\n######## perf sequence {time.ctime()} ########\n")
 

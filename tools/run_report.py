@@ -1,0 +1,196 @@
+"""Render a telemetry JSONL trace into a phase/throughput report.
+
+Usage:  python tools/run_report.py <trace.jsonl> [--json]
+
+Reads the trace written by LGBM_TPU_TELEMETRY / telemetry_out (schema:
+docs/Observability.md) and prints, for the LAST training run in the
+file: backend provenance, compile-vs-steady-state breakdown, the
+per-phase timing table (grad/hist/split/partition/update — host phase
+wall times from the per-iteration records plus the one-shot component
+probe), throughput, counters and final eval results. ``--json`` emits
+the same digest as one machine-readable JSON object (used by CI).
+
+Stdlib-only on purpose: the report must render on any box, including
+ones without jax installed.
+"""
+
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def load(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # tolerate a torn tail line
+    return records
+
+
+def _last(records, kind):
+    out = None
+    for r in records:
+        if r.get("kind") == kind:
+            out = r
+    return out
+
+
+def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a record list into the report's data model."""
+    run = _last(records, "run_start") or {}
+    end = _last(records, "train_end") or {}
+    probe = _last(records, "phase_probe") or {}
+    iters = [r for r in records if r.get("kind") == "iter"]
+    blocks = [r for r in records if r.get("kind") == "block"]
+
+    phases: Dict[str, Dict[str, float]] = {}
+    for r in iters:
+        for name, dur in (r.get("phases") or {}).items():
+            p = phases.setdefault(name, {"total_s": 0.0, "count": 0})
+            p["total_s"] += float(dur)
+            p["count"] += 1
+    for p in phases.values():
+        p["total_s"] = round(p["total_s"], 6)
+        p["mean_s"] = round(p["total_s"] / max(p["count"], 1), 6)
+
+    n_iters = int(end.get("iters") or 0) or (
+        len(iters) + sum(int(b.get("iters", 0)) for b in blocks))
+    rows = int(end.get("num_data") or
+               (iters[-1].get("num_data") if iters else 0) or 0)
+    dur = float(end.get("dur_s") or 0.0)
+    block_rows_per_s = [b["rows_per_s"] for b in blocks
+                       if b.get("rows_per_s")]
+
+    evals: Dict[str, float] = {}
+    ev = _last(records, "eval")
+    if ev:
+        for ds, metric, value, _bigger in ev.get("results", []):
+            evals[f"{ds} {metric}"] = value
+
+    return {
+        "backend": run.get("backend"),
+        "device_count": run.get("device_count"),
+        "jax_version": run.get("jax_version"),
+        "config": run.get("config") or {},
+        "iters": n_iters,
+        "num_data": rows,
+        "dur_s": dur,
+        "rows_per_s": end.get("rows_per_s"),
+        "block_rows_per_s": block_rows_per_s,
+        "compile": end.get("compile") or {},
+        "phases": phases,
+        "phase_totals": end.get("phase_totals") or {},
+        "probe": probe.get("phases") or {},
+        "probe_learner": probe.get("learner"),
+        "counters": end.get("counters") or {},
+        "memory": end.get("memory") or {},
+        "eval": evals,
+        "eval_iter": ev.get("iter") if ev else None,
+    }
+
+
+def render(records: List[Dict[str, Any]]) -> str:
+    d = digest(records)
+    L: List[str] = []
+    L.append("== run ==")
+    L.append(f"backend={d['backend']} devices={d['device_count']} "
+             f"jax={d['jax_version']}")
+    if d["config"]:
+        cfg = " ".join(f"{k}={v}" for k, v in sorted(
+            d["config"].items()))
+        L.append(f"config: {cfg}")
+
+    L.append("")
+    L.append("== compile vs steady state ==")
+    comp = d["compile"]
+    L.append(f"compiles={comp.get('count', 0)} "
+             f"compile_s={comp.get('seconds', 0.0):.3f} "
+             f"trace_s={comp.get('trace_seconds', 0.0):.3f}")
+    L.append(f"train wall: {d['dur_s']:.3f}s for {d['iters']} iters "
+             f"on {d['num_data']} rows")
+    if d["rows_per_s"]:
+        L.append(f"throughput: {d['rows_per_s'] / 1e6:.4f} "
+                 "Mrow-iters/s (incl. host loop)")
+    if d["block_rows_per_s"]:
+        best = max(d["block_rows_per_s"])
+        L.append(f"fused blocks: {len(d['block_rows_per_s'])}, best "
+                 f"{best / 1e6:.4f} Mrow-iters/s (steady state)")
+
+    L.append("")
+    L.append("== phases (host wall, per-iteration records) ==")
+    phases = d["phases"] or {k: {"total_s": v, "count": d["iters"],
+                                 "mean_s": v / max(d["iters"], 1)}
+                             for k, v in d["phase_totals"].items()}
+    if phases:
+        tot = sum(p["total_s"] for p in phases.values()) or 1.0
+        L.append(f"{'phase':<12}{'total_s':>10}{'mean_s':>10}"
+                 f"{'count':>7}{'share':>7}")
+        for name, p in sorted(phases.items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            L.append(f"{name:<12}{p['total_s']:>10.4f}"
+                     f"{p.get('mean_s', 0.0):>10.4f}"
+                     f"{p['count']:>7}"
+                     f"{100 * p['total_s'] / tot:>6.1f}%")
+    else:
+        L.append("(no per-iteration records — fused/pipelined run; "
+                 "see fused blocks above)")
+
+    if d["probe"]:
+        L.append("")
+        L.append("== grow decomposition (one-shot component probe, "
+                 f"{d['probe_learner']}) ==")
+        L.append("grad/hist/split/partition/update seconds per "
+                 "iteration-equivalent:")
+        tot = sum(d["probe"].values()) or 1.0
+        for name in ("grad", "hist", "split", "partition", "update"):
+            if name in d["probe"]:
+                v = d["probe"][name]
+                L.append(f"{name:<12}{v:>10.6f}"
+                         f"{100 * v / tot:>6.1f}%")
+
+    interesting = {k: v for k, v in d["counters"].items()
+                   if not k.startswith("jit.")}
+    if interesting:
+        L.append("")
+        L.append("== counters ==")
+        for k, v in sorted(interesting.items()):
+            L.append(f"{k:<32}{v:>16,.0f}")
+
+    if d["memory"]:
+        m = d["memory"]
+        L.append("")
+        L.append("== memory ==")
+        L.append(" ".join(f"{k}={v}" for k, v in sorted(m.items())))
+
+    if d["eval"]:
+        L.append("")
+        L.append(f"== eval (iter {d['eval_iter']}) ==")
+        for k, v in sorted(d["eval"].items()):
+            L.append(f"{k:<32}{v:>14.6f}")
+    return "\n".join(L) + "\n"
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    if not args:
+        sys.stderr.write(__doc__ + "\n")
+        return 2
+    records = load(args[0])
+    if not records:
+        sys.stderr.write(f"no records in {args[0]}\n")
+        return 1
+    if "--json" in argv:
+        print(json.dumps(digest(records)))
+    else:
+        sys.stdout.write(render(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
