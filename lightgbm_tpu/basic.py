@@ -537,24 +537,76 @@ class Booster:
                                   self.valid_sets[i])
         return out
 
+    def eval_all(self, feval=None, include_train: bool = True) -> List:
+        """Training + every valid set in ONE batched device->host fetch
+        per call (the per-iteration engine loop's eval boundary). Order
+        matches eval_train() + eval_valid()."""
+        jobs = []
+        if include_train:
+            from .metric import create_metrics
+            g = self._gbdt
+            if not g.training_metrics:
+                g.training_metrics = create_metrics(
+                    g.config.resolved_metrics(), g.config)
+                for m in g.training_metrics:
+                    m.init(g.train_data.metadata, g.num_data)
+            jobs.append((g.training_metrics, g.train_score,
+                         self._train_data_name, self.train_set))
+        for i, name in enumerate(self.name_valid_sets):
+            jobs.append((self._gbdt.valid_metrics[i],
+                         self._gbdt.valid_scores[i], name,
+                         self.valid_sets[i]))
+        return self._eval_sets(jobs, feval)
+
     def _eval_one(self, metrics, score, name, feval, dataset) -> List:
+        return self._eval_sets([(metrics, score, name, dataset)], feval)
+
+    def _eval_sets(self, jobs, feval) -> List:
+        """Shared eval driver: one batched fetch for all datasets on
+        the device-eval path (LGBM_TPU_DEVICE_EVAL=0 restores the
+        legacy per-metric fetches)."""
+        from .metric.metrics import batched_eval, device_eval_enabled
+        from .observability.telemetry import get_telemetry
         g = self._gbdt
-        sc = score if g.num_tree_per_iteration > 1 else score[:, 0]
+        tel = get_telemetry()
+        scs = [score if g.num_tree_per_iteration > 1 else score[:, 0]
+               for _metrics, score, _name, _ds in jobs]
+        if device_eval_enabled():
+            tel.count_iter("host.syncs")
+            tel.count_iter("host.dispatches", len(jobs))
+            per_job = batched_eval(
+                [(metrics, sc, name)
+                 for (metrics, _s, name, _ds), sc in zip(jobs, scs)],
+                g.objective)
+        else:
+            per_job = []
+            for (metrics, _s, name, _ds), sc in zip(jobs, scs):
+                sc_h = np.asarray(sc)
+                # legacy accounting: score fetch + per-metric convert
+                # round trip (upload + convert dispatch + result fetch)
+                tel.count_iter("host.syncs", 1 + len(metrics))
+                tel.count_iter("host.dispatches", 2 * len(metrics))
+                rows = []
+                for m in metrics:
+                    vals = m.eval(sc_h, g.objective)
+                    for mname, v in zip(m.names, vals):
+                        rows.append((name, mname, v,
+                                     m.factor_to_bigger_better > 0))
+                per_job.append(rows)
         out = []
-        for m in metrics:
-            vals = m.eval(np.asarray(sc), g.objective)
-            for mname, v in zip(m.names, vals):
-                out.append((name, mname, v, m.factor_to_bigger_better > 0))
-        if feval is not None:
-            flat = np.asarray(sc, np.float64)
-            if flat.ndim == 2:
-                flat = flat.T.reshape(-1)
-            res = feval(flat, dataset)
-            if res is not None:
-                if isinstance(res, tuple):
-                    res = [res]
-                for mname, v, bigger in res:
-                    out.append((name, mname, v, bigger))
+        for (metrics, _s, name, dataset), sc, rows in zip(jobs, scs,
+                                                          per_job):
+            out.extend(rows)
+            if feval is not None:
+                flat = np.asarray(sc, np.float64)
+                if flat.ndim == 2:
+                    flat = flat.T.reshape(-1)
+                res = feval(flat, dataset)
+                if res is not None:
+                    if isinstance(res, tuple):
+                        res = [res]
+                    for mname, v, bigger in res:
+                        out.append((name, mname, v, bigger))
         return out
 
     # ------------------------------------------------------------------

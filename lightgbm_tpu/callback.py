@@ -90,7 +90,9 @@ def record_telemetry(result: Dict) -> Callable:
     ``record_telemetry`` callback).
 
     After training, ``result["iterations"]`` holds one dict per
-    iteration ({iteration, phases, eval, ...}) and ``result["summary"]``
+    iteration ({iteration, phases, counts, eval, ...} — ``counts`` is
+    the per-iteration dispatch/host-sync accounting, see
+    docs/Observability.md) and ``result["summary"]``
     the end-of-run counters/compile stats. The in-memory ring sink is
     enabled on creation when telemetry is otherwise off, so the
     callback works without ``LGBM_TPU_TELEMETRY``/``telemetry_out``.

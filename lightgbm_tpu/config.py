@@ -123,6 +123,8 @@ _PARAM_ALIASES: Dict[str, str] = {
     "workers": "machines", "nodes": "machines",
     "telemetry": "telemetry_out", "telemetry_file": "telemetry_out",
     "telemetry_output": "telemetry_out",
+    "compile_cache": "compile_cache_dir",
+    "compilation_cache_dir": "compile_cache_dir",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -279,6 +281,11 @@ class Config:
     # structured training telemetry (docs/Observability.md): path of a
     # JSONL trace; empty = disabled unless LGBM_TPU_TELEMETRY is set
     telemetry_out: str = ""
+    # persistent XLA compilation cache directory (docs/Performance.md):
+    # compiled executables are serialized there and reloaded by later
+    # processes, so repeat runs skip the cold-compile bill. Empty =
+    # disabled unless LGBM_TPU_COMPILE_CACHE is set.
+    compile_cache_dir: str = ""
 
     # ---- predict task (config.h:675-741)
     num_iteration_predict: int = -1

@@ -194,10 +194,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         evaluation_result_list = []
         if need_eval:
             with tel.span("eval", trace="eval"):
-                if eval_on_train:
-                    evaluation_result_list.extend(
-                        booster.eval_train(feval))
-                if extra_valid_sets:
+                # one batched device->host fetch covering training +
+                # every valid set (basic.py Booster.eval_all) instead
+                # of a fetch-and-convert round trip per metric
+                if eval_on_train or extra_valid_sets:
+                    evaluation_result_list.extend(booster.eval_all(
+                        feval, include_train=eval_on_train))
+                elif feval is not None:
                     evaluation_result_list.extend(
                         booster.eval_valid(feval))
             tel.eval_results(i, evaluation_result_list)

@@ -162,14 +162,18 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
         self._setup_partitioned(dataset, config, interpret)
         self.mat = build_matrix(jnp.asarray(dataset.binned), HIST_BLK)
         self.ws = jnp.zeros_like(self.mat)
+        # no-sampling defaults, built ONCE: a fresh ones_like per
+        # train() call is a per-iteration device allocation + dispatch
+        self._ones_rows = jnp.ones((self.num_data,), jnp.float32)
+        self._all_features = jnp.ones((self.num_features,), bool)
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_weight: Optional[jnp.ndarray] = None,
               feature_mask: Optional[jnp.ndarray] = None) -> GrowResult:
         if bag_weight is None:
-            bag_weight = jnp.ones_like(grad)
+            bag_weight = self._ones_rows
         if feature_mask is None:
-            feature_mask = jnp.ones((self.num_features,), bool)
+            feature_mask = self._all_features
         self._count_tree_telemetry()
         rand_key = self.next_tree_key()
         self.mat, self.ws, tree, leaf_id = _grow_partitioned(

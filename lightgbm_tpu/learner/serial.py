@@ -654,6 +654,8 @@ def count_tree_telemetry(learner) -> None:
     n = learner.dataset.num_data
     big_l = learner.num_leaves
     cache = getattr(learner, "cache_hists", True)
+    # the grow call is ONE fused device program = one dispatch
+    tel.count_iter("host.dispatches")
     tel.count("learner.trees", 1)
     tel.count("learner.rows_scanned", n)
     tel.count("learner.hist_builds_planned",
@@ -705,14 +707,17 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
             config, self.num_leaves, dataset.num_groups,
             self.num_bins_max)
         self._init_cegb()
+        # no-sampling defaults, built ONCE (see PartitionedTreeLearner)
+        self._ones_rows = jnp.ones((dataset.num_data,), jnp.float32)
+        self._all_features = jnp.ones((dataset.num_features,), bool)
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_weight: Optional[jnp.ndarray] = None,
               feature_mask: Optional[jnp.ndarray] = None) -> GrowResult:
         if bag_weight is None:
-            bag_weight = jnp.ones_like(grad)
+            bag_weight = self._ones_rows
         if feature_mask is None:
-            feature_mask = jnp.ones((self.dataset.num_features,), bool)
+            feature_mask = self._all_features
         self._count_tree_telemetry()
         # module-level jit: learners with equal shapes/params share the
         # compiled executable (tests and per-class trainers hit the cache)

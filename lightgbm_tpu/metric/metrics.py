@@ -11,7 +11,8 @@ Ranking metrics (ndcg/map) live in ``rank_metrics.py`` (M2).
 
 from __future__ import annotations
 
-from typing import List, Optional
+import os
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -20,6 +21,50 @@ from ..utils.log import log_fatal
 
 kEpsilon = 1e-15
 _LOG_EPS = 1.0e-12
+
+
+def device_eval_enabled() -> bool:
+    """Device-resident metric eval (one batched device->host fetch per
+    eval boundary). ``LGBM_TPU_DEVICE_EVAL=0`` restores the legacy
+    per-metric fetch path (parity/attribution kill switch)."""
+    return os.environ.get("LGBM_TPU_DEVICE_EVAL", "1") != "0"
+
+
+def batched_eval(jobs: Sequence[Tuple[list, object, str]], objective
+                 ) -> List[List[Tuple[str, str, float, bool]]]:
+    """Evaluate several datasets' metric lists with ONE device->host
+    transfer.
+
+    ``jobs`` is ``[(metrics, score_device, dataset_name), ...]`` with
+    ``score_device`` the raw [N] / [N, K] device score. The converted
+    prediction is computed ON DEVICE once per dataset (the legacy path
+    re-uploaded the fetched score and re-converted per metric), then a
+    single ``jax.device_get`` pulls every (score, pred) pair; each
+    metric's host-side f64 reduction runs unchanged on the fetched
+    arrays, so values are bit-identical to the legacy path. Returns
+    one result list PER JOB (callers control interleaving).
+    """
+    import jax
+
+    payload = []
+    for _metrics, sc, _name in jobs:
+        pred = sc if objective is None else objective.convert_output(sc)
+        payload.append((sc, pred))
+    fetched = jax.device_get(payload)  # the ONE sync per eval boundary
+    out: List[List[Tuple[str, str, float, bool]]] = []
+    for (metrics, _sc, name), (sc_h, pred_h) in zip(jobs, fetched):
+        rows: List[Tuple[str, str, float, bool]] = []
+        for m in metrics:
+            m._pred_cache = pred_h
+            try:
+                vals = m.eval(np.asarray(sc_h), objective)
+            finally:
+                m._pred_cache = None
+            for mname, v in zip(m.names, vals):
+                rows.append((name, mname, v,
+                             m.factor_to_bigger_better > 0))
+        out.append(rows)
+    return out
 
 
 def _xent_loss(label, prob):
@@ -33,6 +78,9 @@ class Metric:
     """Base: subclasses define name, bigger_better, eval()."""
 
     factor_to_bigger_better = -1.0  # smaller is better by default
+    # converted prediction pre-fetched by ``batched_eval`` (device eval
+    # path); ``_convert`` consumes it instead of re-converting
+    _pred_cache: Optional[np.ndarray] = None
 
     def __init__(self, config: Config):
         self.config = config
@@ -58,6 +106,8 @@ class Metric:
 
     # helper: converted predictions
     def _convert(self, score, objective):
+        if self._pred_cache is not None:
+            return self._pred_cache
         if objective is None:
             return score
         import jax.numpy as jnp
@@ -288,7 +338,9 @@ class CrossEntropyLambdaMetric(Metric):
 
     def eval(self, score, objective):
         score = np.asarray(score, np.float64).ravel()
-        if objective is not None:
+        if self._pred_cache is not None:
+            hhat = np.asarray(self._pred_cache, np.float64).ravel()
+        elif objective is not None:
             import jax.numpy as jnp
             hhat = np.asarray(objective.convert_output(jnp.asarray(score)),
                               np.float64)

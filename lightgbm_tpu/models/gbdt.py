@@ -37,19 +37,91 @@ from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
 kEpsilon = 1e-15
 
 
-def _fused_iter_block(mat, ws, score, lr, it0, *, learner, grad_fn,
-                      bag_fn, m, k):
+# ----------------------------------------------------------------------
+# Module-jitted score updaters: one device program per update instead of
+# the eager gather + scatter-add pair (each eager jnp op is its own
+# dispatch; over a tunnel every dispatch costs ~10-25 ms). The score
+# buffer is donated — boosting only ever moves forward, so the previous
+# iteration's buffer is dead the moment the update launches.
+@functools.partial(jax.jit, static_argnames=("tid",),
+                   donate_argnums=(0,))
+def _score_add_leaf(score, leaf_vals, leaf_id, *, tid: int):
+    return score.at[:, tid].add(leaf_vals[leaf_id])
+
+
+@functools.partial(jax.jit, static_argnames=("tid",),
+                   donate_argnums=(0,))
+def _score_add_col(score, add, *, tid: int):
+    return score.at[:, tid].add(add)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nl", "tid", "l1", "l2", "mds"),
+                   donate_argnums=(0,))
+def _refit_tree(score, lp, grad, hess, old_leaf, shrink, decay, *,
+                nl: int, tid: int, l1: float, l2: float, mds: float):
+    """One refit replay step on device: per-leaf grad/hess sums over
+    the fixed leaf assignment ``lp``, the regularized leaf output, the
+    decayed leaf values, and the score update — one program, score
+    donated. Returns (score, raw refit output [nl]); the host combines
+    the raw output with the f64 leaf values for model export."""
+    from ..ops.split import leaf_output_no_constraint
+    sum_g = jnp.zeros((nl,), jnp.float32).at[lp].add(grad)
+    sum_h = jnp.zeros((nl,), jnp.float32).at[lp].add(hess) + kEpsilon
+    out = leaf_output_no_constraint(sum_g, sum_h, l1, l2, mds)
+    new_leaf = decay * old_leaf + (1.0 - decay) * out * shrink
+    return score.at[:, tid].add(new_leaf[lp]), out
+
+
+# ----------------------------------------------------------------------
+# Device bagging (gbdt.cpp:163-243 BaggingHelper, re-keyed): the mask
+# is a pure function of (bagging_seed, iteration), drawn with
+# jax.random instead of the host MT19937, so sampling adds ZERO
+# host->device transfers per iteration and the same stream is
+# reproducible from a traced iteration index inside the fused scan.
+def _bag_mask_core(key0, it, label, *, freq: int, n: int, frac: float,
+                   pos_frac: float, neg_frac: float):
+    """Per-row bagging weights for iteration ``it`` (traced or not).
+
+    ``it`` is collapsed to its bagging_freq boundary, so iterations
+    inside one bagging period share the draw exactly like the cached
+    host mask did. ``label`` is the device label vector for balanced
+    (pos/neg) bagging, else None."""
+    it_eff = it - it % jnp.int32(max(freq, 1))
+    key = jax.random.fold_in(key0, it_eff)
+    if label is None:
+        u = jax.random.uniform(key, (n,))
+        return (u < jnp.float32(frac)).astype(jnp.float32)
+    u = jax.random.uniform(key, label.shape)
+    thr = jnp.where(label > 0, jnp.float32(pos_frac),
+                    jnp.float32(neg_frac))
+    return (u < thr).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("freq", "n", "frac",
+                                             "pos_frac", "neg_frac"))
+def _bag_mask_jit(key0, it, label=None, *, freq, n, frac, pos_frac,
+                  neg_frac):
+    return _bag_mask_core(key0, it, label, freq=freq, n=n, frac=frac,
+                          pos_frac=pos_frac, neg_frac=neg_frac)
+
+
+def _fused_iter_block(mat, ws, score, vscores, lr, it0, *, learner,
+                      grad_fn, bag_fn, valid_data, m, k):
     """``m`` boosting iterations as one device program (lax.scan over
     gradients -> [sampling] -> grow -> score update; ``k`` trees per
     iteration for multiclass; ``bag_fn(it, grad, hess)`` supplies
-    device-computed row weights — GOSS — or None for no sampling).
+    device-computed row weights — bagging/GOSS — or None for no
+    sampling). ``vscores``/``valid_data`` carry the valid-set scores
+    through the scan: each tree is traversed on device against every
+    valid set's binned matrix, so eval-bearing configs fuse too.
     NOT module-jitted: the learner and grad_fn capture device state
     (training matrix layout, objective label arrays), so each booster
     wraps this in its OWN jax.jit (``GBDT._train_fused_blocks``) — the
     compiled-program cache then dies with the booster instead of
     pinning its device buffers in a process-lifetime module cache."""
     def body(carry, it):
-        mat, ws, score = carry
+        mat, ws, score, vscores = carry
         grad, hess = grad_fn(score if k > 1 else score[:, 0])
         if k == 1:
             grad = grad[:, None]
@@ -66,15 +138,20 @@ def _fused_iter_block(mat, ws, score, lr, it0, *, learner, grad_fn,
             # permutation of [0, N), pos_leaf the leaf per POSITION
             score = score.at[row_ids, tid].add(
                 (tree.leaf_value * scale)[pos_leaf])
+            vscores = tuple(
+                vs.at[:, tid].add(traverse_tree_arrays(
+                    tree, vb, learner.meta, scale, vmv))
+                for vs, (vb, vmv) in zip(vscores, valid_data))
             trees_k.append(tree)
             ok = ok_t if ok is None else (ok | ok_t)
         trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_k)
-        return (mat, ws, score), (trees, ok)
+        return (mat, ws, score, vscores), (trees, ok)
 
-    (mat, ws, score), (trees, oks) = jax.lax.scan(
-        body, (mat, ws, score), it0 + jnp.arange(m, dtype=jnp.int32))
+    (mat, ws, score, vscores), (trees, oks) = jax.lax.scan(
+        body, (mat, ws, score, vscores),
+        it0 + jnp.arange(m, dtype=jnp.int32))
     # trees: TreeArrays stacked [m, k, ...]
-    return mat, ws, score, trees, oks
+    return mat, ws, score, vscores, trees, oks
 
 
 class GBDT:
@@ -113,6 +190,10 @@ class GBDT:
         tel = get_telemetry()
         tel.ensure_started(cfg)
         tel.count("train.rows", train_data.num_data)
+        # persistent compile cache (opt-in): wire BEFORE the first
+        # compile so a warmed cache covers learner construction too
+        from ..utils.compile_cache import maybe_enable_compile_cache
+        maybe_enable_compile_cache(cfg)
         from ..parallel import create_tree_learner
         self.learner = create_tree_learner(
             cfg.tree_learner, train_data, cfg, hist_method=hist_method)
@@ -148,6 +229,8 @@ class GBDT:
             for m in self.training_metrics:
                 m.init(train_data.metadata, self.num_data)
         self._bag_rng = np.random.RandomState(cfg.bagging_seed)
+        self._bag_key = jax.random.PRNGKey(cfg.bagging_seed)
+        self._bag_label = None  # device label, built lazily (balanced)
         self.bag_weight: Optional[jnp.ndarray] = None
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
 
@@ -172,17 +255,86 @@ class GBDT:
         self.valid_scores.append(jnp.asarray(score0, jnp.float32))
 
     # ------------------------------------------------------------------
-    # Bagging (gbdt.cpp:163-243): TPU-style = weight mask, not subset copy
+    # Bagging (gbdt.cpp:163-243): TPU-style = weight mask, not subset
+    # copy. Default path is DEVICE-RESIDENT: the mask is a jitted
+    # jax.random draw keyed by (bagging_seed, iteration) — no host mask
+    # materialization/upload per iteration, and the identical stream is
+    # reproducible inside the fused scan (``_traceable_bag_fn``).
+    # ``LGBM_TPU_HOST_BAG=1`` restores the host-MT19937 path (parity/
+    # attribution kill switch).
+    def _bagging_need(self) -> bool:
+        cfg = self.config
+        return cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0
+            or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+
+    @staticmethod
+    def _device_bagging() -> bool:
+        return os.environ.get("LGBM_TPU_HOST_BAG", "") != "1"
+
+    def _bag_balanced_label(self) -> jnp.ndarray:
+        if self._bag_label is None:
+            self._bag_label = jnp.asarray(
+                np.asarray(self.train_data.metadata.label), jnp.float32)
+        return self._bag_label
+
     def _bagging_weight(self, it: int, grad=None,
                         hess=None) -> Optional[jnp.ndarray]:
         """grad/hess [N, K] are passed for gradient-based sampling (GOSS)."""
         cfg = self.config
-        need = cfg.bagging_freq > 0 and (
-            cfg.bagging_fraction < 1.0
-            or cfg.pos_bagging_fraction < 1.0
-            or cfg.neg_bagging_fraction < 1.0)
-        if not need:
+        if not self._bagging_need():
             return None
+        if not self._device_bagging():
+            return self._bagging_weight_host(it)
+        if it % cfg.bagging_freq != 0 and self.bag_weight is not None:
+            return self.bag_weight
+        balanced = cfg.pos_bagging_fraction < 1.0 \
+            or cfg.neg_bagging_fraction < 1.0
+        get_telemetry().count_iter("host.dispatches")
+        self.bag_weight = _bag_mask_jit(
+            self._bag_key, jnp.int32(it),
+            self._bag_balanced_label() if balanced else None,
+            freq=int(cfg.bagging_freq), n=self.num_data,
+            frac=float(cfg.bagging_fraction),
+            pos_frac=float(cfg.pos_bagging_fraction),
+            neg_frac=float(cfg.neg_bagging_fraction))
+        return self.bag_weight
+
+    def _grad_hess_bag(self, score, it: int):
+        """Gradients (+ the bagging mask when the base-class device
+        draw is active) in ONE jitted program — the mask costs no
+        extra dispatch. Returns ``(grad, hess, bag-or-None)``; a None
+        bag means the caller must ask ``_bagging_weight`` (GOSS's
+        gradient-dependent draw, host bagging, no sampling)."""
+        tel = get_telemetry()
+        combined = (self._bagging_need() and self._device_bagging()
+                    and type(self)._bagging_weight
+                    is GBDT._bagging_weight
+                    and getattr(self.objective, "jittable", True))
+        if not combined:
+            tel.count_iter("host.dispatches")
+            grad, hess = self._grad_fn(score)
+            return grad, hess, None
+        fn = getattr(self, "_grad_bag_jit", None)
+        if fn is None:
+            bag_core = self._traceable_bag_fn()
+            grad_fn = self._grad_fn
+
+            def _fused(s, i):
+                g, h = grad_fn(s)
+                return g, h, bag_core(i, g, h)
+
+            fn = jax.jit(_fused)
+            self._grad_bag_jit = fn
+        tel.count_iter("host.dispatches")
+        grad, hess, bag = fn(score, jnp.int32(it))
+        self.bag_weight = bag
+        return grad, hess, bag
+
+    def _bagging_weight_host(self, it: int) -> Optional[jnp.ndarray]:
+        """Legacy host-RNG mask (pre device-resident path)."""
+        cfg = self.config
         if it % cfg.bagging_freq != 0 and self.bag_weight is not None:
             return self.bag_weight
         n = self.num_data
@@ -242,12 +394,13 @@ class GBDT:
         tel = get_telemetry()
         init_scores = [0.0] * k
         with tel.span("grad", phase=True):
+            bag = None
             if gradients is None or hessians is None:
                 for tid in range(k):
                     init_scores[tid] = self.boost_from_average(tid)
                 score = self.train_score if k > 1 \
                     else self.train_score[:, 0]
-                grad, hess = self._grad_fn(score)
+                grad, hess, bag = self._grad_hess_bag(score, self.iter)
                 if k == 1:
                     grad = grad[:, None]
                     hess = hess[:, None]
@@ -255,7 +408,8 @@ class GBDT:
                 grad = _coerce_custom_grad(gradients, self.num_data, k)
                 hess = _coerce_custom_grad(hessians, self.num_data, k)
 
-            bag = self._bagging_weight(self.iter, grad, hess)
+            if bag is None:
+                bag = self._bagging_weight(self.iter, grad, hess)
             fmask = self._feature_mask()
 
         should_continue = False
@@ -270,6 +424,7 @@ class GBDT:
                                                 bag_weight=bag,
                                                 feature_mask=fmask)
                 with tel.span("tree", phase=True):
+                    tel.count_iter("host.syncs")
                     tree = self.learner.to_host_tree(result)
             if tree is not None and tree.num_leaves > 1:
                 should_continue = True
@@ -323,6 +478,9 @@ class GBDT:
         if self.objective is None or not getattr(
                 self.objective, "is_renew_tree_output", False):
             return
+        # exact-reference percentile semantics need the f64 host sort;
+        # this stays a (counted) host round trip by design
+        get_telemetry().count_iter("host.syncs", 2)
         score = np.asarray(self.train_score[:, tid], np.float64)
         leaf_id = np.asarray(result.leaf_id)
         if self.bag_weight is not None:
@@ -335,16 +493,19 @@ class GBDT:
                                          np.float64)[:tree.num_leaves]
 
     def _update_scores(self, tree: Tree, result, tid: int) -> None:
-        # train: leaf_id gather (no traversal), incl. out-of-bag rows
-        leaf_vals = jnp.asarray(tree.leaf_value, jnp.float32)
-        add = leaf_vals[result.leaf_id]
-        self.train_score = self.train_score.at[:, tid].add(add)
-        # valid: jitted bin-space traversal on device
+        tel = get_telemetry()
+        # train: leaf_id gather (no traversal), incl. out-of-bag rows —
+        # ONE jitted donated program (gather + scatter fused)
+        tel.count_iter("host.dispatches")
+        self.train_score = _score_add_leaf(
+            self.train_score, jnp.asarray(tree.leaf_value, jnp.float32),
+            result.leaf_id, tid=tid)
+        # valid: jitted bin-space traversal + add, ONE program each
         for i, vd in enumerate(self.valid_sets):
-            vadd = tree.predict_binned_device(vd.binned_device,
-                                              vd.mv_slots_device)
-            self.valid_scores[i] = \
-                self.valid_scores[i].at[:, tid].add(vadd)
+            tel.count_iter("host.dispatches")
+            self.valid_scores[i] = tree.predict_binned_add(
+                self.valid_scores[i], tid, vd.binned_device,
+                vd.mv_slots_device)
 
     # ------------------------------------------------------------------
     def init_from_models(self, models: List, train_add=None,
@@ -375,10 +536,16 @@ class GBDT:
         replay — per iteration, gradients at the current score, per-leaf
         sums, ``decay*old + (1-decay)*new_output*shrinkage``.
 
+        Device-resident replay: gradients, per-leaf sums and score
+        updates stay on device (one jitted program per tree, score
+        buffer donated through the chain); the only device->host
+        traffic is ONE batched fetch of the refit outputs at the end,
+        applied to the host ``leaf_value`` arrays in f64. The legacy
+        path fetched the full [N, K] gradients every iteration.
+
         ``leaf_preds`` [num_data, num_models] — each row's leaf index in
         every existing tree (from ``predict(..., pred_leaf=True)``).
         """
-        from ..ops.split import leaf_output_no_constraint
         self.finalize_trees()
         k = self.num_tree_per_iteration
         cfg = self.config
@@ -391,14 +558,14 @@ class GBDT:
                       f"match (num_data={self.num_data}, "
                       f"num_models={len(self.models)})")
         n_iters = len(self.models) // k
+        lp_dev = jnp.asarray(leaf_preds.astype(np.int32))
         # sequential replay starts from the init score (the reference's
         # merged booster has an untouched score updater)
         self.train_score = jnp.zeros_like(self.train_score)
+        pending = []  # (tree, device refit output)
         for it in range(n_iters):
             sc = self.train_score if k > 1 else self.train_score[:, 0]
             grad, hess = self._grad_fn(sc)
-            grad = np.asarray(grad)
-            hess = np.asarray(hess)
             if grad.ndim == 1:
                 grad = grad[:, None]
                 hess = hess[:, None]
@@ -408,21 +575,23 @@ class GBDT:
                 if hasattr(tree, "materialize"):
                     tree = tree.materialize()
                     self.models[mi] = tree
-                lp = leaf_preds[:, mi].astype(np.int64)
                 nl = max(tree.num_leaves, 1)
-                sum_g = np.bincount(lp, weights=grad[:, tid],
-                                    minlength=nl)[:nl]
-                sum_h = np.bincount(lp, weights=hess[:, tid],
-                                    minlength=nl)[:nl] + kEpsilon
-                out = np.asarray(leaf_output_no_constraint(
-                    jnp.asarray(sum_g), jnp.asarray(sum_h),
-                    cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step))
-                new_out = out * tree.shrinkage
-                tree.leaf_value = (decay * tree.leaf_value
-                                   + (1.0 - decay) * new_out)
-                add = jnp.asarray(tree.leaf_value, jnp.float32)[
-                    jnp.asarray(lp)]
-                self.train_score = self.train_score.at[:, tid].add(add)
+                self.train_score, out = _refit_tree(
+                    self.train_score, lp_dev[:, mi], grad[:, tid],
+                    hess[:, tid],
+                    jnp.asarray(tree.leaf_value, jnp.float32),
+                    jnp.float32(tree.shrinkage), jnp.float32(decay),
+                    nl=nl, tid=tid, l1=float(cfg.lambda_l1),
+                    l2=float(cfg.lambda_l2),
+                    mds=float(cfg.max_delta_step))
+                pending.append((tree, out))
+        get_telemetry().count("host.syncs")
+        outs = jax.device_get([o for _, o in pending])  # ONE fetch
+        for (tree, _), out in zip(pending, outs):
+            tree.leaf_value = (decay * tree.leaf_value
+                               + (1.0 - decay)
+                               * np.asarray(out, np.float64)
+                               * tree.shrinkage)
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
@@ -448,20 +617,44 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_metrics(self) -> List[Tuple[str, str, float, bool]]:
-        """All (dataset_name, metric_name, value, bigger_better) tuples."""
-        out = []
-        for m in self.training_metrics:
-            vals = m.eval(np.asarray(self._metric_score(self.train_score)),
-                          self.objective)
-            for name, v in zip(m.names, vals):
-                out.append(("training", name, v,
-                            m.factor_to_bigger_better > 0))
+        """All (dataset_name, metric_name, value, bigger_better) tuples.
+
+        Device-resident path (default): raw scores are converted on
+        device and every dataset's (score, pred) pair is pulled in ONE
+        batched ``device_get`` — the legacy path fetched the score and
+        round-tripped a conversion per metric per dataset. Host-side
+        f64 reductions are unchanged, so values are bit-identical
+        (LGBM_TPU_DEVICE_EVAL=0 restores the legacy path)."""
+        from ..metric.metrics import batched_eval, device_eval_enabled
+        tel = get_telemetry()
+        jobs = []
+        if self.training_metrics:
+            jobs.append((self.training_metrics,
+                         self._metric_score(self.train_score),
+                         "training"))
         for i, metrics in enumerate(self.valid_metrics):
-            sc = self._metric_score(self.valid_scores[i])
+            if metrics:
+                jobs.append((metrics,
+                             self._metric_score(self.valid_scores[i]),
+                             self.valid_names[i]))
+        if not jobs:
+            return []
+        if device_eval_enabled():
+            tel.count_iter("host.syncs")
+            tel.count_iter("host.dispatches", len(jobs))
+            return [row for rows in batched_eval(jobs, self.objective)
+                    for row in rows]
+        out = []
+        for metrics, sc, name in jobs:
+            sc_h = np.asarray(sc)
+            # legacy accounting: score fetch + per-metric convert
+            # round trip (upload + convert dispatch + result fetch)
+            tel.count_iter("host.syncs", 1 + len(metrics))
+            tel.count_iter("host.dispatches", 2 * len(metrics))
             for m in metrics:
-                vals = m.eval(np.asarray(sc), self.objective)
-                for name, v in zip(m.names, vals):
-                    out.append((self.valid_names[i], name, v,
+                vals = m.eval(sc_h, self.objective)
+                for name_, v in zip(m.names, vals):
+                    out.append((name, name_, v,
                                 m.factor_to_bigger_better > 0))
         return out
 
@@ -529,11 +722,12 @@ class GBDT:
         tel = get_telemetry()
         with tel.span("grad", phase=True):
             score = self.train_score if k > 1 else self.train_score[:, 0]
-            grad, hess = self._grad_fn(score)
+            grad, hess, bag = self._grad_hess_bag(score, self.iter)
             if k == 1:
                 grad = grad[:, None]
                 hess = hess[:, None]
-            bag = self._bagging_weight(self.iter, grad, hess)
+            if bag is None:
+                bag = self._bagging_weight(self.iter, grad, hess)
             fmask = self._feature_mask()
         flag = None
         for tid in range(k):
@@ -547,6 +741,8 @@ class GBDT:
                 scale = jnp.where(ok, jnp.float32(self.shrinkage_rate),
                                   jnp.float32(0.0))
                 leaf_vals = ta.leaf_value * scale
+                tel.count_iter("host.dispatches",
+                               1 + len(self.valid_sets))
                 self.train_score = self.train_score.at[:, tid].add(
                     leaf_vals[result.leaf_id])
                 for i, vd in enumerate(self.valid_sets):
@@ -599,8 +795,37 @@ class GBDT:
     def _traceable_bag_fn(self):
         """Device-traceable per-iteration sampling hook for the fused
         path: a function ``(it, grad, hess) -> [N] weights`` or None.
-        Base GBDT has no device sampling; GOSS overrides."""
-        return None
+        Base GBDT returns the device bagging draw (the SAME stream as
+        ``_bagging_weight`` for equal ``it``) when bagging is
+        configured and device-resident; GOSS overrides."""
+        cfg = self.config
+        if not self._bagging_need() or not self._device_bagging():
+            return None
+        balanced = cfg.pos_bagging_fraction < 1.0 \
+            or cfg.neg_bagging_fraction < 1.0
+        label = self._bag_balanced_label() if balanced else None
+        key0 = self._bag_key
+        freq = int(cfg.bagging_freq)
+        n = self.num_data
+        frac = float(cfg.bagging_fraction)
+        pos_frac = float(cfg.pos_bagging_fraction)
+        neg_frac = float(cfg.neg_bagging_fraction)
+
+        def bag_fn(it, grad, hess):
+            return _bag_mask_core(key0, it, label, freq=freq, n=n,
+                                  frac=frac, pos_frac=pos_frac,
+                                  neg_frac=neg_frac)
+
+        return bag_fn
+
+    def _sampling_traceable(self) -> bool:
+        """True when the per-iteration row sampling (if any) can run
+        inside a scanned device program: either no sampling at all, or
+        a device-traceable bag fn covering the configured sampling."""
+        custom = type(self)._bagging_weight is not GBDT._bagging_weight
+        if not self._bagging_need() and not custom:
+            return True
+        return self._traceable_bag_fn() is not None
 
     def _fused_scan_supported(self) -> bool:
         ln = getattr(self, "learner", None)
@@ -609,51 +834,81 @@ class GBDT:
         on_device = jax.default_backend() in ("tpu", "axon") \
             or os.environ.get("LGBM_TPU_FUSE_ITERS") == "1"
         return (on_device
-                and not self.valid_sets
+                # valid sets ride the scan carry (score traversal per
+                # tree); the mesh learners keep the no-valid gate —
+                # their replicated tree output meeting an unsharded
+                # valid matrix inside one program is unvalidated
+                and (not self.valid_sets
+                     or getattr(ln, "num_shards", 1) == 1)
                 # non-jittable objectives (rank_xendcg) draw host
                 # randomness per gradient call; inside a scan trace
                 # that draw would be frozen into the compiled program
                 and getattr(self.objective, "jittable", True)
-                # subclasses with their own sampling go through the
-                # per-iteration path unless it is device-traceable
-                # (GOSS); RF/host-RNG bagging stay excluded
-                and (type(self)._bagging_weight is GBDT._bagging_weight
-                     or self._traceable_bag_fn() is not None)
+                # sampling must be device-traceable (device bagging,
+                # GOSS); host-RNG bagging (LGBM_TPU_HOST_BAG) stays on
+                # the per-iteration path
+                and self._sampling_traceable()
                 and type(self)._feature_mask is GBDT._feature_mask
+                and self.config.feature_fraction >= 1.0
                 and getattr(ln, "supports_fused_scan", False)
                 and ln.fused_scan_ok())
 
-    def _train_fused_blocks(self, iters: int) -> None:
+    def _eval_cadence(self) -> int:
+        """Iterations between eval boundaries when eval rides the fused
+        path: the metric output frequency (>= 1). The per-iteration
+        paths evaluate every iteration; fusing trades that granularity
+        for dispatch elimination, which is exactly what metric_freq
+        asks for."""
+        return max(1, int(self.config.metric_freq))
+
+    def _train_fused_blocks(self, iters: int,
+                            eval_every: Optional[int] = None) -> bool:
         """Run [self.iter, iters) in <=_FUSED_BLOCK-iteration scanned
         blocks, one device dispatch per block. Over-run iterations
         after a no-split stop are zero-contribution no-ops, truncated
-        exactly like the async flush path."""
+        exactly like the async flush path. ``eval_every`` caps blocks
+        at the eval cadence and runs metric eval at each boundary
+        (valid scores advance INSIDE the scan). Returns True when
+        training stopped early (no-split)."""
         ln = self.learner
         lr = jnp.float32(self.shrinkage_rate)
         k = self.num_tree_per_iteration
         fused = getattr(self, "_fused_jit", None)
         if fused is None:
+            valid_data = tuple((vd.binned_device, vd.mv_slots_device)
+                               for vd in self.valid_sets)
             fused = jax.jit(
                 functools.partial(_fused_iter_block, learner=ln,
                                   grad_fn=self._grad_fn,
-                                  bag_fn=self._traceable_bag_fn(), k=k),
-                static_argnames=("m",), donate_argnums=(0, 1, 2))
+                                  bag_fn=self._traceable_bag_fn(),
+                                  valid_data=valid_data, k=k),
+                static_argnames=("m",), donate_argnums=(0, 1, 2, 3))
             self._fused_jit = fused
         while self.iter < iters:
             # largest power-of-2 block <= remaining (capped): the set of
             # compiled scan lengths stays O(log) regardless of how the
             # caller slices its train() calls, so a warmed persistent
-            # cache covers every phase of a run
-            remaining = iters - self.iter
+            # cache covers every phase of a run. An eval cadence caps
+            # the block at the next boundary instead of disabling
+            # fusion outright.
+            limit = iters - self.iter
+            if eval_every is not None:
+                to_boundary = eval_every - (self.iter % eval_every)
+                limit = min(limit, to_boundary)
             m = self._FUSED_BLOCK
-            while m > remaining:
+            while m > limit:
                 m //= 2
+            m = max(m, 1)
             tel = get_telemetry()
             t_blk = time.perf_counter()
             with tel.span("boosting", trace="boost_block"):
-                ln.mat, ln.ws, self.train_score, trees, oks = fused(
-                    ln.mat, ln.ws, self.train_score, lr,
-                    jnp.int32(self.iter), m=m)
+                tel.count_iter("host.dispatches")
+                tel.count("fused.block_hits")
+                vs = tuple(self.valid_scores)
+                (ln.mat, ln.ws, self.train_score, vs, trees,
+                 oks) = fused(ln.mat, ln.ws, self.train_score, vs, lr,
+                              jnp.int32(self.iter), m=m)
+                self.valid_scores = list(vs)
             stack = TreeStack(trees)      # TreeArrays [m, k, ...]
             for j in range(m):
                 for tid in range(k):
@@ -662,6 +917,7 @@ class GBDT:
                         shrinkage=self.shrinkage_rate))
             self.iter += m
             with tel.span("device_sync"):
+                tel.count_iter("host.syncs")
                 flags = [bool(v) for v in np.asarray(oks)]
             if tel.enabled:
                 # the stop-flag fetch above is the block's real device
@@ -679,7 +935,15 @@ class GBDT:
                 log_warning(
                     "Stopped training because there are no more "
                     "leaves that meet the split requirements")
-                return
+                return True
+            if eval_every is not None \
+                    and (self.iter % eval_every == 0
+                         or self.iter >= iters):
+                with tel.span("eval", trace="eval"):
+                    # early stopping is gated off on this path
+                    # (_train_impl), so output_metric only records
+                    self.output_metric(self.iter)
+        return False
 
     def train(self, num_iterations: Optional[int] = None) -> None:
         """Full training loop (GBDT::Train, gbdt.cpp:245-264).
@@ -739,20 +1003,27 @@ class GBDT:
             or any(len(m) > 0 for m in self.valid_metrics)
         # batching the stop-flag check is only sound when a no-split
         # iteration reproduces identically on the next iteration; host
-        # RNG that advances per call (bagging mask, feature sampling)
-        # breaks that, so flush every iteration there
+        # RNG that advances per call (host bagging mask, feature
+        # sampling) breaks that, so flush every iteration there.
+        # Device bagging is a pure function of the iteration index and
+        # does NOT count as host RNG.
         cfg = self.config
         host_rng_per_iter = (
-            cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
-                                      or cfg.pos_bagging_fraction < 1.0
-                                      or cfg.neg_bagging_fraction < 1.0)
+            self._bagging_need() and not self._device_bagging()
         ) or cfg.feature_fraction < 1.0 or cfg.extra_trees \
             or cfg.feature_fraction_bynode < 1.0
         flush_every = 1 if (has_eval or host_rng_per_iter) \
             else self._ASYNC_FLUSH
         tel = get_telemetry()
-        if use_async and not has_eval and not host_rng_per_iter \
-                and self._fused_scan_supported():
+        # eval rides the fused path at the metric_freq cadence; early
+        # stopping needs its per-iteration best tracking + score
+        # rollback, so it pins the per-iteration path (an overridden
+        # early-stop hook — DART — is already excluded by
+        # _async_supported)
+        fuse_ok = use_async and not host_rng_per_iter \
+            and self._fused_scan_supported() \
+            and (not has_eval or cfg.early_stopping_round <= 0)
+        if fuse_ok:
             if not self.models and self.iter < iters:
                 # boost-from-average + constant-tree fallback need the
                 # sync first iteration, exactly like the async path
@@ -760,7 +1031,12 @@ class GBDT:
                     if self.train_one_iter():
                         self.finalize_trees()
                         return
-            self._train_fused_blocks(iters)
+                if has_eval:
+                    with tel.span("eval", trace="eval"):
+                        self.output_metric(self.iter)
+            self._train_fused_blocks(
+                iters, eval_every=self._eval_cadence()
+                if has_eval else None)
             self.finalize_trees()
             return
         pending: List = []
@@ -771,6 +1047,7 @@ class GBDT:
                     pending.append(self._train_one_iter_async())
                 if len(pending) >= flush_every or it == iters - 1:
                     with tel.span("device_sync"):
+                        tel.count_iter("host.syncs")
                         flags = [bool(v) for v in jax.device_get(pending)]
                     pending.clear()
                     if not all(flags):

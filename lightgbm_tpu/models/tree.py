@@ -288,6 +288,14 @@ class Tree:
             raise ValueError(
                 "tree splits on multi-val pseudo-groups; bin-space "
                 "prediction needs the dataset's mv_slots matrix")
+        return _traverse_binned_jax(
+            binned_dev, *self._padded_traversal_args(),
+            mv_slots=mv_slots_dev,
+            mv_present=mv_slots_dev is not None)
+
+    def _padded_traversal_args(self):
+        """Node arrays padded to a power of two (shared compilations
+        across trees of similar size) for the jitted traversals."""
         s = len(self.split_feature_inner)
         cap = 1
         while cap < s:
@@ -299,20 +307,34 @@ class Tree:
 
         leaf_vals = np.zeros(cap + 1, np.float32)
         leaf_vals[:self.num_leaves] = self.leaf_value
-        return _traverse_binned_jax(
-            binned_dev,
-            jnp.asarray(pad(self._col)),
-            jnp.asarray(pad(self._offset)),
-            jnp.asarray(pad(self.threshold_bin)),
-            jnp.asarray(pad(self.decision_type)),
-            jnp.asarray(pad(self.left_child, fill=-1)),
-            jnp.asarray(pad(self.right_child, fill=-1)),
-            jnp.asarray(pad(self._missing_code)),
-            jnp.asarray(pad(self._default_bin)),
-            jnp.asarray(pad(self._num_bin)),
-            jnp.asarray(pad(self.cat_bitsets)),
-            jnp.asarray(leaf_vals),
-            mv_slots=mv_slots_dev,
+        return (jnp.asarray(pad(self._col)),
+                jnp.asarray(pad(self._offset)),
+                jnp.asarray(pad(self.threshold_bin)),
+                jnp.asarray(pad(self.decision_type)),
+                jnp.asarray(pad(self.left_child, fill=-1)),
+                jnp.asarray(pad(self.right_child, fill=-1)),
+                jnp.asarray(pad(self._missing_code)),
+                jnp.asarray(pad(self._default_bin)),
+                jnp.asarray(pad(self._num_bin)),
+                jnp.asarray(pad(self.cat_bitsets)),
+                jnp.asarray(leaf_vals))
+
+    def predict_binned_add(self, score, tid: int, binned_dev,
+                           mv_slots_dev=None):
+        """``score[:, tid] += predict_binned_device(...)`` as ONE
+        jitted donated program (bit-identical to the two-dispatch
+        form; see _traverse_binned_add_jax)."""
+        if self.num_leaves <= 1:
+            return score.at[:, tid].add(
+                jnp.float32(self.leaf_value[0]))
+        if mv_slots_dev is None \
+                and (self._col >= binned_dev.shape[1]).any():
+            raise ValueError(
+                "tree splits on multi-val pseudo-groups; bin-space "
+                "prediction needs the dataset's mv_slots matrix")
+        return _traverse_binned_add_jax(
+            score, binned_dev, *self._padded_traversal_args(),
+            mv_slots=mv_slots_dev, tid=tid,
             mv_present=mv_slots_dev is not None)
 
     def leaf_depth_of(self, leaf: int) -> int:
@@ -341,10 +363,10 @@ class Tree:
         return max(self.num_leaves - 1, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("mv_present",))
-def _traverse_binned_jax(binned, col, offset, thr, dec, left, right, miss,
-                         default_bin, num_bin, cat_bitsets, leaf_vals,
-                         mv_slots=None, mv_present: bool = False):
+def _traverse_binned_core(binned, col, offset, thr, dec, left, right,
+                          miss, default_bin, num_bin, cat_bitsets,
+                          leaf_vals, mv_slots=None,
+                          mv_present: bool = False):
     """Vectorized bin-space tree walk (NumericalDecision semantics of
     predict_leaf_index_binned, in one lax.while_loop). ``col``/``offset``
     are the EFB physical column + value offset per node (offset 0 =
@@ -391,6 +413,29 @@ def _traverse_binned_jax(binned, col, offset, thr, dec, left, right, miss,
     done0 = jnp.zeros(n, bool)
     _, out, _ = jax.lax.while_loop(cond, body, (node0, out0, done0))
     return leaf_vals[out]
+
+
+_traverse_binned_jax = functools.partial(jax.jit,
+                                         static_argnames=("mv_present",))(
+    _traverse_binned_core)
+
+
+@functools.partial(jax.jit, static_argnames=("tid", "mv_present"),
+                   donate_argnums=(0,))
+def _traverse_binned_add_jax(score, binned, col, offset, thr, dec, left,
+                             right, miss, default_bin, num_bin,
+                             cat_bitsets, leaf_vals, mv_slots=None, *,
+                             tid: int, mv_present: bool = False):
+    """Traversal + score-column add as ONE device program (the
+    per-iteration valid-score update used to be two dispatches:
+    traverse, then an eager scatter-add). Pure gather+add — no
+    multiply for XLA to contract — so the result is bit-identical to
+    the two-dispatch form."""
+    add = _traverse_binned_core(binned, col, offset, thr, dec, left,
+                                right, miss, default_bin, num_bin,
+                                cat_bitsets, leaf_vals, mv_slots,
+                                mv_present=mv_present)
+    return score.at[:, tid].add(add)
 
 
 class DeferredTree:

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.log import log_fatal, log_info
-from .gbdt import GBDT, _constant_tree, kEpsilon
+from .gbdt import GBDT, _constant_tree, _score_add_col, kEpsilon
 from .tree import Tree
 
 
@@ -108,14 +108,17 @@ class DART(GBDT):
     # -- score arithmetic over all datasets ----------------------------
     def _add_tree_score(self, tree: Tree, tid: int, train: bool,
                         valid: bool) -> None:
+        # jitted donated column adds (models/gbdt.py): one program per
+        # update instead of an eager dispatch pair
         if train:
             tadd = tree.predict_binned_device(self.train_data.binned_device)
-            self.train_score = self.train_score.at[:, tid].add(tadd)
+            self.train_score = _score_add_col(self.train_score, tadd,
+                                              tid=tid)
         if valid:
             for i, vd in enumerate(self.valid_sets):
                 vadd = tree.predict_binned_device(vd.binned_device)
-                self.valid_scores[i] = \
-                    self.valid_scores[i].at[:, tid].add(vadd)
+                self.valid_scores[i] = _score_add_col(
+                    self.valid_scores[i], vadd, tid=tid)
 
     def _dropping_trees(self) -> None:
         """DroppingTrees (dart.hpp:100-146)."""

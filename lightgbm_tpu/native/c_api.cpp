@@ -13,6 +13,7 @@
  */
 #include <Python.h>
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -27,8 +28,10 @@ namespace {
 // is reading
 thread_local std::string g_last_error = "Everything is fine";
 
-PyObject* g_impl = nullptr;          // lightgbm_tpu.capi_impl module
-std::mutex g_init_mutex;             // guards first-call bootstrap
+// lightgbm_tpu.capi_impl module; written once (under the GIL), read
+// lock-free on the fast path — atomic so the unlocked read is sound
+std::atomic<PyObject*> g_impl{nullptr};
+std::mutex g_init_mutex;             // guards interpreter bootstrap only
 
 void set_error_from_python() {
   PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
@@ -61,38 +64,50 @@ void set_error_from_python() {
 #endif
 
 // one-time interpreter bootstrap; returns false (with error set) when
-// Python or the package cannot be loaded. The mutex keeps two threads'
-// FIRST calls from double-initializing the interpreter.
+// Python or the package cannot be loaded.
+//
+// Lock order matters: holding g_init_mutex ACROSS PyGILState_Ensure
+// deadlocks when another thread already owns the GIL and calls in here
+// (GIL-holder waits on the mutex, mutex-holder waits on the GIL). So
+// the mutex only serializes Py_InitializeEx and is DROPPED before the
+// GIL is taken; the import is double-checked under the GIL, which is
+// itself a mutex — two first-callers race to the import, the loser
+// re-reads g_impl and skips.
 bool ensure_python() {
-  std::lock_guard<std::mutex> lock(g_init_mutex);
-  if (g_impl != nullptr) return true;
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // release the GIL acquired by initialization so ANY thread
-    // (including this one, via PyGILState_Ensure) can take it
-    PyEval_SaveThread();
-  }
+  if (g_impl.load(std::memory_order_acquire) != nullptr) return true;
+  {
+    std::lock_guard<std::mutex> lock(g_init_mutex);
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so ANY thread
+      // (including this one, via PyGILState_Ensure) can take it
+      PyEval_SaveThread();
+    }
+  }  // mutex dropped BEFORE taking the GIL
   PyGILState_STATE st = PyGILState_Ensure();
-  PyRun_SimpleString(
-      "import os, sys\n"
-      "for _p in [os.environ.get('LIGHTGBM_TPU_PYTHONPATH', ''),\n"
-      "           '" LGBM_TPU_PKG_DIR "', '" LGBM_TPU_SITE_DIR "']:\n"
-      "    if _p and _p not in sys.path:\n"
-      "        sys.path.insert(0, _p)\n");
-  PyObject* mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
-  if (mod == nullptr) {
-    set_error_from_python();
-    PyGILState_Release(st);
-    return false;
+  if (g_impl.load(std::memory_order_acquire) == nullptr) {
+    PyRun_SimpleString(
+        "import os, sys\n"
+        "for _p in [os.environ.get('LIGHTGBM_TPU_PYTHONPATH', ''),\n"
+        "           '" LGBM_TPU_PKG_DIR "', '" LGBM_TPU_SITE_DIR "']:\n"
+        "    if _p and _p not in sys.path:\n"
+        "        sys.path.insert(0, _p)\n");
+    PyObject* mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+    if (mod == nullptr) {
+      set_error_from_python();
+      PyGILState_Release(st);
+      return false;
+    }
+    g_impl.store(mod, std::memory_order_release);  // held forever
   }
-  g_impl = mod;  // hold forever (process-lifetime module)
   PyGILState_Release(st);
   return true;
 }
 
 // call impl.<fn>(*args); steals `args`; returns new ref or nullptr
 PyObject* call_impl(const char* fn, PyObject* args) {
-  PyObject* f = PyObject_GetAttrString(g_impl, fn);
+  PyObject* f = PyObject_GetAttrString(
+      g_impl.load(std::memory_order_acquire), fn);
   if (f == nullptr) {
     Py_XDECREF(args);
     set_error_from_python();
@@ -374,8 +389,18 @@ int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                 int num_feature_names) {
   API_BEGIN();
   PyObject* lst = PyList_New(num_feature_names);
+  if (lst == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
   for (int i = 0; i < num_feature_names; ++i) {
-    PyList_SetItem(lst, i, PyUnicode_FromString(feature_names[i]));
+    PyObject* s = PyUnicode_FromString(feature_names[i]);
+    if (s == nullptr) {  // e.g. invalid UTF-8 in a caller's name
+      set_error_from_python();
+      Py_DECREF(lst);  // frees the partial list (slots may be null)
+      return -1;
+    }
+    PyList_SetItem(lst, i, s);
   }
   PyObject* r = call_impl(
       "dataset_set_feature_names",

@@ -49,6 +49,14 @@ _COMPILE_EVENTS = {
     "jaxpr_to_mlir_module_duration": ("jit.lowerings", "jit.lowering_s"),
 }
 
+# plain (no-duration) jax.monitoring events worth counting: persistent
+# compilation-cache traffic, so a warmed cache is visible as hits
+# rather than inferred from a compile_s drop alone
+_PLAIN_EVENTS = {
+    "cache_hits": "jit.cache_hits",
+    "cache_misses": "jit.cache_misses",
+}
+
 
 class RingSink:
     """Bounded in-memory record buffer (the default sink)."""
@@ -222,6 +230,7 @@ class Telemetry:
         self.gauges: Dict[str, Any] = {}
         self.dists: Dict[str, list] = {}      # name -> [n, sum, min, max]
         self._iter_phases: Dict[str, float] = {}
+        self._iter_counts: Dict[str, float] = {}
         self._t0 = time.perf_counter()
         self._run_started = False
         self._listener_installed = False
@@ -309,6 +318,21 @@ class Telemetry:
             self.counters[name] = self.counters.get(name, 0.0) \
                 + float(value)
 
+    def count_iter(self, name: str, value: float = 1.0) -> None:
+        """Counter that ALSO accumulates into the current iteration's
+        ``counts`` table (flushed into the ``iter`` record by
+        ``end_iteration``, like phase spans). Used for the dispatch/
+        host-sync accounting: ``host.dispatches`` counts device-program
+        launches our training loop issues, ``host.syncs`` counts
+        blocking device->host fetches. Both are counted at the call
+        sites in models/gbdt.py and learner/*, NOT inferred — a site
+        the loop stops issuing simply stops being counted."""
+        if self._enabled:
+            v = float(value)
+            self.counters[name] = self.counters.get(name, 0.0) + v
+            self._iter_counts[name] = \
+                self._iter_counts.get(name, 0.0) + v
+
     def gauge(self, name: str, value) -> None:
         if self._enabled:
             self.gauges[name] = value
@@ -345,7 +369,11 @@ class Telemetry:
             return
         phases = {k: round(v, 6) for k, v in self._iter_phases.items()}
         self._iter_phases = {}
+        counts = {k: v for k, v in self._iter_counts.items()}
+        self._iter_counts = {}
         rec = dict(iter=int(iteration), phases=phases, **fields)
+        if counts:
+            rec["counts"] = counts
         self.last_iter = rec
         self.record("iter", **rec)
 
@@ -374,7 +402,9 @@ class Telemetry:
                 "seconds": round(self.counters.get("jit.compile_s",
                                                    0.0), 6),
                 "trace_seconds": round(self.counters.get("jit.trace_s",
-                                                         0.0), 6)}
+                                                         0.0), 6),
+                "cache_hits": int(self.counters.get("jit.cache_hits",
+                                                    0))}
 
     @property
     def records(self) -> List[Dict[str, Any]]:
@@ -476,6 +506,16 @@ def _install_compile_listener() -> None:
                            dur_s=round(duration, 6))
 
         monitoring.register_event_duration_secs_listener(_listener)
+
+        def _plain_listener(event: str, **kw) -> None:
+            tel = _TELEMETRY
+            if not tel._enabled:
+                return
+            name = _PLAIN_EVENTS.get(event.rsplit("/", 1)[-1])
+            if name is not None:
+                tel.count(name, 1)
+
+        monitoring.register_event_listener(_plain_listener)
     except Exception as e:  # pragma: no cover - jax API drift
         log_warning(f"telemetry compile hook unavailable: {e}")
 

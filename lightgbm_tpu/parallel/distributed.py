@@ -134,6 +134,17 @@ def init_distributed(config: Config,
     coordinator = f"{machines[0][0]}:{machines[0][1]}"
     log_info(f"Initializing distributed runtime: {len(machines)} "
              f"processes, coordinator {coordinator}, rank {process_id}")
+    # the default XLA:CPU client rejects multi-process computations;
+    # gloo collectives make CPU fleets (CI, laptop rehearsals of pod
+    # jobs) first-class. Best-effort: older jax has no such knob, and
+    # TPU backends ignore it.
+    try:
+        import os as _os
+        if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    except Exception:  # pragma: no cover - jax API drift
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=len(machines),

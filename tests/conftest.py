@@ -36,8 +36,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # compilation_cache.get_executable_and_time after cpu_aot_loader
 # "machine type ... doesn't match" warnings). Slower reruns beat a
 # flaky suite. The TPU bench path keeps its own cache
-# (.jax_cache_tpu) — a different backend, unaffected.
+# (.jax_cache_tpu) — a different backend, unaffected. The library's
+# own opt-in seam (LGBM_TPU_COMPILE_CACHE, utils/compile_cache.py)
+# is dropped for the same reason.
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+os.environ.pop("LGBM_TPU_COMPILE_CACHE", None)
 
 import jax  # noqa: E402
 

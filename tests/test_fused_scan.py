@@ -141,16 +141,124 @@ def test_fused_goss_matches(monkeypatch):
                                rtol=1e-5, atol=2e-6)
 
 
-def test_fused_declines_when_unsupported(monkeypatch):
-    # bagging draws host RNG per iteration -> the fused path must stay
-    # off and results still match the reference semantics of the
-    # per-iteration path (trivially: it IS the per-iteration path)
+def test_fused_bagging_engages_and_matches(monkeypatch):
+    # device bagging (ISSUE 2): the mask is a pure function of
+    # (seed, iteration), so bagging configs now QUALIFY for the fused
+    # path and must reproduce the per-iteration stream bit-exactly
+    from lightgbm_tpu.observability.telemetry import get_telemetry
+    X, y = _make(seed=5)
+    p = {"bagging_freq": 1, "bagging_fraction": 0.7}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, params=p)
+    tel = get_telemetry()
+    tel.reset()
+    tel.ensure_ring()
+    try:
+        b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, params=p)
+        hits = tel.counters.get("fused.block_hits", 0)
+    finally:
+        tel.reset()
+    assert any(isinstance(m, DeferredStackTree) for m in b1.models), \
+        "bagging config must take the fused-blocks path now"
+    assert hits > 0
+    np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
+                                  np.asarray(b1.predict_raw(X)))
+
+
+def test_fused_bagging_freq_period_matches(monkeypatch):
+    # bagging_freq > 1: the in-period mask reuse must survive the scan
+    X, y = _make(seed=15)
+    p = {"bagging_freq": 3, "bagging_fraction": 0.6}
+    b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, iters=7,
+                params=p)
+    b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, iters=7,
+                params=p)
+    np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
+                                  np.asarray(b1.predict_raw(X)))
+
+
+def test_fused_declines_host_bagging(monkeypatch):
+    # LGBM_TPU_HOST_BAG=1 restores the host MT19937 mask; host RNG
+    # inside a scan would freeze, so the fused path must decline
+    monkeypatch.setenv("LGBM_TPU_HOST_BAG", "1")
     X, y = _make(seed=5)
     p = {"bagging_freq": 1, "bagging_fraction": 0.7}
     b0 = _train(X, y, fused=False, monkeypatch=monkeypatch, params=p)
     b1 = _train(X, y, fused=True, monkeypatch=monkeypatch, params=p)
+    assert not any(isinstance(m, DeferredStackTree) for m in b1.models)
     np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
                                   np.asarray(b1.predict_raw(X)))
+
+
+def test_fused_valid_eval_matches_per_iteration(monkeypatch):
+    # valid sets now ride the scan carry; with metric_freq=1 the fused
+    # path must reproduce the per-iteration path's eval series exactly
+    from lightgbm_tpu.models.variants import create_boosting
+    X, y = _make(seed=17)
+    Xv, yv = _make(n=400, seed=18)
+
+    def run(fused):
+        monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1" if fused else "0")
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 7,
+            "learning_rate": 0.1, "tree_learner": "partitioned",
+            "verbosity": -1, "metric": "binary_logloss"})
+        ds = Dataset.from_numpy(X, cfg, label=y)
+        b = create_boosting(cfg, ds)
+        vcfg_ds = Dataset.from_numpy(Xv, cfg, label=yv, reference=ds)
+        b.add_valid(vcfg_ds, "valid_0")
+        b.train(6)
+        b.finalize_trees()
+        return b
+
+    b0, b1 = run(False), run(True)
+    assert any(isinstance(m, DeferredStackTree) for m in b1.models)
+    # the model itself is bit-identical; valid-score EVAL values may
+    # drift at the f32 LSB: inside the scan XLA contracts the
+    # leaf_value*scale traversal with the score add (FMA), where the
+    # per-iteration path runs them as separate dispatches
+    assert list(b0.evals_result) == list(b1.evals_result)
+    np.testing.assert_allclose(
+        b0.evals_result["valid_0"]["binary_logloss"],
+        b1.evals_result["valid_0"]["binary_logloss"],
+        rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
+                                  np.asarray(b1.predict_raw(X)))
+
+
+def test_fused_valid_eval_cadence(monkeypatch):
+    # metric_freq=3: eval only at block boundaries — 1/3 the eval
+    # records, same trained model
+    from lightgbm_tpu.models.variants import create_boosting
+
+    X, y = _make(seed=19)
+    Xv, yv = _make(n=300, seed=20)
+
+    def run(fused, freq):
+        monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1" if fused else "0")
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 7,
+            "tree_learner": "partitioned", "verbosity": -1,
+            "metric": "binary_logloss", "metric_freq": freq})
+        ds = Dataset.from_numpy(X, cfg, label=y)
+        b = create_boosting(cfg, ds)
+        b.add_valid(Dataset.from_numpy(Xv, cfg, label=yv,
+                                       reference=ds), "valid_0")
+        b.train(6)
+        b.finalize_trees()
+        return b
+
+    b0 = run(False, 3)
+    b1 = run(True, 3)
+    np.testing.assert_array_equal(np.asarray(b0.predict_raw(X)),
+                                  np.asarray(b1.predict_raw(X)))
+    series = b1.evals_result["valid_0"]["binary_logloss"]
+    # boundaries: the sync first iteration + iters 3 and 6
+    assert len(series) == 3
+    full = b0.evals_result["valid_0"]["binary_logloss"]
+    assert len(full) == 6  # per-iteration path keeps every iteration
+    # same final model; eval value matches to the f32 LSB (see
+    # test_fused_valid_eval_matches_per_iteration)
+    np.testing.assert_allclose(series[-1], full[-1], rtol=1e-6)
 
 
 def test_fused_mesh_data_parallel_matches(monkeypatch):

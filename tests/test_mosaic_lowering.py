@@ -187,9 +187,10 @@ def test_full_fused_training_block_lowers_for_tpu(leaves, f):
 
     fused = jax.jit(
         functools.partial(_fused_iter_block, learner=ln,
-                          grad_fn=b._grad_fn, bag_fn=None, k=1),
+                          grad_fn=b._grad_fn, bag_fn=None,
+                          valid_data=(), k=1),
         static_argnames=("m",))
-    fused.trace(ln.mat, ln.ws, b.train_score, jnp.float32(0.1),
+    fused.trace(ln.mat, ln.ws, b.train_score, (), jnp.float32(0.1),
                 jnp.int32(0), m=4).lower(lowering_platforms=("tpu",))
 
 

@@ -11,6 +11,12 @@ lax.fori_loop and dividing. Reports:
   - partition_segment: same
   - best-split scan: per-call cost
 
+Streaming rates are additionally normalized to the device's HBM peak
+(lightgbm_tpu/utils/roofline.py: published per-chip GB/s + the
+documented bytes-per-row model), so each number reads as a fraction of
+physically-possible instead of a bare Mrow/s. CPU backends print
+"n/a" — the host's effective bandwidth is not in the table.
+
 Run: python tools/micro_kernel_bench.py [rows]
 """
 
@@ -45,8 +51,23 @@ def main():
 
     from lightgbm_tpu.ops import hist_pallas as hp
     from lightgbm_tpu.ops import partition_pallas as pp
+    from lightgbm_tpu.utils.roofline import (device_peaks,
+                                             hist_bytes_per_row,
+                                             normalize,
+                                             part_bytes_per_row)
 
+    peaks = device_peaks()
     print(f"backend={jax.default_backend()} n={n} f={f}")
+    print(f"device_kind={peaks['device_kind']} "
+          f"hbm_peak={peaks['hbm_gbps'] or 'n/a'} GB/s "
+          f"mxu_peak={peaks['mxu_tflops'] or 'n/a'} bf16 TFLOP/s")
+
+    def roof(rows_per_s, bytes_per_row):
+        rf = normalize(rows_per_s, bytes_per_row, peaks)
+        if rf["hbm_frac"] == "n/a":
+            return f" {rf['achieved_gbps']:7.2f} GB/s (peak n/a)"
+        return (f" {rf['achieved_gbps']:7.2f} GB/s"
+                f" {100 * rf['hbm_frac']:5.1f}% HBM")
 
     rng = np.random.RandomState(0)
     binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
@@ -112,8 +133,10 @@ def main():
             if t_l < 1.1 * t_s or per <= 0:
                 flag = (f"  UNRELIABLE (t{k_short}={t_s*1e3:.2f}ms "
                         f"t{k_chain}={t_l*1e3:.2f}ms)")
+            rate = count / max(per, 1e-9)
             print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
-                  f"({count/max(per, 1e-9)/1e6:8.1f} Mrow/s){flag}")
+                  f"({rate/1e6:8.1f} Mrow/s)"
+                  + roof(rate, hist_bytes_per_row(f)) + flag)
 
     # 3. chained partition_segment: v1 vs v2 (sub-tiled)
     from lightgbm_tpu.ops import partition_pallas_v2 as pp2
@@ -172,8 +195,10 @@ def main():
             if t_l < 1.1 * t_s or per <= 0:
                 flag = (f"  UNRELIABLE (t{k_short}={t_s*1e3:.2f}ms "
                         f"t{k_chain}={t_l*1e3:.2f}ms)")
+            rate = count / max(per, 1e-9)
             print(f"  count={count:8d}: {per*1e3:8.3f} ms/call "
-                  f"({count/max(per, 1e-9)/1e6:8.1f} Mrow/s){flag}")
+                  f"({rate/1e6:8.1f} Mrow/s)"
+                  + roof(rate, part_bytes_per_row(f)) + flag)
 
     # 4. chained best-split scan
     from lightgbm_tpu.learner.serial import (feature_meta_from_dataset,

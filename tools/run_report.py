@@ -59,6 +59,17 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         p["total_s"] = round(p["total_s"], 6)
         p["mean_s"] = round(p["total_s"] / max(p["count"], 1), 6)
 
+    # per-iteration dispatch/host-sync accounting (counts tables on the
+    # iter records; see Telemetry.count_iter)
+    iter_counts: Dict[str, Dict[str, float]] = {}
+    for r in iters:
+        for name, v in (r.get("counts") or {}).items():
+            c = iter_counts.setdefault(name, {"total": 0.0, "iters": 0})
+            c["total"] += float(v)
+            c["iters"] += 1
+    for c in iter_counts.values():
+        c["per_iter"] = round(c["total"] / max(c["iters"], 1), 3)
+
     n_iters = int(end.get("iters") or 0) or (
         len(iters) + sum(int(b.get("iters", 0)) for b in blocks))
     rows = int(end.get("num_data") or
@@ -85,6 +96,9 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "block_rows_per_s": block_rows_per_s,
         "compile": end.get("compile") or {},
         "phases": phases,
+        "iter_counts": iter_counts,
+        "fused_block_hits": int((end.get("counters") or {}).get(
+            "fused.block_hits", 0)) or len(blocks),
         "phase_totals": end.get("phase_totals") or {},
         "probe": probe.get("phases") or {},
         "probe_learner": probe.get("learner"),
@@ -153,6 +167,16 @@ def render(records: List[Dict[str, Any]]) -> str:
                 v = d["probe"][name]
                 L.append(f"{name:<12}{v:>10.6f}"
                          f"{100 * v / tot:>6.1f}%")
+
+    if d["iter_counts"]:
+        L.append("")
+        L.append("== dispatch / host-sync accounting (per iteration) ==")
+        L.append(f"{'counter':<22}{'total':>10}{'per_iter':>10}")
+        for name, c in sorted(d["iter_counts"].items()):
+            L.append(f"{name:<22}{c['total']:>10,.0f}"
+                     f"{c['per_iter']:>10.2f}")
+    if d["fused_block_hits"]:
+        L.append(f"fused_block_hits: {d['fused_block_hits']}")
 
     interesting = {k: v for k, v in d["counters"].items()
                    if not k.startswith("jit.")}
