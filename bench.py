@@ -157,6 +157,25 @@ def measure():
         except Exception as e:  # noqa: BLE001
             result["auc_error"] = str(e)[:200]
             result["quality_ok"] = False
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        # inference-side headline (lightgbm_tpu/serving/): a short
+        # closed-loop hammer on the just-trained booster through the
+        # compiled bucketed path — p50/p95/p99 latency, throughput and
+        # bucket hit rate ride the same JSON line. Failures are
+        # recorded, never fatal: the training headline must survive.
+        try:
+            from lightgbm_tpu.serving import ServingConfig, ServingEngine
+            from lightgbm_tpu.serving.loadgen import serving_block
+            eng = ServingEngine(
+                booster, config=ServingConfig(
+                    buckets=(1, 64, 256), device="always"))
+            result["serving"] = serving_block(
+                eng, X[:4096], batch_sizes=(1, 64),
+                threads=int(os.environ.get("BENCH_SERVING_THREADS", 2)),
+                duration_s=float(os.environ.get("BENCH_SERVING_S", 2)))
+            eng.stop()
+        except Exception as e:  # noqa: BLE001
+            result["serving_error"] = str(e)[:200]
     tel.flush()
     print(json.dumps(result))
 

@@ -19,6 +19,7 @@ from .config import Config
 from .engine import CVBooster, cv, train
 from .observability import get_telemetry
 from .parallel.distributed import init_distributed
+from .serving import ModelRegistry, ServingConfig, ServingEngine
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
 try:  # plotting needs matplotlib (reference: python-package __init__.py)
@@ -35,4 +36,5 @@ __all__ = ["Dataset", "Booster", "LightGBMError", "Config",
            "early_stopping", "print_evaluation", "record_evaluation",
            "record_telemetry", "reset_parameter", "get_telemetry",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
-           "init_distributed"] + _PLOT
+           "init_distributed",
+           "ServingEngine", "ServingConfig", "ModelRegistry"] + _PLOT

@@ -723,6 +723,80 @@ def booster_predict_for_mat(h: int, data_ptr: int, data_type: int,
                            parameter, out_ptr)
 
 
+# ----------------------------------------------------------------------
+# Single-row fast path (LGBM_BoosterPredictForMatSingleRowFast*,
+# src/c_api.cpp): the init call freezes predict kind / parameters ONCE
+# into a fast-config handle holding a cached serving-engine; each
+# subsequent call is one queue-bypassing engine dispatch instead of
+# rebuilding the whole predict state (parameter parsing, model-list
+# slicing, stacking) per row.
+_FAST_KINDS = {PREDICT_NORMAL: "predict", PREDICT_RAW_SCORE: "raw_score",
+               PREDICT_LEAF_INDEX: "pred_leaf"}
+
+
+class _FastConfig:
+    __slots__ = ("bst", "engine", "kind", "ncol", "data_type",
+                 "num_iteration", "kwargs")
+
+
+def booster_predict_for_mat_single_row_fast_init(
+        h: int, predict_type: int, num_iteration: int, data_type: int,
+        ncol: int, parameter: str) -> int:
+    """-> fast-config handle (freed with fast_config_free)."""
+    from .serving import ServingConfig, ServingEngine
+    bst = _get(h)
+    fc = _FastConfig()
+    fc.bst = bst
+    fc.ncol = int(ncol)
+    fc.data_type = int(data_type)
+    fc.num_iteration = int(num_iteration)
+    fc.kind = _FAST_KINDS.get(int(predict_type))
+    fc.kwargs = _parse_params(parameter)
+    total_iters = len(bst._src().models) \
+        // max(bst.num_model_per_iteration(), 1)
+    if fc.num_iteration > 0 and fc.num_iteration < total_iters:
+        # a truncated model cannot reuse the full-model engine pinning
+        fc.engine = None
+    elif fc.kind is None:   # PREDICT_CONTRIB: SHAP is host-only anyway
+        fc.engine = None
+    else:
+        # queue-bypassing engine (predict_now): no flusher thread, no
+        # warmup bill at init; buckets keep repeat shapes compile-free
+        fc.engine = ServingEngine(
+            bst, config=ServingConfig(buckets=(1, 64), warmup=False),
+            auto_start=False)
+    return _register(fc)
+
+
+def booster_predict_for_mat_single_row_fast(fast_h: int, data_ptr: int,
+                                            out_ptr: int) -> int:
+    """One row through the cached fast-config; -> out_len."""
+    fc = _get(fast_h)
+    row = np.array(_as_array(data_ptr, fc.ncol, fc.data_type),
+                   np.float64)[None, :]
+    if fc.engine is not None:
+        pred = fc.engine.predict_now(row, kind=fc.kind)
+    else:
+        kwargs: Dict[str, Any] = dict(
+            num_iteration=fc.num_iteration if fc.num_iteration > 0
+            else None)
+        if fc.kind == "raw_score":
+            kwargs["raw_score"] = True
+        elif fc.kind == "pred_leaf":
+            kwargs["pred_leaf"] = True
+        elif fc.kind is None:
+            kwargs["pred_contrib"] = True
+        pred = fc.bst.predict(row, **kwargs)
+    pred = np.ascontiguousarray(np.asarray(pred, np.float64).reshape(-1))
+    out = _as_array(out_ptr, len(pred), DTYPE_FLOAT64)
+    out[:] = pred
+    return len(pred)
+
+
+def fast_config_free(fast_h: int) -> None:
+    free_handle(fast_h)
+
+
 def network_init(machines: str, local_listen_port: int,
                  listen_time_out: int, num_machines: int) -> None:
     """Network::Init analog over jax.distributed

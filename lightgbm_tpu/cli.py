@@ -17,6 +17,10 @@ file entries), and the tasks
     ``predict_contrib`` and ``num_iteration_predict``.
   * ``task=refit`` — load ``input_model``, refit leaf values on
     ``data`` with ``refit_decay_rate``, save ``output_model``.
+  * ``task=serve`` — load ``input_model`` and serve it over the JSON
+    HTTP endpoint (``serving_host``/``serving_port``) with
+    micro-batching and shape-bucketed compiled dispatch
+    (lightgbm_tpu/serving/, docs/Serving.md).
 """
 
 from __future__ import annotations
@@ -207,6 +211,24 @@ def run_refit(params: Dict[str, str]) -> None:
     log_info(f"Finished refit; model saved to {out}")
 
 
+def run_serve(params: Dict[str, str]) -> None:
+    """``task=serve``: load ``input_model`` and serve it over the JSON
+    HTTP frontend (serving/http.py) with micro-batching and
+    shape-bucketed compiled dispatch (docs/Serving.md)."""
+    from .basic import Booster
+    from .config import Config
+    from .observability.telemetry import get_telemetry
+    from .serving import ServingConfig, ServingEngine
+    from .serving.http import serve_forever
+    cfg = Config.from_params(params)
+    get_telemetry().ensure_started(cfg)
+    if not cfg.input_model:
+        log_fatal("task=serve requires input_model=<model file>")
+    booster = Booster(model_file=cfg.input_model)
+    engine = ServingEngine(booster, config=ServingConfig.from_config(cfg))
+    serve_forever(engine, cfg.serving_host, int(cfg.serving_port))
+
+
 def run_convert_model(params: Dict[str, str]) -> None:
     """``task=convert_model``: model text -> standalone C++ if-else
     source (GBDT::ModelToIfElse, gbdt_model_text.cpp:117-299)."""
@@ -234,6 +256,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_predict(params)
     elif task == "refit":
         run_refit(params)
+    elif task == "serve":
+        run_serve(params)
     elif task == "convert_model":
         run_convert_model(params)
     else:

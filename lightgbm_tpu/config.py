@@ -125,6 +125,9 @@ _PARAM_ALIASES: Dict[str, str] = {
     "telemetry_output": "telemetry_out",
     "compile_cache": "compile_cache_dir",
     "compilation_cache_dir": "compile_cache_dir",
+    "serve_host": "serving_host",
+    "serve_port": "serving_port",
+    "serving_bucket_sizes": "serving_buckets",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -301,6 +304,22 @@ class Config:
     # ---- convert task (config.h:745-757)
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"
+
+    # ---- serve task (lightgbm_tpu/serving/, docs/Serving.md) — the
+    # HTTP frontend address plus the ServingEngine knobs: power-of-two
+    # row buckets precompiled at warmup, the bounded request queue, the
+    # micro-batch coalescing window, per-request deadline, shed policy
+    # (reject_new | drop_oldest) and the device route (auto | always |
+    # never)
+    serving_host: str = "127.0.0.1"
+    serving_port: int = 8080
+    serving_buckets: List[int] = field(default_factory=list)
+    serving_max_queue: int = 1024
+    serving_flush_ms: float = 2.0
+    serving_timeout_ms: float = 1000.0
+    serving_shed_policy: str = "reject_new"
+    serving_device: str = "auto"
+    serving_warmup: bool = True
 
     # ---- objective (config.h:761-832)
     objective_seed: int = 5

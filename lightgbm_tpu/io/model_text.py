@@ -348,21 +348,9 @@ class LoadedBooster:
 
     def predict(self, data: np.ndarray,
                 num_iteration: Optional[int] = None) -> np.ndarray:
+        from ..objective.output import convert_raw_score
         raw = self.predict_raw(data, num_iteration)
-        name = self.objective_str.split(" ")[0] if self.objective_str \
-            else ""
-        if name in ("binary", "cross_entropy", "multiclassova"):
-            sigmoid = 1.0
-            for tok in self.objective_str.split()[1:]:
-                if tok.startswith("sigmoid:"):
-                    sigmoid = float(tok.split(":")[1])
-            return 1.0 / (1.0 + np.exp(-sigmoid * raw))
-        if name == "multiclass":
-            e = np.exp(raw - raw.max(axis=1, keepdims=True))
-            return e / e.sum(axis=1, keepdims=True)
-        if name in ("poisson", "gamma", "tweedie"):
-            return np.exp(raw)
-        return raw
+        return convert_raw_score(self.objective_str, raw)
 
     def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
         data = np.asarray(data, np.float64)

@@ -829,6 +829,45 @@ int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
                                    out_result);
 }
 
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, int predict_type, int num_iteration,
+    int data_type, int32_t ncol, const char* parameter,
+    FastConfigHandle* out_fast_config) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_mat_single_row_fast_init",
+      Py_BuildValue("(Liiiis)", reinterpret_cast<long long>(handle),
+                    predict_type, num_iteration, data_type,
+                    static_cast<int>(ncol),
+                    parameter ? parameter : ""));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_fast_config = reinterpret_cast<FastConfigHandle>(as_int(r, &ok));
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_BoosterPredictForMatSingleRowFast(
+    FastConfigHandle fast_config_handle, const void* data,
+    int64_t* out_len, double* out_result) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_predict_for_mat_single_row_fast",
+      Py_BuildValue("(LLL)",
+                    reinterpret_cast<long long>(fast_config_handle),
+                    reinterpret_cast<long long>(data),
+                    reinterpret_cast<long long>(out_result)));
+  if (r == nullptr) return -1;
+  bool ok;
+  *out_len = as_int(r, &ok);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int LGBM_FastConfigFree(FastConfigHandle fast_config_handle) {
+  return LGBM_DatasetFree(fast_config_handle);  // same registry
+}
+
 int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int indptr_type, const int32_t* indices,
                               const void* data, int data_type,

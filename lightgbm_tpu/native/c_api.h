@@ -36,6 +36,7 @@ extern "C" {
 
 typedef void* DatasetHandle;
 typedef void* BoosterHandle;
+typedef void* FastConfigHandle;
 
 #define C_API_DTYPE_FLOAT32 (0)
 #define C_API_DTYPE_FLOAT64 (1)
@@ -175,6 +176,18 @@ int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
                                        const char* parameter,
                                        int64_t* out_len,
                                        double* out_result);
+/* Fast-config single-row path: Init freezes the predict kind and
+ * parameters into a cached serving-engine handle; each Fast call is
+ * one queue-bypassing dispatch instead of rebuilding predict state
+ * per row (src/c_api.cpp LGBM_BoosterPredictForMatSingleRowFast). */
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, int predict_type, int num_iteration,
+    int data_type, int32_t ncol, const char* parameter,
+    FastConfigHandle* out_fast_config);
+int LGBM_BoosterPredictForMatSingleRowFast(
+    FastConfigHandle fast_config_handle, const void* data,
+    int64_t* out_len, double* out_result);
+int LGBM_FastConfigFree(FastConfigHandle fast_config_handle);
 int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int indptr_type, const int32_t* indices,
                               const void* data, int data_type,

@@ -1,0 +1,65 @@
+"""Shared raw-score -> output transform.
+
+Reference analog: ``ObjectiveFunction::ConvertOutput`` dispatch inside
+``Predictor`` (src/application/predictor.hpp:39-131). Two callers need
+the *string-named* variant: ``predictor._convert`` (models whose
+objective is only known as the model-text ``objective=`` line) and
+``io.model_text.LoadedBooster.predict``. Both used to re-implement the
+sigmoid/softmax math inline — two copies that could drift (and did:
+the loaded-text path silently dropped ``cross_entropy_lambda``'s
+``log1p(exp(x))``). This module is the single host-side (numpy)
+implementation; ``tests/test_serving.py`` pins it equal to every
+built-in objective's device-side ``convert_output``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def objective_param(objective_str: str, key: str, default: float) -> float:
+    """Parse one ``key:value`` token out of a model-text objective line
+    (e.g. ``"binary sigmoid:2"``)."""
+    for tok in (objective_str or "").split()[1:]:
+        if tok.startswith(key + ":"):
+            try:
+                return float(tok.split(":", 1)[1])
+            except ValueError:
+                return default
+    return default
+
+
+def convert_raw_score(objective_str: str, raw: np.ndarray) -> np.ndarray:
+    """ConvertOutput for a string-named objective (numpy, host-side).
+
+    ``objective_str`` is the model-text objective line (name + optional
+    ``key:value`` params); unknown/regression-family names are the
+    identity, exactly like the reference's null-converter default.
+    """
+    raw = np.asarray(raw)
+    name = (objective_str or "").split(" ")[0]
+    if name in ("binary", "multiclassova"):
+        sigmoid = objective_param(objective_str, "sigmoid", 1.0)
+        return 1.0 / (1.0 + np.exp(-sigmoid * raw))
+    if name == "cross_entropy":
+        return 1.0 / (1.0 + np.exp(-raw))
+    if name == "cross_entropy_lambda":
+        return np.log1p(np.exp(raw))
+    if name == "multiclass":
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    if name in ("poisson", "gamma", "tweedie"):
+        return np.exp(raw)
+    return raw
+
+
+def convert_output(src, raw: np.ndarray) -> np.ndarray:
+    """ConvertOutput for a trained GBDT *or* a LoadedBooster: objective
+    objects use their own (device-side) ``convert_output``; everything
+    else routes through :func:`convert_raw_score` on the model's
+    objective line."""
+    obj = getattr(src, "objective", None)
+    if obj is not None and not isinstance(obj, str):
+        import jax.numpy as jnp
+        return np.asarray(obj.convert_output(jnp.asarray(raw)))
+    return convert_raw_score(getattr(src, "objective_str", ""), raw)

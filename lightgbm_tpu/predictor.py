@@ -17,6 +17,7 @@ reference's exact TreeSHAP recursion on host.
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional
 
 import jax
@@ -36,33 +37,58 @@ def _model_list(src, num_iteration: int) -> List:
 
 
 def _convert(src, raw: np.ndarray) -> np.ndarray:
-    """ConvertOutput dispatch for both GBDT and LoadedBooster."""
-    obj = getattr(src, "objective", None)
-    if obj is not None and not isinstance(obj, str):
-        import jax.numpy as jnp
-        return np.asarray(obj.convert_output(jnp.asarray(raw)))
-    name = getattr(src, "objective_str", "").split(" ")[0]
-    if name in ("binary", "cross_entropy", "multiclassova"):
-        sigmoid = 1.0
-        for tok in getattr(src, "objective_str", "").split()[1:]:
-            if tok.startswith("sigmoid:"):
-                sigmoid = float(tok.split(":")[1])
-        return 1.0 / (1.0 + np.exp(-sigmoid * raw))
-    if name == "multiclass":
-        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
-        return e / e.sum(axis=-1, keepdims=True)
-    if name in ("poisson", "gamma", "tweedie"):
-        return np.exp(raw)
-    return raw
+    """ConvertOutput dispatch for both GBDT and LoadedBooster (single
+    shared implementation: objective/output.py)."""
+    from .objective.output import convert_output
+    return convert_output(src, raw)
+
+
+# ----------------------------------------------------------------------
+# shape buckets: every distinct row count that reaches the jitted scan
+# is a fresh XLA compile. Padding row counts up to the next power of two
+# bounds the number of compiled programs at log2(max rows) per model —
+# serving traffic of arbitrary batch sizes then compiles each bucket
+# exactly once. Padded rows are zeros; the scan has no cross-row
+# reductions, so rows are independent and the slice-back is exact.
+def buckets_enabled() -> bool:
+    """Opt-out knob for the bucket padding (LGBM_TPU_PREDICT_BUCKETS=0
+    restores one-compile-per-exact-shape)."""
+    return os.environ.get("LGBM_TPU_PREDICT_BUCKETS", "1") != "0"
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest power of two >= n (the bucket the row count pads to)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def device_min_cells() -> int:
+    """rows*trees threshold above which predict dispatches the batched
+    device scan (below it the vectorized host loop is cheaper than a
+    compile). Env-tunable so serving tests can force either route."""
+    return int(os.environ.get("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS",
+                              1 << 16))
 
 
 def predict(src, data: np.ndarray, num_iteration: int = -1,
             raw_score: bool = False, pred_leaf: bool = False,
             pred_contrib: bool = False, pred_early_stop: bool = False,
             pred_early_stop_freq: int = 10,
-            pred_early_stop_margin: float = 10.0) -> np.ndarray:
+            pred_early_stop_margin: float = 10.0,
+            device: Optional[bool] = None,
+            stacked=None) -> np.ndarray:
     """Unified prediction entry (Predictor closure dispatch,
-    predictor.hpp:39-131)."""
+    predictor.hpp:39-131).
+
+    ``device`` overrides the route: True forces the batched device scan
+    (requires a dataset-backed model), False forces the vectorized host
+    loop, None (default) picks by ``rows*trees >= device_min_cells()``.
+    ``stacked`` supplies pre-stacked (optionally device-pinned) tree
+    arrays from :func:`stack_tree_arrays` — the serving registry pins
+    them once per model version instead of restacking per call.
+    """
     data = np.asarray(data, np.float64)
     models = _model_list(src, num_iteration)
     k = src.num_tree_per_iteration
@@ -86,9 +112,17 @@ def predict(src, data: np.ndarray, num_iteration: int = -1,
                                       pred_early_stop_freq,
                                       pred_early_stop_margin)
     if raw is None:
-        if dataset is not None and models \
-                and n * len(models) >= (1 << 16):
-            raw = _device_predict(models, data, dataset, k)
+        use_device = device
+        if use_device is None:
+            use_device = dataset is not None and bool(models) \
+                and n * len(models) >= device_min_cells()
+        elif use_device and (dataset is None or not models):
+            raise ValueError(
+                "device predict requires a dataset-backed model "
+                "(text-loaded boosters have no bin mappers)")
+        if use_device:
+            raw = _device_predict(models, data, dataset, k,
+                                  stacked=stacked)
         else:
             raw = np.zeros((n, k))
             for i, t in enumerate(models):
@@ -166,15 +200,42 @@ def _predict_raw_early_stop(src, models, data, k: int, freq: int,
     return raw
 
 
-def _device_predict(models, data, dataset, k: int) -> np.ndarray:
-    """All trees x all rows in ONE device dispatch: re-bin the input
-    with the training mappers (exact semantics — the raw threshold of
-    every split is its bin's upper bound) and scan over stacked padded
-    tree arrays."""
-    import jax
-    import jax.numpy as jnp
+class StackedTrees:
+    """Stacked SoA tree arrays for the device scan, built once per
+    model (version) and reusable across dispatches. ``device()``
+    uploads the stack once and keeps the jnp arrays pinned — the
+    serving registry's per-version device residency."""
 
-    binned, mv_slots = _bin_data(data, dataset)
+    _FIELDS = ("col", "off", "thr", "dec", "left", "right", "miss",
+               "dbin", "nbin", "cat", "leaf_vals", "n_leaves",
+               "tree_class")
+
+    def __init__(self, k: int, **arrays):
+        self.k = k
+        for f in self._FIELDS:
+            setattr(self, f, arrays[f])
+        self._device = None
+
+    def device(self):
+        """The stack as (pinned) device arrays, uploaded on first use."""
+        if self._device is None:
+            import jax.numpy as jnp
+            self._device = tuple(jnp.asarray(getattr(self, f))
+                                 for f in self._FIELDS)
+        return self._device
+
+    @property
+    def num_trees(self) -> int:
+        return int(self.col.shape[0])
+
+    def nbytes(self) -> int:
+        return int(sum(getattr(self, f).nbytes for f in self._FIELDS))
+
+
+def stack_tree_arrays(models, k: int) -> StackedTrees:
+    """Stack per-tree arrays into [T, S_max] SoA matrices (the scan's
+    carry inputs). Trees must be finalized and dataset-backed (have the
+    ``_col``/``_offset`` bundled-layout columns)."""
     t = len(models)
     s_max = max(max(len(m.split_feature_inner) for m in models), 1)
 
@@ -185,35 +246,74 @@ def _device_predict(models, data, dataset, k: int) -> np.ndarray:
             out[i, :len(a)] = a
         return out
 
-    col = stack("_col", np.int32)
-    off = stack("_offset", np.int32)
-    thr = stack("threshold_bin", np.int32)
-    dec = stack("decision_type", np.int32)
-    left = stack("left_child", np.int32, -1)
-    right = stack("right_child", np.int32, -1)
-    miss = stack("_missing_code", np.int32)
-    dbin = stack("_default_bin", np.int32)
-    nbin = stack("_num_bin", np.int32)
     nw = models[0].cat_bitsets.shape[1] if len(models) else 8
     cat = np.zeros((t, s_max, nw), np.uint32)
     leaf_vals = np.zeros((t, s_max + 1), np.float32)
     n_leaves = np.zeros((t,), np.int32)
-    tree_class = np.asarray([i % k for i in range(t)], np.int32)
     for i, m in enumerate(models):
         cat[i, :len(m.cat_bitsets)] = m.cat_bitsets
         leaf_vals[i, :m.num_leaves] = m.leaf_value
         n_leaves[i] = m.num_leaves
+    return StackedTrees(
+        k,
+        col=stack("_col", np.int32), off=stack("_offset", np.int32),
+        thr=stack("threshold_bin", np.int32),
+        dec=stack("decision_type", np.int32),
+        left=stack("left_child", np.int32, -1),
+        right=stack("right_child", np.int32, -1),
+        miss=stack("_missing_code", np.int32),
+        dbin=stack("_default_bin", np.int32),
+        nbin=stack("_num_bin", np.int32),
+        cat=cat, leaf_vals=leaf_vals, n_leaves=n_leaves,
+        tree_class=np.asarray([i % k for i in range(t)], np.int32))
+
+
+# signatures already dispatched through _scan_trees this process:
+# a repeat signature is a jit-cache hit (no trace, no compile)
+_SEEN_SCAN_SIGS = set()
+
+
+def _device_predict(models, data, dataset, k: int,
+                    stacked: Optional[StackedTrees] = None) -> np.ndarray:
+    """All trees x all rows in ONE device dispatch: re-bin the input
+    with the training mappers (exact semantics — the raw threshold of
+    every split is its bin's upper bound) and scan over stacked padded
+    tree arrays. Row counts pad to power-of-two buckets (see
+    buckets_enabled) so arbitrary batch sizes hit a bounded set of
+    compiled programs."""
+    import jax
+    import jax.numpy as jnp
+
+    binned, mv_slots = _bin_data(data, dataset)
+    n = binned.shape[0]
+    if buckets_enabled():
+        b = bucket_rows(n)
+        if b > n:
+            binned = np.concatenate(
+                [binned, np.zeros((b - n,) + binned.shape[1:],
+                                  binned.dtype)])
+            if mv_slots is not None:
+                mv_slots = np.concatenate(
+                    [mv_slots, np.zeros((b - n,) + mv_slots.shape[1:],
+                                        mv_slots.dtype)])
+    if stacked is None:
+        stacked = stack_tree_arrays(models, k)
+    dev = stacked.device()
+
+    sig = (binned.shape, str(binned.dtype), k, mv_slots is not None,
+           None if mv_slots is None else mv_slots.shape,
+           tuple((a.shape, str(a.dtype)) for a in dev))
+    from .observability.telemetry import get_telemetry
+    if sig in _SEEN_SCAN_SIGS:
+        get_telemetry().count("jit.cache_hits")
+    else:
+        _SEEN_SCAN_SIGS.add(sig)
 
     out = _scan_trees(
-        jnp.asarray(binned), jnp.asarray(col), jnp.asarray(off),
-        jnp.asarray(thr),
-        jnp.asarray(dec), jnp.asarray(left), jnp.asarray(right),
-        jnp.asarray(miss), jnp.asarray(dbin), jnp.asarray(nbin),
-        jnp.asarray(cat), jnp.asarray(leaf_vals), jnp.asarray(n_leaves),
-        jnp.asarray(tree_class), k,
+        jnp.asarray(binned), *dev, k,
         None if mv_slots is None else jnp.asarray(mv_slots),
         mv_slots is not None)
-    return np.asarray(jax.device_get(out), np.float64)
+    return np.asarray(jax.device_get(out), np.float64)[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("k", "mv_present"))

@@ -1,0 +1,33 @@
+"""Compiled, shape-bucketed inference serving.
+
+The training side of the codebase stops at ``predictor.predict``; this
+package is the inference-side subsystem the ROADMAP's "serves heavy
+traffic" north star needs (reference analog: the ``Predictor``
+application layer, src/application/predictor.hpp:29-131; batched-GBDT
+inference accelerators per arxiv 2011.02022 / 1706.08359):
+
+* :class:`ModelRegistry` (``registry.py``) — versioned model storage
+  with device-pinned stacked tree arrays, atomic hot swap and
+  old-version draining;
+* :class:`ServingEngine` (``engine.py``) — micro-batching over a
+  bounded request queue with a deadline flusher, shape-bucketed
+  compiled dispatch, eager warmup, per-request timeouts, queue-full
+  shedding and host-traversal fallback;
+* ``http.py`` — a stdlib JSON frontend (``python -m lightgbm_tpu
+  serve``): predict / raw_score / pred_leaf / health / reload;
+* ``loadgen.py`` — closed- and open-loop load generation shared by
+  ``tools/serve_bench.py`` and ``bench.py``.
+
+See docs/Serving.md for architecture and tuning.
+"""
+
+from .engine import ServingConfig, ServingEngine
+from .errors import (EngineStoppedError, InvalidRequestError,
+                     ModelLoadError, QueueFullError, RequestTimeoutError,
+                     ServingError)
+from .registry import ModelRegistry, save_model_npz
+
+__all__ = ["ServingEngine", "ServingConfig", "ModelRegistry",
+           "save_model_npz", "ServingError", "QueueFullError",
+           "RequestTimeoutError", "EngineStoppedError",
+           "ModelLoadError", "InvalidRequestError"]

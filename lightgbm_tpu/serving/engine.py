@@ -1,0 +1,600 @@
+"""ServingEngine: micro-batched, shape-bucketed compiled inference.
+
+Request lifecycle::
+
+    submit()/predict() -> bounded queue -> deadline flusher thread
+        -> coalesce into one batch -> group by (kind, route)
+        -> pad to power-of-two bucket -> one device dispatch
+        -> slice per request -> fulfill futures
+
+Compilation is amortized two ways: the model registry pins each
+version's stacked tree arrays on device once, and every dispatch pads
+its row count to a configured power-of-two bucket so each
+(model-version, bucket) compiles exactly once — :meth:`warmup`
+precompiles the configured buckets eagerly so steady-state traffic of
+arbitrary batch sizes triggers zero new XLA compilations.
+
+Degradation is graceful and structured: a full queue sheds
+(:class:`QueueFullError`, policy ``reject_new`` or ``drop_oldest``), a
+passed deadline raises :class:`RequestTimeoutError`, and a device-path
+failure falls back to the vectorized host traversal (counted, never
+silent).
+
+Routes: ``device`` is the compiled bucketed scan (dataset-backed
+models); ``host`` is the vectorized numpy traversal (also the route
+for text/npz-loaded models and ``pred_leaf``). ``device="auto"``
+mirrors ``predictor.predict``'s own per-request rule, which makes
+responses bit-identical to a direct ``predictor.predict`` of the same
+rows; ``device="always"`` forces every eligible request through the
+compiled path (the production setting).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.telemetry import get_telemetry
+from ..utils.log import log_info, log_warning
+from .errors import (EngineStoppedError, InvalidRequestError,
+                     QueueFullError, RequestTimeoutError, ServingError)
+from .registry import ModelRegistry
+
+KINDS = ("predict", "raw_score", "pred_leaf")
+
+
+def _pow2_buckets(spec) -> Tuple[int, ...]:
+    """Normalize a bucket spec ("1,8,64" / iterable) to sorted unique
+    powers of two (rounded up; the predictor pads to powers of two, so
+    non-pow2 buckets would silently alias)."""
+    if isinstance(spec, str):
+        vals = [int(v) for v in spec.replace(";", ",").split(",") if v]
+    else:
+        vals = [int(v) for v in spec]
+    out = set()
+    for v in vals:
+        if v <= 0:
+            raise ValueError(f"bucket sizes must be positive, got {v}")
+        b = 1
+        while b < v:
+            b <<= 1
+        out.add(b)
+    if not out:
+        raise ValueError("at least one bucket is required")
+    return tuple(sorted(out))
+
+
+def bucket_for(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest configured bucket >= n (callers chunk at max(buckets),
+    so n never exceeds it)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class ServingConfig:
+    """Engine tuning knobs; see docs/Serving.md for guidance."""
+
+    buckets: Tuple[int, ...] = (1, 8, 64, 512)
+    max_batch_rows: int = 0          # 0 -> max(buckets)
+    max_queue: int = 1024            # queued requests before shedding
+    flush_interval_ms: float = 2.0   # micro-batch coalescing window
+    request_timeout_ms: float = 1000.0
+    shed_policy: str = "reject_new"  # or "drop_oldest"
+    device: str = "auto"             # auto | always | never
+    warmup: bool = True
+    warmup_kinds: Tuple[str, ...] = ("predict", "raw_score")
+    fallback_to_host: bool = True
+
+    def __post_init__(self):
+        self.buckets = _pow2_buckets(self.buckets)
+        if not self.max_batch_rows:
+            self.max_batch_rows = self.buckets[-1]
+        if self.shed_policy not in ("reject_new", "drop_oldest"):
+            raise ValueError(
+                f"unknown shed_policy {self.shed_policy!r}")
+        if self.device not in ("auto", "always", "never"):
+            raise ValueError(f"unknown device mode {self.device!r}")
+
+    @classmethod
+    def from_config(cls, cfg) -> "ServingConfig":
+        """Build from the lightgbm Config's ``serving_*`` params."""
+        kw: Dict[str, Any] = {}
+        if getattr(cfg, "serving_buckets", None):
+            kw["buckets"] = cfg.serving_buckets
+        for src_name, dst in (("serving_max_queue", "max_queue"),
+                              ("serving_flush_ms", "flush_interval_ms"),
+                              ("serving_timeout_ms",
+                               "request_timeout_ms"),
+                              ("serving_shed_policy", "shed_policy"),
+                              ("serving_device", "device"),
+                              ("serving_warmup", "warmup")):
+            if hasattr(cfg, src_name):
+                kw[dst] = getattr(cfg, src_name)
+        return cls(**kw)
+
+
+class _Request:
+    __slots__ = ("rows", "kind", "t_enqueue", "deadline", "event",
+                 "result", "error", "meta")
+
+    def __init__(self, rows: np.ndarray, kind: str,
+                 timeout_s: Optional[float]):
+        self.rows = rows
+        self.kind = kind
+        self.t_enqueue = time.monotonic()
+        self.deadline = None if timeout_s is None \
+            else self.t_enqueue + timeout_s
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[ServingError] = None
+        self.meta: Dict[str, Any] = {}
+
+
+class ServingFuture:
+    """Handle for an async :meth:`ServingEngine.submit`."""
+
+    __slots__ = ("_req",)
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def done(self) -> bool:
+        return self._req.event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._req.event.wait(timeout):
+            raise RequestTimeoutError(
+                "result not ready within caller wait",
+                waited_s=timeout)
+        if self._req.error is not None:
+            raise self._req.error
+        return self._req.result
+
+    @property
+    def meta(self) -> Dict[str, Any]:
+        return dict(self._req.meta)
+
+
+class ServingEngine:
+    """Embeddable serving frontend; see module docstring."""
+
+    def __init__(self, source=None,
+                 config: Optional[ServingConfig] = None,
+                 registry: Optional[ModelRegistry] = None,
+                 auto_start: bool = True):
+        self.config = config or ServingConfig()
+        self.registry = registry or ModelRegistry()
+        self._auto_start = auto_start
+        self._cond = threading.Condition()
+        self._queue: List[_Request] = []
+        self._queued_rows = 0
+        self._stop = False
+        self._started = False
+        self._thread: Optional[threading.Thread] = None
+        self._stats_lock = threading.Lock()
+        self._counts: Dict[str, float] = {}
+        self._latencies: List[float] = []   # bounded reservoir (ms)
+        self._latency_cap = 8192
+        self._bucket_seen = set()           # (version, bucket)
+        self._queue_peak = 0
+        if source is not None:
+            self.load(source)
+
+    # -- model lifecycle -----------------------------------------------
+    def load(self, source) -> int:
+        """Load + warm up + atomically activate a model version; the
+        previous version (if any) drains. Returns the new version id.
+        In-flight and queued requests never fail across the swap."""
+        pin = self.config.device != "never"
+        mv = self.registry.load(source, pin_device=pin)
+        if self.config.warmup:
+            self._warmup(mv)
+        had_old = self.registry.current() is not None
+        self.registry.activate(mv)
+        if had_old:
+            self._count("reloads")
+        return mv.version
+
+    reload = load
+
+    def _warmup(self, mv) -> None:
+        """Eagerly compile every configured bucket for the new version
+        BEFORE it takes traffic (reload pays compile off the hot path).
+        Host-route models have nothing to compile."""
+        if not mv.device_ready:
+            return
+        tel = get_telemetry()
+        nfeat = mv.dataset.num_total_features
+        t0 = time.perf_counter()
+        with tel.span("serving.warmup"):
+            for b in self.config.buckets:
+                x = np.zeros((b, nfeat))
+                for kind in self.config.warmup_kinds:
+                    if kind == "pred_leaf":
+                        continue       # host route; nothing to compile
+                    self._compute(mv, x, kind, "device")
+        dur = time.perf_counter() - t0
+        self._count("warmup_buckets", len(self.config.buckets))
+        log_info(f"serving: warmed {len(self.config.buckets)} buckets "
+                 f"{list(self.config.buckets)} for v{mv.version} in "
+                 f"{dur:.2f}s")
+
+    @property
+    def version(self) -> Optional[int]:
+        mv = self.registry.current()
+        return None if mv is None else mv.version
+
+    # -- engine lifecycle ----------------------------------------------
+    def start(self) -> "ServingEngine":
+        with self._cond:
+            if self._started:
+                return self
+            self._stop = False
+            self._started = True
+            self._thread = threading.Thread(
+                target=self._flush_loop, name="lgbm-serving-flusher",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop the flusher. ``drain=True`` serves everything already
+        queued first; otherwise queued requests fail with
+        EngineStoppedError."""
+        with self._cond:
+            if not drain:
+                for r in self._queue:
+                    self._fail(r, EngineStoppedError(
+                        "engine stopped before dispatch"))
+                self._queue.clear()
+                self._queued_rows = 0
+            self._stop = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+        with self._cond:
+            self._started = False
+            self._thread = None
+            for r in self._queue:     # drain thread died / timed out
+                self._fail(r, EngineStoppedError(
+                    "engine stopped before dispatch"))
+            self._queue.clear()
+            self._queued_rows = 0
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.record("serving_stats", **self.stats())
+            tel.flush()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request entry -------------------------------------------------
+    def _validate(self, rows) -> np.ndarray:
+        try:
+            arr = np.asarray(rows, np.float64)
+        except (TypeError, ValueError) as e:
+            raise InvalidRequestError(f"rows not numeric: {e}") from e
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2 or arr.shape[0] == 0 or arr.shape[1] == 0:
+            raise InvalidRequestError(
+                f"rows must be a non-empty 2-D matrix, got shape "
+                f"{arr.shape}")
+        mv = self.registry.current()
+        if mv is None:
+            raise ServingError("no model loaded")
+        nfeat = self._num_features(mv)
+        if arr.shape[1] != nfeat:
+            raise InvalidRequestError(
+                f"expected {nfeat} features per row, got "
+                f"{arr.shape[1]}", expected=nfeat, got=arr.shape[1])
+        return arr
+
+    @staticmethod
+    def _num_features(mv) -> int:
+        if mv.dataset is not None:
+            return int(mv.dataset.num_total_features)
+        return int(getattr(mv.src, "max_feature_idx", 0)) + 1
+
+    def submit(self, rows, kind: str = "predict",
+               timeout_ms: Optional[float] = None) -> ServingFuture:
+        """Enqueue a request; returns a future. Raises QueueFullError
+        under the reject_new shed policy when the queue is at
+        max_queue."""
+        if kind not in KINDS:
+            raise InvalidRequestError(
+                f"unknown kind {kind!r}; one of {KINDS}")
+        arr = self._validate(rows)
+        t = self.config.request_timeout_ms if timeout_ms is None \
+            else timeout_ms
+        req = _Request(arr, kind, None if t <= 0 else t / 1000.0)
+        with self._cond:
+            if self._stop:
+                raise EngineStoppedError("engine is stopped")
+            if len(self._queue) >= self.config.max_queue:
+                self._count("shed")
+                if self.config.shed_policy == "reject_new":
+                    raise QueueFullError(
+                        "request queue full",
+                        max_queue=self.config.max_queue,
+                        queue_depth=len(self._queue))
+                oldest = self._queue.pop(0)
+                self._queued_rows -= len(oldest.rows)
+                self._fail(oldest, QueueFullError(
+                    "shed by a newer request (drop_oldest)",
+                    max_queue=self.config.max_queue))
+            self._queue.append(req)
+            self._queued_rows += len(req.rows)
+            self._queue_peak = max(self._queue_peak, len(self._queue))
+            self._cond.notify_all()
+        self._count("requests")
+        self._count("rows", len(arr))
+        if self._auto_start and not self._started:
+            self.start()
+        return ServingFuture(req)
+
+    def predict(self, rows, kind: str = "predict",
+                timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Synchronous predict through the micro-batching queue."""
+        fut = self.submit(rows, kind, timeout_ms=timeout_ms)
+        t = self.config.request_timeout_ms if timeout_ms is None \
+            else timeout_ms
+        # caller-side wait gets slack past the engine deadline so the
+        # flusher's structured timeout (not the wait) is what surfaces
+        wait = None if t <= 0 else t / 1000.0 + 5.0
+        return fut.result(timeout=wait)
+
+    def predict_now(self, rows, kind: str = "predict") -> np.ndarray:
+        """Bypass the queue: validate, route and dispatch on the
+        calling thread (the C-API single-row fast path and closed-loop
+        benchmarks; no flusher required)."""
+        if kind not in KINDS:
+            raise InvalidRequestError(
+                f"unknown kind {kind!r}; one of {KINDS}")
+        arr = self._validate(rows)
+        t0 = time.monotonic()
+        with self.registry.checkout() as mv:
+            route = self._route_for(mv, len(arr), kind)
+            out = self._compute_safe(mv, arr, kind, route)
+        self._count("requests")
+        self._count("rows", len(arr))
+        self._observe_latency((time.monotonic() - t0) * 1000.0)
+        return out
+
+    # -- flusher -------------------------------------------------------
+    def _flush_loop(self) -> None:
+        while True:
+            batch: List[_Request] = []
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if not self._queue and self._stop:
+                    return
+                # deadline-based coalescing: hold the batch open until
+                # the oldest request's flush deadline or the row budget
+                flush_at = self._queue[0].t_enqueue \
+                    + self.config.flush_interval_ms / 1000.0
+                while not self._stop:
+                    now = time.monotonic()
+                    if now >= flush_at \
+                            or self._queued_rows \
+                            >= self.config.max_batch_rows:
+                        break
+                    self._cond.wait(timeout=flush_at - now)
+                total = 0
+                while self._queue:
+                    r = self._queue[0]
+                    if batch and total + len(r.rows) \
+                            > self.config.max_batch_rows:
+                        break
+                    batch.append(self._queue.pop(0))
+                    total += len(r.rows)
+                    self._queued_rows -= len(r.rows)
+            if batch:
+                try:
+                    self._dispatch(batch)
+                except Exception as e:  # never kill the flusher
+                    err = e if isinstance(e, ServingError) \
+                        else ServingError(f"dispatch failed: {e}")
+                    for r in batch:
+                        self._fail(r, err)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        now = time.monotonic()
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self._count("timeouts")
+                self._fail(r, RequestTimeoutError(
+                    "deadline passed before dispatch",
+                    timeout_ms=self.config.request_timeout_ms))
+            else:
+                live.append(r)
+        if not live:
+            return
+        self._count("batches")
+        with self.registry.checkout() as mv:
+            groups: Dict[Tuple[str, str], List[_Request]] = {}
+            for r in live:
+                route = self._route_for(mv, len(r.rows), r.kind)
+                groups.setdefault((r.kind, route), []).append(r)
+            for (kind, route), reqs in groups.items():
+                self._run_group(mv, kind, route, reqs)
+
+    def _run_group(self, mv, kind: str, route: str,
+                   reqs: List[_Request]) -> None:
+        x = np.concatenate([r.rows for r in reqs]) if len(reqs) > 1 \
+            else reqs[0].rows
+        try:
+            out = self._compute_safe(mv, x, kind, route)
+        except ServingError as e:
+            for r in reqs:
+                self._fail(r, e)
+            return
+        except Exception as e:
+            err = ServingError(f"compute failed: {e}")
+            for r in reqs:
+                self._fail(r, err)
+            return
+        lo = 0
+        done_t = time.monotonic()
+        for r in reqs:
+            n = len(r.rows)
+            r.result = out[lo:lo + n]
+            lo += n
+            lat = (done_t - r.t_enqueue) * 1000.0
+            r.meta.update(version=mv.version, route=route, kind=kind,
+                          batch_rows=len(x), latency_ms=round(lat, 3))
+            self._observe_latency(lat)
+            r.event.set()
+
+    # -- routing & compute ---------------------------------------------
+    def _route_for(self, mv, n_rows: int, kind: str) -> str:
+        if kind == "pred_leaf" or not mv.device_ready:
+            return "host"
+        mode = self.config.device
+        if mode == "never":
+            return "host"
+        if mode == "always":
+            return "device"
+        # auto: mirror predictor.predict's own per-request rule so
+        # responses are bit-identical to a direct predict of the rows
+        from ..predictor import device_min_cells
+        return "device" if n_rows * mv.num_trees >= device_min_cells() \
+            else "host"
+
+    def _compute_safe(self, mv, x: np.ndarray, kind: str,
+                      route: str) -> np.ndarray:
+        if route == "device":
+            try:
+                return self._compute(mv, x, kind, "device")
+            except Exception as e:
+                if not self.config.fallback_to_host:
+                    raise
+                self._count("fallbacks")
+                log_warning(f"serving: device path failed ({e}); "
+                            "falling back to host traversal")
+        return self._compute(mv, x, kind, "host")
+
+    def _compute(self, mv, x: np.ndarray, kind: str,
+                 route: str) -> np.ndarray:
+        from .. import predictor
+        from ..objective.output import convert_output
+        if route != "device":
+            kwargs = {}
+            if kind == "raw_score":
+                kwargs["raw_score"] = True
+            elif kind == "pred_leaf":
+                kwargs["pred_leaf"] = True
+            return np.asarray(predictor.predict(
+                mv.src, x, device=False, **kwargs))
+        # device: chunk at the largest bucket, pad each chunk to its
+        # bucket, run the compiled scan, transform on the padded shape
+        # (shape-stable -> no new eager-op compiles), slice back
+        cap = self.config.buckets[-1]
+        parts: List[np.ndarray] = []
+        for lo in range(0, len(x), cap):
+            chunk = x[lo:lo + cap]
+            n = len(chunk)
+            b = bucket_for(n, self.config.buckets)
+            key = (mv.version, b)
+            with self._stats_lock:
+                hit = key in self._bucket_seen
+                if not hit:
+                    self._bucket_seen.add(key)
+            self._count("bucket_hits" if hit else "bucket_misses")
+            if b > n:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - n, chunk.shape[1]))])
+            raw = predictor.predict(mv.src, chunk, raw_score=True,
+                                    device=True, stacked=mv.stacked)
+            out = convert_output(mv.src, raw) if kind == "predict" \
+                else raw
+            parts.append(np.asarray(out)[:n])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    # -- bookkeeping ---------------------------------------------------
+    def _fail(self, req: _Request, err: ServingError) -> None:
+        req.error = err
+        req.meta.update(error=err.code)
+        self._count("errors")
+        req.event.set()
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        with self._stats_lock:
+            self._counts[name] = self._counts.get(name, 0.0) + value
+        get_telemetry().count(f"serving.{name}", value)
+
+    def _observe_latency(self, ms: float) -> None:
+        with self._stats_lock:
+            if len(self._latencies) >= self._latency_cap:
+                # reservoir half-drop keeps recent traffic dominant
+                del self._latencies[:self._latency_cap // 2]
+            self._latencies.append(ms)
+        get_telemetry().observe("serving.latency_ms", ms)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def stats(self) -> Dict[str, Any]:
+        """Counter + latency snapshot (also emitted as the
+        ``serving_stats`` telemetry record on stop)."""
+        with self._stats_lock:
+            counts = dict(self._counts)
+            lats = list(self._latencies)
+        out: Dict[str, Any] = {
+            "requests": int(counts.get("requests", 0)),
+            "rows": int(counts.get("rows", 0)),
+            "batches": int(counts.get("batches", 0)),
+            "shed": int(counts.get("shed", 0)),
+            "timeouts": int(counts.get("timeouts", 0)),
+            "fallbacks": int(counts.get("fallbacks", 0)),
+            "errors": int(counts.get("errors", 0)),
+            "reloads": int(counts.get("reloads", 0)),
+            "bucket_hits": int(counts.get("bucket_hits", 0)),
+            "bucket_misses": int(counts.get("bucket_misses", 0)),
+            "queue_depth": self.queue_depth,
+            "queue_peak": self._queue_peak,
+        }
+        total_b = out["bucket_hits"] + out["bucket_misses"]
+        out["bucket_hit_rate"] = round(out["bucket_hits"] / total_b, 4) \
+            if total_b else None
+        if lats:
+            arr = np.asarray(lats)
+            out["latency_ms"] = {
+                "count": len(lats),
+                "p50": round(float(np.percentile(arr, 50)), 3),
+                "p95": round(float(np.percentile(arr, 95)), 3),
+                "p99": round(float(np.percentile(arr, 99)), 3),
+                "max": round(float(arr.max()), 3),
+            }
+        mv = self.registry.current()
+        if mv is not None:
+            out["model"] = mv.describe()
+        return out
+
+    def health(self) -> Dict[str, Any]:
+        mv = self.registry.current()
+        return {
+            "status": "ok" if mv is not None else "no_model",
+            "version": None if mv is None else mv.version,
+            "device_ready": bool(mv is not None and mv.device_ready),
+            "started": self._started,
+            "queue_depth": self.queue_depth,
+            "buckets": list(self.config.buckets),
+            "versions": self.registry.versions(),
+        }

@@ -1,0 +1,64 @@
+"""Structured serving errors.
+
+Every failure mode of the serving engine raises (or fulfills a future
+with) one of these — a shed or timed-out request gets a typed error
+with a machine-readable ``code``, never a hang. ``to_dict()`` is the
+JSON wire shape the HTTP frontend returns, with ``http_status``
+picking the response code (429 shed, 504 timeout, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class ServingError(Exception):
+    """Base serving error; ``code`` is stable and machine-readable."""
+
+    code = "serving_error"
+    http_status = 500
+
+    def __init__(self, message: str = "", **details: Any):
+        super().__init__(message or self.code)
+        self.details = details
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"error": self.code, "message": str(self)}
+        if self.details:
+            out.update(self.details)
+        return out
+
+
+class QueueFullError(ServingError):
+    """Load shed: the bounded request queue is at ``max_queue``."""
+
+    code = "queue_full"
+    http_status = 429
+
+
+class RequestTimeoutError(ServingError):
+    """The request's deadline passed before a result was produced."""
+
+    code = "timeout"
+    http_status = 504
+
+
+class EngineStoppedError(ServingError):
+    """The engine was stopped while the request was pending."""
+
+    code = "engine_stopped"
+    http_status = 503
+
+
+class ModelLoadError(ServingError):
+    """A model source could not be loaded/parsed."""
+
+    code = "model_load_error"
+    http_status = 400
+
+
+class InvalidRequestError(ServingError):
+    """Malformed request payload (bad shape, non-numeric rows, ...)."""
+
+    code = "invalid_request"
+    http_status = 400

@@ -1,0 +1,141 @@
+"""Stdlib JSON HTTP frontend for the serving engine.
+
+``python -m lightgbm_tpu serve input_model=model.txt serving_port=8080``
+starts it; everything is stdlib ``http.server`` on purpose — the
+serving container needs no web framework.
+
+Endpoints (all JSON):
+
+* ``POST /predict``    body ``{"rows": [[...], ...]}`` (or ``"row"``)
+* ``POST /raw_score``  same body, raw margins
+* ``POST /pred_leaf``  same body, per-tree leaf indices
+* ``GET  /health``     engine + model-version status
+* ``GET  /stats``      counter/latency snapshot
+* ``POST /reload``     ``{"model_file": path}`` or ``{"model_str": txt}``
+
+Errors are structured (``{"error": code, "message": ...}``) with the
+HTTP status from the serving error type: 429 queue-full shed, 504
+deadline timeout, 400 malformed input, 503 stopped.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+from .engine import ServingEngine
+from .errors import InvalidRequestError, ServingError
+
+_MAX_BODY = 256 << 20  # one request body; predict payloads are rows
+
+
+class ServingHandler(BaseHTTPRequestHandler):
+    engine: ServingEngine = None   # set by make_http_server
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers -------------------------------------------------------
+    def _send_json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length <= 0:
+            raise InvalidRequestError("empty request body")
+        if length > _MAX_BODY:
+            raise InvalidRequestError("request body too large",
+                                      limit=_MAX_BODY)
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as e:
+            raise InvalidRequestError(f"invalid JSON: {e}") from e
+
+    def log_message(self, fmt, *args):  # route through our logger
+        pass
+
+    # -- routes --------------------------------------------------------
+    def do_GET(self):
+        try:
+            if self.path == "/health":
+                self._send_json(200, self.engine.health())
+            elif self.path == "/stats":
+                self._send_json(200, self.engine.stats())
+            else:
+                self._send_json(404, {"error": "not_found",
+                                      "message": self.path})
+        except Exception as e:  # pragma: no cover - defensive
+            self._send_json(500, {"error": "internal",
+                                  "message": str(e)})
+
+    def do_POST(self):
+        try:
+            kind = self.path.strip("/")
+            if kind in ("predict", "raw_score", "pred_leaf"):
+                self._predict(kind)
+            elif kind == "reload":
+                self._reload()
+            else:
+                self._send_json(404, {"error": "not_found",
+                                      "message": self.path})
+        except ServingError as e:
+            self._send_json(e.http_status, e.to_dict())
+        except Exception as e:  # pragma: no cover - defensive
+            log_warning(f"serving http: unhandled error: {e}")
+            self._send_json(500, {"error": "internal",
+                                  "message": str(e)})
+
+    def _predict(self, kind: str) -> None:
+        body = self._read_body()
+        rows = body.get("rows", body.get("row"))
+        if rows is None:
+            raise InvalidRequestError('body needs "rows" (or "row")')
+        timeout_ms = body.get("timeout_ms")
+        fut = self.engine.submit(rows, kind=kind, timeout_ms=timeout_ms)
+        t = self.engine.config.request_timeout_ms \
+            if timeout_ms is None else float(timeout_ms)
+        pred = fut.result(timeout=None if t <= 0 else t / 1000.0 + 5.0)
+        self._send_json(200, {
+            "predictions": np.asarray(pred).tolist(), **fut.meta})
+
+    def _reload(self) -> None:
+        body = self._read_body()
+        source = body.get("model_file") or body.get("model_str")
+        if not source:
+            raise InvalidRequestError(
+                'body needs "model_file" or "model_str"')
+        version = self.engine.reload(source)
+        self._send_json(200, {"status": "ok", "version": version})
+
+
+def make_http_server(engine: ServingEngine, host: str = "127.0.0.1",
+                     port: int = 8080) -> ThreadingHTTPServer:
+    """Build (but do not run) the threaded HTTP server; ``port=0``
+    binds an ephemeral port (``server.server_address`` has the real
+    one — tests use this)."""
+    handler = type("BoundServingHandler", (ServingHandler,),
+                   {"engine": engine})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_forever(engine: ServingEngine, host: str, port: int) -> None:
+    """Blocking serve loop (the CLI ``task=serve`` body)."""
+    server = make_http_server(engine, host, port)
+    addr = server.server_address
+    log_info(f"serving on http://{addr[0]}:{addr[1]} "
+             f"(model v{engine.version}, buckets "
+             f"{list(engine.config.buckets)})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover - interactive
+        pass
+    finally:
+        server.server_close()
+        engine.stop()

@@ -1,0 +1,165 @@
+"""Closed- and open-loop load generation for the serving engine.
+
+Shared by ``tools/serve_bench.py`` (standalone benchmark) and
+``bench.py`` (the training benchmark's ``serving`` block). Both loops
+drive an in-process :class:`ServingEngine` and report the same block::
+
+    {"mode", "duration_s", "requests", "rows", "errors",
+     "throughput_rps", "rows_per_s",
+     "p50_ms", "p95_ms", "p99_ms", "max_ms",
+     "bucket_hit_rate", "shed", "timeouts", "fallbacks"}
+
+* **closed loop** — N worker threads, each issuing the next request as
+  soon as the previous answer lands. Measures the engine's saturated
+  throughput and the latency under full concurrency.
+* **open loop** — requests arrive on a Poisson process at a target
+  QPS regardless of completions (the honest way to measure latency
+  under a given offered load; a closed loop self-throttles and hides
+  queueing).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import ServingEngine
+from .errors import ServingError
+
+
+def _percentiles(lat_ms: List[float]) -> Dict[str, float]:
+    if not lat_ms:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None,
+                "max_ms": None}
+    a = np.asarray(lat_ms)
+    return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p95_ms": round(float(np.percentile(a, 95)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            "max_ms": round(float(a.max()), 3)}
+
+
+def _block(mode: str, dur: float, lat_ms: List[float], rows: int,
+           errors: int, engine: ServingEngine) -> Dict:
+    stats = engine.stats()
+    out = {"mode": mode, "duration_s": round(dur, 3),
+           "requests": len(lat_ms), "rows": rows, "errors": errors,
+           "throughput_rps": round(len(lat_ms) / dur, 2) if dur else 0.0,
+           "rows_per_s": round(rows / dur, 2) if dur else 0.0}
+    out.update(_percentiles(lat_ms))
+    for key in ("bucket_hit_rate", "shed", "timeouts", "fallbacks",
+                "queue_peak"):
+        out[key] = stats.get(key)
+    return out
+
+
+def closed_loop(engine: ServingEngine, X: np.ndarray,
+                batch_sizes: Sequence[int] = (1,),
+                threads: int = 4, duration_s: float = 3.0,
+                kind: str = "predict",
+                seed: int = 0) -> Dict:
+    """``threads`` workers issue back-to-back requests of rotating
+    ``batch_sizes`` rows sampled from ``X`` for ``duration_s``."""
+    stop_at = time.monotonic() + duration_s
+    lat_lock = threading.Lock()
+    lat_ms: List[float] = []
+    rows_done = [0]
+    errors = [0]
+
+    def worker(tid: int) -> None:
+        rng = random.Random(seed + tid)
+        i = 0
+        while time.monotonic() < stop_at:
+            b = batch_sizes[i % len(batch_sizes)]
+            i += 1
+            lo = rng.randrange(max(len(X) - b, 1))
+            t0 = time.monotonic()
+            try:
+                engine.predict(X[lo:lo + b], kind=kind)
+            except ServingError:
+                with lat_lock:
+                    errors[0] += 1
+                continue
+            dt = (time.monotonic() - t0) * 1000.0
+            with lat_lock:
+                lat_ms.append(dt)
+                rows_done[0] += b
+    t_start = time.monotonic()
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(duration_s + 30.0)
+    dur = time.monotonic() - t_start
+    return _block("closed", dur, lat_ms, rows_done[0], errors[0], engine)
+
+
+def open_loop(engine: ServingEngine, X: np.ndarray,
+              qps: float = 200.0, duration_s: float = 3.0,
+              batch_sizes: Sequence[int] = (1,),
+              kind: str = "predict", seed: int = 0,
+              timeout_ms: Optional[float] = None) -> Dict:
+    """Poisson arrivals at ``qps`` for ``duration_s``; requests are
+    submitted asynchronously regardless of completions, then all
+    futures are collected. Shed/timeout responses count as errors —
+    that's the load-shedding behavior this loop exists to measure."""
+    rng = random.Random(seed)
+    futures = []
+    errors = 0
+    rows_sent = 0
+    t_start = time.monotonic()
+    stop_at = t_start + duration_s
+    next_at = t_start
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now >= stop_at:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.02))
+            continue
+        next_at += rng.expovariate(qps)
+        b = batch_sizes[i % len(batch_sizes)]
+        i += 1
+        lo = rng.randrange(max(len(X) - b, 1))
+        t0 = time.monotonic()
+        try:
+            fut = engine.submit(X[lo:lo + b], kind=kind,
+                                timeout_ms=timeout_ms)
+        except ServingError:
+            errors += 1
+            continue
+        futures.append((t0, b, fut))
+        rows_sent += b
+    lat_ms: List[float] = []
+    rows_done = 0
+    for t0, b, fut in futures:
+        try:
+            fut.result(timeout=30.0)
+        except ServingError:
+            errors += 1
+            continue
+        lat_ms.append((time.monotonic() - t0) * 1000.0
+                      if not fut.meta.get("latency_ms")
+                      else fut.meta["latency_ms"])
+        rows_done += b
+    dur = time.monotonic() - t_start
+    block = _block("open", dur, lat_ms, rows_done, errors, engine)
+    block["offered_qps"] = qps
+    return block
+
+
+def serving_block(engine: ServingEngine, X: np.ndarray,
+                  batch_sizes: Sequence[int] = (1, 8, 64),
+                  threads: int = 2, duration_s: float = 2.0) -> Dict:
+    """The compact closed-loop measurement ``bench.py`` embeds as the
+    bench JSON's ``serving`` block."""
+    block = closed_loop(engine, X, batch_sizes=batch_sizes,
+                        threads=threads, duration_s=duration_s)
+    block["batch_sizes"] = list(batch_sizes)
+    block["buckets"] = list(engine.config.buckets)
+    return block

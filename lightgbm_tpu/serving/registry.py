@@ -1,0 +1,266 @@
+"""Versioned model registry with device pinning and hot reload.
+
+Each loaded model becomes a :class:`ModelVersion`: the prediction
+source (a trained ``GBDT`` or a text-parsed ``LoadedBooster``), plus —
+for dataset-backed models — the stacked SoA tree arrays pinned on
+device (``predictor.StackedTrees``), built once per version instead of
+per request.
+
+Hot reload is an atomic pointer swap: :meth:`ModelRegistry.activate`
+replaces the current version under a lock; requests already dispatched
+keep the version they acquired (``checkout``), and the old version's
+device arrays are freed only when its in-flight count drains to zero.
+
+Sources accepted by :meth:`ModelRegistry.load`:
+
+* an in-memory ``basic.Booster`` / ``models.GBDT`` / ``LoadedBooster``;
+* a model-text string (starts with ``tree\\n``);
+* a path to a model text file;
+* a path to an ``.npz`` written by :func:`save_model_npz`.
+
+Text/npz sources carry no bin mappers, so they serve through the
+vectorized host traversal; in-memory trained boosters additionally get
+the compiled bucketed device path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zipfile
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+from .errors import ModelLoadError, ServingError
+
+_NPZ_FORMAT = "lightgbm_tpu.serving.model.v1"
+
+
+def save_model_npz(src, path: str) -> None:
+    """Serialize a booster into an ``.npz`` the registry can load.
+
+    The payload is the reference model-text format (the repo's lingua
+    franca for model interchange) wrapped in an npz member, plus a
+    format tag — a single-file binary artifact for deploy pipelines
+    that already move npz datasets around.
+    """
+    from ..io.model_text import save_model_to_string
+    if hasattr(src, "_src"):                      # basic.Booster
+        text = src.model_to_string()
+    else:
+        text = save_model_to_string(src)
+    np.savez(path, format=np.asarray(_NPZ_FORMAT),
+             model_text=np.asarray(text))
+
+
+def _load_npz(path: str):
+    from ..io.model_text import load_model_from_string
+    with np.load(path, allow_pickle=False) as z:
+        if "model_text" not in z.files:
+            raise ModelLoadError(
+                f"{path!r} is not a serving model npz "
+                "(no model_text member)", path=path)
+        fmt = str(z["format"]) if "format" in z.files else ""
+        if fmt and fmt != _NPZ_FORMAT:
+            log_warning(f"serving npz {path!r} has format {fmt!r}; "
+                        f"expected {_NPZ_FORMAT!r} — trying anyway")
+        return load_model_from_string(str(z["model_text"]))
+
+
+class ModelVersion:
+    """One immutable loaded model + its device residency + drain state."""
+
+    def __init__(self, version: int, src, source_desc: str,
+                 booster=None):
+        self.version = version
+        self.src = src
+        self.booster = booster          # keep a basic.Booster alive
+        self.source_desc = source_desc
+        self.created_at = time.time()
+        self.k = int(src.num_tree_per_iteration)
+        self.num_trees = len(src.models)
+        self.dataset = None
+        if getattr(src, "learner", None) is not None:
+            self.dataset = src.learner.dataset
+        self.stacked = None
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._draining = False
+
+    # -- device residency ----------------------------------------------
+    def pin_device(self) -> bool:
+        """Stack the trees and upload once; True when the compiled
+        device route is available for this version."""
+        if self.stacked is not None:
+            return True
+        if self.dataset is None or not self.src.models:
+            return False
+        from ..predictor import stack_tree_arrays
+        try:
+            st = stack_tree_arrays(self.src.models, self.k)
+            st.device()                  # upload now, not per request
+        except Exception as e:  # tree layout w/o bundled columns etc.
+            log_warning(f"serving: device pinning unavailable for "
+                        f"version {self.version}: {e}")
+            return False
+        self.stacked = st
+        return True
+
+    @property
+    def device_ready(self) -> bool:
+        return self.stacked is not None
+
+    # -- draining ------------------------------------------------------
+    def acquire(self) -> "ModelVersion":
+        with self._lock:
+            self._inflight += 1
+        return self
+
+    def release(self) -> None:
+        free = False
+        with self._lock:
+            self._inflight -= 1
+            if self._draining and self._inflight <= 0:
+                free = True
+        if free:
+            self._free()
+
+    def start_draining(self) -> None:
+        free = False
+        with self._lock:
+            self._draining = True
+            free = self._inflight <= 0
+        if free:
+            self._free()
+
+    def _free(self) -> None:
+        # drop the pinned device buffers; the python trees stay (cheap)
+        self.stacked = None
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def describe(self) -> dict:
+        return {"version": self.version, "source": self.source_desc,
+                "num_trees": self.num_trees, "k": self.k,
+                "device_ready": self.device_ready,
+                "draining": self._draining, "inflight": self._inflight,
+                "created_at": self.created_at}
+
+
+class _Checkout:
+    """Context manager pairing acquire/release around one dispatch."""
+
+    __slots__ = ("mv",)
+
+    def __init__(self, mv: ModelVersion):
+        self.mv = mv
+
+    def __enter__(self) -> ModelVersion:
+        return self.mv
+
+    def __exit__(self, *exc):
+        self.mv.release()
+        return False
+
+
+class ModelRegistry:
+    """Thread-safe versioned model store with atomic activation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current: Optional[ModelVersion] = None
+        self._history: List[ModelVersion] = []
+        self._next_version = 1
+
+    # -- loading -------------------------------------------------------
+    def load(self, source: Any, pin_device: bool = True) -> ModelVersion:
+        """Resolve a source into a new (inactive) ModelVersion."""
+        src, desc, booster = self._resolve(source)
+        if hasattr(src, "finalize_trees"):
+            src.finalize_trees()
+        if not src.models:
+            raise ModelLoadError("model has no trees", source=desc)
+        with self._lock:
+            v = self._next_version
+            self._next_version += 1
+        mv = ModelVersion(v, src, desc, booster=booster)
+        if pin_device:
+            mv.pin_device()
+        return mv
+
+    def _resolve(self, source):
+        from ..io.model_text import (LoadedBooster,
+                                     load_model_from_string)
+        booster = None
+        if hasattr(source, "_src"):                 # basic.Booster
+            booster = source
+            return source._src(), "booster", booster
+        if hasattr(source, "models") \
+                and hasattr(source, "num_tree_per_iteration"):
+            return source, type(source).__name__, None
+        if isinstance(source, str):
+            if "\n" in source:                      # model text
+                try:
+                    return (load_model_from_string(source),
+                            "model_str", None)
+                except Exception as e:
+                    raise ModelLoadError(
+                        f"cannot parse model string: {e}") from e
+            if not os.path.exists(source):
+                raise ModelLoadError(f"model file not found: {source!r}",
+                                     path=source)
+            if source.endswith(".npz") or zipfile.is_zipfile(source):
+                return _load_npz(source), source, None
+            try:
+                with open(source) as f:
+                    return load_model_from_string(f.read()), source, None
+            except ServingError:
+                raise
+            except Exception as e:
+                raise ModelLoadError(
+                    f"cannot load model file {source!r}: {e}",
+                    path=source) from e
+        raise ModelLoadError(
+            f"unsupported model source type {type(source).__name__}")
+
+    # -- activation / checkout -----------------------------------------
+    def activate(self, mv: ModelVersion) -> ModelVersion:
+        """Atomically make ``mv`` current; the previous version drains
+        (device arrays freed once its in-flight count hits zero)."""
+        with self._lock:
+            old = self._current
+            self._current = mv
+            self._history.append(mv)
+        if old is not None:
+            old.start_draining()
+            log_info(f"serving: model v{old.version} -> v{mv.version} "
+                     f"({mv.source_desc}, {mv.num_trees} trees, "
+                     f"device={'yes' if mv.device_ready else 'no'})")
+        return mv
+
+    def current(self) -> Optional[ModelVersion]:
+        with self._lock:
+            return self._current
+
+    def checkout(self) -> _Checkout:
+        """Acquire the current version for one dispatch (refcounted so
+        a concurrent hot reload cannot free it mid-flight)."""
+        with self._lock:
+            mv = self._current
+            if mv is None:
+                raise ServingError("no model loaded")
+            mv.acquire()
+        return _Checkout(mv)
+
+    def versions(self) -> List[dict]:
+        with self._lock:
+            return [mv.describe() for mv in self._history]

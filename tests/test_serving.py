@@ -1,0 +1,652 @@
+"""Serving subsystem tests: parity, buckets, shedding, hot reload.
+
+Acceptance gates from the serving issue:
+  * ServingEngine responses bit-identical to ``predictor.predict`` for
+    every output kind (including across a hot reload);
+  * steady-state mixed batch sizes {1, 7, 64, 300} trigger ZERO new
+    XLA compilations after warmup (compile-hook counter);
+  * queue-full and timeout paths return structured errors, never hang;
+  * hot reload swaps versions with no failed requests under
+    concurrent traffic.
+"""
+
+import ctypes
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability.telemetry import get_telemetry
+from lightgbm_tpu.serving import (ModelRegistry, QueueFullError,
+                                  RequestTimeoutError, ServingConfig,
+                                  ServingEngine, ServingError,
+                                  save_model_npz)
+from lightgbm_tpu.serving.errors import (EngineStoppedError,
+                                         InvalidRequestError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy(n=600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    X, y = _toy()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def multiclass_model():
+    rng = np.random.RandomState(3)
+    X = rng.randn(450, 5)
+    y = (X[:, 0] > 0.4).astype(int) + (X[:, 1] > 0).astype(int)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y.astype(np.float64)),
+                    num_boost_round=5)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def regression_model():
+    rng = np.random.RandomState(5)
+    X = rng.randn(400, 5)
+    y = X[:, 0] * 2.0 - X[:, 1] + 0.1 * rng.randn(400)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=6)
+    return bst, X
+
+
+@pytest.fixture
+def tel():
+    t = get_telemetry()
+    t.reset()
+    t.ensure_ring()
+    yield t
+    t.reset()
+
+
+# ----------------------------------------------------------------------
+# parity: bit-identical to predictor.predict
+@pytest.mark.parametrize("fixture", ["binary_model", "multiclass_model",
+                                     "regression_model"])
+def test_parity_default_route(fixture, request):
+    """device='auto' mirrors predictor.predict's own routing rule, so
+    every response is bit-identical to a direct predict of the same
+    rows — for predict, raw_score AND pred_leaf."""
+    bst, X = request.getfixturevalue(fixture)
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), warmup=False, flush_interval_ms=1.0))
+    try:
+        for n in (1, 7, 16):
+            rows = X[:n]
+            np.testing.assert_array_equal(eng.predict(rows),
+                                          bst.predict(rows))
+            np.testing.assert_array_equal(
+                eng.predict(rows, kind="raw_score"),
+                bst.predict(rows, raw_score=True))
+            np.testing.assert_array_equal(
+                eng.predict(rows, kind="pred_leaf"),
+                bst.predict(rows, pred_leaf=True))
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("fixture", ["binary_model", "multiclass_model"])
+def test_parity_compiled_route_bit_identical(fixture, request,
+                                             monkeypatch):
+    """The compiled bucketed device path (padding + pinned stacked
+    arrays + coalescing) is bit-identical to a direct device predict of
+    the same rows — rows are independent lanes of the scan, so padding
+    and batching cannot perturb a single bit."""
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    bst, X = request.getfixturevalue(fixture)
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), device="always", flush_interval_ms=1.0))
+    try:
+        assert eng.registry.current().device_ready
+        for n in (1, 5, 16, 23):   # 23 > max bucket -> chunked 16+7
+            rows = X[:n]
+            np.testing.assert_array_equal(eng.predict(rows),
+                                          bst.predict(rows))
+            np.testing.assert_array_equal(
+                eng.predict(rows, kind="raw_score"),
+                bst.predict(rows, raw_score=True))
+    finally:
+        eng.stop()
+
+
+def test_zero_recompiles_after_warmup(binary_model, tel):
+    """Steady-state serving of mixed batch sizes {1, 7, 64, 300} must
+    trigger ZERO new XLA compilations after warmup (the compile-hook
+    counter is the jax.monitoring backend_compile listener)."""
+    bst, X = binary_model
+    big = np.concatenate([X] * 2)        # 1200 rows to slice 300 from
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(1, 8, 64, 512), device="always",
+        flush_interval_ms=0.5))
+    try:
+        compiles_after_warmup = tel.counters.get("jit.compiles", 0)
+        for _round in range(3):
+            for n in (1, 7, 64, 300):
+                for kind in ("predict", "raw_score"):
+                    out = eng.predict(big[:n], kind=kind)
+                    assert len(out) == n
+        assert tel.counters.get("jit.compiles", 0) \
+            == compiles_after_warmup, \
+            "steady-state mixed-size serving recompiled"
+        st = eng.stats()
+        assert st["bucket_misses"] <= 4      # one per bucket, at warmup
+        assert st["bucket_hits"] >= 20
+        assert st["bucket_hit_rate"] > 0.8
+    finally:
+        eng.stop()
+
+
+def test_hot_reload_concurrent_no_failures(binary_model, monkeypatch):
+    """Threads hammer the queue while the model hot-reloads mid-flight:
+    zero failed requests, and every response is bit-identical to the
+    direct predict of whichever version served it."""
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    bst1, X = binary_model
+    bst2 = lgb.train({"objective": "binary", "num_leaves": 5,
+                      "verbosity": -1},
+                     lgb.Dataset(X, label=(X[:, 0] > 0).astype(float)),
+                     num_boost_round=4)
+    sizes = [1, 3, 8, 13]
+    slices = [X[i:i + s] for i, s in
+              [(j % 50, sizes[j % len(sizes)]) for j in range(40)]]
+    refs = {}
+    for v, b in ((1, bst1), (2, bst2)):
+        refs[v] = {"predict": [b.predict(s) for s in slices],
+                   "pred_leaf": [b.predict(s, pred_leaf=True)
+                                 for s in slices]}
+
+    eng = ServingEngine(bst1, config=ServingConfig(
+        buckets=(4, 16), device="always", flush_interval_ms=1.0,
+        request_timeout_ms=30000))
+    failures = []
+    done = threading.Event()
+
+    def hammer(tid):
+        rng = np.random.RandomState(tid)
+        while not done.is_set():
+            i = rng.randint(len(slices))
+            kind = "pred_leaf" if rng.rand() < 0.3 else "predict"
+            try:
+                fut = eng.submit(slices[i], kind=kind, timeout_ms=30000)
+                out = fut.result(timeout=30)
+                v = fut.meta["version"]
+                np.testing.assert_array_equal(out, refs[v][kind][i])
+            except Exception as e:  # noqa: BLE001
+                failures.append((tid, kind, repr(e)))
+                return
+
+    threads = [threading.Thread(target=hammer, args=(t,), daemon=True)
+               for t in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.3)
+        v2 = eng.reload(bst2)            # swap mid-flight
+        assert v2 == 2
+        time.sleep(0.3)
+        done.set()
+        for t in threads:
+            t.join(30)
+        assert not failures, failures[:3]
+        # the old version drained and dropped its device pinning
+        hist = eng.registry.versions()
+        assert hist[0]["version"] == 1 and hist[0]["draining"]
+        assert hist[0]["inflight"] == 0
+        assert not eng.registry._history[0].device_ready
+        assert eng.registry.current().version == 2
+    finally:
+        done.set()
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+# degradation: shed / timeout / fallback — structured, never a hang
+def test_queue_full_reject_new_and_timeout(binary_model):
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4,), warmup=False, max_queue=3,
+        request_timeout_ms=100), auto_start=False)
+    futs = [eng.submit(X[:1]) for _ in range(3)]
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(X[:1])
+    assert ei.value.to_dict()["error"] == "queue_full"
+    assert ei.value.http_status == 429
+    # caller-side wait also times out structurally (flusher is off)
+    with pytest.raises(RequestTimeoutError):
+        futs[0].result(timeout=0.05)
+    import time
+    time.sleep(0.15)                      # let every deadline pass
+    eng.start()
+    for f in futs:                        # flusher-side expiry
+        with pytest.raises(RequestTimeoutError) as ei:
+            f.result(timeout=10)
+        assert ei.value.to_dict()["error"] == "timeout"
+    # the engine still serves fresh requests afterwards
+    np.testing.assert_array_equal(eng.predict(X[:2]), bst.predict(X[:2]))
+    assert eng.stats()["timeouts"] == 3
+    assert eng.stats()["shed"] == 1
+    eng.stop()
+    with pytest.raises(EngineStoppedError):
+        eng.submit(X[:1])
+
+
+def test_queue_full_drop_oldest(binary_model):
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4,), warmup=False, max_queue=2,
+        shed_policy="drop_oldest"), auto_start=False)
+    try:
+        f1 = eng.submit(X[:1], timeout_ms=0)
+        f2 = eng.submit(X[1:2], timeout_ms=0)
+        f3 = eng.submit(X[2:3], timeout_ms=0)   # evicts f1
+        assert f1.done()
+        with pytest.raises(QueueFullError):
+            f1.result()
+        eng.start()
+        np.testing.assert_array_equal(f2.result(timeout=10),
+                                      bst.predict(X[1:2]))
+        np.testing.assert_array_equal(f3.result(timeout=10),
+                                      bst.predict(X[2:3]))
+    finally:
+        eng.stop()
+
+
+def test_flood_past_max_queue_structured(binary_model):
+    """Flooding the engine past max_queue from 10 threads: every
+    submission either succeeds or sheds with a typed error — exact
+    accounting, no hangs (the acceptance's flood test)."""
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4,), warmup=False, max_queue=2,
+        request_timeout_ms=10000), auto_start=False)
+    results = []
+    lock = threading.Lock()
+
+    def submit_one(i):
+        try:
+            f = eng.submit(X[i % 50:i % 50 + 1])
+            with lock:
+                results.append(("ok", f))
+        except ServingError as e:
+            with lock:
+                results.append(("shed", e))
+
+    threads = [threading.Thread(target=submit_one, args=(i,))
+               for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    try:
+        shed = [r for r in results if r[0] == "shed"]
+        ok = [r for r in results if r[0] == "ok"]
+        assert len(results) == 10
+        assert len(ok) == 2 and len(shed) == 8     # bounded queue held
+        assert all(isinstance(e, QueueFullError) for _, e in shed)
+        eng.start()
+        for _, f in ok:
+            assert len(f.result(timeout=10)) == 1  # queued ones served
+    finally:
+        eng.stop()
+
+
+def test_device_failure_falls_back_to_host(binary_model, monkeypatch):
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4,), device="always", warmup=False,
+        flush_interval_ms=1.0))
+    try:
+        import lightgbm_tpu.predictor as predictor
+
+        def boom(*a, **k):
+            raise RuntimeError("injected device failure")
+        monkeypatch.setattr(predictor, "_scan_trees", boom)
+        out = eng.predict(X[:5])
+        np.testing.assert_array_equal(out, bst.predict(X[:5]))
+        assert eng.stats()["fallbacks"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_invalid_requests_structured(binary_model):
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4,), warmup=False), auto_start=False)
+    with pytest.raises(InvalidRequestError):
+        eng.submit(X[:2, :3])             # wrong feature count
+    with pytest.raises(InvalidRequestError):
+        eng.submit([["a", "b"]])          # non-numeric
+    with pytest.raises(InvalidRequestError):
+        eng.submit(X[:1], kind="nope")
+
+
+# ----------------------------------------------------------------------
+# registry: sources, npz round trip, versioning
+def test_loaded_text_npz_and_string_sources(binary_model, tmp_path):
+    bst, X = binary_model
+    txt = tmp_path / "model.txt"
+    npz = tmp_path / "model.npz"
+    bst.save_model(str(txt))
+    save_model_npz(bst, str(npz))
+    ref = lgb.Booster(model_file=str(txt)).predict(X[:9])
+
+    for source in (str(txt), str(npz), txt.read_text()):
+        eng = ServingEngine(source, config=ServingConfig(
+            buckets=(4, 16), flush_interval_ms=1.0))
+        try:
+            mv = eng.registry.current()
+            assert not mv.device_ready    # no mappers -> host route
+            np.testing.assert_array_equal(eng.predict(X[:9]), ref)
+        finally:
+            eng.stop()
+
+
+def test_registry_version_sequence(binary_model, tmp_path):
+    bst, X = binary_model
+    reg = ModelRegistry()
+    v1 = reg.load(bst)
+    reg.activate(v1)
+    assert reg.current().version == 1 and v1.device_ready
+    txt = tmp_path / "m.txt"
+    bst.save_model(str(txt))
+    v2 = reg.load(str(txt))
+    reg.activate(v2)
+    assert reg.current().version == 2
+    assert v1.draining and not v1.device_ready
+
+
+# ----------------------------------------------------------------------
+# predictor satellites: bucket padding + jit cache-hit counter
+def test_predictor_bucket_padding_and_cache_hits(binary_model, tel,
+                                                 monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    bst, X = binary_model
+    # both 5 and 7 rows pad to the 8-bucket: the second size must be a
+    # jit cache hit, not a new compile
+    p5 = bst.predict(X[:5], raw_score=True)
+    compiles = tel.counters.get("jit.compiles", 0)
+    hits = tel.counters.get("jit.cache_hits", 0)
+    p7 = bst.predict(X[:7], raw_score=True)
+    assert tel.counters.get("jit.compiles", 0) == compiles
+    assert tel.counters.get("jit.cache_hits", 0) > hits
+    # padding is exact: the bucketed result matches the unbucketed scan
+    monkeypatch.setenv("LGBM_TPU_PREDICT_BUCKETS", "0")
+    np.testing.assert_array_equal(p5, bst.predict(X[:5], raw_score=True))
+    np.testing.assert_array_equal(p7, bst.predict(X[:7], raw_score=True))
+
+
+def test_bucket_rows_helper():
+    from lightgbm_tpu.predictor import bucket_rows
+    assert [bucket_rows(n) for n in (1, 2, 3, 8, 9, 300)] \
+        == [1, 2, 4, 8, 16, 512]
+
+
+# ----------------------------------------------------------------------
+# output-transform satellite: one shared helper, pinned equal
+@pytest.mark.parametrize("objective,params,obj_str", [
+    ("binary", {"sigmoid": 2.0}, "binary sigmoid:2.0"),
+    ("multiclass", {"num_class": 3}, "multiclass num_class:3"),
+    ("multiclassova", {"num_class": 3, "sigmoid": 1.5},
+     "multiclassova sigmoid:1.5 num_class:3"),
+    ("regression", {}, "regression"),
+    ("poisson", {}, "poisson"),
+    ("gamma", {}, "gamma"),
+    ("tweedie", {}, "tweedie"),
+    ("cross_entropy", {}, "cross_entropy"),
+    ("cross_entropy_lambda", {}, "cross_entropy_lambda"),
+])
+def test_output_transform_objective_vs_string(objective, params,
+                                              obj_str):
+    """The string-objective path (loaded-text models) and the objective
+    object's convert_output must agree — the shared helper in
+    objective/output.py is the single implementation the text path
+    uses."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objective import create_objective
+    from lightgbm_tpu.objective.output import convert_raw_score
+    cfg = Config.from_params({"objective": objective, **params})
+    obj = create_objective(cfg)
+    rng = np.random.RandomState(0)
+    k = params.get("num_class", 1)
+    raw = rng.randn(40, k) * 2 if k > 1 else rng.randn(40) * 2
+    via_obj = np.asarray(obj.convert_output(jnp.asarray(raw)))
+    via_str = convert_raw_score(obj_str, raw)
+    np.testing.assert_allclose(via_obj, via_str, rtol=1e-5, atol=1e-6)
+
+
+def test_loaded_booster_xentlambda_transform_fixed(tmp_path):
+    """cross_entropy_lambda models loaded from text used to silently
+    return raw scores; the shared helper applies log1p(exp(x))."""
+    X, y = _toy(300)
+    bst = lgb.train({"objective": "xentlambda", "verbosity": -1,
+                     "num_leaves": 5},
+                    lgb.Dataset(X, label=(y * 0.8 + 0.1)),
+                    num_boost_round=3)
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(loaded.predict(X[:20]),
+                               bst.predict(X[:20]), rtol=1e-5,
+                               atol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# C-API single-row fast path
+def test_capi_single_row_fast(binary_model):
+    from lightgbm_tpu import capi_impl
+    bst, X = binary_model
+    h = capi_impl._register(bst)
+    try:
+        fc = capi_impl.booster_predict_for_mat_single_row_fast_init(
+            h, capi_impl.PREDICT_NORMAL, -1, capi_impl.DTYPE_FLOAT64,
+            X.shape[1], "")
+        assert capi_impl._get(fc).engine is not None
+        out = np.zeros(1)
+        for i in range(5):
+            row = np.ascontiguousarray(X[i])
+            n = capi_impl.booster_predict_for_mat_single_row_fast(
+                fc, row.ctypes.data, out.ctypes.data)
+            assert n == 1
+            np.testing.assert_array_equal(out[0],
+                                          bst.predict(X[i:i + 1])[0])
+        capi_impl.fast_config_free(fc)
+
+        # pred_leaf kind: out length = number of trees
+        fcl = capi_impl.booster_predict_for_mat_single_row_fast_init(
+            h, capi_impl.PREDICT_LEAF_INDEX, -1,
+            capi_impl.DTYPE_FLOAT64, X.shape[1], "")
+        out = np.zeros(bst.num_trees())
+        row = np.ascontiguousarray(X[0])
+        n = capi_impl.booster_predict_for_mat_single_row_fast(
+            fcl, row.ctypes.data, out.ctypes.data)
+        assert n == bst.num_trees()
+        np.testing.assert_array_equal(
+            out, bst.predict(X[:1], pred_leaf=True)[0])
+        capi_impl.fast_config_free(fcl)
+
+        # truncated num_iteration falls back to the plain path but
+        # still honors the truncation
+        fct = capi_impl.booster_predict_for_mat_single_row_fast_init(
+            h, capi_impl.PREDICT_NORMAL, 3, capi_impl.DTYPE_FLOAT64,
+            X.shape[1], "")
+        assert capi_impl._get(fct).engine is None
+        out = np.zeros(1)
+        capi_impl.booster_predict_for_mat_single_row_fast(
+            fct, row.ctypes.data, out.ctypes.data)
+        np.testing.assert_array_equal(
+            out[0], bst.predict(X[:1], num_iteration=3)[0])
+        capi_impl.fast_config_free(fct)
+    finally:
+        capi_impl.free_handle(h)
+
+
+# ----------------------------------------------------------------------
+# HTTP frontend
+def test_http_server_endpoints(binary_model, tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from lightgbm_tpu.serving.http import make_http_server
+    bst, X = binary_model
+    txt = tmp_path / "m.txt"
+    bst.save_model(str(txt))
+    eng = ServingEngine(str(txt), config=ServingConfig(
+        buckets=(4,), flush_interval_ms=1.0))
+    server = make_http_server(eng, "127.0.0.1", 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        status, body = post("/predict", {"rows": X[:3].tolist()})
+        assert status == 200
+        np.testing.assert_allclose(body["predictions"],
+                                   bst.predict(X[:3]))
+        assert body["version"] == 1
+
+        status, body = post("/raw_score", {"row": X[0].tolist()})
+        assert status == 200
+        np.testing.assert_allclose(body["predictions"],
+                                   bst.predict(X[:1], raw_score=True))
+
+        status, body = post("/pred_leaf", {"rows": X[:2].tolist()})
+        assert np.asarray(body["predictions"]).shape \
+            == (2, bst.num_trees())
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok" and health["version"] == 1
+
+        # hot reload over HTTP
+        status, body = post("/reload", {"model_file": str(txt)})
+        assert status == 200 and body["version"] == 2
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["requests"] >= 3
+
+        # structured 400 on malformed input
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/predict", {"rows": [[1.0, 2.0]]})   # wrong width
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"] == "invalid_request"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/nope", {})
+        assert ei.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+# load generators + bench/report wiring
+def test_loadgen_and_serve_bench_append(binary_model, tmp_path):
+    from lightgbm_tpu.serving.loadgen import (closed_loop, open_loop,
+                                              serving_block)
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), warmup=False, flush_interval_ms=0.5))
+    try:
+        block = closed_loop(eng, X, batch_sizes=(1, 4), threads=2,
+                            duration_s=0.4)
+        assert block["mode"] == "closed" and block["requests"] > 0
+        assert block["p50_ms"] is not None and block["errors"] == 0
+        ob = open_loop(eng, X, qps=100, duration_s=0.4)
+        assert ob["mode"] == "open" and ob["requests"] > 0
+        sb = serving_block(eng, X, batch_sizes=(1, 4), threads=2,
+                           duration_s=0.3)
+        for key in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+                    "rows_per_s", "bucket_hit_rate", "shed",
+                    "timeouts", "fallbacks"):
+            assert key in sb
+    finally:
+        eng.stop()
+
+    # the bench JSON artifact gains a serving block run_report renders
+    bench = tmp_path / "BENCH.json"
+    bench.write_text(json.dumps({"metric": "higgs_like", "value": 1}))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(REPO, "tools", "serve_bench.py"))
+    sb_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb_mod)
+    rc = sb_mod.main(["--mode", "closed", "--duration", "0.3",
+                      "--threads", "2", "--rows", "300",
+                      "--buckets", "1,8", "--device", "never",
+                      "--append-bench", str(bench)])
+    assert rc == 0
+    merged = json.loads(bench.read_text())
+    assert merged["metric"] == "higgs_like"
+    assert merged["serving"]["requests"] > 0
+    assert "p99_ms" in merged["serving"]
+
+
+def test_run_report_renders_serving(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "run_report", os.path.join(REPO, "tools", "run_report.py"))
+    rr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rr)
+    records = [
+        {"kind": "run_start", "t": 0, "backend": "cpu",
+         "device_count": 1, "jax_version": "x"},
+        {"kind": "serving_stats", "t": 1.0, "requests": 42, "rows": 99,
+         "batches": 12, "shed": 1, "timeouts": 2, "fallbacks": 0,
+         "errors": 3, "reloads": 1, "bucket_hits": 30,
+         "bucket_misses": 4, "bucket_hit_rate": 0.8824,
+         "queue_depth": 0, "queue_peak": 7,
+         "latency_ms": {"count": 42, "p50": 1.2, "p95": 3.4,
+                        "p99": 5.6, "max": 9.9},
+         "model": {"version": 2, "num_trees": 10,
+                   "device_ready": True}},
+    ]
+    d = rr.digest(records)
+    assert d["serving"]["requests"] == 42
+    text = rr.render(records)
+    assert "== serving" in text
+    assert "p95=3.4" in text and "shed=1" in text
+    assert "v2 10 trees" in text
+
+
+def test_engine_stop_emits_serving_stats_record(binary_model, tel):
+    bst, X = binary_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4,), warmup=False, flush_interval_ms=0.5))
+    eng.predict(X[:2])
+    eng.stop()
+    recs = [r for r in tel.records if r["kind"] == "serving_stats"]
+    assert recs and recs[-1]["requests"] == 1
+    assert tel.counters.get("serving.requests", 0) == 1

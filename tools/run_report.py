@@ -84,9 +84,14 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for ds, metric, value, _bigger in ev.get("results", []):
             evals[f"{ds} {metric}"] = value
 
+    serving = _last(records, "serving_stats") or {}
+    serving = {k: v for k, v in serving.items()
+               if k not in ("kind", "t")}
+
     return {
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
+        "serving": serving,
         "jax_version": run.get("jax_version"),
         "config": run.get("config") or {},
         "iters": n_iters,
@@ -197,6 +202,34 @@ def render(records: List[Dict[str, Any]]) -> str:
         L.append(f"== eval (iter {d['eval_iter']}) ==")
         for k, v in sorted(d["eval"].items()):
             L.append(f"{k:<32}{v:>14.6f}")
+
+    if d.get("serving"):
+        s = d["serving"]
+        L.append("")
+        L.append("== serving (lightgbm_tpu/serving/) ==")
+        L.append(f"requests={s.get('requests', 0)} "
+                 f"rows={s.get('rows', 0)} "
+                 f"batches={s.get('batches', 0)} "
+                 f"queue_peak={s.get('queue_peak', 0)}")
+        lat = s.get("latency_ms") or {}
+        if lat:
+            L.append(f"latency_ms: p50={lat.get('p50')} "
+                     f"p95={lat.get('p95')} p99={lat.get('p99')} "
+                     f"max={lat.get('max')}")
+        hit = s.get("bucket_hit_rate")
+        L.append(f"buckets: hits={s.get('bucket_hits', 0)} "
+                 f"misses={s.get('bucket_misses', 0)}"
+                 + (f" hit_rate={hit}" if hit is not None else ""))
+        L.append(f"degradation: shed={s.get('shed', 0)} "
+                 f"timeouts={s.get('timeouts', 0)} "
+                 f"fallbacks={s.get('fallbacks', 0)} "
+                 f"errors={s.get('errors', 0)} "
+                 f"reloads={s.get('reloads', 0)}")
+        model = s.get("model") or {}
+        if model:
+            L.append(f"model: v{model.get('version')} "
+                     f"{model.get('num_trees')} trees "
+                     f"device_ready={model.get('device_ready')}")
     return "\n".join(L) + "\n"
 
 

@@ -1,0 +1,128 @@
+"""Serving load benchmark: closed- and open-loop latency/throughput.
+
+Drives an in-process ServingEngine (lightgbm_tpu/serving/) with the
+shared load generators (serving/loadgen.py) and prints one JSON object
+with a ``serving`` block: latency percentiles (p50/p95/p99),
+throughput, bucket hit rate, shed/timeout/fallback counts.
+
+Usage:
+    python tools/serve_bench.py [--model model.txt]
+        [--mode closed|open|both] [--threads 4] [--duration 3]
+        [--qps 300] [--batches 1,8,64] [--buckets 1,8,64,512]
+        [--device auto|always|never]
+        [--json out.json] [--append-bench BENCH.json]
+
+Without ``--model`` a small binary booster is trained in-process (the
+CI smoke path). ``--append-bench`` merges the block into an existing
+bench JSON artifact under the ``serving`` key, which
+``tools/run_report.py`` knows how to render.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _train_default_model(n=4000, f=10, seed=7):
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=20)
+    return bst, X
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="",
+                    help="model text/npz file (default: train in-proc)")
+    ap.add_argument("--mode", default="both",
+                    choices=["closed", "open", "both"])
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--qps", type=float, default=300.0)
+    ap.add_argument("--batches", default="1,8,64")
+    ap.add_argument("--buckets", default="1,8,64,512")
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "always", "never"])
+    ap.add_argument("--rows", type=int, default=4000,
+                    help="synthetic row pool when no --model data")
+    ap.add_argument("--json", default="", help="write result JSON here")
+    ap.add_argument("--append-bench", default="",
+                    help="merge the serving block into this bench JSON")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import jax
+    from lightgbm_tpu.serving import ServingConfig, ServingEngine
+    from lightgbm_tpu.serving.loadgen import closed_loop, open_loop
+
+    batch_sizes = [int(v) for v in args.batches.split(",") if v]
+    if args.model:
+        source = args.model
+        # loaded models have no mappers: synth a feature pool from the
+        # model's own feature count
+        from lightgbm_tpu.basic import Booster
+        bst = Booster(model_file=args.model) \
+            if not args.model.endswith(".npz") else None
+        if bst is not None:
+            nfeat = bst.num_feature()
+            source = bst
+        else:
+            from lightgbm_tpu.serving.registry import _load_npz
+            lb = _load_npz(args.model)
+            nfeat = lb.max_feature_idx + 1
+            source = lb
+        X = np.random.RandomState(0).randn(args.rows, nfeat)
+    else:
+        source, X = _train_default_model(n=args.rows)
+
+    cfg = ServingConfig(buckets=args.buckets, device=args.device)
+    engine = ServingEngine(source, config=cfg)
+    result = {"metric": "serving_latency",
+              "backend": jax.default_backend(),
+              "buckets": list(cfg.buckets),
+              "device": args.device,
+              "batch_sizes": batch_sizes}
+    if args.mode in ("closed", "both"):
+        result["closed"] = closed_loop(
+            engine, X, batch_sizes=batch_sizes, threads=args.threads,
+            duration_s=args.duration)
+    if args.mode in ("open", "both"):
+        result["open"] = open_loop(
+            engine, X, qps=args.qps, duration_s=args.duration,
+            batch_sizes=batch_sizes)
+    result["stats"] = engine.stats()
+    engine.stop()
+
+    # the headline block: closed loop if measured, else open
+    head = result.get("closed") or result.get("open") or {}
+    result["serving"] = head
+
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.append_bench:
+        try:
+            with open(args.append_bench) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            bench = json.loads(lines[-1]) if lines else {}
+        except (OSError, json.JSONDecodeError):
+            bench = {}
+        bench["serving"] = head
+        with open(args.append_bench, "w") as f:
+            f.write(json.dumps(bench) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
