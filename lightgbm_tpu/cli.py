@@ -133,14 +133,22 @@ def run_train(params: Dict[str, str]) -> None:
     output_model = cfg.output_model or "LightGBM_model.txt"
     if cfg.snapshot_freq > 0:
         freq = int(cfg.snapshot_freq)
+        # snapshots route through the robustness subsystem's atomic
+        # writer (temp + fsync + rename): a crash mid-snapshot can no
+        # longer leave a torn `<output_model>.snapshot_iter_<i>` file
+        # behind. Filenames are unchanged (gbdt.cpp:258-262 compat).
+        from .robustness.checkpoint import atomic_write_text
 
         def snapshot(env):
             it = env.iteration + 1
             if it % freq == 0:
                 out = f"{output_model}.snapshot_iter_{it}"
-                env.model.save_model(out)
+                atomic_write_text(out, env.model.model_to_string())
                 log_info(f"Saved snapshot to {out}")
         snapshot.order = 30
+        # snapshots are side effects of LIVE iterations; never re-fire
+        # them for replayed (pre-checkpoint) iterations on resume
+        snapshot.replay_on_resume = False
         callbacks.append(snapshot)
 
     booster = engine.train(
@@ -150,7 +158,18 @@ def run_train(params: Dict[str, str]) -> None:
         valid_names=valid_names or None,
         init_model=cfg.input_model or None,
         callbacks=callbacks or None)
-    booster.save_model(output_model)
+    if getattr(booster, "preempted", False):
+        # preemption-safe shutdown: the final checkpoint is already on
+        # disk (engine.train wrote it before returning); do NOT publish
+        # a partial output model
+        get_telemetry().flush()
+        log_info(
+            f"Training preempted at iteration {booster._gbdt.iter}; "
+            f"checkpoint saved under {cfg.checkpoint_dir} — rerun the "
+            "same command (resume=auto) to continue")
+        return
+    from .robustness.checkpoint import atomic_write_text
+    atomic_write_text(output_model, booster.model_to_string())
     get_telemetry().flush()
     log_info(f"Finished training; model saved to {output_model}")
 

@@ -128,6 +128,12 @@ _PARAM_ALIASES: Dict[str, str] = {
     "serve_host": "serving_host",
     "serve_port": "serving_port",
     "serving_bucket_sizes": "serving_buckets",
+    "checkpoint_path": "checkpoint_dir", "ckpt_dir": "checkpoint_dir",
+    "checkpoint_period": "checkpoint_freq",
+    "keep_checkpoints": "checkpoint_keep",
+    "nonfinite_policy": "guard_policy", "guard": "guard_policy",
+    "loss_spike_factor": "guard_loss_spike",
+    "fault_spec": "faults",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -289,6 +295,19 @@ class Config:
     # processes, so repeat runs skip the cold-compile bill. Empty =
     # disabled unless LGBM_TPU_COMPILE_CACHE is set.
     compile_cache_dir: str = ""
+
+    # ---- robustness (lightgbm_tpu/robustness/, docs/Robustness.md):
+    # atomic versioned checkpoints + resume, non-finite guards, and the
+    # deterministic fault-injection harness
+    checkpoint_dir: str = ""           # empty = checkpointing off
+    checkpoint_freq: int = 0           # iterations between checkpoints
+    checkpoint_keep: int = 3           # keep-last-K retention
+    checkpoint_score_cache: bool = True  # save device score buffers
+    resume: str = "auto"               # auto | off
+    guard_policy: str = "off"          # off | raise | skip_iter | rollback
+    guard_loss_spike: float = 0.0      # >1 = eval-loss spike factor
+    guard_max_rollbacks: int = 3       # bound on guard-driven restores
+    faults: str = ""                   # fault spec (LGBM_TPU_FAULTS analog)
 
     # ---- predict task (config.h:675-741)
     num_iteration_predict: int = -1
@@ -487,6 +506,16 @@ class Config:
             full = 1 << self.max_depth
             if self.num_leaves == kDefaultNumLeaves or self.num_leaves > full:
                 self.num_leaves = min(self.num_leaves, full)
+        if self.guard_policy not in ("off", "raise", "skip_iter",
+                                     "rollback"):
+            raise ValueError(
+                f"guard_policy={self.guard_policy!r} is not one of "
+                "off|raise|skip_iter|rollback")
+        if self.resume not in ("auto", "off"):
+            raise ValueError(f"resume={self.resume!r} is not auto|off")
+        if self.checkpoint_freq > 0 and not self.checkpoint_dir:
+            log_warning("checkpoint_freq is set without checkpoint_dir; "
+                        "no checkpoints will be written")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objectives")
         if self.objective not in ("multiclass", "multiclassova", "custom",

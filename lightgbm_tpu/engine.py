@@ -24,7 +24,7 @@ from .callback import (CallbackEnv, EarlyStopException, early_stopping,
                        print_evaluation, record_evaluation,
                        record_telemetry)
 from .observability.telemetry import get_telemetry
-from .utils.log import log_warning
+from .utils.log import log_info, log_warning
 
 _ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
                   "n_iter", "num_tree", "num_trees", "num_round",
@@ -137,6 +137,36 @@ def train(params: Dict[str, Any], train_set: Dataset,
             init_models, _raw_add(train_set),
             [_raw_add(v) for v in extra_valid_sets])
 
+    # robustness wiring (lightgbm_tpu/robustness/, docs/Robustness.md):
+    # fault plan from the config param, checkpoint manager + resume,
+    # non-finite/loss-spike guard. Any of these pins the host-stepped
+    # per-iteration loop (they need iteration boundaries).
+    cfg_obj = booster.config
+    booster.preempted = False
+    if getattr(cfg_obj, "faults", ""):
+        from .robustness.faults import set_fault_plan
+        set_fault_plan(cfg_obj.faults)
+    from .robustness.faults import fault_plan_active, maybe_sigterm
+    ckpt = None
+    resume_info = None
+    if getattr(cfg_obj, "checkpoint_dir", ""):
+        from .robustness.checkpoint import CheckpointManager
+        ckpt = CheckpointManager.from_config(cfg_obj)
+        if cfg_obj.resume == "auto":
+            resume_info = ckpt.restore_latest(booster)
+            if resume_info is not None:
+                booster.resumed_iteration = resume_info.iteration
+                log_info(
+                    f"Resuming training from checkpoint iteration "
+                    f"{resume_info.iteration} ({resume_info.path})")
+    guard_spike = None
+    if float(getattr(cfg_obj, "guard_loss_spike", 0.0)) > 1.0:
+        from .robustness.guards import LossSpikeDetector
+        guard_spike = LossSpikeDetector(cfg_obj.guard_loss_spike)
+    robust_active = ckpt is not None or guard_spike is not None \
+        or getattr(cfg_obj, "guard_policy", "off") != "off" \
+        or fault_plan_active()
+
     # callback assembly (engine.py:186-204)
     callbacks = set(callbacks) if callbacks is not None else set()
     if verbose_eval is True:
@@ -169,7 +199,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         and not getattr(cb, "before_iteration", False)
         for cb in callbacks)
     if not need_eval and fobj is None and inert_without_eval \
-            and not (early_stopping_rounds or 0) > 0:
+            and not (early_stopping_rounds or 0) > 0 \
+            and not robust_active:
         # no per-iteration host interaction needed: pipelined fast path
         booster._gbdt.train(booster._gbdt.iter + num_boost_round)
         booster.best_iteration = -1
@@ -178,43 +209,169 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # per-iteration loop (engine.py:221-276); iteration numbers are
     # ABSOLUTE (continued training offsets by the init model's rounds,
     # reference init_iteration semantics) so early stopping records a
-    # best_iteration that predict()'s model truncation understands
-    base_iter = booster._gbdt.iter
+    # best_iteration that predict()'s model truncation understands.
+    # After a checkpoint resume, ``base_iter`` is the ORIGINAL run's
+    # begin iteration (num_boost_round counts from there, so a resumed
+    # run targets the same final round as the uninterrupted one) and
+    # the loop starts at the restored iteration.
+    base_iter = resume_info.begin_iteration if resume_info is not None \
+        else booster._gbdt.iter
     end_iter = base_iter + num_boost_round
     tel = get_telemetry()
     t_train0 = time.perf_counter()
-    for i in range(base_iter, end_iter):
-        for cb in callbacks_before:
-            cb(CallbackEnv(model=booster, params=params, iteration=i,
-                           begin_iteration=base_iter,
-                           end_iteration=end_iter,
-                           evaluation_result_list=None))
-        booster.update(fobj=fobj)
 
-        evaluation_result_list = []
-        if need_eval:
-            with tel.span("eval", trace="eval"):
-                # one batched device->host fetch covering training +
-                # every valid set (basic.py Booster.eval_all) instead
-                # of a fetch-and-convert round trip per metric
-                if eval_on_train or extra_valid_sets:
-                    evaluation_result_list.extend(booster.eval_all(
-                        feval, include_train=eval_on_train))
-                elif feval is not None:
-                    evaluation_result_list.extend(
-                        booster.eval_valid(feval))
-            tel.eval_results(i, evaluation_result_list)
-        try:
-            for cb in callbacks_after:
-                cb(CallbackEnv(model=booster, params=params, iteration=i,
-                               begin_iteration=base_iter,
+    evaluation_result_list = []
+    eval_history = list(resume_info.eval_history) \
+        if resume_info is not None else []
+    stopped_early = False
+    # resume: replay the recorded eval results into the stateful
+    # callbacks (early stopping best-tracking, record_evaluation
+    # history) so their closure state — and therefore the stopping
+    # iteration — is identical to the uninterrupted run. Print (10)
+    # and telemetry (25) callbacks are cosmetic and not re-fired.
+    if resume_info is not None and eval_history:
+        for it_r, results_r in eval_history:
+            env = CallbackEnv(model=booster, params=params,
+                              iteration=int(it_r),
+                              begin_iteration=base_iter,
+                              end_iteration=end_iter,
+                              evaluation_result_list=[
+                                  tuple(r) for r in results_r])
+            try:
+                for cb in callbacks_after:
+                    # side-effecting callbacks (snapshots) opt out via
+                    # replay_on_resume=False
+                    if getattr(cb, "order", 0) in (10, 25) \
+                            or not getattr(cb, "replay_on_resume",
+                                           True):
+                        continue
+                    cb(env)
+            except EarlyStopException as earlyStopException:
+                booster.best_iteration = \
+                    earlyStopException.best_iteration + 1
+                evaluation_result_list = earlyStopException.best_score
+                stopped_early = True
+                break
+
+    preempt = None
+    rollbacks = 0
+    max_rollbacks = int(getattr(cfg_obj, "guard_max_rollbacks", 3))
+    if ckpt is not None:
+        from .robustness.preempt import PreemptionGuard
+        preempt = PreemptionGuard().install()
+    try:
+        from .robustness.guards import (LossSpikeError,
+                                        NonFiniteGradientError)
+        i = booster._gbdt.iter
+        while not stopped_early and i < end_iter:
+            if fault_plan_active():
+                maybe_sigterm(i)
+            for cb in callbacks_before:
+                cb(CallbackEnv(model=booster, params=params,
+                               iteration=i, begin_iteration=base_iter,
                                end_iteration=end_iter,
-                               evaluation_result_list=
-                               evaluation_result_list))
-        except EarlyStopException as earlyStopException:
-            booster.best_iteration = earlyStopException.best_iteration + 1
-            evaluation_result_list = earlyStopException.best_score
-            break
+                               evaluation_result_list=None))
+            try:
+                booster.update(fobj=fobj)
+            except NonFiniteGradientError as nf:
+                if nf.policy != "rollback":
+                    raise
+                restored = None
+                if ckpt is not None and rollbacks < max_rollbacks:
+                    restored = ckpt.restore_latest(booster)
+                if restored is not None:
+                    rollbacks += 1
+                    tel.count("guard.rollbacks")
+                    log_warning(
+                        f"guard: non-finite gradients at iteration "
+                        f"{i}; rolled back to checkpoint iteration "
+                        f"{restored.iteration} "
+                        f"({rollbacks}/{max_rollbacks})")
+                    # the checkpoint's own history replaces entries
+                    # recorded for the now-undone iterations
+                    eval_history = list(restored.eval_history)
+                    i = booster._gbdt.iter
+                    continue
+                if rollbacks >= max_rollbacks:
+                    raise
+                log_warning("guard: rollback requested but no valid "
+                            "checkpoint exists; skipping the "
+                            "iteration instead")
+                booster._gbdt.skip_iteration()
+
+            evaluation_result_list = []
+            if need_eval:
+                with tel.span("eval", trace="eval"):
+                    # one batched device->host fetch covering training
+                    # + every valid set (basic.py Booster.eval_all)
+                    # instead of a fetch-and-convert round trip per
+                    # metric
+                    if eval_on_train or extra_valid_sets:
+                        evaluation_result_list.extend(booster.eval_all(
+                            feval, include_train=eval_on_train))
+                    elif feval is not None:
+                        evaluation_result_list.extend(
+                            booster.eval_valid(feval))
+                tel.eval_results(i, evaluation_result_list)
+                if guard_spike is not None:
+                    spike = guard_spike.check(i, evaluation_result_list)
+                    if spike is not None:
+                        policy = getattr(cfg_obj, "guard_policy", "off")
+                        if policy == "raise":
+                            ds_s, m_s, v_s, prev_s = spike
+                            raise LossSpikeError(
+                                i, ds_s, m_s, v_s, prev_s,
+                                guard_spike.factor)
+                        if policy == "rollback" and ckpt is not None \
+                                and rollbacks < max_rollbacks:
+                            restored = ckpt.restore_latest(booster)
+                            if restored is not None:
+                                rollbacks += 1
+                                tel.count("guard.rollbacks")
+                                log_warning(
+                                    f"guard: loss spike at iteration "
+                                    f"{i}; rolled back to checkpoint "
+                                    f"iteration {restored.iteration}")
+                                eval_history = list(
+                                    restored.eval_history)
+                                i = booster._gbdt.iter
+                                continue
+            try:
+                for cb in callbacks_after:
+                    cb(CallbackEnv(model=booster, params=params,
+                                   iteration=i,
+                                   begin_iteration=base_iter,
+                                   end_iteration=end_iter,
+                                   evaluation_result_list=
+                                   evaluation_result_list))
+            except EarlyStopException as earlyStopException:
+                booster.best_iteration = \
+                    earlyStopException.best_iteration + 1
+                evaluation_result_list = earlyStopException.best_score
+                break
+            if ckpt is not None:
+                if need_eval:
+                    # plain-typed rows: the history is JSON in the
+                    # manifest and must replay with exact values
+                    eval_history.append(
+                        [i, [[r[0], r[1], float(r[2]), bool(r[3])]
+                             for r in evaluation_result_list]])
+                ckpt.maybe_save(booster, eval_history, base_iter)
+                if preempt is not None and preempt.requested:
+                    # finish-the-iteration contract: the in-flight
+                    # iteration (incl. its eval) completed above; write
+                    # a final checkpoint and stop cleanly
+                    ckpt.save(booster, eval_history, base_iter)
+                    booster.preempted = True
+                    log_info(
+                        f"Training preempted after iteration {i}; "
+                        f"checkpoint written to {ckpt.directory} — "
+                        "rerun with resume=auto to continue")
+                    break
+            i += 1
+    finally:
+        if preempt is not None:
+            preempt.uninstall()
     if tel.enabled:
         # the host-stepped loop bypasses GBDT.train, so the train_end
         # summary (+ one-time phase probe) is emitted here

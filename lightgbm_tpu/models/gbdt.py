@@ -29,6 +29,7 @@ from ..data.dataset import Dataset
 from ..metric import create_metrics
 from ..objective import create_objective
 from ..observability.telemetry import get_telemetry, memory_snapshot
+from ..robustness.guards import NonFiniteGradientError
 from ..utils.log import (log_fatal, log_info, log_warning,
                          maybe_profile)
 from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
@@ -233,6 +234,11 @@ class GBDT:
         self._bag_label = None  # device label, built lazily (balanced)
         self.bag_weight: Optional[jnp.ndarray] = None
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        # non-finite guard (robustness/guards.py): policy + the finite
+        # flag folded into the combined gradient program when active
+        self._guard_policy = str(getattr(cfg, "guard_policy", "off")
+                                 or "off")
+        self._last_grad_ok = None
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_data: Dataset, name: str) -> None:
@@ -315,20 +321,33 @@ class GBDT:
         if not combined:
             tel.count_iter("host.dispatches")
             grad, hess = self._grad_fn(score)
+            self._last_grad_ok = None
             return grad, hess, None
         fn = getattr(self, "_grad_bag_jit", None)
         if fn is None:
             bag_core = self._traceable_bag_fn()
             grad_fn = self._grad_fn
+            guard_on = self._guard_policy != "off"
 
             def _fused(s, i):
                 g, h = grad_fn(s)
+                if guard_on:
+                    # guard reduction folded into the SAME program:
+                    # the finite flag costs no extra dispatch
+                    from ..robustness.guards import fold_finite_check
+                    return g, h, bag_core(i, g, h), \
+                        fold_finite_check(g, h)
                 return g, h, bag_core(i, g, h)
 
             fn = jax.jit(_fused)
             self._grad_bag_jit = fn
         tel.count_iter("host.dispatches")
-        grad, hess, bag = fn(score, jnp.int32(it))
+        out = fn(score, jnp.int32(it))
+        if len(out) == 4:
+            grad, hess, bag, self._last_grad_ok = out
+        else:
+            grad, hess, bag = out
+            self._last_grad_ok = None
         self.bag_weight = bag
         return grad, hess, bag
 
@@ -407,10 +426,18 @@ class GBDT:
             else:
                 grad = _coerce_custom_grad(gradients, self.num_data, k)
                 hess = _coerce_custom_grad(hessians, self.num_data, k)
+                self._last_grad_ok = None
 
             if bag is None:
                 bag = self._bagging_weight(self.iter, grad, hess)
             fmask = self._feature_mask()
+            try:
+                grad, hess = self._check_gradients(grad, hess)
+            except NonFiniteGradientError as e:
+                if e.policy == "skip_iter":
+                    self.skip_iteration()
+                    return False
+                raise
 
         should_continue = False
         new_trees: List[Tree] = []
@@ -467,6 +494,54 @@ class GBDT:
             bag_fraction=float(self.config.bagging_fraction)
             if bag is not None else 1.0)
         return False
+
+    def _check_gradients(self, grad, hess):
+        """Fault injection (``nan_grad``) + the non-finite guard
+        (robustness/guards.py). Returns the (possibly poisoned)
+        ``[N, K]`` pair; raises :class:`NonFiniteGradientError` when
+        the guard trips under a non-``off`` policy — ``skip_iter`` is
+        handled by the caller, ``raise``/``rollback`` propagate to the
+        training driver."""
+        from ..robustness.faults import get_fault_plan
+        plan = get_fault_plan()
+        injected = False
+        if plan is not None:
+            f = plan.take("nan_grad", iteration=self.iter)
+            if f is not None:
+                val = jnp.inf if str(f.params.get("value", "")) \
+                    == "inf" else jnp.nan
+                grad = grad.at[0, 0].set(jnp.float32(val))
+                injected = True
+        policy = self._guard_policy
+        if policy == "off":
+            return grad, hess
+        tel = get_telemetry()
+        ok = self._last_grad_ok
+        if ok is None or injected:
+            from ..robustness.guards import _finite_ok
+            tel.count_iter("host.dispatches")
+            ok = _finite_ok(grad, hess)
+        tel.count_iter("host.syncs")
+        if bool(ok):
+            return grad, hess
+        tel.count("guard.nonfinite_iters")
+        log_warning(f"guard: non-finite gradients at iteration "
+                    f"{self.iter} (policy={policy})")
+        raise NonFiniteGradientError(self.iter, policy)
+
+    def skip_iteration(self) -> None:
+        """``guard_policy=skip_iter``: advance one iteration with a
+        no-op constant tree per class so the model stays aligned with
+        the iteration counter (checkpoint/resume and model truncation
+        both index models by iteration)."""
+        k = self.num_tree_per_iteration
+        for _tid in range(k):
+            self.models.append(_constant_tree(0.0))
+        self.iter += 1
+        tel = get_telemetry()
+        tel.count("guard.skipped_iters")
+        tel.end_iteration(self.iter - 1, trees=k, skipped=True,
+                          num_data=self.num_data)
 
     def _renew_tree_output(self, tree: Tree, result, tid: int) -> None:
         """L1-family leaf refit (serial_tree_learner.cpp:720-758).
@@ -709,11 +784,16 @@ class GBDT:
     _ASYNC_FLUSH = 16
 
     def _async_supported(self) -> bool:
+        from ..robustness.faults import fault_plan_active
         return (type(self).train_one_iter is GBDT.train_one_iter
                 and self.objective is not None
                 and not getattr(self.objective, "is_renew_tree_output",
                                 False)
-                and all(self.class_need_train))
+                and all(self.class_need_train)
+                # non-finite guards need the per-iteration sync check;
+                # armed fault plans need per-iteration injection points
+                and self._guard_policy == "off"
+                and not fault_plan_active())
 
     def _train_one_iter_async(self):
         """One boosting iteration with zero host syncs. Returns a device

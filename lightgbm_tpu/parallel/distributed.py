@@ -145,11 +145,23 @@ def init_distributed(config: Config,
                               "gloo")
     except Exception:  # pragma: no cover - jax API drift
         pass
-    jax.distributed.initialize(
+    # transient bootstrap failures (coordinator not listening yet, a
+    # just-released port still in TIME_WAIT) get bounded retries with
+    # jittered exponential backoff instead of failing the whole job
+    # (robustness/retry.py); attempts/delay are env-tunable for tests
+    from ..robustness.retry import retry_call
+    retry_call(
+        jax.distributed.initialize,
         coordinator_address=coordinator,
         num_processes=len(machines),
         process_id=process_id,
-        initialization_timeout=int(config.time_out) * 60)
+        initialization_timeout=int(config.time_out) * 60,
+        attempts=int(os.environ.get("LGBM_TPU_DIST_INIT_ATTEMPTS", 3)),
+        base_delay_s=float(os.environ.get(
+            "LGBM_TPU_DIST_INIT_BACKOFF_S", 1.0)),
+        max_delay_s=30.0,
+        retry_on=(RuntimeError, OSError),
+        desc="jax.distributed.initialize")
     sync_bin_find_seed(config)
     return True
 

@@ -1,0 +1,26 @@
+"""Fault-tolerant training subsystem (docs/Robustness.md).
+
+* :mod:`.checkpoint` — atomic versioned checkpoints, bit-identical
+  resume, keep-last-K retention, atomic file writers.
+* :mod:`.preempt`    — SIGTERM/SIGINT to graceful checkpoint-and-stop.
+* :mod:`.guards`     — device-side non-finite gradient guards with
+  ``raise | skip_iter | rollback`` policies + loss-spike detection.
+* :mod:`.retry`      — bounded jittered-exponential-backoff wrapper
+  for distributed init, checkpoint/model reads, serving loads.
+* :mod:`.faults`     — the deterministic fault-injection harness every
+  robustness test drives (``LGBM_TPU_FAULTS`` / ``faults`` param).
+"""
+
+from .faults import (FaultPlan, fault_plan_active, get_fault_plan,
+                     set_fault_plan)
+from .guards import (GUARD_POLICIES, LossSpikeDetector, LossSpikeError,
+                     NonFiniteGradientError, finite_ok)
+from .preempt import PreemptionGuard
+from .retry import backoff_delays, retry_call
+
+__all__ = [
+    "FaultPlan", "fault_plan_active", "get_fault_plan",
+    "set_fault_plan", "GUARD_POLICIES", "LossSpikeDetector",
+    "LossSpikeError", "NonFiniteGradientError", "finite_ok",
+    "PreemptionGuard", "backoff_delays", "retry_call",
+]

@@ -1,0 +1,506 @@
+"""Atomic versioned training checkpoints with bit-identical resume.
+
+A checkpoint captures everything a boosting run needs to continue *as
+if it had never stopped*:
+
+* the model so far (reference model-text format — the repo's exact
+  round-trip interchange format);
+* the device score cache (train + every valid set, float32 exactly as
+  accumulated on device) — optional via ``checkpoint_score_cache``;
+* host RNG positions (bagging / feature-fraction / DART MT19937
+  states) and the cached bagging mask — the device bagging stream is a
+  pure function of ``(bagging_seed, iteration)`` (PR 2) and needs no
+  state;
+* the eval history, replayed into early-stopping / record-evaluation
+  callbacks on resume so their closure state matches the uninterrupted
+  run;
+* fingerprints of the training config and the dataset bin layout, so a
+  checkpoint is never resumed against a different experiment.
+
+Write protocol (crash-safe on POSIX): everything lands in a hidden
+temp directory first — each file is flushed + fsync'd, the manifest
+(with per-file sizes and sha256 digests) is written **last** — then
+one ``rename`` publishes the checkpoint and the parent directory is
+fsync'd. A reader either sees a complete checkpoint or none; a torn
+payload that somehow survives (fs corruption, non-atomic copies) is
+caught by the manifest digest check and the loader falls back to the
+previous retained checkpoint (``keep-last-K`` retention,
+``checkpoint_keep``).
+
+Layout::
+
+    <checkpoint_dir>/
+      ckpt_00000020/
+        model.txt        # model text at iteration 20
+        state.npz        # score cache + RNG states
+        manifest.json    # written last; sizes+digests of the above
+
+Config: ``checkpoint_dir`` (enables the subsystem), ``checkpoint_freq``
+(iterations between periodic checkpoints; preemption always writes a
+final one), ``checkpoint_keep``, ``checkpoint_score_cache``,
+``resume=auto|off``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io as _io
+import json
+import os
+import shutil
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import LightGBMError, log_info, log_warning
+from .faults import get_fault_plan
+from .retry import read_bytes, read_text, retry_call
+
+CKPT_FORMAT = "lightgbm_tpu.checkpoint.v1"
+CKPT_PREFIX = "ckpt_"
+_TMP_PREFIX = ".tmp_ckpt_"
+
+# host RNG streams that advance per iteration on some paths; every one
+# present on the booster is captured so resume continues the stream
+_RNG_ATTRS = ("_bag_rng", "_feature_rng", "_drop_rng", "_extra_rng",
+              "_goss_rng")
+
+# params that must NOT invalidate a resume: IO paths, robustness /
+# serving / telemetry knobs, prediction-only settings, and the target
+# round count itself (resuming toward a longer target is the point)
+_FINGERPRINT_EXCLUDE = frozenset({
+    "task", "config", "data", "valid", "input_model", "output_model",
+    "output_result", "snapshot_freq", "verbosity", "telemetry_out",
+    "compile_cache_dir", "convert_model", "convert_model_language",
+    "checkpoint_dir", "checkpoint_freq", "checkpoint_keep",
+    "checkpoint_score_cache", "resume", "faults", "guard_policy",
+    "guard_loss_spike", "guard_max_rollbacks", "num_iterations",
+    "num_iteration_predict", "predict_raw_score", "predict_leaf_index",
+    "predict_contrib", "predict_disable_shape_check", "pred_early_stop",
+    "pred_early_stop_freq", "pred_early_stop_margin",
+    "serving_host", "serving_port", "serving_buckets",
+    "serving_max_queue", "serving_flush_ms", "serving_timeout_ms",
+    "serving_shed_policy", "serving_device", "serving_warmup",
+    "num_threads",
+})
+
+
+# ----------------------------------------------------------------------
+# atomic file primitives (shared: CLI snapshots and final model writes
+# route through these too)
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write-temp + fsync + rename: ``path`` either keeps its previous
+    content or atomically becomes ``data`` — never a torn mix."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{os.path.basename(path)}.{os.getpid()}.tmp")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def config_fingerprint(config) -> str:
+    """Digest of every training-relevant parameter (IO/robustness/
+    serving knobs excluded): equal fingerprints mean a checkpoint can
+    legally continue under this config."""
+    params = {k: v for k, v in config.to_params().items()
+              if k not in _FINGERPRINT_EXCLUDE}
+    payload = json.dumps(params, sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+class ResumeInfo(NamedTuple):
+    iteration: int
+    begin_iteration: int
+    eval_history: List
+    path: str
+
+
+class CheckpointManager:
+    """Writes, validates, retains and restores training checkpoints."""
+
+    def __init__(self, directory: str, freq: int = 0, keep: int = 3,
+                 save_scores: bool = True):
+        self.directory = directory
+        self.freq = int(freq)
+        self.keep = max(int(keep), 1)
+        self.save_scores = bool(save_scores)
+        self._writes = 0
+        self._last_saved: Optional[int] = None
+
+    @classmethod
+    def from_config(cls, cfg) -> "CheckpointManager":
+        return cls(cfg.checkpoint_dir,
+                   freq=int(getattr(cfg, "checkpoint_freq", 0)),
+                   keep=int(getattr(cfg, "checkpoint_keep", 3)),
+                   save_scores=bool(getattr(cfg,
+                                            "checkpoint_score_cache",
+                                            True)))
+
+    # -- listing -------------------------------------------------------
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """[(iteration, path)] sorted ascending by iteration."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if not name.startswith(CKPT_PREFIX):
+                continue
+            try:
+                it = int(name[len(CKPT_PREFIX):])
+            except ValueError:
+                continue
+            out.append((it, os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def has_checkpoint(self) -> bool:
+        return bool(self.checkpoints())
+
+    # -- writing -------------------------------------------------------
+    def maybe_save(self, booster, eval_history: List,
+                   begin_iteration: int) -> Optional[str]:
+        """Periodic save at the ``checkpoint_freq`` cadence; call at
+        iteration boundaries (after eval)."""
+        it = booster._gbdt.iter
+        if self.freq <= 0 or it <= 0 or it % self.freq != 0:
+            return None
+        return self.save(booster, eval_history, begin_iteration)
+
+    def save(self, booster, eval_history: List,
+             begin_iteration: int) -> Optional[str]:
+        """Write one checkpoint for the booster's current state.
+        Idempotent per iteration (a preemption right after a periodic
+        save does not write twice)."""
+        gbdt = booster._gbdt
+        it = int(gbdt.iter)
+        if self._last_saved == it:
+            return None
+        from ..observability.telemetry import get_telemetry
+        tel = get_telemetry()
+        with tel.span("checkpoint.write"):
+            path = self._write(booster, it, eval_history,
+                               begin_iteration)
+        self._last_saved = it
+        self._retain()
+        return path
+
+    def _write(self, booster, it: int, eval_history: List,
+               begin_iteration: int) -> str:
+        gbdt = booster._gbdt
+        os.makedirs(self.directory, exist_ok=True)
+        self._cleanup_tmp()
+        from ..io.model_text import save_model_to_string
+        model_text = save_model_to_string(gbdt)
+        state_bytes = self._state_npz_bytes(gbdt)
+
+        name = f"{CKPT_PREFIX}{it:08d}"
+        final = os.path.join(self.directory, name)
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}{it:08d}_{os.getpid()}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            files: Dict[str, Dict[str, Any]] = {}
+            payloads = {"model.txt": model_text.encode("utf-8"),
+                        "state.npz": state_bytes}
+            for fname, data in payloads.items():
+                with open(os.path.join(tmp, fname), "wb") as fh:
+                    fh.write(data)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                files[fname] = {"bytes": len(data),
+                                "sha256": _digest(data)}
+
+            self._writes += 1
+            plan = get_fault_plan()
+            if plan is not None and plan.take(
+                    "torn_checkpoint", nth=self._writes) is not None:
+                # simulate a torn write that still got published: the
+                # manifest keeps the pre-truncation digests, so the
+                # validator MUST reject this checkpoint later
+                victim = os.path.join(tmp, "state.npz")
+                with open(victim, "r+b") as fh:
+                    fh.truncate(max(len(state_bytes) // 2, 1))
+
+            manifest = {
+                "format": CKPT_FORMAT,
+                "iteration": it,
+                "begin_iteration": int(begin_iteration),
+                "num_models": len(gbdt.models),
+                "num_tree_per_iteration": gbdt.num_tree_per_iteration,
+                "num_valid_sets": len(gbdt.valid_scores),
+                "shrinkage_rate": float(gbdt.shrinkage_rate),
+                "score_cache": self.save_scores,
+                "config_fingerprint": config_fingerprint(gbdt.config),
+                "data_fingerprint":
+                    gbdt.train_data.bin_layout_fingerprint(),
+                "eval_history": eval_history,
+                "files": files,
+            }
+            mbytes = json.dumps(manifest, default=float).encode("utf-8")
+            with open(os.path.join(tmp, "manifest.json"), "wb") as fh:
+                fh.write(mbytes)
+                fh.flush()
+                os.fsync(fh.fileno())
+
+            if os.path.isdir(final):  # pre-rollback leftover: replace
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            _fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        from ..observability.telemetry import get_telemetry
+        tel = get_telemetry()
+        tel.count("checkpoint.writes")
+        tel.count("checkpoint.bytes",
+                  sum(f["bytes"] for f in files.values()) + len(mbytes))
+        log_info(f"checkpoint: wrote iteration {it} -> {final}")
+        return final
+
+    def _state_npz_bytes(self, gbdt) -> bytes:
+        arrays: Dict[str, np.ndarray] = {}
+        if self.save_scores:
+            arrays["train_score"] = np.asarray(gbdt.train_score,
+                                               np.float32)
+            for i, vs in enumerate(gbdt.valid_scores):
+                arrays[f"valid_score_{i}"] = np.asarray(vs, np.float32)
+        # cached bagging mask: only the host-RNG path needs it (the
+        # device draw is recomputed from (seed, iteration) exactly)
+        if gbdt.bag_weight is not None and not gbdt._device_bagging():
+            arrays["bag_weight"] = np.asarray(gbdt.bag_weight,
+                                              np.float32)
+        for attr in _RNG_ATTRS:
+            rng = getattr(gbdt, attr, None)
+            if isinstance(rng, np.random.RandomState):
+                name, keys, pos, has_gauss, cached = rng.get_state()
+                arrays[f"rng{attr}_keys"] = np.asarray(keys, np.uint32)
+                arrays[f"rng{attr}_meta"] = np.asarray(
+                    [pos, has_gauss], np.int64)
+                arrays[f"rng{attr}_cached"] = np.asarray(
+                    [cached], np.float64)
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    def _cleanup_tmp(self) -> None:
+        """Drop temp dirs left by crashed writers (best effort)."""
+        try:
+            for name in os.listdir(self.directory):
+                if name.startswith(_TMP_PREFIX):
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+        except OSError:
+            pass
+
+    def _retain(self) -> None:
+        ckpts = self.checkpoints()
+        for it, path in ckpts[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- validation / restore ------------------------------------------
+    def validate(self, path: str) -> Optional[Dict[str, Any]]:
+        """Parse + verify one checkpoint dir; returns the manifest when
+        every payload matches its recorded size and sha256."""
+        try:
+            mtext = retry_call(read_text,
+                               os.path.join(path, "manifest.json"),
+                               attempts=3, base_delay_s=0.05,
+                               desc=f"checkpoint manifest {path}")
+            manifest = json.loads(mtext)
+            if manifest.get("format") != CKPT_FORMAT:
+                log_warning(f"checkpoint: {path} has unknown format "
+                            f"{manifest.get('format')!r}")
+                return None
+            for fname, info in manifest.get("files", {}).items():
+                data = retry_call(read_bytes,
+                                  os.path.join(path, fname),
+                                  attempts=3, base_delay_s=0.05,
+                                  desc=f"checkpoint file {fname}")
+                if len(data) != int(info["bytes"]) \
+                        or _digest(data) != info["sha256"]:
+                    log_warning(
+                        f"checkpoint: {path}/{fname} is torn "
+                        f"({len(data)} bytes vs recorded "
+                        f"{info['bytes']}; digest mismatch)")
+                    return None
+            return manifest
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) \
+                as e:
+            log_warning(f"checkpoint: cannot validate {path}: {e}")
+            return None
+
+    def latest_valid(self) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Newest checkpoint that passes validation; invalid ones fall
+        back to the previous retained checkpoint (counted + warned)."""
+        from ..observability.telemetry import get_telemetry
+        for it, path in reversed(self.checkpoints()):
+            manifest = self.validate(path)
+            if manifest is not None:
+                return path, manifest
+            get_telemetry().count("checkpoint.fallbacks")
+            log_warning(f"checkpoint: {path} failed validation; "
+                        "falling back to the previous checkpoint")
+        return None
+
+    def restore_latest(self, booster) -> Optional[ResumeInfo]:
+        """Restore the newest valid, fingerprint-matching checkpoint
+        into the booster. Returns None (with a warning) when nothing
+        valid/compatible exists — callers then start fresh."""
+        found = self.latest_valid()
+        if found is None:
+            return None
+        path, manifest = found
+        gbdt = booster._gbdt
+        cfg_fp = config_fingerprint(gbdt.config)
+        if manifest.get("config_fingerprint") != cfg_fp:
+            log_warning(
+                "checkpoint: config fingerprint mismatch (training "
+                "parameters changed since the checkpoint was written); "
+                f"ignoring {path}")
+            return None
+        data_fp = gbdt.train_data.bin_layout_fingerprint()
+        if manifest.get("data_fingerprint") != data_fp:
+            log_warning(
+                "checkpoint: dataset bin-layout fingerprint mismatch "
+                f"(different data/binning); ignoring {path}")
+            return None
+        if int(manifest.get("num_valid_sets", 0)) \
+                != len(gbdt.valid_scores):
+            log_warning(
+                "checkpoint: validation-set count changed since the "
+                f"checkpoint was written; ignoring {path}")
+            return None
+        self._apply(booster, path, manifest)
+        from ..observability.telemetry import get_telemetry
+        get_telemetry().count("checkpoint.restores")
+        log_info(f"checkpoint: restored iteration "
+                 f"{manifest['iteration']} from {path}")
+        return ResumeInfo(int(manifest["iteration"]),
+                          int(manifest.get("begin_iteration", 0)),
+                          manifest.get("eval_history") or [], path)
+
+    def _apply(self, booster, path: str,
+               manifest: Dict[str, Any]) -> None:
+        gbdt = booster._gbdt
+        from ..io.model_text import load_model_from_string
+        model_text = read_text(os.path.join(path, "model.txt"))
+        loaded = load_model_from_string(model_text)
+        if loaded.num_tree_per_iteration \
+                != gbdt.num_tree_per_iteration:
+            raise LightGBMError(
+                "checkpoint model has "
+                f"{loaded.num_tree_per_iteration} trees/iteration; "
+                f"booster expects {gbdt.num_tree_per_iteration}")
+        import jax.numpy as jnp
+        gbdt.models = list(loaded.models)
+        gbdt.iter = int(manifest["iteration"])
+        gbdt.shrinkage_rate = float(
+            manifest.get("shrinkage_rate", gbdt.shrinkage_rate))
+        with np.load(_io.BytesIO(
+                read_bytes(os.path.join(path, "state.npz"))),
+                allow_pickle=False) as z:
+            names = set(z.files)
+            if "train_score" in names:
+                gbdt.train_score = jnp.asarray(z["train_score"],
+                                               jnp.float32)
+                for i in range(len(gbdt.valid_scores)):
+                    gbdt.valid_scores[i] = jnp.asarray(
+                        z[f"valid_score_{i}"], jnp.float32)
+            else:
+                self._recompute_scores(booster)
+            if "bag_weight" in names:
+                gbdt.bag_weight = jnp.asarray(z["bag_weight"],
+                                              jnp.float32)
+            else:
+                gbdt.bag_weight = None
+            for attr in _RNG_ATTRS:
+                if f"rng{attr}_keys" not in names:
+                    continue
+                rng = getattr(gbdt, attr, None)
+                if not isinstance(rng, np.random.RandomState):
+                    continue
+                meta = z[f"rng{attr}_meta"]
+                rng.set_state((
+                    "MT19937", np.asarray(z[f"rng{attr}_keys"],
+                                          np.uint32),
+                    int(meta[0]), int(meta[1]),
+                    float(z[f"rng{attr}_cached"][0])))
+
+    def _recompute_scores(self, booster) -> None:
+        """Score-cache-less restore: rebuild the score buffers by
+        re-predicting every checkpointed tree over the RAW feature
+        matrices. f64 accumulation re-cast to f32 — NOT guaranteed
+        bit-identical to the device-accumulated cache; prefer
+        ``checkpoint_score_cache=true`` (the default) when exact resume
+        matters."""
+        import jax.numpy as jnp
+        log_warning(
+            "checkpoint: score cache absent; recomputing scores from "
+            "the raw data (resume is approximate, not bit-identical)")
+        gbdt = booster._gbdt
+        k = gbdt.num_tree_per_iteration
+
+        def raw_matrix(ds):
+            from ..basic import (_apply_pandas_categorical,
+                                 _is_pandas_df, _to_matrix)
+            X = ds.data
+            if X is None:
+                raise LightGBMError(
+                    "cannot recompute scores: the raw feature matrix "
+                    "was freed (free_raw_data) — re-run with "
+                    "checkpoint_score_cache=true")
+            if isinstance(X, str):
+                from ..config import Config as _Cfg
+                from ..data.file_loader import load_file
+                X = load_file(X, _Cfg.from_params(
+                    ds._merged_params()))[0]
+            if _is_pandas_df(X):
+                X = _apply_pandas_categorical(X, ds.pandas_categorical)
+            else:
+                X = _to_matrix(X)
+            return np.asarray(X, np.float64)
+
+        def rebuilt(score0, ds):
+            X = raw_matrix(ds)
+            out = np.zeros((X.shape[0], k))
+            for i, t in enumerate(gbdt.models):
+                out[:, i % k] += t.predict(X)
+            return score0 + jnp.asarray(out, jnp.float32)
+
+        gbdt.train_score = rebuilt(gbdt.train_score,
+                                   booster.train_set)
+        for i, vd in enumerate(booster.valid_sets):
+            gbdt.valid_scores[i] = rebuilt(gbdt.valid_scores[i], vd)

@@ -1,0 +1,135 @@
+"""Non-finite guards and loss-spike detection for the boosting loop.
+
+Custom objectives, extreme learning rates and bad rows produce NaN/inf
+gradients; left unchecked they poison the score buffer and every later
+tree silently. The guard is a cheap device-side ``isfinite`` reduction
+over the gradient/hessian pair — folded into the already-jitted
+gradient program on the combined grad+bagging path (zero extra
+dispatches) and one tiny module-jitted program otherwise — checked
+once per iteration when ``guard_policy`` is enabled.
+
+Policies (``guard_policy`` config param):
+
+* ``raise``     — abort training with :class:`NonFiniteGradientError`.
+* ``skip_iter`` — record the event, append a no-op constant tree for
+  the iteration and keep going (the model stays aligned with the
+  iteration counter).
+* ``rollback``  — restore the last valid checkpoint and re-seed the
+  iteration counter from it (the training driver owns the restore; the
+  guard raises with ``policy='rollback'`` to request it). Bounded by
+  ``guard_max_rollbacks`` per run so a deterministic failure cannot
+  loop forever.
+
+Loss-spike detection (``guard_loss_spike`` config param, factor > 1):
+at every eval boundary, a smaller-is-better metric jumping above
+``factor`` x its previous value (or going non-finite) counts a
+``guard.loss_spikes`` event and applies the policy.
+
+Telemetry: ``guard.nonfinite_iters``, ``guard.skipped_iters``,
+``guard.loss_spikes``, ``guard.rollbacks``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.log import LightGBMError, log_warning
+
+GUARD_POLICIES = ("off", "raise", "skip_iter", "rollback")
+
+
+class NonFiniteGradientError(LightGBMError):
+    """Non-finite gradients/hessians detected at one iteration. The
+    ``policy`` field tells the training driver what was requested
+    (``raise`` propagates; ``rollback`` asks for a checkpoint
+    restore)."""
+
+    def __init__(self, iteration: int, policy: str,
+                 what: str = "gradients"):
+        super().__init__(
+            f"non-finite {what} at iteration {iteration} "
+            f"(guard_policy={policy})")
+        self.iteration = iteration
+        self.policy = policy
+        self.what = what
+
+
+class LossSpikeError(LightGBMError):
+    """Eval metric spiked past the configured factor under
+    ``guard_policy=raise``."""
+
+    def __init__(self, iteration: int, dataset: str, metric: str,
+                 value: float, prev: float, factor: float):
+        super().__init__(
+            f"loss spike at iteration {iteration}: {dataset} {metric} "
+            f"= {value:g} (previous {prev:g}, factor {factor:g})")
+        self.iteration = iteration
+
+
+@jax.jit
+def _finite_ok(grad, hess):
+    """Device-side all-finite reduction over one iteration's gradient
+    pair; returns a device bool scalar (fetch = one host sync)."""
+    return jnp.isfinite(grad).all() & jnp.isfinite(hess).all()
+
+
+def finite_ok(grad, hess) -> bool:
+    return bool(_finite_ok(grad, hess))
+
+
+def fold_finite_check(g, h):
+    """The same reduction as a traceable expression, for folding into
+    an already-jitted gradient program (costs no extra dispatch)."""
+    return jnp.isfinite(g).all() & jnp.isfinite(h).all()
+
+
+class LossSpikeDetector:
+    """Tracks previous values per (dataset, metric) and flags spikes on
+    smaller-is-better metrics. Stateful across iterations; rollback
+    restores do NOT clear it (a restored iteration re-producing the
+    same spike should still be visible)."""
+
+    def __init__(self, factor: float):
+        self.factor = float(factor)
+        self._prev: Dict[Tuple[str, str], float] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.factor > 1.0
+
+    def check(self, iteration: int, results) -> Optional[Tuple]:
+        """``results``: [(dataset, metric, value, bigger_better), ...]
+        from one eval boundary. Returns the first spiking entry as
+        ``(dataset, metric, value, prev)`` or None; updates state."""
+        if not self.enabled:
+            return None
+        spike = None
+        for ds, metric, value, bigger in results or []:
+            if bigger:      # spike detection targets losses
+                continue
+            key = (ds, metric)
+            prev = self._prev.get(key)
+            v = float(value)
+            if not math.isfinite(v):
+                if spike is None:
+                    spike = (ds, metric, v, prev if prev is not None
+                             else float("nan"))
+            elif prev is not None and math.isfinite(prev) \
+                    and v > max(prev, 1e-30) * self.factor:
+                if spike is None:
+                    spike = (ds, metric, v, prev)
+            # only finite values become the new baseline
+            if math.isfinite(v):
+                self._prev[key] = v
+        if spike is not None:
+            from ..observability.telemetry import get_telemetry
+            get_telemetry().count("guard.loss_spikes")
+            ds, metric, v, prev = spike
+            log_warning(f"guard: loss spike at iteration {iteration}: "
+                        f"{ds} {metric} = {v:g} (previous {prev:g}, "
+                        f"factor {self.factor:g})")
+        return spike

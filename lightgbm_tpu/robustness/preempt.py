@@ -1,0 +1,79 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT to graceful stop.
+
+TPU preemption (and every container orchestrator) delivers SIGTERM and
+expects the process to wind down within a grace window. While a guard
+is installed, the first SIGTERM/SIGINT only *sets a flag*; the training
+loop finishes the in-flight iteration, writes a final checkpoint, and
+returns cleanly. A second signal escalates: the original handler (or
+the default action) runs, so a hung loop can still be killed.
+
+Signal handlers can only be installed from the main thread; elsewhere
+the guard degrades to a no-op (``installed`` False) instead of
+failing — training driven from a worker thread simply has no graceful
+preemption, same as before this module existed.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Dict, Optional
+
+from ..utils.log import log_info, log_warning
+
+_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionGuard:
+    """Context manager capturing SIGTERM/SIGINT as a preemption flag."""
+
+    def __init__(self):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self.installed = False
+        self._previous: Dict[int, object] = {}
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            # second signal: escalate to the previous disposition
+            log_warning(f"preemption: second signal {signum}; "
+                        "escalating")
+            self.uninstall()
+            if signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signum = signum
+        from ..observability.telemetry import get_telemetry
+        get_telemetry().count("checkpoint.preemptions")
+        log_info(f"preemption: caught signal {signum}; finishing the "
+                 "in-flight iteration, then checkpointing and "
+                 "shutting down (send again to force)")
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        try:
+            for s in _SIGNALS:
+                self._previous[s] = signal.signal(s, self._handler)
+            self.installed = True
+        except (ValueError, OSError):  # non-main thread / exotic host
+            self.uninstall()
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in list(self._previous.items()):
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._previous.clear()
+        self.installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

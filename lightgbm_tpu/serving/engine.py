@@ -184,6 +184,7 @@ class ServingEngine:
         self._latency_cap = 8192
         self._bucket_seen = set()           # (version, bucket)
         self._queue_peak = 0
+        self._last_reload_error: Optional[Dict[str, Any]] = None
         if source is not None:
             self.load(source)
 
@@ -191,13 +192,31 @@ class ServingEngine:
     def load(self, source) -> int:
         """Load + warm up + atomically activate a model version; the
         previous version (if any) drains. Returns the new version id.
-        In-flight and queued requests never fail across the swap."""
+        In-flight and queued requests never fail across the swap.
+
+        A failed (re)load — e.g. a torn model file rejected by the
+        registry's integrity checks — raises, KEEPS the previous
+        version serving, and flags the engine degraded (surfaced in
+        ``health()``) until a load succeeds."""
         pin = self.config.device != "never"
-        mv = self.registry.load(source, pin_device=pin)
-        if self.config.warmup:
-            self._warmup(mv)
+        try:
+            mv = self.registry.load(source, pin_device=pin)
+            if self.config.warmup:
+                self._warmup(mv)
+        except Exception as e:
+            self._last_reload_error = {
+                "error": str(e),
+                "code": getattr(e, "code", type(e).__name__),
+                "source": str(source)[:256],
+                "at": time.time(),
+            }
+            self._count("reload_failures")
+            log_warning(f"serving: model load failed "
+                        f"(still serving the previous version): {e}")
+            raise
         had_old = self.registry.current() is not None
         self.registry.activate(mv)
+        self._last_reload_error = None
         if had_old:
             self._count("reloads")
         return mv.version
@@ -589,8 +608,17 @@ class ServingEngine:
 
     def health(self) -> Dict[str, Any]:
         mv = self.registry.current()
-        return {
-            "status": "ok" if mv is not None else "no_model",
+        if mv is None:
+            status = "no_model"
+        elif self._last_reload_error is not None:
+            # degraded-but-serving: the last (hot) reload was rejected
+            # (torn file, digest mismatch, parse error) and the
+            # previous version is still taking traffic
+            status = "degraded"
+        else:
+            status = "ok"
+        out = {
+            "status": status,
             "version": None if mv is None else mv.version,
             "device_ready": bool(mv is not None and mv.device_ready),
             "started": self._started,
@@ -598,3 +626,6 @@ class ServingEngine:
             "buckets": list(self.config.buckets),
             "versions": self.registry.versions(),
         }
+        if self._last_reload_error is not None:
+            out["last_reload_error"] = dict(self._last_reload_error)
+        return out

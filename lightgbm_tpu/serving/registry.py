@@ -57,17 +57,82 @@ def save_model_npz(src, path: str) -> None:
 
 
 def _load_npz(path: str):
+    import io as _io
+    import zipfile as _zf
     from ..io.model_text import load_model_from_string
-    with np.load(path, allow_pickle=False) as z:
-        if "model_text" not in z.files:
-            raise ModelLoadError(
-                f"{path!r} is not a serving model npz "
-                "(no model_text member)", path=path)
-        fmt = str(z["format"]) if "format" in z.files else ""
-        if fmt and fmt != _NPZ_FORMAT:
-            log_warning(f"serving npz {path!r} has format {fmt!r}; "
-                        f"expected {_NPZ_FORMAT!r} — trying anyway")
-        return load_model_from_string(str(z["model_text"]))
+    from ..robustness.retry import read_bytes, retry_call
+    raw = retry_call(read_bytes, path, attempts=3, base_delay_s=0.05,
+                     desc=f"serving npz read {path}")
+    try:
+        with np.load(_io.BytesIO(raw), allow_pickle=False) as z:
+            if "model_text" not in z.files:
+                raise ModelLoadError(
+                    f"{path!r} is not a serving model npz "
+                    "(no model_text member)", path=path)
+            fmt = str(z["format"]) if "format" in z.files else ""
+            if fmt and fmt != _NPZ_FORMAT:
+                log_warning(f"serving npz {path!r} has format {fmt!r}; "
+                            f"expected {_NPZ_FORMAT!r} — trying anyway")
+            text = str(z["model_text"])
+    except (_zf.BadZipFile, ValueError, OSError) as e:
+        # a torn/partially-copied npz fails the zip CRC/structure checks
+        raise ModelLoadError(
+            f"{path!r} is torn or not a valid npz: {e}",
+            path=path) from e
+    _check_model_text_integrity(text, path)
+    return load_model_from_string(text)
+
+
+def _check_model_text_integrity(text: str, source: str) -> None:
+    """Reject partially-written / torn model text BEFORE parsing: a
+    complete save always carries the ``end of trees`` marker (and the
+    parameter footer's terminator when a footer was started). Loading
+    a torn file would otherwise silently drop trailing trees."""
+    if "end of trees" not in text:
+        raise ModelLoadError(
+            f"model source {source!r} is truncated (missing 'end of "
+            "trees' marker); refusing to serve a torn model",
+            path=source)
+    if "\nparameters:" in text and "end of parameters" not in text:
+        raise ModelLoadError(
+            f"model source {source!r} is truncated inside the "
+            "parameters footer; refusing to serve a torn model",
+            path=source)
+
+
+def _check_sidecar_manifest(path: str) -> None:
+    """When a ``<path>.manifest.json`` sidecar exists (the checkpoint
+    manifest format — deploy pipelines can publish one next to the
+    model artifact), verify the recorded size + sha256 digest before
+    loading; a mismatch means the artifact is torn or stale."""
+    import hashlib
+    import json
+    sidecar = path + ".manifest.json"
+    if not os.path.exists(sidecar):
+        return
+    from ..robustness.retry import read_bytes, read_text, retry_call
+    try:
+        manifest = json.loads(retry_call(
+            read_text, sidecar, attempts=3, base_delay_s=0.05,
+            desc=f"serving sidecar {sidecar}"))
+        info = (manifest.get("files") or {}).get(
+            os.path.basename(path)) or manifest
+        data = retry_call(read_bytes, path, attempts=3,
+                          base_delay_s=0.05,
+                          desc=f"serving model read {path}")
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        raise ModelLoadError(
+            f"cannot verify {path!r} against its manifest sidecar: "
+            f"{e}", path=path) from e
+    if "bytes" in info and len(data) != int(info["bytes"]):
+        raise ModelLoadError(
+            f"model file {path!r} is torn: {len(data)} bytes on disk "
+            f"vs {info['bytes']} recorded in the manifest", path=path)
+    if "sha256" in info \
+            and hashlib.sha256(data).hexdigest() != info["sha256"]:
+        raise ModelLoadError(
+            f"model file {path!r} digest mismatch vs its manifest "
+            "(torn or stale artifact)", path=path)
 
 
 class ModelVersion:
@@ -210,19 +275,27 @@ class ModelRegistry:
         if isinstance(source, str):
             if "\n" in source:                      # model text
                 try:
+                    _check_model_text_integrity(source, "model_str")
                     return (load_model_from_string(source),
                             "model_str", None)
+                except ServingError:
+                    raise
                 except Exception as e:
                     raise ModelLoadError(
                         f"cannot parse model string: {e}") from e
             if not os.path.exists(source):
                 raise ModelLoadError(f"model file not found: {source!r}",
                                      path=source)
+            _check_sidecar_manifest(source)
             if source.endswith(".npz") or zipfile.is_zipfile(source):
                 return _load_npz(source), source, None
             try:
-                with open(source) as f:
-                    return load_model_from_string(f.read()), source, None
+                from ..robustness.retry import read_text, retry_call
+                text = retry_call(read_text, source, attempts=3,
+                                  base_delay_s=0.05,
+                                  desc=f"serving model read {source}")
+                _check_model_text_integrity(text, source)
+                return load_model_from_string(text), source, None
             except ServingError:
                 raise
             except Exception as e:

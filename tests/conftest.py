@@ -17,6 +17,10 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # hermetic telemetry: a driver-level LGBM_TPU_TELEMETRY must not make
 # every training test append to a shared trace file
 os.environ.pop("LGBM_TPU_TELEMETRY", None)
+# hermetic fault injection: an ambient LGBM_TPU_FAULTS spec would fire
+# inside arbitrary training tests (robustness tests install their own
+# plans programmatically)
+os.environ.pop("LGBM_TPU_FAULTS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,443 @@
+"""Fault-tolerant training (lightgbm_tpu/robustness/): atomic
+checkpoints + bit-identical resume, preemption handling, non-finite
+guards, retry/backoff, and the deterministic fault-injection harness
+that drives every scenario here (docs/Robustness.md)."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import engine
+from lightgbm_tpu.basic import Booster, Dataset
+from lightgbm_tpu.observability.telemetry import get_telemetry
+from lightgbm_tpu.robustness import retry as rretry
+from lightgbm_tpu.robustness.checkpoint import (CheckpointManager,
+                                                atomic_write_text,
+                                                config_fingerprint)
+from lightgbm_tpu.robustness.faults import (FaultPlan, get_fault_plan,
+                                            set_fault_plan)
+from lightgbm_tpu.robustness.guards import (LossSpikeDetector,
+                                            NonFiniteGradientError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_telemetry(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_FAULTS", raising=False)
+    set_fault_plan(None)
+    tel = get_telemetry()
+    tel.ensure_ring()
+    yield
+    set_fault_plan(None)
+    tel.reset()
+
+
+def _data(n=260, nv=120, noise=0.0, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + 0.4 * X[:, 1]
+         + noise * rng.randn(n) > 0).astype(np.float64)
+    Xv = rng.randn(nv, 5)
+    yv = (Xv[:, 0] + 0.4 * Xv[:, 1]
+          + noise * rng.randn(nv) > 0).astype(np.float64)
+    return X, y, Xv, yv
+
+
+def _train(params, n_round, X, y, Xv=None, yv=None, es=None):
+    valid = [Dataset(Xv, label=yv)] if Xv is not None else None
+    return engine.train(dict(params), Dataset(X, label=y),
+                        num_boost_round=n_round, valid_sets=valid,
+                        early_stopping_rounds=es, verbose_eval=False)
+
+
+# ----------------------------------------------------------------------
+# fault harness
+def test_fault_spec_parsing():
+    plan = FaultPlan.parse(
+        "nan_grad@iter=10,value=inf; sigterm@iteration=20;"
+        "fail_read@times=3,match=model; torn_checkpoint@nth=2;"
+        "bogus_kind@x=1;;")
+    kinds = [e.kind for e in plan.events]
+    assert kinds == ["nan_grad", "sigterm", "fail_read",
+                     "torn_checkpoint"]
+    assert plan.events[0].params["iteration"] == 10  # iter alias
+    assert plan.events[0].params["value"] == "inf"
+    assert plan.events[2].remaining == 3
+
+    assert plan.take("nan_grad", iteration=9) is None
+    assert plan.take("nan_grad", iteration=10) is not None
+    assert plan.take("nan_grad", iteration=10) is None  # consumed
+
+    assert plan.take("fail_read", path="/a/other.txt") is None
+    for _ in range(3):
+        assert plan.take("fail_read", path="/a/model.txt") is not None
+    assert plan.take("fail_read", path="/a/model.txt") is None
+
+    assert plan.take("torn_checkpoint", nth=1) is None
+    assert plan.take("torn_checkpoint", nth=2) is not None
+
+
+def test_fault_plan_from_env(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FAULTS", "sigterm@iteration=5")
+    plan = get_fault_plan()
+    assert plan is not None and plan.pending() == ["sigterm@iteration=5"]
+    # same spec -> same (stateful) plan object, not a fresh parse
+    assert get_fault_plan() is plan
+
+
+# ----------------------------------------------------------------------
+# atomic writes + retry
+def test_atomic_write_replaces_never_tears(tmp_path):
+    p = str(tmp_path / "out.txt")
+    atomic_write_text(p, "first version\n")
+    atomic_write_text(p, "second version\n")
+    assert open(p).read() == "second version\n"
+    assert [f for f in os.listdir(tmp_path)
+            if f.endswith(".tmp")] == []
+
+
+def test_retry_call_backoff_and_giveup():
+    calls = []
+    sleeps = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = rretry.retry_call(flaky, attempts=4, base_delay_s=0.01,
+                            sleep=sleeps.append, desc="flaky")
+    assert out == "ok" and len(calls) == 3 and len(sleeps) == 2
+    assert sleeps[1] > sleeps[0]  # exponential
+
+    def dead():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError):
+        rretry.retry_call(dead, attempts=3, base_delay_s=0.01,
+                          sleep=lambda s: None, desc="dead")
+    tel = get_telemetry()
+    assert tel.counters.get("retry.giveups", 0) >= 1
+    assert tel.counters.get("retry.retries", 0) >= 2
+
+
+def test_backoff_delays_deterministic_jitter():
+    a = list(rretry.backoff_delays(4, 0.1, 10.0, desc="x"))
+    b = list(rretry.backoff_delays(4, 0.1, 10.0, desc="x"))
+    c = list(rretry.backoff_delays(4, 0.1, 10.0, desc="y"))
+    assert a == b          # deterministic
+    assert a != c          # but spread across call sites
+    assert all(d2 > d1 for d1, d2 in zip(a, a[1:]))
+
+
+# ----------------------------------------------------------------------
+# checkpoints: write / validate / retain / restore
+def test_checkpoint_roundtrip_and_retention(tmp_path):
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 2,
+              "checkpoint_keep": 2}
+    b = _train(params, 10, X, y)
+    mgr = CheckpointManager(D)
+    ckpts = mgr.checkpoints()
+    assert [it for it, _ in ckpts] == [8, 10]  # keep-last-2
+    path, manifest = mgr.latest_valid()
+    assert manifest["iteration"] == 10
+    assert manifest["config_fingerprint"] == \
+        config_fingerprint(b.config)
+    for fname, info in manifest["files"].items():
+        assert os.path.getsize(os.path.join(path, fname)) \
+            == info["bytes"]
+
+
+def test_resume_is_bit_identical_with_bagging(tmp_path):
+    X, y, Xv, yv = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "binary_logloss", "checkpoint_dir": D,
+              "checkpoint_freq": 4, "bagging_fraction": 0.7,
+              "bagging_freq": 2}
+    clean = _train(params, 21, X, y, Xv, yv)
+    t_clean = clean.model_to_string()
+    shutil.rmtree(D)
+    # stop mid-run at a checkpoint boundary, then resume to the target
+    _train(params, 12, X, y, Xv, yv)
+    resumed = _train(params, 21, X, y, Xv, yv)
+    assert resumed.resumed_iteration == 12
+    assert resumed.model_to_string() == t_clean
+
+
+def test_sigterm_preemption_resume_bit_identical_early_stopping(
+        tmp_path):
+    """The acceptance scenario: SIGTERM mid-training (delivered by the
+    fault harness, caught by the preemption guard, final checkpoint
+    written) -> resume -> the serialized model text diffs clean against
+    the uninterrupted run, with bagging AND early stopping enabled."""
+    X, y, Xv, yv = _data(noise=0.8, seed=3)  # noisy: ES can trigger
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": "binary_logloss", "checkpoint_dir": D,
+              "checkpoint_freq": 3, "bagging_fraction": 0.8,
+              "bagging_freq": 2}
+    clean = _train(params, 30, X, y, Xv, yv, es=4)
+    t_clean = clean.model_to_string()
+    shutil.rmtree(D)
+    set_fault_plan("sigterm@iteration=11")
+    pre = _train(params, 30, X, y, Xv, yv, es=4)
+    assert pre.preempted is True
+    assert pre._gbdt.iter == 12  # finished the in-flight iteration
+    assert CheckpointManager(D).latest_valid()[1]["iteration"] == 12
+    set_fault_plan(None)
+    resumed = _train(params, 30, X, y, Xv, yv, es=4)
+    assert resumed.resumed_iteration == 12
+    assert resumed.model_to_string() == t_clean
+    assert resumed.best_iteration == clean.best_iteration
+
+
+def test_corrupted_checkpoint_falls_back_to_previous(tmp_path):
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 4}
+    _train(params, 12, X, y)
+    # tear the NEWEST checkpoint's payload; digest check must reject
+    # it and resume from the previous retained one
+    latest = sorted(os.listdir(D))[-1]
+    victim = os.path.join(D, latest, "state.npz")
+    data = open(victim, "rb").read()
+    with open(victim, "wb") as fh:
+        fh.write(data[:len(data) // 2])
+    resumed = _train(params, 16, X, y)
+    assert resumed.resumed_iteration == 8
+    assert get_telemetry().counters.get("checkpoint.fallbacks", 0) >= 1
+    assert resumed.num_trees() == 16
+
+
+def test_torn_checkpoint_fault_is_rejected(tmp_path):
+    """Writer-side fault: the 3rd checkpoint write is truncated after
+    its digests were computed — exactly the torn-file shape the
+    manifest validation exists to catch."""
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 4}
+    set_fault_plan("torn_checkpoint@nth=3")
+    _train(params, 12, X, y)
+    set_fault_plan(None)
+    mgr = CheckpointManager(D)
+    assert [it for it, _ in mgr.checkpoints()] == [4, 8, 12]
+    assert mgr.validate(mgr.checkpoints()[-1][1]) is None  # torn
+    path, manifest = mgr.latest_valid()
+    assert manifest["iteration"] == 8
+    resumed = _train(params, 16, X, y)
+    assert resumed.resumed_iteration == 8
+
+
+def test_resume_ignores_checkpoint_after_param_change(tmp_path):
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 4}
+    _train(params, 8, X, y)
+    changed = dict(params, learning_rate=0.31)
+    b = _train(changed, 8, X, y)
+    assert getattr(b, "resumed_iteration", None) is None
+    assert b.num_trees() == 8  # trained fresh under the new config
+
+
+def test_resume_off_starts_fresh(tmp_path):
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 4}
+    _train(params, 8, X, y)
+    b = _train(dict(params, resume="off"), 8, X, y)
+    assert getattr(b, "resumed_iteration", None) is None
+
+
+def test_fail_read_fault_recovered_by_retry(tmp_path):
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 4}
+    _train(params, 8, X, y)
+    set_fault_plan("fail_read@times=2,match=manifest")
+    resumed = _train(params, 12, X, y)
+    assert resumed.resumed_iteration == 8
+    tel = get_telemetry()
+    assert tel.counters.get("retry.retries", 0) >= 2
+
+
+# ----------------------------------------------------------------------
+# non-finite guards
+def test_guard_policy_raise(tmp_path):
+    X, y, _, _ = _data()
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "guard_policy": "raise"}
+    set_fault_plan("nan_grad@iteration=3")
+    with pytest.raises(NonFiniteGradientError):
+        _train(params, 10, X, y)
+    assert get_telemetry().counters.get("guard.nonfinite_iters", 0) >= 1
+
+
+def test_guard_policy_skip_iter(tmp_path):
+    X, y, _, _ = _data()
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "guard_policy": "skip_iter"}
+    set_fault_plan("nan_grad@iteration=3,value=inf")
+    b = _train(params, 10, X, y)
+    assert b.num_trees() == 10  # skipped iter holds a no-op tree
+    tel = get_telemetry()
+    assert tel.counters.get("guard.skipped_iters", 0) == 1
+    assert np.isfinite(b.predict(X)).all()
+
+
+def test_guard_policy_rollback_recovers_bit_identical(tmp_path):
+    X, y, _, _ = _data()
+    D = str(tmp_path / "ck")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "checkpoint_dir": D, "checkpoint_freq": 5,
+              "guard_policy": "rollback"}
+    clean = _train(params, 20, X, y)
+    t_clean = clean.model_to_string()
+    shutil.rmtree(D)
+    set_fault_plan("nan_grad@iteration=10")
+    b = _train(params, 20, X, y)
+    tel = get_telemetry()
+    assert tel.counters.get("guard.nonfinite_iters", 0) >= 1
+    assert tel.counters.get("guard.rollbacks", 0) == 1
+    assert b.model_to_string() == t_clean
+
+
+def test_guard_rollback_without_checkpoint_degrades_to_skip(tmp_path):
+    X, y, _, _ = _data()
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "", "guard_policy": "rollback"}
+    set_fault_plan("nan_grad@iteration=2")
+    b = _train(params, 8, X, y)
+    assert b.num_trees() == 8
+    assert get_telemetry().counters.get("guard.skipped_iters", 0) == 1
+
+
+def test_loss_spike_detector():
+    det = LossSpikeDetector(2.0)
+    assert det.check(0, [("v", "l2", 1.0, False)]) is None
+    assert det.check(1, [("v", "l2", 1.5, False)]) is None
+    spike = det.check(2, [("v", "l2", 4.0, False)])
+    assert spike == ("v", "l2", 4.0, 1.5)
+    # bigger-is-better metrics are ignored
+    assert det.check(3, [("v", "auc", 0.01, True)]) is None
+    # non-finite values always count as a spike
+    assert det.check(4, [("v", "l2", float("nan"), False)]) is not None
+    assert get_telemetry().counters.get("guard.loss_spikes", 0) == 2
+
+
+# ----------------------------------------------------------------------
+# serving: torn-model rejection + degraded health
+def _save_model_text(tmp_path, name="m.txt"):
+    X, y, _, _ = _data()
+    b = _train({"objective": "binary", "num_leaves": 7,
+                "verbosity": -1, "metric": ""}, 6, X, y)
+    path = str(tmp_path / name)
+    b.save_model(path)
+    return b, path
+
+
+def test_registry_rejects_torn_model_file(tmp_path):
+    from lightgbm_tpu.serving.errors import ModelLoadError
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    _b, path = _save_model_text(tmp_path)
+    reg = ModelRegistry()
+    assert reg.load(path).num_trees == 6  # intact file loads
+    text = open(path).read()
+    torn = str(tmp_path / "torn.txt")
+    with open(torn, "w") as fh:
+        fh.write(text[:len(text) // 2])  # cut mid-tree
+    with pytest.raises(ModelLoadError):
+        reg.load(torn)
+
+
+def test_registry_sidecar_manifest_digest_check(tmp_path):
+    import hashlib
+    from lightgbm_tpu.serving.errors import ModelLoadError
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    _b, path = _save_model_text(tmp_path)
+    data = open(path, "rb").read()
+    good = {"files": {os.path.basename(path): {
+        "bytes": len(data),
+        "sha256": hashlib.sha256(data).hexdigest()}}}
+    with open(path + ".manifest.json", "w") as fh:
+        json.dump(good, fh)
+    assert ModelRegistry().load(path).num_trees == 6
+    bad = {"files": {os.path.basename(path): {
+        "bytes": len(data) + 7, "sha256": "0" * 64}}}
+    with open(path + ".manifest.json", "w") as fh:
+        json.dump(bad, fh)
+    with pytest.raises(ModelLoadError):
+        ModelRegistry().load(path)
+
+
+def test_serving_health_degraded_on_failed_reload(tmp_path):
+    from lightgbm_tpu.serving import ServingConfig, ServingEngine
+    from lightgbm_tpu.serving.errors import ModelLoadError
+    b, path = _save_model_text(tmp_path)
+    eng = ServingEngine(b, config=ServingConfig(
+        buckets=(8,), warmup=False), auto_start=False)
+    try:
+        assert eng.health()["status"] == "ok"
+        v1 = eng.version
+        text = open(path).read()
+        torn = str(tmp_path / "torn.txt")
+        with open(torn, "w") as fh:
+            fh.write(text[:len(text) // 2])
+        with pytest.raises(ModelLoadError):
+            eng.reload(torn)
+        h = eng.health()
+        assert h["status"] == "degraded"           # but still serving
+        assert h["version"] == v1
+        assert "torn" in h["last_reload_error"]["error"] \
+            or "truncated" in h["last_reload_error"]["error"]
+        X, _y, _, _ = _data()
+        assert np.isfinite(eng.predict_now(X[:4])).all()
+        eng.reload(path)                            # recovery
+        assert eng.health()["status"] == "ok"
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+# CLI integration: preemption + atomic snapshots + resume
+def test_cli_preempt_and_resume(tmp_path):
+    from lightgbm_tpu import cli
+    X, y, _, _ = _data()
+    train = str(tmp_path / "t.tsv")
+    np.savetxt(train, np.column_stack([y, X]), delimiter="\t",
+               fmt="%.18g")
+    model = str(tmp_path / "model.txt")
+    D = str(tmp_path / "ck")
+    args = ["task=train", "objective=binary", f"data={train}",
+            "num_trees=12", "num_leaves=7", "verbosity=-1", "metric=",
+            f"output_model={model}", f"checkpoint_dir={D}",
+            "checkpoint_freq=3", "snapshot_freq=4"]
+    cli.main(list(args))
+    clean_text = open(model).read()
+    assert os.path.exists(f"{model}.snapshot_iter_4")   # names kept
+    assert os.path.exists(f"{model}.snapshot_iter_8")
+    snap4_clean = open(f"{model}.snapshot_iter_4").read()
+    os.unlink(model)
+    shutil.rmtree(D)
+
+    set_fault_plan("sigterm@iteration=7")
+    cli.main(list(args))
+    set_fault_plan(None)
+    assert not os.path.exists(model)  # no partial model published
+    assert CheckpointManager(D).has_checkpoint()
+    cli.main(list(args))              # resume=auto default
+    assert open(model).read() == clean_text
+    # snapshots written live before the preemption are not clobbered
+    # by the resume's eval-history replay (replay_on_resume=False)
+    assert open(f"{model}.snapshot_iter_4").read() == snap4_clean

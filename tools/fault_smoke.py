@@ -1,0 +1,141 @@
+"""Fault-injection smoke drill (the CI robustness gate).
+
+Scenario (docs/Robustness.md):
+
+1. **Clean run** — 30 boosting iterations with periodic checkpoints;
+   the resulting model text is the golden answer.
+2. **Faulted run** — same config, fresh checkpoint dir, with the
+   deterministic fault harness armed: a NaN gradient injected at
+   iteration 10 under ``guard_policy=rollback`` (must restore the
+   iteration-10 checkpoint and keep going) and a SIGTERM delivered at
+   iteration 20 (must finish the iteration, write a final checkpoint,
+   and stop cleanly).
+3. **Resume run** — same command again; ``resume=auto`` must pick up
+   the final checkpoint and train to completion.
+
+PASS iff the resumed model file is **byte-identical** to the clean
+run's and the telemetry trace recorded the ``guard.nonfinite_iters``
+event. Run with ``LGBM_TPU_TELEMETRY=<path.jsonl>`` to get the trace
+artifact (CI uploads it).
+
+Usage: python tools/fault_smoke.py [workdir]
+"""
+
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+ITERS = 30
+NAN_ITER = 10
+SIGTERM_ITER = 20
+CKPT_FREQ = 5
+
+
+def make_data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(600, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.25 * X[:, 2]
+         + 0.3 * rng.randn(600) > 0).astype(np.float64)
+    Xv = rng.randn(200, 8)
+    yv = (Xv[:, 0] + 0.5 * Xv[:, 1] - 0.25 * Xv[:, 2] > 0).astype(
+        np.float64)
+    return X, y, Xv, yv
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "fault_smoke_work"
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ckpts")
+
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.basic import Dataset
+    from lightgbm_tpu.observability.telemetry import get_telemetry
+    from lightgbm_tpu.robustness.faults import set_fault_plan
+
+    X, y, Xv, yv = make_data()
+    params = {
+        "objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "metric": "binary_logloss", "bagging_fraction": 0.8,
+        "bagging_freq": 2, "checkpoint_dir": ckpt_dir,
+        "checkpoint_freq": CKPT_FREQ, "guard_policy": "rollback",
+    }
+
+    def run():
+        return engine.train(
+            dict(params), Dataset(X, label=y), num_boost_round=ITERS,
+            valid_sets=[Dataset(Xv, label=yv)], verbose_eval=False)
+
+    # 1. clean run
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    clean = run()
+    clean_text = clean.model_to_string()
+    print(f"[1/3] clean run: {clean.num_trees()} trees")
+
+    # 2. faulted run: NaN at iter 10 (rollback), SIGTERM at iter 20
+    shutil.rmtree(ckpt_dir)
+    set_fault_plan(f"nan_grad@iteration={NAN_ITER};"
+                   f"sigterm@iteration={SIGTERM_ITER}")
+    faulted = run()
+    set_fault_plan(None)
+    assert getattr(faulted, "preempted", False), \
+        "SIGTERM fault did not preempt the run"
+    print(f"[2/3] faulted run preempted at iteration "
+          f"{faulted._gbdt.iter} (NaN rolled back, SIGTERM handled)")
+
+    # 3. resume to completion
+    resumed = run()
+    resumed_text = resumed.model_to_string()
+    assert getattr(resumed, "resumed_iteration", None) is not None, \
+        "resume=auto did not restore a checkpoint"
+    print(f"[3/3] resumed from iteration "
+          f"{resumed.resumed_iteration}: {resumed.num_trees()} trees")
+
+    model_clean = os.path.join(workdir, "model_clean.txt")
+    model_resumed = os.path.join(workdir, "model_resumed.txt")
+    with open(model_clean, "w") as fh:
+        fh.write(clean_text)
+    with open(model_resumed, "w") as fh:
+        fh.write(resumed_text)
+    assert resumed_text == clean_text, (
+        "FAIL: resumed model differs from the clean run "
+        f"(diff {model_clean} {model_resumed})")
+    print("PASS: resumed model is byte-identical to the clean run")
+
+    tel = get_telemetry()
+    nonfinite = tel.counters.get("guard.nonfinite_iters", 0)
+    rollbacks = tel.counters.get("guard.rollbacks", 0)
+    assert nonfinite >= 1, (
+        "guard.nonfinite_iters did not count the injected NaN "
+        f"(counters: {tel.counters})")
+    assert rollbacks >= 1, "guard.rollbacks did not count the restore"
+    print(f"PASS: telemetry counted guard.nonfinite_iters={nonfinite:g}"
+          f" guard.rollbacks={rollbacks:g}")
+    tel.flush()
+
+    trace = os.environ.get("LGBM_TPU_TELEMETRY", "").strip()
+    if trace and os.path.exists(trace):
+        # the trace must carry the guard event for the CI artifact
+        found = 0.0
+        with open(trace) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "train_end":
+                    found = max(found, float(
+                        (rec.get("counters") or {}).get(
+                            "guard.nonfinite_iters", 0)))
+        assert found >= 1, \
+            "telemetry trace lacks guard.nonfinite_iters"
+        print(f"PASS: trace {trace} records the guard event")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

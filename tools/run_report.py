@@ -88,7 +88,13 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     serving = {k: v for k, v in serving.items()
                if k not in ("kind", "t")}
 
+    counters_all = end.get("counters") or {}
+    robustness = {k: v for k, v in counters_all.items()
+                  if k.startswith(("guard.", "checkpoint.", "retry.",
+                                   "faults."))}
+
     return {
+        "robustness": robustness,
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
         "serving": serving,
@@ -184,12 +190,41 @@ def render(records: List[Dict[str, Any]]) -> str:
         L.append(f"fused_block_hits: {d['fused_block_hits']}")
 
     interesting = {k: v for k, v in d["counters"].items()
-                   if not k.startswith("jit.")}
+                   if not k.startswith(("jit.", "guard.", "checkpoint.",
+                                        "retry.", "faults."))}
     if interesting:
         L.append("")
         L.append("== counters ==")
         for k, v in sorted(interesting.items()):
             L.append(f"{k:<32}{v:>16,.0f}")
+
+    if d.get("robustness"):
+        r = d["robustness"]
+        L.append("")
+        L.append("== robustness (guards / checkpoints / retries) ==")
+        L.append(f"guards: nonfinite_iters="
+                 f"{r.get('guard.nonfinite_iters', 0):.0f} "
+                 f"skipped={r.get('guard.skipped_iters', 0):.0f} "
+                 f"rollbacks={r.get('guard.rollbacks', 0):.0f} "
+                 f"loss_spikes={r.get('guard.loss_spikes', 0):.0f}")
+        L.append(f"checkpoints: writes="
+                 f"{r.get('checkpoint.writes', 0):.0f} "
+                 f"bytes={r.get('checkpoint.bytes', 0):.0f} "
+                 f"restores={r.get('checkpoint.restores', 0):.0f} "
+                 f"fallbacks={r.get('checkpoint.fallbacks', 0):.0f} "
+                 f"preemptions={r.get('checkpoint.preemptions', 0):.0f}")
+        L.append(f"retries: calls={r.get('retry.calls', 0):.0f} "
+                 f"retries={r.get('retry.retries', 0):.0f} "
+                 f"giveups={r.get('retry.giveups', 0):.0f} "
+                 f"sleep_s={r.get('retry.sleep_s', 0):.3f}")
+        if r.get("faults.injected"):
+            L.append(f"faults injected: "
+                     f"{r.get('faults.injected', 0):.0f} "
+                     + " ".join(
+                         f"{k.split('.', 1)[1]}={v:.0f}"
+                         for k, v in sorted(r.items())
+                         if k.startswith("faults.")
+                         and k != "faults.injected"))
 
     if d["memory"]:
         m = d["memory"]
