@@ -14,13 +14,13 @@ dtypes auto-detected), python lists, and file paths (CSV/TSV/LibSVM via
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .config import Config
 from .data.dataset import Dataset as _InnerDataset
-from .utils.log import LightGBMError, log_fatal, log_warning
+from .utils.log import LightGBMError, log_fatal
 
 __all__ = ["Dataset", "Booster", "LightGBMError"]
 
@@ -500,7 +500,9 @@ class Booster:
         return self._src().num_tree_per_iteration
 
     def __inner_predict_train(self) -> np.ndarray:
-        sc = np.asarray(self._gbdt.train_score, np.float64)
+        import jax
+        sc = np.asarray(jax.device_get(self._gbdt.train_score),
+                        np.float64)
         return sc[:, 0] if sc.shape[1] == 1 else sc.T.reshape(-1)
 
     # ------------------------------------------------------------------
@@ -580,8 +582,9 @@ class Booster:
                 g.objective)
         else:
             per_job = []
+            import jax
             for (metrics, _s, name, _ds), sc in zip(jobs, scs):
-                sc_h = np.asarray(sc)
+                sc_h = jax.device_get(sc)
                 # legacy accounting: score fetch + per-metric convert
                 # round trip (upload + convert dispatch + result fetch)
                 tel.count_iter("host.syncs", 1 + len(metrics))

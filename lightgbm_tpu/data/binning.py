@@ -24,7 +24,7 @@ Semantics preserved (file:line refer to the reference):
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import numpy as np
 

@@ -22,9 +22,8 @@ import numpy as np
 
 from ..config import Config
 from ..utils.log import log_fatal, log_info, log_warning
-from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
-                      MISSING_NAN, MISSING_NONE, MISSING_ZERO,
-                      kZeroThreshold)
+from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL,
+                      BinMapper, kZeroThreshold)
 
 
 def load_forced_bins(path: str) -> Dict[int, List[float]]:

@@ -16,7 +16,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..config import Config
-from ..utils.log import log_fatal, log_info, log_warning
+from ..utils.log import log_fatal, log_info
 
 
 def detect_format(path: str) -> str:

@@ -21,8 +21,7 @@ import numpy as np
 
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (CallbackEnv, EarlyStopException, early_stopping,
-                       print_evaluation, record_evaluation,
-                       record_telemetry)
+                       print_evaluation, record_evaluation)
 from .observability.telemetry import get_telemetry
 from .utils.log import log_info, log_warning
 

@@ -30,13 +30,13 @@ loop's control flow stays identical everywhere; only row partitioning
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
-                         _argmax_first, assemble_split, best_split,
+from ..ops.split import (FeatureMeta, SplitParams, _argmax_first,
+                         assemble_split, best_split,
                          per_feature_splits)
 
 

@@ -53,9 +53,9 @@ if USE_PART_V2:
 else:
     partition_segment = _partition_v1
     _pick_blk = None
-from ..ops.split import (MAX_CAT_WORDS, _argmax_first, assemble_split,
-                         best_split, leaf_output_no_constraint,
-                         per_feature_splits)
+from ..ops.split import (MAX_CAT_WORDS,
+                         _argmax_first, assemble_split,
+                         leaf_output_no_constraint, per_feature_splits)
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
                      StatePack, cegb_pf_state, cegb_refund,
                      cegb_store_row, cegb_upgrade_best,

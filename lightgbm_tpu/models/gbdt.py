@@ -18,7 +18,7 @@ from __future__ import annotations
 import functools
 import os
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -522,7 +522,7 @@ class GBDT:
             tel.count_iter("host.dispatches")
             ok = _finite_ok(grad, hess)
         tel.count_iter("host.syncs")
-        if bool(ok):
+        if bool(jax.device_get(ok)):
             return grad, hess
         tel.count("guard.nonfinite_iters")
         log_warning(f"guard: non-finite gradients at iteration "
@@ -556,10 +556,11 @@ class GBDT:
         # exact-reference percentile semantics need the f64 host sort;
         # this stays a (counted) host round trip by design
         get_telemetry().count_iter("host.syncs", 2)
-        score = np.asarray(self.train_score[:, tid], np.float64)
-        leaf_id = np.asarray(result.leaf_id)
+        score = np.asarray(jax.device_get(self.train_score[:, tid]),
+                           np.float64)
+        leaf_id = jax.device_get(result.leaf_id)
         if self.bag_weight is not None:
-            bag = np.asarray(self.bag_weight)
+            bag = jax.device_get(self.bag_weight)
             leaf_id = np.where(bag > 0, leaf_id, -1)  # OOB rows: no leaf
         new_vals = self.objective.renew_tree_output(
             score, leaf_id, tree.num_leaves, tree.leaf_value)
@@ -721,7 +722,7 @@ class GBDT:
                     for row in rows]
         out = []
         for metrics, sc, name in jobs:
-            sc_h = np.asarray(sc)
+            sc_h = jax.device_get(sc)
             # legacy accounting: score fetch + per-metric convert
             # round trip (upload + convert dispatch + result fetch)
             tel.count_iter("host.syncs", 1 + len(metrics))
@@ -998,7 +999,7 @@ class GBDT:
             self.iter += m
             with tel.span("device_sync"):
                 tel.count_iter("host.syncs")
-                flags = [bool(v) for v in np.asarray(oks)]
+                flags = [bool(v) for v in jax.device_get(oks)]
             if tel.enabled:
                 # the stop-flag fetch above is the block's real device
                 # barrier, so this wall time covers device execution
@@ -1212,7 +1213,7 @@ class GBDT:
                 num_iteration: int = -1) -> np.ndarray:
         raw = self.predict_raw(data, num_iteration)
         if self.objective is not None:
-            return np.asarray(
+            return jax.device_get(
                 self.objective.convert_output(jnp.asarray(raw)))
         return raw
 

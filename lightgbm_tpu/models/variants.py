@@ -296,9 +296,10 @@ class RF(GBDT):
                 self.objective, "is_renew_tree_output", False):
             return
         score = np.full(self.num_data, self._init_scores[tid], np.float64)
-        leaf_id = np.asarray(result.leaf_id)
+        leaf_id = jax.device_get(result.leaf_id)
         if self.bag_weight is not None:
-            leaf_id = np.where(np.asarray(self.bag_weight) > 0, leaf_id, -1)
+            leaf_id = np.where(jax.device_get(self.bag_weight) > 0,
+                               leaf_id, -1)
         new_vals = self.objective.renew_tree_output(
             score, leaf_id, tree.num_leaves, tree.leaf_value)
         if new_vals is not None:

@@ -13,7 +13,6 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
-import numpy as np
 
 from ..config import Config
 from ..data.dataset import Metadata
@@ -43,6 +42,15 @@ class ObjectiveFunction:
         self.label = jnp.asarray(metadata.label)
         self.weights = None if metadata.weights is None \
             else jnp.asarray(metadata.weights)
+        # host mirrors, fetched ONCE and explicitly: the scattered
+        # np.asarray(self.label) coercions the boost_from_score /
+        # check_label paths used were implicit device->host transfers
+        # that tripped the tier-1 transfer guard (graftlint GL105
+        # class). Same bits as np.asarray on the device array.
+        import jax
+        self.label_np = jax.device_get(self.label)
+        self.weights_np = None if self.weights is None \
+            else jax.device_get(self.weights)
         self.check_label()
 
     def check_label(self) -> None:

@@ -35,7 +35,7 @@ class BinaryLogloss(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        lbl = np.asarray(self.label)
+        lbl = self.label_np
         pos_mask = self._is_pos(lbl)
         cnt_positive = int(pos_mask.sum())
         cnt_negative = num_data - cnt_positive
@@ -68,10 +68,10 @@ class BinaryLogloss(ObjectiveFunction):
         return self._weighted(grad, hess)
 
     def boost_from_score(self, class_id: int = 0) -> float:
-        lbl = np.asarray(self.label)
+        lbl = self.label_np
         pos = self._is_pos(lbl).astype(np.float64)
         if self.weights is not None:
-            w = np.asarray(self.weights, np.float64)
+            w = np.asarray(self.weights_np, np.float64)
             pavg = float((pos * w).sum() / w.sum())
         else:
             pavg = float(pos.mean())

@@ -27,13 +27,13 @@ class MulticlassSoftmax(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        lbl = np.asarray(self.label).astype(np.int32)
+        lbl = self.label_np.astype(np.int32)
         if (lbl < 0).any() or (lbl >= self.num_class).any():
             log_fatal("Label must be in [0, num_class) for multiclass "
                       "objective")
         self.label_int = jnp.asarray(lbl)
         w = np.ones(num_data) if self.weights is None \
-            else np.asarray(self.weights, np.float64)
+            else np.asarray(self.weights_np, np.float64)
         probs = np.zeros(self.num_class)
         np.add.at(probs, lbl, w)
         self.class_init_probs = probs / w.sum()

@@ -21,7 +21,6 @@ Semantic deviations (documented):
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -32,8 +32,10 @@ class RegressionL2Loss(ObjectiveFunction):
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         if self.sqrt:
-            lbl = np.asarray(self.label)
+            lbl = self.label_np
             self.label = jnp.asarray(np.sign(lbl) * np.sqrt(np.abs(lbl)))
+            import jax
+            self.label_np = jax.device_get(self.label)
 
     @property
     def is_constant_hessian(self):
@@ -45,9 +47,9 @@ class RegressionL2Loss(ObjectiveFunction):
         return self._weighted(grad, hess)
 
     def boost_from_score(self, class_id: int = 0) -> float:
-        lbl = np.asarray(self.label, np.float64)
+        lbl = np.asarray(self.label_np, np.float64)
         if self.weights is not None:
-            w = np.asarray(self.weights, np.float64)
+            w = np.asarray(self.weights_np, np.float64)
             return float((lbl * w).sum() / w.sum())
         return float(lbl.mean())
 
@@ -74,15 +76,15 @@ class RegressionL1Loss(RegressionL2Loss):
 
     def boost_from_score(self, class_id: int = 0) -> float:
         from ..ops.percentile import percentile_host
-        return percentile_host(np.asarray(self.label),
-                               None if self.weights is None
-                               else np.asarray(self.weights), 0.5)
+        return percentile_host(self.label_np,
+                               self.weights_np, 0.5)
 
     def renew_tree_output(self, score, leaf_id, num_leaves, leaf_value):
         from ..ops.percentile import renew_leaf_outputs
-        residual = self.label - score
+        import jax
+        residual = jax.device_get(self.label - score)
         return renew_leaf_outputs(residual, leaf_id, num_leaves,
-                                  self.weights, self.renew_alpha)
+                                  self.weights_np, self.renew_alpha)
 
     def name(self):
         return "regression_l1"
@@ -149,7 +151,7 @@ class RegressionPoissonLoss(RegressionL2Loss):
         self.sqrt = False
 
     def check_label(self):
-        lbl = np.asarray(self.label)
+        lbl = self.label_np
         if lbl.min(initial=0.0) < 0.0:
             log_fatal(f"[{self.name()}]: at least one target label is "
                       "negative")
@@ -199,15 +201,15 @@ class RegressionQuantileLoss(RegressionL2Loss):
 
     def boost_from_score(self, class_id: int = 0) -> float:
         from ..ops.percentile import percentile_host
-        return percentile_host(np.asarray(self.label),
-                               None if self.weights is None
-                               else np.asarray(self.weights), self.alpha)
+        return percentile_host(self.label_np,
+                               self.weights_np, self.alpha)
 
     def renew_tree_output(self, score, leaf_id, num_leaves, leaf_value):
         from ..ops.percentile import renew_leaf_outputs
-        residual = self.label - score
+        import jax
+        residual = jax.device_get(self.label - score)
         return renew_leaf_outputs(residual, leaf_id, num_leaves,
-                                  self.weights, self.alpha)
+                                  self.weights_np, self.alpha)
 
     def name(self):
         return "quantile"
@@ -219,15 +221,18 @@ class RegressionMAPELoss(RegressionL1Loss):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        lbl = np.asarray(self.label)
+        lbl = self.label_np
         if np.abs(lbl).min(initial=1.0) <= 1.0:
             log_warning("Some label values are < 1 in absolute value. "
                         "MAPE is unstable with such values, so LightGBM "
                         "rounds them to 1.0 when computing MAPE.")
         w = np.ones_like(lbl) if self.weights is None \
-            else np.asarray(self.weights)
-        self.label_weight = jnp.asarray(
-            1.0 / np.maximum(1.0, np.abs(lbl)) * w)
+            else self.weights_np
+        # f32 host mirror: bit-identical to what np.asarray on the
+        # device array used to fetch (jnp downcasts f64 -> f32)
+        self._label_weight_np = np.asarray(
+            1.0 / np.maximum(1.0, np.abs(lbl)) * w, np.float32)
+        self.label_weight = jnp.asarray(self._label_weight_np)
 
     @property
     def is_constant_hessian(self):
@@ -242,14 +247,15 @@ class RegressionMAPELoss(RegressionL1Loss):
 
     def boost_from_score(self, class_id: int = 0) -> float:
         from ..ops.percentile import percentile_host
-        return percentile_host(np.asarray(self.label),
-                               np.asarray(self.label_weight), 0.5)
+        return percentile_host(self.label_np,
+                               self._label_weight_np, 0.5)
 
     def renew_tree_output(self, score, leaf_id, num_leaves, leaf_value):
         from ..ops.percentile import renew_leaf_outputs
-        residual = self.label - score
+        import jax
+        residual = jax.device_get(self.label - score)
         return renew_leaf_outputs(residual, leaf_id, num_leaves,
-                                  self.label_weight, 0.5)
+                                  self._label_weight_np, 0.5)
 
     def name(self):
         return "mape"

@@ -8,7 +8,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import Config
 from ..utils.log import log_fatal, log_info
 from .base import ObjectiveFunction
 
@@ -26,9 +25,9 @@ class CrossEntropy(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        _check_interval(self.label, self.name())
+        _check_interval(self.label_np, self.name())
         if self.weights is not None:
-            w = np.asarray(self.weights)
+            w = self.weights_np
             if w.min() <= 0.0:
                 log_fatal(f"[{self.name()}]: at least one weight is "
                           "non-positive")
@@ -40,9 +39,9 @@ class CrossEntropy(ObjectiveFunction):
         return self._weighted(grad, hess)
 
     def boost_from_score(self, class_id: int = 0) -> float:
-        lbl = np.asarray(self.label, np.float64)
+        lbl = np.asarray(self.label_np, np.float64)
         if self.weights is not None:
-            w = np.asarray(self.weights, np.float64)
+            w = np.asarray(self.weights_np, np.float64)
             pavg = float((lbl * w).sum() / w.sum())
         else:
             pavg = float(lbl.mean())
@@ -65,9 +64,9 @@ class CrossEntropyLambda(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        _check_interval(self.label, self.name())
+        _check_interval(self.label_np, self.name())
         if self.weights is not None:
-            w = np.asarray(self.weights)
+            w = self.weights_np
             if w.min() <= 0.0:
                 log_fatal(f"[{self.name()}]: at least one weight is "
                           "non-positive")
@@ -92,9 +91,9 @@ class CrossEntropyLambda(ObjectiveFunction):
         return grad, hess
 
     def boost_from_score(self, class_id: int = 0) -> float:
-        lbl = np.asarray(self.label, np.float64)
+        lbl = np.asarray(self.label_np, np.float64)
         if self.weights is not None:
-            w = np.asarray(self.weights, np.float64)
+            w = np.asarray(self.weights_np, np.float64)
             havg = float((lbl * w).sum() / w.sum())
         else:
             havg = float(lbl.mean())

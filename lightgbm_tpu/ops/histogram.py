@@ -23,7 +23,6 @@ cheap on TPU and exact under sample weights.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

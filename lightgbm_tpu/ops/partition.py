@@ -11,8 +11,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
-                    MISSING_ZERO_CODE)
+from .split import MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_ZERO_CODE
 
 
 def rows_go_left(bin_col: jnp.ndarray, threshold, default_left,

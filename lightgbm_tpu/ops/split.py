@@ -29,7 +29,6 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-import jax
 import jax.numpy as jnp
 
 kEpsilon = 1e-15

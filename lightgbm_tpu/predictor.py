@@ -23,7 +23,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-from .utils.log import log_fatal, log_warning
+from .utils.log import log_warning
 
 
 def _model_list(src, num_iteration: int) -> List:

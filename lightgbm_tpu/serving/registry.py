@@ -263,8 +263,7 @@ class ModelRegistry:
         return mv
 
     def _resolve(self, source):
-        from ..io.model_text import (LoadedBooster,
-                                     load_model_from_string)
+        from ..io.model_text import load_model_from_string
         booster = None
         if hasattr(source, "_src"):                 # basic.Booster
             booster = source

@@ -8,8 +8,7 @@ contract over the in-package ``train()`` engine.
 
 from __future__ import annotations
 
-import copy
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 

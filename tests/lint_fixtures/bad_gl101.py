@@ -1,0 +1,8 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def loss(score, label):
+    err = jnp.mean((score - label) ** 2)
+    return err.item()  # VIOLATION

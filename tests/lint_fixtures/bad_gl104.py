@@ -1,0 +1,9 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clip(x, lo):
+    if x.sum() > lo:  # VIOLATION
+        return jnp.minimum(x, lo)
+    return x

@@ -1,0 +1,15 @@
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def advance(state, delta):
+    return state + delta
+
+
+def run(state, delta):
+    state = advance(state, delta)
+    flags = np.asarray(state)  # VIOLATION
+    return flags.sum()

@@ -1,0 +1,14 @@
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def advance(state, delta):
+    return state + delta
+
+
+def run(state, delta):
+    out = advance(state, delta)
+    stale = state * 2  # VIOLATION
+    return out, stale

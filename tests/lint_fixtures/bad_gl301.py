@@ -1,0 +1,9 @@
+import jax
+
+
+def sweep(xs, fn):
+    outs = []
+    for x in xs:
+        compiled = jax.jit(fn)  # VIOLATION
+        outs.append(compiled(x))
+    return outs

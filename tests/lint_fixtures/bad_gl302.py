@@ -1,0 +1,13 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("weights",))
+def apply(x, *, weights):
+    return x * weights
+
+
+def run(x):
+    return apply(x, weights=jnp.ones((8,)))  # VIOLATION

@@ -1,0 +1,14 @@
+import jax
+
+
+def trainer(xs):
+    lr = 0.1
+
+    def step(x):
+        return x * lr
+
+    fn = jax.jit(step)  # VIOLATION
+    out = [fn(x) for x in xs]
+    lr = 0.01  # silently ignored: the trace froze lr at 0.1
+    out += [fn(x) for x in xs]
+    return out

@@ -1,0 +1,8 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def accumulate(x):
+    return jnp.cumsum(x.astype(np.float64))  # VIOLATION

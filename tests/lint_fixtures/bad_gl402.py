@@ -1,0 +1,8 @@
+import random
+
+import jax
+
+
+@jax.jit
+def jitter(x):
+    return x * random.random()  # VIOLATION

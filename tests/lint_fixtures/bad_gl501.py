@@ -1,0 +1,5 @@
+def train_iter(tel, step):
+    span = tel.span("grow", phase=True)  # VIOLATION
+    out = step()
+    span.close()
+    return out

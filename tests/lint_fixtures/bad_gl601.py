@@ -1,0 +1,6 @@
+import os
+import sys  # VIOLATION
+
+
+def cwd():
+    return os.getcwd()

@@ -1,0 +1,5 @@
+def total(values):
+    acc = 0.0
+    for v in values:
+        acc += v
+    return accum  # VIOLATION

@@ -1,0 +1,3 @@
+def collect(x, acc=[]):  # VIOLATION
+    acc.append(x)
+    return acc

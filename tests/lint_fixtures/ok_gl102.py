@@ -1,0 +1,8 @@
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("lr",))
+def step(score, grad, *, lr):
+    return score - float(lr) * grad  # static param: trace-time float

@@ -1,0 +1,12 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TABLE = [1.0, 0.5, 0.25]
+
+
+@jax.jit
+def normalize(x):
+    # np on trace-time constants is fine (folded into the program)
+    scale = jnp.asarray(np.asarray(_TABLE))
+    return x * scale[0]

@@ -1,0 +1,11 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("enabled",))
+def clip(x, lo, *, enabled):
+    if enabled:  # static param: resolved at trace time
+        return jnp.where(x.sum() > lo, jnp.minimum(x, lo), x)
+    return x

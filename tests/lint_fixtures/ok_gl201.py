@@ -1,0 +1,15 @@
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def advance(state, delta):
+    return state + delta
+
+
+def run(state, delta):
+    # rebinding from the result in the same statement is the pattern
+    state = advance(state, delta)
+    state = advance(state, delta)
+    return state

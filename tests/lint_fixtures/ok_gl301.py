@@ -1,0 +1,6 @@
+import jax
+
+
+def sweep(xs, fn):
+    compiled = jax.jit(fn)  # hoisted: one compile, many calls
+    return [compiled(x) for x in xs]

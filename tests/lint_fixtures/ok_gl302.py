@@ -1,0 +1,13 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def apply(x, weights, *, scale):
+    return x * weights * scale
+
+
+def run(x):
+    return apply(x, jnp.ones((8,)), scale=2.0)

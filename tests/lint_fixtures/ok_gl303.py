@@ -1,0 +1,8 @@
+_CACHE = {}
+
+
+def compiled_for(x, build):
+    key = (x.shape, str(x.dtype))  # hashable tuple key, no stringify
+    if key not in _CACHE:
+        _CACHE[key] = build(x)
+    return _CACHE[key]

@@ -1,0 +1,12 @@
+import jax
+
+
+def trainer(xs):
+    lr = 0.1
+
+    def step(x, lr):
+        return x * lr
+
+    fn = jax.jit(step)  # lr is an argument, not a frozen capture
+    out = [fn(x, lr) for x in xs]
+    return out + [fn(x, 0.01) for x in xs]

@@ -1,0 +1,7 @@
+import jax
+import jax.random
+
+
+@jax.jit
+def jitter(x, key):
+    return x * jax.random.uniform(key)

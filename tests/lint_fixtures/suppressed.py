@@ -1,0 +1,9 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def loss(score, label):
+    err = jnp.mean((score - label) ** 2)
+    # intentional: fixture for the inline-allow mechanism
+    return err.item()  # graftlint: allow[GL101]

@@ -7,7 +7,6 @@ import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data import Dataset
-from lightgbm_tpu.data.bundling import bundle_matrix, plan_bundles
 from lightgbm_tpu.models.gbdt import GBDT
 
 

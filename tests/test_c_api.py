@@ -10,7 +10,6 @@ predictions) from Python.
 import ctypes
 import os
 import subprocess
-import sys
 
 import numpy as np
 import pytest

@@ -13,8 +13,7 @@ import pytest
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data import Dataset
 from lightgbm_tpu.models.gbdt import GBDT
-from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams, best_split,
-                                    kEpsilon)
+from lightgbm_tpu.ops.split import FeatureMeta, SplitParams, kEpsilon
 from lightgbm_tpu.ops.split_categorical import (_pack_bitset,
                                                 per_feature_categorical)
 

@@ -128,8 +128,7 @@ def test_codegen_deep_tree_no_recursion_limit(tmp_path):
     Trained with num_leaves > recursion limit via a monotone staircase
     feature, which leaf-wise growth splits into a deep chain."""
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.io.model_text import (load_model_from_string,
-                                            save_model_to_string)
+    from lightgbm_tpu.io.model_text import load_model_from_string
     import sys
     n = 4000
     X = np.arange(n, dtype=np.float64).reshape(-1, 1)

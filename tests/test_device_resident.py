@@ -288,6 +288,44 @@ def test_compile_cache_respects_jax_env(monkeypatch):
     assert cc.maybe_enable_compile_cache(None) == "/already/wired"
 
 
+# ---------------------------------------------------------------------
+# dynamic transfer-guard enforcement (tools/graftlint/runtime.py): the
+# device-resident contract — no IMPLICIT device->host transfers on the
+# training path — is enforced at runtime, not just by counter drift.
+# Library-internal fetches (eval boundaries, stop flags, host trees)
+# must all be explicit jax.device_get; a reintroduced np.asarray /
+# float() / .item() stray coercion raises here and fails tier-1.
+def test_training_guarded_against_implicit_host_transfers():
+    from tools.graftlint.runtime import no_implicit_host_transfers
+    X, y = _toy(700)
+    Xv, yv = _toy(250, seed=1)
+    out = {}
+    train_set = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train_set)
+    with no_implicit_host_transfers():
+        # eval-bearing host-stepped loop (device eval, batched fetch)
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1,
+                   "metric": ["binary_logloss", "auc", "binary_error"]},
+                  train_set, num_boost_round=3,
+                  valid_sets=[train_set, valid],
+                  evals_result=out, verbose_eval=False)
+    assert out["valid_1"]["binary_logloss"]
+
+
+def test_pipelined_and_bagged_training_guarded():
+    from tools.graftlint.runtime import no_implicit_host_transfers
+    b = _bag_booster()
+    with no_implicit_host_transfers():
+        # async/pipelined loop + device bagging: zero implicit syncs
+        b.train(4)
+    assert b.num_iterations_trained == 4
+    rng = np.random.RandomState(0)
+    with no_implicit_host_transfers():
+        raw = b.predict_raw(rng.randn(50, 5).astype(np.float32))
+    assert np.isfinite(np.asarray(raw)).all()
+
+
 def test_bench_json_roofline_fields():
     from lightgbm_tpu.utils.roofline import bench_roofline, normalize
     r = bench_roofline(1e6, 28)

@@ -5,7 +5,6 @@ python package can load, and the CLI's predict output must match the
 loaded Booster's predictions on the same data."""
 
 import os
-import runpy
 import shutil
 import subprocess
 import sys

@@ -338,3 +338,55 @@ def test_fused_declines_nonjittable_objective(monkeypatch):
     b.finalize_trees()
     from lightgbm_tpu.models.tree import DeferredStackTree
     assert not any(isinstance(t, DeferredStackTree) for t in b.models)
+
+
+def test_fused_blocks_guarded_against_implicit_host_transfers(
+        monkeypatch):
+    """Dynamic enforcement (tools/graftlint/runtime.py): the fused
+    path's one-dispatch-per-block contract allows exactly ONE explicit
+    device fetch per block (the stop flags) — any implicit
+    device->host transfer (a reintroduced np.asarray/float()/bool()
+    coercion on device state) raises under the guard instead of
+    showing up as `host.syncs` counter drift."""
+    from tools.graftlint.runtime import no_implicit_host_transfers
+    X, y = _make(seed=7)
+    monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1")
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 7, "learning_rate": 0.1,
+        "tree_learner": "partitioned", "verbosity": -1, "metric": ""})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    b = GBDT(cfg, ds)
+    with no_implicit_host_transfers():
+        b.train(6)
+        b.finalize_trees()
+    assert b.num_iterations_trained == 6
+    from lightgbm_tpu.models.tree import DeferredStackTree
+    assert any(isinstance(m, DeferredStackTree) for m in b.models)
+
+
+def test_fused_valid_eval_guarded(monkeypatch):
+    """Eval riding the scan carry: the valid-set metric boundary's
+    batched fetch is explicit device_get, so eval-bearing fused
+    training survives the device->host transfer guard too."""
+    from tools.graftlint.runtime import no_implicit_host_transfers
+    X, y = _make(seed=8)
+    Xv, yv = _make(n=400, seed=9)
+    monkeypatch.setenv("LGBM_TPU_FUSE_ITERS", "1")
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 7, "learning_rate": 0.1,
+        "tree_learner": "partitioned", "verbosity": -1,
+        "metric": "binary_logloss", "metric_freq": 2})
+    ds = Dataset.from_numpy(X, cfg, label=y)
+    b = GBDT(cfg, ds)
+    vd = Dataset.from_numpy(Xv, cfg, label=yv, reference=ds)
+    b.add_valid(vd, "valid_0")
+    with no_implicit_host_transfers():
+        b.train(4)
+        b.finalize_trees()
+    assert b.evals_result["valid_0"]["binary_logloss"]

@@ -1,0 +1,76 @@
+"""The pytest-collected graftlint gate (ISSUE 5 tentpole).
+
+Runs the invariant rule set over ``lightgbm_tpu/`` against the
+committed baseline and fails on any NEW finding — the same check CI's
+``lint`` job runs, here so a plain local ``pytest tests/`` catches a
+reintroduced host sync / donation bug / retrace hazard before review.
+
+Also pins the acceptance bar: the hot-path modules PRs 2-4 fought for
+(engine, models/gbdt, learner/serial, the ops kernels, serving) must
+have an EMPTY baseline — pre-existing findings there were fixed, not
+grandfathered, and may not come back.
+"""
+
+import os
+
+import pytest
+
+from tools.graftlint import (ALL_RULES, HYGIENE_RULE_IDS,
+                             INVARIANT_RULE_IDS, apply_baseline,
+                             load_baseline, run_paths)
+from tools.graftlint.baseline import DEFAULT_BASELINE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HOT_PATH_PREFIXES = (
+    "lightgbm_tpu/engine.py",
+    "lightgbm_tpu/models/",
+    "lightgbm_tpu/learner/",
+    "lightgbm_tpu/ops/",
+    "lightgbm_tpu/serving/",
+)
+
+
+def _fmt(findings):
+    return "\n".join(f"  {f.path}:{f.line}  {f.rule}  {f.message}"
+                     for f in findings)
+
+
+@pytest.fixture(scope="module")
+def all_findings():
+    """ONE analysis pass with every rule (AST work dominates; rule
+    dispatch is cheap) — the per-family tests below slice it."""
+    return run_paths([os.path.join(REPO, "lightgbm_tpu"),
+                      os.path.join(REPO, "tools")], ALL_RULES,
+                     rel_to=REPO)
+
+
+def test_lightgbm_tpu_tree_has_no_new_findings(all_findings):
+    findings = [f for f in all_findings
+                if f.rule in INVARIANT_RULE_IDS
+                and f.path.startswith("lightgbm_tpu/")]
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new, _baselined, _stale = apply_baseline(findings, baseline)
+    assert not new, (
+        "graftlint found new JAX/TPU invariant violations (fix them "
+        "or, for a justified exception, add an inline "
+        "`# graftlint: allow[rule]` with a reason):\n" + _fmt(new))
+
+
+def test_hot_path_baseline_is_empty():
+    baseline = load_baseline(DEFAULT_BASELINE)
+    grandfathered = [k for k in baseline
+                     if k[0].startswith(HOT_PATH_PREFIXES)]
+    assert not grandfathered, (
+        "hot-path modules must stay baseline-clean, not "
+        f"grandfathered: {grandfathered}")
+
+
+def test_hygiene_rules_clean_on_package(all_findings):
+    """ruff-parity sweep (unused imports / undefined names / mutable
+    defaults) over the package + tools — the repo-wide fix the ruff
+    satellite demanded, enforced without requiring ruff in the
+    container (pyproject.toml pins the matching ruff selection for
+    environments that have it)."""
+    findings = [f for f in all_findings if f.rule in HYGIENE_RULE_IDS]
+    assert not findings, _fmt(findings)

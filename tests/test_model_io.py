@@ -7,7 +7,6 @@ predictions (raw and transformed).
 """
 
 import numpy as np
-import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data import Dataset

@@ -4,7 +4,6 @@ shared-column budget ride a padded slot matrix instead of dense
 columns (multi_val_sparse_bin.hpp:26, dataset.cpp:186-231,1170-1273)."""
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 import lightgbm_tpu as lgb

@@ -3,7 +3,6 @@ transcribed from the reference loops, NDCG/MAP metric values, and
 end-to-end LTR training lift."""
 
 import numpy as np
-import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data import Dataset

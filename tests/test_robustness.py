@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from lightgbm_tpu import engine
-from lightgbm_tpu.basic import Booster, Dataset
+from lightgbm_tpu.basic import Dataset
 from lightgbm_tpu.observability.telemetry import get_telemetry
 from lightgbm_tpu.robustness import retry as rretry
 from lightgbm_tpu.robustness.checkpoint import (CheckpointManager,

@@ -10,7 +10,6 @@ Acceptance gates from the serving issue:
     concurrent traffic.
 """
 
-import ctypes
 import json
 import os
 import threading

@@ -14,8 +14,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.observability.telemetry import (JsonlSink, Telemetry,
-                                                  get_telemetry)
+from lightgbm_tpu.observability.telemetry import JsonlSink, get_telemetry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,6 +1,5 @@
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data import Dataset

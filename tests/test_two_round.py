@@ -8,7 +8,6 @@ memory differs.
 """
 
 import numpy as np
-import pytest
 
 from lightgbm_tpu.basic import Dataset
 from lightgbm_tpu.config import Config

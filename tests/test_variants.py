@@ -14,7 +14,7 @@ import pytest
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data import Dataset
 from lightgbm_tpu.models.gbdt import GBDT
-from lightgbm_tpu.models.variants import DART, GOSS, RF, create_boosting
+from lightgbm_tpu.models.variants import DART, GOSS, create_boosting
 
 
 def _binary_problem(n=2000, f=8, seed=0):

@@ -1,0 +1,2 @@
+"""Repo tooling. ``tools.graftlint`` is importable (tests, CI); the
+standalone scripts in this directory are still run as plain scripts."""

@@ -1,0 +1,28 @@
+"""graftlint — AST-based JAX/TPU invariant linter for this repo.
+
+Rule families (docs/StaticAnalysis.md has the full catalog):
+  GL1xx host-sync, GL2xx donation-safety, GL3xx retrace hazards,
+  GL4xx dtype/determinism, GL5xx telemetry discipline,
+  GL6xx hygiene (ruff parity for containers without ruff).
+
+Static analysis is complemented by a thin dynamic hook
+(``tools.graftlint.runtime``) that arms ``jax.transfer_guard`` inside
+the device-resident tier-1 tests, so the #1 invariant — no implicit
+device->host transfers on the hot path — is enforced both ways.
+
+Run: ``python -m tools.graftlint`` (lints ``lightgbm_tpu/`` against
+the committed baseline), ``--rules all`` to add hygiene, ``--help``
+for the rest.
+"""
+
+from .baseline import apply_baseline, load_baseline, save_baseline
+from .core import analyze_file, run_paths
+from .findings import Finding
+from .rules import (ALL_RULES, HYGIENE_RULE_IDS, INVARIANT_RULE_IDS,
+                    RULES_BY_ID, select_rules)
+
+__all__ = [
+    "Finding", "analyze_file", "run_paths", "load_baseline",
+    "save_baseline", "apply_baseline", "ALL_RULES", "RULES_BY_ID",
+    "INVARIANT_RULE_IDS", "HYGIENE_RULE_IDS", "select_rules",
+]

@@ -1,0 +1,60 @@
+"""Committed JSON baseline for pre-existing findings.
+
+The baseline stores (path, rule, snippet) -> count. A run's findings
+are matched against it multiset-style: up to ``count`` findings with
+the same key are baselined (silenced); anything beyond that — a new
+violation, or a new copy of an old one — is reported. Stale entries
+(baselined keys with no matching finding) are reported separately so
+the file shrinks as code gets cleaned up."""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .findings import Finding, sort_findings
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def load_baseline(path: str) -> Dict[tuple, int]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[tuple, int] = {}
+    for row in data.get("findings", []):
+        key = (row["path"], row["rule"], row.get("snippet", ""))
+        out[key] = out.get(key, 0) + int(row.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    counts = collections.Counter(f.baseline_key for f in findings)
+    rows = [{"path": p, "rule": r, "snippet": s, "count": c}
+            for (p, r, s), c in sorted(counts.items())]
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": rows}, f,
+                  indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[tuple, int]
+                   ) -> Tuple[List[Finding], List[Finding], List[tuple]]:
+    """Split into (new, baselined, stale_keys)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in sort_findings(findings):
+        k = f.baseline_key
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [k for k, c in sorted(budget.items()) if c > 0]
+    return new, old, stale

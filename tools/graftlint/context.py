@@ -1,0 +1,664 @@
+"""Traced-context resolution: which functions run under a JAX trace,
+and which of their names are trace-time Python constants ("static")
+versus traced array values.
+
+The rules (tools/graftlint/rules/) only fire *inside traced code* for
+the host-sync / dtype / determinism families, so this module is the
+linter's precision core. Detection is intentionally module-local
+(imports are treated as trace-time constants; functions only ever
+called from *other* modules' traced code are not analyzed as traced —
+the repo gate covers the hot-path modules, whose jit seeds are local).
+
+Seeds for "traced":
+  * defs decorated with ``jax.jit`` / ``functools.partial(jax.jit,...)``
+  * defs wrapped at a call site: ``jax.jit(f)``,
+    ``jax.jit(functools.partial(f, **static_kw))``
+  * defs passed to ``jax.lax.{scan,while_loop,fori_loop,cond,switch,
+    map,associative_scan}``, ``jax.{vmap,pmap,grad,value_and_grad,
+    checkpoint,remat,custom_jvp,custom_vjp}``
+  * defs nested inside a traced def (they execute during the trace)
+  * defs *called* from a traced def (module-local propagation)
+
+Staticness (3-way STATIC / TRACED / HOST classification of names):
+  * ``static_argnames``/``static_argnums`` params, partial-bound
+    kwargs, params never passed at any traced call site (their default
+    is a Python value), and params that receive a static expression at
+    EVERY traced call site
+  * module globals / imports / nested defs (trace-time constants)
+  * closure names from a NON-traced enclosing scope (burned in at
+    trace time)
+  * locals assigned from static expressions; ``x is None`` compares;
+    ``.shape/.ndim/.dtype/.size`` reads
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+TRACE_WRAPPER_CALLS = {
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "lax.scan", "lax.while_loop",
+    "lax.fori_loop", "lax.cond", "lax.switch", "lax.map",
+    "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.custom_jvp", "jax.custom_vjp",
+    "vmap", "pmap",
+}
+JIT_CALLS = {"jax.jit", "jit", "jax.pjit", "pjit"}
+PARTIAL_CALLS = {"functools.partial", "partial"}
+# jnp/jax calls whose result is a traced array
+_TRACED_CALL_ROOTS = ("jnp.", "jax.numpy.", "jax.lax.", "jax.random.",
+                      "jax.nn.", "jax.ops.", "lax.", "jsp.")
+# calls whose result is a host/python value even on traced args
+_HOST_RESULT_CALLS = {"len", "isinstance", "issubclass", "type", "id",
+                      "repr", "str", "format", "hash", "getattr.None"}
+_STATIC_BUILTIN_CALLS = {"int", "float", "bool", "str", "len", "max",
+                         "min", "round", "abs", "tuple", "list", "set",
+                         "dict", "sorted", "range", "enumerate", "zip",
+                         "frozenset", "isinstance", "getattr", "type"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+STATIC = "static"
+TRACED = "traced"
+HOST = "host"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_names(node: ast.AST) -> List[str]:
+    """Names bound by an assignment target (flat, incl. starred)."""
+    out: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+    return out
+
+
+class JitSite:
+    """One jit wrapping of a module-local def: decorator or call."""
+
+    def __init__(self, func_name: str, static_names: Set[str],
+                 donate_nums: Set[int], donate_names: Set[str],
+                 bound_name: Optional[str], node: ast.AST,
+                 partial_kwargs: Set[str]):
+        self.func_name = func_name
+        self.static_names = static_names
+        self.donate_nums = donate_nums
+        self.donate_names = donate_names
+        # the name the jitted callable is bound to (decorated def name,
+        # or the Assign target of `g = jax.jit(f, ...)`)
+        self.bound_name = bound_name
+        self.node = node
+        self.partial_kwargs = partial_kwargs
+
+
+class FunctionInfo:
+    def __init__(self, node, parent: Optional["FunctionInfo"]):
+        self.node = node
+        self.parent = parent
+        self.name = getattr(node, "name", "<lambda>")
+        self.traced = False
+        self.trace_reason = ""
+        args = node.args
+        self.params: List[str] = (
+            [a.arg for a in args.posonlyargs]
+            + [a.arg for a in args.args]
+            + [a.arg for a in args.kwonlyargs]
+            + ([args.vararg.arg] if args.vararg else [])
+            + ([args.kwarg.arg] if args.kwarg else []))
+        self.pos_params: List[str] = ([a.arg for a in args.posonlyargs]
+                                      + [a.arg for a in args.args])
+        ndef = len(args.defaults)
+        self.defaulted: Set[str] = set(
+            self.pos_params[len(self.pos_params) - ndef:] if ndef else [])
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                self.defaulted.add(a.arg)
+        # param staticness starts optimistic for propagated functions
+        # and is narrowed by call sites; decorated jit functions start
+        # with exactly their declared statics.
+        self.static_params: Set[str] = set()
+        self.optimistic = False  # True => static_params may narrow
+        self.local_defs: Set[str] = set()     # nested def/class names
+        self.assigned: Dict[str, List[ast.expr]] = {}  # name -> values
+        self.static_for_targets: Set[str] = set()
+        self._collect_locals()
+
+    def _collect_locals(self) -> None:
+        body = self.node.body if isinstance(self.node.body, list) \
+            else [ast.Expr(self.node.body)]
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.ClassDef)):
+                    self.local_defs.add(sub.name)
+                elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        nm = (alias.asname or alias.name).split(".")[0]
+                        self.local_defs.add(nm)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        self._record_target(t, sub.value)
+                elif isinstance(sub, ast.AnnAssign) and sub.value:
+                    self._record_target(sub.target, sub.value)
+                elif isinstance(sub, ast.NamedExpr):
+                    self._record_target(sub.target, sub.value)
+
+    def _record_target(self, target: ast.AST, value: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.assigned.setdefault(target.id, []).append(value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                if isinstance(el, ast.Name):
+                    # unpacked element: approximate with whole value
+                    self.assigned.setdefault(el.id, []).append(value)
+
+
+class ModuleContext:
+    """Per-module analysis product handed to the rules."""
+
+    def __init__(self, path: str, tree: ast.Module, lines: List[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.functions: List[FunctionInfo] = []
+        self.by_node: Dict[ast.AST, FunctionInfo] = {}
+        self.by_name: Dict[str, FunctionInfo] = {}  # module-level defs
+        self.jit_sites: List[JitSite] = []
+        self.parent_map: Dict[ast.AST, ast.AST] = {}
+        self.module_names: Set[str] = set()
+        self._owner: Dict[ast.AST, Optional[FunctionInfo]] = {}
+        self._ctx_cache: Dict[ast.AST, "FnCtx"] = {}
+        self._build()
+        self._seed_traced()
+        self._propagate()
+
+    # ------------------------------------------------------------------
+    def _build(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parent_map[child] = parent
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                fi = FunctionInfo(node, None)
+                self.functions.append(fi)
+                self.by_node[node] = fi
+        for fi in self.functions:
+            p = self.parent_map.get(fi.node)
+            while p is not None and p not in self.by_node:
+                p = self.parent_map.get(p)
+            fi.parent = self.by_node.get(p) if p is not None else None
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.by_name[node.name] = self.by_node[node]
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    self.module_names.add(
+                        (alias.asname or alias.name).split(".")[0])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                self.module_names.add(node.name)
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self.module_names.update(_const_names(t))
+        # one pass: node -> innermost enclosing function
+        stack: List[tuple] = [(self.tree, None)]
+        while stack:
+            node, owner = stack.pop()
+            self._owner[node] = owner
+            child_owner = self.by_node.get(node, owner)
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, child_owner))
+
+    # ------------------------------------------------------------------
+    def _jit_spec_from_call(self, call: ast.Call):
+        """(inner_func_name, static_names, donate_nums, donate_names,
+        partial_kwargs) for a ``jax.jit(...)`` call, else None."""
+        if dotted_name(call.func) not in JIT_CALLS:
+            return None
+        statics: Set[str] = set()
+        donate_nums: Set[int] = set()
+        donate_names: Set[str] = set()
+        static_nums: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                statics |= set(_str_elems(kw.value))
+            elif kw.arg == "static_argnums":
+                static_nums |= set(_int_elems(kw.value))
+            elif kw.arg == "donate_argnums":
+                donate_nums |= set(_int_elems(kw.value))
+            elif kw.arg == "donate_argnames":
+                donate_names |= set(_str_elems(kw.value))
+        if not call.args:
+            return None
+        inner = call.args[0]
+        partial_kwargs: Set[str] = set()
+        if isinstance(inner, ast.Call) \
+                and dotted_name(inner.func) in PARTIAL_CALLS \
+                and inner.args:
+            partial_kwargs = {kw.arg for kw in inner.keywords if kw.arg}
+            inner = inner.args[0]
+        fname = dotted_name(inner)
+        return fname, statics, static_nums, donate_nums, donate_names, \
+            partial_kwargs
+
+    def _seed_traced(self) -> None:
+        # (a) decorated defs
+        for fi in self.functions:
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            for dec in fi.node.decorator_list:
+                spec = self._decorator_jit_spec(dec)
+                if spec is None:
+                    continue
+                statics, static_nums, donate_nums, donate_names = spec
+                self._mark_traced(fi, "jit-decorator")
+                fi.static_params = set(statics)
+                for i in static_nums:
+                    if i < len(fi.pos_params):
+                        fi.static_params.add(fi.pos_params[i])
+                self.jit_sites.append(JitSite(
+                    fi.name, set(fi.static_params), donate_nums,
+                    donate_names, fi.name, fi.node, set()))
+        # (b) jax.jit(f) / jax.jit(partial(f, **kw)) call sites and
+        # (c) lax-wrapper function references
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = self._jit_spec_from_call(node)
+            if spec is not None:
+                (fname, statics, static_nums, donate_nums, donate_names,
+                 partial_kwargs) = spec
+                bound = self._assign_target_of(node)
+                if fname in self.by_name:
+                    fi = self.by_name[fname]
+                elif fname is not None:
+                    fi = self._nested_def_named(node, fname)
+                else:
+                    fi = None
+                if isinstance(node.args[0], ast.Lambda):
+                    fi = self.by_node.get(node.args[0])
+                if fi is not None:
+                    self._mark_traced(fi, "jit-call")
+                    fi.static_params |= set(statics) | partial_kwargs
+                    for i in static_nums:
+                        if i < len(fi.pos_params):
+                            fi.static_params.add(fi.pos_params[i])
+                    for p in fi.pos_params:
+                        if p not in fi.static_params \
+                                and p not in fi.defaulted:
+                            pass  # stays non-static
+                self.jit_sites.append(JitSite(
+                    fname or "<lambda>", set(statics), donate_nums,
+                    donate_names, bound, node, partial_kwargs))
+                continue
+            if dotted_name(node.func) in TRACE_WRAPPER_CALLS:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    fi = None
+                    if isinstance(arg, ast.Lambda):
+                        fi = self.by_node.get(arg)
+                    elif isinstance(arg, ast.Name) \
+                            and arg.id in self.by_name:
+                        fi = self.by_name[arg.id]
+                    elif isinstance(arg, ast.Name):
+                        fi = self._nested_def_named(node, arg.id)
+                    elif isinstance(arg, ast.Call) \
+                            and dotted_name(arg.func) in PARTIAL_CALLS \
+                            and arg.args:
+                        fn2 = dotted_name(arg.args[0])
+                        fi = self.by_name.get(fn2) \
+                            or self._nested_def_named(node, fn2)
+                    if fi is not None:
+                        self._mark_traced(fi, "lax-wrapper")
+                        # implicit call: positional no-default params
+                        # carry traced values
+                        for p in fi.pos_params:
+                            if p not in fi.defaulted:
+                                fi.static_params.discard(p)
+        # (d) nested defs inside traced defs execute during the trace.
+        # Their params start optimistically static (direct call sites
+        # narrow them in _propagate); lax-wrapper-passed bodies were
+        # already narrowed above and stay untouched.
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions:
+                if not fi.traced and fi.parent is not None \
+                        and fi.parent.traced:
+                    self._mark_traced(fi, "nested-in-traced")
+                    fi.optimistic = True
+                    fi.static_params = set(fi.params)
+                    changed = True
+
+    def _decorator_jit_spec(self, dec: ast.AST):
+        d = dotted_name(dec)
+        if d in JIT_CALLS:
+            return set(), set(), set(), set()
+        if isinstance(dec, ast.Call):
+            if dotted_name(dec.func) in JIT_CALLS:
+                return self._kw_spec(dec)
+            if dotted_name(dec.func) in PARTIAL_CALLS and dec.args \
+                    and dotted_name(dec.args[0]) in JIT_CALLS:
+                return self._kw_spec(dec)
+        return None
+
+    @staticmethod
+    def _kw_spec(call: ast.Call):
+        statics: Set[str] = set()
+        static_nums: Set[int] = set()
+        donate_nums: Set[int] = set()
+        donate_names: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                statics |= set(_str_elems(kw.value))
+            elif kw.arg == "static_argnums":
+                static_nums |= set(_int_elems(kw.value))
+            elif kw.arg == "donate_argnums":
+                donate_nums |= set(_int_elems(kw.value))
+            elif kw.arg == "donate_argnames":
+                donate_names |= set(_str_elems(kw.value))
+        return statics, static_nums, donate_nums, donate_names
+
+    def _assign_target_of(self, node: ast.AST) -> Optional[str]:
+        p = self.parent_map.get(node)
+        if isinstance(p, ast.Assign) and p.value is node:
+            for t in p.targets:
+                d = dotted_name(t)
+                if d:
+                    return d
+        return None
+
+    def _nested_def_named(self, near: ast.AST,
+                          name: Optional[str]) -> Optional[FunctionInfo]:
+        """Resolve a Name to a def nested in the same enclosing
+        function as ``near`` (closure reference)."""
+        if name is None:
+            return None
+        scope = self.enclosing_function(near)
+        while scope is not None:
+            for fi in self.functions:
+                if fi.name == name and fi.parent is scope:
+                    return fi
+            scope = scope.parent
+        return None
+
+    def _mark_traced(self, fi: FunctionInfo, reason: str) -> None:
+        if not fi.traced:
+            fi.traced = True
+            fi.trace_reason = reason
+            if fi.optimistic is False and not fi.static_params:
+                # default standing for non-decorated traced functions:
+                # defaulted params are optimistically static (their
+                # default is a Python value) until a call site narrows
+                fi.optimistic = True
+                fi.static_params = set(fi.defaulted)
+
+    # ------------------------------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
+        if node in self._owner:
+            return self._owner[node]
+        p = self.parent_map.get(node)
+        while p is not None:
+            if p in self.by_node:
+                return self.by_node[p]
+            p = self.parent_map.get(p)
+        return None
+
+    def _propagate(self) -> None:
+        """Module-local propagation: functions called from traced code
+        become traced; their param staticness is the intersection of
+        staticness across traced call sites. Optimistic start +
+        monotone narrowing => terminates."""
+        for fi in self.functions:
+            if fi.traced and fi.optimistic:
+                fi.static_params |= {p for p in fi.params
+                                     if p in fi.defaulted}
+        for _ in range(6):
+            changed = False
+            self._ctx_cache.clear()
+            for fi in self.functions:
+                if not fi.traced:
+                    continue
+                body = fi.node.body if isinstance(fi.node.body, list) \
+                    else [ast.Expr(fi.node.body)]
+                for stmt in body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        if self.enclosing_function(sub) is not fi:
+                            continue
+                        callee = None
+                        if isinstance(sub.func, ast.Name):
+                            callee = self.by_name.get(sub.func.id)
+                            if callee is None:
+                                callee = self._nested_def_named(
+                                    sub, sub.func.id)
+                        if callee is None or callee is fi:
+                            continue
+                        if not callee.traced:
+                            callee.traced = True
+                            callee.trace_reason = "called-from-traced"
+                            callee.optimistic = True
+                            callee.static_params = set(callee.params)
+                            changed = True
+                        if callee.optimistic:
+                            if self._narrow_from_call(fi, callee, sub):
+                                changed = True
+            if not changed:
+                break
+
+    def _narrow_from_call(self, caller: FunctionInfo,
+                          callee: FunctionInfo, call: ast.Call) -> bool:
+        ctx = self.fn_ctx(caller)
+        changed = False
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            if i < len(callee.pos_params):
+                p = callee.pos_params[i]
+                if p in callee.static_params \
+                        and ctx.classify(arg) != STATIC:
+                    callee.static_params.discard(p)
+                    changed = True
+        for kw in call.keywords:
+            if kw.arg and kw.arg in callee.static_params \
+                    and ctx.classify(kw.value) != STATIC:
+                callee.static_params.discard(kw.arg)
+                changed = True
+        return changed
+
+    # ------------------------------------------------------------------
+    def fn_ctx(self, fi: FunctionInfo) -> "FnCtx":
+        ctx = self._ctx_cache.get(fi.node)
+        if ctx is None:
+            ctx = FnCtx(self, fi)
+            self._ctx_cache[fi.node] = ctx
+        return ctx
+
+    def traced_functions(self) -> List[FunctionInfo]:
+        return [fi for fi in self.functions if fi.traced]
+
+
+class FnCtx:
+    """Expression classifier (STATIC / TRACED / HOST) for one function,
+    with closure resolution through enclosing FunctionInfo scopes."""
+
+    def __init__(self, module: ModuleContext, fi: FunctionInfo):
+        self.module = module
+        self.fi = fi
+        self._local_class: Dict[str, str] = {}
+        self._settle_locals()
+
+    def _settle_locals(self) -> None:
+        for _ in range(3):
+            changed = False
+            for name, values in self.fi.assigned.items():
+                cls = None
+                for v in values:
+                    c = self.classify(v, _skip_local=name)
+                    cls = c if cls is None else _join(cls, c)
+                if cls is not None \
+                        and self._local_class.get(name) != cls:
+                    self._local_class[name] = cls
+                    changed = True
+            if not changed:
+                break
+
+    # ------------------------------------------------------------------
+    def name_class(self, name: str) -> str:
+        fi = self.fi
+        if name in self._local_class:
+            return self._local_class[name]
+        if name in fi.local_defs:
+            return STATIC
+        if name in fi.params:
+            return STATIC if name in fi.static_params \
+                else (TRACED if fi.traced else HOST)
+        # closure chain
+        scope = fi.parent
+        while scope is not None:
+            if name in scope.local_defs:
+                return STATIC
+            if name in scope.assigned or name in scope.params:
+                if not scope.traced:
+                    # values from a non-traced enclosing scope are
+                    # burned into the trace as Python constants
+                    return STATIC
+                return self.module.fn_ctx(scope).name_class(name)
+            scope = scope.parent
+        # module globals / imports: trace-time constants
+        return STATIC
+
+    def classify(self, e: ast.AST, _skip_local: Optional[str] = None
+                 ) -> str:
+        if e is None or isinstance(e, (ast.Constant, ast.Lambda,
+                                       ast.JoinedStr)):
+            return STATIC
+        if isinstance(e, ast.Name):
+            if _skip_local is not None and e.id == _skip_local \
+                    and e.id in self._local_class:
+                return self._local_class[e.id]
+            if _skip_local is not None and e.id == _skip_local:
+                return HOST
+            return self.name_class(e.id)
+        if isinstance(e, ast.Starred):
+            return self.classify(e.value, _skip_local)
+        if isinstance(e, ast.Attribute):
+            if e.attr in _SHAPE_ATTRS:
+                return STATIC
+            return self.classify(e.value, _skip_local)
+        if isinstance(e, ast.Subscript):
+            return self.classify(e.value, _skip_local)
+        if isinstance(e, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in e.ops):
+                return STATIC
+            if any(isinstance(c, ast.Constant) and c.value is None
+                   for c in [e.left] + list(e.comparators)):
+                return STATIC
+            return self._join_all([e.left] + list(e.comparators),
+                                  _skip_local)
+        if isinstance(e, ast.BoolOp):
+            return self._join_all(e.values, _skip_local)
+        if isinstance(e, ast.BinOp):
+            return self._join_all([e.left, e.right], _skip_local)
+        if isinstance(e, ast.UnaryOp):
+            return self.classify(e.operand, _skip_local)
+        if isinstance(e, ast.IfExp):
+            return self._join_all([e.body, e.orelse], _skip_local)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return self._join_all(e.elts, _skip_local)
+        if isinstance(e, ast.Dict):
+            return self._join_all(
+                [v for v in e.values if v is not None], _skip_local)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            parts = [g.iter for g in e.generators]
+            if isinstance(e, ast.DictComp):
+                parts += [e.key, e.value]
+            else:
+                parts.append(e.elt)
+            return self._join_all(parts, _skip_local)
+        if isinstance(e, ast.Call):
+            return self._classify_call(e, _skip_local)
+        return HOST
+
+    def _classify_call(self, e: ast.Call,
+                       _skip_local: Optional[str]) -> str:
+        d = dotted_name(e.func)
+        args = list(e.args) + [kw.value for kw in e.keywords]
+        if d is not None:
+            if d.startswith(_TRACED_CALL_ROOTS):
+                return TRACED
+            if d in ("jax.device_get", "jax.device_put", "np.asarray",
+                     "np.array", "numpy.asarray", "numpy.array"):
+                return HOST
+            root = d.split(".")[0]
+            if d in _STATIC_BUILTIN_CALLS or root in ("np", "numpy",
+                                                      "math", "os"):
+                argcls = self._join_all(args, _skip_local)
+                # int()/len() of anything trace-visible is a Python
+                # value; of a traced array it's a concretization the
+                # sync rules flag separately — classify by args
+                return STATIC if argcls == STATIC else argcls
+        # unknown callable: traced data in => traced data out
+        argcls = self._join_all(args, _skip_local)
+        if isinstance(e.func, (ast.Name, ast.Attribute)):
+            fcls = self.classify(e.func, _skip_local)
+            if fcls == TRACED:
+                return TRACED
+        return argcls if argcls == TRACED else HOST
+
+    def _join_all(self, exprs, _skip_local) -> str:
+        cls = STATIC
+        for x in exprs:
+            cls = _join(cls, self.classify(x, _skip_local))
+            if cls == TRACED:
+                return TRACED
+        return cls
+
+    def is_traced(self, e: ast.AST) -> bool:
+        return self.classify(e) == TRACED
+
+
+def _join(a: str, b: str) -> str:
+    if TRACED in (a, b):
+        return TRACED
+    if HOST in (a, b):
+        return HOST
+    return STATIC
+
+
+def _str_elems(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _int_elems(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []

@@ -1,0 +1,43 @@
+"""Finding record + JSON round-trip.
+
+A finding is keyed for baseline purposes by (path, rule, snippet) —
+NOT by line number, so unrelated edits above a pre-existing finding
+don't invalidate the baseline (the lightgbm/LightGBM CheckAlign
+tradition of pinning *what* regressed, not *where*)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str        # e.g. "GL101"
+    name: str        # e.g. "host-sync-item"
+    path: str        # repo-relative, posix separators
+    line: int        # 1-based
+    col: int         # 0-based
+    message: str
+    snippet: str     # stripped source line (baseline key component)
+
+    @property
+    def baseline_key(self) -> tuple:
+        return (self.path, self.rule, self.snippet)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "name": self.name, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message, "snippet": self.snippet}
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "Finding":
+        return cls(rule=d["rule"], name=d.get("name", ""),
+                   path=d["path"], line=int(d.get("line", 0)),
+                   col=int(d.get("col", 0)),
+                   message=d.get("message", ""),
+                   snippet=d.get("snippet", ""))
+
+
+def sort_findings(findings) -> list:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))

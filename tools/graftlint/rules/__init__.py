@@ -1,0 +1,56 @@
+"""Rule registry: one rule family per module, a shared visitor core.
+
+Rule id blocks:
+  GL1xx host-sync        (device->host coercions in/around traced code)
+  GL2xx donation-safety  (use after donate_argnums/donate_argnames)
+  GL3xx retrace hazards  (jit-in-loop, static array args, shape keys,
+                          churning closure captures)
+  GL4xx dtype/determinism (float64 in traced code, host entropy)
+  GL5xx telemetry        (span discipline)
+  GL6xx hygiene          (ruff-parity: unused imports, undefined
+                          names, mutable defaults)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..core import Rule
+from .donation import UseAfterDonateRule
+from .dtype_determinism import Float64InTraceRule, HostEntropyRule
+from .host_sync import (HostCoerceRule, ImplicitDeviceFetchRule,
+                        ItemCallRule, NpInTraceRule, TracedBranchRule)
+from .hygiene import (MutableDefaultRule, UndefinedNameRule,
+                      UnusedImportRule)
+from .retrace import (JitInLoopRule, ScalarClosureRule,
+                      ShapeKeyRule, StaticArrayArgRule)
+from .telemetry import SpanWithoutWithRule
+
+ALL_RULES: List[Rule] = [
+    ItemCallRule(), HostCoerceRule(), NpInTraceRule(),
+    TracedBranchRule(), ImplicitDeviceFetchRule(),
+    UseAfterDonateRule(),
+    JitInLoopRule(), StaticArrayArgRule(), ShapeKeyRule(),
+    ScalarClosureRule(),
+    Float64InTraceRule(), HostEntropyRule(),
+    SpanWithoutWithRule(),
+    UnusedImportRule(), UndefinedNameRule(), MutableDefaultRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.rule_id: r for r in ALL_RULES}
+
+# the JAX/TPU invariant set (everything except hygiene) — what the
+# repo gate + baseline cover; hygiene has its own repo-wide sweep
+INVARIANT_RULE_IDS = [r.rule_id for r in ALL_RULES
+                      if not r.rule_id.startswith("GL6")]
+HYGIENE_RULE_IDS = [r.rule_id for r in ALL_RULES
+                    if r.rule_id.startswith("GL6")]
+
+
+def select_rules(ids: Optional[Sequence[str]] = None) -> List[Rule]:
+    if not ids:
+        return list(ALL_RULES)
+    missing = [i for i in ids if i not in RULES_BY_ID]
+    if missing:
+        raise KeyError(f"unknown rule id(s): {', '.join(missing)}")
+    return [RULES_BY_ID[i] for i in ids]

@@ -1,0 +1,35 @@
+"""Shared helpers for rule modules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..context import FunctionInfo, ModuleContext
+
+
+def own_nodes(module: ModuleContext,
+              fi: FunctionInfo) -> Iterator[ast.AST]:
+    """Nodes belonging directly to ``fi`` (nested defs excluded —
+    they get their own FnCtx pass)."""
+    body = fi.node.body if isinstance(fi.node.body, list) \
+        else [ast.Expr(fi.node.body)]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if module.enclosing_function(node) is fi:
+                yield node
+
+
+def jit_bound_names(module: ModuleContext) -> Set[str]:
+    """Names (simple or dotted) that hold jit-compiled callables:
+    decorated module defs and ``x = jax.jit(...)`` targets."""
+    out: Set[str] = set()
+    for site in module.jit_sites:
+        if site.bound_name:
+            out.add(site.bound_name)
+    return out
+
+
+def call_name(node: ast.Call):
+    from ..context import dotted_name
+    return dotted_name(node.func)

@@ -1,0 +1,194 @@
+"""GL201 — donation safety.
+
+``donate_argnums``/``donate_argnames`` hands the buffer to XLA; the
+Python reference still exists but its memory may alias the output.
+Reading a donated argument after the call is undefined behavior that
+manifests as silent corruption on real accelerators while passing on
+CPU (jax copies there) — exactly the class a green CPU suite hides."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from ..context import ModuleContext, dotted_name
+from ..core import Rule
+from ..findings import Finding
+
+
+class _DonSpec:
+    def __init__(self, nums: Set[int], names: Set[str],
+                 pos_params: Optional[List[str]]):
+        self.nums = nums
+        self.names = names
+        self.pos_params = pos_params  # for argnames -> position
+
+
+class UseAfterDonateRule(Rule):
+    rule_id = "GL201"
+    name = "use-after-donate"
+    description = ("argument read after being donated to a "
+                   "donate_argnums/donate_argnames call site — the "
+                   "buffer may alias the output; rebind it from the "
+                   "call's result instead")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        specs = self._donating_callables(module)
+        if not specs:
+            return
+        for fi in module.functions:
+            body = fi.node.body if isinstance(fi.node.body, list) else []
+            yield from self._check_block(module, fi, body, specs)
+
+    # ------------------------------------------------------------------
+    def _donating_callables(self, module) -> Dict[str, _DonSpec]:
+        out: Dict[str, _DonSpec] = {}
+        for site in module.jit_sites:
+            if not (site.donate_nums or site.donate_names):
+                continue
+            target = module.by_name.get(site.func_name)
+            pos = target.pos_params if target else None
+            if site.bound_name:
+                out[site.bound_name] = _DonSpec(
+                    set(site.donate_nums), set(site.donate_names), pos)
+            if site.func_name and site.func_name != site.bound_name \
+                    and site.func_name in module.by_name:
+                out[site.func_name] = _DonSpec(
+                    set(site.donate_nums), set(site.donate_names), pos)
+        return out
+
+    def _check_block(self, module, fi, stmts: List[ast.stmt],
+                     specs) -> Iterator[Finding]:
+        for idx, stmt in enumerate(stmts):
+            for call in self._shallow_calls(stmt):
+                spec = specs.get(dotted_name(call.func) or "")
+                if spec is None:
+                    continue
+                for path in self._donated_paths(call, spec):
+                    if self._stmt_stores(stmt, path):
+                        continue  # rebound in the same statement
+                    hit = self._first_use_after(
+                        module, fi, stmts, idx, stmt, path)
+                    if hit is not None:
+                        yield self.finding(
+                            module, hit,
+                            f"`{path}` read after being donated at "
+                            f"line {call.lineno} — donated buffers "
+                            f"may alias the output")
+            # recurse into nested blocks so calls there get their own
+            # statement-list context
+            for sub in self._sub_blocks(stmt):
+                yield from self._check_block(module, fi, sub, specs)
+
+    @classmethod
+    def _shallow_calls(cls, stmt: ast.stmt) -> List[ast.Call]:
+        """Calls in this statement's own expressions — not in nested
+        statement blocks (the recursion covers those) and not in
+        nested defs (they have their own FunctionInfo pass)."""
+        out: List[ast.Call] = []
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if node is not stmt and isinstance(node, ast.stmt):
+                continue  # nested block statement: recursion's job
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)) \
+                    and node is not stmt:
+                continue
+            if isinstance(node, ast.Call):
+                out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    @staticmethod
+    def _sub_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+        out = []
+        for attr in ("body", "orelse", "finalbody"):
+            blk = getattr(stmt, attr, None)
+            if isinstance(blk, list) and blk \
+                    and isinstance(blk[0], ast.stmt):
+                out.append(blk)
+        for h in getattr(stmt, "handlers", []) or []:
+            out.append(h.body)
+        return out
+
+    @staticmethod
+    def _donated_paths(call: ast.Call, spec: _DonSpec) -> List[str]:
+        paths = []
+        for i, arg in enumerate(call.args):
+            donated = i in spec.nums
+            if not donated and spec.pos_params \
+                    and i < len(spec.pos_params):
+                donated = spec.pos_params[i] in spec.names
+            if donated:
+                d = dotted_name(arg)
+                if d:
+                    paths.append(d)
+        for kw in call.keywords:
+            if kw.arg and kw.arg in spec.names:
+                d = dotted_name(kw.value)
+                if d:
+                    paths.append(d)
+            elif kw.arg and spec.pos_params \
+                    and kw.arg in spec.pos_params \
+                    and spec.pos_params.index(kw.arg) in spec.nums:
+                d = dotted_name(kw.value)
+                if d:
+                    paths.append(d)
+        return paths
+
+    # ------------------------------------------------------------------
+    def _first_use_after(self, module, fi, stmts, idx, call_stmt,
+                         path) -> Optional[ast.AST]:
+        # forward: statements after the donating one, in source order
+        for stmt in stmts[idx + 1:]:
+            load = self._stmt_loads(stmt, path)
+            if load is not None:
+                return load
+            if self._stmt_stores(stmt, path):
+                return None
+        # back-edge: if the call sits in a loop, the next iteration
+        # re-executes the loop body from the top
+        loop = self._enclosing_loop(module, fi, call_stmt)
+        if loop is not None:
+            stores = self._stmt_stores(loop, path, skip=call_stmt)
+            if not stores:
+                for stmt in loop.body:
+                    load = self._stmt_loads(stmt, path)
+                    if load is not None:
+                        return load
+        return None
+
+    def _enclosing_loop(self, module, fi, stmt):
+        p = module.parent_map.get(stmt)
+        while p is not None and p is not fi.node:
+            if isinstance(p, (ast.For, ast.While)):
+                return p
+            p = module.parent_map.get(p)
+        return None
+
+    @staticmethod
+    def _paths_match(candidate: str, path: str) -> bool:
+        return candidate == path or candidate.startswith(path + ".")
+
+    def _stmt_loads(self, stmt, path) -> Optional[ast.AST]:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                d = dotted_name(node)
+                if d and self._paths_match(d, path):
+                    # skip the sub-names of a larger matched chain
+                    return node
+        return None
+
+    def _stmt_stores(self, stmt, path, skip=None) -> bool:
+        for node in ast.walk(stmt):
+            if node is skip:
+                continue
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None),
+                                   (ast.Store, ast.Del)):
+                d = dotted_name(node)
+                if d and (d == path or path.startswith(d + ".")):
+                    return True
+        return False

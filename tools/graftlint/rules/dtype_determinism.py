@@ -1,0 +1,86 @@
+"""GL4xx — dtype & determinism contracts.
+
+The robustness PR's bit-identical resume guarantee (and the golden
+parity suite) depend on traced programs being pure functions of their
+inputs: no f64 creeping into f32 compute (x64 is disabled; np.float64
+inside a trace downcasts silently and shifts bits), and no host
+entropy or wall-clock values frozen into a compiled program."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..context import ModuleContext, dotted_name
+from ..core import Rule
+from ..findings import Finding
+from ._util import own_nodes
+
+_F64_ATTRS = {"np.float64", "numpy.float64", "jnp.float64",
+              "jax.numpy.float64", "np.double", "numpy.double"}
+_F64_STRINGS = {"float64", "f8", ">f8", "<f8", "double"}
+_ENTROPY_ROOTS = ("random.", "np.random.", "numpy.random.",
+                  "time.", "datetime.")
+_ENTROPY_EXEMPT = {"time.strftime", "datetime.timezone"}
+
+
+class Float64InTraceRule(Rule):
+    rule_id = "GL401"
+    name = "float64-in-trace"
+    description = ("float64 dtype inside traced code: with x64 "
+                   "disabled it silently downcasts (bit drift vs the "
+                   "f64 host reference); with x64 enabled it doubles "
+                   "HBM traffic — f64 reductions belong on host")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for fi in module.traced_functions():
+            for node in own_nodes(module, fi):
+                if isinstance(node, ast.Attribute) \
+                        and dotted_name(node) in _F64_ATTRS:
+                    yield self.finding(
+                        module, node,
+                        f"`{dotted_name(node)}` in traced function "
+                        f"`{fi.name}`")
+                elif isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in _F64_STRINGS \
+                        and self._is_dtype_position(module, node):
+                    yield self.finding(
+                        module, node,
+                        f"float64 dtype string in traced function "
+                        f"`{fi.name}`")
+
+    @staticmethod
+    def _is_dtype_position(module, node) -> bool:
+        p = module.parent_map.get(node)
+        if isinstance(p, ast.keyword) and p.arg == "dtype":
+            return True
+        if isinstance(p, ast.Call):
+            d = dotted_name(p.func) or ""
+            return d.startswith(("jnp.", "jax.numpy.")) \
+                or d.endswith(".astype")
+        return False
+
+
+class HostEntropyRule(Rule):
+    rule_id = "GL402"
+    name = "host-entropy-in-trace"
+    description = ("Python random/np.random/time/datetime inside "
+                   "traced code — the draw or timestamp is frozen "
+                   "into the compiled program at trace time, breaking "
+                   "determinism contracts (bit-identical resume) in a "
+                   "way that depends on compile cache state")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for fi in module.traced_functions():
+            for node in own_nodes(module, fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func) or ""
+                if d.startswith(_ENTROPY_ROOTS) \
+                        and d not in _ENTROPY_EXEMPT:
+                    yield self.finding(
+                        module, node,
+                        f"`{d}` in traced function `{fi.name}` — "
+                        f"host entropy/wall-clock is frozen at trace "
+                        f"time (use jax.random with a threaded key)")

@@ -1,0 +1,178 @@
+"""GL1xx — host-sync lint.
+
+The paper's design premise is one fused XLA program per boosting
+iteration with no host round trips; these rules flag the coercions
+that silently break it. GL101-GL104 fire inside traced code; GL105
+fires in host code on values returned by jit-compiled callables
+(the "stray host coercion" class PRs 2-4 hunted by counter drift)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..context import TRACED, ModuleContext, dotted_name
+from ..core import Rule
+from ..findings import Finding
+from ._util import call_name, jit_bound_names, own_nodes
+
+_NP_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_DEVICE_IO = {"jax.device_get", "jax.device_put"}
+_COERCERS = {"float", "int", "bool", "complex"}
+
+
+class ItemCallRule(Rule):
+    rule_id = "GL101"
+    name = "host-sync-item"
+    description = (".item() on a traced value inside a jitted/traced "
+                   "function forces a device->host sync (or a tracer "
+                   "error) — keep the value on device")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for fi in module.traced_functions():
+            ctx = module.fn_ctx(fi)
+            for node in own_nodes(module, fi):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" \
+                        and not node.args \
+                        and ctx.classify(node.func.value) == TRACED:
+                    yield self.finding(
+                        module, node,
+                        f"`.item()` on traced value in traced "
+                        f"function `{fi.name}`")
+
+
+class HostCoerceRule(Rule):
+    rule_id = "GL102"
+    name = "host-sync-coerce"
+    description = ("float()/int()/bool() on a traced value inside a "
+                   "traced function concretizes the tracer — a host "
+                   "sync outside jit, a TracerError inside it")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for fi in module.traced_functions():
+            ctx = module.fn_ctx(fi)
+            for node in own_nodes(module, fi):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in _COERCERS \
+                        and len(node.args) == 1 \
+                        and ctx.classify(node.args[0]) == TRACED:
+                    yield self.finding(
+                        module, node,
+                        f"`{node.func.id}()` coercion of traced value "
+                        f"in traced function `{fi.name}`")
+
+
+class NpInTraceRule(Rule):
+    rule_id = "GL103"
+    name = "host-sync-numpy"
+    description = ("np.asarray/np.array on traced values (or "
+                   "jax.device_get/device_put at all) inside traced "
+                   "code materializes on host mid-trace")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for fi in module.traced_functions():
+            ctx = module.fn_ctx(fi)
+            for node in own_nodes(module, fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = call_name(node)
+                if d in _DEVICE_IO:
+                    yield self.finding(
+                        module, node,
+                        f"`{d}` inside traced function `{fi.name}`")
+                elif d in _NP_CALLS and node.args \
+                        and ctx.classify(node.args[0]) == TRACED:
+                    yield self.finding(
+                        module, node,
+                        f"`{d}` on traced value in traced function "
+                        f"`{fi.name}`")
+
+
+class TracedBranchRule(Rule):
+    rule_id = "GL104"
+    name = "traced-branch"
+    description = ("Python `if`/`while` on a traced value inside a "
+                   "traced function — use jnp.where/lax.cond; under "
+                   "jit this is a TracerBoolConversionError, outside "
+                   "it a silent per-iteration host sync")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for fi in module.traced_functions():
+            ctx = module.fn_ctx(fi)
+            for node in own_nodes(module, fi):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)) \
+                        and ctx.classify(node.test) == TRACED:
+                    kind = {"If": "if", "While": "while",
+                            "IfExp": "conditional expression"}[
+                                type(node).__name__]
+                    yield self.finding(
+                        module, node,
+                        f"`{kind}` branches on traced value in traced "
+                        f"function `{fi.name}`")
+
+
+class ImplicitDeviceFetchRule(Rule):
+    rule_id = "GL105"
+    name = "implicit-device-fetch"
+    description = ("np.asarray/float/int/bool on a value returned by "
+                   "a jit-compiled callable — an implicit "
+                   "device->host transfer invisible to the transfer "
+                   "guard discipline; use jax.device_get explicitly")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        bound = jit_bound_names(module)
+        if not bound:
+            return
+        for fi in module.functions:
+            if fi.traced:
+                continue  # traced code is GL101-104's jurisdiction
+            device_locals = self._device_locals(module, fi, bound)
+            if not device_locals:
+                continue
+            for node in own_nodes(module, fi):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                d = call_name(node)
+                coercer = (d in _NP_CALLS
+                           or (isinstance(node.func, ast.Name)
+                               and node.func.id in _COERCERS))
+                if not coercer:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) \
+                        and arg.id in device_locals:
+                    yield self.finding(
+                        module, node,
+                        f"implicit device->host fetch: `{d}({arg.id})`"
+                        f" on the result of a jitted call — use "
+                        f"jax.device_get")
+
+    def _device_locals(self, module, fi, bound):
+        out = set()
+        rebound = set()  # names that ALSO hold host values somewhere
+        for node in own_nodes(module, fi):
+            if isinstance(node, ast.Assign):
+                val = node.value
+                is_dev = (isinstance(val, ast.Call)
+                          and dotted_name(val.func) in bound)
+                # second-order: unpacking a tracked device local
+                if isinstance(val, ast.Name) and val.id in out:
+                    is_dev = True
+                sink = out if is_dev else rebound
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        sink.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            if isinstance(el, ast.Name):
+                                sink.add(el.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                # loop targets iterate element-wise (often over a
+                # fetched host copy) — ambiguous, don't track
+                for el in ast.walk(node.target):
+                    if isinstance(el, ast.Name):
+                        rebound.add(el.id)
+        return out - rebound

@@ -1,0 +1,160 @@
+"""GL6xx — hygiene (ruff parity).
+
+Mirrors the ruff selection in pyproject.toml (F401 unused imports,
+F821 undefined names, B006 mutable default args) so the checks run in
+environments without ruff — the container gating rule: never assume a
+third-party linter is installed. Conservative by design: GL602 uses a
+flat module-wide binding set (it catches typos, not scoping
+subtleties) and is disabled entirely for star-import modules."""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, Iterator, List, Set
+
+from ..context import ModuleContext
+from ..core import Rule
+from ..findings import Finding
+
+_BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__class__",
+    "__annotations__", "__dict__", "__path__", "WindowsError"}
+
+
+class UnusedImportRule(Rule):
+    rule_id = "GL601"
+    name = "unused-import"
+    description = ("imported name never used in the module (ruff "
+                   "F401); __init__.py re-exports are exempt")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        if module.path.endswith("__init__.py"):
+            return
+        imports: Dict[str, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = (alias.asname or alias.name).split(".")[0]
+                    imports.setdefault(name, node)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        return  # can't reason about star imports
+                    name = alias.asname or alias.name
+                    imports.setdefault(name, node)
+        if not imports:
+            return
+        used: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                root = node
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name):
+                    used.add(root.id)
+        used |= self._all_strings(module.tree)
+        for name, node in sorted(imports.items(),
+                                 key=lambda kv: kv[1].lineno):
+            if name not in used:
+                yield self.finding(module, node,
+                                   f"`{name}` imported but unused")
+
+    @staticmethod
+    def _all_strings(tree: ast.Module) -> Set[str]:
+        """Names referenced via __all__."""
+        out: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.List, ast.Tuple)):
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        out.add(el.value)
+        return out
+
+
+class UndefinedNameRule(Rule):
+    rule_id = "GL602"
+    name = "undefined-name"
+    description = ("name loaded but never bound anywhere in the "
+                   "module and not a builtin (ruff F821) — almost "
+                   "always a typo that only explodes at runtime on "
+                   "the path tests didn't cover")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        bound: Set[str] = set(_BUILTINS)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                bound.add(node.name)
+            elif isinstance(node, ast.arg):
+                bound.add(node.arg)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    if alias.name == "*":
+                        return  # star import: skip the module
+                    bound.add((alias.asname or alias.name).split(".")[0])
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                bound.add(node.name)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                bound.update(node.names)
+            elif isinstance(node, ast.MatchAs) and node.name:
+                bound.add(node.name)
+            elif isinstance(node, ast.MatchStar) and node.name:
+                bound.add(node.name)
+            elif isinstance(node, ast.MatchMapping) and node.rest:
+                bound.add(node.rest)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id not in bound:
+                yield self.finding(module, node,
+                                   f"undefined name `{node.id}`")
+
+
+class MutableDefaultRule(Rule):
+    rule_id = "GL603"
+    name = "mutable-default-arg"
+    description = ("mutable default argument (ruff B006): the "
+                   "list/dict/set is shared across calls — one "
+                   "caller's mutation leaks into the next")
+
+    _MUTABLE_CALLS = {"list", "dict", "set", "bytearray",
+                      "collections.defaultdict", "defaultdict",
+                      "collections.OrderedDict", "OrderedDict"}
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            defaults: List[ast.expr] = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if self._mutable(d):
+                    fname = getattr(node, "name", "<lambda>")
+                    yield self.finding(
+                        module, d,
+                        f"mutable default argument in `{fname}`")
+
+    @classmethod
+    def _mutable(cls, d: ast.expr) -> bool:
+        if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(d, ast.Call):
+            from ..context import dotted_name
+            return dotted_name(d.func) in cls._MUTABLE_CALLS
+        return False

@@ -1,0 +1,229 @@
+"""GL3xx — retrace hazards.
+
+Every retrace is a full XLA recompile (seconds to minutes at bench
+shapes); the perf PRs' `jit.cache_hits` counter only catches churn
+after the fact. These rules flag the static patterns that cause it."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ..context import (JIT_CALLS, PARTIAL_CALLS, TRACED, ModuleContext,
+                       dotted_name)
+from ..core import Rule
+from ..findings import Finding
+
+_ARRAY_FACTORY_ROOTS = ("jnp.", "jax.numpy.", "jax.random.")
+_NP_ARRAY_FACTORIES = {"np.asarray", "np.array", "np.zeros", "np.ones",
+                       "np.arange", "np.full", "np.empty",
+                       "numpy.asarray", "numpy.array"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    d = dotted_name(node.func)
+    if d in JIT_CALLS:
+        return True
+    return (d in PARTIAL_CALLS and bool(node.args)
+            and dotted_name(node.args[0]) in JIT_CALLS)
+
+
+class JitInLoopRule(Rule):
+    rule_id = "GL301"
+    name = "jit-in-loop"
+    description = ("jax.jit called inside a loop body builds a fresh "
+                   "compiled callable per iteration — hoist it (or "
+                   "cache it on the owner, like GBDT._grad_bag_jit)")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            owner = module.enclosing_function(loop)
+            for stmt in loop.body + loop.orelse:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) \
+                            and _is_jit_call(node) \
+                            and module.enclosing_function(node) is owner:
+                        yield self.finding(
+                            module, node,
+                            "jax.jit inside a loop body — each "
+                            "iteration rebuilds (and re-traces) the "
+                            "compiled callable")
+
+
+class StaticArrayArgRule(Rule):
+    rule_id = "GL302"
+    name = "static-array-arg"
+    description = ("an array is passed for a static_argnums/"
+                   "static_argnames parameter — arrays are unhashable "
+                   "(TypeError) or, as numpy values, retrace on every "
+                   "distinct content")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        # map each jitted callable's static params
+        statics = {}
+        for site in module.jit_sites:
+            target = module.by_name.get(site.func_name)
+            names: Set[str] = set(site.static_names)
+            pos: Optional[List[str]] = None
+            if target is not None:
+                names |= target.static_params & set(target.params)
+                pos = target.pos_params
+            if site.bound_name and names:
+                statics[site.bound_name] = (names, pos)
+        if not statics:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            entry = statics.get(dotted_name(node.func) or "")
+            if entry is None:
+                continue
+            names, pos = entry
+            caller = module.enclosing_function(node)
+            ctx = module.fn_ctx(caller) if caller is not None else None
+            for i, arg in enumerate(node.args):
+                if pos and i < len(pos) and pos[i] in names \
+                        and self._arraylike(arg, ctx):
+                    yield self.finding(
+                        module, arg,
+                        f"array-valued argument for static parameter "
+                        f"`{pos[i]}`")
+            for kw in node.keywords:
+                if kw.arg in names and self._arraylike(kw.value, ctx):
+                    yield self.finding(
+                        module, kw.value,
+                        f"array-valued argument for static parameter "
+                        f"`{kw.arg}`")
+
+    @staticmethod
+    def _arraylike(e: ast.AST, ctx) -> bool:
+        if isinstance(e, ast.Call):
+            d = dotted_name(e.func) or ""
+            if d.startswith(_ARRAY_FACTORY_ROOTS) \
+                    or d in _NP_ARRAY_FACTORIES:
+                return True
+        if ctx is not None and ctx.classify(e) == TRACED:
+            return True
+        return False
+
+
+class ShapeKeyRule(Rule):
+    rule_id = "GL303"
+    name = "shape-string-key"
+    description = ("dict/cache key built by stringifying an array "
+                   "shape (f-string or str(x.shape)) — shape tuples "
+                   "are already hashable; string keys silently "
+                   "collide across dtypes and invite per-shape state "
+                   "leaks in retrace-sensitive caches")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        slice_names: set = set()
+        shape_str_assigns = {}  # name -> assignment node (first wins)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Subscript):
+                if self._shape_str(node.slice):
+                    yield self.finding(
+                        module, node.slice,
+                        "subscript key stringifies an array shape")
+                elif isinstance(node.slice, ast.Name):
+                    slice_names.add(node.slice.id)
+            elif isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if k is not None and self._shape_str(k):
+                        yield self.finding(
+                            module, k,
+                            "dict key stringifies an array shape")
+            elif isinstance(node, ast.Assign) \
+                    and self._shape_str(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        shape_str_assigns.setdefault(t.id, node)
+        # indirect: key = f"...{x.shape}..." later used as d[key]
+        for name, node in sorted(shape_str_assigns.items(),
+                                 key=lambda kv: kv[1].lineno):
+            if name in slice_names:
+                yield self.finding(
+                    module, node,
+                    f"`{name}` stringifies an array shape and is used "
+                    f"as a subscript key")
+
+    @staticmethod
+    def _shape_str(e: ast.AST) -> bool:
+        if isinstance(e, ast.JoinedStr):
+            for v in e.values:
+                if isinstance(v, ast.FormattedValue):
+                    for sub in ast.walk(v.value):
+                        if isinstance(sub, ast.Attribute) \
+                                and sub.attr == "shape":
+                            return True
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Name) \
+                and e.func.id == "str" and e.args:
+            for sub in ast.walk(e.args[0]):
+                if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                    return True
+        return False
+
+
+class ScalarClosureRule(Rule):
+    rule_id = "GL304"
+    name = "churning-closure-capture"
+    description = ("jit-wrapped nested function captures an enclosing "
+                   "local that is rebound (or a mutable list/dict/set "
+                   "literal) — the trace freezes the value at first "
+                   "call; later rebinds silently don't apply")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        for site in module.jit_sites:
+            fi = None
+            for cand in module.functions:
+                if cand.name == site.func_name \
+                        and cand.parent is not None:
+                    fi = cand
+                    break
+            if fi is None or fi.parent is None:
+                continue
+            enclosing = fi.parent
+            captured = self._captured_names(fi)
+            for name in sorted(captured):
+                values = enclosing.assigned.get(name)
+                if not values:
+                    continue
+                if len(values) > 1:
+                    yield self.finding(
+                        module, site.node,
+                        f"jitted closure `{fi.name}` captures "
+                        f"`{name}`, rebound {len(values)}x in "
+                        f"`{enclosing.name}` — the first trace "
+                        f"freezes it")
+                elif isinstance(values[0], (ast.List, ast.Dict,
+                                            ast.Set)):
+                    yield self.finding(
+                        module, site.node,
+                        f"jitted closure `{fi.name}` captures mutable "
+                        f"literal `{name}` — mutations after tracing "
+                        f"silently don't apply")
+
+    @staticmethod
+    def _captured_names(fi) -> Set[str]:
+        # a name bound anywhere in the subtree (incl. nested defs'
+        # params/locals) is not a capture from the enclosing scope
+        local: Set[str] = set(fi.params) | set(fi.assigned) \
+            | fi.local_defs
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                local.add(node.id)
+            elif isinstance(node, ast.arg):
+                local.add(node.arg)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                local.add(node.name)
+        out: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id not in local:
+                out.add(node.id)
+        return out

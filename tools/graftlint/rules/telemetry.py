@@ -1,0 +1,51 @@
+"""GL501 — telemetry span discipline.
+
+Spans (observability/telemetry.py) are context managers; a span opened
+without ``with`` never closes on an exception path, so the phase
+totals under-count exactly when something went wrong — the trace you
+need most is the one that lies."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..context import ModuleContext
+from ..core import Rule
+from ..findings import Finding
+
+_SPAN_METHODS = {"span"}
+
+
+class SpanWithoutWithRule(Rule):
+    rule_id = "GL501"
+    name = "span-without-with"
+    description = ("telemetry .span(...) opened outside a `with` "
+                   "block — error paths leak the span and skew phase "
+                   "totals; use `with tel.span(...)`")
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        with_exprs: Set[int] = set()
+        returned: Set[int] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+            elif isinstance(node, ast.Return) and node.value is not None:
+                returned.add(id(node.value))
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SPAN_METHODS):
+                continue
+            if id(node) in with_exprs or id(node) in returned:
+                continue  # `with tel.span(...)` or a pass-through
+            fi = module.enclosing_function(node)
+            # the telemetry module's own span() machinery is exempt
+            if fi is not None and fi.name in _SPAN_METHODS:
+                continue
+            yield self.finding(
+                module, node,
+                "span opened outside `with` — it will not close on "
+                "error paths")

@@ -1,0 +1,208 @@
+"""Dynamic enforcement hook: no implicit device->host transfers.
+
+The static host-sync rules (GL1xx) can only see this module's AST;
+the runtime guard catches the same invariant end-to-end — any
+*implicit* device->host coercion (np.asarray on a device array,
+float()/bool() on a device scalar, .item()) raises inside the guarded
+region, while explicit ``jax.device_get`` stays allowed. The
+device-resident tier-1 tests wrap training in
+``no_implicit_host_transfers()`` so a reintroduced stray coercion
+fails the suite outright instead of showing up as `host.syncs`
+counter drift a reviewer has to notice.
+
+Two layers, because they cover different backends:
+
+* ``jax.transfer_guard_device_to_host("disallow")`` — jax's own
+  scoped guard. On real device backends (TPU) every implicit D2H DMA
+  trips it. On the CPU backend it is VACUOUS: host "transfers" are
+  zero-copy views and never register with the guard (verified on
+  jax 0.4.37 — np.asarray/float()/.item() all pass silently).
+* a Python-level interception — the coercion dunders on jax's
+  concrete Array type (``__array__``/``__bool__``/``__float__``/...)
+  are wrapped for the scope's duration and raise
+  :class:`ImplicitHostTransferError` unless the nearest non-numpy
+  caller frame is jax itself. That allowance is what keeps EXPLICIT
+  fetches working: ``jax.device_get`` materializes via jax's own
+  frames, as does compile-time constant embedding (mlir lowering), so
+  only *library/user code* doing the coercion directly is blocked —
+  exactly the discipline graftlint's GL105 enforces statically.
+
+Host->device stays open: uploads (dataset construction, per-call np
+inputs) are legitimate and ubiquitous; the device-resident contract
+is about *fetches*.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+
+_WRAPPED_DUNDERS = ("__array__", "__bool__", "__float__", "__int__",
+                    "__index__", "__complex__", "item", "tolist",
+                    # numpy 2 consumes jax arrays zero-copy via DLPack
+                    # BEFORE trying __array__ — same implicit fetch,
+                    # different protocol
+                    "__dlpack__")
+_ALLOWED_ROOTS = ("jax", "jaxlib")
+# frames skipped when resolving "who asked for the coercion": numpy's
+# Python shims sit between e.g. np.asarray and __array__
+_SKIPPED_ROOTS = ("numpy",)
+
+
+class ImplicitHostTransferError(RuntimeError):
+    """An implicit device->host coercion inside a guarded scope."""
+
+
+class _InterceptState(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_STATE = _InterceptState()
+_PATCH_LOCK = threading.Lock()
+_ORIGINALS: dict = {}
+
+
+def transfer_guard_supported() -> bool:
+    """Capability probe for jax's scoped per-direction guards (jax
+    0.3.x+); older jax falls back to the interception layer alone."""
+    import jax
+    return hasattr(jax, "transfer_guard_device_to_host")
+
+
+def _caller_is_jax() -> bool:
+    """True when the nearest non-numpy Python frame below the wrapped
+    call belongs to jax — an explicit device_get or jax-internal
+    materialization (constant lowering, debugging callbacks)."""
+    f = sys._getframe(2)  # 0=_caller_is_jax, 1=the wrapper, 2=caller
+    own_root = __name__.partition(".")[0]
+    while f is not None:
+        root = f.f_globals.get("__name__", "").partition(".")[0]
+        if root in _SKIPPED_ROOTS or root == own_root:
+            f = f.f_back
+            continue
+        return root in _ALLOWED_ROOTS
+    return False
+
+
+def _wrap(cls, name):
+    orig = getattr(cls, name, None)
+    if orig is None:
+        return None
+
+    def guarded(self, *args, **kwargs):
+        if _STATE.depth > 0 and not _caller_is_jax():
+            raise ImplicitHostTransferError(
+                f"implicit device->host transfer: `{name}` on a jax "
+                f"array inside a no_implicit_host_transfers() scope — "
+                f"fetch explicitly with jax.device_get "
+                f"(graftlint GL105; docs/StaticAnalysis.md)")
+        return orig(self, *args, **kwargs)
+
+    guarded.__name__ = name
+    guarded.__qualname__ = f"{cls.__name__}.{name}"
+    return orig, guarded
+
+
+def _array_type():
+    import jax.numpy as jnp
+    return type(jnp.zeros((), jnp.float32))
+
+
+# numpy converters reach a CPU-backed jax array's storage through the
+# C-level buffer/DLPack protocols without ever calling a Python-level
+# dunder, so the dunder wraps alone can't see np.asarray(x). Wrap the
+# numpy entry points themselves (same jax-caller allowance — an
+# explicit jax.device_get internally calls np.asarray from a jax
+# frame and stays permitted).
+_WRAPPED_NP_FUNCS = ("asarray", "array", "asanyarray",
+                     "ascontiguousarray", "asfortranarray", "copy")
+
+
+def _wrap_np(np_mod, name, array_cls):
+    orig = getattr(np_mod, name, None)
+    if orig is None:
+        return None
+
+    def guarded(a, *args, **kwargs):
+        if _STATE.depth > 0 and isinstance(a, array_cls) \
+                and not _caller_is_jax():
+            raise ImplicitHostTransferError(
+                f"implicit device->host transfer: `np.{name}` on a "
+                f"jax array inside a no_implicit_host_transfers() "
+                f"scope — fetch explicitly with jax.device_get "
+                f"(graftlint GL105; docs/StaticAnalysis.md)")
+        return orig(a, *args, **kwargs)
+
+    guarded.__name__ = name
+    return orig, guarded
+
+
+def _install() -> None:
+    import numpy as np
+    with _PATCH_LOCK:
+        if _ORIGINALS:
+            return
+        cls = _array_type()
+        for name in _WRAPPED_DUNDERS:
+            pair = _wrap(cls, name)
+            if pair is not None:
+                _ORIGINALS[(cls, name)] = pair[0]
+                setattr(cls, name, pair[1])
+        for name in _WRAPPED_NP_FUNCS:
+            pair = _wrap_np(np, name, cls)
+            if pair is not None:
+                _ORIGINALS[(np, name)] = pair[0]
+                setattr(np, name, pair[1])
+
+
+def _uninstall() -> None:
+    with _PATCH_LOCK:
+        for (obj, name), orig in _ORIGINALS.items():
+            setattr(obj, name, orig)
+        _ORIGINALS.clear()
+
+
+@contextlib.contextmanager
+def no_implicit_host_transfers():
+    """Disallow implicit device->host transfers in the scope.
+
+    Yields True when at least one enforcement layer is armed (always,
+    on current jax: the interception layer needs no jax support).
+    Nestable and thread-scoped: only the arming thread is policed, so
+    a guarded test can't fail a concurrent serving thread.
+    """
+    import jax
+    _install()
+    _STATE.depth += 1
+    try:
+        if transfer_guard_supported():
+            with jax.transfer_guard_device_to_host("disallow"):
+                yield True
+        else:  # pragma: no cover - old jax
+            yield True
+    finally:
+        _STATE.depth -= 1
+        if _STATE.depth == 0:
+            _uninstall()
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Strictest scope: jax's guard disallows implicit transfers in
+    ANY direction (on backends that register them), plus the D2H
+    interception. Most callers want ``no_implicit_host_transfers``."""
+    import jax
+    _install()
+    _STATE.depth += 1
+    try:
+        if hasattr(jax, "transfer_guard"):
+            with jax.transfer_guard("disallow"):
+                yield True
+        else:  # pragma: no cover - old jax
+            yield True
+    finally:
+        _STATE.depth -= 1
+        if _STATE.depth == 0:
+            _uninstall()

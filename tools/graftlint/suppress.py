@@ -1,0 +1,36 @@
+"""Inline suppressions: ``# graftlint: allow[GL101]`` (comma-separated
+rule ids, or ``*`` for all rules) on the finding's physical line, or on
+the line directly above it (for lines too long to carry a comment)."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set
+
+_ALLOW_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[([A-Za-z0-9_*,\s-]+)\]")
+
+
+def parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of allowed rule ids ('*' = all).
+
+    A suppression on its own line (nothing but the comment) also covers
+    the next line."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if line.strip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def is_suppressed(suppressions: Dict[int, Set[str]], line: int,
+                  rule: str) -> bool:
+    allowed = suppressions.get(line)
+    if not allowed:
+        return False
+    return "*" in allowed or rule in allowed

@@ -38,12 +38,10 @@ def main():
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.data import Dataset
     from lightgbm_tpu.learner.partitioned import PartitionedTreeLearner
-    from lightgbm_tpu.learner import partitioned as P
     from lightgbm_tpu.ops.split import best_split, leaf_output_no_constraint
     from lightgbm_tpu.ops.hist_pallas import (combine_planes,
                                               histogram_segment_raw)
-    from lightgbm_tpu.ops.partition_pallas import bitset_to_lut, \
-        partition_segment
+    from lightgbm_tpu.ops.partition_pallas import partition_segment
 
     rng = np.random.RandomState(42)
     X = rng.randn(n, f).astype(np.float32)
