@@ -26,6 +26,27 @@ import time
 
 BASELINE_ROW_ITERS_PER_S = 10_500_000 * 500 / 238.505
 
+# ---------------------------------------------------------------------
+# fixed-config CPU baseline (ROADMAP item 5): ONE pinned configuration,
+# measured steady-state (warmup absorbs every compile), so the CPU
+# number is comparable round over round. The r02->r05 history mixed
+# 2-iteration micro-runs at drifting shapes and was pure noise.
+# Changing ANY of these constants requires bumping the config id.
+CPU_BASELINE = {"rows": 50_000, "features": 28, "leaves": 63,
+                "iters": 10}
+CPU_BASELINE_ID = "cpu-fixed-v1-50k-28f-63l-10it"
+CPU_BASELINE_TIMEOUT_S = 420
+
+# linear-tree convergence probe (ROADMAP item 4): iterations for
+# linear_tree=true to reach the constant-leaf model's validation loss
+# on dense numeric regression, recorded in the bench JSON
+LINEAR_CONV_TIMEOUT_S = 300
+
+# cached TPU probe verdict: one wedged-tunnel hang must not eat the
+# budget of every bench invocation in a round
+PROBE_CACHE_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_probe_cache.json")
+
 # escalation order: smallest first so SOME number prints fast
 ROWS_PLAN = [500_000, 2_000_000, 10_500_000]
 # per-size child timeout caps (seconds); the first must cover one cold
@@ -180,6 +201,99 @@ def measure():
     print(json.dumps(result))
 
 
+def measure_linear():
+    """Linear-vs-constant convergence on dense synthetic regression
+    (the ISSUE-6 acceptance metric): train a constant-leaf model for
+    ``iters`` rounds, then count how many rounds ``linear_tree=true``
+    needs to reach (<=) its final validation l2. Prints one JSON line
+    with the iteration ratio."""
+    import numpy as np
+
+    n = int(os.environ.get("BENCH_LINEAR_ROWS", 20_000))
+    f = int(os.environ.get("BENCH_LINEAR_FEATURES", 10))
+    iters = int(os.environ.get("BENCH_LINEAR_ITERS", 40))
+    leaves = int(os.environ.get("BENCH_LINEAR_LEAVES", 15))
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(n, f)
+    y = (3.0 * X[:, 0] + 2.0 * X[:, 1] - 1.5 * X[:, 2]
+         + 0.5 * X[:, 3] * X[:, 4] + 0.1 * rng.randn(n))
+    cut = int(n * 0.8)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.callback import record_evaluation
+
+    def run(linear: bool):
+        params = {"objective": "regression", "num_leaves": leaves,
+                  "learning_rate": 0.1, "metric": "l2",
+                  "verbosity": -1}
+        if linear:
+            params.update(linear_tree=True, linear_lambda=0.01)
+        hist = {}
+        lgb.train(params, lgb.Dataset(X[:cut], label=y[:cut]),
+                  num_boost_round=iters,
+                  valid_sets=[lgb.Dataset(X[cut:], label=y[cut:])],
+                  valid_names=["valid"], verbose_eval=False,
+                  callbacks=[record_evaluation(hist)])
+        return hist["valid"]["l2"]
+
+    const_curve = run(False)
+    linear_curve = run(True)
+    target = const_curve[-1]
+    match_iter = next((i + 1 for i, v in enumerate(linear_curve)
+                       if v <= target), None)
+    result = {
+        "metric": "linear_tree_convergence",
+        "rows": n, "features": f, "num_leaves": leaves,
+        "const_iters": iters,
+        "const_valid_l2": round(float(target), 6),
+        "linear_iters_to_match": match_iter,
+        "linear_final_l2": round(float(linear_curve[-1]), 6),
+        "iter_ratio": round(match_iter / iters, 4)
+        if match_iter else None,
+        # acceptance bar: linear leaves reach the constant model's
+        # valid loss in <= 0.7x the iterations on dense numeric data
+        "meets_0p7_bar": bool(match_iter is not None
+                              and match_iter <= 0.7 * iters)}
+    print(json.dumps(result))
+
+
+def _probe_cache_ttl() -> float:
+    return float(os.environ.get("BENCH_PROBE_TTL_S", 1800))
+
+
+def read_probe_cache():
+    """Fresh cached probe verdict dict, or None. Verdicts are keyed by
+    the BENCH_ALLOW_CPU mode so a CPU-allowed test run's 'ok' can
+    never stand in for a real accelerator verdict."""
+    if os.environ.get("BENCH_PROBE_CACHE", "1") == "0":
+        return None
+    try:
+        with open(PROBE_CACHE_FILE) as fh:
+            data = json.load(fh)
+        if data.get("allow_cpu") != bool(
+                os.environ.get("BENCH_ALLOW_CPU")):
+            return None
+        if time.time() - float(data.get("ts", 0)) <= _probe_cache_ttl():
+            return data
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def write_probe_cache(ok: bool, detail: str) -> None:
+    if os.environ.get("BENCH_PROBE_CACHE", "1") == "0":
+        return
+    try:
+        with open(PROBE_CACHE_FILE, "w") as fh:
+            json.dump({"ok": bool(ok), "detail": detail[:500],
+                       "allow_cpu":
+                       bool(os.environ.get("BENCH_ALLOW_CPU")),
+                       "ts": time.time()}, fh)
+    except OSError:
+        pass
+
+
 def find_result_line(stdout: str):
     """Locate and parse the last JSON result line in bench output
     (shared with tools/bench_sweep.py)."""
@@ -208,9 +322,79 @@ def _run_child(env, rows, timeout):
     return None, (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
 
 
+def _cpu_env(env):
+    """Child env forced onto the CPU backend (never dials the tunnel)."""
+    envc = dict(env)
+    envc.pop("PALLAS_AXON_POOL_IPS", None)
+    envc["JAX_PLATFORMS"] = "cpu"
+    flags = envc.get("XLA_FLAGS", "")
+    if "xla_cpu_max_isa" not in flags:  # see tests/conftest.py
+        envc["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    return envc
+
+
+def _fixed_cpu_child_env(env):
+    """The ONE pinned CPU configuration (CPU_BASELINE/CPU_BASELINE_ID):
+    steady-state iterations with warmup absorbing every compile."""
+    envc = _cpu_env(env)
+    envc["BENCH_FEATURES"] = str(CPU_BASELINE["features"])
+    envc["BENCH_LEAVES"] = str(CPU_BASELINE["leaves"])
+    envc["BENCH_ITERS"] = str(CPU_BASELINE["iters"])
+    envc["BENCH_WARMUP_ITERS"] = str(CPU_BASELINE["iters"] + 1)
+    envc["BENCH_SERVING"] = "0"       # training throughput only
+    envc["BENCH_MIN_AUC"] = os.environ.get("BENCH_BASELINE_MIN_AUC",
+                                           "0.75")
+    return envc
+
+
+def run_cpu_baseline(env, remaining):
+    """Measure the fixed-config steady-state CPU baseline; prints its
+    JSON line (metric cpu_fixed_baseline_throughput) and returns it."""
+    if os.environ.get("BENCH_NO_CPU_BASELINE") or remaining < 120:
+        return None
+    envc = _fixed_cpu_child_env(env)
+    timeout = max(120.0, min(CPU_BASELINE_TIMEOUT_S, remaining))
+    parsed, err = _run_child(envc, CPU_BASELINE["rows"], timeout)
+    if parsed is None:
+        sys.stderr.write(f"cpu fixed baseline failed: {err}\n")
+        return None
+    parsed["metric"] = "cpu_fixed_baseline_throughput"
+    parsed["baseline_config"] = CPU_BASELINE_ID
+    print(json.dumps(parsed), flush=True)
+    return parsed
+
+
+def run_linear_convergence(env, remaining):
+    """Run the linear-vs-constant convergence child; prints its JSON
+    line (metric linear_tree_convergence) and returns it."""
+    if os.environ.get("BENCH_NO_LINEAR") or remaining < 90:
+        return None
+    envc = _cpu_env(env)
+    envc.pop("_BENCH_CHILD", None)
+    envc["_BENCH_CHILD_LINEAR"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=envc,
+            capture_output=True, text=True,
+            timeout=max(90.0, min(LINEAR_CONV_TIMEOUT_S, remaining)))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("linear convergence child timed out\n")
+        return None
+    parsed = find_result_line(proc.stdout)
+    if parsed is None:
+        sys.stderr.write("linear convergence child failed:\n"
+                         + proc.stderr[-2000:] + "\n")
+        return None
+    print(json.dumps(parsed), flush=True)
+    return parsed
+
+
 def main():
     if os.environ.get("_BENCH_CHILD") == "1":
         measure()
+        return
+    if os.environ.get("_BENCH_CHILD_LINEAR") == "1":
+        measure_linear()
         return
     budget = float(os.environ.get("BENCH_BUDGET_S", 1500))
     t_start = time.monotonic()
@@ -237,36 +421,75 @@ def main():
     printed_any = False
     quality_fail = False
 
+    # fixed-config CPU blocks run FIRST (they never touch the tunnel):
+    # the steady-state baseline (ROADMAP item 5, comparable round over
+    # round) and the linear-tree convergence probe (ROADMAP item 4).
+    # Pinned single-size runs (tools/bench_sweep.py) skip both.
+    baseline_parsed = None
+    if pinned is None:
+        baseline_parsed = run_cpu_baseline(
+            env, budget - (time.monotonic() - t_start))
+        run_linear_convergence(
+            env, budget - (time.monotonic() - t_start))
+
     # fast tunnel probe: a WEDGED axon tunnel (observed repeatedly in
     # rounds 3-4) hangs children at jax.devices() until their full
-    # per-size timeout. The timeout is configurable and the probe
-    # retries once — a healthy-but-cold tunnel (or a slow 1-core-host
-    # import) must not silently drop the whole TPU plan
+    # per-size timeout. The timeout is configurable, the probe retries
+    # once, runs a tiny JITTED program (so the persistent compile
+    # cache also warms the probe path), and its VERDICT is cached
+    # (BENCH_PROBE_TTL_S, default 1800 s) so one hang cannot zero the
+    # block for every bench invocation of a round.
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90))
     # a CPU-only JAX fallback must NOT count as a live accelerator (it
     # would run the full-size plan on the host); CI sets
     # BENCH_ALLOW_CPU=1 to exercise main() on forced CPU
-    probe_src = "import jax; d = jax.devices(); print(d)"
+    probe_src = "import jax, jax.numpy as jnp; d = jax.devices(); " \
+        "print(d)"
     if not os.environ.get("BENCH_ALLOW_CPU"):
         probe_src += "; assert d and d[0].platform != 'cpu', d"
-    tpu_ok = False
-    for probe_try in range(2):
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", probe_src],
-                env=env, capture_output=True, timeout=probe_timeout)
-            tpu_ok = probe.returncode == 0
-        except subprocess.TimeoutExpired:
-            tpu_ok = False
-        if tpu_ok:
-            break
-        sys.stderr.write(f"TPU probe attempt {probe_try + 1} "
-                         f"failed/hung ({probe_timeout:.0f}s)\n")
+    probe_src += "; print(float(jax.jit(lambda v: (v * 2 + 1).sum())" \
+        "(jnp.ones((128,)))))"
+    envp = dict(env)
+    if envp.get("LGBM_TPU_COMPILE_CACHE"):
+        # the probe child bypasses the library seam; hand jax the
+        # cache dir directly so its one compile persists
+        envp.setdefault("JAX_COMPILATION_CACHE_DIR",
+                        envp["LGBM_TPU_COMPILE_CACHE"])
+    cached = read_probe_cache()
+    if cached is not None:
+        tpu_ok = bool(cached.get("ok"))
+        probe_info = {"tpu_probe": "ok" if tpu_ok else "failed",
+                      "tpu_probe_cached": True}
+        sys.stderr.write(f"TPU probe: cached verdict "
+                         f"{'ok' if tpu_ok else 'failed'} "
+                         f"({cached.get('detail', '')[:120]})\n")
+    else:
+        tpu_ok = False
+        detail = ""
+        for probe_try in range(2):
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c", probe_src],
+                    env=envp, capture_output=True, text=True,
+                    timeout=probe_timeout)
+                tpu_ok = probe.returncode == 0
+                detail = (probe.stdout if tpu_ok
+                          else probe.stderr)[-300:]
+            except subprocess.TimeoutExpired:
+                tpu_ok = False
+                detail = f"hung > {probe_timeout:.0f}s"
+            if tpu_ok:
+                break
+            sys.stderr.write(f"TPU probe attempt {probe_try + 1} "
+                             f"failed/hung ({probe_timeout:.0f}s)\n")
+        write_probe_cache(tpu_ok, detail)
+        probe_info = {"tpu_probe": "ok" if tpu_ok else "failed",
+                      "tpu_probe_cached": False}
     if not tpu_ok:
-        sys.stderr.write("TPU probe failed twice; skipping TPU plan\n")
+        sys.stderr.write("TPU probe negative; skipping TPU plan\n")
         plan = []
         last_err = ("probe", "",
-                    f"jax.devices() unreachable in 2x{probe_timeout:.0f}s")
+                    f"TPU probe negative (cached={cached is not None})")
 
     for rows in plan:
         remaining = budget - (time.monotonic() - t_start)
@@ -280,6 +503,7 @@ def main():
         while True:
             parsed, err = _run_child(env, rows, timeout)
             if parsed is not None:
+                parsed.update(probe_info)
                 print(json.dumps(parsed), flush=True)
                 printed_any = True
                 if parsed.get("quality_ok") is False:
@@ -302,44 +526,36 @@ def main():
 
     if not printed_any:
         # last resort: the TPU tunnel can wedge for hours (rounds 3-4
-        # both saw it). A clearly-labeled CPU number beats recording
-        # nothing — `backend`/`num_leaves`/`rows` in the JSON line mark
-        # exactly what was measured. NEVER in pinned mode: sweep
-        # callers (tools/bench_sweep.py) relabel the line with the
-        # pinned row count, which would record a mislabeled CPU point
+        # both saw it). The fallback is the SAME fixed CPU config as
+        # the baseline (comparable across rounds, steady-state, enough
+        # iterations to amortize compile) — when the baseline already
+        # ran this invocation, its measurement is reused rather than
+        # re-measured. NEVER in pinned mode: sweep callers
+        # (tools/bench_sweep.py) relabel the line with the pinned row
+        # count, which would record a mislabeled CPU point
         remaining = budget - (time.monotonic() - t_start)
-        if pinned is None and remaining > 120 \
+        if pinned is None \
                 and not os.environ.get("BENCH_NO_CPU_FALLBACK"):
-            sys.stderr.write("TPU attempts failed; trying a CPU "
-                             "fallback measurement\n")
-            envc = dict(env)
-            envc.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial tunnel
-            envc["JAX_PLATFORMS"] = "cpu"
-            envc["BENCH_ITERS"] = "2"
-            envc["BENCH_WARMUP_ITERS"] = "1"
-            # 3 total trees of 63 leaves can't reach the full-run AUC
-            # bar; the fallback gets its own fixed bar — an operator
-            # BENCH_MIN_AUC meant for full-size runs must not turn a
-            # tunnel outage into a spurious quality failure
-            envc["BENCH_MIN_AUC"] = os.environ.get(
-                "BENCH_FALLBACK_MIN_AUC", "0.70")
-            # interpret-mode kernels + XLA-CPU compile are slow; a
-            # smaller tree keeps the fallback inside the budget
-            envc["BENCH_LEAVES"] = "63"
-            flags = envc.get("XLA_FLAGS", "")
-            if "xla_cpu_max_isa" not in flags:  # see tests/conftest.py
-                envc["XLA_FLAGS"] = (flags
-                                     + " --xla_cpu_max_isa=AVX2").strip()
-            parsed, err = _run_child(envc, 100_000,
+            fb = baseline_parsed
+            if fb is None and remaining > 120:
+                sys.stderr.write("TPU attempts failed; measuring the "
+                                 "fixed-config CPU fallback\n")
+                envc = _fixed_cpu_child_env(env)
+                fb, err = _run_child(envc, CPU_BASELINE["rows"],
                                      max(120.0, remaining - 10))
-            if parsed is not None:
-                print(json.dumps(parsed), flush=True)
-                if parsed.get("quality_ok") is False:
+                last_err = err or last_err
+            if fb is not None:
+                head = dict(fb)
+                head["metric"] = "higgs_like_train_throughput"
+                head["source"] = "cpu_fixed_baseline"
+                head["baseline_config"] = CPU_BASELINE_ID
+                head.update(probe_info)
+                print(json.dumps(head), flush=True)
+                if head.get("quality_ok") is False:
                     sys.stderr.write("QUALITY GATE FAILED: auc "
-                                     f"{parsed.get('auc')} below bar\n")
+                                     f"{head.get('auc')} below bar\n")
                     sys.exit(3)
                 return
-            last_err = err or last_err
         e = last_err or ("?", "", "")
         sys.stderr.write(
             f"bench failed; last rc={e[0]}\nstdout:\n{e[1]}\nstderr:\n{e[2]}\n")

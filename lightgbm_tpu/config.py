@@ -71,6 +71,10 @@ _PARAM_ALIASES: Dict[str, str] = {
     "min_split_gain": "min_gain_to_split",
     "rate_drop": "drop_rate",
     "topk": "top_k",
+    "linear_trees": "linear_tree",
+    "linear_leaf": "linear_tree",
+    "linear_l2": "linear_lambda",
+    "linear_max_leaf_features": "linear_max_features",
     "mc": "monotone_constraints", "monotone_constraint": "monotone_constraints",
     "feature_contrib": "feature_contri", "fc": "feature_contri",
     "fp": "feature_contri", "feature_penalty": "feature_contri",
@@ -257,6 +261,13 @@ class Config:
     feature_contri: List[float] = field(default_factory=list)
     forcedsplits_filename: str = ""
     refit_decay_rate: float = 0.9
+    # piecewise-linear leaf models (docs/LinearTrees.md): fit a small
+    # ridge regression over each leaf's path features from the leaf's
+    # gradient/hessian sufficient statistics ("Gradient Boosting With
+    # Piece-Wise Linear Regression Trees", arxiv 1802.05640)
+    linear_tree: bool = False
+    linear_lambda: float = 0.0         # ridge strength on the leaf coeffs
+    linear_max_features: int = 8       # per-leaf feature cap (pads the IR)
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
     cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
@@ -506,6 +517,25 @@ class Config:
             full = 1 << self.max_depth
             if self.num_leaves == kDefaultNumLeaves or self.num_leaves > full:
                 self.num_leaves = min(self.num_leaves, full)
+        if self.linear_tree:
+            if self.linear_lambda < 0.0:
+                raise ValueError("linear_lambda must be >= 0")
+            if self.linear_max_features < 1:
+                raise ValueError("linear_max_features must be >= 1")
+            if self.boosting in ("dart", "rf"):
+                # DART re-scores dropped trees and RF keeps a running
+                # average through code paths that predate the linear
+                # leaf IR; the combination is unvalidated
+                log_warning(f"linear_tree is not supported with "
+                            f"boosting={self.boosting}; using constant "
+                            "leaves")
+                self.linear_tree = False
+            elif self.tree_learner not in ("serial", "partitioned") \
+                    or self.is_parallel:
+                log_warning("linear_tree is only supported by the "
+                            "single-device serial/partitioned tree "
+                            "learners; using constant leaves")
+                self.linear_tree = False
         if self.guard_policy not in ("off", "raise", "skip_iter",
                                      "rollback"):
             raise ValueError(

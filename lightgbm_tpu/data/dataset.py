@@ -176,8 +176,14 @@ class Dataset:
         # mv_group_start have no physical column (data/bundling.py)
         self.mv_slots: Optional[np.ndarray] = None
         self.mv_group_start: Optional[int] = None
+        # raw numeric feature values [N, F_used] f32 (NaN preserved),
+        # kept only when linear_tree is on: the leaf-linear fits and
+        # the linear prediction paths consume raw values, not bins
+        # (docs/LinearTrees.md)
+        self.raw_numeric: Optional[np.ndarray] = None
         self._binned_device = None
         self._mv_slots_device = None
+        self._raw_device = None
 
     # ------------------------------------------------------------------
     @property
@@ -195,6 +201,22 @@ class Dataset:
             import jax.numpy as jnp
             self._mv_slots_device = jnp.asarray(self.mv_slots)
         return self._mv_slots_device
+
+    @property
+    def raw_numeric_device(self):
+        """Lazy device copy of the raw numeric matrix (linear trees)."""
+        if self._raw_device is None and self.raw_numeric is not None:
+            import jax.numpy as jnp
+            self._raw_device = jnp.asarray(self.raw_numeric)
+        return self._raw_device
+
+    def _store_raw(self, data: np.ndarray) -> None:
+        """Keep the inner-feature raw values for leaf-linear models
+        (the reference's linear_tree forces keeping raw data too)."""
+        idx = np.asarray(self.real_feature_idx, np.int64)
+        self.raw_numeric = np.ascontiguousarray(
+            np.asarray(data, np.float64)[:, idx], np.float32) \
+            if idx.size else np.zeros((data.shape[0], 0), np.float32)
 
     @property
     def has_multival(self) -> bool:
@@ -288,6 +310,9 @@ class Dataset:
             self._resolve_monotone_and_penalty(config)
 
         self._extract_features(data)
+        if config.linear_tree or (reference is not None
+                                  and reference.raw_numeric is not None):
+            self._store_raw(data)
         if reference is None:
             self._maybe_bundle(config)
         elif self.feature_group is not None:

@@ -158,6 +158,21 @@ def _tree_to_string(tree: Tree, index: int) -> str:
     if num_cat > 0:
         s.write("cat_boundaries=" + _arr(cat_boundaries) + "\n")
         s.write("cat_threshold=" + _arr(cat_words) + "\n")
+    if getattr(tree, "is_linear", False):
+        # piecewise-linear leaf models (docs/LinearTrees.md): per-leaf
+        # constant, feature count, then the flattened ORIGINAL feature
+        # indices and coefficients (v4-format layout). Full-precision
+        # repr floats -> exact round trip.
+        counts = (np.asarray(tree.leaf_features) >= 0).sum(axis=1)
+        s.write("is_linear=1\n")
+        s.write("leaf_const=" + _arr(tree.leaf_const, _fmt) + "\n")
+        s.write("num_features=" + _arr(int(c) for c in counts) + "\n")
+        flat_feat = [int(tree.leaf_features[li, j])
+                     for li in range(n) for j in range(int(counts[li]))]
+        flat_coeff = [float(tree.leaf_coeff[li, j])
+                      for li in range(n) for j in range(int(counts[li]))]
+        s.write("leaf_features=" + _arr(flat_feat) + "\n")
+        s.write("leaf_coeff=" + _arr(flat_coeff, _fmt) + "\n")
     s.write(f"shrinkage={_fmt(tree.shrinkage)}\n")
     s.write("\n")
     return s.getvalue()
@@ -309,6 +324,27 @@ def _parse_tree_block(lines: Dict[str, str]) -> Tree:
         else:
             tree.cat_threshold.append(np.zeros(0, np.int64))
             tree.threshold[i] = thresholds[i] if nodes else 0.0
+
+    # piecewise-linear leaf blocks (written by _tree_to_string above)
+    if int(lines.get("is_linear", "0")):
+        consts = floats("leaf_const")
+        counts = ints("num_features")
+        flat_feat = ints("leaf_features")
+        flat_coeff = floats("leaf_coeff")
+        cmax = max(int(counts.max(initial=0)), 1)
+        feats = np.full((n, cmax), -1, np.int32)
+        coeff = np.zeros((n, cmax), np.float64)
+        pos = 0
+        for li in range(n):
+            c = int(counts[li])
+            feats[li, :c] = flat_feat[pos:pos + c]
+            coeff[li, :c] = flat_coeff[pos:pos + c]
+            pos += c
+        tree.leaf_const = consts
+        tree.leaf_coeff = coeff
+        tree.leaf_features = feats
+        tree.leaf_features_inner = feats.copy()
+        tree.is_linear = True
     return tree
 
 
@@ -465,12 +501,20 @@ def _node_json(tree: Tree, node: int) -> dict:
     """Tree::NodeToJSON (src/io/tree.cpp:286-340)."""
     if node < 0:  # leaf
         leaf = ~node
-        return {
+        d = {
             "leaf_index": int(leaf),
             "leaf_value": float(tree.leaf_value[leaf]),
             "leaf_weight": float(tree.leaf_weight[leaf]),
             "leaf_count": int(tree.leaf_count[leaf]),
         }
+        if getattr(tree, "is_linear", False):
+            used = tree.leaf_features[leaf] >= 0
+            d["leaf_const"] = float(tree.leaf_const[leaf])
+            d["leaf_features"] = [int(f) for f in
+                                  tree.leaf_features[leaf][used]]
+            d["leaf_coeff"] = [float(c) for c in
+                               tree.leaf_coeff[leaf][used]]
+        return d
     is_cat = bool(tree.decision_type[node] & K_CAT_MASK)
     d = {
         "split_index": int(node),

@@ -56,6 +56,7 @@ else:
 from ..ops.split import (MAX_CAT_WORDS,
                          _argmax_first, assemble_split,
                          leaf_output_no_constraint, per_feature_splits)
+from ..models.linear import LinearLeafFitMixin
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
                      StatePack, cegb_pf_state, cegb_refund,
                      cegb_store_row, cegb_upgrade_best,
@@ -80,10 +81,13 @@ pack_state = _PACK.pack
 view_state = _PACK.view
 
 
-class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin):
+class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin,
+                             LinearLeafFitMixin):
     """Shared setup / host-tree conversion for the single-device and
     mesh partitioned learners (one source of truth for the uint8 bin
-    cap, categorical params and interpret default)."""
+    cap, categorical params and interpret default). The leaf-linear
+    fit (models/linear.py) rides the reconstructed ``leaf_id`` exactly
+    like the serial learner's."""
 
     _count_tree_telemetry = count_tree_telemetry
 

@@ -34,6 +34,7 @@ from ..config import Config
 from ..data.binning import (BIN_TYPE_CATEGORICAL, MISSING_NAN, MISSING_NONE,
                             MISSING_ZERO)
 from ..data.dataset import Dataset
+from ..models.linear import LinearLeafFitMixin
 from ..models.tree import Tree, TreeArrays
 from ..ops.histogram import build_histogram, make_ghc
 from ..ops.partition import split_leaf
@@ -666,8 +667,12 @@ def count_tree_telemetry(learner) -> None:
         tel.gauge("mesh.num_shards", shards)
 
 
-class SerialTreeLearner(NodeRandMixin, CegbStateMixin):
-    """Owns the device copy of the dataset and the compiled grow program."""
+class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
+                        LinearLeafFitMixin):
+    """Owns the device copy of the dataset and the compiled grow
+    program. ``LinearLeafFitMixin`` adds the post-grow leaf-linear
+    ridge fit over the grow loop's device-resident ``leaf_id`` (the
+    ``linear_tree`` subsystem, models/linear.py)."""
 
     _count_tree_telemetry = count_tree_telemetry
 

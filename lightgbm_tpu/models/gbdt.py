@@ -56,6 +56,19 @@ def _score_add_col(score, add, *, tid: int):
     return score.at[:, tid].add(add)
 
 
+@functools.partial(jax.jit, static_argnames=("tid",),
+                   donate_argnums=(0,))
+def _score_add_leaf_linear(score, leaf_vals, lin_const, lin_coeff,
+                           lin_feat, leaf_id, raw, *, tid: int):
+    """Linear-leaf train-score update: the leaf assignment is already
+    known (no traversal) — gather each row's leaf model, evaluate
+    ``const + w . x`` with the constant fallback for NaN rows, add to
+    the donated score column. One program, like _score_add_leaf."""
+    from .linear import linear_leaf_values
+    return score.at[:, tid].add(linear_leaf_values(
+        leaf_id, raw, leaf_vals, lin_const, lin_coeff, lin_feat))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("nl", "tid", "l1", "l2", "mds"),
                    donate_argnums=(0,))
@@ -239,9 +252,37 @@ class GBDT:
         self._guard_policy = str(getattr(cfg, "guard_policy", "off")
                                  or "off")
         self._last_grad_ok = None
+        # leaf-linear models (models/linear.py): the fit rides the
+        # host-stepped per-iteration path (the host tree is in hand
+        # there anyway); async/fused paths are pinned off below
+        self._linear_on = bool(cfg.linear_tree)
+        if self._linear_on:
+            if self.objective is not None and getattr(
+                    self.objective, "is_renew_tree_output", False):
+                log_warning(
+                    "linear_tree is not supported with objective "
+                    f"{self.objective.name()} (its percentile leaf "
+                    "refit overwrites leaf outputs); using constant "
+                    "leaves")
+                self._linear_on = False
+            elif not (hasattr(self.learner, "fit_linear_leaves")
+                      and self.learner.linear_fit_available()):
+                log_warning(
+                    "linear_tree needs the raw numeric matrix on a "
+                    "single-device learner (in-memory dense data); "
+                    "using constant leaves")
+                self._linear_on = False
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_data: Dataset, name: str) -> None:
+        if getattr(self, "_linear_on", False) \
+                and valid_data.raw_numeric is None:
+            # e.g. a sparse valid set against a dense linear train set:
+            # linear valid scoring needs raw values it doesn't have
+            log_warning(
+                f"valid set {name!r} carries no raw numeric matrix; "
+                "linear_tree falls back to constant leaves")
+            self._linear_on = False
         metrics = create_metrics(self.config.resolved_metrics(), self.config)
         for m in metrics:
             m.init(valid_data.metadata, valid_data.num_data)
@@ -456,6 +497,14 @@ class GBDT:
             if tree is not None and tree.num_leaves > 1:
                 should_continue = True
                 with tel.span("update", phase=True):
+                    if getattr(self, "_linear_on", False):
+                        # batched per-leaf ridge solve on device; ONE
+                        # explicit fetch of the coefficient triple
+                        tel.count_iter("host.syncs")
+                        tel.count_iter("host.dispatches")
+                        self.learner.fit_linear_leaves(
+                            tree, result, grad[:, tid], hess[:, tid],
+                            bag_weight=bag)
                     self._renew_tree_output(tree, result, tid)
                     tree.shrink(self.shrinkage_rate)
                     self._update_scores(tree, result, tid)
@@ -573,15 +622,24 @@ class GBDT:
         # train: leaf_id gather (no traversal), incl. out-of-bag rows —
         # ONE jitted donated program (gather + scatter fused)
         tel.count_iter("host.dispatches")
-        self.train_score = _score_add_leaf(
-            self.train_score, jnp.asarray(tree.leaf_value, jnp.float32),
-            result.leaf_id, tid=tid)
+        if tree.is_linear:
+            self.train_score = _score_add_leaf_linear(
+                self.train_score, tree._padded_leaf_values(),
+                *tree._padded_linear_args(), result.leaf_id,
+                self.train_data.raw_numeric_device, tid=tid)
+        else:
+            self.train_score = _score_add_leaf(
+                self.train_score,
+                jnp.asarray(tree.leaf_value, jnp.float32),
+                result.leaf_id, tid=tid)
         # valid: jitted bin-space traversal + add, ONE program each
         for i, vd in enumerate(self.valid_sets):
             tel.count_iter("host.dispatches")
             self.valid_scores[i] = tree.predict_binned_add(
                 self.valid_scores[i], tid, vd.binned_device,
-                vd.mv_slots_device)
+                vd.mv_slots_device,
+                raw_dev=vd.raw_numeric_device if tree.is_linear
+                else None)
 
     # ------------------------------------------------------------------
     def init_from_models(self, models: List, train_add=None,
@@ -623,6 +681,12 @@ class GBDT:
         every existing tree (from ``predict(..., pred_leaf=True)``).
         """
         self.finalize_trees()
+        if any(getattr(t, "is_linear", False) for t in self.models):
+            log_warning("refit keeps tree structures but drops the "
+                        "leaf linear models (constant-leaf refit)")
+            for t in self.models:
+                if getattr(t, "is_linear", False):
+                    t.clear_linear()
         k = self.num_tree_per_iteration
         cfg = self.config
         decay = float(cfg.refit_decay_rate)
@@ -681,11 +745,13 @@ class GBDT:
             if self.train_data is not None:
                 tadd = tree.predict_binned_device(
                     self.train_data.binned_device,
-                    self.train_data.mv_slots_device)
+                    self.train_data.mv_slots_device,
+                    raw_dev=self.train_data.raw_numeric_device)
                 self.train_score = self.train_score.at[:, tid].add(tadd)
             for i, vd in enumerate(self.valid_sets):
-                vadd = tree.predict_binned_device(vd.binned_device,
-                                              vd.mv_slots_device)
+                vadd = tree.predict_binned_device(
+                    vd.binned_device, vd.mv_slots_device,
+                    raw_dev=vd.raw_numeric_device)
                 self.valid_scores[i] = \
                     self.valid_scores[i].at[:, tid].add(vadd)
         del self.models[-k:]
@@ -791,6 +857,10 @@ class GBDT:
                 and not getattr(self.objective, "is_renew_tree_output",
                                 False)
                 and all(self.class_need_train)
+                # the leaf-linear fit needs the host tree in hand each
+                # iteration (path-feature selection), so linear trees
+                # pin the host-stepped path
+                and not getattr(self, "_linear_on", False)
                 # non-finite guards need the per-iteration sync check;
                 # armed fault plans need per-iteration injection points
                 and self._guard_policy == "off"
@@ -1177,12 +1247,14 @@ class GBDT:
                     tree = self.models[-(es - j) * k + tid]
                     tadd = tree.predict_binned_device(
                         self.train_data.binned_device,
-                        self.train_data.mv_slots_device)
+                        self.train_data.mv_slots_device,
+                        raw_dev=self.train_data.raw_numeric_device)
                     self.train_score = \
                         self.train_score.at[:, tid].add(tadd)
                     for i, vd in enumerate(self.valid_sets):
-                        vadd = tree.predict_binned_device(vd.binned_device,
-                                              vd.mv_slots_device)
+                        vadd = tree.predict_binned_device(
+                            vd.binned_device, vd.mv_slots_device,
+                            raw_dev=vd.raw_numeric_device)
                         self.valid_scores[i] = \
                             self.valid_scores[i].at[:, tid].add(vadd)
             del self.models[-es * k:]

@@ -1,0 +1,265 @@
+"""Piecewise-linear leaf models: batched per-leaf ridge fits.
+
+Reference analog: "Gradient Boosting With Piece-Wise Linear Regression
+Trees" (arxiv 1802.05640) and the reference's ``linear_tree`` subsystem
+(src/treelearner/linear_tree_learner.cpp): after a tree's structure is
+grown, each leaf gets a small linear model over the numeric features on
+its root-to-leaf path, fit from the leaf's second-order sufficient
+statistics
+
+    min_beta  sum_{i in leaf} [ g_i f(x_i) + 1/2 h_i f(x_i)^2 ]
+              + 1/2 linear_lambda ||w||^2,     f(x) = w . x + b
+
+whose normal equations are ``(X^T H X + Lam) beta = -X^T g`` with a
+bias column appended to X. All leaves solve in ONE jitted device
+program: the (X^T H X, X^T g) statistics accumulate by ``segment_sum``
+over the grow loop's ``leaf_id`` vector and the [L, C+1, C+1] systems
+solve as a batched ``jnp.linalg.solve``.
+
+Gating (mirrors the reference's linear-tree fallbacks): a leaf keeps
+its constant output when its path has no numeric features, when too few
+in-bag non-NaN rows support the system (count <= active features), or
+when the solve is ill-conditioned (non-finite / exploding
+coefficients). Rows with a NaN in any of the leaf's model features
+always receive the constant ``leaf_value`` — at fit time they are
+excluded from the statistics, at predict time they take the fallback.
+
+The regularizer: ``linear_lambda`` on each coefficient's diagonal and
+``lambda_l2`` on the bias diagonal, so a leaf with zero active features
+solves to exactly the familiar ``-G / (H + lambda_l2)`` constant.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+kLinEps = 1e-15
+# conditioning bound: a solve whose coefficients exceed this is treated
+# as singular and the leaf falls back to its constant output
+kCoeffBound = 1e8
+
+
+def linear_bucket(c: int) -> int:
+    """Smallest power of two >= c: the per-leaf feature axis pads to
+    this bucket so serving/score-update compiles are shared across
+    trees (and across hot-reloaded model versions)."""
+    b = 1
+    while b < max(c, 1):
+        b <<= 1
+    return b
+
+
+def node_parents(left_child: np.ndarray,
+                 right_child: np.ndarray) -> np.ndarray:
+    """Parent internal node of each internal node (-1 for the root),
+    reconstructed from the child arrays (children always carry a larger
+    node index than their parent — creation order)."""
+    nodes = len(left_child)
+    parent = np.full(nodes, -1, np.int32)
+    for s in range(nodes):
+        for child in (int(left_child[s]), int(right_child[s])):
+            if child >= 0:
+                parent[child] = s
+    return parent
+
+
+def leaf_path_features(tree, is_numeric: np.ndarray, big_l: int,
+                       cap: int) -> np.ndarray:
+    """Per-leaf candidate features: the NUMERIC features on the
+    root-to-leaf path (the paper's feature set), deduplicated,
+    deepest-split-first, capped at ``cap`` and -1-padded.
+
+    Returns [big_l, cap] i32 of INNER feature indices; rows past
+    ``tree.num_leaves`` stay all -1 (the fit masks them out).
+    """
+    cap = max(int(cap), 1)
+    feats = np.full((big_l, cap), -1, np.int32)
+    if tree.num_leaves <= 1:
+        return feats
+    tree.ensure_leaf_depth()  # leaf_parent may need reconstruction
+    parent = node_parents(tree.left_child, tree.right_child)
+    split_feat = tree.split_feature_inner
+    for leaf in range(tree.num_leaves):
+        node = int(tree.leaf_parent[leaf])
+        seen = set()
+        k = 0
+        while node >= 0 and k < cap:
+            f = int(split_feat[node])
+            if f not in seen and 0 <= f < len(is_numeric) \
+                    and bool(is_numeric[f]):
+                feats[leaf, k] = f
+                seen.add(f)
+                k += 1
+            node = int(parent[node])
+    return feats
+
+
+@functools.partial(jax.jit, static_argnames=("lam", "l2"))
+def _fit_linear_jit(raw, leaf_id, grad, hess, bag, feats, leaf_value, *,
+                    lam: float, l2: float):
+    """Batched normal-equations ridge solve for every leaf at once.
+
+    raw [N, F] f32 (NaN preserved), leaf_id [N] i32, grad/hess/bag [N]
+    f32, feats [L, C] i32 (-1 padded), leaf_value [L] f32 (the constant
+    fallback). Returns (coeff [L, C] f32, const [L] f32, ok [L] bool).
+    """
+    n = raw.shape[0]
+    big_l, c = feats.shape
+    rows = jnp.arange(n)
+    ft = feats[leaf_id]                                   # [N, C]
+    m = ft >= 0
+    x = raw[rows[:, None], jnp.clip(ft, 0, raw.shape[1] - 1)]
+    bad = ~jnp.isfinite(x) & m
+    row_ok = ~bad.any(axis=1)
+    xz = jnp.where(m & ~bad, x, 0.0)
+    w = hess * bag * row_ok
+    gw = grad * bag * row_ok
+    xb = jnp.concatenate([xz, jnp.ones((n, 1), xz.dtype)], axis=1)
+    outer = xb[:, :, None] * xb[:, None, :] * w[:, None, None]
+    a_mat = jax.ops.segment_sum(outer, leaf_id, num_segments=big_l)
+    b_vec = jax.ops.segment_sum(xb * gw[:, None], leaf_id,
+                                num_segments=big_l)
+    cnt = jax.ops.segment_sum(
+        (row_ok & (bag > 0)).astype(jnp.float32), leaf_id,
+        num_segments=big_l)
+    active = feats >= 0                                    # [L, C]
+    # inactive slots get a unit diagonal (their row of A is otherwise
+    # all-zero), so their coefficient solves to exactly 0
+    diag = jnp.concatenate(
+        [jnp.where(active, jnp.float32(lam), jnp.float32(1.0)),
+         jnp.full((big_l, 1), jnp.float32(l2) + jnp.float32(kLinEps))],
+        axis=1)
+    a_mat = a_mat + jnp.eye(c + 1, dtype=a_mat.dtype) * diag[:, None, :]
+    sol = -jnp.linalg.solve(a_mat, b_vec[..., None])[..., 0]
+    ca = active.sum(axis=1).astype(jnp.float32)
+    ok = (jnp.isfinite(sol).all(axis=1)
+          & (jnp.abs(sol) < kCoeffBound).all(axis=1)
+          & (cnt > ca) & (ca > 0))
+    coeff = jnp.where(ok[:, None], sol[:, :c], 0.0)
+    const = jnp.where(ok, sol[:, c], leaf_value)
+    return coeff, const, ok
+
+
+def fit_leaf_linear(raw_dev, leaf_id_dev, grad, hess, bag_weight,
+                    feats: np.ndarray, leaf_value: np.ndarray, *,
+                    linear_lambda: float, lambda_l2: float):
+    """Run the batched fit on device; ONE explicit host fetch of the
+    (coeff, const, ok) triple. ``bag_weight=None`` means every row is
+    in-bag."""
+    if bag_weight is None:
+        bag_weight = jnp.ones((grad.shape[0],), jnp.float32)
+    coeff, const, ok = _fit_linear_jit(
+        raw_dev, leaf_id_dev, grad, hess, bag_weight,
+        jnp.asarray(feats), jnp.asarray(leaf_value, jnp.float32),
+        lam=float(linear_lambda), l2=float(lambda_l2))
+    return jax.device_get((coeff, const, ok))
+
+
+# ----------------------------------------------------------------------
+# shared prediction helpers: the SAME f32 math on device (traced) and
+# host (numpy), so every route computes identical linear outputs
+def linear_leaf_values(out, raw, leaf_vals, lin_const, lin_coeff,
+                       lin_feat):
+    """Traced: per-row leaf output ``const + w . x`` for leaf index
+    ``out`` [N], with the constant ``leaf_vals`` fallback for rows with
+    a NaN in any model feature. All linear arrays are leaf-indexed and
+    may be padded past the real leaf count (padding rows: coeff 0,
+    feat -1, const 0)."""
+    rows = jnp.arange(out.shape[0])
+    ft = lin_feat[out]                                    # [N, C]
+    m = ft >= 0
+    x = raw[rows[:, None], jnp.clip(ft, 0, raw.shape[1] - 1)]
+    bad = jnp.isnan(x) & m
+    nan_row = bad.any(axis=1)
+    xz = jnp.where(m & ~bad, x, 0.0)
+    co = lin_coeff[out]
+    # explicit left-to-right f32 add chain (C is small and static):
+    # fixes the accumulation order so host numpy and every XLA backend
+    # produce IDENTICAL bits — mixed-route serving parity depends on it
+    lin = lin_const[out]
+    for j in range(xz.shape[1]):
+        lin = lin + co[:, j] * xz[:, j]
+    return jnp.where(nan_row, leaf_vals[out], lin)
+
+
+def linear_leaf_values_host(out: np.ndarray, data: np.ndarray,
+                            leaf_value: np.ndarray,
+                            leaf_const: np.ndarray,
+                            leaf_coeff: np.ndarray,
+                            leaf_features: np.ndarray) -> np.ndarray:
+    """Host mirror over RAW feature columns (``leaf_features`` holds
+    ORIGINAL feature indices): f32 accumulation matching the device
+    path, widened to f64 at the end like the constant gather."""
+    n = out.shape[0]
+    if n == 0:
+        return np.zeros(0, np.float64)
+    ft = leaf_features[out]
+    m = ft >= 0
+    x = np.asarray(
+        data[np.arange(n)[:, None],
+             np.clip(ft, 0, max(data.shape[1] - 1, 0))], np.float32)
+    bad = np.isnan(x) & m
+    nan_row = bad.any(axis=1)
+    xz = np.where(m & ~bad, x, np.float32(0.0)).astype(np.float32)
+    co = np.asarray(leaf_coeff, np.float32)[out]
+    # same left-to-right f32 add chain as the traced helper above —
+    # the two routes must agree bit-for-bit
+    lin = np.asarray(leaf_const, np.float32)[out]
+    for j in range(xz.shape[1]):
+        lin = lin + co[:, j] * xz[:, j]
+    return np.where(nan_row, np.asarray(leaf_value, np.float64)[out],
+                    np.asarray(lin, np.float64))
+
+
+# ----------------------------------------------------------------------
+class LinearLeafFitMixin:
+    """Leaf-linear fitting hook for the single-device tree learners
+    (serial + partitioned): consumes the grow result's device-resident
+    ``leaf_id`` plus the gradient/hessian/bag vectors and attaches the
+    fitted coefficients to the host tree."""
+
+    def linear_fit_available(self) -> bool:
+        ds = self.dataset
+        return getattr(ds, "raw_numeric", None) is not None \
+            and ds.num_features > 0
+
+    def _linear_is_numeric(self) -> np.ndarray:
+        cached = getattr(self, "_lin_is_numeric", None)
+        if cached is None:
+            from ..data.binning import BIN_TYPE_CATEGORICAL
+            ds = self.dataset
+            cached = np.asarray(
+                [ds.feature_mapper(i).bin_type != BIN_TYPE_CATEGORICAL
+                 for i in range(ds.num_features)], bool)
+            self._lin_is_numeric = cached
+        return cached
+
+    def fit_linear_leaves(self, tree, result, grad, hess,
+                          bag_weight=None) -> bool:
+        """Fit every leaf of ``tree`` (the host tree of ``result``);
+        returns True when at least one leaf got a linear model."""
+        if not self.linear_fit_available() or tree.num_leaves <= 1:
+            return False
+        ds = self.dataset
+        cfg = self.config
+        cap = min(int(cfg.linear_max_features), ds.num_features)
+        feats = leaf_path_features(tree, self._linear_is_numeric(),
+                                   self.num_leaves, cap)
+        if not (feats >= 0).any():
+            return False
+        lv = np.zeros(self.num_leaves, np.float32)
+        lv[:tree.num_leaves] = np.asarray(tree.leaf_value, np.float32)
+        coeff, const, ok = fit_leaf_linear(
+            ds.raw_numeric_device, result.leaf_id, grad, hess,
+            bag_weight, feats, lv,
+            linear_lambda=float(cfg.linear_lambda),
+            lambda_l2=float(cfg.lambda_l2))
+        if not bool(np.asarray(ok).any()):
+            return False
+        nl = tree.num_leaves
+        tree.set_linear(feats[:nl], coeff[:nl], const[:nl], dataset=ds)
+        return True

@@ -60,6 +60,17 @@ class TreeArrays(NamedTuple):
 class Tree:
     """Host-side tree (numpy arrays), prediction + serialization."""
 
+    # piecewise-linear leaf model (models/linear.py, docs/LinearTrees.md):
+    # ``leaf_const + leaf_coeff . x`` over the leaf's model features,
+    # with the constant ``leaf_value`` as the NaN/fallback output.
+    # Class-level defaults keep every construction path (arrays, text
+    # parse via __new__, constant trees) a plain constant-leaf tree.
+    is_linear = False
+    leaf_const: Optional[np.ndarray] = None          # [L] f64
+    leaf_coeff: Optional[np.ndarray] = None          # [L, C] f64
+    leaf_features: Optional[np.ndarray] = None       # [L, C] ORIG idx
+    leaf_features_inner: Optional[np.ndarray] = None  # [L, C] inner idx
+
     def __init__(self, arrays: TreeArrays, dataset=None,
                  shrinkage: float = 1.0):
         a = arrays
@@ -132,16 +143,51 @@ class Tree:
                                   for _ in self.split_feature]
 
     # ------------------------------------------------------------------
+    def set_linear(self, feats_inner: np.ndarray, coeff: np.ndarray,
+                   const: np.ndarray, dataset=None) -> None:
+        """Attach per-leaf linear models (models/linear.py fit output).
+        ``feats_inner`` [L, C] holds -1-padded INNER feature indices;
+        columns are trimmed to the widest leaf. Non-fitted leaves must
+        arrive with coeff 0 and const == leaf_value."""
+        feats_inner = np.asarray(feats_inner, np.int32)
+        cmax = max(int((feats_inner >= 0).sum(axis=1).max(initial=0)), 1)
+        self.leaf_features_inner = \
+            np.ascontiguousarray(feats_inner[:, :cmax])
+        self.leaf_coeff = np.asarray(coeff, np.float64)[:, :cmax].copy()
+        self.leaf_const = np.asarray(const, np.float64).copy()
+        lf = self.leaf_features_inner
+        if dataset is not None:
+            real = np.asarray(dataset.real_feature_idx, np.int64)
+            self.leaf_features = np.where(
+                lf >= 0, real[np.clip(lf, 0, max(len(real) - 1, 0))],
+                -1).astype(np.int32)
+        else:
+            self.leaf_features = lf.copy()
+        self.is_linear = True
+
+    def clear_linear(self) -> None:
+        """Drop the leaf linear models (back to constant leaves)."""
+        self.is_linear = False
+        self.leaf_const = None
+        self.leaf_coeff = None
+        self.leaf_features = None
+        self.leaf_features_inner = None
+
     def shrink(self, rate: float) -> None:
         """Tree::Shrinkage (tree.h:164-172)."""
         self.leaf_value *= rate
         self.internal_value *= rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_coeff = self.leaf_coeff * rate
+            self.leaf_const = self.leaf_const * rate
 
     def add_bias(self, val: float) -> None:
         """Tree::AddBias (tree.h:180-189)."""
         self.leaf_value = self.leaf_value + val
         self.internal_value = self.internal_value + val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
         self.shrinkage = 1.0
 
     def default_left(self, node: int) -> bool:
@@ -156,7 +202,13 @@ class Tree:
     # ------------------------------------------------------------------
     def predict(self, data: np.ndarray) -> np.ndarray:
         """Batch raw-feature prediction (Tree::Predict, tree.h:476)."""
-        return self.leaf_value[self.predict_leaf_index(data)]
+        idx = self.predict_leaf_index(data)
+        if not self.is_linear:
+            return self.leaf_value[idx]
+        from .linear import linear_leaf_values_host
+        return linear_leaf_values_host(
+            idx, np.asarray(data, np.float64), self.leaf_value,
+            self.leaf_const, self.leaf_coeff, self.leaf_features)
 
     def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
         n = data.shape[0]
@@ -203,15 +255,25 @@ class Tree:
         return numeric
 
     def predict_binned(self, binned: np.ndarray,
-                       mv_slots: Optional[np.ndarray] = None
-                       ) -> np.ndarray:
+                       mv_slots: Optional[np.ndarray] = None,
+                       raw: Optional[np.ndarray] = None) -> np.ndarray:
         """Prediction over a train-aligned BINNED matrix [N, F_inner].
 
         Mirrors Dataset-side decisions (bin-space): used for valid-set
-        score updates (ScoreUpdater::AddScore on valid data).
+        score updates (ScoreUpdater::AddScore on valid data). Linear
+        trees additionally need the dataset's raw numeric matrix
+        (``Dataset.raw_numeric``, inner-feature columns).
         """
-        return self.leaf_value[
-            self.predict_leaf_index_binned(binned, mv_slots)]
+        idx = self.predict_leaf_index_binned(binned, mv_slots)
+        if not self.is_linear:
+            return self.leaf_value[idx]
+        if raw is None:
+            raise ValueError("linear-leaf tree: bin-space prediction "
+                             "needs the dataset's raw numeric matrix")
+        from .linear import linear_leaf_values_host
+        return linear_leaf_values_host(
+            idx, np.asarray(raw, np.float64), self.leaf_value,
+            self.leaf_const, self.leaf_coeff, self.leaf_features_inner)
 
     def predict_leaf_index_binned(self, binned: np.ndarray,
                                   mv_slots: Optional[np.ndarray] = None
@@ -269,8 +331,8 @@ class Tree:
             active[idx[is_leaf]] = False
         return out
 
-    def predict_binned_device(self, binned_dev,
-                              mv_slots_dev=None) -> jnp.ndarray:
+    def predict_binned_device(self, binned_dev, mv_slots_dev=None,
+                              raw_dev=None) -> jnp.ndarray:
         """Device (jitted) bin-space prediction: f32 leaf values [N].
 
         Used wherever a past tree must be re-scored against a device-
@@ -288,6 +350,16 @@ class Tree:
             raise ValueError(
                 "tree splits on multi-val pseudo-groups; bin-space "
                 "prediction needs the dataset's mv_slots matrix")
+        if self.is_linear:
+            if raw_dev is None:
+                raise ValueError(
+                    "linear-leaf tree: device bin-space prediction "
+                    "needs the dataset's raw numeric matrix")
+            return _traverse_binned_linear_jax(
+                binned_dev, *self._padded_traversal_args(),
+                *self._padded_linear_args(), raw_dev,
+                mv_slots=mv_slots_dev,
+                mv_present=mv_slots_dev is not None)
         return _traverse_binned_jax(
             binned_dev, *self._padded_traversal_args(),
             mv_slots=mv_slots_dev,
@@ -319,8 +391,41 @@ class Tree:
                 jnp.asarray(pad(self.cat_bitsets)),
                 jnp.asarray(leaf_vals))
 
+    def _padded_leaf_values(self):
+        """f32 leaf values padded to the same power-of-two capacity as
+        ``_padded_traversal_args`` (shared by the linear score
+        updater)."""
+        s = len(self.split_feature_inner)
+        cap = 1
+        while cap < s:
+            cap *= 2
+        lv = np.zeros(cap + 1, np.float32)
+        lv[:self.num_leaves] = self.leaf_value
+        return jnp.asarray(lv)
+
+    def _padded_linear_args(self):
+        """Leaf-indexed linear arrays padded to the SAME power-of-two
+        leaf capacity as ``_padded_traversal_args`` and a power-of-two
+        feature bucket (shared compilations across trees/versions)."""
+        from .linear import linear_bucket
+        s = len(self.split_feature_inner)
+        cap = 1
+        while cap < s:
+            cap *= 2
+        c = linear_bucket(self.leaf_coeff.shape[1])
+        const = np.zeros(cap + 1, np.float32)
+        const[:self.num_leaves] = self.leaf_const
+        coeff = np.zeros((cap + 1, c), np.float32)
+        coeff[:self.num_leaves, :self.leaf_coeff.shape[1]] = \
+            self.leaf_coeff
+        feat = np.full((cap + 1, c), -1, np.int32)
+        feat[:self.num_leaves, :self.leaf_features_inner.shape[1]] = \
+            self.leaf_features_inner
+        return (jnp.asarray(const), jnp.asarray(coeff),
+                jnp.asarray(feat))
+
     def predict_binned_add(self, score, tid: int, binned_dev,
-                           mv_slots_dev=None):
+                           mv_slots_dev=None, raw_dev=None):
         """``score[:, tid] += predict_binned_device(...)`` as ONE
         jitted donated program (bit-identical to the two-dispatch
         form; see _traverse_binned_add_jax)."""
@@ -332,6 +437,16 @@ class Tree:
             raise ValueError(
                 "tree splits on multi-val pseudo-groups; bin-space "
                 "prediction needs the dataset's mv_slots matrix")
+        if self.is_linear:
+            if raw_dev is None:
+                raise ValueError(
+                    "linear-leaf tree: device bin-space prediction "
+                    "needs the dataset's raw numeric matrix")
+            return _traverse_binned_add_linear_jax(
+                score, binned_dev, *self._padded_traversal_args(),
+                *self._padded_linear_args(), raw_dev,
+                mv_slots=mv_slots_dev, tid=tid,
+                mv_present=mv_slots_dev is not None)
         return _traverse_binned_add_jax(
             score, binned_dev, *self._padded_traversal_args(),
             mv_slots=mv_slots_dev, tid=tid,
@@ -363,15 +478,16 @@ class Tree:
         return max(self.num_leaves - 1, 0)
 
 
-def _traverse_binned_core(binned, col, offset, thr, dec, left, right,
-                          miss, default_bin, num_bin, cat_bitsets,
-                          leaf_vals, mv_slots=None,
-                          mv_present: bool = False):
+def _traverse_binned_idx(binned, col, offset, thr, dec, left, right,
+                         miss, default_bin, num_bin, cat_bitsets,
+                         leaf_vals, mv_slots=None,
+                         mv_present: bool = False):
     """Vectorized bin-space tree walk (NumericalDecision semantics of
-    predict_leaf_index_binned, in one lax.while_loop). ``col``/``offset``
-    are the EFB physical column + value offset per node (offset 0 =
-    raw bins; columns >= the dense width are multi-val pseudo-groups
-    decoded from the row-wise slot matrix)."""
+    predict_leaf_index_binned, in one lax.while_loop) returning the
+    LEAF SLOT per row. ``col``/``offset`` are the EFB physical column +
+    value offset per node (offset 0 = raw bins; columns >= the dense
+    width are multi-val pseudo-groups decoded from the row-wise slot
+    matrix); ``leaf_vals`` only sizes the pad slot here."""
     n = binned.shape[0]
     rows = jnp.arange(n)
     g_dense = binned.shape[1]
@@ -412,12 +528,62 @@ def _traverse_binned_core(binned, col, offset, thr, dec, left, right,
     out0 = jnp.full(n, leaf_vals.shape[0] - 1, jnp.int32)  # pad slot
     done0 = jnp.zeros(n, bool)
     _, out, _ = jax.lax.while_loop(cond, body, (node0, out0, done0))
-    return leaf_vals[out]
+    return out
+
+
+def _traverse_binned_core(binned, col, offset, thr, dec, left, right,
+                          miss, default_bin, num_bin, cat_bitsets,
+                          leaf_vals, mv_slots=None,
+                          mv_present: bool = False):
+    return leaf_vals[_traverse_binned_idx(
+        binned, col, offset, thr, dec, left, right, miss, default_bin,
+        num_bin, cat_bitsets, leaf_vals, mv_slots,
+        mv_present=mv_present)]
 
 
 _traverse_binned_jax = functools.partial(jax.jit,
                                          static_argnames=("mv_present",))(
     _traverse_binned_core)
+
+
+def _traverse_binned_linear_core(binned, col, offset, thr, dec, left,
+                                 right, miss, default_bin, num_bin,
+                                 cat_bitsets, leaf_vals, lin_const,
+                                 lin_coeff, lin_feat, raw,
+                                 mv_slots=None, *,
+                                 mv_present: bool = False):
+    """Bin-space traversal + piecewise-linear leaf output in one
+    program: ``const + w . x`` over the leaf's raw model features,
+    with the constant ``leaf_vals`` fallback for NaN rows."""
+    from .linear import linear_leaf_values
+    out = _traverse_binned_idx(binned, col, offset, thr, dec, left,
+                               right, miss, default_bin, num_bin,
+                               cat_bitsets, leaf_vals, mv_slots,
+                               mv_present=mv_present)
+    return linear_leaf_values(out, raw, leaf_vals, lin_const,
+                              lin_coeff, lin_feat)
+
+
+_traverse_binned_linear_jax = functools.partial(
+    jax.jit, static_argnames=("mv_present",))(
+    _traverse_binned_linear_core)
+
+
+@functools.partial(jax.jit, static_argnames=("tid", "mv_present"),
+                   donate_argnums=(0,))
+def _traverse_binned_add_linear_jax(score, binned, col, offset, thr,
+                                    dec, left, right, miss, default_bin,
+                                    num_bin, cat_bitsets, leaf_vals,
+                                    lin_const, lin_coeff, lin_feat, raw,
+                                    mv_slots=None, *, tid: int,
+                                    mv_present: bool = False):
+    """Linear-leaf traversal + score-column add as ONE donated device
+    program (the linear analog of _traverse_binned_add_jax)."""
+    add = _traverse_binned_linear_core(
+        binned, col, offset, thr, dec, left, right, miss, default_bin,
+        num_bin, cat_bitsets, leaf_vals, lin_const, lin_coeff, lin_feat,
+        raw, mv_slots, mv_present=mv_present)
+    return score.at[:, tid].add(add)
 
 
 @functools.partial(jax.jit, static_argnames=("tid", "mv_present"),
@@ -552,14 +718,14 @@ def traverse_tree_arrays(arrays: TreeArrays, binned_dev, meta,
         mv_slots=mv_slots_dev, mv_present=mv_slots_dev is not None)
 
 
-@functools.partial(jax.jit, static_argnames=("mv_present",))
-def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right, miss,
-                         default_bin, num_bin, cat_bitsets, leaf_vals,
-                         num_leaves, mv_slots=None,
+def _traverse_arrays_idx(binned, col, offset, thr, dec, left, right,
+                         miss, default_bin, num_bin, cat_bitsets,
+                         leaf_vals, num_leaves, mv_slots=None,
                          mv_present: bool = False):
-    """Like ``_traverse_binned_jax`` but over full-size (num_leaves_max)
+    """Like ``_traverse_binned_idx`` but over full-size (num_leaves_max)
     node arrays with a live ``num_leaves`` scalar: 1-leaf trees resolve
-    to leaf 0 immediately (whose value the caller scaled)."""
+    to leaf 0 immediately (whose value the caller scaled). Returns the
+    leaf index per row."""
     n = binned.shape[0]
     rows = jnp.arange(n)
     g_dense = binned.shape[1]
@@ -603,7 +769,18 @@ def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right, miss,
     done0 = jnp.broadcast_to(num_leaves <= 1, (n,))
     _, out, _, _ = jax.lax.while_loop(
         cond, body, (node0, out0, done0, jnp.int32(0)))
-    return leaf_vals[out]
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("mv_present",))
+def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right,
+                         miss, default_bin, num_bin, cat_bitsets,
+                         leaf_vals, num_leaves, mv_slots=None,
+                         mv_present: bool = False):
+    return leaf_vals[_traverse_arrays_idx(
+        binned, col, offset, thr, dec, left, right, miss, default_bin,
+        num_bin, cat_bitsets, leaf_vals, num_leaves, mv_slots,
+        mv_present=mv_present)]
 
 
 def _bin_threshold_to_value(dataset, inner_feature: int,

@@ -204,25 +204,44 @@ class StackedTrees:
     """Stacked SoA tree arrays for the device scan, built once per
     model (version) and reusable across dispatches. ``device()``
     uploads the stack once and keeps the jnp arrays pinned — the
-    serving registry's per-version device residency."""
+    serving registry's per-version device residency.
 
-    _FIELDS = ("col", "off", "thr", "dec", "left", "right", "miss",
-               "dbin", "nbin", "cat", "leaf_vals", "n_leaves",
-               "tree_class")
+    Linear-leaf forests (``any_linear``) carry three extra leaf-indexed
+    matrices — per-leaf constant, coefficients and INNER feature
+    indices — padded to a power-of-two feature bucket so shape-bucketed
+    serving compiles stay stable across trees and hot-reloaded model
+    versions; constant trees in a mixed stack ride the same formula
+    with coeff 0 / const = leaf value (bit-identical output)."""
 
-    def __init__(self, k: int, **arrays):
+    _BASE_FIELDS = ("col", "off", "thr", "dec", "left", "right", "miss",
+                    "dbin", "nbin", "cat", "leaf_vals", "n_leaves",
+                    "tree_class")
+    _LINEAR_FIELDS = ("lin_const", "lin_coeff", "lin_feat")
+    _FIELDS = _BASE_FIELDS + _LINEAR_FIELDS
+
+    def __init__(self, k: int, any_linear: bool = False, **arrays):
         self.k = k
+        self.any_linear = bool(any_linear)
         for f in self._FIELDS:
             setattr(self, f, arrays[f])
         self._device = None
 
     def device(self):
-        """The stack as (pinned) device arrays, uploaded on first use."""
+        """The stack as (pinned) device arrays, uploaded on first use.
+        Returns the base field tuple; ``device_linear()`` appends the
+        linear matrices for linear-leaf stacks."""
         if self._device is None:
             import jax.numpy as jnp
+            fields = self._FIELDS if self.any_linear else \
+                self._BASE_FIELDS
             self._device = tuple(jnp.asarray(getattr(self, f))
-                                 for f in self._FIELDS)
-        return self._device
+                                 for f in fields)
+        return self._device[:len(self._BASE_FIELDS)]
+
+    def device_linear(self):
+        """The (lin_const, lin_coeff, lin_feat) device triple."""
+        self.device()
+        return self._device[len(self._BASE_FIELDS):]
 
     @property
     def num_trees(self) -> int:
@@ -236,6 +255,7 @@ def stack_tree_arrays(models, k: int) -> StackedTrees:
     """Stack per-tree arrays into [T, S_max] SoA matrices (the scan's
     carry inputs). Trees must be finalized and dataset-backed (have the
     ``_col``/``_offset`` bundled-layout columns)."""
+    from .models.linear import linear_bucket
     t = len(models)
     s_max = max(max(len(m.split_feature_inner) for m in models), 1)
 
@@ -250,12 +270,28 @@ def stack_tree_arrays(models, k: int) -> StackedTrees:
     cat = np.zeros((t, s_max, nw), np.uint32)
     leaf_vals = np.zeros((t, s_max + 1), np.float32)
     n_leaves = np.zeros((t,), np.int32)
+    any_linear = any(getattr(m, "is_linear", False) for m in models)
+    cbkt = linear_bucket(max(
+        (m.leaf_coeff.shape[1] for m in models
+         if getattr(m, "is_linear", False)), default=1))
+    lin_const = np.zeros((t, s_max + 1), np.float32)
+    lin_coeff = np.zeros((t, s_max + 1, cbkt), np.float32)
+    lin_feat = np.full((t, s_max + 1, cbkt), -1, np.int32)
     for i, m in enumerate(models):
         cat[i, :len(m.cat_bitsets)] = m.cat_bitsets
         leaf_vals[i, :m.num_leaves] = m.leaf_value
         n_leaves[i] = m.num_leaves
+        if getattr(m, "is_linear", False):
+            cm = m.leaf_coeff.shape[1]
+            lin_const[i, :m.num_leaves] = m.leaf_const
+            lin_coeff[i, :m.num_leaves, :cm] = m.leaf_coeff
+            lin_feat[i, :m.num_leaves, :cm] = m.leaf_features_inner
+        elif any_linear:
+            # constant trees in a mixed stack: the uniform linear
+            # formula degenerates to exactly the leaf value
+            lin_const[i, :m.num_leaves] = m.leaf_value
     return StackedTrees(
-        k,
+        k, any_linear=any_linear,
         col=stack("_col", np.int32), off=stack("_offset", np.int32),
         thr=stack("threshold_bin", np.int32),
         dec=stack("decision_type", np.int32),
@@ -265,7 +301,8 @@ def stack_tree_arrays(models, k: int) -> StackedTrees:
         dbin=stack("_default_bin", np.int32),
         nbin=stack("_num_bin", np.int32),
         cat=cat, leaf_vals=leaf_vals, n_leaves=n_leaves,
-        tree_class=np.asarray([i % k for i in range(t)], np.int32))
+        tree_class=np.asarray([i % k for i in range(t)], np.int32),
+        lin_const=lin_const, lin_coeff=lin_coeff, lin_feat=lin_feat)
 
 
 # signatures already dispatched through _scan_trees this process:
@@ -286,6 +323,16 @@ def _device_predict(models, data, dataset, k: int,
 
     binned, mv_slots = _bin_data(data, dataset)
     n = binned.shape[0]
+    if stacked is None:
+        stacked = stack_tree_arrays(models, k)
+    raw = None
+    if stacked.any_linear:
+        # linear leaves read raw feature values (inner-feature
+        # columns), gathered once per dispatch alongside the re-binning
+        idx = np.asarray(dataset.real_feature_idx, np.int64)
+        raw = np.ascontiguousarray(
+            np.asarray(data, np.float64)[:, idx], np.float32) \
+            if idx.size else np.zeros((n, 1), np.float32)
     if buckets_enabled():
         b = bucket_rows(n)
         if b > n:
@@ -296,12 +343,15 @@ def _device_predict(models, data, dataset, k: int,
                 mv_slots = np.concatenate(
                     [mv_slots, np.zeros((b - n,) + mv_slots.shape[1:],
                                         mv_slots.dtype)])
-    if stacked is None:
-        stacked = stack_tree_arrays(models, k)
+            if raw is not None:
+                raw = np.concatenate(
+                    [raw, np.zeros((b - n,) + raw.shape[1:],
+                                   raw.dtype)])
     dev = stacked.device()
 
     sig = (binned.shape, str(binned.dtype), k, mv_slots is not None,
            None if mv_slots is None else mv_slots.shape,
+           stacked.any_linear,
            tuple((a.shape, str(a.dtype)) for a in dev))
     from .observability.telemetry import get_telemetry
     if sig in _SEEN_SCAN_SIGS:
@@ -309,10 +359,17 @@ def _device_predict(models, data, dataset, k: int,
     else:
         _SEEN_SCAN_SIGS.add(sig)
 
-    out = _scan_trees(
-        jnp.asarray(binned), *dev, k,
-        None if mv_slots is None else jnp.asarray(mv_slots),
-        mv_slots is not None)
+    if stacked.any_linear:
+        out = _scan_trees_linear(
+            jnp.asarray(binned), *dev, *stacked.device_linear(),
+            jnp.asarray(raw), k,
+            None if mv_slots is None else jnp.asarray(mv_slots),
+            mv_slots is not None)
+    else:
+        out = _scan_trees(
+            jnp.asarray(binned), *dev, k,
+            None if mv_slots is None else jnp.asarray(mv_slots),
+            mv_slots is not None)
     return np.asarray(jax.device_get(out), np.float64)[:n]
 
 
@@ -337,6 +394,40 @@ def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
         body, acc0,
         (col, off, thr, dec, left, right, miss, dbin, nbin, cat,
          leaf_vals, n_leaves, tree_class))
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("k", "mv_present"))
+def _scan_trees_linear(binned, col, off, thr, dec, left, right, miss,
+                       dbin, nbin, cat, leaf_vals, n_leaves, tree_class,
+                       lin_const, lin_coeff, lin_feat, raw, k,
+                       mv_slots=None, mv_present=False):
+    """Linear-leaf forest scan: per tree, the bin-space traversal
+    yields the leaf INDEX and the leaf's linear model evaluates over
+    the raw feature matrix (models/linear.py). Constant trees in the
+    stack carry coeff 0 / const = leaf value, so the uniform formula
+    is bit-identical to the constant gather."""
+    import jax.numpy as jnp
+    from .models.linear import linear_leaf_values
+    from .models.tree import _traverse_arrays_idx
+
+    n = binned.shape[0]
+
+    def body(acc, tree):
+        (c, o, th, d, lt, r, mi, db, nb, ct, lv, nl, cls,
+         lc, lw, lf) = tree
+        idx = _traverse_arrays_idx(binned, c, o, th, d, lt, r, mi, db,
+                                   nb, ct, lv, nl, mv_slots=mv_slots,
+                                   mv_present=mv_present)
+        add = linear_leaf_values(idx, raw, lv, lc, lw, lf)
+        return acc.at[:, cls].add(add), None
+
+    acc0 = jnp.zeros((n, k), jnp.float32)
+    acc, _ = jax.lax.scan(
+        body, acc0,
+        (col, off, thr, dec, left, right, miss, dbin, nbin, cat,
+         leaf_vals, n_leaves, tree_class, lin_const, lin_coeff,
+         lin_feat))
     return acc
 
 
@@ -387,6 +478,11 @@ def _predict_contrib(models, data: np.ndarray, k: int) -> np.ndarray:
     the recursive Python _tree_shap below is the fallback and the
     golden reference for tests."""
     from .native import get_shap_lib
+    if any(getattr(t, "is_linear", False) for t in models):
+        raise ValueError(
+            "pred_contrib (TreeSHAP) is not supported for linear-leaf "
+            "trees; predict with linear_tree=false or drop the leaf "
+            "linear models first")
     n, f = data.shape
     out = np.zeros((n, k, f + 1))
     lib = get_shap_lib() if n else None

@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -64,6 +66,7 @@ def test_bench_main_probe_and_pinned_plan(tmp_path):
                BENCH_LEAVES="7", BENCH_ITERS="1",
                BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500",
                BENCH_MIN_AUC="0.4", BENCH_ALLOW_CPU="1",
+               BENCH_PROBE_CACHE="0",
                LGBM_TPU_TELEMETRY=tel_path)
     flags = env.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
@@ -93,7 +96,8 @@ def test_bench_quality_gate_is_loud():
                BENCH_LEAVES="7", BENCH_ITERS="1",
                BENCH_WARMUP_ITERS="1", BENCH_BUDGET_S="500",
                BENCH_MIN_AUC="1.01",   # unreachable bar
-               BENCH_ALLOW_CPU="1", BENCH_NO_TELEMETRY="1")
+               BENCH_ALLOW_CPU="1", BENCH_NO_TELEMETRY="1",
+               BENCH_PROBE_CACHE="0")
     flags = env.get("XLA_FLAGS", "")
     if "xla_cpu_max_isa" not in flags:
         env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
@@ -105,6 +109,65 @@ def test_bench_quality_gate_is_loud():
     from bench import find_result_line
     line = find_result_line(proc.stdout)
     assert line is not None and line["quality_ok"] is False
+
+
+@pytest.mark.slow
+def test_bench_linear_convergence_child():
+    """The linear_tree=true bench block (ISSUE 6): the convergence
+    child prints a JSON line with the iteration ratio that the parent
+    records in the bench output. A full double training in a child
+    process — slow-marked so the tier-1 budget gate keeps its headroom
+    (the full suite and CI still run it; the in-process convergence
+    acceptance test lives in tests/test_linear_tree.py)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the tunnel
+    env.pop("_BENCH_CHILD", None)
+    env.update(JAX_PLATFORMS="cpu", _BENCH_CHILD_LINEAR="1",
+               BENCH_LINEAR_ROWS="2500", BENCH_LINEAR_ITERS="15",
+               BENCH_LINEAR_LEAVES="15")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_cpu_max_isa" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], env=env,
+        capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    sys.path.insert(0, REPO)
+    from bench import find_result_line
+    line = find_result_line(proc.stdout)
+    assert line is not None, proc.stdout[-2000:]
+    assert line["metric"] == "linear_tree_convergence"
+    assert line["const_iters"] == 15
+    assert line["linear_iters_to_match"] is not None
+    assert 0 < line["iter_ratio"] <= 1.0
+    assert isinstance(line["meets_0p7_bar"], bool)
+
+
+def test_probe_cache_round_trip(tmp_path, monkeypatch):
+    """The cached TPU probe verdict: fresh entries are honored, stale
+    and mode-mismatched (BENCH_ALLOW_CPU) entries are not, and
+    BENCH_PROBE_CACHE=0 disables the cache entirely."""
+    sys.path.insert(0, REPO)
+    import bench
+    monkeypatch.setattr(bench, "PROBE_CACHE_FILE",
+                        str(tmp_path / "probe.json"))
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_CACHE", raising=False)
+    assert bench.read_probe_cache() is None
+    bench.write_probe_cache(False, "hung > 90s")
+    got = bench.read_probe_cache()
+    assert got is not None and got["ok"] is False
+    # verdicts are keyed by the allow-cpu mode
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    assert bench.read_probe_cache() is None
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    # a stale entry expires
+    monkeypatch.setenv("BENCH_PROBE_TTL_S", "0")
+    assert bench.read_probe_cache() is None
+    monkeypatch.delenv("BENCH_PROBE_TTL_S", raising=False)
+    # kill switch
+    monkeypatch.setenv("BENCH_PROBE_CACHE", "0")
+    assert bench.read_probe_cache() is None
 
 
 def test_find_result_line_takes_last_valid():

@@ -149,3 +149,29 @@ def test_codegen_deep_tree_no_recursion_limit(tmp_path):
     raw_ref = booster.predict_raw(X[::37])[:, 0]
     raw_c = _predict_compiled(dll, X[::37], raw=True)
     np.testing.assert_allclose(raw_c, raw_ref, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.skipif(not HAVE_GXX, reason="g++ not available")
+def test_codegen_linear_leaves(tmp_path):
+    """Linear-leaf models emit `const + w . x` leaf expressions with
+    the NaN fallback. The generated code is double-precision while the
+    trained predictor accumulates the linear part in f32, so parity is
+    close-but-not-bitwise (like the reference's compiled predictors)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io.model_text import load_model_from_string
+    rng = np.random.RandomState(4)
+    X = rng.randn(400, 5)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(400)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "linear_tree": True, "linear_lambda": 0.01,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=4)
+    booster = load_model_from_string(bst.model_to_string())
+    source = model_to_if_else(booster)
+    assert "std::isnan" in source
+    dll = _compile_and_load(source, tmp_path)
+    Xte = np.concatenate([X[:40], np.full((3, 5), np.nan)])
+    raw_ref = booster.predict_raw(Xte)[:, 0]
+    raw_c = _predict_compiled(dll, Xte, raw=True)
+    np.testing.assert_allclose(raw_c, raw_ref, rtol=1e-4, atol=1e-4)
+    assert np.isfinite(raw_c).all()

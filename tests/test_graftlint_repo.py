@@ -57,6 +57,21 @@ def test_lightgbm_tpu_tree_has_no_new_findings(all_findings):
         "`# graftlint: allow[rule]` with a reason):\n" + _fmt(new))
 
 
+def test_linear_leaf_module_is_clean(all_findings):
+    """ISSUE-6 pin: the leaf-linear subsystem (models/linear.py) joins
+    the hot path with ZERO findings of any family — its fit program
+    sits in the per-iteration training loop and its prediction helpers
+    trace into the serving scan, so host-sync/donation/retrace
+    discipline applies from day one (never baselined)."""
+    findings = [f for f in all_findings
+                if f.path == "lightgbm_tpu/models/linear.py"]
+    assert not findings, _fmt(findings)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert not [k for k in baseline
+                if k[0] == "lightgbm_tpu/models/linear.py"], \
+        "models/linear.py must stay baseline-clean, not grandfathered"
+
+
 def test_hot_path_baseline_is_empty():
     baseline = load_baseline(DEFAULT_BASELINE)
     grandfathered = [k for k in baseline

@@ -1,0 +1,370 @@
+"""Linear-leaf tree golden tests (ISSUE 6).
+
+* model-text round-trip is BYTE-exact (save -> load -> save);
+* serving through ``ServingEngine`` is BIT-identical to direct
+  ``predict`` — compiled bucketed route and host route, including
+  across a hot reload — with zero steady-state recompiles;
+* checkpoint/resume with ``linear_tree=true`` is byte-identical;
+* convergence: on dense synthetic regression, linear leaves reach the
+  constant-leaf model's validation loss in <= 0.7x the iterations;
+* fit gating: categorical-only paths and NaN rows fall back to the
+  constant leaf output.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.callback import record_evaluation
+from lightgbm_tpu.io.model_text import (load_model_from_string,
+                                        save_model_to_string)
+from lightgbm_tpu.observability.telemetry import get_telemetry
+from lightgbm_tpu.serving import ServingConfig, ServingEngine
+
+LINEAR_PARAMS = {"objective": "regression", "num_leaves": 7,
+                 "linear_tree": True, "linear_lambda": 0.01,
+                 "verbosity": -1}
+
+
+def _dense_regression(n=800, f=6, seed=0, noise=0.01):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (3.0 * X[:, 0] + 2.0 * X[:, 1] - 1.5 * X[:, 2]
+         + 0.5 * X[:, 3] * X[:, 4] + noise * rng.randn(n))
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def linear_model():
+    X, y = _dense_regression()
+    bst = lgb.train(dict(LINEAR_PARAMS), lgb.Dataset(X, label=y),
+                    num_boost_round=6)
+    return bst, X
+
+
+@pytest.fixture
+def tel():
+    t = get_telemetry()
+    t.reset()
+    t.ensure_ring()
+    yield t
+    t.reset()
+
+
+# ----------------------------------------------------------------------
+def test_linear_leaves_actually_fit(linear_model):
+    bst, X = linear_model
+    src = bst._src()
+    lin = [t for t in src.models if getattr(t, "is_linear", False)]
+    assert lin, "no tree grew linear leaves on dense numeric data"
+    t0 = lin[0]
+    assert t0.leaf_coeff.shape[0] == t0.num_leaves
+    assert (np.abs(t0.leaf_coeff) > 0).any()
+    assert (t0.leaf_features >= 0).any()
+    # non-fitted padding slots must be inert
+    assert np.all(t0.leaf_coeff[t0.leaf_features < 0] == 0.0)
+
+
+def test_model_text_round_trip_byte_exact(linear_model):
+    bst, X = linear_model
+    text1 = bst.model_to_string()
+    assert "is_linear=1" in text1
+    assert "leaf_coeff=" in text1 and "leaf_features=" in text1
+    loaded = load_model_from_string(text1)
+    text2 = save_model_to_string(loaded)
+
+    def tree_section(t):
+        return t[t.index("tree_sizes"):t.index("end of trees")]
+
+    # the tree blocks (incl. every coefficient) round-trip byte-exact
+    assert tree_section(text1) == tree_section(text2)
+    # and a second full round trip is a fixed point
+    text3 = save_model_to_string(load_model_from_string(text2))
+    assert text2 == text3
+
+
+def test_loaded_booster_predicts_identically(linear_model):
+    bst, X = linear_model
+    loaded = load_model_from_string(bst.model_to_string())
+    direct = np.asarray(bst.predict(X[:100], raw_score=True))
+    via_text = loaded.predict_raw(X[:100])[:, 0]
+    np.testing.assert_array_equal(direct, via_text)
+
+
+def test_device_and_host_routes_agree(linear_model):
+    """The batched device scan vs the host traversal for linear
+    forests: identical f32 leaf-model math per tree (explicit add
+    chain), so the routes differ only by the pre-existing cross-tree
+    accumulation dtype (f32 scan carry vs f64 host sum)."""
+    from lightgbm_tpu import predictor
+    bst, X = linear_model
+    src = bst._src()
+    host = np.asarray(predictor.predict(src, X, raw_score=True,
+                                        device=False))
+    dev = np.asarray(predictor.predict(src, X, raw_score=True,
+                                       device=True))
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-6)
+    # per single tree the two routes are BIT-identical
+    one = src.models[0]
+    hv = one.predict(X)
+    dv = np.asarray(predictor.predict(
+        src, X, num_iteration=1, raw_score=True, device=True))
+    np.testing.assert_array_equal(np.asarray(hv, np.float32),
+                                  np.asarray(dv, np.float32))
+
+
+def test_nan_rows_fall_back_to_constant(linear_model):
+    bst, X = linear_model
+    src = bst._src()
+    Xn = X[:32].copy()
+    Xn[:, :] = np.nan  # every model feature missing
+    for t in src.models:
+        if not getattr(t, "is_linear", False):
+            continue
+        out = t.predict(Xn)
+        idx = t.predict_leaf_index(Xn)
+        np.testing.assert_array_equal(out, t.leaf_value[idx])
+
+
+def test_shrinkage_scales_coefficients(linear_model):
+    bst, X = linear_model
+    src = bst._src()
+    t0 = next(t for t in src.models if getattr(t, "is_linear", False))
+    before = t0.predict(X[:50])
+    coeff, const = t0.leaf_coeff.copy(), t0.leaf_const.copy()
+    t0.shrink(0.5)
+    np.testing.assert_allclose(t0.leaf_coeff, coeff * 0.5)
+    np.testing.assert_allclose(t0.leaf_const, const * 0.5)
+    after = t0.predict(X[:50])
+    np.testing.assert_allclose(after, before * 0.5, rtol=1e-6)
+    t0.shrink(2.0)  # restore for the other module-scoped tests
+
+
+# ----------------------------------------------------------------------
+# serving parity (bit-identical, both routes, across hot reload)
+def test_serving_parity_default_route(linear_model):
+    bst, X = linear_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), warmup=False, flush_interval_ms=1.0))
+    try:
+        for n in (1, 7, 16):
+            rows = X[:n]
+            np.testing.assert_array_equal(eng.predict(rows),
+                                          bst.predict(rows))
+            np.testing.assert_array_equal(
+                eng.predict(rows, kind="raw_score"),
+                bst.predict(rows, raw_score=True))
+            np.testing.assert_array_equal(
+                eng.predict(rows, kind="pred_leaf"),
+                bst.predict(rows, pred_leaf=True))
+    finally:
+        eng.stop()
+
+
+def test_serving_parity_compiled_route_bit_identical(linear_model,
+                                                     monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    bst, X = linear_model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), device="always", flush_interval_ms=1.0))
+    try:
+        assert eng.registry.current().device_ready
+        assert eng.registry.current().stacked.any_linear
+        for n in (1, 5, 16, 23):   # 23 > max bucket -> chunked 16+7
+            rows = X[:n]
+            np.testing.assert_array_equal(eng.predict(rows),
+                                          bst.predict(rows))
+            np.testing.assert_array_equal(
+                eng.predict(rows, kind="raw_score"),
+                bst.predict(rows, raw_score=True))
+    finally:
+        eng.stop()
+
+
+def test_serving_zero_steady_state_recompiles(linear_model, tel):
+    """Mixed batch sizes against a linear-leaf forest must trigger
+    ZERO new XLA compilations after warmup (acceptance criterion)."""
+    bst, X = linear_model
+    big = np.concatenate([X] * 2)
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(1, 8, 64, 512), device="always",
+        flush_interval_ms=0.5))
+    try:
+        compiles_after_warmup = tel.counters.get("jit.compiles", 0)
+        for _round in range(2):
+            for n in (1, 7, 64, 300):
+                out = eng.predict(big[:n], kind="raw_score")
+                assert len(out) == n
+        assert tel.counters.get("jit.compiles", 0) \
+            == compiles_after_warmup, \
+            "steady-state linear-leaf serving recompiled"
+    finally:
+        eng.stop()
+
+
+def test_serving_parity_across_hot_reload(linear_model, monkeypatch):
+    """Hot-reloading a SECOND linear model (different trees, same
+    feature-bucket shape) keeps responses bit-identical to the direct
+    predict of the newly-active version."""
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    bst, X = linear_model
+    X2, y2 = _dense_regression(seed=5)
+    bst2 = lgb.train(dict(LINEAR_PARAMS), lgb.Dataset(X2, label=y2),
+                     num_boost_round=5)
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), device="always", flush_interval_ms=1.0))
+    try:
+        np.testing.assert_array_equal(eng.predict(X[:16]),
+                                      bst.predict(X[:16]))
+        eng.reload(bst2)
+        assert eng.registry.current().stacked.any_linear
+        np.testing.assert_array_equal(eng.predict(X[:16]),
+                                      bst2.predict(X[:16]))
+        np.testing.assert_array_equal(
+            eng.predict(X[:7], kind="raw_score"),
+            bst2.predict(X[:7], raw_score=True))
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+def test_checkpoint_resume_byte_identical(tmp_path):
+    """Train-to-10, resume-to-20 must produce the SAME model text as
+    an uninterrupted 20-iteration run (coefficients included)."""
+    X, y = _dense_regression(n=500)
+    params = dict(LINEAR_PARAMS)
+    params.update(checkpoint_dir=str(tmp_path / "ckpts"),
+                  checkpoint_freq=5, metric="l2")
+
+    def run(rounds):
+        return lgb.train(dict(params), lgb.Dataset(X, label=y),
+                         num_boost_round=rounds,
+                         valid_sets=[lgb.Dataset(X[:200],
+                                                 label=y[:200])],
+                         verbose_eval=False)
+
+    clean = lgb.train(
+        {k: v for k, v in params.items()
+         if not k.startswith("checkpoint")},
+        lgb.Dataset(X, label=y), num_boost_round=20,
+        valid_sets=[lgb.Dataset(X[:200], label=y[:200])],
+        verbose_eval=False)
+    run(10)                       # writes ckpt at iteration 10
+    resumed = run(20)             # resume=auto picks it up
+    assert resumed.resumed_iteration == 10
+
+    def body(text):
+        # everything except the parameters footer, which (correctly)
+        # records the differing checkpoint_* knobs
+        return text.split("\nparameters:")[0]
+
+    assert body(resumed.model_to_string()) \
+        == body(clean.model_to_string())
+
+
+# ----------------------------------------------------------------------
+def test_convergence_materially_fewer_iterations():
+    """Acceptance: linear_tree reaches the constant-leaf model's
+    validation loss in <= 0.7x the boosting iterations on dense
+    numeric regression."""
+    rng = np.random.RandomState(9)
+    n, iters = 2000, 25
+    X = rng.randn(n, 8)
+    y = (3.0 * X[:, 0] + 2.0 * X[:, 1] - 1.5 * X[:, 2]
+         + 0.5 * X[:, 3] * X[:, 4] + 0.1 * rng.randn(n))
+    cut = int(n * 0.8)
+
+    def run(linear):
+        params = {"objective": "regression", "num_leaves": 15,
+                  "learning_rate": 0.1, "metric": "l2",
+                  "verbosity": -1}
+        if linear:
+            params.update(linear_tree=True, linear_lambda=0.01)
+        hist = {}
+        lgb.train(params, lgb.Dataset(X[:cut], label=y[:cut]),
+                  num_boost_round=iters,
+                  valid_sets=[lgb.Dataset(X[cut:], label=y[cut:])],
+                  valid_names=["valid"], verbose_eval=False,
+                  callbacks=[record_evaluation(hist)])
+        return hist["valid"]["l2"]
+
+    const_curve = run(False)
+    linear_curve = run(True)
+    target = const_curve[-1]
+    match = next((i + 1 for i, v in enumerate(linear_curve)
+                  if v <= target), None)
+    assert match is not None, "linear trees never reached the " \
+        "constant model's validation loss"
+    assert match <= 0.7 * iters, (
+        f"linear trees needed {match}/{iters} iterations "
+        f"(> 0.7x) to reach valid l2 {target}")
+
+
+# ----------------------------------------------------------------------
+# gating / fallback behavior
+def test_categorical_only_paths_fall_back():
+    """Splits on categorical features contribute no linear model
+    features; a leaf whose whole path is categorical keeps its
+    constant output (coeff row is empty)."""
+    rng = np.random.RandomState(2)
+    n = 600
+    Xc = rng.randint(0, 5, size=(n, 1)).astype(np.float64)
+    y = (Xc[:, 0] * 1.7 + 0.05 * rng.randn(n))
+    bst = lgb.train(dict(LINEAR_PARAMS),
+                    lgb.Dataset(Xc, label=y,
+                                categorical_feature=[0]),
+                    num_boost_round=3)
+    src = bst._src()
+    for t in src.models:
+        assert not getattr(t, "is_linear", False), \
+            "categorical-only tree must not carry linear leaves"
+    # prediction still works and matches the loaded model
+    loaded = load_model_from_string(bst.model_to_string())
+    np.testing.assert_array_equal(
+        np.asarray(bst.predict(Xc[:50], raw_score=True)),
+        loaded.predict_raw(Xc[:50])[:, 0])
+
+
+def test_nan_training_rows_are_excluded_not_fatal():
+    X, y = _dense_regression(n=700)
+    X = X.copy()
+    X[::7, 0] = np.nan          # NaNs in the most-split feature
+    bst = lgb.train(dict(LINEAR_PARAMS), lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    pred = np.asarray(bst.predict(X, raw_score=True))
+    assert np.isfinite(pred).all()
+    # bin-space (training-path) and raw-feature prediction agree
+    loaded = load_model_from_string(bst.model_to_string())
+    np.testing.assert_array_equal(pred,
+                                  loaded.predict_raw(X)[:, 0])
+
+
+def test_train_score_matches_host_predict():
+    """The device-resident training score cache must equal the host
+    re-prediction of the final model (the linear score updater and the
+    host evaluator implement the same math)."""
+    X, y = _dense_regression(n=400)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(dict(LINEAR_PARAMS), ds, num_boost_round=6)
+    import jax
+    cached = np.asarray(
+        jax.device_get(bst._gbdt.train_score))[:, 0]
+    host = np.asarray(bst.predict(X, raw_score=True, device=False))
+    np.testing.assert_allclose(cached, host, rtol=2e-5, atol=2e-5)
+
+
+def test_pred_contrib_raises_clearly(linear_model):
+    bst, X = linear_model
+    with pytest.raises(ValueError, match="linear"):
+        bst.predict(X[:4], pred_contrib=True)
+
+
+def test_dart_and_parallel_configs_downgrade_with_warning():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"boosting": "dart", "linear_tree": True})
+    assert cfg.linear_tree is False
+    cfg = Config.from_params({"tree_learner": "data",
+                              "num_machines": 2, "linear_tree": True})
+    assert cfg.linear_tree is False
+    cfg = Config.from_params({"linear_tree": True})
+    assert cfg.linear_tree is True

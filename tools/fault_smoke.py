@@ -1,6 +1,8 @@
 """Fault-injection smoke drill (the CI robustness gate).
 
-Scenario (docs/Robustness.md):
+Scenario (docs/Robustness.md), run for TWO configs — constant leaves
+and ``linear_tree=true`` (the leaf-coefficient state must survive the
+NaN guard, the SIGTERM checkpoint and the resume byte-identically):
 
 1. **Clean run** — 30 boosting iterations with periodic checkpoints;
    the resulting model text is the golden answer.
@@ -13,9 +15,9 @@ Scenario (docs/Robustness.md):
 3. **Resume run** — same command again; ``resume=auto`` must pick up
    the final checkpoint and train to completion.
 
-PASS iff the resumed model file is **byte-identical** to the clean
+PASS iff each resumed model file is **byte-identical** to its clean
 run's and the telemetry trace recorded the ``guard.nonfinite_iters``
-event. Run with ``LGBM_TPU_TELEMETRY=<path.jsonl>`` to get the trace
+events. Run with ``LGBM_TPU_TELEMETRY=<path.jsonl>`` to get the trace
 artifact (CI uploads it).
 
 Usage: python tools/fault_smoke.py [workdir]
@@ -35,6 +37,11 @@ NAN_ITER = 10
 SIGTERM_ITER = 20
 CKPT_FREQ = 5
 
+CONFIGS = {
+    "": {},
+    "linear": {"linear_tree": True, "linear_lambda": 0.01},
+}
+
 
 def make_data():
     rng = np.random.RandomState(7)
@@ -47,14 +54,14 @@ def make_data():
     return X, y, Xv, yv
 
 
-def main() -> int:
-    workdir = sys.argv[1] if len(sys.argv) > 1 else "fault_smoke_work"
-    os.makedirs(workdir, exist_ok=True)
-    ckpt_dir = os.path.join(workdir, "ckpts")
+def run_scenario(workdir: str, tag: str, extra_params: dict) -> int:
+    """One clean/faulted/resume drill; returns the clean model's tree
+    count. ``tag`` suffixes the checkpoint dir and artifacts."""
+    suffix = f"_{tag}" if tag else ""
+    ckpt_dir = os.path.join(workdir, f"ckpts{suffix}")
 
     from lightgbm_tpu import engine
     from lightgbm_tpu.basic import Dataset
-    from lightgbm_tpu.observability.telemetry import get_telemetry
     from lightgbm_tpu.robustness.faults import set_fault_plan
 
     X, y, Xv, yv = make_data()
@@ -64,6 +71,8 @@ def main() -> int:
         "bagging_freq": 2, "checkpoint_dir": ckpt_dir,
         "checkpoint_freq": CKPT_FREQ, "guard_policy": "rollback",
     }
+    params.update(extra_params)
+    label = tag or "base"
 
     def run():
         return engine.train(
@@ -74,7 +83,10 @@ def main() -> int:
     shutil.rmtree(ckpt_dir, ignore_errors=True)
     clean = run()
     clean_text = clean.model_to_string()
-    print(f"[1/3] clean run: {clean.num_trees()} trees")
+    print(f"[{label} 1/3] clean run: {clean.num_trees()} trees")
+    if extra_params.get("linear_tree"):
+        assert "is_linear=1" in clean_text, \
+            "linear_tree run produced no linear leaves"
 
     # 2. faulted run: NaN at iter 10 (rollback), SIGTERM at iter 20
     shutil.rmtree(ckpt_dir)
@@ -84,7 +96,7 @@ def main() -> int:
     set_fault_plan(None)
     assert getattr(faulted, "preempted", False), \
         "SIGTERM fault did not preempt the run"
-    print(f"[2/3] faulted run preempted at iteration "
+    print(f"[{label} 2/3] faulted run preempted at iteration "
           f"{faulted._gbdt.iter} (NaN rolled back, SIGTERM handled)")
 
     # 3. resume to completion
@@ -92,27 +104,40 @@ def main() -> int:
     resumed_text = resumed.model_to_string()
     assert getattr(resumed, "resumed_iteration", None) is not None, \
         "resume=auto did not restore a checkpoint"
-    print(f"[3/3] resumed from iteration "
+    print(f"[{label} 3/3] resumed from iteration "
           f"{resumed.resumed_iteration}: {resumed.num_trees()} trees")
 
-    model_clean = os.path.join(workdir, "model_clean.txt")
-    model_resumed = os.path.join(workdir, "model_resumed.txt")
+    model_clean = os.path.join(workdir, f"model_clean{suffix}.txt")
+    model_resumed = os.path.join(workdir, f"model_resumed{suffix}.txt")
     with open(model_clean, "w") as fh:
         fh.write(clean_text)
     with open(model_resumed, "w") as fh:
         fh.write(resumed_text)
     assert resumed_text == clean_text, (
-        "FAIL: resumed model differs from the clean run "
+        f"FAIL[{label}]: resumed model differs from the clean run "
         f"(diff {model_clean} {model_resumed})")
-    print("PASS: resumed model is byte-identical to the clean run")
+    print(f"PASS[{label}]: resumed model is byte-identical to the "
+          "clean run")
+    return clean.num_trees()
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "fault_smoke_work"
+    os.makedirs(workdir, exist_ok=True)
+
+    from lightgbm_tpu.observability.telemetry import get_telemetry
+
+    for tag, extra in CONFIGS.items():
+        run_scenario(workdir, tag, extra)
 
     tel = get_telemetry()
     nonfinite = tel.counters.get("guard.nonfinite_iters", 0)
     rollbacks = tel.counters.get("guard.rollbacks", 0)
-    assert nonfinite >= 1, (
-        "guard.nonfinite_iters did not count the injected NaN "
+    assert nonfinite >= len(CONFIGS), (
+        "guard.nonfinite_iters did not count every injected NaN "
         f"(counters: {tel.counters})")
-    assert rollbacks >= 1, "guard.rollbacks did not count the restore"
+    assert rollbacks >= len(CONFIGS), \
+        "guard.rollbacks did not count every restore"
     print(f"PASS: telemetry counted guard.nonfinite_iters={nonfinite:g}"
           f" guard.rollbacks={rollbacks:g}")
     tel.flush()
