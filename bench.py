@@ -294,6 +294,46 @@ def write_probe_cache(ok: bool, detail: str) -> None:
         pass
 
 
+def emit_probe_telemetry(ok: bool, detail: str, dur_s: float,
+                         cached: bool, age_s=None) -> None:
+    """Record the TPU-probe verdict in the telemetry JSONL trace
+    (kind=probe + a probe.fail counter record on failure). Written
+    with stdlib file appends on purpose: the bench PARENT must never
+    import jax/lightgbm_tpu — a wedged tunnel would hang the
+    orchestrator itself (the exact failure mode the probe exists to
+    contain)."""
+    path = os.environ.get("LGBM_TPU_TELEMETRY", "").strip()
+    if not path:
+        return
+    recs = [{"kind": "probe", "t": 0.0, "verdict":
+             "ok" if ok else "failed", "reason": detail[:300],
+             "dur_s": round(float(dur_s), 3), "cached": bool(cached),
+             "cache_age_s": None if age_s is None
+             else round(float(age_s), 1), "wall_time": time.time()}]
+    if not ok:
+        recs.append({"kind": "counter", "t": 0.0, "name": "probe.fail",
+                     "value": 1})
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, "a") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+    except OSError as e:
+        sys.stderr.write(f"probe telemetry write failed: {e}\n")
+
+
+def probe_info_from_cache(cached) -> dict:
+    """Result-line fields for a cached probe verdict: the verdict, the
+    cache hit, the stored reason and the verdict's age — so a line
+    produced under a stale-ish verdict is diagnosable as such."""
+    age = time.time() - float(cached.get("ts", 0))
+    return {"tpu_probe": "ok" if cached.get("ok") else "failed",
+            "tpu_probe_cached": True,
+            "tpu_probe_detail": str(cached.get("detail", ""))[:160],
+            "tpu_probe_age_s": round(age, 1)}
+
+
 def find_result_line(stdout: str):
     """Locate and parse the last JSON result line in bench output
     (shared with tools/bench_sweep.py)."""
@@ -458,14 +498,18 @@ def main():
     cached = read_probe_cache()
     if cached is not None:
         tpu_ok = bool(cached.get("ok"))
-        probe_info = {"tpu_probe": "ok" if tpu_ok else "failed",
-                      "tpu_probe_cached": True}
+        probe_info = probe_info_from_cache(cached)
         sys.stderr.write(f"TPU probe: cached verdict "
                          f"{'ok' if tpu_ok else 'failed'} "
-                         f"({cached.get('detail', '')[:120]})\n")
+                         f"(age {probe_info['tpu_probe_age_s']:.0f}s, "
+                         f"{cached.get('detail', '')[:120]})\n")
+        emit_probe_telemetry(tpu_ok, str(cached.get("detail", "")),
+                             0.0, cached=True,
+                             age_s=probe_info["tpu_probe_age_s"])
     else:
         tpu_ok = False
         detail = ""
+        t_probe0 = time.monotonic()
         for probe_try in range(2):
             try:
                 probe = subprocess.run(
@@ -482,9 +526,12 @@ def main():
                 break
             sys.stderr.write(f"TPU probe attempt {probe_try + 1} "
                              f"failed/hung ({probe_timeout:.0f}s)\n")
+        probe_dur = time.monotonic() - t_probe0
         write_probe_cache(tpu_ok, detail)
         probe_info = {"tpu_probe": "ok" if tpu_ok else "failed",
-                      "tpu_probe_cached": False}
+                      "tpu_probe_cached": False,
+                      "tpu_probe_detail": detail.strip()[-160:]}
+        emit_probe_telemetry(tpu_ok, detail, probe_dur, cached=False)
     if not tpu_ok:
         sys.stderr.write("TPU probe negative; skipping TPU plan\n")
         plan = []

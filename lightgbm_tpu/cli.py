@@ -116,6 +116,10 @@ def run_train(params: Dict[str, str]) -> None:
     # (telemetry_out=<path.jsonl> CLI/config param or LGBM_TPU_TELEMETRY)
     from .observability.telemetry import get_telemetry
     get_telemetry().ensure_started(cfg)
+    # live metrics plane: metrics_port=<p> / LGBM_TPU_METRICS_PORT
+    # serves GET /metrics (Prometheus text) for the whole run
+    from .observability.metrics import maybe_start_exporter
+    maybe_start_exporter(cfg)
     if cfg.machines or cfg.machine_list_filename:
         from .parallel.distributed import init_distributed
         init_distributed(cfg)
@@ -241,6 +245,10 @@ def run_serve(params: Dict[str, str]) -> None:
     from .serving.http import serve_forever
     cfg = Config.from_params(params)
     get_telemetry().ensure_started(cfg)
+    # the frontend serves /metrics on its own port; metrics_port
+    # additionally exports on a dedicated port when configured
+    from .observability.metrics import maybe_start_exporter
+    maybe_start_exporter(cfg)
     if not cfg.input_model:
         log_fatal("task=serve requires input_model=<model file>")
     booster = Booster(model_file=cfg.input_model)

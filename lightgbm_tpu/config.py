@@ -127,6 +127,10 @@ _PARAM_ALIASES: Dict[str, str] = {
     "workers": "machines", "nodes": "machines",
     "telemetry": "telemetry_out", "telemetry_file": "telemetry_out",
     "telemetry_output": "telemetry_out",
+    "prometheus_port": "metrics_port",
+    "metrics_http_port": "metrics_port",
+    "crash_dump_path": "crash_dump",
+    "flight_recorder_path": "crash_dump",
     "compile_cache": "compile_cache_dir",
     "compilation_cache_dir": "compile_cache_dir",
     "serve_host": "serving_host",
@@ -301,6 +305,15 @@ class Config:
     # structured training telemetry (docs/Observability.md): path of a
     # JSONL trace; empty = disabled unless LGBM_TPU_TELEMETRY is set
     telemetry_out: str = ""
+    # live metrics plane (docs/Observability.md): >0 serves Prometheus
+    # text on GET http://<metrics_host>:<metrics_port>/metrics for the
+    # training CLI; 0 = off unless LGBM_TPU_METRICS_PORT is set. The
+    # serving frontend always mounts /metrics on its own port.
+    metrics_port: int = 0
+    metrics_host: str = "127.0.0.1"
+    # crash flight recorder dump path override; empty = derive
+    # <telemetry_out>.crash.json (or LGBM_TPU_CRASH_DUMP env)
+    crash_dump: str = ""
     # persistent XLA compilation cache directory (docs/Performance.md):
     # compiled executables are serialized there and reloaded by later
     # processes, so repeat runs skip the cold-compile bill. Empty =
@@ -543,6 +556,9 @@ class Config:
                 "off|raise|skip_iter|rollback")
         if self.resume not in ("auto", "off"):
             raise ValueError(f"resume={self.resume!r} is not auto|off")
+        if not (0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                f"metrics_port={self.metrics_port} is not a port")
         if self.checkpoint_freq > 0 and not self.checkpoint_dir:
             log_warning("checkpoint_freq is set without checkpoint_dir; "
                         "no checkpoints will be written")

@@ -166,6 +166,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
         or getattr(cfg_obj, "guard_policy", "off") != "off" \
         or fault_plan_active()
 
+    # crash flight recorder (observability/flightrec.py): armed when a
+    # dump path resolves (crash_dump param / LGBM_TPU_CRASH_DUMP /
+    # <telemetry_out>.crash.json). Guard trips dump via guards.py and
+    # SIGTERM via preempt.py; this loop owns the uncaught-exception and
+    # clean-preemption dumps. Disarmed (recorder cleared, dump files
+    # kept) when the run ends.
+    from .observability.flightrec import (arm_recorder, disarm_recorder,
+                                          dump_exception)
+    flightrec = arm_recorder(cfg_obj, booster._gbdt)
+
     # callback assembly (engine.py:186-204)
     callbacks = set(callbacks) if callbacks is not None else set()
     if verbose_eval is True:
@@ -201,7 +211,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
             and not (early_stopping_rounds or 0) > 0 \
             and not robust_active:
         # no per-iteration host interaction needed: pipelined fast path
-        booster._gbdt.train(booster._gbdt.iter + num_boost_round)
+        try:
+            booster._gbdt.train(booster._gbdt.iter + num_boost_round)
+        except BaseException as e:
+            if flightrec is not None:
+                dump_exception(e)
+            raise
+        finally:
+            disarm_recorder(flightrec)
         booster.best_iteration = -1
         return booster
 
@@ -366,9 +383,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         f"Training preempted after iteration {i}; "
                         f"checkpoint written to {ckpt.directory} — "
                         "rerun with resume=auto to continue")
+                    if flightrec is not None:
+                        # the complete post-checkpoint black box
+                        # atomically replaces the signal handler's
+                        # mid-iteration dump
+                        flightrec.dump(
+                            "preemption", iteration=i,
+                            checkpoint_dir=ckpt.directory,
+                            signum=preempt.signum)
                     break
             i += 1
+    except BaseException as e:
+        if flightrec is not None:
+            dump_exception(e)
+        raise
     finally:
+        disarm_recorder(flightrec)
         if preempt is not None:
             preempt.uninstall()
     if tel.enabled:

@@ -1,12 +1,21 @@
-"""Structured training telemetry (spans, counters, JSONL traces).
+"""Structured training telemetry + the live observability plane.
 
 See docs/Observability.md. Import surface:
 
   from lightgbm_tpu.observability import get_telemetry, telemetry_enabled
+  from lightgbm_tpu.observability import get_metrics, metrics_text
 """
 
+from .flightrec import (FlightRecorder, active_recorder, arm_recorder,
+                        disarm_recorder)
+from .metrics import (LogHistogram, MetricsRegistry, get_metrics,
+                      maybe_start_exporter, metrics_text,
+                      start_exporter, stop_exporter)
 from .telemetry import (JsonlSink, RingSink, Telemetry, get_telemetry,
                         telemetry_enabled)
 
 __all__ = ["Telemetry", "RingSink", "JsonlSink", "get_telemetry",
-           "telemetry_enabled"]
+           "telemetry_enabled", "MetricsRegistry", "LogHistogram",
+           "get_metrics", "metrics_text", "start_exporter",
+           "stop_exporter", "maybe_start_exporter", "FlightRecorder",
+           "arm_recorder", "disarm_recorder", "active_recorder"]

@@ -1,0 +1,412 @@
+"""Live metrics plane: Prometheus-text aggregation over the telemetry.
+
+PR 1's :class:`~lightgbm_tpu.observability.telemetry.Telemetry` is
+post-hoc — counters and records surface only in the JSONL trace after
+the run. This module makes the same state (plus new log-bucketed
+latency/phase histograms and scrape-time collectors) continuously
+queryable:
+
+  * :class:`LogHistogram` — geometric-bucket histogram whose p50/p95/
+    p99 are derivable from the buckets alone (no raw-sample storage),
+    fed by the serving engine (per-bucket request latency) and the
+    training loop (per-iteration phase wall times);
+  * :class:`MetricsRegistry` — one process-wide registry
+    (``get_metrics()``) holding the histograms, scrape-time gauge
+    **collectors** (serving queue depth / shed / timeout counts,
+    ``memory_snapshot()`` device-memory gauges), and a renderer for
+    the Prometheus text exposition format (version 0.0.4);
+  * an **exporter** — a stdlib HTTP thread serving ``GET /metrics``
+    for the training CLI (``metrics_port`` config param or
+    ``LGBM_TPU_METRICS_PORT``); the serving frontend mounts the same
+    renderer on its own ``GET /metrics`` route.
+
+Scrape cost model: rendering reads host-side Python state only — no
+device dispatches and **no implicit device->host transfers** are ever
+issued by a scrape (``memory_snapshot`` reads array metadata and
+allocator stats, never array contents), so scraping a serving process
+cannot perturb its zero-steady-state-recompile guarantee. Asserted by
+``tests/test_observability_plane.py`` under
+``no_implicit_host_transfers()``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.log import log_info, log_warning
+from .telemetry import get_telemetry, memory_snapshot
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# per-metric bucket layouts: (start, factor, count). The factor-sqrt(2)
+# geometric ladder bounds the within-bucket quantile error at ~41%
+# worst-case before interpolation; with the linear interpolation in
+# LogHistogram.quantile the derived p50/p95/p99 land inside the true
+# value's bucket (asserted by tests).
+_HIST_LAYOUTS: Dict[str, Tuple[float, float, int]] = {
+    # serving request latency, milliseconds: 0.05 ms .. ~1.6e6 ms
+    "serving_request_latency_ms": (0.05, 2.0 ** 0.5, 50),
+    # per-iteration phase wall time, seconds: 0.1 ms .. ~100 s
+    "train_phase_seconds": (1e-4, 2.0 ** 0.5, 40),
+}
+_DEFAULT_LAYOUT = (0.001, 2.0 ** 0.5, 60)
+
+Labels = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Optional[Dict[str, Any]]) -> Labels:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class LogHistogram:
+    """Geometric-bucket histogram: fixed memory, derivable quantiles.
+
+    ``bounds[i]`` is the inclusive upper edge of bucket ``i``; one
+    overflow bucket catches everything past the last edge. Negative
+    and zero observations land in the first bucket (latencies and
+    durations; there is no use for a negative edge here).
+    """
+
+    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
+
+    def __init__(self, start: float, factor: float, n: int):
+        b, bounds = float(start), []
+        for _ in range(n):
+            bounds.append(b)
+            b *= factor
+        self.bounds: List[float] = bounds
+        self.counts: List[int] = [0] * (n + 1)   # + overflow
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """q in [0, 1]; linear interpolation inside the target bucket.
+        None when empty. The overflow bucket reports its lower edge."""
+        with self._lock:
+            counts = list(self.counts)
+            total = self.count
+        if total <= 0:
+            return None
+        target = q * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if c and seen + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else None
+                if hi is None:
+                    return lo
+                frac = (target - seen) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.bounds[-1]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = list(self.counts)
+            total, s = self.count, self.sum
+        out = {"bounds": [round(b, 9) for b in self.bounds],
+               "counts": counts, "count": total,
+               "sum": round(s, 6)}
+        for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = self.quantile(q)
+            out[name] = None if v is None else round(v, 4)
+        return out
+
+
+# ---------------------------------------------------------------------
+# Prometheus text helpers
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str, prefix: str = "lgbm_") -> str:
+    n = _NAME_BAD.sub("_", str(name))
+    if not re.match(r"[a-zA-Z_:]", n):
+        n = "_" + n
+    return prefix + n if not n.startswith(prefix) else n
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _label_str(labels: Labels, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class MetricsRegistry:
+    """Process-wide aggregation point; see module docstring."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: Dict[Tuple[str, Labels], LogHistogram] = {}
+        # collectors: scrape-time callables returning {name: value};
+        # each is tied to an owner via weakref and pruned when the
+        # owner is collected. Same-name values from live collectors
+        # are SUMMED (several serving engines in one process = one
+        # process-level total).
+        self._collectors: List[Tuple[Any, Callable[[], Dict]]] = []
+        self.include_memory = True
+
+    # -- histograms ----------------------------------------------------
+    def hist(self, name: str,
+             labels: Optional[Dict[str, Any]] = None) -> LogHistogram:
+        key = (str(name), _labels_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                start, factor, n = _HIST_LAYOUTS.get(
+                    str(name), _DEFAULT_LAYOUT)
+                h = LogHistogram(start, factor, n)
+                self._hists[key] = h
+        return h
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, Any]] = None) -> None:
+        self.hist(name, labels).observe(value)
+
+    def snapshots(self, prefix: str = "") -> List[Dict[str, Any]]:
+        """Histogram snapshots (for ``hist`` telemetry records and the
+        flight recorder), optionally filtered by name prefix."""
+        with self._lock:
+            items = list(self._hists.items())
+        out = []
+        for (name, labels), h in sorted(items):
+            if prefix and not name.startswith(prefix):
+                continue
+            snap = h.snapshot()
+            if not snap["count"]:
+                continue
+            snap["name"] = name
+            snap["labels"] = dict(labels)
+            out.append(snap)
+        return out
+
+    # -- collectors ----------------------------------------------------
+    def register_collector(self, fn: Callable[[], Dict],
+                           owner: Any = None) -> None:
+        ref = weakref.ref(owner) if owner is not None else None
+        with self._lock:
+            self._collectors.append((ref, fn))
+
+    def _collect(self) -> Dict[str, float]:
+        with self._lock:
+            collectors = list(self._collectors)
+        out: Dict[str, float] = {}
+        dead = []
+        for ref, fn in collectors:
+            if ref is not None and ref() is None:
+                dead.append((ref, fn))
+                continue
+            try:
+                for k, v in (fn() or {}).items():
+                    try:
+                        out[k] = out.get(k, 0.0) + float(v)
+                    except (TypeError, ValueError):
+                        continue
+            except Exception as e:  # a collector must never kill a scrape
+                log_warning(f"metrics collector failed: {e}")
+        if dead:
+            with self._lock:
+                self._collectors = [c for c in self._collectors
+                                    if c not in dead]
+        return out
+
+    # -- rendering -----------------------------------------------------
+    def render(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of everything:
+        telemetry counters/gauges/dists, collector gauges, memory
+        snapshot gauges and the histograms."""
+        tel = get_telemetry()
+        L: List[str] = []
+
+        with tel._lock:
+            counters = dict(tel.counters)
+            gauges = dict(tel.gauges)
+            dists = {k: list(v) for k, v in tel.dists.items()}
+
+        for name in sorted(counters):
+            mn = _metric_name(name) + "_total"
+            L.append(f"# HELP {mn} telemetry counter {name}")
+            L.append(f"# TYPE {mn} counter")
+            L.append(f"{mn} {_fmt(counters[name])}")
+
+        numeric_gauges: Dict[str, float] = {}
+        for name, v in gauges.items():
+            try:
+                numeric_gauges[_metric_name(name)] = float(v)
+            except (TypeError, ValueError):
+                continue
+        for name, v in self._collect().items():
+            numeric_gauges[_metric_name(name)] = v
+        if self.include_memory:
+            for name, v in memory_snapshot().items():
+                try:
+                    numeric_gauges[_metric_name(name)] = float(v)
+                except (TypeError, ValueError):
+                    continue
+        for mn in sorted(numeric_gauges):
+            L.append(f"# HELP {mn} gauge")
+            L.append(f"# TYPE {mn} gauge")
+            L.append(f"{mn} {_fmt(numeric_gauges[mn])}")
+
+        for name in sorted(dists):
+            n, s, mn_v, mx_v = dists[name]
+            base = _metric_name(name)
+            L.append(f"# HELP {base} telemetry distribution {name}")
+            L.append(f"# TYPE {base} summary")
+            L.append(f"{base}_count {_fmt(n)}")
+            L.append(f"{base}_sum {_fmt(s)}")
+            for suffix, v in (("_min", mn_v), ("_max", mx_v)):
+                g = base + suffix
+                L.append(f"# HELP {g} gauge")
+                L.append(f"# TYPE {g} gauge")
+                L.append(f"{g} {_fmt(v)}")
+
+        with self._lock:
+            hist_items = sorted(self._hists.items())
+        typed: set = set()
+        for (name, labels), h in hist_items:
+            base = _metric_name(name)
+            if base not in typed:
+                typed.add(base)
+                L.append(f"# HELP {base} log-bucketed histogram {name}")
+                L.append(f"# TYPE {base} histogram")
+            with h._lock:
+                counts = list(h.counts)
+                total, s = h.count, h.sum
+            cum = 0
+            for i, edge in enumerate(h.bounds):
+                cum += counts[i]
+                le = _label_str(labels, f'le="{repr(float(edge))}"')
+                L.append(f"{base}_bucket{le} {cum}")
+            cum += counts[-1]
+            le = _label_str(labels, 'le="+Inf"')
+            L.append(f"{base}_bucket{le} {cum}")
+            ls = _label_str(labels)
+            L.append(f"{base}_sum{ls} {_fmt(s)}")
+            L.append(f"{base}_count{ls} {total}")
+        return "\n".join(L) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+            self._collectors.clear()
+            self.include_memory = True
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_metrics() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def metrics_text() -> str:
+    return _REGISTRY.render()
+
+
+# ---------------------------------------------------------------------
+# exporter: GET /metrics for processes without a serving frontend
+class _MetricsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] != "/metrics":
+            body = b"not found; scrape /metrics\n"
+            self.send_response(404)
+        else:
+            try:
+                body = metrics_text().encode("utf-8")
+                self.send_response(200)
+            except Exception as e:  # defensive: scrape must answer
+                body = f"# metrics render failed: {e}\n".encode()
+                self.send_response(500)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+        pass
+
+
+_EXPORTER: List[Optional[ThreadingHTTPServer]] = [None]
+
+
+def start_exporter(port: int,
+                   host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Start the /metrics exporter thread; ``port=0`` binds an
+    ephemeral port (``server.server_address`` has the real one).
+    Idempotent per process: a running exporter is returned as-is."""
+    if _EXPORTER[0] is not None:
+        return _EXPORTER[0]
+    server = ThreadingHTTPServer((host, int(port)), _MetricsHandler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="lgbm-metrics-exporter", daemon=True)
+    thread.start()
+    _EXPORTER[0] = server
+    addr = server.server_address
+    log_info(f"metrics exporter on http://{addr[0]}:{addr[1]}/metrics")
+    return server
+
+
+def stop_exporter() -> None:
+    server = _EXPORTER[0]
+    _EXPORTER[0] = None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+
+
+def maybe_start_exporter(config=None) -> Optional[ThreadingHTTPServer]:
+    """Config/env-driven exporter startup (the training-CLI opt-in):
+    ``metrics_port`` config param, else ``LGBM_TPU_METRICS_PORT``.
+    0/unset = off. Also enables ring-only telemetry so counters and
+    phase histograms exist without a JSONL opt-in."""
+    import os
+    port = int(getattr(config, "metrics_port", 0) or 0)
+    host = str(getattr(config, "metrics_host", "") or "127.0.0.1")
+    if port <= 0:
+        env = os.environ.get("LGBM_TPU_METRICS_PORT", "").strip()
+        if not env:
+            return None
+        try:
+            port = int(env)
+        except ValueError:
+            log_warning(f"LGBM_TPU_METRICS_PORT={env!r} is not a port")
+            return None
+        if port <= 0:
+            return None
+    get_telemetry().ensure_ring()
+    try:
+        return start_exporter(port, host)
+    except OSError as e:
+        log_warning(f"metrics exporter failed to bind port {port}: {e}")
+        return None

@@ -31,8 +31,10 @@ iteration boundaries are already materialized by the caller.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -78,8 +80,20 @@ class RingSink:
         pass
 
 
+# record kinds worth an immediate file flush: run/summary boundaries
+# are rare and losing them to buffer timing makes short CLI runs and
+# preempted runs undiagnosable
+_FLUSH_KINDS = ("run_start", "train_end", "serving_stats", "probe")
+
+
 class JsonlSink:
-    """Append-mode JSONL file sink; one record per line."""
+    """Append-mode JSONL file sink; one record per line.
+
+    Trailing-record durability: boundary records (``_FLUSH_KINDS``)
+    flush immediately, and the module registers ONE process-wide
+    ``atexit`` flush (plus the preemption handler's signal-time flush,
+    robustness/preempt.py) so short CLI runs and preempted runs no
+    longer lose whatever happened to sit in the stdio buffer."""
 
     def __init__(self, path: str):
         self.path = path
@@ -96,6 +110,8 @@ class JsonlSink:
         try:
             self._ensure().write(json.dumps(rec, default=_json_default)
                                  + "\n")
+            if rec.get("kind") in _FLUSH_KINDS:
+                self._fh.flush()
         except OSError as e:  # telemetry must never kill training
             log_warning(f"telemetry sink write failed: {e}")
 
@@ -208,12 +224,13 @@ class _Span:
         if self._path is not None and tel._enabled:
             if tel._stack and tel._stack[-1] == self.name:
                 tel._stack.pop()
-            acc = tel.spans.setdefault(self._path, [0.0, 0])
-            acc[0] += dur
-            acc[1] += 1
-            if self.phase:
-                tel._iter_phases[self.name] = \
-                    tel._iter_phases.get(self.name, 0.0) + dur
+            with tel._lock:
+                acc = tel.spans.setdefault(self._path, [0.0, 0])
+                acc[0] += dur
+                acc[1] += 1
+                if self.phase:
+                    tel._iter_phases[self.name] = \
+                        tel._iter_phases.get(self.name, 0.0) + dur
         return False
 
 
@@ -222,6 +239,11 @@ class Telemetry:
 
     def __init__(self):
         self._enabled = False
+        # serving's flusher + worker threads and the jax.monitoring
+        # compile listener mutate the counter/gauge/dist dicts
+        # concurrently with the training thread; one process-wide lock
+        # keeps the read-modify-write increments from losing updates
+        self._lock = threading.Lock()
         self._sinks: list = []
         self._ring: Optional[RingSink] = None
         self._stack: List[str] = []
@@ -256,6 +278,7 @@ class Telemetry:
         self._enabled = True
         self._t0 = time.perf_counter()
         self._install_compile_listener()
+        _install_atexit_flush()
         return self
 
     def ensure_started(self, config=None) -> None:
@@ -315,8 +338,9 @@ class Telemetry:
     # -- metrics -------------------------------------------------------
     def count(self, name: str, value: float = 1.0) -> None:
         if self._enabled:
-            self.counters[name] = self.counters.get(name, 0.0) \
-                + float(value)
+            v = float(value)
+            with self._lock:
+                self.counters[name] = self.counters.get(name, 0.0) + v
 
     def count_iter(self, name: str, value: float = 1.0) -> None:
         """Counter that ALSO accumulates into the current iteration's
@@ -329,25 +353,28 @@ class Telemetry:
         the loop stops issuing simply stops being counted."""
         if self._enabled:
             v = float(value)
-            self.counters[name] = self.counters.get(name, 0.0) + v
-            self._iter_counts[name] = \
-                self._iter_counts.get(name, 0.0) + v
+            with self._lock:
+                self.counters[name] = self.counters.get(name, 0.0) + v
+                self._iter_counts[name] = \
+                    self._iter_counts.get(name, 0.0) + v
 
     def gauge(self, name: str, value) -> None:
         if self._enabled:
-            self.gauges[name] = value
+            with self._lock:
+                self.gauges[name] = value
 
     def observe(self, name: str, value: float) -> None:
         if self._enabled:
             v = float(value)
-            d = self.dists.get(name)
-            if d is None:
-                self.dists[name] = [1, v, v, v]
-            else:
-                d[0] += 1
-                d[1] += v
-                d[2] = min(d[2], v)
-                d[3] = max(d[3], v)
+            with self._lock:
+                d = self.dists.get(name)
+                if d is None:
+                    self.dists[name] = [1, v, v, v]
+                else:
+                    d[0] += 1
+                    d[1] += v
+                    d[2] = min(d[2], v)
+                    d[3] = max(d[3], v)
 
     # -- records -------------------------------------------------------
     def record(self, kind: str, **fields) -> None:
@@ -367,10 +394,24 @@ class Telemetry:
         already be host values (no device syncs are issued here)."""
         if not self._enabled:
             return
-        phases = {k: round(v, 6) for k, v in self._iter_phases.items()}
-        self._iter_phases = {}
-        counts = {k: v for k, v in self._iter_counts.items()}
-        self._iter_counts = {}
+        with self._lock:
+            phases = {k: round(v, 6)
+                      for k, v in self._iter_phases.items()}
+            self._iter_phases = {}
+            counts = {k: v for k, v in self._iter_counts.items()}
+            self._iter_counts = {}
+        # feed the live metrics plane (observability/metrics.py): the
+        # per-iteration phase wall times become the
+        # train_phase_seconds{phase=...} histogram a /metrics scrape
+        # can derive p50/p95/p99 from
+        try:
+            from .metrics import get_metrics
+            reg = get_metrics()
+            for name, dur in phases.items():
+                reg.observe("train_phase_seconds", dur,
+                            labels={"phase": name})
+        except Exception:  # metrics must never kill an iteration
+            pass
         rec = dict(iter=int(iteration), phases=phases, **fields)
         if counts:
             rec["counts"] = counts
@@ -479,6 +520,26 @@ def traced_bytes(tree) -> int:
 
 _TELEMETRY = Telemetry()
 _LISTENER_INSTALLED = [False]
+_ATEXIT_INSTALLED = [False]
+
+
+def _atexit_flush() -> None:
+    """Interpreter-exit flush of the singleton's sinks, so a short CLI
+    run never loses trailing records to buffer timing. Also invoked
+    from the preemption signal handler (flush() is async-signal-safe
+    enough: pure-Python file flushes, no locks held across it)."""
+    tel = _TELEMETRY
+    if tel._enabled:
+        try:
+            tel.flush()
+        except Exception:  # interpreter may be tearing down
+            pass
+
+
+def _install_atexit_flush() -> None:
+    if not _ATEXIT_INSTALLED[0]:
+        _ATEXIT_INSTALLED[0] = True
+        atexit.register(_atexit_flush)
 
 
 def _install_compile_listener() -> None:

@@ -56,6 +56,13 @@ class NonFiniteGradientError(LightGBMError):
         self.iteration = iteration
         self.policy = policy
         self.what = what
+        # black box first, handling second: even a trip a rollback
+        # recovers from dumps the faulting iteration's ring records
+        # before they age out (observability/flightrec.py; no-op when
+        # no recorder is armed)
+        from ..observability.flightrec import record_guard_trip
+        record_guard_trip("nonfinite", iteration, policy=policy,
+                          what=what)
 
 
 class LossSpikeError(LightGBMError):
@@ -132,4 +139,8 @@ class LossSpikeDetector:
             log_warning(f"guard: loss spike at iteration {iteration}: "
                         f"{ds} {metric} = {v:g} (previous {prev:g}, "
                         f"factor {self.factor:g})")
+            from ..observability.flightrec import record_guard_trip
+            record_guard_trip("loss_spike", iteration, dataset=ds,
+                              metric=metric, value=v, prev=prev,
+                              factor=self.factor)
         return spike

@@ -50,6 +50,13 @@ class PreemptionGuard:
         log_info(f"preemption: caught signal {signum}; finishing the "
                  "in-flight iteration, then checkpointing and "
                  "shutting down (send again to force)")
+        # signal-time durability: dump the flight-recorder black box
+        # (the loop may never reach its clean-shutdown path if a
+        # dispatch hangs) and flush the JSONL sinks so the trace holds
+        # everything recorded so far
+        from ..observability.flightrec import notify_signal
+        notify_signal(signum)
+        get_telemetry().flush()
 
     def install(self) -> "PreemptionGuard":
         if threading.current_thread() is not threading.main_thread():

@@ -33,11 +33,13 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.metrics import get_metrics
 from ..observability.telemetry import get_telemetry
 from ..utils.log import log_info, log_warning
 from .errors import (EngineStoppedError, InvalidRequestError,
@@ -185,6 +187,26 @@ class ServingEngine:
         self._bucket_seen = set()           # (version, bucket)
         self._queue_peak = 0
         self._last_reload_error: Optional[Dict[str, Any]] = None
+        # live metrics plane (observability/metrics.py): request
+        # latency lands in the per-(kind, bucket) log histogram and a
+        # scrape-time collector exposes the counters + queue depth as
+        # gauges on GET /metrics. The collector holds only a weakref —
+        # a dropped engine unregisters itself.
+        self._metrics = get_metrics()
+        ref = weakref.ref(self)
+
+        def _collect() -> Dict[str, float]:
+            eng = ref()
+            if eng is None:
+                return {}
+            with eng._stats_lock:
+                out = {f"serving_{k}": v
+                       for k, v in eng._counts.items()}
+                out["serving_queue_peak"] = eng._queue_peak
+            out["serving_queue_depth"] = eng.queue_depth
+            return out
+
+        self._metrics.register_collector(_collect, owner=self)
         if source is not None:
             self.load(source)
 
@@ -290,6 +312,11 @@ class ServingEngine:
         tel = get_telemetry()
         if tel.enabled:
             tel.record("serving_stats", **self.stats())
+            # histogram snapshots ride the trace as ``hist`` records so
+            # tools/run_report.py can render offline what a /metrics
+            # scrape would have shown live
+            for snap in self._metrics.snapshots(prefix="serving_"):
+                tel.record("hist", **snap)
             tel.flush()
 
     def __enter__(self) -> "ServingEngine":
@@ -389,7 +416,8 @@ class ServingEngine:
             out = self._compute_safe(mv, arr, kind, route)
         self._count("requests")
         self._count("rows", len(arr))
-        self._observe_latency((time.monotonic() - t0) * 1000.0)
+        self._observe_latency((time.monotonic() - t0) * 1000.0,
+                              kind=kind, rows=len(arr))
         return out
 
     # -- flusher -------------------------------------------------------
@@ -476,7 +504,7 @@ class ServingEngine:
             lat = (done_t - r.t_enqueue) * 1000.0
             r.meta.update(version=mv.version, route=route, kind=kind,
                           batch_rows=len(x), latency_ms=round(lat, 3))
-            self._observe_latency(lat)
+            self._observe_latency(lat, kind=kind, rows=n)
             r.event.set()
 
     # -- routing & compute ---------------------------------------------
@@ -556,13 +584,20 @@ class ServingEngine:
             self._counts[name] = self._counts.get(name, 0.0) + value
         get_telemetry().count(f"serving.{name}", value)
 
-    def _observe_latency(self, ms: float) -> None:
+    def _observe_latency(self, ms: float, kind: str = "predict",
+                         rows: int = 0) -> None:
         with self._stats_lock:
             if len(self._latencies) >= self._latency_cap:
                 # reservoir half-drop keeps recent traffic dominant
                 del self._latencies[:self._latency_cap // 2]
             self._latencies.append(ms)
         get_telemetry().observe("serving.latency_ms", ms)
+        # per-bucket request latency histogram: the bucket label is the
+        # pow2 shape bucket the request's row count maps to, so a
+        # /metrics scrape can read p50/p95/p99 per compiled shape
+        b = bucket_for(max(int(rows), 1), self.config.buckets)
+        self._metrics.observe("serving_request_latency_ms", ms,
+                              labels={"kind": kind, "bucket": b})
 
     @property
     def queue_depth(self) -> int:

@@ -11,6 +11,9 @@ Endpoints (all JSON):
 * ``POST /pred_leaf``  same body, per-tree leaf indices
 * ``GET  /health``     engine + model-version status
 * ``GET  /stats``      counter/latency snapshot
+* ``GET  /metrics``    Prometheus text exposition (the live metrics
+  plane, docs/Observability.md: serving latency histograms, queue
+  depth, shed/timeout counters, device-memory gauges)
 * ``POST /reload``     ``{"model_file": path}`` or ``{"model_str": txt}``
 
 Errors are structured (``{"error": code, "message": ...}``) with the
@@ -60,6 +63,15 @@ class ServingHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through our logger
         pass
 
+    def _send_metrics(self) -> None:
+        from ..observability.metrics import CONTENT_TYPE, metrics_text
+        body = metrics_text().encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     # -- routes --------------------------------------------------------
     def do_GET(self):
         try:
@@ -67,6 +79,8 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self._send_json(200, self.engine.health())
             elif self.path == "/stats":
                 self._send_json(200, self.engine.stats())
+            elif self.path == "/metrics":
+                self._send_metrics()
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": self.path})

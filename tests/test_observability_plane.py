@@ -1,0 +1,588 @@
+"""Live observability plane (ISSUE 7): metrics, flight recorder, trend.
+
+Acceptance gates:
+  * ``GET /metrics`` returns valid Prometheus text exposition
+    (grammar-checked below) including the serving latency histogram
+    with p50/p95/p99-derivable buckets, and scrape load causes ZERO
+    steady-state recompiles and no implicit device->host transfers;
+  * the fault drill (nan_grad under rollback + sigterm preemption via
+    the PR 4 harness) produces an atomic flight-recorder dump carrying
+    the faulting iteration's records, counter totals and the config
+    fingerprint;
+  * ``tools/bench_trend.py`` exits 0 on the committed BENCH_r01..r05
+    series and nonzero on a synthetic >20% fixed-baseline regression.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability.flightrec import (arm_recorder,
+                                                  disarm_recorder,
+                                                  resolve_dump_path)
+from lightgbm_tpu.observability.metrics import (LogHistogram,
+                                                get_metrics,
+                                                maybe_start_exporter,
+                                                metrics_text,
+                                                start_exporter,
+                                                stop_exporter)
+from lightgbm_tpu.observability.telemetry import get_telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def tel():
+    t = get_telemetry()
+    t.reset()
+    get_metrics().reset()
+    yield t
+    t.reset()
+    get_metrics().reset()
+    stop_exporter()
+
+
+def _toy(n=500, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def model():
+    X, y = _toy()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    return bst, X
+
+
+# ---------------------------------------------------------------------
+# Prometheus text-format grammar checker (exposition format 0.0.4)
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{({_LABEL}(?:,{_LABEL})*)?\}})? "
+    r"([-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf)|NaN)"
+    r"( [0-9]+)?$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def validate_prometheus(text):
+    """Assert every line of ``text`` is grammatical; returns
+    {sample_name: value} (last value per name+labels wins) and the
+    {name: type} table."""
+    samples = {}
+    types = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.split("\n"):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert re.fullmatch(_NAME, name), line
+        elif line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4 and parts[3] in _TYPES, line
+            assert re.fullmatch(_NAME, parts[2]), line
+            assert parts[2] not in types, f"duplicate TYPE: {line}"
+            types[parts[2]] = parts[3]
+        elif line.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"bad sample line: {line!r}"
+            samples[(m.group(1), m.group(2) or "")] = float(
+                m.group(3).replace("Inf", "inf"))
+    # every sample belongs to a declared metric family
+    for (name, _labels) in samples:
+        base = re.sub(r"_(bucket|sum|count|min|max|total)$", "", name)
+        assert name in types or base in types \
+            or name.removesuffix("_total") in types, \
+            f"sample {name} has no TYPE declaration"
+    return samples, types
+
+
+def _hist_series(samples, base):
+    """{labels_without_le: [(le, cum_count), ...]} for one histogram."""
+    out = {}
+    for (name, labels), v in samples.items():
+        if name != f"{base}_bucket":
+            continue
+        pairs = dict(p.split("=", 1) for p in labels.split(",")) \
+            if labels else {}
+        le = pairs.pop("le").strip('"')
+        key = tuple(sorted(pairs.items()))
+        out.setdefault(key, []).append(
+            (float("inf") if le == "+Inf" else float(le), v))
+    for series in out.values():
+        series.sort()
+    return out
+
+
+# ---------------------------------------------------------------------
+def test_log_histogram_quantiles_derivable():
+    h = LogHistogram(start=0.05, factor=2 ** 0.5, n=50)
+    rng = np.random.RandomState(0)
+    vals = rng.lognormal(mean=2.0, sigma=0.8, size=2000)
+    for v in vals:
+        h.observe(v)
+    assert h.count == 2000
+    assert h.sum == pytest.approx(float(vals.sum()), rel=1e-9)
+    for q in (0.5, 0.95, 0.99):
+        est = h.quantile(q)
+        true = float(np.percentile(vals, q * 100))
+        # the estimate must land within one geometric bucket of truth
+        assert true / 2 ** 0.5 <= est <= true * 2 ** 0.5, \
+            (q, est, true)
+    assert LogHistogram(1.0, 2.0, 4).quantile(0.5) is None  # empty
+
+
+def test_counters_and_observe_are_thread_safe(tel):
+    tel.configure(summary=False)
+    n_threads, n_iter = 8, 500
+
+    def worker():
+        for _ in range(n_iter):
+            tel.count("t.count", 1)
+            tel.count_iter("t.iter", 1)
+            tel.observe("t.obs", 1.0)
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = n_threads * n_iter
+    # without the lock these read-modify-writes lose updates
+    assert tel.counters["t.count"] == total
+    assert tel.counters["t.iter"] == total
+    assert tel.dists["t.obs"][0] == total
+    assert tel.dists["t.obs"][1] == pytest.approx(float(total))
+
+
+def test_jsonl_sink_flushes_boundary_records(tel, tmp_path):
+    """run_start/train_end flush immediately — a reader (or a crash)
+    right after the record sees it on disk without an explicit
+    flush()."""
+    path = str(tmp_path / "t.jsonl")
+    tel.configure(jsonl_path=path, summary=False)
+    tel.record("iter", iter=0)          # buffered is fine
+    tel.record("train_end", iters=1)    # boundary: must hit the disk
+    with open(path) as fh:
+        kinds = [json.loads(ln)["kind"] for ln in fh if ln.strip()]
+    assert "train_end" in kinds
+    # the atexit hook is installed exactly once
+    from lightgbm_tpu.observability import telemetry as tmod
+    assert tmod._ATEXIT_INSTALLED[0]
+
+
+# ---------------------------------------------------------------------
+def test_metrics_render_is_valid_prometheus(tel):
+    from lightgbm_tpu.serving import ServingConfig, ServingEngine
+    tel.ensure_ring()
+    X, y = _toy(400)
+    # stepped loop (valid set) -> end_iteration feeds the
+    # train_phase_seconds histogram
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "metric": "binary_logloss"},
+                    lgb.Dataset(X, label=y), num_boost_round=3,
+                    valid_sets=[lgb.Dataset(X[:80], label=y[:80])],
+                    verbose_eval=False)
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(4, 16), flush_interval_ms=1.0))
+    try:
+        for n in (1, 5, 16):
+            eng.predict(X[:n])
+            eng.predict(X[:n], kind="raw_score")
+        text = metrics_text()
+    finally:
+        eng.stop()
+    samples, types = validate_prometheus(text)
+    assert types["lgbm_serving_request_latency_ms"] == "histogram"
+    assert types["lgbm_train_phase_seconds"] == "histogram"
+    assert any(n == "lgbm_serving_queue_depth" for n, _l in samples)
+    assert any(n == "lgbm_serving_requests" for n, _l in samples)
+    # histogram buckets: cumulative, +Inf-terminated, count-consistent
+    series = _hist_series(samples, "lgbm_serving_request_latency_ms")
+    assert series, "no serving latency buckets rendered"
+    for key, pairs in series.items():
+        les = [le for le, _ in pairs]
+        cums = [c for _, c in pairs]
+        assert les[-1] == float("inf")
+        assert cums == sorted(cums), (key, cums)
+        labels = dict(key)
+        assert "bucket" in labels and "kind" in labels
+        count_key = ("lgbm_serving_request_latency_ms_count",
+                     ",".join(f"{k}={v}" for k, v in key))
+        assert samples[count_key] == cums[-1]
+
+
+def test_metrics_endpoint_under_load_zero_recompiles(tel, model,
+                                                     monkeypatch):
+    """Scrape ``GET /metrics`` on the serving frontend DURING a loadgen
+    burst: every scrape is grammatical, steady-state traffic plus
+    scraping triggers zero new XLA compiles, and rendering issues no
+    implicit device->host transfer."""
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    from lightgbm_tpu.serving import ServingConfig, ServingEngine
+    from lightgbm_tpu.serving.http import make_http_server
+    from lightgbm_tpu.serving.loadgen import closed_loop
+    tel.ensure_ring()
+    bst, X = model
+    eng = ServingEngine(bst, config=ServingConfig(
+        buckets=(1, 8, 64), device="always", flush_interval_ms=0.5))
+    server = make_http_server(eng, "127.0.0.1", 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        # absorb warmup + first dispatches, then pin the compile count
+        for n in (1, 7, 64):
+            eng.predict(X[:n])
+        compiles0 = tel.counters.get("jit.compiles", 0)
+
+        scrapes = []
+        stop = [False]
+
+        def scraper():
+            while not stop[0]:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=30) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "text/plain")
+                    scrapes.append(r.read().decode())
+        st = threading.Thread(target=scraper, daemon=True)
+        st.start()
+        block = closed_loop(eng, X, batch_sizes=(1, 7, 64), threads=2,
+                            duration_s=0.6)
+        stop[0] = True
+        st.join(10.0)
+        assert block["requests"] > 0 and block["errors"] == 0
+        assert len(scrapes) >= 2, "burst finished with <2 scrapes"
+        for text in (scrapes[0], scrapes[-1]):
+            samples, _types = validate_prometheus(text)
+        assert tel.counters.get("jit.compiles", 0) == compiles0, \
+            "scraping a serving process recompiled something"
+
+        # the render itself must not fetch device data implicitly
+        from tools.graftlint.runtime import no_implicit_host_transfers
+        with no_implicit_host_transfers():
+            text = metrics_text()
+        samples, _types = validate_prometheus(text)
+        # p50/p95/p99 are derivable from the live registry
+        h = get_metrics().hist("serving_request_latency_ms",
+                               {"kind": "predict", "bucket": 1})
+        assert h.count > 0 and h.quantile(0.99) is not None
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.stop()
+
+
+def test_exporter_serves_metrics(tel):
+    tel.ensure_ring()
+    tel.count("exporter.test", 3)
+    server = start_exporter(0)
+    port = server.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        samples, _ = validate_prometheus(text)
+        assert samples[("lgbm_exporter_test_total", "")] == 3.0
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=30)
+    finally:
+        stop_exporter()
+
+
+def test_maybe_start_exporter_config_and_env(tel, monkeypatch):
+    from lightgbm_tpu.config import Config
+    monkeypatch.delenv("LGBM_TPU_METRICS_PORT", raising=False)
+    assert maybe_start_exporter(Config.from_params({})) is None
+    monkeypatch.setenv("LGBM_TPU_METRICS_PORT", "not-a-port")
+    assert maybe_start_exporter(None) is None
+    with pytest.raises(ValueError):
+        Config.from_params({"metrics_port": 99999})
+
+
+# ---------------------------------------------------------------------
+# crash flight recorder
+def _drill_params(tmp_path, **extra):
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "metric": "binary_logloss",
+         "checkpoint_dir": str(tmp_path / "ckpts"),
+         "checkpoint_freq": 3, "guard_policy": "rollback",
+         "telemetry_out": str(tmp_path / "trace.jsonl")}
+    p.update(extra)
+    return p
+
+
+def test_flightrec_dump_path_resolution(tmp_path, monkeypatch):
+    from lightgbm_tpu.config import Config
+    monkeypatch.delenv("LGBM_TPU_CRASH_DUMP", raising=False)
+    monkeypatch.delenv("LGBM_TPU_TELEMETRY", raising=False)
+    assert resolve_dump_path(Config.from_params({})) is None
+    cfg = Config.from_params({"telemetry_out": "/x/t.jsonl"})
+    assert resolve_dump_path(cfg) == "/x/t.jsonl.crash.json"
+    cfg = Config.from_params({"crash_dump": "/y/d.json"})
+    assert resolve_dump_path(cfg) == "/y/d.json"
+    monkeypatch.setenv("LGBM_TPU_CRASH_DUMP", "/z/env.json")
+    assert resolve_dump_path(cfg) == "/z/env.json"
+
+
+def test_fault_drill_nan_rollback_dumps_black_box(tel, tmp_path):
+    """nan_grad under guard_policy=rollback (the PR 4 harness): the
+    rollback RECOVERS the run, and the dump still captures the
+    faulting iteration's records, counter totals and fingerprints."""
+    from lightgbm_tpu.robustness.faults import set_fault_plan
+    X, y = _toy(600, 8, seed=7)
+    params = _drill_params(tmp_path, faults="nan_grad@iteration=7")
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=10,
+                    valid_sets=[lgb.Dataset(X[:100], label=y[:100])],
+                    verbose_eval=False)
+    set_fault_plan(None)
+    assert bst.num_trees() == 10   # rollback recovered
+    dump_path = str(tmp_path / "trace.jsonl.crash.json")
+    assert os.path.exists(dump_path)
+    with open(dump_path) as fh:
+        d = json.load(fh)
+    assert d["flight_recorder"] == 1
+    assert d["reason"] == "guard:nonfinite"
+    assert d["counters"]["guard.nonfinite_iters"] >= 1
+    assert d["counters"]["faults.nan_grad"] == 1
+    assert d["config_fingerprint"] and d["bin_layout_fingerprint"]
+    assert d["config"]["guard_policy"] == "rollback"
+    # the faulting iteration's records are in the black box: the ring
+    # holds everything up to the trip (iterations 0..6 completed)
+    iters = {r["iter"] for r in d["records"]
+             if r.get("kind") == "iter"}
+    assert 6 in iters, sorted(iters)
+    assert d["trips"] and d["trips"][0]["kind"] == "nonfinite"
+    assert d["trips"][0]["iteration"] == 7
+    # atomic write: no temp leftovers
+    assert not [f for f in os.listdir(tmp_path)
+                if f.endswith(".tmp")]
+
+
+def test_fault_drill_sigterm_preemption_dumps(tel, tmp_path):
+    """sigterm via the harness: the engine finishes the in-flight
+    iteration, checkpoints, and the final dump (reason=preemption)
+    atomically replaces the signal-time one."""
+    from lightgbm_tpu.robustness.faults import set_fault_plan
+    X, y = _toy(600, 8, seed=8)
+    params = _drill_params(tmp_path, faults="sigterm@iteration=5")
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=12,
+                    valid_sets=[lgb.Dataset(X[:100], label=y[:100])],
+                    verbose_eval=False)
+    set_fault_plan(None)
+    assert getattr(bst, "preempted", False)
+    with open(str(tmp_path / "trace.jsonl.crash.json")) as fh:
+        d = json.load(fh)
+    assert d["reason"] == "preemption"
+    assert d["signum"] == 15
+    assert d["counters"]["checkpoint.preemptions"] == 1
+    assert d["checkpoint_dir"] == str(tmp_path / "ckpts")
+    assert any(r.get("kind") == "iter" for r in d["records"])
+    # the signal-time trip is preserved in the final dump
+    assert any(t["kind"] == "signal" for t in d["trips"])
+
+
+def test_uncaught_exception_dumps(tel, tmp_path):
+    class Boom(RuntimeError):
+        pass
+
+    def bad_feval(preds, ds):
+        raise Boom("feval exploded")
+    X, y = _toy(400)
+    params = _drill_params(tmp_path)
+    with pytest.raises(Boom):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                  valid_sets=[lgb.Dataset(X[:80], label=y[:80])],
+                  feval=bad_feval, verbose_eval=False)
+    with open(str(tmp_path / "trace.jsonl.crash.json")) as fh:
+        d = json.load(fh)
+    assert d["reason"] == "exception"
+    assert d["exception"]["type"] == "Boom"
+    assert "feval exploded" in d["exception"]["message"]
+
+
+def test_flightrec_disarm_ownership(tel, tmp_path):
+    rec = arm_recorder(None, dump_path=str(tmp_path / "a.json"))
+    assert rec is not None
+    # a nested arm does not steal, and its disarm does not clear
+    rec2 = arm_recorder(None, dump_path=str(tmp_path / "b.json"))
+    assert rec2 is rec
+    disarm_recorder(None)
+    from lightgbm_tpu.observability.flightrec import active_recorder
+    assert active_recorder() is rec
+    disarm_recorder(rec)
+    assert active_recorder() is None
+
+
+# ---------------------------------------------------------------------
+# bench trend gate
+def _mk_round(path, n, lines):
+    tail = "\n".join(json.dumps(ln) for ln in lines)
+    with open(path, "w") as fh:
+        json.dump({"n": n, "cmd": "bench", "rc": 0, "tail": tail,
+                   "parsed": lines[-1] if lines else None}, fh)
+
+
+_FIXED = {"metric": "cpu_fixed_baseline_throughput", "value": 1.0,
+          "unit": "Mrow-iters/s", "baseline_config": "cpu-fixed-v1",
+          "backend": "cpu"}
+_HEAD = {"metric": "higgs_like_train_throughput", "value": 2.0,
+         "backend": "cpu",
+         "serving": {"p99_ms": 10.0, "p50_ms": 2.0,
+                     "buckets": [1, 64], "batch_sizes": [1, 64],
+                     "mode": "closed"}}
+
+
+def test_bench_trend_committed_series_passes(capsys):
+    bt = _load_tool("bench_trend")
+    assert bt.main([]) == 0
+    out = capsys.readouterr().out
+    assert "verdict: ok" in out
+
+
+def test_bench_trend_fixed_baseline_regression(tmp_path, capsys):
+    bt = _load_tool("bench_trend")
+    a, b = str(tmp_path / "BENCH_r06.json"), \
+        str(tmp_path / "BENCH_r07.json")
+    _mk_round(a, 6, [_FIXED, _HEAD])
+    _mk_round(b, 7, [dict(_FIXED, value=0.79), _HEAD])  # -21%
+    rep = str(tmp_path / "rep.json")
+    assert bt.main([a, b, "--report", rep]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    assert report["verdict"] == "regression"
+    [r] = report["regressions"]
+    assert r["series"] == "cpu_fixed_baseline_throughput"
+    assert r["change_pct"] == -21.0
+    assert "REGRESSIONS" in capsys.readouterr().out
+    # -15% is within the 20% gate
+    _mk_round(b, 7, [dict(_FIXED, value=0.85), _HEAD])
+    assert bt.main([a, b]) == 0
+
+
+def test_bench_trend_serving_p99_and_config_bump(tmp_path):
+    bt = _load_tool("bench_trend")
+    a, b = str(tmp_path / "BENCH_r06.json"), \
+        str(tmp_path / "BENCH_r07.json")
+    _mk_round(a, 6, [_FIXED, _HEAD])
+    worse = dict(_HEAD, serving=dict(_HEAD["serving"], p99_ms=12.5))
+    _mk_round(b, 7, [_FIXED, worse])              # p99 +25%
+    assert bt.main([a, b, "--quiet"]) == 1
+    # a baseline_config bump deliberately breaks the comparison chain
+    _mk_round(b, 7, [dict(_FIXED, value=0.1,
+                          baseline_config="cpu-fixed-v2"), _HEAD])
+    assert bt.main([a, b, "--quiet"]) == 0
+    # unparsable-only input is a usage error, not a silent pass
+    bad = str(tmp_path / "BENCH_r08.json")
+    with open(bad, "w") as fh:
+        fh.write("not json")
+    assert bt.main([bad]) == 2
+
+
+# ---------------------------------------------------------------------
+# run_report + bench probe telemetry satellites
+def test_run_report_renders_hist_records_and_probe(tel, tmp_path):
+    rr = _load_tool("run_report")
+    path = str(tmp_path / "t.jsonl")
+    recs = [
+        {"kind": "run_start", "t": 0.0, "backend": "cpu"},
+        {"kind": "probe", "t": 0.1, "verdict": "failed",
+         "reason": "hung > 90s", "dur_s": 180.0, "cached": False},
+        {"kind": "hist", "t": 1.0,
+         "name": "serving_request_latency_ms",
+         "labels": {"kind": "predict", "bucket": "8"},
+         "count": 100, "sum": 250.0, "p50": 2.1, "p95": 6.0,
+         "p99": 9.5},
+        {"kind": "train_end", "t": 2.0, "iters": 1, "dur_s": 1.0},
+    ]
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    d = rr.digest(rr.load(path))
+    assert d["tpu_probe"]["verdict"] == "failed"
+    key, = d["hists"]
+    assert "serving_request_latency_ms" in key and "bucket=8" in key
+    text = rr.render(rr.load(path))
+    assert "histograms (live metrics plane)" in text
+    assert "tpu probe" in text and "hung > 90s" in text
+
+
+def test_run_report_renders_crash_dump(tmp_path):
+    rr = _load_tool("run_report")
+    dump = {"flight_recorder": 1, "reason": "guard:nonfinite",
+            "pid": 1, "iteration": 9, "config_fingerprint": "abc",
+            "bin_layout_fingerprint": "def",
+            "config": {"objective": "binary"},
+            "counters": {"guard.nonfinite_iters": 1},
+            "trips": [{"kind": "nonfinite", "iteration": 9,
+                       "wall_time": 0}],
+            "memory": {"live_arrays": 3},
+            "records": [{"kind": "iter", "t": 1.0, "iter": 8,
+                         "phases": {"grow": 0.01}}]}
+    path = str(tmp_path / "x.crash.json")
+    with open(path, "w") as fh:
+        json.dump(dump, fh, indent=1)
+    assert rr.load_crash(path) is not None
+    text = rr.render_crash(dump)
+    assert "reason=guard:nonfinite" in text
+    assert "config_fingerprint=abc" in text
+    assert "iter=8" in text
+    # a JSONL trace is NOT mistaken for a crash dump
+    tr = str(tmp_path / "t.jsonl")
+    with open(tr, "w") as fh:
+        fh.write(json.dumps({"kind": "iter", "t": 0.0}) + "\n")
+    assert rr.load_crash(tr) is None
+
+
+def test_bench_probe_telemetry_and_cache_age(tmp_path, monkeypatch):
+    import sys
+    sys.path.insert(0, REPO)
+    import bench
+    path = str(tmp_path / "bt.jsonl")
+    monkeypatch.setenv("LGBM_TPU_TELEMETRY", path)
+    bench.emit_probe_telemetry(False, "tunnel wedged", 3.2,
+                               cached=False)
+    bench.emit_probe_telemetry(True, "ok", 0.0, cached=True,
+                               age_s=120.0)
+    with open(path) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    probes = [r for r in recs if r["kind"] == "probe"]
+    assert [p["verdict"] for p in probes] == ["failed", "ok"]
+    assert probes[0]["reason"] == "tunnel wedged"
+    assert probes[1]["cache_age_s"] == 120.0
+    counters = [r for r in recs if r["kind"] == "counter"]
+    assert counters and counters[0]["name"] == "probe.fail"
+    # the cached-verdict fields surfaced on result lines
+    info = bench.probe_info_from_cache(
+        {"ok": False, "ts": time.time() - 100, "detail": "hung"})
+    assert info["tpu_probe"] == "failed"
+    assert info["tpu_probe_cached"] is True
+    assert info["tpu_probe_detail"] == "hung"
+    assert 95 <= info["tpu_probe_age_s"] <= 110

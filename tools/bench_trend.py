@@ -1,0 +1,270 @@
+"""Round-over-round bench trend gate (ROADMAP item 5).
+
+Parses the committed ``BENCH_r*.json`` series (the driver's round
+files: ``{"n", "cmd", "rc", "tail", "parsed"}`` — every JSON result
+line in ``tail`` is read, ``parsed`` is the headline), tracks the two
+series that are *comparable across rounds*, writes a trend report, and
+exits nonzero on a regression:
+
+* ``cpu_fixed_baseline_throughput`` — the ONE pinned steady-state CPU
+  configuration (``bench.py:CPU_BASELINE_ID``). Points are compared
+  only when their ``baseline_config`` ids match: bumping the config id
+  deliberately breaks the chain instead of flagging a bogus
+  regression. Lower is worse; a drop of more than ``--threshold``
+  (default 20%) between consecutive comparable rounds fails the gate.
+* serving ``p99_ms`` — from any result line's ``serving`` block, keyed
+  by (backend, buckets, batch_sizes) so only like-for-like serving
+  measurements chain. Higher is worse.
+
+The legacy headline (``higgs_like_train_throughput``) is REPORTED but
+never gated: the r01-r05 history mixes row counts, iteration counts
+and backends, which is exactly the noise the fixed baseline exists to
+replace.
+
+Stdlib-only on purpose: the CI job runs it without jax.
+
+Usage::
+
+    python tools/bench_trend.py [FILES...] [--threshold 0.2]
+                                [--report trend_report.json] [--quiet]
+
+No FILES -> ``BENCH_r*.json`` in the repo root, sorted. Exit codes:
+0 = no regression, 1 = regression(s), 2 = no parsable input.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_THRESHOLD = 0.20
+
+FIXED_METRIC = "cpu_fixed_baseline_throughput"
+HEADLINE_METRIC = "higgs_like_train_throughput"
+
+
+def extract_lines(text: str) -> List[Dict[str, Any]]:
+    """Every parsable JSON result line in a blob (same acceptance rule
+    as ``bench.find_result_line``, but keeping ALL lines)."""
+    out = []
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def round_label(path: str, data: Dict[str, Any]) -> str:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    if m:
+        return f"r{int(m.group(1)):02d}"
+    n = data.get("n")
+    return f"r{int(n):02d}" if isinstance(n, (int, float)) else \
+        os.path.basename(path)
+
+
+def load_round(path: str) -> Optional[Dict[str, Any]]:
+    """One round file -> {"label", "path", "lines"} or None."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"bench_trend: skipping {path}: {e}\n")
+        return None
+    lines = extract_lines(data.get("tail", ""))
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("metric") \
+            and parsed not in lines:
+        lines.append(parsed)
+    return {"label": round_label(path, data), "path": path,
+            "lines": lines}
+
+
+def _fixed_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's fixed-baseline measurement: an explicit
+    cpu_fixed_baseline_throughput line, else a headline that reused
+    the fixed config as its CPU fallback (source=cpu_fixed_baseline).
+    The LAST matching line wins (bench prints escalating attempts)."""
+    found = None
+    for ln in lines:
+        if ln.get("metric") == FIXED_METRIC \
+                or (ln.get("metric") == HEADLINE_METRIC
+                    and ln.get("source") == "cpu_fixed_baseline"):
+            if ln.get("value") is not None \
+                    and ln.get("baseline_config"):
+                found = {"value": float(ln["value"]),
+                         "key": str(ln["baseline_config"])}
+    return found
+
+
+def _serving_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's serving p99, keyed by the measurement shape."""
+    found = None
+    for ln in lines:
+        sv = ln.get("serving")
+        if not isinstance(sv, dict) or sv.get("p99_ms") is None:
+            continue
+        key = json.dumps({
+            "backend": ln.get("backend"),
+            "buckets": sv.get("buckets"),
+            "batch_sizes": sv.get("batch_sizes"),
+            "mode": sv.get("mode"),
+        }, sort_keys=True)
+        found = {"value": float(sv["p99_ms"]), "key": key,
+                 "p50": sv.get("p50_ms"), "p95": sv.get("p95_ms")}
+    return found
+
+
+def _headline_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    for ln in reversed(lines):
+        if ln.get("metric") == HEADLINE_METRIC \
+                and ln.get("value") is not None:
+            return {"value": float(ln["value"]),
+                    "backend": ln.get("backend"),
+                    "rows": ln.get("rows")}
+    return None
+
+
+def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
+          threshold: float, name: str) -> List[Dict[str, Any]]:
+    """Consecutive comparable points (equal ``key``) whose worsening
+    exceeds the threshold."""
+    regressions = []
+    prev_label, prev = None, None
+    for label, point in series:
+        if prev is not None and point["key"] == prev["key"] \
+                and prev["value"] > 0:
+            change = (point["value"] - prev["value"]) / prev["value"]
+            worsening = -change if higher_is_better else change
+            if worsening > threshold:
+                regressions.append({
+                    "series": name,
+                    "from_round": prev_label, "to_round": label,
+                    "from_value": prev["value"],
+                    "to_value": point["value"],
+                    "change_pct": round(change * 100.0, 2),
+                    "threshold_pct": round(threshold * 100.0, 2),
+                    "key": point["key"],
+                })
+        prev_label, prev = label, point
+    return regressions
+
+
+def analyze(rounds: List[Dict[str, Any]],
+            threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
+    fixed, serving, headline = [], [], []
+    for rnd in rounds:
+        p = _fixed_point(rnd["lines"])
+        if p is not None:
+            fixed.append((rnd["label"], p))
+        p = _serving_point(rnd["lines"])
+        if p is not None:
+            serving.append((rnd["label"], p))
+        p = _headline_point(rnd["lines"])
+        if p is not None:
+            headline.append((rnd["label"], p))
+
+    regressions = _gate(fixed, True, threshold,
+                        FIXED_METRIC)
+    regressions += _gate(serving, False, threshold, "serving_p99_ms")
+    return {
+        "rounds": [r["label"] for r in rounds],
+        "threshold_pct": round(threshold * 100.0, 2),
+        "series": {
+            FIXED_METRIC: [
+                {"round": lb, **pt} for lb, pt in fixed],
+            "serving_p99_ms": [
+                {"round": lb, **pt} for lb, pt in serving],
+            # informational only — config drifts across rounds
+            HEADLINE_METRIC + "_ungated": [
+                {"round": lb, **pt} for lb, pt in headline],
+        },
+        "gated_points": {FIXED_METRIC: len(fixed),
+                         "serving_p99_ms": len(serving)},
+        "regressions": regressions,
+        "verdict": "regression" if regressions else "ok",
+    }
+
+
+def render(report: Dict[str, Any]) -> str:
+    L = [f"bench trend over rounds: {', '.join(report['rounds'])}",
+         f"threshold: {report['threshold_pct']:.0f}%"]
+    for name, pts in report["series"].items():
+        L.append("")
+        gated = "" if not name.endswith("_ungated") else " (not gated)"
+        L.append(f"== {name}{gated} ==")
+        if not pts:
+            L.append("(no measurements in the series yet)")
+            continue
+        for pt in pts:
+            extra = f"  [{pt['key']}]" if "key" in pt else ""
+            L.append(f"{pt['round']:>6}  {pt['value']:>12.4f}{extra}")
+    L.append("")
+    if report["regressions"]:
+        L.append("REGRESSIONS:")
+        for r in report["regressions"]:
+            L.append(
+                f"  {r['series']}: {r['from_round']} -> "
+                f"{r['to_round']}: {r['from_value']:.4f} -> "
+                f"{r['to_value']:.4f} ({r['change_pct']:+.1f}% vs "
+                f"{r['threshold_pct']:.0f}% allowed)")
+    else:
+        L.append("verdict: ok (no gated regression)")
+    return "\n".join(L) + "\n"
+
+
+def main(argv: List[str]) -> int:
+    threshold = DEFAULT_THRESHOLD
+    report_path = None
+    files: List[str] = []
+    quiet = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--threshold":
+            i += 1
+            threshold = float(argv[i])
+        elif a.startswith("--threshold="):
+            threshold = float(a.split("=", 1)[1])
+        elif a == "--report":
+            i += 1
+            report_path = argv[i]
+        elif a.startswith("--report="):
+            report_path = a.split("=", 1)[1]
+        elif a == "--quiet":
+            quiet = True
+        elif a.startswith("--"):
+            sys.stderr.write(__doc__ + f"\nunknown option {a}\n")
+            return 2
+        else:
+            files.append(a)
+        i += 1
+    if not files:
+        files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not files:
+        sys.stderr.write("bench_trend: no BENCH round files found\n")
+        return 2
+    rounds = [r for r in (load_round(f) for f in files) if r]
+    if not rounds:
+        sys.stderr.write("bench_trend: no parsable round files\n")
+        return 2
+    report = analyze(rounds, threshold)
+    if report_path:
+        with open(report_path, "w") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+    if not quiet:
+        sys.stdout.write(render(report))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

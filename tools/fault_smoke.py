@@ -159,6 +159,32 @@ def main() -> int:
         assert found >= 1, \
             "telemetry trace lacks guard.nonfinite_iters"
         print(f"PASS: trace {trace} records the guard event")
+
+        # crash flight recorder (observability/flightrec.py): the
+        # faulted runs must leave the black box next to the trace,
+        # atomically (no torn temp files), carrying the faulting run's
+        # records + counter totals + config fingerprint
+        dump_path = trace + ".crash.json"
+        assert os.path.exists(dump_path), (
+            f"fault drill left no flight-recorder dump at {dump_path}")
+        with open(dump_path) as fh:
+            dump = json.load(fh)
+        assert dump.get("flight_recorder") == 1
+        assert dump.get("reason") in ("preemption", "guard:nonfinite",
+                                      "sigterm"), dump.get("reason")
+        assert dump.get("config_fingerprint"), "dump lacks config fp"
+        assert dump.get("counters", {}).get("guard.nonfinite_iters",
+                                            0) >= 1
+        assert any(r.get("kind") == "iter"
+                   for r in dump.get("records", [])), \
+            "dump carries no iteration records"
+        leftovers = [f for f in os.listdir(os.path.dirname(
+            os.path.abspath(dump_path)))
+            if f.startswith(os.path.basename(dump_path))
+            and f.endswith(".tmp")]
+        assert not leftovers, f"non-atomic dump leftovers: {leftovers}"
+        print(f"PASS: flight-recorder dump {dump_path} "
+              f"(reason={dump['reason']}) is complete and atomic")
     return 0
 
 

@@ -1,6 +1,14 @@
 """Render a telemetry JSONL trace into a phase/throughput report.
 
-Usage:  python tools/run_report.py <trace.jsonl> [--json]
+Usage:  python tools/run_report.py <trace.jsonl | dump.crash.json>
+                                   [--json]
+
+Also renders a crash flight-recorder dump
+(``<telemetry_out>.crash.json``, lightgbm_tpu/observability/
+flightrec.py): a file whose whole body is one JSON object with a
+``flight_recorder`` key is detected and rendered as the black-box
+report (reason, faulting iteration, fingerprints, guard trips, the
+last ring records) instead of as a trace.
 
 Reads the trace written by LGBM_TPU_TELEMETRY / telemetry_out (schema:
 docs/Observability.md) and prints, for the LAST training run in the
@@ -88,6 +96,21 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     serving = {k: v for k, v in serving.items()
                if k not in ("kind", "t")}
 
+    # histogram snapshots (kind=hist, emitted by the live metrics
+    # plane on engine stop): keep the LAST snapshot per (name, labels)
+    hists: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") != "hist" or not r.get("name"):
+            continue
+        labels = r.get("labels") or {}
+        key = r["name"] + "".join(
+            f"{{{k}={labels[k]}}}" for k in sorted(labels))
+        hists[key] = {k: r.get(k) for k in
+                      ("name", "labels", "count", "sum",
+                       "p50", "p95", "p99")}
+
+    probe_rec = _last(records, "probe")
+
     counters_all = end.get("counters") or {}
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
@@ -98,6 +121,10 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
         "serving": serving,
+        "hists": hists,
+        "tpu_probe": None if probe_rec is None else {
+            k: probe_rec.get(k) for k in
+            ("verdict", "reason", "dur_s", "cached", "cache_age_s")},
         "jax_version": run.get("jax_version"),
         "config": run.get("config") or {},
         "iters": n_iters,
@@ -265,6 +292,108 @@ def render(records: List[Dict[str, Any]]) -> str:
             L.append(f"model: v{model.get('version')} "
                      f"{model.get('num_trees')} trees "
                      f"device_ready={model.get('device_ready')}")
+
+    if d.get("hists"):
+        L.append("")
+        L.append("== histograms (live metrics plane) ==")
+        L.append(f"{'series':<48}{'count':>8}{'p50':>10}{'p95':>10}"
+                 f"{'p99':>10}")
+        for key, h in sorted(d["hists"].items()):
+            def _f(v):
+                return "-" if v is None else f"{float(v):.3f}"
+            L.append(f"{key:<48}{h.get('count', 0):>8}"
+                     f"{_f(h.get('p50')):>10}{_f(h.get('p95')):>10}"
+                     f"{_f(h.get('p99')):>10}")
+
+    if d.get("tpu_probe"):
+        p = d["tpu_probe"]
+        L.append("")
+        L.append("== tpu probe ==")
+        age = p.get("cache_age_s")
+        L.append(f"verdict={p.get('verdict')} "
+                 f"cached={p.get('cached')}"
+                 + (f" age_s={age}" if age is not None else "")
+                 + f" dur_s={p.get('dur_s')}")
+        if p.get("reason"):
+            L.append(f"reason: {str(p['reason'])[:200]}")
+    return "\n".join(L) + "\n"
+
+
+# ----------------------------------------------------------------------
+# crash flight-recorder dumps (observability/flightrec.py)
+def load_crash(path: str):
+    """The whole-file JSON object when ``path`` is a flight-recorder
+    dump, else None."""
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if isinstance(obj, dict) and "flight_recorder" in obj:
+        return obj
+    return None
+
+
+def render_crash(d: Dict[str, Any]) -> str:
+    L = ["== crash flight recorder =="]
+    L.append(f"reason={d.get('reason')} pid={d.get('pid')} "
+             f"iteration={d.get('iteration')} "
+             f"schema=v{d.get('flight_recorder')}")
+    L.append(f"config_fingerprint={d.get('config_fingerprint')}")
+    L.append(f"bin_layout_fingerprint="
+             f"{d.get('bin_layout_fingerprint')}")
+    cfg = d.get("config") or {}
+    if cfg:
+        L.append("config: " + " ".join(
+            f"{k}={v}" for k, v in sorted(cfg.items())))
+    exc = d.get("exception")
+    if exc:
+        L.append("")
+        L.append(f"exception: {exc.get('type')}: "
+                 f"{exc.get('message')}")
+        for ln in (exc.get("traceback") or [])[-6:]:
+            L.append("  " + ln.rstrip())
+    trips = d.get("trips") or []
+    if trips:
+        L.append("")
+        L.append("== guard trips / signals ==")
+        for t in trips:
+            desc = " ".join(f"{k}={v}" for k, v in sorted(t.items())
+                            if k != "wall_time")
+            L.append(f"  {desc}")
+    counters = d.get("counters") or {}
+    rob = {k: v for k, v in counters.items()
+           if k.startswith(("guard.", "checkpoint.", "retry.",
+                            "faults."))}
+    if rob:
+        L.append("")
+        L.append("== robustness counters at dump time ==")
+        for k, v in sorted(rob.items()):
+            L.append(f"  {k:<32}{v:>12,.0f}")
+    mem = d.get("memory") or {}
+    if mem:
+        L.append("")
+        L.append("memory: " + " ".join(
+            f"{k}={v}" for k, v in sorted(mem.items())))
+    records = d.get("records") or []
+    L.append("")
+    L.append(f"== last {len(records)} ring records ==")
+    if len(records) > 12:
+        L.append(f"  ... ({len(records) - 12} earlier records in "
+                 "the dump file)")
+    for r in records[-12:]:
+        kind = r.get("kind")
+        extra = ""
+        if kind == "iter":
+            extra = (f" iter={r.get('iter')} phases="
+                     + ",".join(f"{k}:{v:.3f}"
+                                for k, v in
+                                (r.get('phases') or {}).items()))
+        elif kind == "eval":
+            extra = f" iter={r.get('iter')} {r.get('results')}"
+        elif kind == "compile":
+            extra = f" dur_s={r.get('dur_s')}"
+        L.append(f"  t={r.get('t')} {kind}{extra}"[:100])
     return "\n".join(L) + "\n"
 
 
@@ -273,6 +402,13 @@ def main(argv: List[str]) -> int:
     if not args:
         sys.stderr.write(__doc__ + "\n")
         return 2
+    crash = load_crash(args[0])
+    if crash is not None:
+        if "--json" in argv:
+            print(json.dumps(crash))
+        else:
+            sys.stdout.write(render_crash(crash))
+        return 0
     records = load(args[0])
     if not records:
         sys.stderr.write(f"no records in {args[0]}\n")
