@@ -42,6 +42,23 @@ CPU_BASELINE_TIMEOUT_S = 420
 # on dense numeric regression, recorded in the bench JSON
 LINEAR_CONV_TIMEOUT_S = 300
 
+# >=100-iteration fixed-config quality gate (VERDICT r5 weak #5):
+# quality_ok now means "within `tolerance` AUC of the committed
+# baseline accuracy at matched params" (BENCH_QUALITY_BASELINE.json),
+# not the old 3-iteration sanity floor. Changing iters/shape requires
+# a new id + re-committed baseline.
+QUALITY_GATE = {"iters": 100, "tolerance": 0.002}
+QUALITY_GATE_ID = "cpu-fixed-quality-v1-50k-28f-63l-100it"
+QUALITY_BASELINE_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "BENCH_QUALITY_BASELINE.json")
+QUALITY_TIMEOUT_S = 900
+
+# compiled-HLO dispatch census (tools/hlo_census.py): per-split op
+# count of the grow programs, gated against the committed budget and
+# chained round-over-round by tools/bench_trend.py
+CENSUS_TIMEOUT_S = 240
+
 # cached TPU probe verdict: one wedged-tunnel hang must not eat the
 # budget of every bench invocation in a round
 PROBE_CACHE_FILE = os.path.join(
@@ -387,9 +404,11 @@ def _fixed_cpu_child_env(env):
     return envc
 
 
-def run_cpu_baseline(env, remaining):
+def run_cpu_baseline(env, remaining, dispatches=None):
     """Measure the fixed-config steady-state CPU baseline; prints its
-    JSON line (metric cpu_fixed_baseline_throughput) and returns it."""
+    JSON line (metric cpu_fixed_baseline_throughput, carrying the
+    census-derived dispatches_per_split when available) and returns
+    it."""
     if os.environ.get("BENCH_NO_CPU_BASELINE") or remaining < 120:
         return None
     envc = _fixed_cpu_child_env(env)
@@ -400,6 +419,8 @@ def run_cpu_baseline(env, remaining):
         return None
     parsed["metric"] = "cpu_fixed_baseline_throughput"
     parsed["baseline_config"] = CPU_BASELINE_ID
+    if dispatches is not None:
+        parsed["dispatches_per_split"] = dispatches
     print(json.dumps(parsed), flush=True)
     return parsed
 
@@ -425,6 +446,100 @@ def run_linear_convergence(env, remaining):
         sys.stderr.write("linear convergence child failed:\n"
                          + proc.stderr[-2000:] + "\n")
         return None
+    print(json.dumps(parsed), flush=True)
+    return parsed
+
+
+def run_dispatch_census(env, remaining):
+    """Compiled-HLO dispatch census (tools/hlo_census.py) on the CPU
+    backend: one JSON line (metric dispatches_per_split; value = the
+    serial grow program's per-split op count — the program the fixed
+    CPU baseline trains with) plus the committed-budget verdict. Runs
+    at tiny shapes: the while-body op census is shape-independent
+    (asserted by tests/test_split_fusion.py)."""
+    if os.environ.get("BENCH_NO_CENSUS") or remaining < 60:
+        return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = os.path.join(here, "bench_census.json")
+    # a stale artifact from an earlier run must never be mistaken for
+    # this run's measurement (the child may crash before writing)
+    try:
+        os.remove(art)
+    except OSError:
+        pass
+    envc = _cpu_env(env)
+    envc.pop("_BENCH_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.hlo_census", "--check",
+             "--json", art, "--rows", "512", "--features", "8",
+             "--leaves", "15"],
+            env=envc, capture_output=True, text=True, cwd=here,
+            timeout=max(60.0, min(CENSUS_TIMEOUT_S, remaining)))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("hlo census timed out\n")
+        return None
+    try:
+        with open(art) as fh:
+            census = json.load(fh)
+    except OSError:
+        sys.stderr.write("hlo census child failed (no artifact):\n"
+                         + proc.stderr[-2000:] + "\n")
+        return None
+    progs = census.get("programs", {})
+    result = {
+        "metric": "dispatches_per_split",
+        "value": progs.get("serial_grow", {}).get("ops_per_split"),
+        "unit": "hlo-ops/split",
+        "baseline_config": CPU_BASELINE_ID,
+        "budget_ok": proc.returncode == 0,
+        "split_fusion": census.get("config", {}).get("split_fusion"),
+        "programs": {n: {"ops_per_split": p.get("ops_per_split"),
+                         "carry_arrays": p.get("carry_arrays"),
+                         "carry_bytes": p.get("carry_bytes")}
+                     for n, p in progs.items()},
+    }
+    print(json.dumps(result), flush=True)
+    if proc.returncode != 0:
+        sys.stderr.write("DISPATCH CENSUS over budget (see "
+                         "tools/hlo_census_budget.json):\n"
+                         + proc.stdout[-1500:] + "\n")
+    return result
+
+
+def run_quality_gate(env, remaining):
+    """The >=100-iteration fixed-config accuracy gate: same generator
+    and params as the CPU fixed baseline, QUALITY_GATE['iters']
+    boosting rounds, quality_ok = AUC within QUALITY_GATE['tolerance']
+    of the committed BENCH_QUALITY_BASELINE.json accuracy."""
+    if os.environ.get("BENCH_NO_QUALITY") or remaining < 240:
+        return None
+    try:
+        with open(QUALITY_BASELINE_FILE) as fh:
+            base = json.load(fh)
+    except OSError:
+        sys.stderr.write("no committed quality baseline "
+                         f"({QUALITY_BASELINE_FILE}); skipping the "
+                         "quality gate\n")
+        return None
+    envc = _cpu_env(env)
+    envc["BENCH_FEATURES"] = str(CPU_BASELINE["features"])
+    envc["BENCH_LEAVES"] = str(CPU_BASELINE["leaves"])
+    envc["BENCH_ITERS"] = str(QUALITY_GATE["iters"])
+    envc["BENCH_WARMUP_ITERS"] = "1"
+    envc["BENCH_SERVING"] = "0"
+    min_auc = float(base["auc"]) - QUALITY_GATE["tolerance"]
+    envc["BENCH_MIN_AUC"] = repr(min_auc)
+    parsed, err = _run_child(
+        envc, CPU_BASELINE["rows"],
+        max(240.0, min(QUALITY_TIMEOUT_S, remaining)))
+    if parsed is None:
+        sys.stderr.write(f"quality gate child failed: {err}\n")
+        return None
+    parsed["metric"] = "cpu_fixed_quality_gate"
+    parsed["baseline_config"] = QUALITY_GATE_ID
+    parsed["auc_baseline"] = float(base["auc"])
+    parsed["auc_tolerance"] = QUALITY_GATE["tolerance"]
     print(json.dumps(parsed), flush=True)
     return parsed
 
@@ -467,10 +582,18 @@ def main():
     # Pinned single-size runs (tools/bench_sweep.py) skip both.
     baseline_parsed = None
     if pinned is None:
-        baseline_parsed = run_cpu_baseline(
+        # dispatch census first (cheap, feeds the baseline line)
+        census_parsed = run_dispatch_census(
             env, budget - (time.monotonic() - t_start))
+        baseline_parsed = run_cpu_baseline(
+            env, budget - (time.monotonic() - t_start),
+            dispatches=(census_parsed or {}).get("value"))
         run_linear_convergence(
             env, budget - (time.monotonic() - t_start))
+        qp = run_quality_gate(
+            env, budget - (time.monotonic() - t_start))
+        if qp is not None and qp.get("quality_ok") is False:
+            quality_fail = True
 
     # fast tunnel probe: a WEDGED axon tunnel (observed repeatedly in
     # rounds 3-4) hangs children at jax.devices() until their full
@@ -601,6 +724,13 @@ def main():
                 if head.get("quality_ok") is False:
                     sys.stderr.write("QUALITY GATE FAILED: auc "
                                      f"{head.get('auc')} below bar\n")
+                    sys.exit(3)
+                if quality_fail:
+                    # the 100-iter fixed-config gate failed earlier;
+                    # the fallback headline must not bury it
+                    sys.stderr.write(
+                        "QUALITY GATE FAILED: cpu_fixed_quality_gate "
+                        "fell below the committed baseline AUC\n")
                     sys.exit(3)
                 return
         e = last_err or ("?", "", "")

@@ -58,27 +58,23 @@ from ..ops.split import (MAX_CAT_WORDS,
                          leaf_output_no_constraint, per_feature_splits)
 from ..models.linear import LinearLeafFitMixin
 from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
-                     StatePack, cegb_pf_state, cegb_refund,
+                     cegb_pf_state, cegb_refund,
                      cegb_store_row, cegb_upgrade_best,
-                     count_tree_telemetry, feature_meta_from_dataset,
+                     count_tree_telemetry, dataset_has_monotone,
+                     feature_meta_from_dataset,
                      forced_left_sums, forced_split_override,
-                     make_node_rand, split_params_from_config,
-                     scan_children)
+                     make_node_rand, split_params_from_config)
+from .split_step import (StatePack, child_columns, child_constraints,
+                         make_grow_pack, order_child_pair,
+                         scan_children, set_bitsets,
+                         split_fusion_default)
 
 HIST_BLK = 2048
 PART_BLK = 512
 
-# Packed grow-loop state (serial.py:StatePack): the partitioned loop's
-# int matrix additionally carries the physical segment bounds
-SF_FIELDS = StatePack.GROW_SF
-SI_FIELDS = ("leaf_begin", "leaf_cnt") + StatePack.GROW_SI
-TF_FIELDS = StatePack.GROW_TF
-TI_FIELDS = StatePack.GROW_TI
-_PACK = StatePack(SF_FIELDS, SI_FIELDS, TF_FIELDS, TI_FIELDS)
-SF_IDX, SI_IDX = _PACK.sf_idx, _PACK.si_idx
-TF_IDX, TI_IDX = _PACK.tf_idx, _PACK.ti_idx
-pack_state = _PACK.pack
-view_state = _PACK.view
+# the partitioned loop's int state additionally carries the physical
+# segment bounds (learner/split_step.py:StatePack)
+SEG_SI_PREFIX = ("leaf_begin", "leaf_cnt")
 
 
 class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin,
@@ -139,6 +135,7 @@ class PartitionedLearnerBase(NodeRandMixin, CegbStateMixin,
         self.bundled = dataset.feature_offset is not None
         self.num_data = dataset.num_data
         self.interpret = interpret
+        self.has_monotone = dataset_has_monotone(dataset)
         from .serial import hist_pool_slots
         # bounded LRU pool (single-device path only; the mesh learners
         # keep full-cache/rebuild because their seg_hist carries
@@ -189,7 +186,9 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             n=self.num_data, bundled=self.bundled,
             interpret=self.interpret, extra_trees=self.extra_trees,
             ff_bynode=self.ff_bynode, bynode_count=self.bynode_count,
-            forced_plan=self.forced_plan, hist_slots=self.hist_slots)
+            forced_plan=self.forced_plan, hist_slots=self.hist_slots,
+            has_monotone=self.has_monotone,
+            split_fusion=split_fusion_default())
         res = GrowResult(tree=tree, leaf_id=leaf_id)
         self._cegb_after_tree(res)
         return res
@@ -222,6 +221,8 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             n=self.num_data, bundled=self.bundled,
             interpret=self.interpret, forced_plan=self.forced_plan,
             cache_hists=self.cache_hists, hist_slots=self.hist_slots,
+            has_monotone=self.has_monotone,
+            split_fusion=split_fusion_default(),
             return_leaf_parts=True)
 
 
@@ -230,7 +231,8 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
                               "num_bins_max", "num_features",
                               "num_groups", "n", "bundled", "interpret",
                               "extra_trees", "ff_bynode", "bynode_count",
-                              "forced_plan", "cache_hists", "hist_slots"),
+                              "forced_plan", "cache_hists", "hist_slots",
+                              "has_monotone", "split_fusion"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       rand_key=None, cegb_used0=None, *, params,
@@ -238,7 +240,8 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       num_groups, n, bundled, interpret,
                       extra_trees=False, ff_bynode=1.0,
                       bynode_count=2, forced_plan=(), cache_hists=True,
-                      hist_slots=None):
+                      hist_slots=None, has_monotone=True,
+                      split_fusion=True):
     return grow_partitioned(
         mat, ws, grad, hess, bag_weight, feature_mask, meta,
         rand_key=rand_key, params=params, num_leaves=num_leaves,
@@ -247,7 +250,8 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         bundled=bundled, interpret=interpret, extra_trees=extra_trees,
         ff_bynode=ff_bynode, bynode_count=bynode_count,
         forced_plan=forced_plan, cache_hists=cache_hists,
-        cegb_used0=cegb_used0, hist_slots=hist_slots)
+        cegb_used0=cegb_used0, hist_slots=hist_slots,
+        has_monotone=has_monotone, split_fusion=split_fusion)
 
 
 def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
@@ -257,6 +261,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      bynode_count=2, forced_plan=(), comm=None,
                      row_id_base=0, n_total=None, cache_hists=True,
                      cegb_used0=None, hist_slots=None,
+                     has_monotone=True, split_fusion=None,
                      return_leaf_parts=False):
     """Traceable partitioned grow loop.
 
@@ -317,6 +322,13 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         cache_hists = hist_slots >= big_l
 
     inf = jnp.float32(jnp.inf)
+    if split_fusion is None:
+        split_fusion = split_fusion_default()
+    # static per-trace packing of the grow-loop carry
+    # (learner/split_step.py)
+    pack = make_grow_pack(SEG_SI_PREFIX, merged=split_fusion,
+                          has_cat=params.has_categorical,
+                          has_monotone=has_monotone, big_l=big_l)
     node_rand = make_node_rand(rand_key, feature_mask, bynode_count,
                                meta.num_bins, extra_trees, ff_bynode)
 
@@ -438,7 +450,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         fields["cegb_used"] = cegb_used0
         fields.update(cegb_pf_state(big_l, num_features))
         cegb_store_row(fields, 0, root_pf, root_blocked)
-    state = pack_state(fields)
+    state = pack.pack(fields)
 
     leaf_range = jnp.arange(big_l)
 
@@ -460,7 +472,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                         v["leaf_cnt"][leaf])
 
     def cond(st):
-        bs_gain = st["SF"][SF_IDX["bs_gain"]]
+        bs_gain = pack.row_f(st, "bs_gain")
         open_gain = jnp.where(leaf_range < st["k"], bs_gain, -jnp.inf)
         # best gain <= 0 stops training (equivalent to the old
         # isfinite check for unpenalized gains)
@@ -469,7 +481,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     kEps = 1e-15
 
     def body(st_packed, forced=None, forced_hist=None):
-        st = view_state(st_packed)  # row views, folded by XLA
+        st = pack.view(st_packed)  # row views, folded by XLA
         k = st["k"]
         new = k
         s = k - 1
@@ -478,23 +490,18 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             open_gain = jnp.where(leaf_range < k, st["bs_gain"],
                                   -jnp.inf)
             leaf = jnp.argmax(open_gain).astype(jnp.int32)
-            # TWO column gathers replace ~24 per-field scalar reads
-            colf = st_packed["SF"][:, leaf]
-            coli = st_packed["SI"][:, leaf]
-            feat = coli[SI_IDX["bs_feat"]]
-            thr = coli[SI_IDX["bs_thr"]]
-            dleft = coli[SI_IDX["bs_dleft"]].astype(bool)
-            gain = colf[SF_IDX["bs_gain"]]
-            is_cat = coli[SI_IDX["bs_iscat"]].astype(bool)
+            # ONE column slice replaces ~24 per-field scalar reads
+            site = pack.read_site(st_packed, leaf)
+            feat = site["bs_feat"]
+            thr = site["bs_thr"]
+            dleft = site["bs_dleft"]
+            gain = site["bs_gain"]
+            is_cat = site["bs_iscat"]
             bitset = st["bs_bitset"][leaf]
-            lg, lh, lc = (colf[SF_IDX["bs_lg"]], colf[SF_IDX["bs_lh"]],
-                          colf[SF_IDX["bs_lc"]])
-            pg, ph, pc = (colf[SF_IDX["leaf_g"]],
-                          colf[SF_IDX["leaf_h"]],
-                          colf[SF_IDX["leaf_c"]])
+            lg, lh, lc = site["bs_lg"], site["bs_lh"], site["bs_lc"]
+            pg, ph, pc = site["leaf_g"], site["leaf_h"], site["leaf_c"]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
-            lout, rout = (colf[SF_IDX["bs_lout"]],
-                          colf[SF_IDX["bs_rout"]])
+            lout, rout = site["bs_lout"], site["bs_rout"]
         else:
             fh = forced_hist if forced_hist is not None \
                 else st["hist"][forced[0]] if cache_hists \
@@ -503,11 +510,12 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
              lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
                 forced_split_override(fh, st, forced, params, meta,
                                       bundled)
-            colf = st_packed["SF"][:, leaf]
-            coli = st_packed["SI"][:, leaf]
+            site = pack.read_site(st_packed, leaf)
+        pcmin = site.get("leaf_cmin", -inf)
+        pcmax = site.get("leaf_cmax", inf)
 
-        begin = coli[SI_IDX["leaf_begin"]]
-        cnt = coli[SI_IDX["leaf_cnt"]]
+        begin = site["leaf_begin"]
+        cnt = site["leaf_cnt"]
 
         # ---- physical partition of the leaf's segment ----------------
         # bundled numerical splits route through the kernel's LUT path:
@@ -552,7 +560,9 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         # which side is "smaller" must be decided from the GLOBAL
         # (reduced) counts so every shard streams the same side of its
         # local segment and the reduced histograms stay consistent
-        # (pool-bounded mode: no parent cache -> build both directly)
+        # (pool-bounded mode: no parent cache -> build both directly).
+        # The fused path keeps the pair in (smaller, other) order; the
+        # CEGB/pool branches reorder to (left, right)
         if cache_hists:
             parent_hist = st["hist"][leaf]
             left_small = lc <= rc
@@ -560,8 +570,11 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             sc = jnp.where(left_small, nl, nr)
             hist_small = seg_hist(mat2, sb, sc)
             hist_other = parent_hist - hist_small
-            hist_left = jnp.where(left_small, hist_small, hist_other)
-            hist_right = jnp.where(left_small, hist_other, hist_small)
+            if params.cegb_on:
+                hist_left = jnp.where(left_small, hist_small,
+                                      hist_other)
+                hist_right = jnp.where(left_small, hist_other,
+                                       hist_small)
         elif pool_mode:
             # parent pooled: stream only the smaller child + subtract;
             # evicted: both children directly (cheaper than rebuilding
@@ -591,90 +604,90 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
 
         # ---- tree arrays (same bookkeeping as learner/serial.py) -----
         dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
-        ref_node = coli[SI_IDX["ref_node"]]
+        ref_node = site["ref_node"]
         upd = ref_node >= 0
         pnode = jnp.where(upd, ref_node, 0)
-        pside = coli[SI_IDX["ref_side"]]
+        pside = site["ref_side"]
 
-        depth = coli[SI_IDX["leaf_depth"]] + 1
+        depth = site["leaf_depth"] + 1
         parent_out = leaf_output_no_constraint(
             pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
             params.max_delta_step)
 
-        # ---- monotone constraint propagation -------------------------
-        mono = meta.monotone[feat]
-        mid = (lout + rout) * 0.5
-        pcmin = colf[SF_IDX["leaf_cmin"]]
-        pcmax = colf[SF_IDX["leaf_cmax"]]
-        numerical = ~is_cat
-        cmin_l = jnp.where(numerical & (mono < 0),
-                           jnp.maximum(pcmin, mid), pcmin)
-        cmax_l = jnp.where(numerical & (mono > 0),
-                           jnp.minimum(pcmax, mid), pcmax)
-        cmin_r = jnp.where(numerical & (mono > 0),
-                           jnp.maximum(pcmin, mid), pcmin)
-        cmax_r = jnp.where(numerical & (mono < 0),
-                           jnp.minimum(pcmax, mid), pcmax)
+        # ---- monotone constraint propagation (compiled out when no
+        # feature has a monotone constraint) ---------------------------
+        cmin_l, cmax_l, cmin_r, cmax_r = child_constraints(
+            meta, feat, is_cat, lout, rout, pcmin, pcmax, has_monotone)
 
         if params.cegb_on:
             cu = st["cegb_used"].at[feat].set(True)
-            split_l, pf_l, blk_l = scan_leaf_pf(
+            split_a, pf_l, blk_l = scan_leaf_pf(
                 hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
                 2 * k + 1, cu)
-            split_r, pf_r, blk_r = scan_leaf_pf(
+            split_b, pf_r, blk_r = scan_leaf_pf(
                 hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
                 2 * k + 2, cu)
+            idx_a, idx_b = leaf, new
+            hist_a, hist_b = hist_left, hist_right
+            begin_a, cnt_a, begin_b, cnt_b = begin, nl, begin + nl, nr
+            o = order_child_pair(
+                jnp.bool_(True), k, lg, lh, lc, rg, rh, rc, lout, rout,
+                cmin_l, cmax_l, cmin_r, cmax_r)
         else:
             cu = None
-            split_l, split_r = scan_children(
-                comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
-                rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k)
+            if cache_hists:
+                a_is_left = left_small
+                idx_a = jnp.where(left_small, leaf, new)
+                idx_b = jnp.where(left_small, new, leaf)
+                hist_a, hist_b = hist_small, hist_other
+                begin_a, cnt_a = sb, sc
+                begin_b = jnp.where(left_small, begin + nl, begin)
+                cnt_b = cnt - sc
+            else:
+                a_is_left = jnp.bool_(True)
+                idx_a, idx_b = leaf, new
+                hist_a, hist_b = hist_left, hist_right
+                begin_a, cnt_a, begin_b, cnt_b = (begin, nl,
+                                                  begin + nl, nr)
+            o = order_child_pair(
+                a_is_left, k, lg, lh, lc, rg, rh, rc, lout, rout,
+                cmin_l, cmax_l, cmin_r, cmax_r)
+            split_a, split_b = scan_children(
+                comm, scan_leaf, hist_a, hist_b, o["ga"], o["ha"],
+                o["ca"], o["gb"], o["hb"], o["cb"], depth, o["cmin_a"],
+                o["cmax_a"], o["cmin_b"], o["cmax_b"], o["salt_a"],
+                o["salt_b"])
 
-        # ---- packed column writes: 2 columns per state matrix, one
-        # column per tree matrix (the whole point of the packing) -----
-        i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
-        f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
-        uf_leaf = jnp.stack([
-            lg, lh, lc, split_l.gain, split_l.left_g, split_l.left_h,
-            split_l.left_c, split_l.left_output, split_l.right_output,
-            cmin_l, cmax_l, lout, f32(lh), f32(lc)])
-        uf_new = jnp.stack([
-            rg, rh, rc, split_r.gain, split_r.left_g, split_r.left_h,
-            split_r.left_c, split_r.left_output, split_r.right_output,
-            cmin_r, cmax_r, rout, f32(rh), f32(rc)])
-        ui_leaf = jnp.stack([
-            begin, nl, split_l.feature, split_l.threshold,
-            i32(split_l.default_left), i32(split_l.is_cat), s,
-            jnp.int32(0), s, depth])
-        ui_new = jnp.stack([
-            begin + nl, nr, split_r.feature, split_r.threshold,
-            i32(split_r.default_left), i32(split_r.is_cat), s,
-            jnp.int32(1), s, depth])
-        sf = st_packed["SF"].at[:, leaf].set(uf_leaf) \
-            .at[:, new].set(uf_new)
-        si = st_packed["SI"].at[:, leaf].set(ui_leaf) \
-            .at[:, new].set(ui_new)
-        tf = st_packed["TF"].at[:, s].set(
-            jnp.stack([gain, parent_out, ph, pc]))
-        ti = st_packed["TI"].at[:, s].set(
-            jnp.stack([feat, thr, dec, ~leaf, ~new]))
-        # pointer fixups on the parent node's child slots
-        lc_row, rc_row = TI_IDX["left_child"], TI_IDX["right_child"]
-        ti = ti.at[lc_row, pnode].set(
-            jnp.where(upd & (pside == 0), s, ti[lc_row, pnode]))
-        ti = ti.at[rc_row, pnode].set(
-            jnp.where(upd & (pside == 1), s, ti[rc_row, pnode]))
-
+        # ---- packed column writes (learner/split_step.py) ------------
+        fa, ia = child_columns(split_a, o["ga"], o["ha"], o["ca"],
+                               o["out_a"], o["cmin_a"], o["cmax_a"],
+                               s, o["side_a"], depth,
+                               extra_i=dict(leaf_begin=begin_a,
+                                            leaf_cnt=cnt_a))
+        fb, ib = child_columns(split_b, o["gb"], o["hb"], o["cb"],
+                               o["out_b"], o["cmin_b"], o["cmax_b"],
+                               s, o["side_b"], depth,
+                               extra_i=dict(leaf_begin=begin_b,
+                                            leaf_cnt=cnt_b))
         st2 = {kk: vv for kk, vv in st_packed.items()
-               if kk not in ("SF", "SI", "TF", "TI")}
-        st2.update(
-            k=k + 1, mat=mat2, ws=ws2, SF=sf, SI=si, TF=tf, TI=ti,
-            bs_bitset=st["bs_bitset"].at[leaf].set(split_l.cat_bitset)
-            .at[new].set(split_r.cat_bitset),
-            cat_bitsets=st["cat_bitsets"].at[s].set(bitset))
+               if kk not in StatePack._MATS}
+        st2.update(pack.set_state_cols(st_packed, idx_a, idx_b,
+                                       fa, fb, ia, ib))
+        st2.update(pack.set_tree_col(
+            st_packed, s,
+            dict(split_gain_arr=gain, internal_value=parent_out,
+                 internal_weight=ph, internal_count=pc),
+            dict(split_feature=feat, threshold_bin=thr,
+                 decision_type=dec, left_child=~leaf, right_child=~new),
+            pnode, upd, pside))
+        st2.update(k=k + 1, mat=mat2, ws=ws2)
+        st2.update(set_bitsets(pack, st, idx_a, idx_b,
+                               split_a.cat_bitset, split_b.cat_bitset,
+                               s, bitset))
         if cache_hists:
-            st2["hist"] = st["hist"].at[leaf].set(hist_left) \
-                .at[new].set(hist_right)
+            st2["hist"] = st["hist"].at[
+                jnp.stack([idx_a, idx_b])].set(
+                jnp.stack([hist_a, hist_b]))
         elif pool_mode:
             # children claim slots: the left child reuses the parent's
             # slot (HistogramPool::Move semantics), the right evicts
@@ -704,14 +717,14 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         if params.cegb_on:
             # shared CEGB helpers mutate whole rows on a view dict;
             # repack writes them back as static-index row updates
-            vv = view_state(st2)
+            vv = pack.view(st2)
             vv["cegb_used"] = cu
             cegb_refund(vv, feat, st["cegb_used"][feat], meta, params)
             cegb_store_row(vv, leaf, pf_l, blk_l)
             cegb_store_row(vv, new, pf_r, blk_r)
             cegb_upgrade_best(vv, feat, st["cegb_used"][feat], leaf,
                               new, big_l)
-            st2 = pack_state(vv)
+            st2 = pack.pack(vv)
         return st2
 
     # forced splits: unrolled static pre-pass (ForceSplits analog);
@@ -719,7 +732,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        v0 = view_state(st)
+        v0 = pack.view(st)
         fh0 = v0["hist"][step[0]] if cache_hists \
             else leaf_hist_any(v0, step[0])
         lg_f, lh_f, _ = forced_left_sums(fh0, v0, step, meta, bundled)
@@ -732,7 +745,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             lambda s: s, st)
 
     st = jax.lax.while_loop(cond, body, st)
-    vf = view_state(st)
+    vf = pack.view(st)
 
     tree = TreeArrays(
         num_leaves=st["k"],

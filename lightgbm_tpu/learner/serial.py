@@ -44,6 +44,10 @@ from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
                          per_feature_splits)
 from ..ops.split_scan_pallas import \
     scan_kernel_default as _scan_kernel_default
+from .split_step import (StatePack, child_columns, child_constraints,
+                         make_grow_pack, order_child_pair,
+                         scan_children, set_bitsets,
+                         split_fusion_default)
 
 _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
                  MISSING_ZERO: MISSING_ZERO_CODE,
@@ -58,6 +62,15 @@ def dataset_any_missing(dataset: Dataset) -> bool:
     search needed)."""
     return any(dataset.feature_mapper(i).missing_type != MISSING_NONE
                for i in range(dataset.num_features))
+
+
+def dataset_has_monotone(dataset: Dataset) -> bool:
+    """Static gate for the grow loops' monotone-bound carry: when no
+    feature carries a monotone constraint the per-leaf cmin/cmax stay
+    ±inf forever, so the fused split step drops them from the carry and
+    compiles the propagation out."""
+    return bool(dataset.monotone_types) \
+        and any(int(t) != 0 for t in dataset.monotone_types)
 
 
 def feature_meta_from_dataset(dataset: Dataset,
@@ -409,77 +422,6 @@ _PF_FIELDS = (("pf_score", "score"), ("pf_thr", "threshold"),
               ("pf_iscat", "is_cat"), ("pf_bitset", "cat_bitset"))
 
 
-class StatePack:
-    """Packed grow-loop state: [K, L] matrices (column = leaf) for the
-    float/int per-leaf state and [K, L-1] matrices for the tree arrays.
-    A naive dict-of-[L]-arrays while_loop carry costs ~44 tiny
-    dynamic-update-slice ops per split plus a 30+-buffer carry; packed,
-    each split issues two column writes per state matrix, one column
-    write per tree matrix, and two column gathers for the split-site
-    reads (the per-split fixed cost the round-3 profile flagged).
-    Bool fields ride the int matrix; unlisted keys pass through."""
-
-    def __init__(self, sf, si, tf, ti,
-                 bools=("bs_dleft", "bs_iscat")):
-        self.sf_fields, self.si_fields = sf, si
-        self.tf_fields, self.ti_fields = tf, ti
-        self.sf_idx = {k: i for i, k in enumerate(sf)}
-        self.si_idx = {k: i for i, k in enumerate(si)}
-        self.tf_idx = {k: i for i, k in enumerate(tf)}
-        self.ti_idx = {k: i for i, k in enumerate(ti)}
-        self.bools = frozenset(bools)
-        self._packed = set(sf) | set(si) | set(tf) | set(ti)
-
-    # field layouts shared by the serial (leaf_id) and partitioned
-    # (segment) grow loops; the partitioned loop prepends its physical
-    # segment bounds to the int matrix
-    GROW_SF = ("leaf_g", "leaf_h", "leaf_c", "bs_gain", "bs_lg",
-               "bs_lh", "bs_lc", "bs_lout", "bs_rout", "leaf_cmin",
-               "leaf_cmax", "leaf_value", "leaf_weight", "leaf_count")
-    GROW_SI = ("bs_feat", "bs_thr", "bs_dleft", "bs_iscat", "ref_node",
-               "ref_side", "leaf_parent", "leaf_depth")
-    GROW_TF = ("split_gain_arr", "internal_value", "internal_weight",
-               "internal_count")
-    GROW_TI = ("split_feature", "threshold_bin", "decision_type",
-               "left_child", "right_child")
-
-    def pack(self, fields: dict) -> dict:
-        """Plain per-field dict -> packed carry (one-time, outside the
-        while_loop; a mutated view repacks the same way — the stacks
-        rebuild the matrices wholesale as 4 concatenates)."""
-        st = {k: v for k, v in fields.items() if k not in self._packed}
-        st["SF"] = jnp.stack([fields[k].astype(jnp.float32)
-                              for k in self.sf_fields])
-        st["SI"] = jnp.stack([fields[k].astype(jnp.int32)
-                              for k in self.si_fields])
-        st["TF"] = jnp.stack([fields[k].astype(jnp.float32)
-                              for k in self.tf_fields])
-        st["TI"] = jnp.stack([fields[k].astype(jnp.int32)
-                              for k in self.ti_fields])
-        return st
-
-    def view(self, st: dict) -> dict:
-        """Packed carry -> per-field dict of row VIEWS (static-index
-        slices XLA folds away); shared helpers (forced_split_override,
-        cegb_*) consume this unchanged."""
-        v = {k: val for k, val in st.items()
-             if k not in ("SF", "SI", "TF", "TI")}
-        for k, i in self.sf_idx.items():
-            v[k] = st["SF"][i]
-        for k, i in self.si_idx.items():
-            v[k] = st["SI"][i].astype(bool) if k in self.bools \
-                else st["SI"][i]
-        for k, i in self.tf_idx.items():
-            v[k] = st["TF"][i]
-        for k, i in self.ti_idx.items():
-            v[k] = st["TI"][i]
-        return v
-
-
-_SERIAL_PACK = StatePack(StatePack.GROW_SF, StatePack.GROW_SI,
-                         StatePack.GROW_TF, StatePack.GROW_TI)
-
-
 def cegb_pf_state(big_l: int, f: int) -> dict:
     """Per-(leaf, feature) RAW candidate cache — the reference's
     ``splits_per_leaf_`` (cost_effective_gradient_boosting.hpp:35,114).
@@ -555,31 +497,6 @@ def cegb_upgrade_best(st: dict, feat, was_used, leaf, new,
         st[bs_key] = jnp.where(do, st[pf_key][:, feat], st[bs_key])
     st["bs_bitset"] = jnp.where(do[:, None], st["pf_bitset"][:, feat],
                                 st["bs_bitset"])
-
-
-def scan_children(comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
-                  rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k):
-    """Best splits of both fresh children. For vmap_safe comms this is
-    ONE vmapped scan: same math, half the op count inside the
-    while_loop body (each [F, B] scan op is tiny; per-op overhead
-    dominates at bench shapes). Collective-bearing selects stay
-    unbatched. Shared by the serial and partitioned grow loops."""
-    if not comm.vmap_safe:
-        return (scan_leaf(hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
-                          2 * k + 1),
-                scan_leaf(hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
-                          2 * k + 2))
-    res2 = jax.vmap(
-        lambda hh, g_, h_, c_, cm, cx, s_: scan_leaf(
-            hh, g_, h_, c_, depth, cm, cx, s_))(
-        jnp.stack([hist_left, hist_right]),
-        jnp.stack([lg, rg]), jnp.stack([lh, rh]),
-        jnp.stack([lc, rc]),
-        jnp.stack([cmin_l, cmin_r]),
-        jnp.stack([cmax_l, cmax_r]),
-        jnp.stack([2 * k + 1, 2 * k + 2]))
-    return (jax.tree.map(lambda x: x[0], res2),
-            jax.tree.map(lambda x: x[1], res2))
 
 
 class CegbStateMixin:
@@ -708,6 +625,7 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
         self.num_leaves = int(config.num_leaves)
         self.max_depth = int(config.max_depth)
         self.hist_method = hist_method
+        self.has_monotone = dataset_has_monotone(dataset)
         self.cache_hists = use_hist_cache(
             config, self.num_leaves, dataset.num_groups,
             self.num_bins_max)
@@ -743,7 +661,9 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
                         forced_plan=self.forced_plan,
                         cache_hists=self.cache_hists,
                         mv_slots=self.mv_slots,
-                        mv_groups=self.mv_groups)
+                        mv_groups=self.mv_groups,
+                        has_monotone=self.has_monotone,
+                        split_fusion=split_fusion_default())
         self._cegb_after_tree(res)
         if res.cegb_charged is not None:
             self._cegb_charged = res.cegb_charged
@@ -761,14 +681,20 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "hist_method", "bundled",
                               "extra_trees", "ff_bynode", "bynode_count",
-                              "forced_plan", "cache_hists", "mv_groups"))
+                              "forced_plan", "cache_hists", "mv_groups",
+                              "has_monotone", "split_fusion"),
+    # the CEGB lazy charged matrix [N, F] is replaced by the grow
+    # result every tree — the input buffer is dead the moment the
+    # program launches, so donate it (the largest state array a CEGB
+    # config carries)
+    donate_argnames=("cegb_charged0",))
 def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
               rand_key=None, cegb_used0=None, cegb_charged0=None,
               mv_slots=None, *,
               params, num_leaves, max_depth, num_bins_max, hist_method,
               bundled=False, extra_trees=False, ff_bynode=1.0,
               bynode_count=2, forced_plan=(), cache_hists=True,
-              mv_groups=0):
+              mv_groups=0, has_monotone=True, split_fusion=True):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
@@ -777,7 +703,9 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
                      ff_bynode=ff_bynode, bynode_count=bynode_count,
                      forced_plan=forced_plan, cache_hists=cache_hists,
                      cegb_used0=cegb_used0, cegb_charged0=cegb_charged0,
-                     mv_slots=mv_slots, mv_groups=mv_groups)
+                     mv_slots=mv_slots, mv_groups=mv_groups,
+                     has_monotone=has_monotone,
+                     split_fusion=split_fusion)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -789,7 +717,9 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               bynode_count=2, bynode_cap: int | None = None,
               forced_plan: tuple = (), cache_hists: bool = True,
               cegb_used0=None, cegb_charged0=None,
-              mv_slots=None, mv_groups: int = 0) -> GrowResult:
+              mv_slots=None, mv_groups: int = 0,
+              has_monotone: bool = True,
+              split_fusion: bool | None = None) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -804,10 +734,16 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     of deriving the sibling by subtraction. Costs one extra histogram
     pass per split, bounds grow-loop HBM by O(F*B) regardless of
     num_leaves.
+
+    ``split_fusion`` selects the per-split state packing
+    (learner/split_step.py): fused (merged single-scatter state, slim
+    carry) or the r05 legacy layout — bit-identical models either way.
     """
     if comm is None:
         from .comm import SERIAL_COMM
         comm = SERIAL_COMM
+    if split_fusion is None:
+        split_fusion = split_fusion_default()
     if binned_hist is None:
         binned_hist = binned
     if meta_hist is None:
@@ -835,6 +771,12 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     root_g, root_h, root_c = root_sums[0], root_sums[1], root_sums[2]
 
     inf = jnp.float32(jnp.inf)
+    # static per-trace packing of the grow-loop carry
+    # (learner/split_step.py): fused = merged single-scatter state +
+    # slim carry; legacy = the r05 split-matrix layout
+    pack = make_grow_pack(merged=split_fusion,
+                          has_cat=params.has_categorical,
+                          has_monotone=has_monotone, big_l=big_l)
     # the scan's feature axis is LOGICAL features (EFB hists debundle
     # before select_split), so draws span meta_hist's length, not the
     # physical group count
@@ -970,12 +912,9 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         cegb_store_row(fields, 0, root_pf, root_blocked)
         if params.cegb_lazy_on:
             fields["cegb_charged"] = cegb_charged0
-    state = _SERIAL_PACK.pack(fields)
+    state = pack.pack(fields)
 
     leaf_range = jnp.arange(big_l)
-    SF_IDX = _SERIAL_PACK.sf_idx
-    SI_IDX = _SERIAL_PACK.si_idx
-    TI_IDX = _SERIAL_PACK.ti_idx
 
     def leaf_hist_masked(v, leaf):
         """Pool-bounded mode: rebuild one leaf's histogram on demand."""
@@ -984,7 +923,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         return comm.reduce_hist(full_hist(ghc_leaf))
 
     def cond(st):
-        bs_gain = st["SF"][SF_IDX["bs_gain"]]
+        bs_gain = pack.row_f(st, "bs_gain")
         open_gain = jnp.where(leaf_range < st["k"], bs_gain, -jnp.inf)
         # best gain <= 0 stops training (serial_tree_learner.cpp Train;
         # equivalent to the old isfinite check for unpenalized gains,
@@ -992,7 +931,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         return (st["k"] < big_l) & (open_gain.max() > 0.0)
 
     def body(st_packed, forced=None, forced_hist=None):
-        st = _SERIAL_PACK.view(st_packed)  # row views, folded by XLA
+        st = pack.view(st_packed)  # row views, folded by XLA
         k = st["k"]
         new = k
         s = k - 1  # internal node index for this split
@@ -1001,23 +940,18 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             open_gain = jnp.where(leaf_range < k, st["bs_gain"],
                                   -jnp.inf)
             leaf = jnp.argmax(open_gain).astype(jnp.int32)
-            # TWO column gathers replace ~22 per-field scalar reads
-            colf = st_packed["SF"][:, leaf]
-            coli = st_packed["SI"][:, leaf]
-            feat = coli[SI_IDX["bs_feat"]]
-            thr = coli[SI_IDX["bs_thr"]]
-            dleft = coli[SI_IDX["bs_dleft"]].astype(bool)
-            gain = colf[SF_IDX["bs_gain"]]
-            is_cat = coli[SI_IDX["bs_iscat"]].astype(bool)
+            # ONE column slice replaces ~22 per-field scalar reads
+            site = pack.read_site(st_packed, leaf)
+            feat = site["bs_feat"]
+            thr = site["bs_thr"]
+            dleft = site["bs_dleft"]
+            gain = site["bs_gain"]
+            is_cat = site["bs_iscat"]
             bitset = st["bs_bitset"][leaf]
-            lg, lh, lc = (colf[SF_IDX["bs_lg"]], colf[SF_IDX["bs_lh"]],
-                          colf[SF_IDX["bs_lc"]])
-            pg, ph, pc = (colf[SF_IDX["leaf_g"]],
-                          colf[SF_IDX["leaf_h"]],
-                          colf[SF_IDX["leaf_c"]])
+            lg, lh, lc = site["bs_lg"], site["bs_lh"], site["bs_lc"]
+            pg, ph, pc = site["leaf_g"], site["leaf_h"], site["leaf_c"]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
-            lout, rout = (colf[SF_IDX["bs_lout"]],
-                          colf[SF_IDX["bs_rout"]])
+            lout, rout = site["bs_lout"], site["bs_rout"]
         else:
             fh = forced_hist if forced_hist is not None \
                 else st["hist"][forced[0]] if cache_hists \
@@ -1026,8 +960,11 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
              lg, lh, lc, pg, ph, pc, rg, rh, rc, lout, rout) = \
                 forced_split_override(fh, st, forced, params, meta_hist,
                                       bundled)
-            colf = st_packed["SF"][:, leaf]
-            coli = st_packed["SI"][:, leaf]
+            site = pack.read_site(st_packed, leaf)
+        # monotone bounds drop out of the carry (and the site read)
+        # when no feature has a monotone constraint
+        pcmin = site.get("leaf_cmin", -inf)
+        pcmax = site.get("leaf_cmax", inf)
 
         # ---- partition rows of `leaf` ---------------------------------
         grp = meta.group[feat]
@@ -1066,48 +1003,44 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
 
         # ---- tree arrays ---------------------------------------------
         dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
-        ref_node = coli[SI_IDX["ref_node"]]
+        ref_node = site["ref_node"]
         upd = ref_node >= 0
         pnode = jnp.where(upd, ref_node, 0)
-        pside = coli[SI_IDX["ref_side"]]
+        pside = site["ref_side"]
 
-        depth = coli[SI_IDX["leaf_depth"]] + 1
+        depth = site["leaf_depth"] + 1
         parent_out = leaf_output_no_constraint(
             pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
             params.max_delta_step)
 
         # ---- histograms: smaller child built, sibling by subtraction
-        # (pool-bounded mode: no parent cache -> build both directly) --
+        # (pool-bounded mode: no parent cache -> build both directly).
+        # The fused path carries the pair in (smaller, other) order —
+        # the state/hist writes key on the child's leaf index, so the
+        # two [F, B, 3] left/right reorder selects vanish ------------
         if cache_hists:
             parent_hist = st["hist"][leaf]
-            small = jnp.where(lc <= rc, leaf, new)
-            ghc_small = ghc * (leaf_id == small).astype(
+            small_is_left = lc <= rc
+            sm = jnp.where(small_is_left, leaf, new)
+            ghc_small = ghc * (leaf_id == sm).astype(
                 jnp.float32)[:, None]
             hist_small = comm.reduce_hist(full_hist(ghc_small))
             hist_other = parent_hist - hist_small
-            left_small = lc <= rc
-            hist_left = jnp.where(left_small, hist_small, hist_other)
-            hist_right = jnp.where(left_small, hist_other, hist_small)
+            if params.cegb_on:
+                hist_left = jnp.where(small_is_left, hist_small,
+                                      hist_other)
+                hist_right = jnp.where(small_is_left, hist_other,
+                                       hist_small)
         else:
             st_after = dict(st, leaf_id=leaf_id)
             hist_left = leaf_hist_masked(st_after, leaf)
             hist_right = leaf_hist_masked(st_after, new)
 
         # ---- monotone constraint propagation -------------------------
-        # (LeafConstraints::UpdateConstraints monotone_constraints.hpp:44)
-        mono = meta.monotone[feat]
-        mid = (lout + rout) * 0.5
-        pcmin = colf[SF_IDX["leaf_cmin"]]
-        pcmax = colf[SF_IDX["leaf_cmax"]]
-        numerical = ~is_cat
-        cmin_l = jnp.where(numerical & (mono < 0),
-                           jnp.maximum(pcmin, mid), pcmin)
-        cmax_l = jnp.where(numerical & (mono > 0),
-                           jnp.minimum(pcmax, mid), pcmax)
-        cmin_r = jnp.where(numerical & (mono > 0),
-                           jnp.maximum(pcmin, mid), pcmin)
-        cmax_r = jnp.where(numerical & (mono < 0),
-                           jnp.minimum(pcmax, mid), pcmax)
+        # (LeafConstraints::UpdateConstraints monotone_constraints.hpp:44;
+        # compiled out when no feature has a monotone constraint)
+        cmin_l, cmax_l, cmin_r, cmax_r = child_constraints(
+            meta, feat, is_cat, lout, rout, pcmin, pcmax, has_monotone)
 
         # ---- child best splits ---------------------------------------
         # CEGB: the feature just split is "acquired" for the children's
@@ -1125,68 +1058,68 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                     charged2, (leaf_id == leaf) & used_rows)
                 unch_r = lazy_uncharged(
                     charged2, (leaf_id == new) & used_rows)
-            split_l, pf_l, blk_l = scan_leaf_pf(
+            split_a, pf_l, blk_l = scan_leaf_pf(
                 hist_left, lg, lh, lc, depth, cmin_l, cmax_l,
                 2 * k + 1, cu, unch_l)
-            split_r, pf_r, blk_r = scan_leaf_pf(
+            split_b, pf_r, blk_r = scan_leaf_pf(
                 hist_right, rg, rh, rc, depth, cmin_r, cmax_r,
                 2 * k + 2, cu, unch_r)
+            idx_a, idx_b = leaf, new
+            hist_a, hist_b = hist_left, hist_right
+            o = order_child_pair(
+                jnp.bool_(True), k, lg, lh, lc, rg, rh, rc, lout, rout,
+                cmin_l, cmax_l, cmin_r, cmax_r)
         else:
-            cu = None
-            split_l, split_r = scan_children(
-                comm, scan_leaf, hist_left, hist_right, lg, lh, lc,
-                rg, rh, rc, depth, cmin_l, cmax_l, cmin_r, cmax_r, k)
+            if cache_hists:
+                a_is_left = small_is_left
+                idx_a = sm
+                idx_b = jnp.where(small_is_left, new, leaf)
+                hist_a, hist_b = hist_small, hist_other
+            else:
+                a_is_left = jnp.bool_(True)
+                idx_a, idx_b = leaf, new
+                hist_a, hist_b = hist_left, hist_right
+            o = order_child_pair(
+                a_is_left, k, lg, lh, lc, rg, rh, rc, lout, rout,
+                cmin_l, cmax_l, cmin_r, cmax_r)
+            split_a, split_b = scan_children(
+                comm, scan_leaf, hist_a, hist_b, o["ga"], o["ha"],
+                o["ca"], o["gb"], o["hb"], o["cb"], depth, o["cmin_a"],
+                o["cmax_a"], o["cmin_b"], o["cmax_b"], o["salt_a"],
+                o["salt_b"])
 
-        # ---- packed column writes: 2 columns per state matrix, one
-        # column per tree matrix (see learner/partitioned.py) ----------
-        i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
-        f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
-        uf_leaf = jnp.stack([
-            lg, lh, lc, split_l.gain, split_l.left_g, split_l.left_h,
-            split_l.left_c, split_l.left_output, split_l.right_output,
-            cmin_l, cmax_l, lout, f32(lh), f32(lc)])
-        uf_new = jnp.stack([
-            rg, rh, rc, split_r.gain, split_r.left_g, split_r.left_h,
-            split_r.left_c, split_r.left_output, split_r.right_output,
-            cmin_r, cmax_r, rout, f32(rh), f32(rc)])
-        ui_leaf = jnp.stack([
-            split_l.feature, split_l.threshold,
-            i32(split_l.default_left), i32(split_l.is_cat), s,
-            jnp.int32(0), s, depth])
-        ui_new = jnp.stack([
-            split_r.feature, split_r.threshold,
-            i32(split_r.default_left), i32(split_r.is_cat), s,
-            jnp.int32(1), s, depth])
-        sf = st_packed["SF"].at[:, leaf].set(uf_leaf) \
-            .at[:, new].set(uf_new)
-        si = st_packed["SI"].at[:, leaf].set(ui_leaf) \
-            .at[:, new].set(ui_new)
-        tf = st_packed["TF"].at[:, s].set(
-            jnp.stack([gain, parent_out, ph, pc]))
-        ti = st_packed["TI"].at[:, s].set(
-            jnp.stack([feat, thr, dec, ~leaf, ~new]))
-        # pointer fixups on the parent node's child slots
-        lc_row, rc_row = TI_IDX["left_child"], TI_IDX["right_child"]
-        ti = ti.at[lc_row, pnode].set(
-            jnp.where(upd & (pside == 0), s, ti[lc_row, pnode]))
-        ti = ti.at[rc_row, pnode].set(
-            jnp.where(upd & (pside == 1), s, ti[rc_row, pnode]))
-
+        # ---- packed column writes (learner/split_step.py): fused =
+        # one scatter per state/tree matrix; legacy = the r05 writes --
+        fa, ia = child_columns(split_a, o["ga"], o["ha"], o["ca"],
+                               o["out_a"], o["cmin_a"], o["cmax_a"],
+                               s, o["side_a"], depth)
+        fb, ib = child_columns(split_b, o["gb"], o["hb"], o["cb"],
+                               o["out_b"], o["cmin_b"], o["cmax_b"],
+                               s, o["side_b"], depth)
         st2 = {kk: vv for kk, vv in st_packed.items()
-               if kk not in ("SF", "SI", "TF", "TI")}
-        st2.update(
-            k=k + 1, leaf_id=leaf_id, SF=sf, SI=si, TF=tf, TI=ti,
-            bs_bitset=st["bs_bitset"].at[leaf].set(split_l.cat_bitset)
-            .at[new].set(split_r.cat_bitset),
-            cat_bitsets=st["cat_bitsets"].at[s].set(bitset))
+               if kk not in StatePack._MATS}
+        st2.update(pack.set_state_cols(st_packed, idx_a, idx_b,
+                                       fa, fb, ia, ib))
+        st2.update(pack.set_tree_col(
+            st_packed, s,
+            dict(split_gain_arr=gain, internal_value=parent_out,
+                 internal_weight=ph, internal_count=pc),
+            dict(split_feature=feat, threshold_bin=thr,
+                 decision_type=dec, left_child=~leaf, right_child=~new),
+            pnode, upd, pside))
+        st2.update(k=k + 1, leaf_id=leaf_id)
+        st2.update(set_bitsets(pack, st, idx_a, idx_b,
+                               split_a.cat_bitset, split_b.cat_bitset,
+                               s, bitset))
         if cache_hists:
-            st2["hist"] = st["hist"].at[leaf].set(hist_left) \
-                .at[new].set(hist_right)
+            st2["hist"] = st["hist"].at[
+                jnp.stack([idx_a, idx_b])].set(
+                jnp.stack([hist_a, hist_b]))
         if params.cegb_on:
             # shared CEGB helpers mutate whole rows on a view dict;
             # repacking writes them back (refund BEFORE the children's
             # rows land — their scans already saw `feat` acquired)
-            vv = _SERIAL_PACK.view(st2)
+            vv = pack.view(st2)
             vv["cegb_used"] = cu
             if params.cegb_lazy_on:
                 vv["cegb_charged"] = charged2
@@ -1196,7 +1129,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             cegb_store_row(vv, new, pf_r, blk_r)
             cegb_upgrade_best(vv, feat, st["cegb_used"][feat], leaf,
                               new, big_l)
-            st2 = _SERIAL_PACK.pack(vv)
+            st2 = pack.pack(vv)
         return st2
 
     # ---- forced splits: unrolled static pre-pass (ForceSplits,
@@ -1205,7 +1138,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     st = state
     force_ok = jnp.bool_(True)
     for step in forced_plan:
-        v0 = _SERIAL_PACK.view(st)
+        v0 = pack.view(st)
         fh0 = v0["hist"][step[0]] if cache_hists \
             else leaf_hist_masked(v0, step[0])
         lg_f, lh_f, _ = forced_left_sums(fh0, v0, step, meta_hist,
@@ -1219,7 +1152,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             lambda s: s, st)
 
     st = jax.lax.while_loop(cond, body, st)
-    vf = _SERIAL_PACK.view(st)
+    vf = pack.view(st)
 
     tree = TreeArrays(
         num_leaves=st["k"],

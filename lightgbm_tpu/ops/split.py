@@ -202,6 +202,18 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     IS_RAND template paths, feature_histogram.hpp:555-709 rand_threshold_):
     [F] i32 of one uniformly-drawn candidate threshold per feature —
     both scan directions consider ONLY that bin.
+
+    The cumulative machinery runs CHANNEL-STACKED on a [3, F, B]
+    channels-FIRST tensor — one cumsum / one reduce / one
+    winning-threshold gather per scan direction instead of three — so
+    the compiled while-loop body carries ~3x fewer per-split ops. The
+    bin axis stays MINOR exactly as in the per-channel [F, B]
+    formulation, so each channel's reduction runs over the same
+    contiguous layout with the same vectorized accumulation order and
+    every value is bit-identical to the unstacked scan (a
+    channels-last [F, B, 3] stack is NOT: reducing the then-strided
+    bin axis changes the accumulation order under vectorization —
+    observed at AVX2 — and flips last-ulp rounding).
     """
     f, b, _ = hist.shape
     p = params
@@ -210,9 +222,6 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     if constraint_max is None:
         constraint_max = jnp.float32(jnp.inf)
 
-    g = hist[..., 0]
-    h = hist[..., 1]
-    c = hist[..., 2]
     bins = jnp.arange(b, dtype=jnp.int32)[None, :]          # [1,B]
     nb = meta.num_bins[:, None]                              # [F,1]
     missing = meta.missing[:, None]
@@ -220,12 +229,27 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     monotone = meta.monotone[:, None]
 
     parent_h_eps = parent_h + 2.0 * kEpsilon
+    # (parent_g, parent_h + 2eps, parent_c) as a [3, 1, 1] channel
+    # vector; the kEpsilon seed lands on the hessian channel ONLY via
+    # a channel select (an unconditional `+ [0, eps, 0]` would rewrite
+    # -0.0 bins to +0.0 on the grad/count channels — a bit-level
+    # divergence)
+    parents = jnp.stack([jnp.asarray(parent_g, jnp.float32),
+                         jnp.asarray(parent_h_eps, jnp.float32),
+                         jnp.asarray(parent_c, jnp.float32)]
+                        )[:, None, None]
+    ch_is_h = jnp.asarray([False, True, False])[:, None, None]
+
+    def seed_h(x):
+        return jnp.where(ch_is_h, x + kEpsilon, x)
+
+    hist_cf = jnp.moveaxis(hist, -1, 0)                      # [3,F,B]
     gain_shift = leaf_split_gain(parent_g, parent_h_eps, p.lambda_l1,
                                  p.lambda_l2, p.max_delta_step)
     min_gain_shift = gain_shift + p.min_gain_to_split
 
     def masked(x, m):
-        return jnp.where(m, 0.0, x)
+        return jnp.where(m[None, :, :], 0.0, x)
 
     if p.any_missing:
         # reference runs the two-scan path only when num_bin > 2 and
@@ -237,10 +261,11 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
         is_na_bin = na_excl & (bins == nb - 1)
 
         # ---- dir=+1: left-to-right; default/NaN implicitly go right ----
-        lg_p = jnp.cumsum(masked(g, skip_default), axis=1)
-        lh_p = jnp.cumsum(masked(h, skip_default), axis=1)
-        lc_p = jnp.cumsum(masked(c, skip_default), axis=1)
-        hl_p = lh_p + kEpsilon
+        # left sums at threshold t = cumsum of masked bins <= t, with
+        # the kEpsilon seed on the hessian channel
+        left_p = seed_h(jnp.cumsum(masked(hist_cf, skip_default),
+                                   axis=2))
+        lg_p, hl_p, lc_p = left_p[0], left_p[1], left_p[2]
         hr_p = parent_h_eps - hl_p
         gr_p = parent_g - lg_p
         cr_p = parent_c - lc_p
@@ -255,27 +280,23 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                                constraint_min, constraint_max)
         score_p = jnp.where(valid_p & (gains_p > min_gain_shift),
                             gains_p, NEG_INF)
-        mask_m = skip_default | is_na_bin
-        g_m = masked(g, mask_m)
-        h_m = masked(h, mask_m)
-        c_m = masked(c, mask_m)
+        hist_m = masked(hist_cf, skip_default | is_na_bin)
     else:
         # static no-missing fast path (set by the learner from the bin
         # mappers): two_scan would be all-False, so the dir=+1 scan can
         # never record a split and every missing mask vanishes — only
         # the dir=-1 scan below compiles (the reference's one-scan path
         # for MissingType::None, feature_histogram.hpp:555-709)
-        g_m, h_m, c_m = g, h, c
+        hist_m = hist_cf
 
     # ---- dir=-1: right-to-left; default/NaN implicitly go left ---------
-    # right side at threshold t = sum of masked bins > t
-    rg_m = g_m.sum(axis=1, keepdims=True) - jnp.cumsum(g_m, axis=1)
-    rh_m = h_m.sum(axis=1, keepdims=True) - jnp.cumsum(h_m, axis=1)
-    rc_m = c_m.sum(axis=1, keepdims=True) - jnp.cumsum(c_m, axis=1)
-    hr_m = rh_m + kEpsilon
-    hl_m = parent_h_eps - hr_m
-    gl_m = parent_g - rg_m
-    cl_m = parent_c - rc_m
+    # right side at threshold t = sum of masked bins > t (hessian
+    # channel seeded with kEpsilon); left side = parents - right
+    right_m = seed_h(hist_m.sum(axis=2, keepdims=True)
+                     - jnp.cumsum(hist_m, axis=2))
+    left_m = parents - right_m
+    rg_m, hr_m, rc_m = right_m[0], right_m[1], right_m[2]
+    gl_m, hl_m, cl_m = left_m[0], left_m[1], left_m[2]
     if p.any_missing:
         valid_m = bins <= nb - 2 - na_excl.astype(jnp.int32)
     else:
@@ -299,7 +320,6 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     # ---- per-feature best with reference iteration-order tie-breaks ----
     t_m = _argmax_last(score_m, axis=1)                      # [F]
     v_m = jnp.take_along_axis(score_m, t_m[:, None], axis=1)[:, 0]
-    fr = jnp.arange(f)
     if p.any_missing:
         t_p = jnp.argmax(score_p, axis=1)
         v_p = jnp.take_along_axis(score_p, t_p[:, None], axis=1)[:, 0]
@@ -317,13 +337,18 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
     feat_score = jnp.where(
         feat_valid, (feat_gain - min_gain_shift) * meta.penalty, NEG_INF)
 
-    # left-side sums at each feature's winning threshold
+    # left-side sums at each feature's winning threshold: ONE stacked
+    # [3, F] gather per direction instead of three scalar-channel
+    # gathers (the seeded left tensors already exist channel-stacked)
+    lf_m = jnp.take_along_axis(left_m, t_m[None, :, None],
+                               axis=2)[:, :, 0]              # [3, F]
     if p.any_missing:
-        lg_f = jnp.where(use_m, gl_m[fr, t_m], lg_p[fr, t_p])
-        lh_f = jnp.where(use_m, hl_m[fr, t_m], hl_p[fr, t_p])
-        lc_f = jnp.where(use_m, cl_m[fr, t_m], lc_p[fr, t_p])
+        lf_p = jnp.take_along_axis(left_p, t_p[None, :, None],
+                                   axis=2)[:, :, 0]
+        lf = jnp.where(use_m[None, :], lf_m, lf_p)
     else:
-        lg_f, lh_f, lc_f = gl_m[fr, t_m], hl_m[fr, t_m], cl_m[fr, t_m]
+        lf = lf_m
+    lg_f, lh_f, lc_f = lf[0], lf[1], lf[2]
 
     # default direction: -1 scan => left; 2-bin NaN fix goes right
     # (feature_histogram.hpp:127-130)
@@ -443,15 +468,24 @@ def assemble_split(pf: PerFeatureSplits, best_f,
     the GLOBAL id while indexing their local shard.
     """
     fid = best_f if feature_id is None else feature_id
+    # two packed column gathers (f32 fields / int-ish fields) + the
+    # bitset row replace ten scalar gathers — the per-split dispatch
+    # economy the fused grow loop counts on (tools/hlo_census.py)
+    fpack = jnp.stack([pf.score, pf.left_g, pf.left_h, pf.left_c,
+                       pf.left_output, pf.right_output])      # [6, F]
+    ipack = jnp.stack([pf.threshold,
+                       pf.default_left.astype(jnp.int32),
+                       pf.is_cat.astype(jnp.int32)])          # [3, F]
+    fv = fpack[:, best_f]
+    iv = ipack[:, best_f]
     return SplitResult(
-        gain=pf.score[best_f], feature=jnp.asarray(fid, jnp.int32),
-        threshold=pf.threshold[best_f],
-        default_left=pf.default_left[best_f],
-        left_g=pf.left_g[best_f], left_h=pf.left_h[best_f],
-        left_c=pf.left_c[best_f],
-        left_output=pf.left_output[best_f],
-        right_output=pf.right_output[best_f],
-        is_cat=pf.is_cat[best_f],
+        gain=fv[0], feature=jnp.asarray(fid, jnp.int32),
+        threshold=iv[0],
+        default_left=iv[1].astype(bool),
+        left_g=fv[1], left_h=fv[2], left_c=fv[3],
+        left_output=fv[4],
+        right_output=fv[5],
+        is_cat=iv[2].astype(bool),
         cat_bitset=pf.cat_bitset[best_f])
 
 

@@ -214,7 +214,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                 forced_plan=self.forced_plan,  # hist cache is psum'ed
                 cache_hists=self.cache_hists,
                 cegb_used0=cegb0 if self.params.cegb_on else None,
-                mv_slots=mv_l, mv_groups=mv_groups)
+                mv_slots=mv_l, mv_groups=mv_groups,
+                has_monotone=self.has_monotone)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -359,7 +360,8 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                 bundled=self.bundled,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=bn_local, bynode_cap=bn_cap,
-                cache_hists=self.cache_hists)
+                cache_hists=self.cache_hists,
+                has_monotone=self.has_monotone)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -427,7 +429,8 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
                 bynode_count=self.bynode_count,
                 cache_hists=self.cache_hists,
-                mv_slots=mv_l, mv_groups=mv_groups)
+                mv_slots=mv_l, mv_groups=mv_groups,
+                has_monotone=self.has_monotone)
 
         mapped = shard_map(
             body, mesh=self.mesh,
@@ -536,6 +539,7 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                 row_id_base=base, n_total=n_pad,
                 cache_hists=self.cache_hists,
                 cegb_used0=cegb0 if self.params.cegb_on else None,
+                has_monotone=self.has_monotone,
                 return_leaf_parts=leaf_parts)
             if leaf_parts:
                 mat_l, ws_l, tree, (rid_l, pos_leaf) = out

@@ -487,6 +487,29 @@ def test_bench_trend_fixed_baseline_regression(tmp_path, capsys):
     assert bt.main([a, b]) == 0
 
 
+def test_bench_trend_dispatch_census_series(tmp_path):
+    """dispatches_per_split chains per baseline_config (lower is
+    better): a >20% increase fails, a config bump breaks the chain."""
+    bt = _load_tool("bench_trend")
+    a, b = str(tmp_path / "BENCH_r06.json"), \
+        str(tmp_path / "BENCH_r07.json")
+    disp = {"metric": "dispatches_per_split", "value": 44.0,
+            "baseline_config": "cpu-fixed-v1"}
+    _mk_round(a, 6, [disp, _FIXED, _HEAD])
+    _mk_round(b, 7, [dict(disp, value=56.0), _FIXED, _HEAD])  # +27%
+    rep = str(tmp_path / "rep.json")
+    assert bt.main([a, b, "--quiet", "--report", rep]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    assert any(r["series"] == "dispatches_per_split"
+               for r in report["regressions"])
+    # fewer dispatches never regress; the value also rides the fixed
+    # baseline line itself
+    fixed_carry = dict(_FIXED, dispatches_per_split=40.0)
+    _mk_round(b, 7, [fixed_carry, _HEAD])
+    assert bt.main([a, b, "--quiet"]) == 0
+
+
 def test_bench_trend_serving_p99_and_config_bump(tmp_path):
     bt = _load_tool("bench_trend")
     a, b = str(tmp_path / "BENCH_r06.json"), \
@@ -532,6 +555,33 @@ def test_run_report_renders_hist_records_and_probe(tel, tmp_path):
     text = rr.render(rr.load(path))
     assert "histograms (live metrics plane)" in text
     assert "tpu probe" in text and "hung > 90s" in text
+
+
+def test_run_report_renders_dispatch_census(tmp_path):
+    """The census artifact renders standalone AND automatically next
+    to a trace report when bench_census.json sits beside the trace."""
+    rr = _load_tool("run_report")
+    art = {"config": {"features": 8, "leaves": 15, "backend": "cpu",
+                      "split_fusion": True},
+           "programs": {"serial_grow": {
+               "ops_per_split": 44, "fusions": 28, "inner_whiles": 3,
+               "collectives": 0, "carry_arrays": 24,
+               "carry_bytes": 294508}}}
+    path = str(tmp_path / "bench_census.json")
+    with open(path, "w") as fh:
+        json.dump(art, fh)
+    loaded = rr.load_census(path)
+    assert loaded is not None
+    text = rr.render_census(loaded)
+    assert "per-split dispatch census" in text
+    assert "serial_grow" in text and "44" in text
+    # sibling detection from a trace path in the same directory
+    assert rr.sibling_census(str(tmp_path / "t.jsonl")) is not None
+    # a crash dump / trace is NOT mistaken for a census artifact
+    tr = str(tmp_path / "t2.json")
+    with open(tr, "w") as fh:
+        json.dump({"flight_recorder": 1, "programs": 3}, fh)
+    assert rr.load_census(tr) is None
 
 
 def test_run_report_renders_crash_dump(tmp_path):

@@ -46,6 +46,7 @@ DEFAULT_THRESHOLD = 0.20
 
 FIXED_METRIC = "cpu_fixed_baseline_throughput"
 HEADLINE_METRIC = "higgs_like_train_throughput"
+DISPATCH_METRIC = "dispatches_per_split"
 
 
 def extract_lines(text: str) -> List[Dict[str, Any]]:
@@ -123,6 +124,25 @@ def _serving_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     return found
 
 
+def _dispatch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's census-derived dispatches/split (bench.py
+    run_dispatch_census): the serial grow program's compiled while-body
+    op count on the fixed CPU config — lower is better; keyed by the
+    baseline config id so shape bumps break the chain deliberately.
+    The value also rides the cpu_fixed_baseline_throughput line."""
+    found = None
+    for ln in lines:
+        v = None
+        if ln.get("metric") == DISPATCH_METRIC:
+            v = ln.get("value")
+        elif ln.get("metric") == FIXED_METRIC:
+            v = ln.get("dispatches_per_split")
+        if v is not None and ln.get("baseline_config"):
+            found = {"value": float(v),
+                     "key": str(ln["baseline_config"])}
+    return found
+
+
 def _headline_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     for ln in reversed(lines):
         if ln.get("metric") == HEADLINE_METRIC \
@@ -160,7 +180,7 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
 
 def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
-    fixed, serving, headline = [], [], []
+    fixed, serving, headline, dispatch = [], [], [], []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -171,10 +191,14 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _headline_point(rnd["lines"])
         if p is not None:
             headline.append((rnd["label"], p))
+        p = _dispatch_point(rnd["lines"])
+        if p is not None:
+            dispatch.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
     regressions += _gate(serving, False, threshold, "serving_p99_ms")
+    regressions += _gate(dispatch, False, threshold, DISPATCH_METRIC)
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -183,12 +207,15 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in fixed],
             "serving_p99_ms": [
                 {"round": lb, **pt} for lb, pt in serving],
+            DISPATCH_METRIC: [
+                {"round": lb, **pt} for lb, pt in dispatch],
             # informational only — config drifts across rounds
             HEADLINE_METRIC + "_ungated": [
                 {"round": lb, **pt} for lb, pt in headline],
         },
         "gated_points": {FIXED_METRIC: len(fixed),
-                         "serving_p99_ms": len(serving)},
+                         "serving_p99_ms": len(serving),
+                         DISPATCH_METRIC: len(dispatch)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",
     }

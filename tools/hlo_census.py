@@ -1,0 +1,350 @@
+"""Compiled-HLO dispatch census for the fused grow-loop programs.
+
+The grow loop compiles to ONE ``lax.while_loop`` program per tree; what
+the hardware actually pays per split is the number of executable ops in
+the compiled while-loop BODY (each fusion / reduce / scatter / inner
+loop is one dispatch on CPU and one kernel launch worth of fixed cost
+on an accelerator). This tool lowers the repo's grow programs at a
+fixed config, finds the grow ``while`` in the optimized HLO, counts the
+body's non-trivial ops, and compares the result against the committed
+budget (``tools/hlo_census_budget.json``) — CI fails when a change
+regresses the per-split dispatch count (the round-6 directive: prove
+the per-split fixed-cost reduction with an op census, VERDICT item 2).
+
+Usage:
+  python -m tools.hlo_census            # print the census table
+  python -m tools.hlo_census --check    # exit 1 on budget regression
+  python -m tools.hlo_census --update   # rewrite budget measurements
+  python -m tools.hlo_census --json F   # also write the census artifact
+
+Counting rules (deliberately simple and stable):
+  * the grow while is the ``while`` op WITHOUT a ``known_trip_count``
+    backend_config (scatter expansions and pallas grid loops are
+    trip-counted) whose body holds the most non-trivial ops;
+  * non-trivial = everything except parameter / constant / tuple /
+    get-tuple-element / bitcast (pure bookkeeping that costs nothing);
+  * inner ``while`` ops (CPU scatter expansion, interpret-mode Pallas
+    grids) count as ONE op each — on TPU they are one kernel.
+
+The numbers are CPU-backend numbers and comparable only to each other
+(the partitioned program carries interpret-mode Pallas emulation glue
+that does not exist on TPU), which is exactly what a trend gate needs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from collections import Counter
+
+# the census must run on CPU regardless of the ambient platform (and
+# must never dial a TPU tunnel from CI)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+BUDGET_PATH = os.path.join(os.path.dirname(__file__),
+                           "hlo_census_budget.json")
+
+# fixed census config: the bench fixed CPU baseline's shape family
+# (cpu-fixed-v1: 28 features, 63 leaves; see bench.py CPU_BASELINE_ID).
+# Rows are scaled down — the while-body op census is row-count
+# independent (row count only scales tensor shapes, never the op list)
+# — so the compile stays fast enough for CI.
+CENSUS_ROWS = 4096
+CENSUS_FEATURES = 28
+CENSUS_LEAVES = 63
+
+_TRIVIAL = ("get-tuple-element", "parameter", "constant", "tuple",
+            "bitcast")
+_TYPES = ("f32", "s32", "u32", "u8", "pred", "u16", "bf16", "s8",
+          "s64", "f64", "u64", "c64", "c128", "s16", "f16")
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8}
+
+
+def _op_of(line: str):
+    """HLO opcode of one instruction line (first known-op token
+    preceding a paren that is not a dtype)."""
+    rhs = line.split(" = ", 1)[1]
+    for cand in re.findall(r"([a-z][a-z0-9\-]*)\(", rhs):
+        if cand not in _TYPES:
+            return cand
+    return None
+
+
+def _shape_bytes(shape: str) -> int:
+    m = re.match(r"([a-z0-9]+)\[([\d,]*)\]", shape)
+    if not m:
+        return 0
+    n = 1
+    for d in m.group(2).split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(m.group(1), 4)
+
+
+def _carry_stats(line: str):
+    """(elements, bytes) of a while instruction's carry tuple."""
+    m = re.search(r"= \((.*?)\) while\(", line)
+    if not m:
+        return 0, 0
+    shapes = re.findall(r"[a-z0-9]+\[[\d,]*\](?:\{[\d,]*\})?",
+                        m.group(1))
+    return len(shapes), sum(_shape_bytes(s) for s in shapes)
+
+
+def census_from_hlo(txt: str) -> dict:
+    """Census of the grow while loop inside one compiled HLO module."""
+    lines = txt.splitlines()
+    candidates = []  # (body_name, carry_elems, carry_bytes)
+    for m in re.finditer(r"body=(%[\w.\-]+)", txt):
+        s = txt.rfind("\n", 0, m.start()) + 1
+        line = txt[s:txt.find("\n", m.end())]
+        if "known_trip_count" in line:
+            continue
+        elems, nbytes = _carry_stats(line)
+        candidates.append((m.group(1), elems, nbytes))
+    best = None
+    for body, elems, nbytes in candidates:
+        start = None
+        for i, ln in enumerate(lines):
+            if ln.startswith(body + " "):
+                start = i
+                break
+        if start is None:
+            continue
+        ops = Counter()
+        for ln in lines[start + 1:]:
+            if ln.startswith("}"):
+                break
+            if " = " not in ln:
+                continue
+            op = _op_of(ln)
+            if op:
+                ops[op] += 1
+        total = sum(ops.values())
+        nontrivial = total - sum(ops[t] for t in _TRIVIAL)
+        if best is None or nontrivial > best["ops_per_split"]:
+            best = dict(
+                body=body.lstrip("%"),
+                ops_per_split=nontrivial,
+                total_instructions=total,
+                fusions=ops.get("fusion", 0),
+                inner_whiles=ops.get("while", 0),
+                collectives=sum(ops.get(c, 0) for c in _COLLECTIVES),
+                carry_arrays=elems,
+                carry_bytes=nbytes,
+                op_histogram={k: v for k, v in sorted(
+                    ops.items(), key=lambda kv: -kv[1])},
+            )
+    if best is None:
+        raise RuntimeError("no grow while loop found in compiled HLO")
+    return best
+
+
+def _build_dataset(rows=CENSUS_ROWS, features=CENSUS_FEATURES,
+                   leaves=CENSUS_LEAVES):
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import Dataset
+    rng = np.random.RandomState(0)
+    x = rng.randn(rows, features).astype(np.float32)
+    y = (rng.rand(rows) < 0.5).astype(np.float32)
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": leaves,
+        "min_data_in_leaf": 20, "verbosity": -1})
+    return Dataset.from_numpy(x, cfg, label=y), cfg
+
+
+def _compiled_serial(ds, cfg) -> str:
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learner.serial import SerialTreeLearner, _grow_jit
+    lrn = SerialTreeLearner(ds, cfg)
+    n = ds.num_data
+    grad = jnp.zeros((n,), jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    low = _grow_jit.lower(
+        lrn.binned, grad, hess, lrn._ones_rows, lrn._all_features,
+        lrn.meta, rand_key=None, cegb_used0=None, cegb_charged0=None,
+        params=lrn.params, num_leaves=lrn.num_leaves,
+        max_depth=lrn.max_depth, num_bins_max=lrn.num_bins_max,
+        hist_method=lrn.hist_method, bundled=lrn.bundled,
+        extra_trees=False, ff_bynode=1.0, bynode_count=2,
+        forced_plan=(), cache_hists=lrn.cache_hists,
+        mv_slots=lrn.mv_slots, mv_groups=lrn.mv_groups,
+        has_monotone=lrn.has_monotone,
+        split_fusion=_fusion_mode())
+    return low.compile().as_text()
+
+
+def _compiled_partitioned(ds, cfg) -> str:
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learner.partitioned import (PartitionedTreeLearner,
+                                                  _grow_partitioned)
+    lrn = PartitionedTreeLearner(ds, cfg)
+    n = ds.num_data
+    grad = jnp.zeros((n,), jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    low = _grow_partitioned.lower(
+        lrn.mat, lrn.ws, grad, hess, lrn._ones_rows, lrn._all_features,
+        lrn.meta, None, None, params=lrn.params,
+        num_leaves=lrn.num_leaves, max_depth=lrn.max_depth,
+        num_bins_max=lrn.num_bins_max, num_features=lrn.num_features,
+        num_groups=lrn.num_groups, n=lrn.num_data, bundled=lrn.bundled,
+        interpret=lrn.interpret, extra_trees=False, ff_bynode=1.0,
+        bynode_count=2, forced_plan=(), cache_hists=lrn.cache_hists,
+        hist_slots=lrn.hist_slots, has_monotone=lrn.has_monotone,
+        split_fusion=_fusion_mode())
+    return low.compile().as_text()
+
+
+def _fusion_mode() -> bool:
+    from lightgbm_tpu.learner.split_step import split_fusion_default
+    return split_fusion_default()
+
+
+PROGRAMS = {
+    "serial_grow": _compiled_serial,
+    "partitioned_grow": _compiled_partitioned,
+}
+
+
+def run_census(programs=None, rows=CENSUS_ROWS,
+               features=CENSUS_FEATURES, leaves=CENSUS_LEAVES) -> dict:
+    """Compile + census every requested program. Returns the artifact
+    dict (the committed budget holds a subset of these fields). The
+    ops_per_split census is shape-independent — smaller ``rows``/
+    ``features``/``leaves`` only shrink tensor shapes (and thus the
+    compile time), never the while-body op list — so tests run a tiny
+    config against the same budget (asserted by
+    tests/test_split_fusion.py)."""
+    ds, cfg = _build_dataset(rows, features, leaves)
+    out = {
+        "config": {"rows": rows, "features": features,
+                   "leaves": leaves, "backend": "cpu",
+                   "split_fusion": _fusion_mode(),
+                   "baseline_family": "cpu-fixed-v1-50k-28f-63l-10it"},
+        "programs": {},
+    }
+    for name in (programs or PROGRAMS):
+        txt = PROGRAMS[name](ds, cfg)
+        out["programs"][name] = census_from_hlo(txt)
+    return out
+
+
+def load_budget(path: str = BUDGET_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def check(current: dict, budget: dict):
+    """(ok, messages): every program's ops_per_split must stay within
+    budget + slack; carry_bytes within its own budget + slack_bytes."""
+    msgs, ok = [], True
+    for name, b in budget["programs"].items():
+        cur = current["programs"].get(name)
+        if cur is None:
+            msgs.append(f"{name}: MISSING from census run")
+            ok = False
+            continue
+        limit = b["ops_per_split"] + b.get("slack", 0)
+        status = "ok" if cur["ops_per_split"] <= limit else "REGRESSED"
+        msgs.append(
+            f"{name}: ops/split {cur['ops_per_split']} "
+            f"(budget {b['ops_per_split']} + slack {b.get('slack', 0)}"
+            f", pre-PR {b.get('pre_pr', '?')}) [{status}]")
+        if cur["ops_per_split"] > limit:
+            ok = False
+        cb = b.get("carry_bytes")
+        if cb is not None:
+            climit = cb + b.get("slack_bytes", 0)
+            if cur["carry_bytes"] > climit:
+                msgs.append(f"{name}: carry {cur['carry_bytes']}B "
+                            f"exceeds budget {climit}B [REGRESSED]")
+                ok = False
+    return ok, msgs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the committed budget regresses")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite budget measurements (keeps slack + "
+                         "pre_pr fields)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full census artifact JSON")
+    ap.add_argument("--programs", default=None,
+                    help="comma list (default: all)")
+    ap.add_argument("--rows", type=int, default=CENSUS_ROWS)
+    ap.add_argument("--features", type=int, default=CENSUS_FEATURES)
+    ap.add_argument("--leaves", type=int, default=CENSUS_LEAVES,
+                    help="shape overrides: the op census is shape-"
+                         "independent, smaller shapes only compile "
+                         "faster (bench uses 512x8x15)")
+    args = ap.parse_args(argv)
+
+    programs = args.programs.split(",") if args.programs else None
+    current = run_census(programs, rows=args.rows,
+                         features=args.features, leaves=args.leaves)
+
+    for name, c in current["programs"].items():
+        print(f"{name}: ops/split={c['ops_per_split']} "
+              f"fusions={c['fusions']} inner_whiles={c['inner_whiles']} "
+              f"collectives={c['collectives']} "
+              f"carry={c['carry_arrays']} arrays / "
+              f"{c['carry_bytes']} bytes")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+        print(f"wrote {args.json}")
+
+    if args.update:
+        budget = load_budget() if os.path.exists(BUDGET_PATH) else {
+            "programs": {}}
+        for name, c in current["programs"].items():
+            b = budget["programs"].setdefault(name, {})
+            b["ops_per_split"] = c["ops_per_split"]
+            b["carry_bytes"] = c["carry_bytes"]
+            b.setdefault("slack", 8)
+            b.setdefault("slack_bytes", 4096)
+        # the top-level config describes ALL program measurements:
+        # only rewrite it when this run re-measured every program at
+        # the canonical shape (a partial/overridden --update must not
+        # mislabel untouched entries)
+        full = (programs is None
+                and (args.rows, args.features, args.leaves)
+                == (CENSUS_ROWS, CENSUS_FEATURES, CENSUS_LEAVES))
+        if full:
+            budget["config"] = current["config"]
+        else:
+            print("partial --update: keeping the budget's config "
+                  "block (re-run without --programs/shape overrides "
+                  "to refresh it)")
+        with open(BUDGET_PATH, "w") as f:
+            json.dump(budget, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"updated {BUDGET_PATH}")
+        return 0
+
+    if args.check:
+        ok, msgs = check(current, load_budget())
+        for m in msgs:
+            print(m)
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ ones without jax installed.
 """
 
 import json
+import os
 import sys
 from typing import Any, Dict, List
 
@@ -320,6 +321,52 @@ def render(records: List[Dict[str, Any]]) -> str:
 
 
 # ----------------------------------------------------------------------
+# compiled-HLO dispatch census artifacts (tools/hlo_census.py): the
+# per-split op budget lives next to the per-phase histograms so one
+# report answers both "where does the time go" and "how many dispatches
+# does a split cost"
+def load_census(path: str):
+    """Parse a census artifact (bench_census.json / hlo_census.json);
+    None when the file is not one."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    progs = d.get("programs")
+    if not isinstance(progs, dict) or not all(
+            isinstance(p, dict) and "ops_per_split" in p
+            for p in progs.values()):
+        return None
+    return d
+
+
+def sibling_census(trace_path: str):
+    """The census artifact bench.py writes next to its telemetry."""
+    cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                        "bench_census.json")
+    return load_census(cand) if os.path.exists(cand) else None
+
+
+def render_census(d: Dict[str, Any]) -> str:
+    cfg = d.get("config") or {}
+    L = ["== per-split dispatch census (tools/hlo_census.py) ==",
+         f"config: {cfg.get('features')}f x {cfg.get('leaves')}l "
+         f"backend={cfg.get('backend')} "
+         f"split_fusion={cfg.get('split_fusion')}",
+         f"{'program':<20}{'ops/split':>10}{'fusions':>9}"
+         f"{'whiles':>8}{'coll':>6}{'carry':>7}{'bytes':>12}"]
+    for name, p in sorted((d.get("programs") or {}).items()):
+        L.append(f"{name:<20}{p.get('ops_per_split', 0):>10}"
+                 f"{p.get('fusions', '-'):>9}"
+                 f"{p.get('inner_whiles', '-'):>8}"
+                 f"{p.get('collectives', '-'):>6}"
+                 f"{p.get('carry_arrays', '-'):>7}"
+                 f"{p.get('carry_bytes', 0):>12,}")
+    return "\n".join(L) + "\n"
+
+
+# ----------------------------------------------------------------------
 # crash flight-recorder dumps (observability/flightrec.py)
 def load_crash(path: str):
     """The whole-file JSON object when ``path`` is a flight-recorder
@@ -409,6 +456,13 @@ def main(argv: List[str]) -> int:
         else:
             sys.stdout.write(render_crash(crash))
         return 0
+    census = load_census(args[0])
+    if census is not None:
+        if "--json" in argv:
+            print(json.dumps(census))
+        else:
+            sys.stdout.write(render_census(census))
+        return 0
     records = load(args[0])
     if not records:
         sys.stderr.write(f"no records in {args[0]}\n")
@@ -417,6 +471,9 @@ def main(argv: List[str]) -> int:
         print(json.dumps(digest(records)))
     else:
         sys.stdout.write(render(records))
+        sib = sibling_census(args[0])
+        if sib is not None:
+            sys.stdout.write("\n" + render_census(sib))
     return 0
 
 
