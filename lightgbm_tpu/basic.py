@@ -460,6 +460,64 @@ class Booster:
             self._gbdt.config = Config.from_params(self.params)
         return self
 
+    def reset_training_data(self, train_set: "Dataset") -> "Booster":
+        """GBDT::ResetTrainingData analog (c_api.cpp
+        LGBM_BoosterResetTrainingData, gbdt.cpp:244-262): swap the
+        training dataset under the existing model. The trained trees
+        are kept and their raw contribution seeds the new score cache
+        (the init_from_models continued-training path), so the next
+        ``update()`` boosts on the correct residuals of the NEW data.
+
+        Must come before ``add_valid``: validation bins reference the
+        training dataset's mappers, and rebasing them under a
+        different bin layout would mis-bin every valid row."""
+        if self._gbdt is None:
+            raise LightGBMError("Booster was loaded from a model "
+                                "file; cannot reset training data")
+        if self.valid_sets:
+            raise LightGBMError(
+                "reset_training_data must be called before adding "
+                "validation data (valid bins reference the old "
+                "training mappers)")
+        if not isinstance(train_set, Dataset):
+            raise TypeError("Training data should be Dataset "
+                            f"instance, met {type(train_set).__name__}")
+        train_set.params = {**self.params, **train_set.params} \
+            if train_set.params else dict(self.params)
+        train_set.construct()
+        old = self._gbdt
+        if train_set._inner.num_features \
+                != self.train_set._inner.num_features:
+            raise LightGBMError(
+                "reset_training_data: new dataset has "
+                f"{train_set._inner.num_features} features, model "
+                f"expects {self.train_set._inner.num_features}")
+        from .models.variants import create_boosting
+        gbdt = create_boosting(self.config, train_set._inner)
+        models = list(old.models)
+        if models:
+            X = train_set.data
+            if X is None:
+                raise LightGBMError(
+                    "reset_training_data needs the raw feature "
+                    "matrix to seed scores; construct the Dataset "
+                    "with free_raw_data=False and not via subset()")
+            if _is_pandas_df(X):
+                X = _apply_pandas_categorical(X,
+                                              train_set.pandas_categorical)
+            else:
+                X = _to_matrix(X)
+            X = np.asarray(X, np.float64)
+            k = gbdt.num_tree_per_iteration
+            raw = np.zeros((X.shape[0], k))
+            for i, t in enumerate(models):
+                raw[:, i % k] += t.predict(X)
+            gbdt.init_from_models(models, raw, [])
+        self._gbdt = gbdt
+        self.train_set = train_set
+        self.pandas_categorical = train_set.pandas_categorical
+        return self
+
     # ------------------------------------------------------------------
     def update(self, train_set: Optional[Dataset] = None, fobj=None) \
             -> bool:

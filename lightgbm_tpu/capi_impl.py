@@ -464,6 +464,16 @@ def booster_reset_parameter(h: int, parameters: str) -> None:
     _get(h).reset_parameter(_parse_params(parameters))
 
 
+def booster_reset_training_data(h: int, train_h: int) -> None:
+    """LGBM_BoosterResetTrainingData: swap the training dataset under
+    the booster handle, keeping the trained trees (continued-training
+    score seed; see Booster.reset_training_data)."""
+    bst = _get(h)
+    train = _get(train_h)
+    _check_push_complete(train)
+    bst.reset_training_data(train)
+
+
 def booster_update_one_iter(h: int) -> int:
     """-> 1 when training cannot continue (reference is_finished)."""
     return 1 if _get(h).update() else 0

@@ -34,6 +34,7 @@ import numpy as np
 from ..config import Config
 from ..data.dataset import Dataset
 from ..models.tree import Tree, TreeArrays
+from ..utils.jit_registry import register_jit
 from ..ops.hist_pallas import (build_matrix, extract_row_ids,
                                histogram_segment, pack_gh)
 from ..ops.partition_pallas import bitset_to_lut
@@ -226,6 +227,7 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             return_leaf_parts=True)
 
 
+@register_jit("partitioned_grow", donate=(0, 1))
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "num_features",

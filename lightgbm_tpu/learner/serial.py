@@ -36,6 +36,7 @@ from ..data.binning import (BIN_TYPE_CATEGORICAL, MISSING_NAN, MISSING_NONE,
 from ..data.dataset import Dataset
 from ..models.linear import LinearLeafFitMixin
 from ..models.tree import Tree, TreeArrays
+from ..utils.jit_registry import register_jit
 from ..ops.histogram import build_histogram, make_ghc
 from ..ops.partition import split_leaf
 from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
@@ -677,6 +678,11 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
         return tree
 
 
+# registered under TWO contract names: the default config (CEGB off —
+# no donation can materialize) and the lazy-CEGB config whose charged
+# matrix the jit site donates (graftcheck proves the alias holds)
+@register_jit("serial_grow_cegb", donate=("cegb_charged0",))
+@register_jit("serial_grow")
 @functools.partial(
     jax.jit, static_argnames=("params", "num_leaves", "max_depth",
                               "num_bins_max", "hist_method", "bundled",

@@ -30,6 +30,7 @@ from ..metric import create_metrics
 from ..objective import create_objective
 from ..observability.telemetry import get_telemetry, memory_snapshot
 from ..robustness.guards import NonFiniteGradientError
+from ..utils.jit_registry import register_dynamic, register_jit
 from ..utils.log import (log_fatal, log_info, log_warning,
                          maybe_profile)
 from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
@@ -44,18 +45,21 @@ kEpsilon = 1e-15
 # dispatch; over a tunnel every dispatch costs ~10-25 ms). The score
 # buffer is donated — boosting only ever moves forward, so the previous
 # iteration's buffer is dead the moment the update launches.
+@register_jit("score_add_leaf", donate=(0,))
 @functools.partial(jax.jit, static_argnames=("tid",),
                    donate_argnums=(0,))
 def _score_add_leaf(score, leaf_vals, leaf_id, *, tid: int):
     return score.at[:, tid].add(leaf_vals[leaf_id])
 
 
+@register_jit("score_add_col", donate=(0,))
 @functools.partial(jax.jit, static_argnames=("tid",),
                    donate_argnums=(0,))
 def _score_add_col(score, add, *, tid: int):
     return score.at[:, tid].add(add)
 
 
+@register_jit("score_add_leaf_linear", donate=(0,))
 @functools.partial(jax.jit, static_argnames=("tid",),
                    donate_argnums=(0,))
 def _score_add_leaf_linear(score, leaf_vals, lin_const, lin_coeff,
@@ -69,6 +73,7 @@ def _score_add_leaf_linear(score, leaf_vals, lin_const, lin_coeff,
         leaf_id, raw, leaf_vals, lin_const, lin_coeff, lin_feat))
 
 
+@register_jit("refit_tree", donate=(0,))
 @functools.partial(jax.jit,
                    static_argnames=("nl", "tid", "l1", "l2", "mds"),
                    donate_argnums=(0,))
@@ -112,6 +117,7 @@ def _bag_mask_core(key0, it, label, *, freq: int, n: int, frac: float,
     return (u < thr).astype(jnp.float32)
 
 
+@register_jit("bag_mask")
 @functools.partial(jax.jit, static_argnames=("freq", "n", "frac",
                                              "pos_frac", "neg_frac"))
 def _bag_mask_jit(key0, it, label=None, *, freq, n, frac, pos_frac,
@@ -216,7 +222,8 @@ class GBDT:
             self.objective.init(train_data.metadata, self.num_data)
             # objectives with per-call host randomness (rank_xendcg)
             # jit internally instead
-            self._grad_fn = jax.jit(self.objective.gradients) \
+            self._grad_fn = register_dynamic(
+                "gbdt_grad", jax.jit(self.objective.gradients)) \
                 if getattr(self.objective, "jittable", True) \
                 else self.objective.gradients
         k = self.num_tree_per_iteration
@@ -380,7 +387,7 @@ class GBDT:
                         fold_finite_check(g, h)
                 return g, h, bag_core(i, g, h)
 
-            fn = jax.jit(_fused)
+            fn = register_dynamic("gbdt_grad_bag", jax.jit(_fused))
             self._grad_bag_jit = fn
         tel.count_iter("host.dispatches")
         out = fn(score, jnp.int32(it))
@@ -1028,12 +1035,15 @@ class GBDT:
         if fused is None:
             valid_data = tuple((vd.binned_device, vd.mv_slots_device)
                                for vd in self.valid_sets)
-            fused = jax.jit(
-                functools.partial(_fused_iter_block, learner=ln,
-                                  grad_fn=self._grad_fn,
-                                  bag_fn=self._traceable_bag_fn(),
-                                  valid_data=valid_data, k=k),
-                static_argnames=("m",), donate_argnums=(0, 1, 2, 3))
+            fused = register_dynamic(
+                "gbdt_fused_block",
+                jax.jit(
+                    functools.partial(_fused_iter_block, learner=ln,
+                                      grad_fn=self._grad_fn,
+                                      bag_fn=self._traceable_bag_fn(),
+                                      valid_data=valid_data, k=k),
+                    static_argnames=("m",), donate_argnums=(0, 1, 2, 3)),
+                donate=(0, 1, 2))
             self._fused_jit = fused
         while self.iter < iters:
             # largest power-of-2 block <= remaining (capped): the set of

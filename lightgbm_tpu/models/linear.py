@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jit_registry import register_jit
+
 kLinEps = 1e-15
 # conditioning bound: a solve whose coefficients exceed this is treated
 # as singular and the leaf falls back to its constant output
@@ -98,6 +100,7 @@ def leaf_path_features(tree, is_numeric: np.ndarray, big_l: int,
     return feats
 
 
+@register_jit("linear_leaf_fit")
 @functools.partial(jax.jit, static_argnames=("lam", "l2"))
 def _fit_linear_jit(raw, leaf_id, grad, hess, bag, feats, leaf_value, *,
                     lam: float, l2: float):

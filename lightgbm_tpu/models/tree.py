@@ -26,6 +26,7 @@ import numpy as np
 from ..data.binning import (BIN_TYPE_CATEGORICAL, MISSING_NAN, MISSING_NONE,
                             MISSING_ZERO)
 from ..ops.split import MAX_CAT_WORDS
+from ..utils.jit_registry import register_jit
 
 kCategoricalMask = 1
 kDefaultLeftMask = 2
@@ -541,9 +542,9 @@ def _traverse_binned_core(binned, col, offset, thr, dec, left, right,
         mv_present=mv_present)]
 
 
-_traverse_binned_jax = functools.partial(jax.jit,
-                                         static_argnames=("mv_present",))(
-    _traverse_binned_core)
+_traverse_binned_jax = register_jit("tree_traverse_binned")(
+    functools.partial(jax.jit, static_argnames=("mv_present",))(
+        _traverse_binned_core))
 
 
 def _traverse_binned_linear_core(binned, col, offset, thr, dec, left,
@@ -564,11 +565,12 @@ def _traverse_binned_linear_core(binned, col, offset, thr, dec, left,
                               lin_coeff, lin_feat)
 
 
-_traverse_binned_linear_jax = functools.partial(
-    jax.jit, static_argnames=("mv_present",))(
-    _traverse_binned_linear_core)
+_traverse_binned_linear_jax = register_jit("tree_traverse_linear")(
+    functools.partial(jax.jit, static_argnames=("mv_present",))(
+        _traverse_binned_linear_core))
 
 
+@register_jit("tree_traverse_add_linear", donate=(0,))
 @functools.partial(jax.jit, static_argnames=("tid", "mv_present"),
                    donate_argnums=(0,))
 def _traverse_binned_add_linear_jax(score, binned, col, offset, thr,
@@ -586,6 +588,7 @@ def _traverse_binned_add_linear_jax(score, binned, col, offset, thr,
     return score.at[:, tid].add(add)
 
 
+@register_jit("tree_traverse_add", donate=(0,))
 @functools.partial(jax.jit, static_argnames=("tid", "mv_present"),
                    donate_argnums=(0,))
 def _traverse_binned_add_jax(score, binned, col, offset, thr, dec, left,
@@ -772,6 +775,7 @@ def _traverse_arrays_idx(binned, col, offset, thr, dec, left, right,
     return out
 
 
+@register_jit("tree_traverse_arrays")
 @functools.partial(jax.jit, static_argnames=("mv_present",))
 def _traverse_arrays_jax(binned, col, offset, thr, dec, left, right,
                          miss, default_bin, num_bin, cat_bitsets,

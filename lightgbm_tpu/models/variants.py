@@ -25,12 +25,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jit_registry import register_jit
 from ..utils.log import log_fatal, log_info
 from .gbdt import GBDT, _constant_tree, _score_add_col, kEpsilon
 from .tree import Tree
 
 
 # ----------------------------------------------------------------------
+@register_jit("goss_weights")
 @functools.partial(jax.jit, static_argnames=("top_rate", "other_rate"))
 def _goss_weights(grad, hess, key, *, top_rate: float, other_rate: float):
     """Per-row GOSS weights on device. grad/hess: [N, K]."""

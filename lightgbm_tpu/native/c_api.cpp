@@ -609,6 +609,18 @@ int LGBM_BoosterResetParameter(BoosterHandle handle,
   return 0;
 }
 
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data) {
+  API_BEGIN();
+  PyObject* r = call_impl(
+      "booster_reset_training_data",
+      Py_BuildValue("(LL)", reinterpret_cast<long long>(handle),
+                    reinterpret_cast<long long>(train_data)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
   API_BEGIN();
   PyObject* r = call_impl(

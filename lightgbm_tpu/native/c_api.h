@@ -19,8 +19,8 @@
  * _lightgbm_tpu_capi.so next to this header.
  *
  * Not implemented from the reference header (use the Python API):
- * LGBM_BoosterResetTrainingData, LGBM_NetworkInitWithFunctions
- * (custom C collectives are architecturally replaced by XLA/ICI).
+ * LGBM_NetworkInitWithFunctions (custom C collectives are
+ * architecturally replaced by XLA/ICI).
  * Streaming-push ingestion note: multi-val (conflict-overflow EFB)
  * plans are not supported on the push path — such datasets fall back
  * to unbundled columns.
@@ -133,6 +133,12 @@ int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data);
 int LGBM_BoosterResetParameter(BoosterHandle handle,
                                const char* parameters);
+/* Swap the training dataset under an existing booster; trained trees
+ * are kept and re-seed the score cache on the new data. Must be
+ * called BEFORE AddValidData (valid bins reference the training
+ * mappers). */
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
 int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
                                     const float* grad,

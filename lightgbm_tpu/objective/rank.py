@@ -28,6 +28,7 @@ import numpy as np
 
 from ..config import Config
 from ..data.dataset import Metadata
+from ..utils.jit_registry import register_jit
 from ..utils.log import log_fatal
 from .base import ObjectiveFunction
 
@@ -245,6 +246,7 @@ class RankXENDCG(RankingObjective):
         return "rank_xendcg"
 
 
+@register_jit("xendcg_grad")
 @functools.partial(jax.jit, static_argnames=("num_data",))
 def _xendcg_grad(score, uniforms, pad_idx, pad_mask, labels_pad, counts,
                  num_data, weights):

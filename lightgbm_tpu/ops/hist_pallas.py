@@ -58,6 +58,7 @@ RID_OFF = 9        # row-id bytes start at column F + RID_OFF
 # v5e has 128 MB of VMEM — raise the ceiling rather than shrink the
 # block (smaller blocks double the DMA count per row).
 VMEM_LIMIT = 100 * 1024 * 1024
+from ..utils.jit_registry import register_jit  # noqa: E402
 from .pallas_compat import tpu_compiler_params  # noqa: E402
 
 _COMPILER_PARAMS = tpu_compiler_params(vmem_limit_bytes=VMEM_LIMIT)
@@ -284,6 +285,7 @@ def _hist_seg_kernel(scal_ref,          # SMEM [2] (begin, count)
     jax.lax.fori_loop(0, nblk, block_body, 0)
 
 
+@register_jit("hist_segment_raw")
 @functools.partial(
     jax.jit,
     static_argnames=("num_features", "num_bins", "blk", "interpret"))
@@ -518,6 +520,7 @@ def _hist_nibble_kernel(scal_ref,       # SMEM [2] (begin, count)
     jax.lax.fori_loop(0, nblk, block_body, 0)
 
 
+@register_jit("hist_segment_nibble")
 @functools.partial(
     jax.jit,
     static_argnames=("num_features", "num_bins", "blk", "interpret",

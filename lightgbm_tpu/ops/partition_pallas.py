@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jit_registry import register_jit
 from .pallas_compat import tpu_compiler_params
 
 ALIGN = 8
@@ -189,6 +190,7 @@ def _partition_kernel(scal_ref, lut_ref, mat_in, ws_in,
     jax.lax.fori_loop(0, pl.cdiv(nr_total, blk), back_body, 0)
 
 
+@register_jit("partition_segment")
 @functools.partial(
     jax.jit, static_argnames=("blk", "interpret", "use_lut_path"))
 def partition_segment(mat, ws, begin, count, feat, thr, default_left,

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jit_registry import register_jit
 from .pallas_compat import tpu_compiler_params
 
 from .partition_pallas import (MISSING_NAN_CODE, MISSING_ZERO_CODE,
@@ -302,6 +303,7 @@ def _partition_kernel_v2(scal_ref, lut_ref, mat_in, ws_in,
     drain(stage_l, t_l, w_l, mat_hbm, sems.at[2], merge_tail=True)
 
 
+@register_jit("partition_segment_v2")
 @functools.partial(
     jax.jit, static_argnames=("blk", "interpret", "use_lut_path"))
 def partition_segment_v2(mat, ws, begin, count, feat, thr, default_left,

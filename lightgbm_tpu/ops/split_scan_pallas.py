@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jit_registry import register_jit
 from .split import (MISSING_NAN_CODE, MISSING_NONE_CODE,
                     MISSING_ZERO_CODE, MAX_CAT_WORDS, PerFeatureSplits,
                     SplitParams, _split_gains, gain_given_output,
@@ -208,6 +209,7 @@ def _scan_kernel(scal_ref, imeta_ref, fmeta_ref, hg_ref, hh_ref, hc_ref,
          dleft, wl_f, wr_f], axis=1)                           # [F, 8]
 
 
+@register_jit("split_scan_kernel")
 @functools.partial(
     jax.jit, static_argnames=("params", "interpret"))
 def _scan_call(scal, imeta, fmeta, hg, hh, hc, *, params: SplitParams,

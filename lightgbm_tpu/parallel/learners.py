@@ -38,6 +38,7 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
                check_rep=check_rep)
 
 from ..config import Config
+from ..utils.jit_registry import register_dynamic
 from ..data.dataset import Dataset
 from ..learner.comm import (make_data_parallel_comm,
                             make_feature_parallel_comm,
@@ -223,7 +224,8 @@ class DataParallelTreeLearner(_MeshLearnerBase):
                       P(AXIS), P(), P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
-        sharded = jax.jit(mapped)
+        sharded = register_dynamic("mesh_data_grow", jax.jit(mapped),
+                                   collective=True)
         self._fn = functools.partial(sharded, self.binned,
                                      self._mv_sharded())
 
@@ -369,7 +371,8 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
                       P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P()),
             check_rep=False)
-        sharded = jax.jit(mapped)
+        sharded = register_dynamic("mesh_feature_grow",
+                                   jax.jit(mapped), collective=True)
         # place once with the mesh shardings (replicated rows for the
         # partition path, feature-sharded copy for histogram build)
         self.binned = jax.device_put(
@@ -438,7 +441,8 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
                       P(AXIS), P(), P(), P()),
             out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
             check_rep=False)
-        sharded = jax.jit(mapped)
+        sharded = register_dynamic("mesh_voting_grow",
+                                   jax.jit(mapped), collective=True)
         self._fn = functools.partial(sharded, self.binned,
                                      self._mv_sharded())
 
@@ -562,7 +566,10 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                            TreeArrays_spec()) + out_tail,
                 check_rep=False)
 
-        self._fn = jax.jit(mk_mapped(False), donate_argnums=(0, 1))
+        self._fn = register_dynamic(
+            "mesh_partitioned_grow",
+            jax.jit(mk_mapped(False), donate_argnums=(0, 1)),
+            donate=(0, 1), collective=True)
         self._mapped_parts = mk_mapped(True)   # fused path (traced)
 
     def train(self, grad, hess, bag_weight=None, feature_mask=None

@@ -23,6 +23,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from .utils.jit_registry import register_jit
 from .utils.log import log_warning
 
 
@@ -373,6 +374,7 @@ def _device_predict(models, data, dataset, k: int,
     return np.asarray(jax.device_get(out), np.float64)[:n]
 
 
+@register_jit("predict_scan_trees")
 @functools.partial(jax.jit, static_argnames=("k", "mv_present"))
 def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
                 cat, leaf_vals, n_leaves, tree_class, k, mv_slots=None,
@@ -397,6 +399,7 @@ def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
     return acc
 
 
+@register_jit("predict_scan_trees_linear")
 @functools.partial(jax.jit, static_argnames=("k", "mv_present"))
 def _scan_trees_linear(binned, col, off, thr, dec, left, right, miss,
                        dbin, nbin, cat, leaf_vals, n_leaves, tree_class,

@@ -37,6 +37,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..utils.jit_registry import register_jit
 from ..utils.log import LightGBMError, log_warning
 
 GUARD_POLICIES = ("off", "raise", "skip_iter", "rollback")
@@ -77,6 +78,7 @@ class LossSpikeError(LightGBMError):
         self.iteration = iteration
 
 
+@register_jit("finite_ok")
 @jax.jit
 def _finite_ok(grad, hess):
     """Device-side all-finite reduction over one iteration's gradient

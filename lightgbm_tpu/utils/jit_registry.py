@@ -1,0 +1,118 @@
+"""Registry of the package's jitted entry points (graftcheck seam).
+
+Every ``jax.jit`` / ``pjit`` / ``pallas_call`` entry point in
+``lightgbm_tpu/`` registers here with a stable name and its declared
+IR-level contract; ``tools/graftcheck`` lowers each registered program
+at a fixed tiny config and verifies the contract against the compiled
+artifact (donation materialized, dtype discipline, no host callbacks,
+collective census, shape staticness, op/fusion budgets — see
+docs/StaticAnalysis.md). graftlint rule GL506 fails any jit site that
+is neither registered nor explicitly allow-marked, so this registry
+cannot silently rot.
+
+This module is import-cheap by design: it never imports jax and holds
+plain records only. Example-argument builders live with the checker
+(``tools/graftcheck/programs.py``), keyed by the names registered
+here — the hot modules carry the contract, not the test harness.
+
+Two registration forms:
+
+* ``@register_jit(name, ...)`` above a module-level jitted callable
+  (stacked on top of the ``functools.partial(jax.jit, ...)``
+  decorator, or wrapping the jit call expression);
+* ``register_dynamic(name, jax.jit(fn), ...)`` around a jit program
+  created at runtime (per-booster fused blocks, mesh learners) — it
+  records/refreshes the spec and returns the callable unchanged, so
+  it drops into the creation expression.
+
+Contract fields (the numeric budgets — op counts, fusion counts,
+exact collective multisets — live in the committed manifest
+``tools/graftcheck/contracts.json``, maintained with
+``python -m tools.graftcheck --update``):
+
+* ``hot``: host callbacks / infeed / outfeed are forbidden (default
+  True — a callback inside a hot program is a per-dispatch host sync);
+* ``donate``: argnums/argnames declared donated at the jit site whose
+  aliasing must MATERIALIZE in the compiled ``input_output_alias``
+  map (XLA silently drops undonatable buffers — the regression this
+  check exists to catch);
+* ``allow_f64``: f64 ops tolerated (default False: the repo trains in
+  f32; a silent x64 upcast doubles bandwidth on the hot path);
+* ``collective``: the program is expected to contain cross-device
+  collectives (their exact multiset is pinned by the manifest; a
+  non-collective program containing any collective always fails).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["JitProgram", "register_jit", "register_dynamic", "get",
+           "names", "programs"]
+
+
+@dataclasses.dataclass
+class JitProgram:
+    """One registered jitted entry point + its declared contract."""
+
+    name: str
+    fn: Any = None              # the jitted callable (None until built
+    #                             for dynamic programs never created)
+    hot: bool = True
+    donate: Tuple[Any, ...] = ()   # argnums (int) or argnames (str)
+    allow_f64: bool = False
+    collective: bool = False
+    dynamic: bool = False       # runtime-created (fn refreshed per use)
+    module: str = ""            # defining module, for reports
+
+    @property
+    def declares_donation(self) -> bool:
+        return len(self.donate) > 0
+
+
+_REGISTRY: Dict[str, JitProgram] = {}
+
+
+def register_jit(name: str, *, hot: bool = True,
+                 donate: Tuple[Any, ...] = (), allow_f64: bool = False,
+                 collective: bool = False):
+    """Decorator registering a module-level jitted callable under
+    ``name``. Returns the callable unchanged (zero wrapping — the
+    registry must never add a call-path indirection to a hot program).
+    """
+    def deco(fn):
+        _REGISTRY[name] = JitProgram(
+            name=name, fn=fn, hot=hot, donate=tuple(donate),
+            allow_f64=allow_f64, collective=collective,
+            module=getattr(fn, "__module__", "") or "")
+        return fn
+    return deco
+
+
+def register_dynamic(name: str, fn: Any, *, hot: bool = True,
+                     donate: Tuple[Any, ...] = (),
+                     allow_f64: bool = False,
+                     collective: bool = False) -> Any:
+    """Record (or refresh) a runtime-created jitted program and return
+    it unchanged. Later registrations under the same name overwrite —
+    graftcheck builds one instance at a time, and the latest is the
+    one whose compiled artifact gets checked."""
+    mod = getattr(fn, "__module__", "") or ""
+    _REGISTRY[name] = JitProgram(
+        name=name, fn=fn, hot=hot, donate=tuple(donate),
+        allow_f64=allow_f64, collective=collective, dynamic=True,
+        module=mod)
+    return fn
+
+
+def get(name: str) -> Optional[JitProgram]:
+    return _REGISTRY.get(name)
+
+
+def names() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def programs() -> Dict[str, JitProgram]:
+    return dict(_REGISTRY)

@@ -4,7 +4,7 @@ import jax
 import numpy as np
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,))  # graftlint: allow[GL506]
 def advance(state, delta):
     return state + delta
 

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("weights",))
+@functools.partial(jax.jit, static_argnames=("weights",))  # graftlint: allow[GL506]
 def apply(x, *, weights):
     return x * weights
 

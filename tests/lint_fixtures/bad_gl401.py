@@ -3,6 +3,6 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@jax.jit
+@jax.jit  # graftlint: allow[GL506]
 def accumulate(x):
     return jnp.cumsum(x.astype(np.float64))  # VIOLATION

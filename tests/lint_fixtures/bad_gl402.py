@@ -3,6 +3,6 @@ import random
 import jax
 
 
-@jax.jit
+@jax.jit  # graftlint: allow[GL506]
 def jitter(x):
     return x * random.random()  # VIOLATION

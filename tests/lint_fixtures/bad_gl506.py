@@ -1,0 +1,14 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit  # VIOLATION
+def scale(x):
+    return x * jnp.float32(2.0)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))  # VIOLATION
+def scale_static(x, *, k: int):
+    return x * jnp.float32(k)

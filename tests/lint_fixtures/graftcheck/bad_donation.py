@@ -1,0 +1,25 @@
+"""Dropped donation: the jit site declares ``donate_argnums=(0,)``
+but the donated buffer cannot back the (larger) output, so XLA
+silently drops the alias — the exact regression GC101 exists to
+surface (today this is invisible: jax only warns, tests still pass,
+and the old buffer stays live on device)."""
+
+NAME = "fixture_bad_donation"
+CONTRACT = dict(donate=(0,))
+ENTRY = dict(ops=10_000, ops_slack=0, fusions=10_000, fusions_slack=0,
+             collectives={}, donation=1)
+EXPECT = ["GC101"]
+
+
+def build():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def grow(x):
+        # output shape != input shape: the donation cannot materialize
+        return jnp.concatenate([x, x])
+
+    return grow.lower(jnp.zeros((64,), jnp.float32))

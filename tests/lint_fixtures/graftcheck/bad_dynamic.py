@@ -1,0 +1,30 @@
+"""Dynamic-slice-of-traced-size: a slice whose SIZE depends on a
+traced value lowers to bounded-dynamism machinery
+(``set-dimension-size`` + ``f32[<=N]`` shapes + pad-to-static) — on
+TPU these compile to padded programs with data-dependent semantics
+the repo bans outright.
+
+jax only emits this under the experimental dynamic-shapes mode, so
+the fixture pins the checker's DETECTION with compiled-HLO text (the
+exact op sequence ``jax_dynamic_shapes`` + XLA's DynamicPadder
+produce); production programs can never contain it unnoticed."""
+
+NAME = "fixture_bad_dynamic"
+CONTRACT = dict()
+ENTRY = dict(ops=10_000, ops_slack=0, fusions=10_000, fusions_slack=0,
+             collectives={}, donation=0)
+EXPECT = ["GC501"]
+
+HLO = """\
+HloModule jit_take_first_n, is_scheduled=true, entry_computation_layout={(f32[64]{0}, s32[])->f32[<=64]{0}}
+
+ENTRY %main.5 (Arg_0.1: f32[64], Arg_1.2: s32[]) -> f32[<=64] {
+  %Arg_0.1 = f32[64]{0} parameter(0), metadata={op_name="x"}
+  %Arg_1.2 = s32[] parameter(1), metadata={op_name="n"}
+  ROOT %set-dimension-size.3 = f32[<=64]{0} set-dimension-size(f32[64]{0} %Arg_0.1, s32[] %Arg_1.2), dimensions={0}, metadata={op_name="jit(take_first_n)/jit(main)/slice"}
+}
+"""
+
+
+def hlo() -> str:
+    return HLO

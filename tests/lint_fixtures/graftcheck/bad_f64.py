@@ -1,0 +1,22 @@
+"""f64 leak via a python-float default: ``np.asarray(scale)`` turns
+the float default into an f64 array; under x64 the whole expression
+silently promotes and the compiled program converts + computes in f64
+— double bandwidth on what the caller thinks is an f32 path."""
+
+NAME = "fixture_bad_f64"
+CONTRACT = dict()
+ENTRY = dict(ops=10_000, ops_slack=0, fusions=10_000, fusions_slack=0,
+             collectives={}, donation=0)
+EXPECT = ["GC201", "GC202"]
+X64 = True  # f64 must be representable for the leak to compile at all
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def scaled(x, scale=2.0):
+        return x * np.asarray(scale)
+
+    return jax.jit(scaled).lower(jnp.zeros((64,), jnp.float32))

@@ -2,7 +2,7 @@ import jax
 import jax.numpy as jnp
 
 
-@jax.jit
+@jax.jit  # graftlint: allow[GL506]
 def loss(score, label):
     return jnp.mean((score - label) ** 2)
 

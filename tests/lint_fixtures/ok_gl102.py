@@ -3,6 +3,6 @@ import functools
 import jax
 
 
-@functools.partial(jax.jit, static_argnames=("lr",))
+@functools.partial(jax.jit, static_argnames=("lr",))  # graftlint: allow[GL506]
 def step(score, grad, *, lr):
     return score - float(lr) * grad  # static param: trace-time float

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("enabled",))
+@functools.partial(jax.jit, static_argnames=("enabled",))  # graftlint: allow[GL506]
 def clip(x, lo, *, enabled):
     if enabled:  # static param: resolved at trace time
         return jnp.where(x.sum() > lo, jnp.minimum(x, lo), x)

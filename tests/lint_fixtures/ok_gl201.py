@@ -3,7 +3,7 @@ import functools
 import jax
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,))  # graftlint: allow[GL506]
 def advance(state, delta):
     return state + delta
 

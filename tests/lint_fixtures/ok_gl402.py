@@ -2,6 +2,6 @@ import jax
 import jax.random
 
 
-@jax.jit
+@jax.jit  # graftlint: allow[GL506]
 def jitter(x, key):
     return x * jax.random.uniform(key)

@@ -1,0 +1,35 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from lightgbm_tpu.utils.jit_registry import (register_dynamic,
+                                             register_jit)
+
+
+@register_jit("fixture_scale", donate=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scale(x):
+    return x * jnp.float32(2.0)
+
+
+@register_jit("fixture_kernel")
+@jax.jit
+def kernel_wrapper(x):
+    # a pallas_call inside a registered jitted wrapper is covered by
+    # that registration (one compiled program, one contract)
+    return pl.pallas_call(
+        lambda x_ref, o_ref: None,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+
+def build(fn):
+    return register_dynamic("fixture_dynamic", jax.jit(fn))
+
+
+wrapped = register_jit("fixture_wrapped")(
+    functools.partial(jax.jit, static_argnames=("k",))(
+        lambda x, *, k: x * k))
+
+probe = jax.jit(lambda x: x + 1)  # graftlint: allow[GL506]

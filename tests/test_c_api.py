@@ -191,6 +191,72 @@ def test_c_model_loads_in_python_with_identical_predictions(c_run):
     assert c_preds[y == 1].mean() > c_preds[y == 0].mean() + 0.2
 
 
+def test_reset_training_data_via_handle_registry():
+    """LGBM_BoosterResetTrainingData (round-5 verdict backlog): swap
+    the training dataset under the booster handle; the kept trees
+    re-seed the new score cache, so continued boosting matches a
+    two-stage init_model run on the same data split."""
+    from lightgbm_tpu import capi_impl as ci
+    rng = np.random.RandomState(3)
+    XA = np.ascontiguousarray(rng.randn(300, 4))
+    yA = np.ascontiguousarray((XA[:, 0] > 0).astype(np.float32))
+    XB = np.ascontiguousarray(rng.randn(260, 4))
+    yB = np.ascontiguousarray((XB[:, 0] > 0).astype(np.float32))
+
+    hA = ci.dataset_create_from_mat(
+        XA.ctypes.data, ci.DTYPE_FLOAT64, 300, 4, 1, "verbosity=-1", 0)
+    ci.dataset_set_field(hA, "label", yA.ctypes.data, 300,
+                         ci.DTYPE_FLOAT32)
+    b = ci.booster_create(
+        hA, "objective=binary num_leaves=7 verbosity=-1 seed=7")
+    for _ in range(4):
+        ci.booster_update_one_iter(b)
+
+    hB = ci.dataset_create_from_mat(
+        XB.ctypes.data, ci.DTYPE_FLOAT64, 260, 4, 1, "verbosity=-1", 0)
+    ci.dataset_set_field(hB, "label", yB.ctypes.data, 260,
+                         ci.DTYPE_FLOAT32)
+    ci.booster_reset_training_data(b, hB)
+    # iteration count (trees) survives the swap; training continues
+    assert ci.booster_get_current_iteration(b) == 4
+    for _ in range(3):
+        ci.booster_update_one_iter(b)
+    assert ci.booster_get_current_iteration(b) == 7
+    assert ci.booster_number_of_total_model(b) == 7
+
+    out = np.zeros(260, np.float64)
+    got = ci.booster_predict_for_mat(
+        b, XB.ctypes.data, ci.DTYPE_FLOAT64, 260, 4, 1,
+        ci.PREDICT_NORMAL, -1, "", out.ctypes.data)
+    assert got == 260
+
+    # reference: the same split via the continued-training seed path
+    import lightgbm_tpu as lgb
+    params = {"objective": "binary", "num_leaves": 7,
+              "verbosity": -1, "seed": 7}
+    # rebuild stage1 from the SAME booster's first 4 trees (the C
+    # route fed f32 labels) to keep the comparison exact
+    s = ci.booster_save_model_to_string(b, 0, 4)
+    stage1_c = lgb.Booster(model_str=s)
+    stage2 = lgb.train(params, lgb.Dataset(
+        XB, label=np.asarray(yB, np.float64), free_raw_data=False),
+        num_boost_round=3, init_model=stage1_c)
+    ref = stage2.predict(XB)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-7)
+
+    # error contract: feature-count mismatch raises cleanly
+    X3 = np.ascontiguousarray(rng.randn(50, 3))
+    h3 = ci.dataset_create_from_mat(
+        X3.ctypes.data, ci.DTYPE_FLOAT64, 50, 3, 1, "verbosity=-1", 0)
+    y3 = np.ascontiguousarray(np.zeros(50, np.float32))
+    ci.dataset_set_field(h3, "label", y3.ctypes.data, 50,
+                         ci.DTYPE_FLOAT32)
+    with pytest.raises(Exception, match="features"):
+        ci.booster_reset_training_data(b, h3)
+    for h in (h3, hB, hA, b):
+        ci.free_handle(h)
+
+
 def test_c_api_error_contract(capi_so):
     """Bad inputs return -1 and set LGBM_GetLastError (never crash)."""
     lib = ctypes.CDLL(capi_so)

@@ -1,0 +1,103 @@
+"""The pytest-collected graftcheck repo gate (ISSUE 9 tentpole).
+
+Builds EVERY registered jit entry point at the fixed tiny config in
+one shared pass and checks the contracts against the committed
+manifest — the same sweep CI's ``graftcheck`` job runs. Marked slow
+(31 programs, ~60 s of compiles) so the tier-1 budgeted run keeps its
+870 s envelope — the fast halves (fixture detection, manifest/builder
+coverage, GL506 registration enforcement) run un-marked in
+tests/test_graftcheck.py and tests/test_graftlint_repo.py, and CI's
+dedicated job runs THIS check on every PR regardless.
+"""
+
+import pytest
+
+from lightgbm_tpu.utils import jit_registry
+from tools.graftcheck import load_manifest
+from tools.graftcheck.core import check_run, run_census
+from tools.graftcheck.programs import BUILDERS, \
+    import_side_registrations
+
+
+def _fmt(findings):
+    return "\n".join(f"  {f.program}: {f.rule} {f.message}"
+                     for f in findings)
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    """ONE build+measure pass over the full registry (compiles
+    dominate; the checks are cheap) — the tests below slice it."""
+    return run_census()
+
+
+@pytest.mark.slow
+def test_every_contract_holds_against_committed_manifest(sweep):
+    current, build_findings = sweep
+    findings = check_run(current, build_findings, load_manifest())
+    assert not findings, (
+        "graftcheck contract violations (fix the program, or for an "
+        "intentional change re-run `python -m tools.graftcheck "
+        "--update` and justify the diff in the PR):\n"
+        + _fmt(findings))
+
+
+@pytest.mark.slow
+def test_donation_materializes_for_every_declaring_program(sweep):
+    """ISSUE 9 acceptance: the donation check confirms
+    input_output_aliases for every program that declares donation."""
+    current, build_findings = sweep
+    assert not build_findings, _fmt(build_findings)
+    declaring = [n for n in current["programs"]
+                 if (s := jit_registry.get(n)) is not None
+                 and s.declares_donation]
+    assert declaring, "no program declares donation?!"
+    for name in declaring:
+        assert current["programs"][name]["donation"] >= 1, (
+            f"{name}: declared donation produced no "
+            "input_output_alias entry")
+
+
+@pytest.mark.slow
+def test_mesh_collective_census_is_pinned(sweep):
+    """The mesh learners' collective programs are the gate the
+    Mesh/NamedSharding refactor (ROADMAP item 2) will diff against:
+    each must contain collectives, and exactly the committed ones."""
+    current, _ = sweep
+    manifest = load_manifest()
+    mesh = [n for n in current["programs"] if n.startswith("mesh_")]
+    assert len(mesh) >= 4
+    for name in mesh:
+        cur = current["programs"][name]["collectives"]
+        assert cur, f"{name}: no collectives in a mesh program"
+        assert cur == manifest["programs"][name]["collectives"], name
+
+
+def test_registry_fully_covered():
+    """Fast (no compiles): every registered program name has an
+    example builder and a committed contract — a registration that
+    nothing checks is exactly the rot GL506 + this gate prevent."""
+    import_side_registrations()
+    manifest = load_manifest()
+    missing_builders = [n for n in jit_registry.names()
+                        if n not in BUILDERS]
+    assert not missing_builders, missing_builders
+    missing_contracts = [n for n in BUILDERS
+                         if n not in manifest["programs"]]
+    assert not missing_contracts, missing_contracts
+
+
+def test_contracts_hold_on_cheap_subset():
+    """A non-slow slice of the full gate: the synthetic-arg programs
+    (no booster training, sub-second compiles each) checked against
+    the committed manifest on every tier-1 run."""
+    names = ["score_add_leaf", "score_add_col", "refit_tree",
+             "bag_mask", "finite_ok", "goss_weights",
+             "linear_leaf_fit", "xendcg_grad"]
+    current, build_findings = run_census(names)
+    findings = check_run(current, build_findings, load_manifest())
+    findings = [f for f in findings if f.program in names]
+    assert not findings, _fmt(findings)
+    # the donated score updaters must alias even at tiny shapes
+    for name in ("score_add_leaf", "score_add_col", "refit_tree"):
+        assert current["programs"][name]["donation"] == 1, name

@@ -76,7 +76,7 @@ def test_suppression_silences_only_allowed_rule():
 def test_suppression_is_rule_specific(tmp_path):
     src = (
         "import jax\n\n\n"
-        "@jax.jit\n"
+        "@jax.jit  # graftlint: allow[GL506]\n"
         "def f(x):\n"
         "    return x.item()  # graftlint: allow[GL999]\n")
     p = tmp_path / "wrong_rule.py"
@@ -88,7 +88,7 @@ def test_suppression_is_rule_specific(tmp_path):
 def test_suppression_on_preceding_comment_line(tmp_path):
     src = (
         "import jax\n\n\n"
-        "@jax.jit\n"
+        "@jax.jit  # graftlint: allow[GL506]\n"
         "def f(x):\n"
         "    # graftlint: allow[GL101]\n"
         "    return x.item()\n")

@@ -1,0 +1,23 @@
+"""graftcheck: compiled-program contract checker (ISSUE 9 tentpole).
+
+Static analysis over the LOWERED artifacts (compiled HLO) of every
+jitted entry point registered in
+``lightgbm_tpu.utils.jit_registry`` — the IR-level complement to the
+AST-level ``tools/graftlint``. See docs/StaticAnalysis.md.
+
+Keep this module import-light: the CLI (``cli.py``) owns the
+jax/XLA environment setup; importing the package must not import jax
+(graftlint's GL506 front-end and run_report only need the parser and
+finding types).
+"""
+
+from .checks import check_program, measure
+from .findings import GcFinding, RULE_NAMES, sort_findings
+from .hlo import census_from_hlo
+from .manifest import (MANIFEST_PATH, load_manifest, stale_entries,
+                       update_manifest)
+
+__all__ = ["GcFinding", "RULE_NAMES", "sort_findings",
+           "check_program", "measure", "census_from_hlo",
+           "MANIFEST_PATH", "load_manifest", "update_manifest",
+           "stale_entries"]

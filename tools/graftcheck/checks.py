@@ -1,0 +1,150 @@
+"""Per-program contract checks over one compiled HLO module.
+
+Each check reads the program's registered contract
+(``lightgbm_tpu.utils.jit_registry.JitProgram``), the committed
+manifest entry (``contracts.json``) and the compiled text, and yields
+findings with stable GC rule ids. The manifest's per-program
+``allow`` list suppresses individual rules (the inline-allow-list
+analog of graftlint's ``# graftlint: allow[...]``), and slack fields
+absorb benign drift exactly like ``hlo_census_budget.json``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .findings import GcFinding
+from .hlo import (aliased_param_count, collective_census,
+                  dynamic_shape_lines, host_callback_lines,
+                  module_op_counts, nontrivial_total,
+                  wide_dtype_lines, widening_convert_lines)
+
+
+def _lines_detail(lines, cap: int = 3) -> str:
+    shown = [f"L{n}: {t[:160]}" for n, t in lines[:cap]]
+    more = len(lines) - len(shown)
+    if more > 0:
+        shown.append(f"... and {more} more")
+    return "\n".join(shown)
+
+
+def measure(hlo_txt: str) -> Dict:
+    """The manifest-facing measurements of one compiled program."""
+    ops = module_op_counts(hlo_txt)
+    return {
+        "ops": nontrivial_total(ops),
+        "fusions": ops.get("fusion", 0),
+        "collectives": collective_census(hlo_txt),
+        "donation": aliased_param_count(hlo_txt),
+    }
+
+
+def check_program(spec, hlo_txt: str,
+                  entry: Optional[Dict]) -> List[GcFinding]:
+    """Contract-check one program. ``entry`` is the committed manifest
+    record (None = program not yet recorded -> GC002 plus every
+    contract check that needs no baseline)."""
+    name = spec.name
+    out: List[GcFinding] = []
+    allow = set((entry or {}).get("allow", ()))
+    cur = measure(hlo_txt)
+
+    if entry is None:
+        out.append(GcFinding(
+            "GC002", name,
+            "program has no entry in contracts.json",
+            "run `python -m tools.graftcheck --update` and commit"))
+
+    # GC1xx: declared donation must materialize in the compiled module
+    if spec.declares_donation:
+        expected = (entry or {}).get("donation")
+        minimum = expected if isinstance(expected, int) else 1
+        if cur["donation"] < minimum:
+            out.append(GcFinding(
+                "GC101", name,
+                f"declared donation did not materialize: "
+                f"{cur['donation']} aliased parameter(s), expected "
+                f">= {minimum} (jit site declares donate="
+                f"{spec.donate!r})",
+                "XLA drops aliases it cannot honor (shape/dtype "
+                "mismatch, buffer still live) without failing — check "
+                "the donated arg is returned with identical layout"))
+
+    # GC2xx: dtype discipline
+    if not spec.allow_f64:
+        wide = wide_dtype_lines(hlo_txt)
+        if wide:
+            out.append(GcFinding(
+                "GC201", name,
+                f"{len(wide)} instruction(s) produce 8-byte element "
+                "types (f64/s64/u64/c128) in an f32 program",
+                _lines_detail(wide)))
+        conv = widening_convert_lines(hlo_txt)
+        if conv:
+            out.append(GcFinding(
+                "GC202", name,
+                f"{len(conv)} widening convert(s) to the x64 family "
+                "(python float / numpy scalar promotion leak)",
+                _lines_detail(conv)))
+
+    # GC3xx: host callbacks in hot programs
+    if spec.hot:
+        cbs = host_callback_lines(hlo_txt)
+        if cbs:
+            out.append(GcFinding(
+                "GC301", name,
+                f"{len(cbs)} host callback/transfer op(s) compiled "
+                "into a hot program (one host round-trip per "
+                "dispatch)",
+                _lines_detail(cbs)))
+
+    # GC4xx: collective census
+    cols = cur["collectives"]
+    expected_cols = (entry or {}).get("collectives", {})
+    if not spec.collective:
+        if cols:
+            out.append(GcFinding(
+                "GC401", name,
+                "collectives in a program whose contract declares "
+                f"none: {cols}",
+                "a single-device program gained cross-device traffic"))
+    elif entry is not None and cols != expected_cols:
+        out.append(GcFinding(
+            "GC401", name,
+            f"collective census changed: {cols} != committed "
+            f"{expected_cols}",
+            "an extra all-reduce/all-gather per split is exactly the "
+            "cost the voting/pipelined designs exist to avoid; if "
+            "intentional, re-run --update and justify in the PR"))
+
+    # GC5xx: dynamic shapes
+    dyn = dynamic_shape_lines(hlo_txt)
+    if dyn:
+        out.append(GcFinding(
+            "GC501", name,
+            f"{len(dyn)} dynamic-shape op(s) (bounded dynamism / "
+            "pad-to-static) compiled in",
+            _lines_detail(dyn)))
+
+    # GC6xx: op/fusion budgets (the hlo_census model generalized)
+    if entry is not None and "ops" in entry:
+        limit = entry["ops"] + entry.get("ops_slack", 0)
+        if cur["ops"] > limit:
+            out.append(GcFinding(
+                "GC601", name,
+                f"op count {cur['ops']} exceeds budget "
+                f"{entry['ops']} + slack {entry.get('ops_slack', 0)}",
+                "more executable ops = more per-dispatch fixed cost; "
+                "if intentional, --update and justify"))
+    if entry is not None and "fusions" in entry:
+        limit = entry["fusions"] + entry.get("fusions_slack", 0)
+        if cur["fusions"] > limit:
+            out.append(GcFinding(
+                "GC602", name,
+                f"fusion count {cur['fusions']} exceeds budget "
+                f"{entry['fusions']} + slack "
+                f"{entry.get('fusions_slack', 0)}",
+                "fusion fragmentation — XLA stopped fusing something "
+                "it used to"))
+
+    return [f for f in out if f.rule not in allow]

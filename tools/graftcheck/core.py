@@ -1,0 +1,79 @@
+"""graftcheck run loop: build every registered program, measure, and
+contract-check against the committed manifest."""
+
+from __future__ import annotations
+
+import traceback
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .checks import check_program, measure
+from .findings import GcFinding, sort_findings
+from .manifest import load_manifest, stale_entries
+from .programs import (BUILDERS, build_program,
+                       import_side_registrations)
+
+
+def run_census(names: Optional[Sequence[str]] = None
+               ) -> Tuple[Dict, List[GcFinding]]:
+    """Build + measure every requested program. Returns
+    ``({"config": ..., "programs": {name: measurements}},
+    build_findings)`` — build failures become GC001 findings instead
+    of aborting the sweep (one broken program must not hide the other
+    29 results)."""
+    import jax
+    import_side_registrations()
+    current: Dict = {
+        "config": {
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "jax": jax.__version__,
+        },
+        "programs": {},
+    }
+    findings: List[GcFinding] = []
+    hlo_texts: Dict[str, str] = {}
+    for name in sorted(names or BUILDERS):
+        try:
+            txt = build_program(name)
+        except Exception as e:  # noqa: BLE001 — reported as GC001
+            findings.append(GcFinding(
+                "GC001", name,
+                f"failed to build/lower/compile: {type(e).__name__}: "
+                f"{e}",
+                traceback.format_exc(limit=4)))
+            continue
+        hlo_texts[name] = txt
+        current["programs"][name] = measure(txt)
+    current["_hlo"] = hlo_texts  # transient (not written to JSON)
+    return current, findings
+
+
+def check_run(current: Dict, build_findings: List[GcFinding],
+              manifest: Optional[Dict] = None) -> List[GcFinding]:
+    """Contract-check a run_census result against the manifest."""
+    from lightgbm_tpu.utils import jit_registry
+    manifest = manifest if manifest is not None else load_manifest()
+    findings = list(build_findings)
+    progs = manifest.get("programs", {})
+    for name, txt in current.get("_hlo", {}).items():
+        spec = jit_registry.get(name)
+        if spec is None:
+            findings.append(GcFinding(
+                "GC001", name,
+                "example builder exists but no program registered "
+                "under this name",
+                "register_jit/register_dynamic the site, or drop the "
+                "builder"))
+            continue
+        findings.extend(check_program(spec, txt, progs.get(name)))
+    # registry entries with no example builder can never be checked —
+    # that is exactly the silent rot GL506 + this sweep exist to stop
+    for name in jit_registry.names():
+        if name not in BUILDERS:
+            findings.append(GcFinding(
+                "GC001", name,
+                "registered program has no example builder in "
+                "tools/graftcheck/programs.py",
+                "add a builder so the contract is actually checked"))
+    findings.extend(stale_entries(manifest, list(BUILDERS)))
+    return sort_findings(findings)

@@ -1,0 +1,47 @@
+"""graftcheck finding record.
+
+Stable rule-id blocks (docs/StaticAnalysis.md):
+  GC0xx  harness      (build/lower failure, manifest drift)
+  GC1xx  donation     (declared donation did not materialize)
+  GC2xx  dtype        (f64 ops, widening converts)
+  GC3xx  host sync    (callbacks / infeed / outfeed in hot programs)
+  GC4xx  collectives  (census mismatch vs the committed manifest)
+  GC5xx  shapes       (dynamic-shape machinery compiled in)
+  GC6xx  budgets      (op / fusion count past manifest + slack)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+
+@dataclasses.dataclass(frozen=True)
+class GcFinding:
+    rule: str        # e.g. "GC101"
+    program: str     # registered program name
+    message: str
+    detail: str = ""  # evidence: offending HLO lines, counts, diffs
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "program": self.program,
+                "message": self.message, "detail": self.detail}
+
+
+RULE_NAMES = {
+    "GC001": "build-error",
+    "GC002": "missing-contract",
+    "GC003": "stale-contract",
+    "GC101": "donation-dropped",
+    "GC201": "f64-op",
+    "GC202": "widening-convert",
+    "GC301": "host-callback",
+    "GC401": "collective-mismatch",
+    "GC501": "dynamic-shape",
+    "GC601": "op-budget",
+    "GC602": "fusion-budget",
+}
+
+
+def sort_findings(findings: List[GcFinding]) -> List[GcFinding]:
+    return sorted(findings, key=lambda f: (f.program, f.rule))

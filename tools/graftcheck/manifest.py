@@ -1,0 +1,75 @@
+"""The committed contract manifest (``tools/graftcheck/contracts.json``).
+
+Same workflow as ``tools/hlo_census_budget.json``: ``--check`` compares
+the current lowered artifacts against the committed measurements +
+slack, ``--update`` rewrites the measurements while PRESERVING the
+human-owned fields (``ops_slack``, ``fusions_slack``, ``allow``,
+``note``). Collective multisets and donation counts are exact — no
+slack: a new all-reduce or a dropped alias is never benign drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from .findings import GcFinding
+
+MANIFEST_PATH = os.path.join(os.path.dirname(__file__),
+                             "contracts.json")
+
+# human-owned per-program fields --update must never clobber
+PRESERVED_FIELDS = ("ops_slack", "fusions_slack", "allow", "note")
+
+
+def load_manifest(path: str = MANIFEST_PATH) -> Dict:
+    if not os.path.exists(path):
+        return {"config": {}, "programs": {}}
+    with open(path) as f:
+        return json.load(f)
+
+
+def default_slacks(ops: int, fusions: int) -> Dict[str, int]:
+    """First-update slack: 10% rounded up, floored at 8 ops / 4
+    fusions (the hlo_census defaults scaled to whole-module counts)."""
+    return {"ops_slack": max(8, (ops + 9) // 10),
+            "fusions_slack": max(4, (fusions + 9) // 10)}
+
+
+def update_manifest(current: Dict, path: str = MANIFEST_PATH) -> Dict:
+    """Merge a census run (``{"config": ..., "programs": {name:
+    measurements}}``) into the committed manifest, preserving
+    human-owned fields, and write it. Programs missing from this run
+    are kept untouched (a partial --programs update must not drop
+    them)."""
+    manifest = load_manifest(path)
+    progs = manifest.setdefault("programs", {})
+    for name, cur in current["programs"].items():
+        entry = progs.setdefault(name, {})
+        kept = {k: entry[k] for k in PRESERVED_FIELDS if k in entry}
+        entry.clear()
+        entry.update(cur)
+        for k, v in default_slacks(cur["ops"], cur["fusions"]).items():
+            entry[k] = kept.get(k, v)
+        for k in ("allow", "note"):
+            if k in kept:
+                entry[k] = kept[k]
+    manifest["config"] = current["config"]
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return manifest
+
+
+def stale_entries(manifest: Dict,
+                  registered: List[str]) -> List[GcFinding]:
+    """GC003 for manifest programs no longer in the registry — the
+    contracts file must not accrete dead entries."""
+    reg = set(registered)
+    return [GcFinding("GC003", name,
+                      "manifest entry has no registered program",
+                      "remove it from contracts.json (or restore the "
+                      "registration)")
+            for name in sorted(manifest.get("programs", {}))
+            if name not in reg]

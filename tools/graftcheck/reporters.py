@@ -1,0 +1,47 @@
+"""Table + JSON reporters for graftcheck findings and measurements."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from .findings import GcFinding, RULE_NAMES
+
+
+def render_table(findings: List[GcFinding], current: Dict) -> str:
+    lines = []
+    progs = current.get("programs", {})
+    if progs:
+        w = max(len(n) for n in progs)
+        lines.append(f"{'program':<{w}}  ops  fusions  donation  "
+                     "collectives")
+        for name in sorted(progs):
+            c = progs[name]
+            cols = ",".join(f"{k}={v}"
+                            for k, v in sorted(c["collectives"].items())) \
+                or "-"
+            lines.append(f"{name:<{w}}  {c['ops']:>3}  "
+                         f"{c['fusions']:>7}  {c['donation']:>8}  "
+                         f"{cols}")
+    if findings:
+        lines.append("")
+        lines.append(f"{len(findings)} finding(s):")
+        for f in findings:
+            rule = f"{f.rule}[{RULE_NAMES.get(f.rule, '?')}]"
+            lines.append(f"  {f.program}: {rule} {f.message}")
+            for dl in f.detail.splitlines():
+                lines.append(f"      {dl}")
+    else:
+        lines.append("")
+        lines.append("graftcheck: all program contracts hold")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(findings: List[GcFinding], current: Dict) -> str:
+    payload = {
+        "config": current.get("config", {}),
+        "programs": current.get("programs", {}),
+        "findings": [f.to_json() for f in findings],
+        "ok": not findings,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True) + "\n"

@@ -6,7 +6,8 @@ Rule id blocks:
   GL3xx retrace hazards  (jit-in-loop, static array args, shape keys,
                           churning closure captures)
   GL4xx dtype/determinism (float64 in traced code, host entropy)
-  GL5xx telemetry        (span discipline)
+  GL5xx telemetry/registry (span discipline; graftcheck GC-link:
+                          every jit site registered or allow-marked)
   GL6xx hygiene          (ruff-parity: unused imports, undefined
                           names, mutable defaults)
 """
@@ -22,6 +23,7 @@ from .host_sync import (HostCoerceRule, ImplicitDeviceFetchRule,
                         ItemCallRule, NpInTraceRule, TracedBranchRule)
 from .hygiene import (MutableDefaultRule, UndefinedNameRule,
                       UnusedImportRule)
+from .registration import UnregisteredJitSiteRule
 from .retrace import (JitInLoopRule, ScalarClosureRule,
                       ShapeKeyRule, StaticArrayArgRule)
 from .telemetry import SpanWithoutWithRule
@@ -33,7 +35,7 @@ ALL_RULES: List[Rule] = [
     JitInLoopRule(), StaticArrayArgRule(), ShapeKeyRule(),
     ScalarClosureRule(),
     Float64InTraceRule(), HostEntropyRule(),
-    SpanWithoutWithRule(),
+    SpanWithoutWithRule(), UnregisteredJitSiteRule(),
     UnusedImportRule(), UndefinedNameRule(), MutableDefaultRule(),
 ]
 

@@ -1,0 +1,127 @@
+"""GL506 — graftcheck registration link (GC-link).
+
+Every ``jax.jit`` / ``pjit`` / ``pallas_call`` site in
+``lightgbm_tpu/`` must be covered by the graftcheck registry
+(``lightgbm_tpu/utils/jit_registry.py``) so its compiled program gets
+contract-checked in CI — an unregistered jit site is a program whose
+donation/dtype/collective behavior nothing gates. A site counts as
+registered when:
+
+  * it is wrapped in ``register_jit(...)`` / ``register_dynamic(...)``
+    (``register_dynamic("name", jax.jit(fn))``,
+    ``register_jit("name")(functools.partial(jax.jit, ...)(core))``);
+  * it decorates (or is decorated alongside) a function that carries a
+    ``@register_jit(...)`` decorator; or
+  * it sits INSIDE a function that is itself registered (a
+    ``pallas_call`` in the body of a registered jitted wrapper — one
+    registration covers the whole compiled program).
+
+Intentionally unregistered cold paths (one-shot probes, diagnostics)
+carry the usual ``# graftlint: allow[GL506]`` escape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..context import ModuleContext, dotted_name
+from ..core import Rule
+from ..findings import Finding
+
+_REGISTER_FNS = {"register_jit", "register_dynamic"}
+_JIT_HEADS = {"jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"}
+_PALLAS_HEADS = {"pl.pallas_call", "pallas_call",
+                 "pallas.pallas_call"}
+
+
+def _is_register_call(node: ast.AST) -> bool:
+    """``register_jit(...)`` / ``register_dynamic(...)`` call, or the
+    second-stage call of the decorator form
+    ``register_jit(...)(wrapped)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name and name.split(".")[-1] in _REGISTER_FNS:
+        return True
+    inner = node.func
+    return (isinstance(inner, ast.Call)
+            and (dotted_name(inner.func) or "").split(".")[-1]
+            in _REGISTER_FNS)
+
+
+def _decorators_register(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name and name.split(".")[-1] in _REGISTER_FNS:
+            return True
+    return False
+
+
+class UnregisteredJitSiteRule(Rule):
+    rule_id = "GL506"
+    name = "unregistered-jit-site"
+    description = ("jax.jit/pjit/pallas_call site not covered by the "
+                   "graftcheck registry (utils/jit_registry.py) — its "
+                   "compiled program has no contract gate; register "
+                   "it or mark an intentional cold path with "
+                   "`# graftlint: allow[GL506]`")
+
+    def _site_kind(self, node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name in _JIT_HEADS:
+            return "jit"
+        if name in _PALLAS_HEADS:
+            return "pallas_call"
+        # functools.partial(jax.jit, ...) applied as decorator/wrapper
+        if name in ("functools.partial", "partial") and node.args:
+            head = dotted_name(node.args[0])
+            if head in _JIT_HEADS:
+                return "jit"
+        return None
+
+    def check(self, module: ModuleContext) -> Iterator[Finding]:
+        # functions carrying a @register_jit decorator: everything
+        # lexically inside them is covered by that registration
+        registered_spans: Set[ast.AST] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and _decorators_register(node):
+                registered_spans.add(node)
+
+        def is_covered(node: ast.AST) -> bool:
+            anc = module.parent_map.get(node)
+            while anc is not None:
+                if _is_register_call(anc) or anc in registered_spans:
+                    return True
+                anc = module.parent_map.get(anc)
+            return False
+
+        def report(node: ast.AST, kind: str) -> Finding:
+            return self.finding(
+                module, node,
+                f"{kind} site is not registered with the graftcheck "
+                "registry (register_jit/register_dynamic, or "
+                "allow[GL506] for an intentional cold path)")
+
+        for node in ast.walk(module.tree):
+            # bare-decorator form: ``@jax.jit`` without parens is an
+            # Attribute, not a Call — check decorator lists directly
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node not in registered_spans:
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) \
+                            and dotted_name(dec) in _JIT_HEADS \
+                            and not is_covered(node):
+                        yield report(dec, "jit")
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._site_kind(node)
+            if kind is None:
+                continue
+            if is_covered(node):
+                continue
+            yield report(node, kind)

@@ -36,14 +36,17 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
-from collections import Counter
 
 # the census must run on CPU regardless of the ambient platform (and
 # must never dial a TPU tunnel from CI)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+# parsing/counting core shared with tools/graftcheck (ONE parser, two
+# front-ends — ISSUE 9); the helpers moved there verbatim, so the
+# committed budget and the reported fixed-config counts are unchanged
+from tools.graftcheck.hlo import census_from_hlo  # noqa: E402
 
 BUDGET_PATH = os.path.join(os.path.dirname(__file__),
                            "hlo_census_budget.json")
@@ -56,98 +59,6 @@ BUDGET_PATH = os.path.join(os.path.dirname(__file__),
 CENSUS_ROWS = 4096
 CENSUS_FEATURES = 28
 CENSUS_LEAVES = 63
-
-_TRIVIAL = ("get-tuple-element", "parameter", "constant", "tuple",
-            "bitcast")
-_TYPES = ("f32", "s32", "u32", "u8", "pred", "u16", "bf16", "s8",
-          "s64", "f64", "u64", "c64", "c128", "s16", "f16")
-_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
-                "collective-permute", "all-to-all")
-
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-                "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
-                "s64": 8, "u64": 8, "f64": 8}
-
-
-def _op_of(line: str):
-    """HLO opcode of one instruction line (first known-op token
-    preceding a paren that is not a dtype)."""
-    rhs = line.split(" = ", 1)[1]
-    for cand in re.findall(r"([a-z][a-z0-9\-]*)\(", rhs):
-        if cand not in _TYPES:
-            return cand
-    return None
-
-
-def _shape_bytes(shape: str) -> int:
-    m = re.match(r"([a-z0-9]+)\[([\d,]*)\]", shape)
-    if not m:
-        return 0
-    n = 1
-    for d in m.group(2).split(","):
-        if d:
-            n *= int(d)
-    return n * _DTYPE_BYTES.get(m.group(1), 4)
-
-
-def _carry_stats(line: str):
-    """(elements, bytes) of a while instruction's carry tuple."""
-    m = re.search(r"= \((.*?)\) while\(", line)
-    if not m:
-        return 0, 0
-    shapes = re.findall(r"[a-z0-9]+\[[\d,]*\](?:\{[\d,]*\})?",
-                        m.group(1))
-    return len(shapes), sum(_shape_bytes(s) for s in shapes)
-
-
-def census_from_hlo(txt: str) -> dict:
-    """Census of the grow while loop inside one compiled HLO module."""
-    lines = txt.splitlines()
-    candidates = []  # (body_name, carry_elems, carry_bytes)
-    for m in re.finditer(r"body=(%[\w.\-]+)", txt):
-        s = txt.rfind("\n", 0, m.start()) + 1
-        line = txt[s:txt.find("\n", m.end())]
-        if "known_trip_count" in line:
-            continue
-        elems, nbytes = _carry_stats(line)
-        candidates.append((m.group(1), elems, nbytes))
-    best = None
-    for body, elems, nbytes in candidates:
-        start = None
-        for i, ln in enumerate(lines):
-            if ln.startswith(body + " "):
-                start = i
-                break
-        if start is None:
-            continue
-        ops = Counter()
-        for ln in lines[start + 1:]:
-            if ln.startswith("}"):
-                break
-            if " = " not in ln:
-                continue
-            op = _op_of(ln)
-            if op:
-                ops[op] += 1
-        total = sum(ops.values())
-        nontrivial = total - sum(ops[t] for t in _TRIVIAL)
-        if best is None or nontrivial > best["ops_per_split"]:
-            best = dict(
-                body=body.lstrip("%"),
-                ops_per_split=nontrivial,
-                total_instructions=total,
-                fusions=ops.get("fusion", 0),
-                inner_whiles=ops.get("while", 0),
-                collectives=sum(ops.get(c, 0) for c in _COLLECTIVES),
-                carry_arrays=elems,
-                carry_bytes=nbytes,
-                op_histogram={k: v for k, v in sorted(
-                    ops.items(), key=lambda kv: -kv[1])},
-            )
-    if best is None:
-        raise RuntimeError("no grow while loop found in compiled HLO")
-    return best
-
 
 def _build_dataset(rows=CENSUS_ROWS, features=CENSUS_FEATURES,
                    leaves=CENSUS_LEAVES):
@@ -164,7 +75,9 @@ def _build_dataset(rows=CENSUS_ROWS, features=CENSUS_FEATURES,
     return Dataset.from_numpy(x, cfg, label=y), cfg
 
 
-def _compiled_serial(ds, cfg) -> str:
+def lower_serial(ds, cfg):
+    """jax Lowered of the serial grow program at this dataset/config
+    (shared with tools/graftcheck's serial_grow example builder)."""
     import jax.numpy as jnp
 
     from lightgbm_tpu.learner.serial import SerialTreeLearner, _grow_jit
@@ -172,7 +85,7 @@ def _compiled_serial(ds, cfg) -> str:
     n = ds.num_data
     grad = jnp.zeros((n,), jnp.float32)
     hess = jnp.ones((n,), jnp.float32)
-    low = _grow_jit.lower(
+    return _grow_jit.lower(
         lrn.binned, grad, hess, lrn._ones_rows, lrn._all_features,
         lrn.meta, rand_key=None, cegb_used0=None, cegb_charged0=None,
         params=lrn.params, num_leaves=lrn.num_leaves,
@@ -183,10 +96,15 @@ def _compiled_serial(ds, cfg) -> str:
         mv_slots=lrn.mv_slots, mv_groups=lrn.mv_groups,
         has_monotone=lrn.has_monotone,
         split_fusion=_fusion_mode())
-    return low.compile().as_text()
 
 
-def _compiled_partitioned(ds, cfg) -> str:
+def _compiled_serial(ds, cfg) -> str:
+    return lower_serial(ds, cfg).compile().as_text()
+
+
+def lower_partitioned(ds, cfg):
+    """jax Lowered of the partitioned grow program (shared with
+    tools/graftcheck's partitioned_grow example builder)."""
     import jax.numpy as jnp
 
     from lightgbm_tpu.learner.partitioned import (PartitionedTreeLearner,
@@ -195,7 +113,7 @@ def _compiled_partitioned(ds, cfg) -> str:
     n = ds.num_data
     grad = jnp.zeros((n,), jnp.float32)
     hess = jnp.ones((n,), jnp.float32)
-    low = _grow_partitioned.lower(
+    return _grow_partitioned.lower(
         lrn.mat, lrn.ws, grad, hess, lrn._ones_rows, lrn._all_features,
         lrn.meta, None, None, params=lrn.params,
         num_leaves=lrn.num_leaves, max_depth=lrn.max_depth,
@@ -205,7 +123,10 @@ def _compiled_partitioned(ds, cfg) -> str:
         bynode_count=2, forced_plan=(), cache_hists=lrn.cache_hists,
         hist_slots=lrn.hist_slots, has_monotone=lrn.has_monotone,
         split_fusion=_fusion_mode())
-    return low.compile().as_text()
+
+
+def _compiled_partitioned(ds, cfg) -> str:
+    return lower_partitioned(ds, cfg).compile().as_text()
 
 
 def _fusion_mode() -> bool:

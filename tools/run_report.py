@@ -367,6 +367,53 @@ def render_census(d: Dict[str, Any]) -> str:
 
 
 # ----------------------------------------------------------------------
+# graftcheck contract artifacts (tools/graftcheck): the per-program
+# contract verdicts render next to the census section — one report
+# answers "how many dispatches" AND "do the compiled contracts hold"
+def load_graftcheck(path: str):
+    """Parse a graftcheck artifact (graftcheck.json); None when the
+    file is not one."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    progs = d.get("programs")
+    if "findings" not in d or not isinstance(progs, dict) or not all(
+            isinstance(p, dict) and "ops" in p
+            for p in progs.values()):
+        return None
+    return d
+
+
+def sibling_graftcheck(trace_path: str):
+    cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                        "graftcheck.json")
+    return load_graftcheck(cand) if os.path.exists(cand) else None
+
+
+def render_graftcheck(d: Dict[str, Any]) -> str:
+    cfg = d.get("config") or {}
+    verdict = "PASS" if d.get("ok") else \
+        f"FAIL ({len(d.get('findings') or [])} finding(s))"
+    L = ["== compiled-program contracts (tools/graftcheck) ==",
+         f"backend={cfg.get('backend')} devices={cfg.get('devices')} "
+         f"jax={cfg.get('jax')}  verdict: {verdict}",
+         f"{'program':<28}{'ops':>6}{'fusions':>9}{'donation':>10}"
+         "  collectives"]
+    for name, p in sorted((d.get("programs") or {}).items()):
+        cols = ",".join(f"{k}={v}" for k, v in sorted(
+            (p.get("collectives") or {}).items())) or "-"
+        L.append(f"{name:<28}{p.get('ops', 0):>6}"
+                 f"{p.get('fusions', 0):>9}"
+                 f"{p.get('donation', 0):>10}  {cols}")
+    for f in d.get("findings") or []:
+        L.append(f"  {f.get('program')}: {f.get('rule')} "
+                 f"{f.get('message')}")
+    return "\n".join(L) + "\n"
+
+
+# ----------------------------------------------------------------------
 # crash flight-recorder dumps (observability/flightrec.py)
 def load_crash(path: str):
     """The whole-file JSON object when ``path`` is a flight-recorder
@@ -463,6 +510,13 @@ def main(argv: List[str]) -> int:
         else:
             sys.stdout.write(render_census(census))
         return 0
+    gc = load_graftcheck(args[0])
+    if gc is not None:
+        if "--json" in argv:
+            print(json.dumps(gc))
+        else:
+            sys.stdout.write(render_graftcheck(gc))
+        return 0
     records = load(args[0])
     if not records:
         sys.stderr.write(f"no records in {args[0]}\n")
@@ -474,6 +528,9 @@ def main(argv: List[str]) -> int:
         sib = sibling_census(args[0])
         if sib is not None:
             sys.stdout.write("\n" + render_census(sib))
+        sgc = sibling_graftcheck(args[0])
+        if sgc is not None:
+            sys.stdout.write("\n" + render_graftcheck(sgc))
     return 0
 
 
