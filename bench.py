@@ -214,6 +214,32 @@ def measure():
             eng.stop()
         except Exception as e:  # noqa: BLE001
             result["serving_error"] = str(e)[:200]
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        # fleet-serving headline (serving/fleet.py): a short open-loop
+        # soak through a 2-replica, 2-named-model pool — the
+        # p99/throughput/shed-rate trajectory tools/bench_trend.py
+        # chains round-over-round. Same booster under both names keeps
+        # the block cheap (shared compiled programs, shared device
+        # arrays are NOT shared across versions — pinning is measured
+        # too). Failures are recorded, never fatal.
+        try:
+            from lightgbm_tpu.serving import FleetEngine, ServingConfig
+            from lightgbm_tpu.serving.loadgen import soak_loop
+            fl = FleetEngine(
+                models={"base": booster, "variant": booster},
+                config=ServingConfig(buckets=(1, 64, 256),
+                                     device="always"),
+                replicas=2, default_model="base")
+            blk = soak_loop(
+                fl, X[:4096], batch_sizes=(1, 64),
+                models=["base", "variant"],
+                duration_s=float(os.environ.get("BENCH_FLEET_S", 2)),
+                qps=float(os.environ.get("BENCH_FLEET_QPS", 150)))
+            blk["backend"] = result["backend"]
+            result["fleet"] = blk
+            fl.stop()
+        except Exception as e:  # noqa: BLE001
+            result["fleet_error"] = str(e)[:200]
     tel.flush()
     print(json.dumps(result))
 
@@ -399,6 +425,7 @@ def _fixed_cpu_child_env(env):
     envc["BENCH_ITERS"] = str(CPU_BASELINE["iters"])
     envc["BENCH_WARMUP_ITERS"] = str(CPU_BASELINE["iters"] + 1)
     envc["BENCH_SERVING"] = "0"       # training throughput only
+    envc["BENCH_FLEET"] = "0"
     envc["BENCH_MIN_AUC"] = os.environ.get("BENCH_BASELINE_MIN_AUC",
                                            "0.75")
     return envc
@@ -528,6 +555,7 @@ def run_quality_gate(env, remaining):
     envc["BENCH_ITERS"] = str(QUALITY_GATE["iters"])
     envc["BENCH_WARMUP_ITERS"] = "1"
     envc["BENCH_SERVING"] = "0"
+    envc["BENCH_FLEET"] = "0"
     min_auc = float(base["auc"]) - QUALITY_GATE["tolerance"]
     envc["BENCH_MIN_AUC"] = repr(min_auc)
     parsed, err = _run_child(

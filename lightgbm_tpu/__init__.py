@@ -19,7 +19,8 @@ from .config import Config
 from .engine import CVBooster, cv, train
 from .observability import get_telemetry
 from .parallel.distributed import init_distributed
-from .serving import ModelRegistry, ServingConfig, ServingEngine
+from .serving import (FleetEngine, ModelRegistry, Router,
+                      ServingConfig, ServingEngine, TenantQuotas)
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
 try:  # plotting needs matplotlib (reference: python-package __init__.py)
@@ -37,4 +38,5 @@ __all__ = ["Dataset", "Booster", "LightGBMError", "Config",
            "record_telemetry", "reset_parameter", "get_telemetry",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "init_distributed",
-           "ServingEngine", "ServingConfig", "ModelRegistry"] + _PLOT
+           "ServingEngine", "ServingConfig", "ModelRegistry",
+           "FleetEngine", "Router", "TenantQuotas"] + _PLOT

@@ -65,6 +65,9 @@ def _get(h: int) -> Any:
 def free_handle(h: int) -> None:
     _handles.pop(int(h), None)
     _field_refs.pop(int(h), None)
+    cached = _FAST_ENGINES.pop(int(h), None)
+    if cached is not None:   # booster freed -> drop its fast engine
+        cached[0].stop(drain=False)
 
 
 def _parse_params(parameters: str) -> Dict[str, str]:
@@ -743,6 +746,33 @@ def booster_predict_for_mat(h: int, data_ptr: int, data_type: int,
 _FAST_KINDS = {PREDICT_NORMAL: "predict", PREDICT_RAW_SCORE: "raw_score",
                PREDICT_LEAF_INDEX: "pred_leaf"}
 
+# queue-bypassing engines keyed by the BOOSTER handle — one pinned
+# engine per live booster, shared by every fast-config on that handle.
+# Keying per handle (instead of one process-wide slot) is what keeps
+# concurrently live models from cross-wiring: each handle's engine
+# pins that booster's stacked arrays and nothing else. The cached
+# tree count invalidates the entry when the booster trains further
+# between init calls. Freed with its booster handle.
+_FAST_ENGINES: Dict[int, tuple] = {}
+
+
+def _fast_engine_for(h: int, bst):
+    """The shared queue-bypassing engine for one booster handle."""
+    from .serving import ServingConfig, ServingEngine
+    num_trees = len(bst._src().models)
+    cached = _FAST_ENGINES.get(int(h))
+    if cached is not None and cached[1] == num_trees:
+        return cached[0]
+    if cached is not None:
+        cached[0].stop(drain=False)
+    # no flusher thread, no warmup bill at init; buckets keep repeat
+    # shapes compile-free (predict_now dispatches on the caller thread)
+    engine = ServingEngine(
+        bst, config=ServingConfig(buckets=(1, 64), warmup=False),
+        auto_start=False)
+    _FAST_ENGINES[int(h)] = (engine, num_trees)
+    return engine
+
 
 class _FastConfig:
     __slots__ = ("bst", "engine", "kind", "ncol", "data_type",
@@ -753,7 +783,6 @@ def booster_predict_for_mat_single_row_fast_init(
         h: int, predict_type: int, num_iteration: int, data_type: int,
         ncol: int, parameter: str) -> int:
     """-> fast-config handle (freed with fast_config_free)."""
-    from .serving import ServingConfig, ServingEngine
     bst = _get(h)
     fc = _FastConfig()
     fc.bst = bst
@@ -770,11 +799,7 @@ def booster_predict_for_mat_single_row_fast_init(
     elif fc.kind is None:   # PREDICT_CONTRIB: SHAP is host-only anyway
         fc.engine = None
     else:
-        # queue-bypassing engine (predict_now): no flusher thread, no
-        # warmup bill at init; buckets keep repeat shapes compile-free
-        fc.engine = ServingEngine(
-            bst, config=ServingConfig(buckets=(1, 64), warmup=False),
-            auto_start=False)
+        fc.engine = _fast_engine_for(h, bst)
     return _register(fc)
 
 

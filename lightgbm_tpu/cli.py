@@ -237,22 +237,43 @@ def run_refit(params: Dict[str, str]) -> None:
 def run_serve(params: Dict[str, str]) -> None:
     """``task=serve``: load ``input_model`` and serve it over the JSON
     HTTP frontend (serving/http.py) with micro-batching and
-    shape-bucketed compiled dispatch (docs/Serving.md)."""
+    shape-bucketed compiled dispatch (docs/Serving.md).
+
+    ``serving_replicas > 1`` or a ``serving_models`` list switches to
+    the fleet topology (serving/fleet.py): a replica pool with
+    least-loaded dispatch, named models, canary/shadow routing
+    (``serving_canary_*`` / ``serving_shadow_model``) and per-tenant
+    quotas (``serving_quota_*``) behind the same frontend."""
     from .basic import Booster
     from .config import Config
     from .observability.telemetry import get_telemetry
-    from .serving import ServingConfig, ServingEngine
+    from .serving import FleetEngine, ServingConfig, ServingEngine
     from .serving.http import serve_forever
+    from .utils.compile_cache import maybe_enable_compile_cache
     cfg = Config.from_params(params)
     get_telemetry().ensure_started(cfg)
     # the frontend serves /metrics on its own port; metrics_port
     # additionally exports on a dedicated port when configured
     from .observability.metrics import maybe_start_exporter
     maybe_start_exporter(cfg)
-    if not cfg.input_model:
-        log_fatal("task=serve requires input_model=<model file>")
-    booster = Booster(model_file=cfg.input_model)
-    engine = ServingEngine(booster, config=ServingConfig.from_config(cfg))
+    # zero-compile cold start: with compile_cache_dir (or
+    # LGBM_TPU_COMPILE_CACHE) pointing at a warm persistent cache,
+    # warmup replays the serialized bucket programs instead of
+    # compiling them (docs/Serving.md "zero-compile cold start")
+    maybe_enable_compile_cache(cfg)
+    fleet_mode = int(cfg.serving_replicas) > 1 or cfg.serving_models
+    if not cfg.input_model and not cfg.serving_models:
+        log_fatal("task=serve requires input_model=<model file> "
+                  "(or serving_models=name=path,...)")
+    if fleet_mode:
+        models = {}
+        if cfg.input_model:
+            models["default"] = Booster(model_file=cfg.input_model)
+        engine = FleetEngine.from_config(cfg, models=models)
+    else:
+        booster = Booster(model_file=cfg.input_model)
+        engine = ServingEngine(booster,
+                               config=ServingConfig.from_config(cfg))
     serve_forever(engine, cfg.serving_host, int(cfg.serving_port))
 
 

@@ -136,6 +136,12 @@ _PARAM_ALIASES: Dict[str, str] = {
     "serve_host": "serving_host",
     "serve_port": "serving_port",
     "serving_bucket_sizes": "serving_buckets",
+    "serving_num_replicas": "serving_replicas",
+    "num_replicas": "serving_replicas",
+    "serving_model_list": "serving_models",
+    "serving_canary": "serving_canary_model",
+    "serving_shadow": "serving_shadow_model",
+    "serving_quota_rate": "serving_quota_qps",
     "checkpoint_path": "checkpoint_dir", "ckpt_dir": "checkpoint_dir",
     "checkpoint_period": "checkpoint_freq",
     "keep_checkpoints": "checkpoint_keep",
@@ -363,6 +369,22 @@ class Config:
     serving_shed_policy: str = "reject_new"
     serving_device: str = "auto"
     serving_warmup: bool = True
+    # ---- fleet serving (serving/fleet.py, docs/Serving.md "Fleet"):
+    # replica pool size, named-model list ("name=path" entries; the
+    # default model is input_model when set), the shared pending bound
+    # (0 = replicas * serving_max_queue), per-tenant token-bucket
+    # quotas (qps rate + burst; serving_quota_tenants entries are
+    # "tenant=rate" or "tenant=rate:burst"), and the canary/shadow
+    # routing rules applied to the default model
+    serving_replicas: int = 1
+    serving_models: List[str] = field(default_factory=list)
+    serving_max_pending: int = 0
+    serving_quota_qps: float = 0.0
+    serving_quota_burst: float = 0.0
+    serving_quota_tenants: List[str] = field(default_factory=list)
+    serving_canary_model: str = ""
+    serving_canary_weight: float = 0.0
+    serving_shadow_model: str = ""
 
     # ---- objective (config.h:761-832)
     objective_seed: int = 5
@@ -559,6 +581,18 @@ class Config:
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(
                 f"metrics_port={self.metrics_port} is not a port")
+        if self.serving_replicas < 1:
+            raise ValueError("serving_replicas must be >= 1")
+        if not (0.0 <= self.serving_canary_weight <= 1.0):
+            raise ValueError(
+                "serving_canary_weight must be in [0, 1]")
+        if self.serving_quota_qps < 0 or self.serving_quota_burst < 0:
+            raise ValueError("serving_quota_* must be >= 0")
+        if self.serving_canary_weight > 0 \
+                and not self.serving_canary_model:
+            log_warning("serving_canary_weight is set without "
+                        "serving_canary_model; no canary traffic "
+                        "will be split")
         if self.checkpoint_freq > 0 and not self.checkpoint_dir:
             log_warning("checkpoint_freq is set without checkpoint_dir; "
                         "no checkpoints will be written")

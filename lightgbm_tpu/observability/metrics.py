@@ -51,6 +51,8 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _HIST_LAYOUTS: Dict[str, Tuple[float, float, int]] = {
     # serving request latency, milliseconds: 0.05 ms .. ~1.6e6 ms
     "serving_request_latency_ms": (0.05, 2.0 ** 0.5, 50),
+    # fleet request latency, per-(model, tenant) labels: same ladder
+    "fleet_request_latency_ms": (0.05, 2.0 ** 0.5, 50),
     # per-iteration phase wall time, seconds: 0.1 ms .. ~100 s
     "train_phase_seconds": (1e-4, 2.0 ** 0.5, 40),
 }

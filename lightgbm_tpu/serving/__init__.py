@@ -15,19 +15,36 @@ inference accelerators per arxiv 2011.02022 / 1706.08359):
   shedding and host-traversal fallback;
 * ``http.py`` — a stdlib JSON frontend (``python -m lightgbm_tpu
   serve``): predict / raw_score / pred_leaf / health / reload;
-* ``loadgen.py`` — closed- and open-loop load generation shared by
-  ``tools/serve_bench.py`` and ``bench.py``.
+* ``loadgen.py`` — closed- and open-loop load generation plus the
+  sustained soak mode, shared by ``tools/serve_bench.py`` and
+  ``bench.py``;
+* the **fleet layer** (ROADMAP item 3) — :class:`FleetEngine`
+  (``fleet.py``): a replica pool of engines with least-loaded dispatch,
+  per-replica health/draining and zero-compile cold start;
+  :class:`ModelFleet` serving many named models (per-tenant / A-B
+  variants); :class:`Router` (``router.py``) for weighted canary
+  splits and shadow-traffic mirroring; :class:`TenantQuotas`
+  (``tenants.py``) for per-tenant token-bucket admission.
 
 See docs/Serving.md for architecture and tuning.
 """
 
 from .engine import ServingConfig, ServingEngine
 from .errors import (EngineStoppedError, InvalidRequestError,
-                     ModelLoadError, QueueFullError, RequestTimeoutError,
-                     ServingError)
+                     ModelLoadError, ModelNotFoundError, QueueFullError,
+                     QuotaExceededError, ReplicaUnavailableError,
+                     RequestTimeoutError, ServingError)
+from .fleet import FleetEngine, ModelFleet, Replica
 from .registry import ModelRegistry, save_model_npz
+from .router import RouteDecision, Router
+from .tenants import TenantQuotas, TokenBucket
 
 __all__ = ["ServingEngine", "ServingConfig", "ModelRegistry",
            "save_model_npz", "ServingError", "QueueFullError",
            "RequestTimeoutError", "EngineStoppedError",
-           "ModelLoadError", "InvalidRequestError"]
+           "ModelLoadError", "InvalidRequestError",
+           "ModelNotFoundError", "QuotaExceededError",
+           "ReplicaUnavailableError",
+           "FleetEngine", "ModelFleet", "Replica",
+           "Router", "RouteDecision",
+           "TenantQuotas", "TokenBucket"]

@@ -57,6 +57,29 @@ class ModelLoadError(ServingError):
     http_status = 400
 
 
+class ModelNotFoundError(ServingError):
+    """The request named a model the fleet does not serve."""
+
+    code = "model_not_found"
+    http_status = 404
+
+
+class QuotaExceededError(ServingError):
+    """Per-tenant token-bucket quota exhausted: a structured shed (the
+    fleet's admission-side load shedder), NEVER a timeout — the caller
+    learns immediately and can back off (``retry_after_s`` detail)."""
+
+    code = "quota_exceeded"
+    http_status = 429
+
+
+class ReplicaUnavailableError(ServingError):
+    """No healthy replica can take the dispatch (all dead/draining)."""
+
+    code = "replica_unavailable"
+    http_status = 503
+
+
 class InvalidRequestError(ServingError):
     """Malformed request payload (bad shape, non-numeric rows, ...)."""
 

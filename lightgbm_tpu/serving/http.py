@@ -16,9 +16,22 @@ Endpoints (all JSON):
   depth, shed/timeout counters, device-memory gauges)
 * ``POST /reload``     ``{"model_file": path}`` or ``{"model_str": txt}``
 
+When the engine is a :class:`~lightgbm_tpu.serving.fleet.FleetEngine`
+(``serving_replicas > 1`` or ``serving_models`` configured), predict
+bodies additionally accept ``"model"`` (named model) and ``"tenant"``
+(quota identity; the ``X-Tenant`` header is the fallback), ``/reload``
+accepts ``"model"`` to name the entry being swapped, and one more
+route exists:
+
+* ``POST /route``      canary/shadow control:
+  ``{"model": m, "canary": target, "weight": w}``,
+  ``{"model": m, "shadow": target}``, or ``{"model": m,
+  "promote": true}``
+
 Errors are structured (``{"error": code, "message": ...}``) with the
-HTTP status from the serving error type: 429 queue-full shed, 504
-deadline timeout, 400 malformed input, 503 stopped.
+HTTP status from the serving error type: 429 queue-full or
+quota-exceeded shed, 504 deadline timeout, 400 malformed input,
+404 unknown model, 503 stopped / no healthy replica.
 """
 
 from __future__ import annotations
@@ -95,6 +108,9 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self._predict(kind)
             elif kind == "reload":
                 self._reload()
+            elif kind == "route" \
+                    and getattr(self.engine, "is_fleet", False):
+                self._route()
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": self.path})
@@ -111,7 +127,16 @@ class ServingHandler(BaseHTTPRequestHandler):
         if rows is None:
             raise InvalidRequestError('body needs "rows" (or "row")')
         timeout_ms = body.get("timeout_ms")
-        fut = self.engine.submit(rows, kind=kind, timeout_ms=timeout_ms)
+        kwargs = {}
+        if getattr(self.engine, "is_fleet", False):
+            if body.get("model"):
+                kwargs["model"] = str(body["model"])
+            tenant = body.get("tenant") \
+                or self.headers.get("X-Tenant")
+            if tenant:
+                kwargs["tenant"] = str(tenant)
+        fut = self.engine.submit(rows, kind=kind, timeout_ms=timeout_ms,
+                                 **kwargs)
         t = self.engine.config.request_timeout_ms \
             if timeout_ms is None else float(timeout_ms)
         pred = fut.result(timeout=None if t <= 0 else t / 1000.0 + 5.0)
@@ -124,8 +149,36 @@ class ServingHandler(BaseHTTPRequestHandler):
         if not source:
             raise InvalidRequestError(
                 'body needs "model_file" or "model_str"')
-        version = self.engine.reload(source)
-        self._send_json(200, {"status": "ok", "version": version})
+        kwargs = {}
+        if getattr(self.engine, "is_fleet", False) and body.get("model"):
+            kwargs["model"] = str(body["model"])
+        version = self.engine.reload(source, **kwargs)
+        self._send_json(200, {"status": "ok", "version": version,
+                              **kwargs})
+
+    def _route(self) -> None:
+        """Fleet canary/shadow control plane (POST /route)."""
+        body = self._read_body()
+        model = str(body.get("model")
+                    or self.engine.default_model)
+        out = {"status": "ok", "model": model}
+        if body.get("promote"):
+            out["promoted"] = self.engine.promote_canary(model)
+        elif "canary" in body:
+            try:
+                self.engine.router.set_canary(
+                    model, body.get("canary") or None,
+                    float(body.get("weight", 0.0)))
+            except (TypeError, ValueError) as e:
+                raise InvalidRequestError(str(e)) from e
+        elif "shadow" in body:
+            self.engine.router.set_shadow(
+                model, body.get("shadow") or None)
+        else:
+            raise InvalidRequestError(
+                'body needs "canary", "shadow" or "promote"')
+        out["router"] = self.engine.router.describe()
+        self._send_json(200, out)
 
 
 def make_http_server(engine: ServingEngine, host: str = "127.0.0.1",

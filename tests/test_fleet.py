@@ -1,0 +1,543 @@
+"""Fleet serving tests: replica pool, routing, quotas, soak.
+
+Acceptance gates from the fleet issue:
+  * >=2 named models across >=2 replicas serve BIT-IDENTICAL results
+    vs direct ``predict`` — including across a canary promotion and a
+    hot reload — with zero steady-state recompiles asserted and a
+    cold-started replica performing ZERO compiles when the bucket
+    programs are already cached;
+  * router edge cases: canary weight 0/100, shadow target missing or
+    mid-drain, quota exhaustion returning the structured shed error
+    (never a timeout), replica death mid-request re-dispatching
+    without duplicate responses;
+  * the soak harness survives reload storms + injected faults with
+    availability 1.0.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability.telemetry import get_telemetry
+from lightgbm_tpu.serving import (FleetEngine, ModelNotFoundError,
+                                  QueueFullError, QuotaExceededError,
+                                  ReplicaUnavailableError, Router,
+                                  ServingConfig, TenantQuotas)
+from lightgbm_tpu.serving.tenants import TokenBucket, parse_tenant_specs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy(n=500, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def two_models():
+    X, y = _toy()
+    alpha = lgb.train({"objective": "binary", "num_leaves": 7,
+                       "verbosity": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=8)
+    beta = lgb.train({"objective": "binary", "num_leaves": 5,
+                      "verbosity": -1},
+                     lgb.Dataset(X, label=(X[:, 1] > 0).astype(float)),
+                     num_boost_round=5)
+    return alpha, beta, X
+
+
+@pytest.fixture
+def tel():
+    t = get_telemetry()
+    t.reset()
+    t.ensure_ring()
+    yield t
+    t.reset()
+
+
+def _mk_fleet(models, replicas=2, default="alpha", **kw):
+    cfg = kw.pop("config", None) or ServingConfig(
+        buckets=(4, 16), device="always", flush_interval_ms=1.0)
+    return FleetEngine(models=models, config=cfg, replicas=replicas,
+                       default_model=default, **kw)
+
+
+# ----------------------------------------------------------------------
+# the fleet parity acceptance suite
+def test_fleet_parity_two_models_two_replicas(two_models, tel,
+                                              monkeypatch):
+    """2 named models x 2 replicas: bit-identical to direct predict
+    across mixed batch sizes, across a hot reload AND a canary
+    promotion; zero steady-state recompiles per replica; a replica
+    cold-started afterwards performs ZERO compiles."""
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    alpha, beta, X = two_models
+    fl = _mk_fleet({"alpha": alpha, "beta": beta})
+    try:
+        for n in (1, 3, 7, 16):
+            for model, bst in (("alpha", alpha), ("beta", beta)):
+                np.testing.assert_array_equal(
+                    fl.predict(X[:n], model=model), bst.predict(X[:n]))
+                np.testing.assert_array_equal(
+                    fl.predict(X[:n], model=model, kind="raw_score"),
+                    bst.predict(X[:n], raw_score=True))
+        # steady state: mixed sizes through BOTH replicas recompile
+        # nothing (the warmup already replayed every bucket program)
+        compiles = tel.counters.get("jit.compiles", 0)
+        for _round in range(3):
+            for n in (1, 5, 16):
+                fl.predict(X[:n], model="alpha")
+                fl.predict(X[:n], model="beta")
+        assert tel.counters.get("jit.compiles", 0) == compiles, \
+            "steady-state fleet serving recompiled"
+        served = [r for r in fl.replicas
+                  if any(e.stats()["requests"] > 0
+                         for e in r._engines.values())]
+        assert len(served) == 2, "least-loaded dispatch used one replica"
+
+        # hot reload alpha -> a different booster: pool-wide swap,
+        # bit-identical to the new model afterwards
+        X2, y2 = _toy(seed=9)
+        gamma = lgb.train({"objective": "binary", "num_leaves": 9,
+                           "verbosity": -1},
+                          lgb.Dataset(X2, label=y2), num_boost_round=6)
+        v = fl.reload(gamma, model="alpha")
+        assert v == 2
+        np.testing.assert_array_equal(fl.predict(X[:7], model="alpha"),
+                                      gamma.predict(X[:7]))
+        np.testing.assert_array_equal(fl.predict(X[:7], model="beta"),
+                                      beta.predict(X[:7]))
+
+        # canary 100% -> beta answers alpha traffic; promotion pins it
+        fl.router.set_canary("alpha", "beta", 1.0)
+        np.testing.assert_array_equal(fl.predict(X[:5], model="alpha"),
+                                      beta.predict(X[:5]))
+        assert fl.promote_canary("alpha") == "beta"
+        np.testing.assert_array_equal(fl.predict(X[:5], model="alpha"),
+                                      beta.predict(X[:5]))
+
+        # zero-compile cold start: every bucket program is cached, so
+        # the new replica's warmup replays instead of compiling
+        rep = fl.cold_start_replica()
+        assert rep.cold_start_compiles == 0
+        np.testing.assert_array_equal(fl.predict(X[:9], model="beta"),
+                                      beta.predict(X[:9]))
+        st = fl.stats()
+        assert st["errors"] == 0 and st["requests"] > 30
+    finally:
+        fl.stop()
+
+
+# ----------------------------------------------------------------------
+# router unit semantics
+def test_router_canary_weights_exact():
+    r = Router()
+    r.set_canary("m", "c", 0.0)
+    assert all(not r.route("m").is_canary for _ in range(50))
+    r.set_canary("m", "c", 1.0)
+    assert all(r.route("m").is_canary for _ in range(50))
+    r.set_canary("m", "c", 0.25)
+    hits = sum(r.route("m").is_canary for _ in range(100))
+    assert hits == 25          # deterministic round-robin, not a coin
+    with pytest.raises(ValueError):
+        r.set_canary("m", "c", 1.5)
+
+
+def test_router_promote_rebinds_primary():
+    r = Router()
+    assert r.promote("m") is None      # no canary configured
+    r.set_canary("m", "c", 0.5)
+    assert r.promote("m") == "c"
+    d = r.route("m")
+    assert d.target == "c" and not d.is_canary
+    assert r.describe()["m"]["primary"] == "c"
+    # an unknown model routes to itself
+    d = r.route("other")
+    assert d.target == "other" and d.shadow is None
+
+
+# ----------------------------------------------------------------------
+# quotas: structured shed, never a timeout
+def test_token_bucket_and_specs():
+    clock = [0.0]
+    b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: clock[0])
+    assert b.try_acquire()[0] and b.try_acquire()[0]
+    ok, retry = b.try_acquire()
+    assert not ok and retry == pytest.approx(0.5)
+    clock[0] += 0.5                       # refill one token
+    assert b.try_acquire()[0]
+    assert parse_tenant_specs("a=10,b=500:1000") \
+        == {"a": (10.0, 0.0), "b": (500.0, 1000.0)}
+
+
+def test_quota_exhaustion_structured_shed(two_models):
+    alpha, beta, X = two_models
+    clock = [0.0]
+    quotas = TenantQuotas(tenants={"t1": (1.0, 2.0)},
+                          clock=lambda: clock[0])
+    fl = _mk_fleet({"alpha": alpha}, replicas=1,
+                   config=ServingConfig(buckets=(4,), warmup=False,
+                                        flush_interval_ms=1.0),
+                   quotas=quotas)
+    try:
+        t0 = time.monotonic()
+        fl.predict(X[:1], tenant="t1")
+        fl.predict(X[:1], tenant="t1")
+        with pytest.raises(QuotaExceededError) as ei:
+            fl.predict(X[:1], tenant="t1")
+        # the shed is immediate and structured — not a timeout
+        assert time.monotonic() - t0 < 5.0
+        d = ei.value.to_dict()
+        assert d["error"] == "quota_exceeded"
+        assert ei.value.http_status == 429
+        assert d["retry_after_s"] > 0 and d["tenant"] == "t1"
+        # unnamed tenants stay unlimited (no default rate configured)
+        for _ in range(5):
+            fl.predict(X[:1])
+        # the bucket refills with time
+        clock[0] += 1.0
+        fl.predict(X[:1], tenant="t1")
+        assert fl.stats()["quota_shed"] == 1
+    finally:
+        fl.stop()
+
+
+# ----------------------------------------------------------------------
+# shadow mirroring edge cases
+def _wait_counter(fl, name, value, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if fl.stats().get(name, 0) >= value:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_shadow_mirrors_compares_never_returns(two_models):
+    alpha, beta, X = two_models
+    fl = _mk_fleet({"alpha": alpha, "beta": beta})
+    try:
+        # shadow to a DIFFERENT model: mismatch counted, response is
+        # still the primary's
+        fl.router.set_shadow("alpha", "beta")
+        out = fl.predict(X[:3], model="alpha")
+        np.testing.assert_array_equal(out, alpha.predict(X[:3]))
+        assert _wait_counter(fl, "shadow_parity_mismatch", 1)
+        # shadow to the SAME registry entry: parity ok
+        fl.router.set_shadow("beta", "beta")
+        np.testing.assert_array_equal(fl.predict(X[:3], model="beta"),
+                                      beta.predict(X[:3]))
+        assert _wait_counter(fl, "shadow_parity_ok", 1)
+        st = fl.stats()
+        assert st["shadow_mirrored"] >= 2 and st["errors"] == 0
+    finally:
+        fl.stop()
+
+
+def test_shadow_target_missing_or_mid_drain_skipped(two_models):
+    alpha, beta, X = two_models
+    fl = _mk_fleet({"alpha": alpha, "beta": beta})
+    try:
+        # missing target: counted, primary unaffected
+        fl.router.set_shadow("alpha", "nope")
+        np.testing.assert_array_equal(fl.predict(X[:2], model="alpha"),
+                                      alpha.predict(X[:2]))
+        assert fl.stats()["shadow_skipped"] == 1
+        # loaded-but-empty target (registry exists, no active version)
+        fl.fleet.ensure("empty")
+        fl.router.set_shadow("alpha", "empty")
+        fl.predict(X[:2], model="alpha")
+        assert fl.stats()["shadow_skipped"] == 2
+        # mid-drain target: the current version is being retired
+        fl.fleet.current("beta").start_draining()
+        fl.router.set_shadow("alpha", "beta")
+        np.testing.assert_array_equal(fl.predict(X[:2], model="alpha"),
+                                      alpha.predict(X[:2]))
+        assert fl.stats()["shadow_skipped"] == 3
+        assert fl.stats().get("shadow_mirrored", 0) == 0
+    finally:
+        fl.stop()
+
+
+# ----------------------------------------------------------------------
+# replica death mid-request: re-dispatch, no duplicates, no losses
+def test_replica_death_redispatches_without_duplicates(two_models,
+                                                       monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE_MIN_CELLS", "0")
+    alpha, beta, X = two_models
+    # a slow flusher keeps requests QUEUED while the replica dies
+    fl = _mk_fleet({"alpha": alpha},
+                   config=ServingConfig(buckets=(4,), warmup=False,
+                                        flush_interval_ms=400.0,
+                                        request_timeout_ms=30000))
+    try:
+        futs = [fl.submit(X[i:i + 1]) for i in range(8)]
+        victim = futs[0]._replica.rid
+        fl.kill_replica(victim)
+        outs = [f.result(timeout=30) for f in futs]
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(out,
+                                          alpha.predict(X[i:i + 1]))
+        st = fl.stats()
+        assert st["redispatches"] >= 1
+        assert st["replica_deaths"] == 1
+        assert st["errors"] == 0
+        # exactly one response per request: every future resolved once
+        # and the pool's engines served exactly the re-dispatched total
+        assert st["requests"] == 8
+        assert st["engine_totals"]["requests"] \
+            == 8 + st["redispatches"]
+        moved = [f for f in futs if f.meta["redispatches"] > 0]
+        assert moved and all(f.meta["replica"] != victim
+                             for f in moved)
+        # the dead replica never takes new work
+        f = fl.submit(X[:1])
+        assert f._replica.rid != victim
+        f.result(timeout=10)
+    finally:
+        fl.stop()
+
+
+def test_fleet_admission_and_replica_exhaustion(two_models):
+    alpha, beta, X = two_models
+    fl = _mk_fleet({"alpha": alpha}, replicas=1,
+                   config=ServingConfig(buckets=(4,), warmup=False,
+                                        flush_interval_ms=300.0),
+                   max_pending=2)
+    try:
+        f1 = fl.submit(X[:1])
+        f2 = fl.submit(X[:1])
+        with pytest.raises(QueueFullError) as ei:
+            fl.submit(X[:1])
+        assert ei.value.to_dict()["error"] == "queue_full"
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+        with pytest.raises(ModelNotFoundError) as ei:
+            fl.submit(X[:1], model="ghost")
+        assert ei.value.http_status == 404
+        fl.kill_replica(fl.replicas[0].rid)
+        with pytest.raises(ReplicaUnavailableError) as ei:
+            fl.submit(X[:1])
+        assert ei.value.http_status == 503
+    finally:
+        fl.stop()
+
+
+def test_drain_replica_serves_queued_then_retires(two_models):
+    alpha, beta, X = two_models
+    fl = _mk_fleet({"alpha": alpha},
+                   config=ServingConfig(buckets=(4,), warmup=False,
+                                        flush_interval_ms=100.0,
+                                        request_timeout_ms=30000))
+    try:
+        futs = [fl.submit(X[i:i + 1]) for i in range(4)]
+        victim = futs[0]._replica.rid
+        fl.drain_replica(victim)          # graceful: serves the queue
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(timeout=30),
+                                          alpha.predict(X[i:i + 1]))
+        st = fl.stats()
+        assert st["errors"] == 0 and st.get("redispatches", 0) == 0
+        assert st["replica_drains"] == 1
+    finally:
+        fl.stop()
+
+
+# ----------------------------------------------------------------------
+# HTTP fleet surface
+def test_http_fleet_endpoints(two_models, tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from lightgbm_tpu.serving.http import make_http_server
+    alpha, beta, X = two_models
+    clock = [0.0]
+    fl = _mk_fleet({"alpha": alpha, "beta": beta},
+                   quotas=TenantQuotas(tenants={"slow": (0.001, 1.0)},
+                                       clock=lambda: clock[0]))
+    server = make_http_server(fl, "127.0.0.1", 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def post(path, payload, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        status, body = post("/predict", {"rows": X[:2].tolist(),
+                                         "model": "beta",
+                                         "tenant": "acme"})
+        assert status == 200
+        np.testing.assert_allclose(body["predictions"],
+                                   beta.predict(X[:2]))
+        assert body["model"] == "beta" and body["tenant"] == "acme"
+        assert body["replica"] in (0, 1)
+
+        # X-Tenant header drives the quota identity
+        post("/predict", {"rows": X[:1].tolist()},
+             headers={"X-Tenant": "slow"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/predict", {"rows": X[:1].tolist()},
+                 headers={"X-Tenant": "slow"})
+        assert ei.value.code == 429
+        assert json.loads(ei.value.read())["error"] == "quota_exceeded"
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/predict", {"rows": X[:1].tolist(), "model": "ghost"})
+        assert ei.value.code == 404
+
+        # named reload over HTTP
+        txt = tmp_path / "m.txt"
+        alpha.save_model(str(txt))
+        status, body = post("/reload", {"model_file": str(txt),
+                                        "model": "beta"})
+        assert status == 200 and body["version"] == 2
+
+        # canary config + promotion over HTTP
+        status, body = post("/route", {"model": "alpha",
+                                       "canary": "beta", "weight": 1.0})
+        assert status == 200
+        assert body["router"]["alpha"]["weight"] == 1.0
+        status, body = post("/route", {"model": "alpha",
+                                       "promote": True})
+        assert body["promoted"] == "beta"
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["fleet"] and health["status"] == "ok"
+        assert len(health["replicas"]) == 2
+        assert set(health["models"]) == {"alpha", "beta"}
+
+        # per-(model, tenant) labels on the Prometheus exposition
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "lgbm_fleet_request_latency_ms_bucket" in text
+        assert 'model="beta"' in text and 'tenant="acme"' in text
+        assert "lgbm_fleet_replicas_ok" in text
+    finally:
+        server.shutdown()
+        server.server_close()
+        fl.stop()
+
+
+# ----------------------------------------------------------------------
+# config -> fleet construction
+def test_fleet_from_config(two_models, tmp_path):
+    from lightgbm_tpu.config import Config
+    alpha, beta, X = two_models
+    pa, pb = tmp_path / "a.txt", tmp_path / "b.txt"
+    alpha.save_model(str(pa))
+    beta.save_model(str(pb))
+    cfg = Config.from_params({
+        "serving_replicas": 2,
+        "serving_models": f"prod={pa},cand={pb}",
+        "serving_canary_model": "cand", "serving_canary_weight": 0.5,
+        "serving_shadow_model": "cand",
+        "serving_quota_qps": 0, "serving_quota_tenants": "a=10:20",
+        "serving_buckets": "4,16", "verbosity": -1})
+    assert cfg.serving_replicas == 2
+    assert cfg.serving_models == [f"prod={pa}", f"cand={pb}"]
+    fl = FleetEngine.from_config(cfg)
+    try:
+        assert set(fl.fleet.names()) == {"prod", "cand"}
+        assert fl.default_model == "cand"    # first sorted name
+        assert len(fl.replicas) == 2
+        assert fl.quotas.describe()["tenants"]["a"]["rate"] == 10.0
+        rule = fl.router.describe()["cand"]
+        assert rule["canary"] == "cand" and rule["weight"] == 0.5
+        # text-loaded models serve host-route through the pool
+        ref = lgb.Booster(model_file=str(pa)).predict(X[:3])
+        np.testing.assert_array_equal(fl.predict(X[:3], model="prod"),
+                                      ref)
+    finally:
+        fl.stop()
+
+
+def test_config_fleet_param_validation():
+    from lightgbm_tpu.config import Config
+    with pytest.raises(ValueError):
+        Config.from_params({"serving_replicas": 0})
+    with pytest.raises(ValueError):
+        Config.from_params({"serving_canary_weight": 1.5})
+    with pytest.raises(ValueError):
+        Config.from_params({"serving_quota_qps": -1})
+
+
+# ----------------------------------------------------------------------
+# soak harness + serve_bench CLI
+def test_soak_loop_chaos_availability(two_models, tmp_path):
+    from lightgbm_tpu.robustness.faults import get_fault_plan
+    from lightgbm_tpu.serving.loadgen import soak_loop
+    alpha, beta, X = two_models
+    pa = tmp_path / "alpha.txt"
+    alpha.save_model(str(pa))
+    fl = _mk_fleet({"alpha": alpha, "beta": beta},
+                   config=ServingConfig(buckets=(4,), warmup=False,
+                                        flush_interval_ms=1.0))
+    try:
+        block = soak_loop(
+            fl, X, duration_s=1.5, qps=120, batch_sizes=(1, 3),
+            models=["alpha", "beta"], tenants=["default", "t2"],
+            timeout_ms=20000,
+            reload_every_s=0.4, reload_sources={"alpha": str(pa)},
+            replica_storm_every_s=0.6,
+            fault_spec=f"fail_read@times=2,match={pa.name}")
+        assert block["mode"] == "soak"
+        assert block["requests"] > 20
+        assert block["non_shed_errors"] == 0
+        assert block["availability"] == 1.0
+        assert block["reloads"] >= 1
+        assert block["replica_kills"] >= 1
+        assert block["cold_starts"] >= 1
+        # the injected read faults fired and were absorbed (retry /
+        # degraded reload) — availability did not move
+        assert block["faults_injected"] >= 1
+        assert get_fault_plan() is None      # plan cleaned up
+        for key in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+                    "shed_rate", "redispatches", "replicas", "models"):
+            assert key in block
+    finally:
+        fl.stop()
+
+
+def test_serve_bench_soak_cli_and_trend_chain(tmp_path):
+    """tools/serve_bench.py --mode soak end-to-end: block written,
+    availability gate honored, bench JSON merged, and the fleet p99
+    chains into tools/bench_trend.py's gated series."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(REPO, "tools", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    out = tmp_path / "soak.json"
+    bench = tmp_path / "BENCH.json"
+    bench.write_text(json.dumps({"metric": "higgs_like", "value": 1}))
+    rc = sb.main(["--mode", "soak", "--replicas", "2",
+                  "--duration", "1.0", "--qps", "60", "--rows", "400",
+                  "--buckets", "1,8", "--device", "never",
+                  "--workdir", str(tmp_path),
+                  "--assert-availability", "1.0",
+                  "--json", str(out), "--append-bench", str(bench)])
+    assert rc == 0
+    result = json.loads(out.read_text())
+    blk = result["fleet"]
+    assert blk["availability"] == 1.0 and blk["p99_ms"] is not None
+    assert blk["replicas"] == 2
+    assert set(blk["models"]) == {"base", "variant"}
+    merged = json.loads(bench.read_text())
+    assert merged["fleet"]["p99_ms"] == blk["p99_ms"]
+    assert merged["metric"] == "higgs_like"

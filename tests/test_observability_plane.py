@@ -510,6 +510,40 @@ def test_bench_trend_dispatch_census_series(tmp_path):
     assert bt.main([a, b, "--quiet"]) == 0
 
 
+def test_bench_trend_fleet_p99_synthetic_regression(tmp_path):
+    """The fleet soak p99 chains per (backend, replicas, models,
+    buckets, batch_sizes, qps): a >20% worsening fails the gate, a
+    shape change breaks the chain deliberately."""
+    bt = _load_tool("bench_trend")
+    fleet = {"p99_ms": 10.0, "p50_ms": 2.0, "throughput_rps": 100.0,
+             "shed_rate": 0.0, "availability": 1.0,
+             "replicas": 2, "models": ["base", "variant"],
+             "buckets": [1, 64], "batch_sizes": [1, 64],
+             "offered_qps": 150, "backend": "cpu", "mode": "soak"}
+    line = dict(_HEAD, fleet=fleet)
+    a, b = str(tmp_path / "BENCH_r06.json"), \
+        str(tmp_path / "BENCH_r07.json")
+    _mk_round(a, 6, [_FIXED, line])
+    worse = dict(line, fleet=dict(fleet, p99_ms=13.0))    # +30%
+    _mk_round(b, 7, [_FIXED, worse])
+    rep = str(tmp_path / "rep.json")
+    assert bt.main([a, b, "--quiet", "--report", rep]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    [r] = [r for r in report["regressions"]
+           if r["series"] == "fleet_p99_ms"]
+    assert r["change_pct"] == 30.0
+    assert report["gated_points"]["fleet_p99_ms"] == 2
+    # within threshold passes
+    _mk_round(b, 7, [_FIXED, dict(line,
+                                  fleet=dict(fleet, p99_ms=11.0))])
+    assert bt.main([a, b, "--quiet"]) == 0
+    # a replica-count change breaks the comparison chain (no gate)
+    _mk_round(b, 7, [_FIXED, dict(line, fleet=dict(
+        fleet, p99_ms=50.0, replicas=4))])
+    assert bt.main([a, b, "--quiet"]) == 0
+
+
 def test_bench_trend_serving_p99_and_config_bump(tmp_path):
     bt = _load_tool("bench_trend")
     a, b = str(tmp_path / "BENCH_r06.json"), \

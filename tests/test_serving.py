@@ -501,6 +501,63 @@ def test_capi_single_row_fast(binary_model):
         capi_impl.free_handle(h)
 
 
+def test_capi_fast_engine_keyed_per_booster_handle(binary_model,
+                                                   regression_model):
+    """Two live boosters, each with fast-configs: the cached
+    queue-bypassing engine is keyed PER BOOSTER HANDLE — interleaved
+    single-row fast predicts never cross-wire models, two fast-configs
+    on one handle share one engine, and freeing the booster handle
+    drops its cached engine."""
+    from lightgbm_tpu import capi_impl
+    bst_a, Xa = binary_model
+    bst_b, Xb = regression_model
+    ha = capi_impl._register(bst_a)
+    hb = capi_impl._register(bst_b)
+    try:
+        fa1 = capi_impl.booster_predict_for_mat_single_row_fast_init(
+            ha, capi_impl.PREDICT_NORMAL, -1, capi_impl.DTYPE_FLOAT64,
+            Xa.shape[1], "")
+        fa2 = capi_impl.booster_predict_for_mat_single_row_fast_init(
+            ha, capi_impl.PREDICT_RAW_SCORE, -1,
+            capi_impl.DTYPE_FLOAT64, Xa.shape[1], "")
+        fb = capi_impl.booster_predict_for_mat_single_row_fast_init(
+            hb, capi_impl.PREDICT_NORMAL, -1, capi_impl.DTYPE_FLOAT64,
+            Xb.shape[1], "")
+        # one engine per handle, shared across that handle's configs
+        assert capi_impl._get(fa1).engine \
+            is capi_impl._get(fa2).engine
+        assert capi_impl._get(fa1).engine \
+            is not capi_impl._get(fb).engine
+        assert ha in capi_impl._FAST_ENGINES
+        assert hb in capi_impl._FAST_ENGINES
+        # interleaved rows: each handle answers with ITS model
+        out = np.zeros(1)
+        for i in range(4):
+            row_a = np.ascontiguousarray(Xa[i])
+            capi_impl.booster_predict_for_mat_single_row_fast(
+                fa1, row_a.ctypes.data, out.ctypes.data)
+            np.testing.assert_array_equal(
+                out[0], bst_a.predict(Xa[i:i + 1])[0])
+            row_b = np.ascontiguousarray(Xb[i])
+            capi_impl.booster_predict_for_mat_single_row_fast(
+                fb, row_b.ctypes.data, out.ctypes.data)
+            np.testing.assert_array_equal(
+                out[0], bst_b.predict(Xb[i:i + 1])[0])
+            capi_impl.booster_predict_for_mat_single_row_fast(
+                fa2, row_a.ctypes.data, out.ctypes.data)
+            np.testing.assert_array_equal(
+                out[0], bst_a.predict(Xa[i:i + 1], raw_score=True)[0])
+        capi_impl.fast_config_free(fa1)
+        capi_impl.fast_config_free(fa2)
+        capi_impl.fast_config_free(fb)
+    finally:
+        capi_impl.free_handle(ha)
+        capi_impl.free_handle(hb)
+    # freeing the booster handles dropped their cached engines
+    assert ha not in capi_impl._FAST_ENGINES
+    assert hb not in capi_impl._FAST_ENGINES
+
+
 # ----------------------------------------------------------------------
 # HTTP frontend
 def test_http_server_endpoints(binary_model, tmp_path):

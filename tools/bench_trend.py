@@ -15,6 +15,10 @@ exits nonzero on a regression:
 * serving ``p99_ms`` — from any result line's ``serving`` block, keyed
   by (backend, buckets, batch_sizes) so only like-for-like serving
   measurements chain. Higher is worse.
+* fleet ``p99_ms`` — from any result line's ``fleet`` block (the
+  replica-pool soak, serving/fleet.py), keyed by (backend, replicas,
+  models, buckets, batch_sizes, qps) so only like-for-like fleet
+  soaks chain. Higher is worse.
 
 The legacy headline (``higgs_like_train_throughput``) is REPORTED but
 never gated: the r01-r05 history mixes row counts, iteration counts
@@ -124,6 +128,29 @@ def _serving_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     return found
 
 
+def _fleet_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's fleet-soak p99, keyed by the soak shape."""
+    found = None
+    for ln in lines:
+        fv = ln.get("fleet")
+        if not isinstance(fv, dict) or fv.get("p99_ms") is None:
+            continue
+        key = json.dumps({
+            "backend": fv.get("backend", ln.get("backend")),
+            "replicas": fv.get("replicas"),
+            "models": fv.get("models"),
+            "buckets": fv.get("buckets"),
+            "batch_sizes": fv.get("batch_sizes"),
+            "qps": fv.get("offered_qps"),
+        }, sort_keys=True)
+        found = {"value": float(fv["p99_ms"]), "key": key,
+                 "p50": fv.get("p50_ms"),
+                 "throughput_rps": fv.get("throughput_rps"),
+                 "shed_rate": fv.get("shed_rate"),
+                 "availability": fv.get("availability")}
+    return found
+
+
 def _dispatch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     """The round's census-derived dispatches/split (bench.py
     run_dispatch_census): the serial grow program's compiled while-body
@@ -180,7 +207,7 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
 
 def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
-    fixed, serving, headline, dispatch = [], [], [], []
+    fixed, serving, headline, dispatch, fleet = [], [], [], [], []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -194,11 +221,15 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _dispatch_point(rnd["lines"])
         if p is not None:
             dispatch.append((rnd["label"], p))
+        p = _fleet_point(rnd["lines"])
+        if p is not None:
+            fleet.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
     regressions += _gate(serving, False, threshold, "serving_p99_ms")
     regressions += _gate(dispatch, False, threshold, DISPATCH_METRIC)
+    regressions += _gate(fleet, False, threshold, "fleet_p99_ms")
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -207,6 +238,8 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in fixed],
             "serving_p99_ms": [
                 {"round": lb, **pt} for lb, pt in serving],
+            "fleet_p99_ms": [
+                {"round": lb, **pt} for lb, pt in fleet],
             DISPATCH_METRIC: [
                 {"round": lb, **pt} for lb, pt in dispatch],
             # informational only — config drifts across rounds
@@ -215,6 +248,7 @@ def analyze(rounds: List[Dict[str, Any]],
         },
         "gated_points": {FIXED_METRIC: len(fixed),
                          "serving_p99_ms": len(serving),
+                         "fleet_p99_ms": len(fleet),
                          DISPATCH_METRIC: len(dispatch)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",

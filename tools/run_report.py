@@ -97,6 +97,10 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     serving = {k: v for k, v in serving.items()
                if k not in ("kind", "t")}
 
+    fleet = _last(records, "fleet_stats") or {}
+    fleet = {k: v for k, v in fleet.items()
+             if k not in ("kind", "t")}
+
     # histogram snapshots (kind=hist, emitted by the live metrics
     # plane on engine stop): keep the LAST snapshot per (name, labels)
     hists: Dict[str, Dict[str, Any]] = {}
@@ -122,6 +126,7 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
         "serving": serving,
+        "fleet": fleet,
         "hists": hists,
         "tpu_probe": None if probe_rec is None else {
             k: probe_rec.get(k) for k in
@@ -293,6 +298,25 @@ def render(records: List[Dict[str, Any]]) -> str:
             L.append(f"model: v{model.get('version')} "
                      f"{model.get('num_trees')} trees "
                      f"device_ready={model.get('device_ready')}")
+
+    if d.get("fleet"):
+        f = d["fleet"]
+        L.append("")
+        L.append("== fleet (lightgbm_tpu/serving/fleet.py) ==")
+        L.append(f"requests={f.get('requests', 0)} "
+                 f"shed={f.get('shed', 0)} "
+                 f"quota_shed={f.get('quota_shed', 0)} "
+                 f"errors={f.get('errors', 0)} "
+                 f"redispatches={f.get('redispatches', 0)}")
+        L.append(f"pool: starts={f.get('replica_starts', 0)} "
+                 f"deaths={f.get('replica_deaths', 0)} "
+                 f"drains={f.get('replica_drains', 0)} "
+                 f"reloads={f.get('reloads', 0)} "
+                 f"promotions={f.get('promotions', 0)}")
+        L.append(f"shadow: mirrored={f.get('shadow_mirrored', 0)} "
+                 f"parity_ok={f.get('shadow_parity_ok', 0)} "
+                 f"mismatch={f.get('shadow_parity_mismatch', 0)} "
+                 f"skipped={f.get('shadow_skipped', 0)}")
 
     if d.get("hists"):
         L.append("")

@@ -1,42 +1,125 @@
-"""Serving load benchmark: closed- and open-loop latency/throughput.
+"""Serving load benchmark: closed/open-loop latency + fleet soak.
 
-Drives an in-process ServingEngine (lightgbm_tpu/serving/) with the
-shared load generators (serving/loadgen.py) and prints one JSON object
-with a ``serving`` block: latency percentiles (p50/p95/p99),
-throughput, bucket hit rate, shed/timeout/fallback counts.
+Drives an in-process ServingEngine — or, in ``--fleet`` mode, a
+FleetEngine replica pool (lightgbm_tpu/serving/fleet.py) — with the
+shared load generators (serving/loadgen.py) and prints one JSON
+object. Closed/open loops report the ``serving`` block; the fleet
+soak reports a ``fleet`` block (p99, throughput, shed rate,
+availability) that ``tools/bench_trend.py`` chains round-over-round.
 
 Usage:
     python tools/serve_bench.py [--model model.txt]
-        [--mode closed|open|both] [--threads 4] [--duration 3]
+        [--mode closed|open|both|soak] [--threads 4] [--duration 3]
         [--qps 300] [--batches 1,8,64] [--buckets 1,8,64,512]
         [--device auto|always|never]
         [--json out.json] [--append-bench BENCH.json]
+    # fleet soak (CI serve-soak job):
+    python tools/serve_bench.py --mode soak --fleet --replicas 3 \
+        --duration 90 --qps 150 --reload-every 5 \
+        --replica-storm-every 20 --canary-weight 0.2 --shadow \
+        --faults 'fail_read@times=3,match=serve_bench_model' \
+        --quota-tenants 'burst_tenant=20' \
+        --assert-availability 1.0 --json soak.json
 
 Without ``--model`` a small binary booster is trained in-process (the
-CI smoke path). ``--append-bench`` merges the block into an existing
-bench JSON artifact under the ``serving`` key, which
-``tools/run_report.py`` knows how to render.
+CI smoke path); ``--fleet`` without ``--model`` trains TWO variants
+and serves them as named models ``base`` / ``variant`` with optional
+canary/shadow routing between them. ``--append-bench`` merges the
+headline block into an existing bench JSON artifact under the
+``serving`` (and ``fleet``) keys, which ``tools/run_report.py`` and
+``tools/bench_trend.py`` know how to read. A SIGTERM received
+mid-soak triggers the crash flight recorder
+(observability/flightrec.py) and a graceful fleet drain — the block
+still prints, flagged ``"preempted": true``.
 """
 
 import argparse
 import json
 import os
+import signal
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _train_default_model(n=4000, f=10, seed=7):
+def _train_default_model(n=4000, f=10, seed=7, leaves=31, rounds=20):
     import numpy as np
 
     import lightgbm_tpu as lgb
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f)
     y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] > 0).astype(np.float64)
-    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+    bst = lgb.train({"objective": "binary", "num_leaves": leaves,
                      "verbosity": -1}, lgb.Dataset(X, label=y),
-                    num_boost_round=20)
+                    num_boost_round=rounds)
     return bst, X
+
+
+def _build_fleet(args, workdir):
+    """FleetEngine + row pool + reload sources for the soak."""
+    import numpy as np
+
+    from lightgbm_tpu.serving import (FleetEngine, Router,
+                                      ServingConfig, TenantQuotas)
+    from lightgbm_tpu.serving.tenants import parse_tenant_specs
+    models = {}
+    if args.model:
+        models["base"] = args.model
+        from lightgbm_tpu.basic import Booster
+        nfeat = Booster(model_file=args.model).num_feature()
+        X = np.random.RandomState(0).randn(args.rows, nfeat)
+    else:
+        base, X = _train_default_model(n=args.rows)
+        variant, _ = _train_default_model(n=args.rows, seed=11,
+                                          leaves=15, rounds=12)
+        models["base"] = base
+        models["variant"] = variant
+    router = Router()
+    if args.canary_weight > 0 and "variant" in models:
+        router.set_canary("base", "variant", args.canary_weight)
+    if args.shadow and "variant" in models:
+        router.set_shadow("base", "variant")
+    quotas = TenantQuotas(
+        default_rate=args.quota_qps,
+        tenants=parse_tenant_specs(args.quota_tenants))
+    cfg = ServingConfig(buckets=args.buckets, device=args.device)
+    fleet = FleetEngine(models=models, config=cfg,
+                        replicas=args.replicas, router=router,
+                        quotas=quotas, default_model="base")
+    # reload storms re-read the models from disk, through the
+    # registry's guarded (fault-injectable) file reads
+    reload_sources = {}
+    if args.reload_every > 0:
+        for name in fleet.fleet.names():
+            path = os.path.join(workdir, f"serve_bench_model_{name}.txt")
+            src = models[name]
+            if isinstance(src, str):
+                path = src
+            else:
+                src.save_model(path)
+            reload_sources[name] = path
+    return fleet, X, reload_sources
+
+
+def _arm_sigterm(fleet, state):
+    """SIGTERM mid-soak: flight-recorder dump + graceful drain; the
+    soak block still prints (flagged preempted). The recorder arms
+    only when a dump path is configured (LGBM_TPU_CRASH_DUMP /
+    crash_dump / a telemetry trace to derive from)."""
+    from lightgbm_tpu.observability.flightrec import (arm_recorder,
+                                                      notify_signal)
+    arm_recorder()
+
+    def handler(signum, frame):
+        state["preempted"] = True
+        try:
+            notify_signal(signum)
+        except Exception:  # noqa: BLE001 - the drill must not crash us
+            pass
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:      # non-main thread (embedded use)
+        pass
 
 
 def main(argv=None) -> int:
@@ -44,7 +127,7 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default="",
                     help="model text/npz file (default: train in-proc)")
     ap.add_argument("--mode", default="both",
-                    choices=["closed", "open", "both"])
+                    choices=["closed", "open", "both", "soak"])
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--duration", type=float, default=3.0)
     ap.add_argument("--qps", type=float, default=300.0)
@@ -57,55 +140,111 @@ def main(argv=None) -> int:
     ap.add_argument("--json", default="", help="write result JSON here")
     ap.add_argument("--append-bench", default="",
                     help="merge the serving block into this bench JSON")
+    # fleet / soak knobs
+    ap.add_argument("--fleet", action="store_true",
+                    help="serve through a FleetEngine replica pool")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--reload-every", type=float, default=0.0,
+                    help="seconds between reload-storm cycles (soak)")
+    ap.add_argument("--replica-storm-every", type=float, default=0.0,
+                    help="seconds between replica kill/cold-start "
+                         "cycles (soak)")
+    ap.add_argument("--canary-weight", type=float, default=0.0)
+    ap.add_argument("--shadow", action="store_true",
+                    help="mirror default-model traffic to the variant")
+    ap.add_argument("--quota-qps", type=float, default=0.0)
+    ap.add_argument("--quota-tenants", default="",
+                    help="tenant=rate[:burst],... quota specs")
+    ap.add_argument("--tenants", default="",
+                    help="comma list of tenant ids to rotate through")
+    ap.add_argument("--faults", default="",
+                    help="robustness/faults.py spec armed for the soak")
+    ap.add_argument("--timeout-ms", type=float, default=5000.0,
+                    help="per-request deadline in soak mode (generous "
+                         "by default: chaos cycles must shed or "
+                         "re-dispatch, not time out)")
+    ap.add_argument("--workdir", default=".",
+                    help="scratch dir for reload-storm model files")
+    ap.add_argument("--assert-availability", type=float, default=-1.0,
+                    help="exit 1 when soak availability drops below "
+                         "this (e.g. 1.0 = zero non-shed errors)")
     args = ap.parse_args(argv)
 
     import numpy as np
 
     import jax
     from lightgbm_tpu.serving import ServingConfig, ServingEngine
-    from lightgbm_tpu.serving.loadgen import closed_loop, open_loop
+    from lightgbm_tpu.serving.loadgen import (closed_loop, open_loop,
+                                              soak_loop)
 
     batch_sizes = [int(v) for v in args.batches.split(",") if v]
-    if args.model:
-        source = args.model
-        # loaded models have no mappers: synth a feature pool from the
-        # model's own feature count
-        from lightgbm_tpu.basic import Booster
-        bst = Booster(model_file=args.model) \
-            if not args.model.endswith(".npz") else None
-        if bst is not None:
-            nfeat = bst.num_feature()
-            source = bst
-        else:
-            from lightgbm_tpu.serving.registry import _load_npz
-            lb = _load_npz(args.model)
-            nfeat = lb.max_feature_idx + 1
-            source = lb
-        X = np.random.RandomState(0).randn(args.rows, nfeat)
-    else:
-        source, X = _train_default_model(n=args.rows)
-
-    cfg = ServingConfig(buckets=args.buckets, device=args.device)
-    engine = ServingEngine(source, config=cfg)
+    fleet_mode = args.fleet or args.mode == "soak"
     result = {"metric": "serving_latency",
               "backend": jax.default_backend(),
-              "buckets": list(cfg.buckets),
               "device": args.device,
               "batch_sizes": batch_sizes}
-    if args.mode in ("closed", "both"):
-        result["closed"] = closed_loop(
-            engine, X, batch_sizes=batch_sizes, threads=args.threads,
-            duration_s=args.duration)
-    if args.mode in ("open", "both"):
-        result["open"] = open_loop(
-            engine, X, qps=args.qps, duration_s=args.duration,
-            batch_sizes=batch_sizes)
-    result["stats"] = engine.stats()
-    engine.stop()
 
-    # the headline block: closed loop if measured, else open
-    head = result.get("closed") or result.get("open") or {}
-    result["serving"] = head
+    if fleet_mode:
+        os.makedirs(args.workdir, exist_ok=True)
+        engine, X, reload_sources = _build_fleet(args, args.workdir)
+        result["metric"] = "fleet_serving"
+        state = {"preempted": False}
+        _arm_sigterm(engine, state)
+        tenants = [t for t in args.tenants.split(",") if t] or None
+        models = engine.fleet.names()
+        block = soak_loop(
+            engine, X, duration_s=args.duration, qps=args.qps,
+            batch_sizes=batch_sizes, models=models, tenants=tenants,
+            timeout_ms=args.timeout_ms,
+            reload_every_s=args.reload_every,
+            reload_sources=reload_sources,
+            replica_storm_every_s=args.replica_storm_every,
+            fault_spec=args.faults)
+        block["preempted"] = state["preempted"]
+        block["backend"] = result["backend"]
+        result["fleet"] = block
+        result["stats"] = {
+            k: v for k, v in engine.stats().items()
+            if isinstance(v, (int, float, str))}
+        result["health"] = engine.health()
+        head = block
+        engine.stop()
+    else:
+        if args.model:
+            source = args.model
+            # loaded models have no mappers: synth a feature pool from
+            # the model's own feature count
+            from lightgbm_tpu.basic import Booster
+            bst = Booster(model_file=args.model) \
+                if not args.model.endswith(".npz") else None
+            if bst is not None:
+                nfeat = bst.num_feature()
+                source = bst
+            else:
+                from lightgbm_tpu.serving.registry import _load_npz
+                lb = _load_npz(args.model)
+                nfeat = lb.max_feature_idx + 1
+                source = lb
+            X = np.random.RandomState(0).randn(args.rows, nfeat)
+        else:
+            source, X = _train_default_model(n=args.rows)
+
+        cfg = ServingConfig(buckets=args.buckets, device=args.device)
+        engine = ServingEngine(source, config=cfg)
+        result["buckets"] = list(cfg.buckets)
+        if args.mode in ("closed", "both"):
+            result["closed"] = closed_loop(
+                engine, X, batch_sizes=batch_sizes,
+                threads=args.threads, duration_s=args.duration)
+        if args.mode in ("open", "both"):
+            result["open"] = open_loop(
+                engine, X, qps=args.qps, duration_s=args.duration,
+                batch_sizes=batch_sizes)
+        result["stats"] = engine.stats()
+        engine.stop()
+        # the headline block: closed loop if measured, else open
+        head = result.get("closed") or result.get("open") or {}
+        result["serving"] = head
 
     print(json.dumps(result))
     if args.json:
@@ -118,9 +257,21 @@ def main(argv=None) -> int:
             bench = json.loads(lines[-1]) if lines else {}
         except (OSError, json.JSONDecodeError):
             bench = {}
-        bench["serving"] = head
+        if fleet_mode:
+            bench["fleet"] = head
+        else:
+            bench["serving"] = head
         with open(args.append_bench, "w") as f:
             f.write(json.dumps(bench) + "\n")
+    if fleet_mode and args.assert_availability >= 0:
+        avail = head.get("availability")
+        if avail is None or avail < args.assert_availability:
+            sys.stderr.write(
+                f"serve_bench: availability {avail} below the "
+                f"--assert-availability {args.assert_availability} "
+                f"gate ({head.get('non_shed_errors')} non-shed "
+                "errors)\n")
+            return 1
     return 0
 
 
