@@ -172,6 +172,21 @@ def measure():
     # documented lower-bound byte model; CPU backends report "n/a"
     from lightgbm_tpu.utils.roofline import bench_roofline
     result["roofline"] = bench_roofline(throughput, f)
+    # per-phase wall-time decomposition for the trend gate's
+    # REGRESSION ATTRIBUTION (tools/bench_trend.py): phase span totals
+    # when the host-stepped spans ran, else the one-shot component
+    # probe's grad/hist/split/partition/update breakdown. Shares (not
+    # absolute seconds) are what the gate compares across rounds.
+    phases = tel.phase_totals()
+    if not phases:
+        for rec in reversed(tel.records):
+            if rec.get("kind") == "phase_probe" and rec.get("phases"):
+                phases = {k: float(v)
+                          for k, v in rec["phases"].items()}
+                break
+    if phases:
+        result["phases"] = {k: round(v, 6)
+                            for k, v in sorted(phases.items())}
     if os.environ.get("BENCH_EVAL", "1") != "0":
         # training-quality gate, DEFAULT-ON (Experiments.rst:120-148
         # accuracy table analog): in-sample AUC on a bounded slice so a
@@ -337,25 +352,41 @@ def write_probe_cache(ok: bool, detail: str) -> None:
         pass
 
 
+def _classify_probe(detail: str) -> str:
+    """Structured probe-failure reason code (tools/probe_taxonomy.py:
+    no_device / init_timeout / compile_error / transport / unknown);
+    falls back to 'unknown' when the taxonomy module is unreachable
+    (the classification must never break the stdlib-only parent)."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.probe_taxonomy import classify_probe_failure
+        return classify_probe_failure(detail)
+    except Exception:  # noqa: BLE001 - taxonomy is best-effort
+        return "unknown"
+
+
 def emit_probe_telemetry(ok: bool, detail: str, dur_s: float,
                          cached: bool, age_s=None) -> None:
     """Record the TPU-probe verdict in the telemetry JSONL trace
-    (kind=probe + a probe.fail counter record on failure). Written
-    with stdlib file appends on purpose: the bench PARENT must never
-    import jax/lightgbm_tpu — a wedged tunnel would hang the
-    orchestrator itself (the exact failure mode the probe exists to
-    contain)."""
+    (kind=probe + a probe.fail counter record on failure), with the
+    failure classified into a structured ``reason_code`` (the raw
+    cause stays attached as ``reason``). Written with stdlib file
+    appends on purpose: the bench PARENT must never import
+    jax/lightgbm_tpu — a wedged tunnel would hang the orchestrator
+    itself (the exact failure mode the probe exists to contain)."""
     path = os.environ.get("LGBM_TPU_TELEMETRY", "").strip()
     if not path:
         return
+    code = None if ok else _classify_probe(detail)
     recs = [{"kind": "probe", "t": 0.0, "verdict":
              "ok" if ok else "failed", "reason": detail[:300],
+             "reason_code": code,
              "dur_s": round(float(dur_s), 3), "cached": bool(cached),
              "cache_age_s": None if age_s is None
              else round(float(age_s), 1), "wall_time": time.time()}]
     if not ok:
         recs.append({"kind": "counter", "t": 0.0, "name": "probe.fail",
-                     "value": 1})
+                     "value": 1, "reason_code": code})
     try:
         os.makedirs(os.path.dirname(os.path.abspath(path)),
                     exist_ok=True)
@@ -371,10 +402,14 @@ def probe_info_from_cache(cached) -> dict:
     cache hit, the stored reason and the verdict's age — so a line
     produced under a stale-ish verdict is diagnosable as such."""
     age = time.time() - float(cached.get("ts", 0))
-    return {"tpu_probe": "ok" if cached.get("ok") else "failed",
-            "tpu_probe_cached": True,
-            "tpu_probe_detail": str(cached.get("detail", ""))[:160],
-            "tpu_probe_age_s": round(age, 1)}
+    out = {"tpu_probe": "ok" if cached.get("ok") else "failed",
+           "tpu_probe_cached": True,
+           "tpu_probe_detail": str(cached.get("detail", ""))[:160],
+           "tpu_probe_age_s": round(age, 1)}
+    if not cached.get("ok"):
+        out["tpu_probe_reason_code"] = _classify_probe(
+            str(cached.get("detail", "")))
+    return out
 
 
 def find_result_line(stdout: str):
@@ -682,6 +717,9 @@ def main():
         probe_info = {"tpu_probe": "ok" if tpu_ok else "failed",
                       "tpu_probe_cached": False,
                       "tpu_probe_detail": detail.strip()[-160:]}
+        if not tpu_ok:
+            probe_info["tpu_probe_reason_code"] = \
+                _classify_probe(detail)
         emit_probe_telemetry(tpu_ok, detail, probe_dur, cached=False)
     if not tpu_ok:
         sys.stderr.write("TPU probe negative; skipping TPU plan\n")

@@ -127,6 +127,9 @@ _PARAM_ALIASES: Dict[str, str] = {
     "workers": "machines", "nodes": "machines",
     "telemetry": "telemetry_out", "telemetry_file": "telemetry_out",
     "telemetry_output": "telemetry_out",
+    "trace": "trace_out", "trace_file": "trace_out",
+    "trace_output": "trace_out", "chrome_trace": "trace_out",
+    "profiler_dir": "profile_dir", "jax_profile_dir": "profile_dir",
     "prometheus_port": "metrics_port",
     "metrics_http_port": "metrics_port",
     "crash_dump_path": "crash_dump",
@@ -320,6 +323,15 @@ class Config:
     # crash flight recorder dump path override; empty = derive
     # <telemetry_out>.crash.json (or LGBM_TPU_CRASH_DUMP env)
     crash_dump: str = ""
+    # end-to-end trace correlation (docs/Observability.md "Tracing"):
+    # path of the Chrome-trace-event JSON export (Perfetto-loadable
+    # request/iteration span timeline); empty = disabled unless
+    # LGBM_TPU_TRACE is set
+    trace_out: str = ""
+    # one-shot jax.profiler capture window aligned to span boundaries
+    # (LGBM_TPU_PROFILE_DIR env analog; skip/length via
+    # LGBM_TPU_PROFILE_SKIP / LGBM_TPU_PROFILE_SPANS); empty = off
+    profile_dir: str = ""
     # persistent XLA compilation cache directory (docs/Performance.md):
     # compiled executables are serialized there and reloaded by later
     # processes, so repeat runs skip the cold-compile bill. Empty =

@@ -23,6 +23,7 @@ from .basic import Booster, Dataset, LightGBMError
 from .callback import (CallbackEnv, EarlyStopException, early_stopping,
                        print_evaluation, record_evaluation)
 from .observability.telemetry import get_telemetry
+from .observability.tracing import get_tracer, profile_close
 from .utils.log import log_info, log_warning
 
 _ROUND_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
@@ -288,7 +289,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                end_iteration=end_iter,
                                evaluation_result_list=None))
             try:
-                booster.update(fobj=fobj)
+                # "boosting" groups the iteration's grad/grow/tree/
+                # update phase spans under one span on the trace
+                # timeline (each host-stepped iteration is one trace)
+                with tel.span("boosting", trace="boost_iter"):
+                    booster.update(fobj=fobj)
             except NonFiniteGradientError as nf:
                 if nf.policy != "rollback":
                     raise
@@ -401,6 +406,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         disarm_recorder(flightrec)
         if preempt is not None:
             preempt.uninstall()
+        # close a profiler capture still in flight and persist the
+        # span timeline (the host-stepped loop bypasses GBDT.train)
+        profile_close()
+        get_tracer().flush()
     if tel.enabled:
         # the host-stepped loop bypasses GBDT.train, so the train_end
         # summary (+ one-time phase probe) is emitted here

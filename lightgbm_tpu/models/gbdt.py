@@ -29,10 +29,11 @@ from ..data.dataset import Dataset
 from ..metric import create_metrics
 from ..objective import create_objective
 from ..observability.telemetry import get_telemetry, memory_snapshot
+from ..observability.tracing import (get_tracer, profile_boundary,
+                                     profile_close)
 from ..robustness.guards import NonFiniteGradientError
 from ..utils.jit_registry import register_dynamic, register_jit
-from ..utils.log import (log_fatal, log_info, log_warning,
-                         maybe_profile)
+from ..utils.log import log_fatal, log_info, log_warning
 from .tree import (DeferredStackTree, DeferredTree, Tree, TreeStack,
                    traverse_tree_arrays)
 
@@ -549,6 +550,7 @@ class GBDT:
             self.iter - 1, trees=k, num_data=self.num_data,
             bag_fraction=float(self.config.bagging_fraction)
             if bag is not None else 1.0)
+        profile_boundary("iter")
         return False
 
     def _check_gradients(self, grad, hess):
@@ -919,6 +921,7 @@ class GBDT:
             num_data=self.num_data,
             bag_fraction=float(self.config.bagging_fraction)
             if bag is not None else 1.0)
+        profile_boundary("iter")
         return flag
 
     def finalize_trees(self) -> None:
@@ -1080,6 +1083,7 @@ class GBDT:
             with tel.span("device_sync"):
                 tel.count_iter("host.syncs")
                 flags = [bool(v) for v in jax.device_get(oks)]
+            profile_boundary("block")
             if tel.enabled:
                 # the stop-flag fetch above is the block's real device
                 # barrier, so this wall time covers device execution
@@ -1109,18 +1113,28 @@ class GBDT:
     def train(self, num_iterations: Optional[int] = None) -> None:
         """Full training loop (GBDT::Train, gbdt.cpp:245-264).
 
-        Profiling: set ``LGBM_TPU_PROFILE_DIR`` to capture an xprof
-        device trace of the whole loop (phases named via
-        TraceAnnotation) plus host-side Timer totals (the reference's
-        -DTIMETAG global_timer analog, utils/log.py). Telemetry: set
-        ``LGBM_TPU_TELEMETRY=/path.jsonl`` (or ``telemetry_out``) for a
-        structured trace — see docs/Observability.md."""
+        Profiling: ``LGBM_TPU_PROFILE_DIR`` (env) or ``profile_dir``
+        (param) arms a ONE-SHOT ``jax.profiler`` capture window
+        aligned to iteration/block span boundaries
+        (observability/tracing.py ProfileWindow — skip/length tunable
+        via ``LGBM_TPU_PROFILE_SKIP``/``LGBM_TPU_PROFILE_SPANS``), so
+        the device trace covers steady-state iterations, not the
+        compile storm. Telemetry: ``LGBM_TPU_TELEMETRY=/path.jsonl``
+        (or ``telemetry_out``) for a structured trace, and
+        ``LGBM_TPU_TRACE=/path.json`` (or ``trace_out``) for the
+        Perfetto-loadable span timeline — see docs/Observability.md."""
         tel = get_telemetry()
+        tel.ensure_started(self.config)
         it0 = self.iter
         t0 = time.perf_counter()
-        with maybe_profile():
+        try:
             with tel.span("train"):
                 self._train_impl(num_iterations)
+        finally:
+            # close a profiler capture still in flight (run shorter
+            # than the window) and persist the span timeline
+            profile_close()
+            get_tracer().flush()
         if tel.enabled:
             self.emit_train_end(it0, time.perf_counter() - t0)
 

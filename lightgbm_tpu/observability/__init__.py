@@ -4,6 +4,7 @@ See docs/Observability.md. Import surface:
 
   from lightgbm_tpu.observability import get_telemetry, telemetry_enabled
   from lightgbm_tpu.observability import get_metrics, metrics_text
+  from lightgbm_tpu.observability import get_tracer, tracing_enabled
 """
 
 from .flightrec import (FlightRecorder, active_recorder, arm_recorder,
@@ -13,9 +14,12 @@ from .metrics import (LogHistogram, MetricsRegistry, get_metrics,
                       start_exporter, stop_exporter)
 from .telemetry import (JsonlSink, RingSink, Telemetry, get_telemetry,
                         telemetry_enabled)
+from .tracing import (TraceContext, Tracer, get_tracer,
+                      tracing_enabled)
 
 __all__ = ["Telemetry", "RingSink", "JsonlSink", "get_telemetry",
            "telemetry_enabled", "MetricsRegistry", "LogHistogram",
            "get_metrics", "metrics_text", "start_exporter",
            "stop_exporter", "maybe_start_exporter", "FlightRecorder",
-           "arm_recorder", "disarm_recorder", "active_recorder"]
+           "arm_recorder", "disarm_recorder", "active_recorder",
+           "Tracer", "TraceContext", "get_tracer", "tracing_enabled"]

@@ -141,6 +141,19 @@ class FlightRecorder:
             "trips": list(self.trips),
             "records": records[-self.last_n:],
         }
+        # trace correlation (observability/tracing.py): the span
+        # stacks of everything in flight at trip time — open requests'
+        # queue/batch spans and the current training iteration's phase
+        # spans, each with its trace id — so the black box links
+        # directly to the timeline that explains it
+        try:
+            from .tracing import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                out["trace_spans"] = tracer.active_spans()
+                tracer.flush()   # the exported timeline survives too
+        except Exception:  # never mask the original failure
+            pass
         if exc is not None:
             out["exception"] = {
                 "type": type(exc).__name__,

@@ -174,6 +174,11 @@ class MetricsRegistry:
         # are SUMMED (several serving engines in one process = one
         # process-level total).
         self._collectors: List[Tuple[Any, Callable[[], Dict]]] = []
+        # exemplars: per-(name, labels) the single WORST observation
+        # seen, with the trace id that produced it — the jump-off from
+        # a p99 number to the end-to-end timeline of the request behind
+        # it (docs/Observability.md "Tracing")
+        self._exemplars: Dict[Tuple[str, Labels], Dict[str, Any]] = {}
         self.include_memory = True
 
     # -- histograms ----------------------------------------------------
@@ -208,6 +213,33 @@ class MetricsRegistry:
             snap["name"] = name
             snap["labels"] = dict(labels)
             out.append(snap)
+        return out
+
+    # -- exemplars -----------------------------------------------------
+    def exemplar_max(self, name: str, value: float,
+                     labels: Optional[Dict[str, Any]] = None,
+                     trace_id: Optional[str] = None,
+                     **attrs) -> bool:
+        """Keep ``value`` as the series' exemplar iff it is the worst
+        seen so far; returns True when it took the slot."""
+        key = (str(name), _labels_key(labels))
+        v = float(value)
+        with self._lock:
+            cur = self._exemplars.get(key)
+            if cur is not None and cur["value"] >= v:
+                return False
+            self._exemplars[key] = {"value": v, "trace_id": trace_id,
+                                    **attrs}
+        return True
+
+    def exemplars(self, prefix: str = "") -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._exemplars.items())
+        out = []
+        for (name, labels), ex in sorted(items):
+            if prefix and not name.startswith(prefix):
+                continue
+            out.append({"name": name, "labels": dict(labels), **ex})
         return out
 
     # -- collectors ----------------------------------------------------
@@ -314,12 +346,29 @@ class MetricsRegistry:
             ls = _label_str(labels)
             L.append(f"{base}_sum{ls} {_fmt(s)}")
             L.append(f"{base}_count{ls} {total}")
+
+        # slowest-observation exemplars: the trace id rides as a label
+        # so a dashboard can link a p99 spike straight to its timeline
+        with self._lock:
+            ex_items = sorted(self._exemplars.items())
+        ex_typed: set = set()
+        for (name, labels), ex in ex_items:
+            base = _metric_name(name)
+            if base not in ex_typed:
+                ex_typed.add(base)
+                L.append(f"# HELP {base} slowest-observation exemplar "
+                         f"{name}")
+                L.append(f"# TYPE {base} gauge")
+            extra = f'trace_id="{_escape_label(ex.get("trace_id") or "")}"'
+            L.append(f"{base}{_label_str(labels, extra)} "
+                     f"{_fmt(ex['value'])}")
         return "\n".join(L) + "\n"
 
     def reset(self) -> None:
         with self._lock:
             self._hists.clear()
             self._collectors.clear()
+            self._exemplars.clear()
             self.include_memory = True
 
 

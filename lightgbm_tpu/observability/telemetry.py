@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.log import Timer, get_verbosity, global_timer, log_info, \
     log_warning
+from .tracing import get_tracer
 
 # jax.monitoring event suffixes -> (count counter, seconds counter).
 # backend_compile is THE compile; trace/lowering are recorded too so a
@@ -184,19 +185,24 @@ _NULL_SPAN = _NullSpan()
 
 class _Span:
     """Active span: telemetry accumulation + global_timer bridge +
-    optional jax profiler trace region."""
+    optional jax profiler trace region + the trace-correlation bridge
+    (every telemetry span lands on the tracing.py timeline with ids
+    when the tracer is enabled — the training side of the end-to-end
+    trace plane rides this, no second instrumentation pass)."""
 
     __slots__ = ("tel", "name", "phase", "trace", "timer_on", "_t0",
-                 "_path", "_ann")
+                 "_path", "_ann", "_tspan")
 
     def __init__(self, tel: "Telemetry", name: str, phase: bool,
-                 trace: Optional[str], timer_on: bool):
+                 trace: Optional[str], timer_on: bool, tracer):
         self.tel = tel
         self.name = name
         self.phase = phase
         self.trace = trace
         self.timer_on = timer_on
         self._ann = None
+        self._tspan = None if tracer is None \
+            else tracer._begin(name, "train", None, None, scoped=True)
 
     def __enter__(self):
         tel = self.tel
@@ -220,6 +226,8 @@ class _Span:
             self._ann.__exit__(*exc)
         if self.timer_on:
             global_timer.end(self.name)
+        if self._tspan is not None:
+            self._tspan.finish()
         tel = self.tel
         if self._path is not None and tel._enabled:
             if tel._stack and tel._stack[-1] == self.name:
@@ -287,6 +295,10 @@ class Telemetry:
         names a JSONL path, and emits the one-time ``run_start`` record.
         Called from every training entry point; a no-op when neither
         knob is set and telemetry was not enabled programmatically."""
+        # the trace-correlation plane (tracing.py) shares this seam:
+        # trace_out / LGBM_TPU_TRACE and the profiler window arm here,
+        # so every entry point that starts telemetry starts tracing
+        get_tracer().ensure_started(config)
         path = (getattr(config, "telemetry_out", "") or "").strip() \
             or os.environ.get("LGBM_TPU_TELEMETRY", "").strip()
         if not self._enabled:
@@ -331,9 +343,12 @@ class Telemetry:
         into the current iteration's phase table; ``trace=<name>`` opens
         a named jax profiler region (the old ``annotate``)."""
         timer_on = Timer._enabled
-        if not self._enabled and not timer_on and trace is None:
+        tracer = get_tracer()
+        if not self._enabled and not timer_on and trace is None \
+                and not tracer._enabled:
             return _NULL_SPAN
-        return _Span(self, name, phase, trace, timer_on)
+        return _Span(self, name, phase, trace, timer_on,
+                     tracer if tracer._enabled else None)
 
     # -- metrics -------------------------------------------------------
     def count(self, name: str, value: float = 1.0) -> None:

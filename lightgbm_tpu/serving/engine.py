@@ -41,6 +41,8 @@ import numpy as np
 
 from ..observability.metrics import get_metrics
 from ..observability.telemetry import get_telemetry
+from ..observability.tracing import (get_tracer, profile_boundary,
+                                     program_args)
 from ..utils.log import log_info, log_warning
 from .errors import (EngineStoppedError, InvalidRequestError,
                      QueueFullError, RequestTimeoutError, ServingError)
@@ -124,19 +126,28 @@ class ServingConfig:
 
 class _Request:
     __slots__ = ("rows", "kind", "t_enqueue", "deadline", "event",
-                 "result", "error", "meta")
+                 "result", "error", "meta", "ctx", "qspan", "t_perf",
+                 "t_perf_done")
 
     def __init__(self, rows: np.ndarray, kind: str,
                  timeout_s: Optional[float]):
         self.rows = rows
         self.kind = kind
         self.t_enqueue = time.monotonic()
+        self.t_perf = time.perf_counter()
         self.deadline = None if timeout_s is None \
             else self.t_enqueue + timeout_s
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[ServingError] = None
         self.meta: Dict[str, Any] = {}
+        # trace correlation (observability/tracing.py): the request's
+        # TraceContext and its open queue-wait span (started at submit
+        # on the caller's thread, finished on the flusher thread when
+        # the request is pulled into a batch)
+        self.ctx = None
+        self.qspan = None
+        self.t_perf_done: Optional[float] = None
 
 
 class ServingFuture:
@@ -185,6 +196,9 @@ class ServingEngine:
         self._latencies: List[float] = []   # bounded reservoir (ms)
         self._latency_cap = 8192
         self._bucket_seen = set()           # (version, bucket)
+        # per-(kind, bucket) slowest-request exemplar: latency + the
+        # trace id of the request behind it (docs/Observability.md)
+        self._slowest: Dict[str, Dict[str, Any]] = {}
         self._queue_peak = 0
         self._last_reload_error: Optional[Dict[str, Any]] = None
         # live metrics plane (observability/metrics.py): request
@@ -318,6 +332,7 @@ class ServingEngine:
             for snap in self._metrics.snapshots(prefix="serving_"):
                 tel.record("hist", **snap)
             tel.flush()
+        get_tracer().flush()   # persist the request timeline
 
     def __enter__(self) -> "ServingEngine":
         return self
@@ -355,10 +370,12 @@ class ServingEngine:
         return int(getattr(mv.src, "max_feature_idx", 0)) + 1
 
     def submit(self, rows, kind: str = "predict",
-               timeout_ms: Optional[float] = None) -> ServingFuture:
+               timeout_ms: Optional[float] = None,
+               trace_ctx=None) -> ServingFuture:
         """Enqueue a request; returns a future. Raises QueueFullError
         under the reject_new shed policy when the queue is at
-        max_queue."""
+        max_queue. ``trace_ctx`` parents the request's spans (the
+        HTTP frontend / fleet dispatch hand their context down)."""
         if kind not in KINDS:
             raise InvalidRequestError(
                 f"unknown kind {kind!r}; one of {KINDS}")
@@ -366,6 +383,14 @@ class ServingEngine:
         t = self.config.request_timeout_ms if timeout_ms is None \
             else timeout_ms
         req = _Request(arr, kind, None if t <= 0 else t / 1000.0)
+        tracer = get_tracer()
+        if tracer.enabled:
+            ctx = trace_ctx or tracer.current() or tracer.new_trace()
+            req.ctx = ctx
+            req.qspan = tracer.begin_span(
+                "serving.queue_wait", cat="serving", ctx=ctx,
+                args={"kind": kind, "rows": len(arr)})
+            req.meta["trace_id"] = ctx.trace_id
         with self._cond:
             if self._stop:
                 raise EngineStoppedError("engine is stopped")
@@ -411,13 +436,25 @@ class ServingEngine:
                 f"unknown kind {kind!r}; one of {KINDS}")
         arr = self._validate(rows)
         t0 = time.monotonic()
-        with self.registry.checkout() as mv:
-            route = self._route_for(mv, len(arr), kind)
-            out = self._compute_safe(mv, arr, kind, route)
+        tracer = get_tracer()
+        trace_id = None
+        if tracer.enabled:
+            with tracer.span("serving.request", cat="serving",
+                             args={"kind": kind, "rows": len(arr),
+                                   "route_mode": "bypass"}) as sp:
+                trace_id = sp.ctx.trace_id
+                with self.registry.checkout() as mv:
+                    route = self._route_for(mv, len(arr), kind)
+                    out = self._compute_safe(mv, arr, kind, route)
+        else:
+            with self.registry.checkout() as mv:
+                route = self._route_for(mv, len(arr), kind)
+                out = self._compute_safe(mv, arr, kind, route)
         self._count("requests")
         self._count("rows", len(arr))
         self._observe_latency((time.monotonic() - t0) * 1000.0,
-                              kind=kind, rows=len(arr))
+                              kind=kind, rows=len(arr),
+                              trace_id=trace_id)
         return out
 
     # -- flusher -------------------------------------------------------
@@ -462,12 +499,23 @@ class ServingEngine:
         now = time.monotonic()
         live: List[_Request] = []
         for r in batch:
+            # the queue-wait span closes HERE for every outcome — a
+            # request timing out in the queue still leaves its wait on
+            # the timeline (that wait IS the diagnosis)
+            queue_ms = round((now - r.t_enqueue) * 1000.0, 3)
+            r.meta["queue_ms"] = queue_ms
             if r.deadline is not None and now > r.deadline:
+                if r.qspan is not None:
+                    r.qspan.finish(queue_ms=queue_ms, outcome="timeout")
+                    r.qspan = None
                 self._count("timeouts")
                 self._fail(r, RequestTimeoutError(
                     "deadline passed before dispatch",
                     timeout_ms=self.config.request_timeout_ms))
             else:
+                if r.qspan is not None:
+                    r.qspan.finish(queue_ms=queue_ms)
+                    r.qspan = None
                 live.append(r)
         if not live:
             return
@@ -484,17 +532,41 @@ class ServingEngine:
                    reqs: List[_Request]) -> None:
         x = np.concatenate([r.rows for r in reqs]) if len(reqs) > 1 \
             else reqs[0].rows
+        tracer = get_tracer()
+        # the coalesced batch is one span (parented under the FIRST
+        # request's trace; the other member traces join it via their
+        # own per-request events carrying batch_span)
+        bspan = tracer.begin_span(
+            "serving.batch", cat="serving",
+            ctx=reqs[0].ctx,
+            args={"kind": kind, "route": route, "rows": len(x),
+                  "requests": len(reqs)}) \
+            if tracer.enabled and reqs[0].ctx is not None else None
+        t_c0 = time.perf_counter()
         try:
-            out = self._compute_safe(mv, x, kind, route)
+            if bspan is not None:
+                with tracer.attach(bspan.ctx):
+                    out = self._compute_safe(mv, x, kind, route)
+            else:
+                out = self._compute_safe(mv, x, kind, route)
         except ServingError as e:
+            if bspan is not None:
+                bspan.finish(error=e.code)
             for r in reqs:
                 self._fail(r, e)
             return
         except Exception as e:
+            if bspan is not None:
+                bspan.finish(error="compute_failed")
             err = ServingError(f"compute failed: {e}")
             for r in reqs:
                 self._fail(r, err)
             return
+        t_c1 = time.perf_counter()
+        compute_ms = round((t_c1 - t_c0) * 1000.0, 3)
+        if bspan is not None:
+            bspan.finish(compute_ms=compute_ms)
+        profile_boundary("serving.batch")
         lo = 0
         done_t = time.monotonic()
         for r in reqs:
@@ -503,8 +575,27 @@ class ServingEngine:
             lo += n
             lat = (done_t - r.t_enqueue) * 1000.0
             r.meta.update(version=mv.version, route=route, kind=kind,
-                          batch_rows=len(x), latency_ms=round(lat, 3))
-            self._observe_latency(lat, kind=kind, rows=n)
+                          batch_rows=len(x), latency_ms=round(lat, 3),
+                          compute_ms=compute_ms)
+            if r.ctx is not None:
+                # one summary event per request decomposing its
+                # latency: queue-wait (enqueue -> batch pull) vs the
+                # shared batch compute (device dispatch included)
+                tracer.emit_complete(
+                    "serving.request", r.t_perf,
+                    r.t_perf + (done_t - r.t_enqueue),
+                    cat="serving", ctx=r.ctx,
+                    args={"kind": kind, "route": route, "rows": n,
+                          "queue_ms": r.meta.get("queue_ms"),
+                          "compute_ms": compute_ms,
+                          "batch_rows": len(x),
+                          "batch_span": bspan.ctx.span_id
+                          if bspan is not None else None,
+                          "latency_ms": round(lat, 3)})
+            self._observe_latency(lat, kind=kind, rows=n,
+                                  trace_id=r.ctx.trace_id
+                                  if r.ctx is not None else None)
+            r.t_perf_done = time.perf_counter()
             r.event.set()
 
     # -- routing & compute ---------------------------------------------
@@ -551,6 +642,13 @@ class ServingEngine:
         # bucket, run the compiled scan, transform on the padded shape
         # (shape-stable -> no new eager-op compiles), slice back
         cap = self.config.buckets[-1]
+        tracer = get_tracer()
+        # the jit_registry program this dispatch runs — every device
+        # span on the timeline is attributable to a graftcheck-
+        # registered compiled program by name
+        program = "predict_scan_trees_linear" \
+            if getattr(mv.stacked, "any_linear", False) \
+            else "predict_scan_trees"
         parts: List[np.ndarray] = []
         for lo in range(0, len(x), cap):
             chunk = x[lo:lo + cap]
@@ -565,8 +663,18 @@ class ServingEngine:
             if b > n:
                 chunk = np.concatenate(
                     [chunk, np.zeros((b - n, chunk.shape[1]))])
-            raw = predictor.predict(mv.src, chunk, raw_score=True,
-                                    device=True, stacked=mv.stacked)
+            if tracer.enabled:
+                dargs = program_args(program)
+                dargs.update(bucket=b, rows=n, version=mv.version)
+                with tracer.span("device.dispatch", cat="device",
+                                 args=dargs):
+                    raw = predictor.predict(mv.src, chunk,
+                                            raw_score=True,
+                                            device=True,
+                                            stacked=mv.stacked)
+            else:
+                raw = predictor.predict(mv.src, chunk, raw_score=True,
+                                        device=True, stacked=mv.stacked)
             out = convert_output(mv.src, raw) if kind == "predict" \
                 else raw
             parts.append(np.asarray(out)[:n])
@@ -574,9 +682,13 @@ class ServingEngine:
 
     # -- bookkeeping ---------------------------------------------------
     def _fail(self, req: _Request, err: ServingError) -> None:
+        if req.qspan is not None:   # shed/stop before dispatch
+            req.qspan.finish(outcome=err.code)
+            req.qspan = None
         req.error = err
         req.meta.update(error=err.code)
         self._count("errors")
+        req.t_perf_done = time.perf_counter()
         req.event.set()
 
     def _count(self, name: str, value: float = 1.0) -> None:
@@ -585,7 +697,8 @@ class ServingEngine:
         get_telemetry().count(f"serving.{name}", value)
 
     def _observe_latency(self, ms: float, kind: str = "predict",
-                         rows: int = 0) -> None:
+                         rows: int = 0,
+                         trace_id: Optional[str] = None) -> None:
         with self._stats_lock:
             if len(self._latencies) >= self._latency_cap:
                 # reservoir half-drop keeps recent traffic dominant
@@ -598,6 +711,18 @@ class ServingEngine:
         b = bucket_for(max(int(rows), 1), self.config.buckets)
         self._metrics.observe("serving_request_latency_ms", ms,
                               labels={"kind": kind, "bucket": b})
+        # slowest-request exemplar per bucket: the trace id of the
+        # worst request rides /metrics and serving_stats, linking the
+        # p99 number to the timeline that explains it
+        self._metrics.exemplar_max(
+            "serving_slowest_request_ms", ms,
+            labels={"kind": kind, "bucket": b}, trace_id=trace_id)
+        with self._stats_lock:
+            key = f"{kind}/{b}"
+            cur = self._slowest.get(key)
+            if cur is None or ms > cur["latency_ms"]:
+                self._slowest[key] = {"latency_ms": round(ms, 3),
+                                      "trace_id": trace_id}
 
     @property
     def queue_depth(self) -> int:
@@ -610,6 +735,7 @@ class ServingEngine:
         with self._stats_lock:
             counts = dict(self._counts)
             lats = list(self._latencies)
+            slowest = {k: dict(v) for k, v in self._slowest.items()}
         out: Dict[str, Any] = {
             "requests": int(counts.get("requests", 0)),
             "rows": int(counts.get("rows", 0)),
@@ -627,6 +753,8 @@ class ServingEngine:
         total_b = out["bucket_hits"] + out["bucket_misses"]
         out["bucket_hit_rate"] = round(out["bucket_hits"] / total_b, 4) \
             if total_b else None
+        if slowest:
+            out["slowest_request"] = slowest
         if lats:
             arr = np.asarray(lats)
             out["latency_ms"] = {

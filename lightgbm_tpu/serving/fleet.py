@@ -56,6 +56,7 @@ import numpy as np
 
 from ..observability.metrics import get_metrics
 from ..observability.telemetry import get_telemetry
+from ..observability.tracing import get_tracer
 from ..utils.log import log_info, log_warning
 from .engine import ServingConfig, ServingEngine, ServingFuture
 from .errors import (EngineStoppedError, InvalidRequestError,
@@ -209,12 +210,12 @@ class FleetFuture:
     __slots__ = ("_fleet", "_fut", "_replica", "_model", "_target",
                  "_kind", "_tenant", "_rows", "_t0", "_deadline",
                  "_redispatches", "_finished", "_meta", "_rlock",
-                 "__weakref__")
+                 "_span", "__weakref__")
 
     def __init__(self, fleet: "FleetEngine", fut: ServingFuture,
                  replica: Replica, model: str, target: str, kind: str,
                  tenant: str, rows: np.ndarray,
-                 timeout_s: Optional[float]):
+                 timeout_s: Optional[float], span=None):
         self._fleet = fleet
         self._fut = fut
         self._replica = replica
@@ -229,6 +230,11 @@ class FleetFuture:
         self._redispatches = 0
         self._finished = False
         self._meta: Dict[str, Any] = {}
+        # the request's root trace span (observability/tracing.py):
+        # opened at submit, finished when the future completes
+        self._span = span
+        if span is not None:
+            self._meta["trace_id"] = span.ctx.trace_id
         self._rlock = threading.Lock()
         replica.futures.add(self)
 
@@ -492,52 +498,79 @@ class FleetEngine:
     def submit(self, rows, kind: str = "predict",
                timeout_ms: Optional[float] = None,
                model: Optional[str] = None,
-               tenant: str = "default") -> FleetFuture:
+               tenant: str = "default",
+               trace_ctx=None) -> FleetFuture:
         if self._stopping:
             raise EngineStoppedError("fleet is stopped")
         name = model or self.default_model
+        tracer = get_tracer()
+        # root span of the fleet request: everything downstream — the
+        # canary/shadow targets, the replica engine's queue-wait/batch/
+        # device spans — shares this trace id
+        span = tracer.begin_span(
+            "fleet.request", cat="fleet", ctx=trace_ctx,
+            args={"model": name, "tenant": tenant, "kind": kind}) \
+            if tracer.enabled else None
         try:
-            self.quotas.check(tenant)
-        except QuotaExceededError:
-            self._count("quota_shed")
-            self._count("shed")
-            raise
-        decision = self.router.route(name)
-        if not self.fleet.has(decision.target):
-            self._count("model_not_found")
-            raise ModelNotFoundError(
-                f"model {decision.target!r} is not served by this "
-                "fleet", model=decision.target,
-                known=self.fleet.names())
-        try:
-            arr = np.asarray(rows, np.float64)
-        except (TypeError, ValueError) as e:
-            raise InvalidRequestError(f"rows not numeric: {e}") from e
-        with self._lock:
-            full = self._pending >= self.max_pending
-            if not full:
-                self._pending += 1
-        if full:
-            self._count("shed")
-            raise QueueFullError(
-                "fleet pending limit reached",
-                max_pending=self.max_pending)
-        t = self.config.request_timeout_ms if timeout_ms is None \
-            else timeout_ms
-        timeout_s = None if t <= 0 else t / 1000.0
-        try:
-            rep, fut = self._dispatch(decision.target, arr, kind,
-                                      timeout_ms)
-        except ServingError:
+            try:
+                # tenant admission runs attached to the root span so a
+                # quota denial's marker lands on this request's trace
+                with tracer.attach(None if span is None else span.ctx):
+                    self.quotas.check(tenant)
+            except QuotaExceededError:
+                self._count("quota_shed")
+                self._count("shed")
+                raise
+            decision = self.router.route(name)
+            if span is not None and (decision.is_canary
+                                     or decision.shadow):
+                # the routing decision rides the root span's args so a
+                # canary-tail investigation sees WHICH variant served
+                tracer.instant("fleet.route", cat="fleet",
+                               ctx=span.ctx,
+                               args=decision.describe())
+            if not self.fleet.has(decision.target):
+                self._count("model_not_found")
+                raise ModelNotFoundError(
+                    f"model {decision.target!r} is not served by this "
+                    "fleet", model=decision.target,
+                    known=self.fleet.names())
+            try:
+                arr = np.asarray(rows, np.float64)
+            except (TypeError, ValueError) as e:
+                raise InvalidRequestError(
+                    f"rows not numeric: {e}") from e
             with self._lock:
-                self._pending -= 1
+                full = self._pending >= self.max_pending
+                if not full:
+                    self._pending += 1
+            if full:
+                self._count("shed")
+                raise QueueFullError(
+                    "fleet pending limit reached",
+                    max_pending=self.max_pending)
+            t = self.config.request_timeout_ms if timeout_ms is None \
+                else timeout_ms
+            timeout_s = None if t <= 0 else t / 1000.0
+            try:
+                rep, fut = self._dispatch(
+                    decision.target, arr, kind, timeout_ms,
+                    trace_ctx=None if span is None else span.ctx)
+            except ServingError:
+                with self._lock:
+                    self._pending -= 1
+                raise
+        except ServingError as e:
+            if span is not None:
+                span.finish(error=e.code)
             raise
         self._count("requests")
         self._count("rows", arr.shape[0] if arr.ndim > 1 else 1)
         if decision.is_canary:
             self._count("canary_requests")
         ff = FleetFuture(self, fut, rep, name, decision.target, kind,
-                         tenant, arr, timeout_s)
+                         tenant, arr, timeout_s, span=span)
+        ff._meta["is_canary"] = decision.is_canary
         if decision.shadow:
             self._mirror(decision.shadow, arr, kind, ff)
         return ff
@@ -557,8 +590,8 @@ class FleetEngine:
 
     def _dispatch(self, target: str, rows: np.ndarray, kind: str,
                   timeout_ms: Optional[float],
-                  exclude: Tuple[int, ...] = ()
-                  ) -> Tuple[Replica, ServingFuture]:
+                  exclude: Tuple[int, ...] = (),
+                  trace_ctx=None) -> Tuple[Replica, ServingFuture]:
         """Least-loaded dispatch with dead-replica failover at submit
         time (a replica that died between selection and submit is
         marked and the next one tried)."""
@@ -567,7 +600,8 @@ class FleetEngine:
             rep = self._pick_replica(exclude=tuple(tried))
             try:
                 fut = rep.engine_for(target).submit(
-                    rows, kind, timeout_ms=timeout_ms)
+                    rows, kind, timeout_ms=timeout_ms,
+                    trace_ctx=trace_ctx)
             except EngineStoppedError:
                 self._mark_dead(rep)
                 tried.append(rep.rid)
@@ -591,10 +625,17 @@ class FleetEngine:
                 "deadline passed before re-dispatch after replica "
                 "death", replica=ff._replica.rid)
         self._count("redispatches")
+        ctx = None
+        if ff._span is not None:
+            ctx = ff._span.ctx
+            get_tracer().instant(
+                "fleet.redispatch", cat="fleet", ctx=ctx,
+                args={"from_replica": ff._replica.rid,
+                      "target": ff._target})
         rep, fut = self._dispatch(
             ff._target, ff._rows, ff._kind,
             None if remaining is None else remaining * 1000.0,
-            exclude=(ff._replica.rid,))
+            exclude=(ff._replica.rid,), trace_ctx=ctx)
         rep.futures.add(ff)
         return rep, fut
 
@@ -604,6 +645,14 @@ class FleetEngine:
             self._pending = max(self._pending - 1, 0)
         with ff._replica._lock:
             ff._replica.outstanding = max(ff._replica.outstanding - 1, 0)
+        if ff._span is not None:
+            # end the root span at the moment the underlying request
+            # actually completed, not when the caller collected it
+            ff._span.finish(
+                _end_t=getattr(ff._fut._req, "t_perf_done", None),
+                replica=ff._replica.rid,
+                redispatches=ff._redispatches,
+                **({"error": error.code} if error is not None else {}))
         if error is None:
             lat = (time.monotonic() - ff._t0) * 1000.0
             self._metrics.observe(
@@ -634,7 +683,13 @@ class FleetEngine:
             self._count("shadow_skipped")
             return
         try:
-            fut = rep.engine_for(shadow).submit(rows, kind)
+            # the mirror rides the PRIMARY request's trace: its
+            # queue/batch/device spans appear on the same timeline,
+            # labeled by the shadow target
+            fut = rep.engine_for(shadow).submit(
+                rows, kind,
+                trace_ctx=None if primary._span is None
+                else primary._span.ctx)
         except ServingError:
             self._count("shadow_skipped")
             return
@@ -780,6 +835,7 @@ class FleetEngine:
             tel.record("fleet_stats", **{
                 k: v for k, v in stats.items()
                 if isinstance(v, (int, float, str))})
+        get_tracer().flush()   # persist the request timeline
 
     def __enter__(self) -> "FleetEngine":
         return self

@@ -41,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..observability.tracing import get_tracer
 from ..utils.log import log_info, log_warning
 from .engine import ServingEngine
 from .errors import InvalidRequestError, ServingError
@@ -135,13 +136,35 @@ class ServingHandler(BaseHTTPRequestHandler):
                 or self.headers.get("X-Tenant")
             if tenant:
                 kwargs["tenant"] = str(tenant)
-        fut = self.engine.submit(rows, kind=kind, timeout_ms=timeout_ms,
-                                 **kwargs)
-        t = self.engine.config.request_timeout_ms \
-            if timeout_ms is None else float(timeout_ms)
-        pred = fut.result(timeout=None if t <= 0 else t / 1000.0 + 5.0)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # the request's root span: an X-Trace-Id header (plain hex
+            # or trace-span form) joins the caller's existing trace,
+            # otherwise a fresh trace id is minted here. The id is
+            # returned in the response so caller-side latency can be
+            # joined to the server-side timeline.
+            ctx = tracer.from_header(self.headers.get("X-Trace-Id"))
+            with tracer.span(f"http.{kind}", cat="http", ctx=ctx,
+                             args={"path": self.path}) as root:
+                fut = self.engine.submit(
+                    rows, kind=kind, timeout_ms=timeout_ms,
+                    trace_ctx=root.ctx, **kwargs)
+                t = self.engine.config.request_timeout_ms \
+                    if timeout_ms is None else float(timeout_ms)
+                pred = fut.result(
+                    timeout=None if t <= 0 else t / 1000.0 + 5.0)
+            meta = dict(fut.meta)
+            meta.setdefault("trace_id", root.ctx.trace_id)
+        else:
+            fut = self.engine.submit(
+                rows, kind=kind, timeout_ms=timeout_ms, **kwargs)
+            t = self.engine.config.request_timeout_ms \
+                if timeout_ms is None else float(timeout_ms)
+            pred = fut.result(
+                timeout=None if t <= 0 else t / 1000.0 + 5.0)
+            meta = fut.meta
         self._send_json(200, {
-            "predictions": np.asarray(pred).tolist(), **fut.meta})
+            "predictions": np.asarray(pred).tolist(), **meta})
 
     def _reload(self) -> None:
         body = self._read_body()

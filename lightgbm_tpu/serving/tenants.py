@@ -135,12 +135,20 @@ class TenantQuotas:
 
     def check(self, tenant: str, cost: float = 1.0) -> None:
         """Admission check: consumes one token or raises the
-        structured :class:`QuotaExceededError` shed (HTTP 429)."""
+        structured :class:`QuotaExceededError` shed (HTTP 429). A
+        denial leaves an instant marker on the current trace so a
+        per-tenant 429 investigation finds the exact admission points
+        on the timeline."""
         bucket = self.bucket_for(tenant)
         if bucket is None:
             return
         ok, retry_after = bucket.try_acquire(cost)
         if not ok:
+            from ..observability.tracing import get_tracer
+            get_tracer().instant(
+                "tenant.quota_denied", cat="fleet",
+                args={"tenant": tenant, "rate": bucket.rate,
+                      "retry_after_s": round(retry_after, 4)})
             raise QuotaExceededError(
                 f"tenant {tenant!r} exceeded its request quota "
                 f"({bucket.rate:g}/s, burst {bucket.burst:g})",

@@ -100,43 +100,6 @@ class _TimerScope:
 global_timer = Timer()
 
 
-class maybe_profile:
-    """Context manager: capture an xprof/TensorBoard device trace of the
-    wrapped region when profiling is requested (the reference's -DTIMETAG
-    wall-timers can't see inside an XLA program; a device trace can).
-
-    Enable with ``LGBM_TPU_PROFILE_DIR=/path`` in the environment (or an
-    explicit ``dirname``). For the profiled region the host-side phase
-    timers (``global_timer``) are enabled and cleared on entry and the
-    previous enable state is restored on exit, so one train() run never
-    accumulates into the next."""
-
-    def __init__(self, dirname=None):
-        import os
-        self.dir = dirname or os.environ.get("LGBM_TPU_PROFILE_DIR")
-        self._trace = None
-        self._prev_enabled = Timer._enabled
-
-    def __enter__(self):
-        if self.dir:
-            self._prev_enabled = Timer._enabled
-            Timer.enable(True)
-            global_timer.acc.clear()
-            import jax
-            self._trace = jax.profiler.trace(self.dir)
-            self._trace.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        if self._trace is not None:
-            self._trace.__exit__(*exc)
-        if self.dir:
-            if global_timer.acc:
-                global_timer.print_all()
-            Timer.enable(self._prev_enabled)
-        return False
-
-
 def annotate(name: str):
     """Named trace region (jax.profiler.TraceAnnotation) so device
     profiles show grow/predict/eval phases by name; no-op cost when no

@@ -323,22 +323,31 @@ def test_histogram_pool_bounded_matches_cached():
 
 
 def test_profile_capture(tmp_path, monkeypatch):
-    """LGBM_TPU_PROFILE_DIR captures an xprof trace of GBDT.train and
-    reports the host-side phase timers."""
+    """LGBM_TPU_PROFILE_DIR arms the ONE-SHOT span-aligned capture
+    window (observability/tracing.py ProfileWindow): the xprof trace
+    covers a few steady-state iteration boundaries and the host-side
+    phase timers accumulate over the same window."""
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.data import Dataset
     from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.observability import tracing
+    # fresh window: the singleton is one-shot per process and another
+    # test may have consumed it
+    monkeypatch.setattr(tracing, "_PROFILE", tracing.ProfileWindow())
     monkeypatch.setenv("LGBM_TPU_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("LGBM_TPU_PROFILE_SKIP", "0")
     rng = np.random.RandomState(1)
     X = rng.randn(300, 4)
     y = (X[:, 0] > 0).astype(np.float32)
     cfg = Config.from_params({"objective": "binary", "num_leaves": 5,
-                              "num_iterations": 3, "verbosity": -1})
+                              "num_iterations": 6, "verbosity": -1})
     booster = GBDT(cfg, Dataset.from_numpy(X, cfg, label=y))
     booster.train()
+    assert tracing.profile_window().state == "done"
     from lightgbm_tpu.utils.log import Timer
     assert not Timer._enabled  # enable state restored after the trace
-    # a trace was written and the boosting timer accumulated
+    # a trace was written and the boosting timer accumulated inside
+    # the capture window
     import os
     found = [f for _, _, fs in os.walk(tmp_path) for f in fs]
     assert any(f.endswith((".pb", ".json.gz", ".xplane.pb"))

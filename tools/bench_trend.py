@@ -97,7 +97,9 @@ def _fixed_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     """The round's fixed-baseline measurement: an explicit
     cpu_fixed_baseline_throughput line, else a headline that reused
     the fixed config as its CPU fallback (source=cpu_fixed_baseline).
-    The LAST matching line wins (bench prints escalating attempts)."""
+    The LAST matching line wins (bench prints escalating attempts).
+    The line's per-phase wall-time decomposition (``phases``) rides
+    along for regression attribution."""
     found = None
     for ln in lines:
         if ln.get("metric") == FIXED_METRIC \
@@ -107,7 +109,46 @@ def _fixed_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
                     and ln.get("baseline_config"):
                 found = {"value": float(ln["value"]),
                          "key": str(ln["baseline_config"])}
+                ph = ln.get("phases")
+                if isinstance(ph, dict) and ph:
+                    found["phases"] = {str(k): float(v)
+                                       for k, v in ph.items()
+                                       if isinstance(v, (int, float))}
     return found
+
+
+def phase_shares(phases: Dict[str, float]) -> Dict[str, float]:
+    """Normalize absolute per-phase seconds into shares of the total
+    (shares compare across rounds even when the absolute wall time
+    moved — which is exactly the regression case)."""
+    tot = sum(v for v in phases.values() if v > 0)
+    if tot <= 0:
+        return {}
+    return {k: round(v / tot, 4) for k, v in phases.items() if v >= 0}
+
+
+def attribute_regression(prev_phases: Dict[str, float],
+                         cur_phases: Dict[str, float]
+                         ) -> Optional[Dict[str, Any]]:
+    """Name the phase whose share of the wall time GREW the most
+    between two comparable rounds — when the headline regresses, that
+    phase is where the regression lives. Returns None when either
+    round lacks a phase decomposition."""
+    ps, cs = phase_shares(prev_phases or {}), \
+        phase_shares(cur_phases or {})
+    if not ps or not cs:
+        return None
+    deltas = {k: round(cs.get(k, 0.0) - ps.get(k, 0.0), 4)
+              for k in set(ps) | set(cs)}
+    worst = max(deltas, key=lambda k: deltas[k])
+    return {
+        "phase": worst,
+        "from_share": ps.get(worst, 0.0),
+        "to_share": cs.get(worst, 0.0),
+        "share_delta": deltas[worst],
+        "share_deltas": dict(sorted(deltas.items(),
+                                    key=lambda kv: -kv[1])),
+    }
 
 
 def _serving_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
@@ -183,7 +224,10 @@ def _headline_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
 def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
           threshold: float, name: str) -> List[Dict[str, Any]]:
     """Consecutive comparable points (equal ``key``) whose worsening
-    exceeds the threshold."""
+    exceeds the threshold. A regression between two points that both
+    carry a ``phases`` decomposition additionally names the phase
+    whose span share regressed (``attribution``) — the gate trip says
+    *where*, not just *how much*."""
     regressions = []
     prev_label, prev = None, None
     for label, point in series:
@@ -192,7 +236,7 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
             change = (point["value"] - prev["value"]) / prev["value"]
             worsening = -change if higher_is_better else change
             if worsening > threshold:
-                regressions.append({
+                reg = {
                     "series": name,
                     "from_round": prev_label, "to_round": label,
                     "from_value": prev["value"],
@@ -200,7 +244,12 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
                     "change_pct": round(change * 100.0, 2),
                     "threshold_pct": round(threshold * 100.0, 2),
                     "key": point["key"],
-                })
+                }
+                attr = attribute_regression(prev.get("phases"),
+                                            point.get("phases"))
+                if attr is not None:
+                    reg["attribution"] = attr
+                regressions.append(reg)
         prev_label, prev = label, point
     return regressions
 
@@ -233,6 +282,13 @@ def analyze(rounds: List[Dict[str, Any]],
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
+        # per-round phase-share decomposition of the fixed baseline
+        # (informational; the attribution inside a regression entry is
+        # the gated use of this data)
+        "phase_shares": [
+            {"round": lb, "key": pt["key"],
+             "shares": phase_shares(pt["phases"])}
+            for lb, pt in fixed if pt.get("phases")],
         "series": {
             FIXED_METRIC: [
                 {"round": lb, **pt} for lb, pt in fixed],
@@ -268,6 +324,15 @@ def render(report: Dict[str, Any]) -> str:
         for pt in pts:
             extra = f"  [{pt['key']}]" if "key" in pt else ""
             L.append(f"{pt['round']:>6}  {pt['value']:>12.4f}{extra}")
+    if report.get("phase_shares"):
+        L.append("")
+        L.append("== fixed-baseline phase shares (attribution "
+                 "input) ==")
+        for row in report["phase_shares"]:
+            body = " ".join(
+                f"{k}={100 * v:.0f}%" for k, v in sorted(
+                    row["shares"].items(), key=lambda kv: -kv[1]))
+            L.append(f"{row['round']:>6}  {body}")
     L.append("")
     if report["regressions"]:
         L.append("REGRESSIONS:")
@@ -277,6 +342,13 @@ def render(report: Dict[str, Any]) -> str:
                 f"{r['to_round']}: {r['from_value']:.4f} -> "
                 f"{r['to_value']:.4f} ({r['change_pct']:+.1f}% vs "
                 f"{r['threshold_pct']:.0f}% allowed)")
+            attr = r.get("attribution")
+            if attr:
+                L.append(
+                    f"    attributed to phase '{attr['phase']}': "
+                    f"span share {100 * attr['from_share']:.1f}% -> "
+                    f"{100 * attr['to_share']:.1f}% "
+                    f"({100 * attr['share_delta']:+.1f}pp)")
     else:
         L.append("verdict: ok (no gated regression)")
     return "\n".join(L) + "\n"

@@ -1,0 +1,67 @@
+"""Probe failure taxonomy (ROADMAP item 6): structured reason codes.
+
+The TPU probe (bench.py) has failed every round since r03 with only a
+raw stderr tail as evidence. This module classifies that raw cause
+into a small stable vocabulary so the failure MODE is diagnosable and
+trendable across rounds (``tools/run_report.py`` renders the probe
+timeline; the ``probe`` telemetry records carry ``reason_code``):
+
+* ``no_device``     — jax came up but only saw CPU (the tunnel handed
+                      us no accelerator; the probe's device assert).
+* ``init_timeout``  — the probe child hung past its budget (the
+                      wedged-tunnel signature: backend init never
+                      returns).
+* ``compile_error`` — devices were there but compilation/execution
+                      failed (XLA/Mosaic lowering errors).
+* ``transport``     — connection-level failures dialing the tunnel
+                      (refused/reset/unreachable/grpc deadline).
+* ``unknown``       — none of the signatures matched; the raw cause
+                      is always attached alongside the code.
+
+Stdlib-only: imported by the bench PARENT (which must never import
+jax — a wedged tunnel would hang the orchestrator) and by
+``tools/run_report.py`` (which must render on boxes without jax).
+"""
+
+from __future__ import annotations
+
+REASON_CODES = ("no_device", "init_timeout", "compile_error",
+                "transport", "unknown")
+
+# signature -> code, checked in order: the FIRST match wins, so the
+# more specific transport/compile signatures are tested before the
+# broad device-assert one
+_SIGNATURES = (
+    # the probe child hung past its timeout (bench.py writes this
+    # exact detail) or the subprocess layer timed out
+    (("hung > ", "timeoutexpired", "timed out", "deadline_exceeded",
+      "initialization timed out"), "init_timeout"),
+    # dialing the tunnel failed at the connection level
+    (("connection refused", "connection reset", "unreachable",
+      "failed to connect", "socket", "tunnel", "axon",
+      "grpc", "unavailable:", "broken pipe", "econnrefused"),
+     "transport"),
+    # devices came up; compiling/running the tiny program did not
+    (("xlaruntimeerror", "compile", "mosaic", "lowering",
+      "internal: ", "unimplemented"), "compile_error"),
+    # the probe's assert fired: jax fell back to CPU / saw no chips
+    (("platform != 'cpu'", "platform 'cpu'", "assertionerror",
+      "no devices", "device_count", "cpudevice",
+      "unable to initialize backend"), "no_device"),
+)
+
+
+def classify_probe_failure(detail: str) -> str:
+    """Raw probe stderr/assert tail -> one of :data:`REASON_CODES`."""
+    d = (detail or "").lower()
+    if not d.strip():
+        return "unknown"
+    for needles, code in _SIGNATURES:
+        if any(n in d for n in needles):
+            return code
+    return "unknown"
+
+
+if __name__ == "__main__":  # tiny manual check: classify stdin
+    import sys
+    print(classify_probe_failure(sys.stdin.read()))

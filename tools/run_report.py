@@ -27,6 +27,19 @@ import os
 import sys
 from typing import Any, Dict, List
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _classify_probe(detail: str) -> str:
+    """Reason-code fallback for probe records written before the
+    taxonomy existed (tools/probe_taxonomy.py)."""
+    try:
+        from tools.probe_taxonomy import classify_probe_failure
+        return classify_probe_failure(detail)
+    except Exception:
+        return "unknown"
+
 
 def load(path: str) -> List[Dict[str, Any]]:
     records = []
@@ -116,6 +129,24 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 
     probe_rec = _last(records, "probe")
 
+    # probe timeline: EVERY probe verdict in the file, classified —
+    # bench appends across rounds, so this is the round-over-round
+    # failure-mode history ROADMAP item 6 asks for
+    probe_history = []
+    for r in records:
+        if r.get("kind") != "probe":
+            continue
+        code = r.get("reason_code")
+        if code is None and r.get("verdict") != "ok":
+            code = _classify_probe(str(r.get("reason", "")))
+        probe_history.append({
+            "verdict": r.get("verdict"),
+            "reason_code": code,
+            "reason": str(r.get("reason", ""))[:120],
+            "cached": r.get("cached"),
+            "dur_s": r.get("dur_s"),
+            "wall_time": r.get("wall_time")})
+
     counters_all = end.get("counters") or {}
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
@@ -130,7 +161,9 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "hists": hists,
         "tpu_probe": None if probe_rec is None else {
             k: probe_rec.get(k) for k in
-            ("verdict", "reason", "dur_s", "cached", "cache_age_s")},
+            ("verdict", "reason", "reason_code", "dur_s", "cached",
+             "cache_age_s")},
+        "probe_history": probe_history,
         "jax_version": run.get("jax_version"),
         "config": run.get("config") or {},
         "iters": n_iters,
@@ -338,9 +371,33 @@ def render(records: List[Dict[str, Any]]) -> str:
         L.append(f"verdict={p.get('verdict')} "
                  f"cached={p.get('cached')}"
                  + (f" age_s={age}" if age is not None else "")
-                 + f" dur_s={p.get('dur_s')}")
+                 + f" dur_s={p.get('dur_s')}"
+                 + (f" reason_code={p['reason_code']}"
+                    if p.get("reason_code") else ""))
         if p.get("reason"):
             L.append(f"reason: {str(p['reason'])[:200]}")
+
+    hist = d.get("probe_history") or []
+    if len(hist) > 1:
+        L.append("")
+        L.append("== tpu probe timeline (all rounds in this trace) ==")
+        L.append(f"{'#':>3} {'verdict':<8}{'reason_code':<15}"
+                 f"{'cached':<7}{'dur_s':>7}  cause")
+        for i, p in enumerate(hist):
+            L.append(f"{i:>3} {str(p.get('verdict')):<8}"
+                     f"{str(p.get('reason_code') or '-'):<15}"
+                     f"{str(bool(p.get('cached'))):<7}"
+                     f"{p.get('dur_s') if p.get('dur_s') is not None else '-':>7}"
+                     f"  {str(p.get('reason', ''))[:60]}")
+        codes: Dict[str, int] = {}
+        for p in hist:
+            if p.get("reason_code"):
+                codes[p["reason_code"]] = \
+                    codes.get(p["reason_code"], 0) + 1
+        if codes:
+            L.append("failure modes: " + " ".join(
+                f"{k}={v}" for k, v in sorted(codes.items(),
+                                              key=lambda kv: -kv[1])))
     return "\n".join(L) + "\n"
 
 
@@ -438,6 +495,102 @@ def render_graftcheck(d: Dict[str, Any]) -> str:
 
 
 # ----------------------------------------------------------------------
+# Chrome-trace timelines (observability/tracing.py): the Perfetto-
+# loadable span export, summarized offline — per-category totals plus
+# the slowest requests' full span chains with their trace ids
+def load_chrome_trace(path: str):
+    """The whole-file JSON object when ``path`` is a Chrome trace
+    export (``{"traceEvents": [...]}``), else None."""
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if isinstance(obj, dict) and isinstance(obj.get("traceEvents"),
+                                            list):
+        return obj
+    return None
+
+
+def trace_digest(d: Dict[str, Any]) -> Dict[str, Any]:
+    events = [e for e in d.get("traceEvents", [])
+              if e.get("ph") == "X" and e.get("args")]
+    by_cat: Dict[str, Dict[str, float]] = {}
+    by_name: Dict[str, Dict[str, float]] = {}
+    traces: Dict[str, List[Dict]] = {}
+    for e in events:
+        for table, key in ((by_cat, e.get("cat") or "span"),
+                           (by_name, e.get("name") or "?")):
+            row = table.setdefault(key, {"count": 0, "total_us": 0.0})
+            row["count"] += 1
+            row["total_us"] += float(e.get("dur", 0.0))
+        tid = e["args"].get("trace_id")
+        if tid:
+            traces.setdefault(tid, []).append(e)
+    # roots: the request/iteration-level spans (no parent link)
+    roots = [e for e in events if e["args"].get("trace_id")
+             and not e["args"].get("parent_id")]
+    roots.sort(key=lambda e: -float(e.get("dur", 0.0)))
+    slowest = []
+    for e in roots[:5]:
+        tid = e["args"]["trace_id"]
+        chain = sorted(traces.get(tid, []),
+                       key=lambda ev: float(ev.get("ts", 0.0)))
+        slowest.append({
+            "trace_id": tid, "root": e.get("name"),
+            "dur_ms": round(float(e.get("dur", 0.0)) / 1000.0, 3),
+            "spans": [{
+                "name": ev.get("name"), "cat": ev.get("cat"),
+                "dur_ms": round(float(ev.get("dur", 0.0)) / 1000.0, 3),
+                "program": ev["args"].get("program"),
+                "queue_ms": ev["args"].get("queue_ms"),
+                "compute_ms": ev["args"].get("compute_ms"),
+            } for ev in chain]})
+    return {"events": len(events),
+            "traces": len(traces),
+            "dropped": (d.get("otherData") or {}).get("dropped_events"),
+            "by_cat": by_cat, "by_name": by_name, "slowest": slowest}
+
+
+def render_timeline(d: Dict[str, Any]) -> str:
+    t = trace_digest(d)
+    L = ["== span timeline (observability/tracing.py; load the file "
+         "in Perfetto for the visual form) ==",
+         f"events={t['events']} traces={t['traces']} "
+         f"dropped={t['dropped']}"]
+    L.append("")
+    L.append(f"{'category':<12}{'spans':>8}{'total_ms':>12}")
+    for cat, row in sorted(t["by_cat"].items(),
+                           key=lambda kv: -kv[1]["total_us"]):
+        L.append(f"{cat:<12}{row['count']:>8}"
+                 f"{row['total_us'] / 1000.0:>12.3f}")
+    L.append("")
+    L.append(f"{'span':<24}{'count':>8}{'total_ms':>12}{'mean_ms':>10}")
+    for name, row in sorted(t["by_name"].items(),
+                            key=lambda kv: -kv[1]["total_us"]):
+        mean = row["total_us"] / max(row["count"], 1) / 1000.0
+        L.append(f"{name:<24}{row['count']:>8}"
+                 f"{row['total_us'] / 1000.0:>12.3f}{mean:>10.3f}")
+    if t["slowest"]:
+        L.append("")
+        L.append("== slowest traces (root span -> chain) ==")
+        for s in t["slowest"]:
+            L.append(f"trace {s['trace_id']}  {s['root']}  "
+                     f"{s['dur_ms']:.3f} ms")
+            for sp in s["spans"]:
+                extra = ""
+                if sp.get("program"):
+                    extra += f" program={sp['program']}"
+                if sp.get("queue_ms") is not None:
+                    extra += f" queue_ms={sp['queue_ms']}"
+                if sp.get("compute_ms") is not None:
+                    extra += f" compute_ms={sp['compute_ms']}"
+                L.append(f"    {sp['name']:<22}"
+                         f"{sp['dur_ms']:>10.3f} ms{extra}")
+    return "\n".join(L) + "\n"
+
+
+# ----------------------------------------------------------------------
 # crash flight-recorder dumps (observability/flightrec.py)
 def load_crash(path: str):
     """The whole-file JSON object when ``path`` is a flight-recorder
@@ -479,6 +632,15 @@ def render_crash(d: Dict[str, Any]) -> str:
             desc = " ".join(f"{k}={v}" for k, v in sorted(t.items())
                             if k != "wall_time")
             L.append(f"  {desc}")
+    spans = d.get("trace_spans") or []
+    if spans:
+        L.append("")
+        L.append("== in-flight span stacks at trip time ==")
+        for s in spans:
+            L.append(f"  {s.get('name'):<24}"
+                     f"trace={s.get('trace_id')} "
+                     f"elapsed_ms={s.get('elapsed_ms')} "
+                     f"thread={s.get('thread')}")
     counters = d.get("counters") or {}
     rob = {k: v for k, v in counters.items()
            if k.startswith(("guard.", "checkpoint.", "retry.",
@@ -526,6 +688,13 @@ def main(argv: List[str]) -> int:
             print(json.dumps(crash))
         else:
             sys.stdout.write(render_crash(crash))
+        return 0
+    chrome = load_chrome_trace(args[0])
+    if chrome is not None:
+        if "--json" in argv:
+            print(json.dumps(trace_digest(chrome)))
+        else:
+            sys.stdout.write(render_timeline(chrome))
         return 0
     census = load_census(args[0])
     if census is not None:

@@ -101,6 +101,45 @@ def _build_fleet(args, workdir):
     return fleet, X, reload_sources
 
 
+def _http_probe(engine, X, n: int = 3):
+    """Send a few requests through the real HTTP frontend so the
+    exported timeline contains the FULL chain — http.predict ->
+    fleet/engine queue-wait -> batch -> named device program — not
+    just the in-process loadgen's spans. Best-effort: a bind failure
+    never kills the bench."""
+    import json as _json
+    import urllib.request
+
+    from lightgbm_tpu.serving.http import make_http_server
+    try:
+        server = make_http_server(engine, port=0)
+    except OSError:
+        return 0
+    import threading
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address[:2]
+    ok = 0
+    try:
+        for i in range(n):
+            body = _json.dumps(
+                {"rows": X[i % len(X):i % len(X) + 1].tolist()}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    if _json.loads(resp.read()).get("trace_id"):
+                        ok += 1
+            except OSError:
+                break
+    finally:
+        server.shutdown()
+        server.server_close()
+    return ok
+
+
 def _arm_sigterm(fleet, state):
     """SIGTERM mid-soak: flight-recorder dump + graceful drain; the
     soak block still prints (flagged preempted). The recorder arms
@@ -138,6 +177,11 @@ def main(argv=None) -> int:
     ap.add_argument("--rows", type=int, default=4000,
                     help="synthetic row pool when no --model data")
     ap.add_argument("--json", default="", help="write result JSON here")
+    ap.add_argument("--trace-out", default="",
+                    help="write the Chrome-trace span timeline here "
+                         "(Perfetto-loadable; every request's "
+                         "HTTP/fleet/queue/batch/device spans with "
+                         "trace ids — docs/Observability.md)")
     ap.add_argument("--append-bench", default="",
                     help="merge the serving block into this bench JSON")
     # fleet / soak knobs
@@ -173,9 +217,17 @@ def main(argv=None) -> int:
     import numpy as np
 
     import jax
+    from lightgbm_tpu.observability.tracing import get_tracer
     from lightgbm_tpu.serving import ServingConfig, ServingEngine
     from lightgbm_tpu.serving.loadgen import (closed_loop, open_loop,
                                               soak_loop)
+
+    if args.trace_out:
+        # the span timeline (request -> replica -> batch -> program)
+        # exports here; env (LGBM_TPU_TRACE) also arms it without the
+        # flag, through Telemetry.ensure_started
+        get_tracer().configure(path=args.trace_out)
+    tracer_on = get_tracer().enabled
 
     batch_sizes = [int(v) for v in args.batches.split(",") if v]
     fleet_mode = args.fleet or args.mode == "soak"
@@ -192,6 +244,8 @@ def main(argv=None) -> int:
         _arm_sigterm(engine, state)
         tenants = [t for t in args.tenants.split(",") if t] or None
         models = engine.fleet.names()
+        if tracer_on:
+            result["http_traced_requests"] = _http_probe(engine, X)
         block = soak_loop(
             engine, X, duration_s=args.duration, qps=args.qps,
             batch_sizes=batch_sizes, models=models, tenants=tenants,
@@ -232,6 +286,8 @@ def main(argv=None) -> int:
         cfg = ServingConfig(buckets=args.buckets, device=args.device)
         engine = ServingEngine(source, config=cfg)
         result["buckets"] = list(cfg.buckets)
+        if tracer_on:
+            result["http_traced_requests"] = _http_probe(engine, X)
         if args.mode in ("closed", "both"):
             result["closed"] = closed_loop(
                 engine, X, batch_sizes=batch_sizes,
@@ -246,6 +302,15 @@ def main(argv=None) -> int:
         head = result.get("closed") or result.get("open") or {}
         result["serving"] = head
 
+    tracer = get_tracer()
+    if tracer.enabled:
+        path = tracer.export()
+        if path:
+            result["trace_out"] = path
+            result["trace_events"] = len(tracer.events)
+            sys.stderr.write(f"serve_bench: span timeline -> {path} "
+                             f"({result['trace_events']} events; "
+                             "load in Perfetto)\n")
     print(json.dumps(result))
     if args.json:
         with open(args.json, "w") as f:
