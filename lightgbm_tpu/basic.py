@@ -746,13 +746,29 @@ class Booster:
                 key, _, val = tok.partition(":")
                 if key and val:
                     new_params.setdefault(key, val)
+        is_linear = any(getattr(t, "is_linear", False)
+                        for t in src.models)
+        raw = None
+        if is_linear:
+            # the per-leaf ridge coefficients are RE-FIT from the new
+            # labels (never silently dropped): the replay needs the
+            # ORIGINAL-index raw matrix, and the new Dataset keeps raw
+            # values like any linear_tree training set
+            new_params.setdefault("linear_tree", True)
+            raw = data
+            if _is_pandas_df(raw):
+                raw = _apply_pandas_categorical(
+                    raw, self.pandas_categorical)
+            else:
+                raw = _to_matrix(raw)
+            raw = np.asarray(raw, np.float64)
         train_set = Dataset(data, label=label)
         new_booster = Booster(new_params, train_set)
         getattr(src, "finalize_trees", lambda: None)()
         new_booster._gbdt.models = [copy.deepcopy(t) for t in src.models]
         new_booster._gbdt.iter = len(src.models) \
             // src.num_tree_per_iteration
-        new_booster._gbdt.refit(leaf_preds)
+        new_booster._gbdt.refit(leaf_preds, raw=raw)
         return new_booster
 
     # ------------------------------------------------------------------

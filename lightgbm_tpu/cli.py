@@ -21,6 +21,10 @@ file entries), and the tasks
     HTTP endpoint (``serving_host``/``serving_port``) with
     micro-batching and shape-bucketed compiled dispatch
     (lightgbm_tpu/serving/, docs/Serving.md).
+  * ``task=pipeline`` — the continuous refit-and-promote loop: serve
+    ``input_model`` from a fleet pool while tailing a log source,
+    refitting candidates, canary-ramping and auto-promoting them
+    (``pipeline_*`` params; lightgbm_tpu/pipeline/, docs/Pipeline.md).
 """
 
 from __future__ import annotations
@@ -277,6 +281,21 @@ def run_serve(params: Dict[str, str]) -> None:
     serve_forever(engine, cfg.serving_host, int(cfg.serving_port))
 
 
+def run_pipeline(params: Dict[str, str]) -> None:
+    """``task=pipeline``: the continuous refit-and-promote loop
+    (lightgbm_tpu/pipeline/, docs/Pipeline.md). Loads ``input_model``
+    as the production model, serves it from a fleet replica pool, and
+    then — forever (or for ``pipeline_cycles`` cycles) — tails the
+    log source for labeled windows, refits a checkpointed candidate,
+    publishes it into the fleet registry, ramps it through the
+    ``pipeline_canary_stages`` traffic splits with latency/quality/
+    parity/flight-recorder watchdogs, and promotes it (or rolls back
+    on regression). Preemption-safe: SIGTERM finishes the in-flight
+    cycle, drains the fleet, and exits cleanly."""
+    from .pipeline import run_pipeline as _run
+    _run(params)
+
+
 def run_convert_model(params: Dict[str, str]) -> None:
     """``task=convert_model``: model text -> standalone C++ if-else
     source (GBDT::ModelToIfElse, gbdt_model_text.cpp:117-299)."""
@@ -306,6 +325,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_refit(params)
     elif task == "serve":
         run_serve(params)
+    elif task == "pipeline":
+        run_pipeline(params)
     elif task == "convert_model":
         run_convert_model(params)
     else:

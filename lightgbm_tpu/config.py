@@ -146,6 +146,10 @@ _PARAM_ALIASES: Dict[str, str] = {
     "serving_shadow": "serving_shadow_model",
     "serving_quota_rate": "serving_quota_qps",
     "checkpoint_path": "checkpoint_dir", "ckpt_dir": "checkpoint_dir",
+    "pipeline_stages": "pipeline_canary_stages",
+    "pipeline_window": "pipeline_window_rows",
+    "pipeline_workdir": "pipeline_dir",
+    "pipeline_interval": "pipeline_interval_s",
     "checkpoint_period": "checkpoint_freq",
     "keep_checkpoints": "checkpoint_keep",
     "nonfinite_policy": "guard_policy", "guard": "guard_policy",
@@ -398,6 +402,30 @@ class Config:
     serving_canary_weight: float = 0.0
     serving_shadow_model: str = ""
 
+    # ---- pipeline task (lightgbm_tpu/pipeline/, docs/Pipeline.md) —
+    # the continuous refit-and-promote loop: a log source (replay
+    # stream or tailed serving-log JSONL) feeds labeled windows to a
+    # refit trainer; each candidate is checkpointed, published into
+    # the fleet registry, ramped through the canary stages and
+    # auto-promoted (or rolled back on latency/quality/parity/
+    # flight-recorder regression)
+    pipeline_mode: str = "refit"       # refit | continue
+    pipeline_source: str = "replay"    # replay | tail
+    pipeline_log_path: str = ""        # tail source JSONL path
+    pipeline_window_rows: int = 512    # rows per refit window
+    pipeline_holdout_rows: int = 256   # rows per quality holdout
+    pipeline_cycles: int = 0           # 0 = loop until preempted
+    pipeline_interval_s: float = 0.0   # idle wait between cycles
+    pipeline_dir: str = ""             # candidate checkpoint workdir
+    pipeline_canary_stages: List[float] = field(default_factory=list)
+    pipeline_stage_requests: int = 64  # watched requests per stage
+    pipeline_latency_slo_pct: float = 100.0  # canary p99 headroom %
+    pipeline_quality_drop: float = 0.02  # max holdout quality drop
+    pipeline_continue_iters: int = 10  # trees per continue-mode cycle
+    pipeline_replay_seed: int = 0      # replay stream seed
+    pipeline_replay_noise: float = 0.1  # replay label noise
+    pipeline_serve_http: bool = False  # serve HTTP during the loop
+
     # ---- objective (config.h:761-832)
     objective_seed: int = 5
     num_class: int = 1
@@ -608,6 +636,26 @@ class Config:
         if self.checkpoint_freq > 0 and not self.checkpoint_dir:
             log_warning("checkpoint_freq is set without checkpoint_dir; "
                         "no checkpoints will be written")
+        if self.pipeline_mode not in ("refit", "continue"):
+            raise ValueError(
+                f"pipeline_mode={self.pipeline_mode} must be refit or "
+                "continue")
+        if self.pipeline_source not in ("replay", "tail"):
+            raise ValueError(
+                f"pipeline_source={self.pipeline_source} must be "
+                "replay or tail")
+        for w in self.pipeline_canary_stages:
+            if not (0.0 < w <= 1.0):
+                raise ValueError("pipeline_canary_stages weights must "
+                                 f"be in (0, 1], got {w}")
+        if self.pipeline_quality_drop < 0 \
+                or self.pipeline_latency_slo_pct < 0:
+            raise ValueError("pipeline_quality_drop and "
+                             "pipeline_latency_slo_pct must be >= 0")
+        if self.pipeline_window_rows <= 0 \
+                or self.pipeline_holdout_rows <= 0:
+            raise ValueError("pipeline_window_rows and "
+                             "pipeline_holdout_rows must be > 0")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objectives")
         if self.objective not in ("multiclass", "multiclassova", "custom",

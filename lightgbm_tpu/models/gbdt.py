@@ -93,6 +93,72 @@ def _refit_tree(score, lp, grad, hess, old_leaf, shrink, decay, *,
     return score.at[:, tid].add(new_leaf[lp]), out
 
 
+@register_jit("refit_tree_linear", donate=(0,))
+@functools.partial(jax.jit,
+                   static_argnames=("nl", "tid", "l1", "l2", "mds",
+                                    "lam", "l2lin"),
+                   donate_argnums=(0,))
+def _refit_tree_linear(score, lp, grad, hess, raw, feats, old_leaf,
+                       old_const, old_coeff, shrink, decay, *,
+                       nl: int, tid: int, l1: float, l2: float,
+                       mds: float, lam: float, l2lin: float):
+    """Linear-leaf refit replay step: the constant refit output (the
+    fallback), PLUS a fresh per-leaf ridge solve over the leaf's
+    existing model features from the NEW labels' grad/hess — the
+    models/linear.py normal equations with the refit leaf assignment
+    ``lp`` standing in for the grow loop's leaf_id. The decayed leaf
+    model blends old and new like the constant path
+    (``decay*old + (1-decay)*new*shrink`` elementwise on const and
+    coeffs); a leaf whose new solve is gated (too few rows, singular,
+    exploding coefficients) decays toward the constant refit output
+    instead — with decay=1.0 the model is unchanged exactly.
+
+    Returns (score, (out, fit_const, fit_coeff, ok)); the host redoes
+    the blend in f64 on the tree arrays for model export."""
+    from ..ops.split import leaf_output_no_constraint
+    from .linear import kCoeffBound, kLinEps, linear_leaf_values
+    sum_g = jnp.zeros((nl,), jnp.float32).at[lp].add(grad)
+    sum_h = jnp.zeros((nl,), jnp.float32).at[lp].add(hess) + kEpsilon
+    out = leaf_output_no_constraint(sum_g, sum_h, l1, l2, mds)
+    new_leaf = decay * old_leaf + (1.0 - decay) * out * shrink
+    # ridge statistics (every row in-bag; NaN rows excluded like fit)
+    n = raw.shape[0]
+    c = feats.shape[1]
+    rows = jnp.arange(n)
+    ft = feats[lp]                                        # [N, C]
+    m = ft >= 0
+    x = raw[rows[:, None], jnp.clip(ft, 0, raw.shape[1] - 1)]
+    bad = ~jnp.isfinite(x) & m
+    row_ok = ~bad.any(axis=1)
+    xz = jnp.where(m & ~bad, x, 0.0)
+    w = hess * row_ok
+    gw = grad * row_ok
+    xb = jnp.concatenate([xz, jnp.ones((n, 1), xz.dtype)], axis=1)
+    outer = xb[:, :, None] * xb[:, None, :] * w[:, None, None]
+    a_mat = jax.ops.segment_sum(outer, lp, num_segments=nl)
+    b_vec = jax.ops.segment_sum(xb * gw[:, None], lp, num_segments=nl)
+    cnt = jax.ops.segment_sum(row_ok.astype(jnp.float32), lp,
+                              num_segments=nl)
+    active = feats >= 0                                    # [L, C]
+    diag = jnp.concatenate(
+        [jnp.where(active, jnp.float32(lam), jnp.float32(1.0)),
+         jnp.full((nl, 1), jnp.float32(l2lin) + jnp.float32(kLinEps))],
+        axis=1)
+    a_mat = a_mat + jnp.eye(c + 1, dtype=a_mat.dtype) * diag[:, None, :]
+    sol = -jnp.linalg.solve(a_mat, b_vec[..., None])[..., 0]
+    ca = active.sum(axis=1).astype(jnp.float32)
+    ok = (jnp.isfinite(sol).all(axis=1)
+          & (jnp.abs(sol) < kCoeffBound).all(axis=1)
+          & (cnt > ca) & (ca > 0))
+    fit_coeff = jnp.where(ok[:, None], sol[:, :c], 0.0)
+    fit_const = jnp.where(ok, sol[:, c], out)
+    bc = decay * old_const + (1.0 - decay) * fit_const * shrink
+    bw = decay * old_coeff + (1.0 - decay) * fit_coeff * shrink
+    score = score.at[:, tid].add(linear_leaf_values(
+        lp, raw, new_leaf, bc, bw, feats))
+    return score, (out, fit_const, fit_coeff, ok)
+
+
 # ----------------------------------------------------------------------
 # Device bagging (gbdt.cpp:163-243 BaggingHelper, re-keyed): the mask
 # is a pure function of (bagging_seed, iteration), drawn with
@@ -672,12 +738,23 @@ class GBDT:
             self.valid_scores[i] = self.valid_scores[i] + jnp.asarray(va)
 
     # ------------------------------------------------------------------
-    def refit(self, leaf_preds: np.ndarray) -> None:
+    def refit(self, leaf_preds: np.ndarray,
+              raw: Optional[np.ndarray] = None) -> None:
         """RefitTree (gbdt.cpp:266-289) + FitByExistingTree
         (serial_tree_learner.cpp:194-224): keep every tree's structure,
         refit leaf values on THIS booster's train data by sequential
         replay — per iteration, gradients at the current score, per-leaf
         sums, ``decay*old + (1-decay)*new_output*shrinkage``.
+
+        ``linear_tree`` models refit their per-leaf ridge coefficients
+        too (``_refit_tree_linear``): each leaf's existing model
+        features get a fresh normal-equations solve from the new
+        labels' grad/hess, blended by the same decay rule — the
+        coefficients are never silently dropped. ``raw`` is the
+        ORIGINAL-index raw feature matrix of the refit data
+        (``Booster.refit`` passes it); without it the booster's own
+        training dataset must carry the inner-index raw matrix, else a
+        clear error is raised.
 
         Device-resident replay: gradients, per-leaf sums and score
         updates stay on device (one jitted program per tree, score
@@ -690,12 +767,25 @@ class GBDT:
         every existing tree (from ``predict(..., pred_leaf=True)``).
         """
         self.finalize_trees()
+        raw_dev = None
+        use_inner = False
         if any(getattr(t, "is_linear", False) for t in self.models):
-            log_warning("refit keeps tree structures but drops the "
-                        "leaf linear models (constant-leaf refit)")
-            for t in self.models:
-                if getattr(t, "is_linear", False):
-                    t.clear_linear()
+            if raw is not None:
+                raw_dev = jnp.asarray(np.asarray(raw, np.float32))
+            elif self.train_data is not None \
+                    and self.train_data.raw_numeric is not None:
+                raw_dev = self.train_data.raw_numeric_device
+                use_inner = True
+            else:
+                from ..utils.log import LightGBMError
+                raise LightGBMError(
+                    "refit_linear_raw_missing: refit of a "
+                    "linear_tree=true model must re-fit the per-leaf "
+                    "linear coefficients, which needs the raw feature "
+                    "matrix of the refit data; pass raw= (Booster."
+                    "refit does) or construct the training Dataset "
+                    "with linear_tree=true so it keeps raw values — "
+                    "refusing to silently drop leaf coefficients")
         k = self.num_tree_per_iteration
         cfg = self.config
         decay = float(cfg.refit_decay_rate)
@@ -711,7 +801,7 @@ class GBDT:
         # sequential replay starts from the init score (the reference's
         # merged booster has an untouched score updater)
         self.train_score = jnp.zeros_like(self.train_score)
-        pending = []  # (tree, device refit output)
+        pending = []  # (tree, device refit output, linear feats|None)
         for it in range(n_iters):
             sc = self.train_score if k > 1 else self.train_score[:, 0]
             grad, hess = self._grad_fn(sc)
@@ -725,22 +815,67 @@ class GBDT:
                     tree = tree.materialize()
                     self.models[mi] = tree
                 nl = max(tree.num_leaves, 1)
-                self.train_score, out = _refit_tree(
-                    self.train_score, lp_dev[:, mi], grad[:, tid],
-                    hess[:, tid],
-                    jnp.asarray(tree.leaf_value, jnp.float32),
-                    jnp.float32(tree.shrinkage), jnp.float32(decay),
-                    nl=nl, tid=tid, l1=float(cfg.lambda_l1),
-                    l2=float(cfg.lambda_l2),
-                    mds=float(cfg.max_delta_step))
-                pending.append((tree, out))
+                if getattr(tree, "is_linear", False):
+                    feats = np.asarray(
+                        tree.leaf_features_inner if use_inner
+                        else tree.leaf_features, np.int32)
+                    self.train_score, out = _refit_tree_linear(
+                        self.train_score, lp_dev[:, mi], grad[:, tid],
+                        hess[:, tid], raw_dev, jnp.asarray(feats),
+                        jnp.asarray(tree.leaf_value, jnp.float32),
+                        jnp.asarray(tree.leaf_const, jnp.float32),
+                        jnp.asarray(tree.leaf_coeff, jnp.float32),
+                        jnp.float32(tree.shrinkage),
+                        jnp.float32(decay),
+                        nl=nl, tid=tid, l1=float(cfg.lambda_l1),
+                        l2=float(cfg.lambda_l2),
+                        mds=float(cfg.max_delta_step),
+                        lam=float(cfg.linear_lambda),
+                        l2lin=float(cfg.lambda_l2))
+                    pending.append((tree, out, feats))
+                else:
+                    self.train_score, out = _refit_tree(
+                        self.train_score, lp_dev[:, mi], grad[:, tid],
+                        hess[:, tid],
+                        jnp.asarray(tree.leaf_value, jnp.float32),
+                        jnp.float32(tree.shrinkage), jnp.float32(decay),
+                        nl=nl, tid=tid, l1=float(cfg.lambda_l1),
+                        l2=float(cfg.lambda_l2),
+                        mds=float(cfg.max_delta_step))
+                    pending.append((tree, out, None))
         get_telemetry().count("host.syncs")
-        outs = jax.device_get([o for _, o in pending])  # ONE fetch
-        for (tree, _), out in zip(pending, outs):
+        outs = jax.device_get([o for _, o, _ in pending])  # ONE fetch
+        for (tree, _, feats), out in zip(pending, outs):
+            if feats is None:
+                tree.leaf_value = (decay * tree.leaf_value
+                                   + (1.0 - decay)
+                                   * np.asarray(out, np.float64)
+                                   * tree.shrinkage)
+                continue
+            # linear tree: redo the f32 device blend in f64 on the
+            # exported arrays (same rule as the constant leaf_value).
+            # everything here is HOST data already — the whole pending
+            # list went through the single batched device_get above
+            o, fit_const, fit_coeff, ok = out
+            o64 = np.asarray(o, np.float64)
+            okh = np.asarray(ok, bool)  # graftlint: allow[GL105]
+            shrink = tree.shrinkage
             tree.leaf_value = (decay * tree.leaf_value
-                               + (1.0 - decay)
-                               * np.asarray(out, np.float64)
-                               * tree.shrinkage)
+                               + (1.0 - decay) * o64 * shrink)
+            fc64 = np.asarray(fit_const,  # graftlint: allow[GL105]
+                              np.float64)
+            fw64 = np.asarray(fit_coeff,  # graftlint: allow[GL105]
+                              np.float64)
+            target_c = np.where(okh, fc64, o64)
+            const = decay * tree.leaf_const \
+                + (1.0 - decay) * target_c * shrink
+            coeff = decay * tree.leaf_coeff \
+                + (1.0 - decay) * np.where(okh[:, None], fw64,
+                                           0.0) * shrink
+            get_telemetry().count("refit.linear_trees")
+            tree.set_linear(
+                feats, coeff, const,
+                dataset=self.train_data if use_inner else None)
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:

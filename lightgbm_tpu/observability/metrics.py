@@ -179,6 +179,9 @@ class MetricsRegistry:
         # a p99 number to the end-to-end timeline of the request behind
         # it (docs/Observability.md "Tracing")
         self._exemplars: Dict[Tuple[str, Labels], Dict[str, Any]] = {}
+        # labeled gauges set explicitly (collectors can only export
+        # bare names): e.g. lgbm_pipeline_stage{stage="canary"}
+        self._gauges: Dict[Tuple[str, Labels], float] = {}
         self.include_memory = True
 
     # -- histograms ----------------------------------------------------
@@ -214,6 +217,28 @@ class MetricsRegistry:
             snap["labels"] = dict(labels)
             out.append(snap)
         return out
+
+    # -- labeled gauges ------------------------------------------------
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, Any]] = None) -> None:
+        """Set a labeled gauge series (rendered in the gauge section;
+        unlike collectors, the label set rides the exposition)."""
+        with self._lock:
+            self._gauges[(str(name), _labels_key(labels))] = float(value)
+
+    def clear_gauge(self, name: str) -> None:
+        """Drop every series of a labeled gauge (e.g. before setting
+        the one active ``lgbm_pipeline_stage`` stage)."""
+        with self._lock:
+            for key in [k for k in self._gauges if k[0] == str(name)]:
+                del self._gauges[key]
+
+    def labeled_gauges(self, prefix: str = "") -> Dict[str, float]:
+        with self._lock:
+            items = list(self._gauges.items())
+        return {f"{name}{_label_str(labels)}": v
+                for (name, labels), v in sorted(items)
+                if not prefix or name.startswith(prefix)}
 
     # -- exemplars -----------------------------------------------------
     def exemplar_max(self, name: str, value: float,
@@ -310,6 +335,17 @@ class MetricsRegistry:
             L.append(f"# TYPE {mn} gauge")
             L.append(f"{mn} {_fmt(numeric_gauges[mn])}")
 
+        with self._lock:
+            labeled = sorted(self._gauges.items())
+        lg_typed: set = set()
+        for (name, labels), v in labeled:
+            base = _metric_name(name)
+            if base not in lg_typed:
+                lg_typed.add(base)
+                L.append(f"# HELP {base} gauge")
+                L.append(f"# TYPE {base} gauge")
+            L.append(f"{base}{_label_str(labels)} {_fmt(v)}")
+
         for name in sorted(dists):
             n, s, mn_v, mx_v = dists[name]
             base = _metric_name(name)
@@ -369,6 +405,7 @@ class MetricsRegistry:
             self._hists.clear()
             self._collectors.clear()
             self._exemplars.clear()
+            self._gauges.clear()
             self.include_memory = True
 
 

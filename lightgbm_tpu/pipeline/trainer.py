@@ -1,0 +1,164 @@
+"""Refit trainer: labeled windows -> checkpointed candidate models.
+
+Two modes, both warm-started from the current production model:
+
+* ``refit`` — keep every tree's structure and refit the leaf values
+  (and, for ``linear_tree`` models, the per-leaf ridge coefficients)
+  on the window via :meth:`Booster.refit` — one fully deterministic
+  device replay, the communication-light update that makes the loop
+  cheap enough to run continuously. Byte-stable: the same base model
+  and the same window always produce the same candidate text (the
+  drill's promoted-vs-direct-retrain parity gate).
+* ``continue`` — continued training (``init_from_models`` through
+  ``engine.train(init_model=...)``): grow ``continue_iters`` new trees
+  on the window on top of the production model.
+
+Every candidate is checkpointed through
+``robustness/checkpoint.py`` (atomic temp+fsync+rename, manifest
+digests, keep-last-K) before it is ever published, so a crashed
+pipeline process never loses a candidate it already paid to train.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..observability.telemetry import get_telemetry
+from ..observability.tracing import get_tracer
+from ..utils.log import log_info
+from .logsource import LabeledWindow
+
+MODES = ("refit", "continue")
+
+
+class Candidate:
+    """One refit candidate moving through the pipeline."""
+
+    STATUSES = ("candidate", "published", "promoted", "rejected",
+                "rolled_back")
+
+    def __init__(self, cid: int, model_text: str, mode: str,
+                 window_index: int, booster=None):
+        self.cid = int(cid)
+        self.model_text = model_text
+        self.mode = mode
+        self.window_index = int(window_index)
+        self.booster = booster
+        self.created_at = time.time()
+        self.status = "candidate"
+        self.reason = ""
+        self.name: Optional[str] = None       # fleet registry name
+        self.version: Optional[int] = None    # registry version id
+        self.checkpoint_path: Optional[str] = None
+
+    def mark(self, status: str, reason: str = "") -> None:
+        self.status = status
+        self.reason = reason
+
+    def describe(self) -> Dict[str, Any]:
+        return {"candidate": self.cid, "mode": self.mode,
+                "window": self.window_index, "status": self.status,
+                "reason": self.reason, "name": self.name,
+                "version": self.version,
+                "checkpoint": self.checkpoint_path}
+
+
+class RefitTrainer:
+    """Consumes labeled windows, emits checkpointed candidates."""
+
+    def __init__(self, model_text: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 mode: str = "refit", decay: float = 0.9,
+                 continue_iters: int = 10,
+                 checkpoint_dir: str = "", checkpoint_keep: int = 3):
+        if mode not in MODES:
+            raise ValueError(
+                f"pipeline_mode must be one of {MODES}, got {mode!r}")
+        self._model_text = model_text
+        self.params = dict(params or {})
+        self.mode = mode
+        self.decay = float(decay)
+        self.continue_iters = int(continue_iters)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_keep = int(checkpoint_keep)
+        self._next_cid = 1
+
+    @property
+    def current_model_text(self) -> str:
+        """The model the next candidate warm-starts from (advanced by
+        :meth:`note_promoted`)."""
+        return self._model_text
+
+    def note_promoted(self, candidate: Candidate) -> None:
+        self._model_text = candidate.model_text
+
+    # ------------------------------------------------------------------
+    def refit(self, window: LabeledWindow) -> Candidate:
+        """One candidate from one window; see module docstring."""
+        from ..basic import Booster
+        tel = get_telemetry()
+        cid = self._next_cid
+        self._next_cid += 1
+        with get_tracer().span("pipeline.refit", cat="pipeline",
+                               args={"candidate": cid,
+                                     "mode": self.mode,
+                                     "window": window.index,
+                                     "rows": window.rows}):
+            with tel.span("pipeline.refit"):
+                if self.mode == "refit":
+                    base = Booster(model_str=self._model_text)
+                    booster = base.refit(window.X, window.y,
+                                         decay_rate=self.decay)
+                else:
+                    booster = self._continue(window)
+        cand = Candidate(cid, booster.model_to_string(), self.mode,
+                         window.index, booster=booster)
+        tel.count("pipeline.candidates")
+        self._checkpoint(cand)
+        log_info(f"pipeline: candidate {cid} ({self.mode}) from "
+                 f"window {window.index} ({window.rows} rows)"
+                 + (f", checkpointed at {cand.checkpoint_path}"
+                    if cand.checkpoint_path else ""))
+        return cand
+
+    def _continue(self, window: LabeledWindow):
+        from .. import engine
+        from ..basic import Booster, Dataset
+        params = {k: v for k, v in self.params.items()
+                  if not str(k).startswith(("pipeline_", "serving_"))
+                  and k not in ("task", "input_model", "output_model",
+                                "data", "config", "num_iterations")}
+        init = Booster(model_str=self._model_text)
+        return engine.train(
+            params, Dataset(window.X, label=window.y),
+            num_boost_round=self.continue_iters,
+            init_model=init, verbose_eval=False)
+
+    def _checkpoint(self, cand: Candidate) -> None:
+        """Atomic candidate checkpoint (robustness/checkpoint.py) under
+        ``<checkpoint_dir>/cand_<id>/`` — model text + training state
+        + digest manifest, keep-last-K over candidate directories."""
+        if not self.checkpoint_dir:
+            return
+        from ..robustness.checkpoint import CheckpointManager
+        path = os.path.join(self.checkpoint_dir, f"cand_{cand.cid:05d}")
+        mgr = CheckpointManager(path, freq=0, keep=1)
+        cand.checkpoint_path = mgr.save(cand.booster, [], 0)
+        get_telemetry().count("pipeline.candidate_checkpoints")
+        self._retain_candidates()
+
+    def _retain_candidates(self) -> None:
+        if not os.path.isdir(self.checkpoint_dir):
+            return
+        dirs: List[str] = sorted(
+            d for d in os.listdir(self.checkpoint_dir)
+            if d.startswith("cand_"))
+        import shutil
+        for stale in dirs[:-max(self.checkpoint_keep, 1)]:
+            shutil.rmtree(os.path.join(self.checkpoint_dir, stale),
+                          ignore_errors=True)
+
+
+__all__ = ["Candidate", "RefitTrainer", "MODES"]

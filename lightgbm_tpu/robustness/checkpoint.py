@@ -81,6 +81,17 @@ _FINGERPRINT_EXCLUDE = frozenset({
     "serving_host", "serving_port", "serving_buckets",
     "serving_max_queue", "serving_flush_ms", "serving_timeout_ms",
     "serving_shed_policy", "serving_device", "serving_warmup",
+    "serving_replicas", "serving_models", "serving_max_pending",
+    "serving_quota_qps", "serving_quota_burst",
+    "serving_quota_tenants", "serving_canary_model",
+    "serving_canary_weight", "serving_shadow_model",
+    "pipeline_mode", "pipeline_source", "pipeline_log_path",
+    "pipeline_window_rows", "pipeline_holdout_rows",
+    "pipeline_cycles", "pipeline_interval_s", "pipeline_dir",
+    "pipeline_canary_stages", "pipeline_stage_requests",
+    "pipeline_latency_slo_pct", "pipeline_quality_drop",
+    "pipeline_continue_iters", "pipeline_replay_seed",
+    "pipeline_replay_noise", "pipeline_serve_http",
     "num_threads",
 })
 

@@ -26,6 +26,12 @@ Supported kinds and their args:
 * ``fail_read@times=K[,match=SUBSTR]`` — the first ``K`` guarded file
   reads whose path contains ``SUBSTR`` (all reads when omitted) raise
   ``OSError`` (exercises the retry/backoff wrappers).
+* ``drift@window=K[,shift=V,feature=J,flip=P,once=1]`` — from
+  replay-stream window ``K`` on, the pipeline log source draws
+  drifted data: feature ``J``'s mean shifts by ``V`` and/or labels
+  flip with probability ``P``; ``once=1`` poisons only window ``K``
+  (``lightgbm_tpu/pipeline/logsource.py`` — the continuous-refit
+  drill's deterministic drift injection).
 
 Every event fires a bounded number of times (``times``, default 1 —
 ``nth``-style events always once) and is *consumed*: reruns inside the
@@ -44,7 +50,8 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.log import log_warning
 
-_KNOWN_KINDS = ("nan_grad", "sigterm", "torn_checkpoint", "fail_read")
+_KNOWN_KINDS = ("nan_grad", "sigterm", "torn_checkpoint", "fail_read",
+                "drift")
 
 
 class Fault:
@@ -67,6 +74,9 @@ class Fault:
                 return False
         if "nth" in self.params:
             if int(ctx.get("nth", -1)) != int(self.params["nth"]):
+                return False
+        if "window" in self.params:
+            if int(ctx.get("window", -1)) != int(self.params["window"]):
                 return False
         match = str(self.params.get("match", ""))
         if match and match not in str(ctx.get("path", "")):

@@ -332,6 +332,11 @@ class FleetEngine:
             or int(self.config.max_queue) * max(int(replicas), 1)
         self._stopping = False
         self._counts: Dict[str, float] = {}
+        # the last FAILED model publish (None when the most recent
+        # load of every model succeeded): the continuous-refit ramp
+        # controller treats this as a hard abort — a candidate whose
+        # publish was rejected must never sit in canary
+        self._last_reload_error: Optional[Dict[str, Any]] = None
         self._lat_by_label: Dict[Tuple[str, str], int] = {}
         self._shadow_q: "queue.Queue" = queue.Queue(maxsize=512)
         self._shadow_thread: Optional[threading.Thread] = None
@@ -398,11 +403,29 @@ class FleetEngine:
         whole pool — replicas share the version's pinned arrays and
         the compiled programs."""
         pin = self.config.device != "never"
-        mv = self.fleet.load(name, source, pin_device=pin)
-        rep = self._pick_replica(allow_none=True)
-        if rep is not None and self.config.warmup:
-            rep.engine_for(name)._warmup(mv)
+        try:
+            mv = self.fleet.load(name, source, pin_device=pin)
+            rep = self._pick_replica(allow_none=True)
+            if rep is not None and self.config.warmup:
+                rep.engine_for(name)._warmup(mv)
+        except Exception as e:
+            # a rejected publish (torn model file, integrity failure,
+            # warmup crash) keeps every previous version serving and
+            # flags the fleet degraded until a load succeeds —
+            # surfaced in health() for the pipeline ramp controller
+            self._last_reload_error = {
+                "error": str(e),
+                "code": getattr(e, "code", type(e).__name__),
+                "model": name,
+                "source": str(source)[:256],
+                "at": time.time(),
+            }
+            self._count("reload_failures")
+            log_warning(f"serving fleet: publish of model {name!r} "
+                        f"failed (previous versions keep serving): {e}")
+            raise
         self.fleet.activate(name, mv)
+        self._last_reload_error = None
         self._count("reloads")
         return mv.version
 
@@ -796,9 +819,12 @@ class FleetEngine:
             status = "no_replicas"
         elif not models or all(v is None for v in models.values()):
             status = "no_model"
-        elif len(ok) < len(reps):
+        elif len(ok) < len(reps) or self._last_reload_error is not None:
+            # degraded-but-serving: a replica is down, or the last
+            # model publish was rejected (previous versions keep
+            # serving; the ramp controller aborts on this)
             status = "degraded"
-        return {
+        out = {
             "status": status,
             "fleet": True,
             "pending": pending,
@@ -809,6 +835,9 @@ class FleetEngine:
             "router": self.router.describe(),
             "quotas": self.quotas.describe(),
         }
+        if self._last_reload_error is not None:
+            out["last_reload_error"] = dict(self._last_reload_error)
+        return out
 
     # ServingEngine-compat surface used by http.py / loadgen
     @property

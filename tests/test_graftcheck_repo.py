@@ -3,7 +3,7 @@
 Builds EVERY registered jit entry point at the fixed tiny config in
 one shared pass and checks the contracts against the committed
 manifest — the same sweep CI's ``graftcheck`` job runs. Marked slow
-(31 programs, ~60 s of compiles) so the tier-1 budgeted run keeps its
+(32 programs, ~60 s of compiles) so the tier-1 budgeted run keeps its
 870 s envelope — the fast halves (fixture detection, manifest/builder
 coverage, GL506 registration enforcement) run un-marked in
 tests/test_graftcheck.py and tests/test_graftlint_repo.py, and CI's

@@ -1,0 +1,373 @@
+"""Continuous refit-and-promote pipeline (lightgbm_tpu/pipeline/).
+
+Fast halves (no engine): the PURE promote/rollback decision logic fed
+synthetic metric streams — clean pass, latency regression, quality
+regression, parity mismatch, flight-recorder trip, degraded fleet
+health — plus log-source determinism/drift and the stage gauge.
+
+Slow halves (train + fleet): trainer/publisher/ramp against a live
+FleetEngine, including the rejected-publish abort and a full driver
+cycle. CI's ``pipeline-drill`` job additionally runs the end-to-end
+drill (``tools/pipeline_drill.py``) on every PR.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.observability.metrics import get_metrics, metrics_text
+from lightgbm_tpu.pipeline import (LabeledWindow, ReplayLogSource,
+                                   TailLogSource, evaluate_stage)
+from lightgbm_tpu.pipeline.ramp import (RampThresholds, StageMetrics,
+                                        set_stage)
+from lightgbm_tpu.robustness.faults import set_fault_plan
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    set_fault_plan(None)
+    yield
+    set_fault_plan(None)
+    # never leak the stage gauge into other test modules' scrapes
+    get_metrics().clear_gauge("pipeline_stage")
+
+
+# ----------------------------------------------------------------------
+# promote/rollback decision logic: pure unit over synthetic streams
+def _clean_metrics(**over):
+    m = StageMetrics(stage=0, weight=0.25, requests=64,
+                     canary_requests=16,
+                     canary_p99_ms=12.0, baseline_p99_ms=11.0,
+                     canary_quality=-0.05, baseline_quality=-0.06,
+                     parity_mismatches=0, flightrec_trips=0,
+                     errors=0, health_status="ok")
+    for k, v in over.items():
+        setattr(m, k, v)
+    return m
+
+
+def test_clean_stage_advances():
+    v = evaluate_stage(_clean_metrics())
+    assert v.decision == "advance" and not v.reasons and v.ok
+
+
+def test_latency_regression_rolls_back():
+    th = RampThresholds(latency_regression_pct=50.0)
+    v = evaluate_stage(
+        _clean_metrics(canary_p99_ms=30.0, baseline_p99_ms=10.0), th)
+    assert v.decision == "rollback"
+    assert any(r.startswith("latency_p99") for r in v.reasons)
+
+
+def test_latency_under_floor_never_trips():
+    # micro-benchmark noise below the absolute floor is not a signal
+    th = RampThresholds(latency_regression_pct=10.0,
+                        latency_floor_ms=5.0)
+    v = evaluate_stage(
+        _clean_metrics(canary_p99_ms=3.0, baseline_p99_ms=0.5), th)
+    assert v.ok
+
+
+def test_quality_regression_rolls_back():
+    th = RampThresholds(quality_drop=0.02)
+    v = evaluate_stage(
+        _clean_metrics(canary_quality=-0.20, baseline_quality=-0.05),
+        th)
+    assert v.decision == "rollback"
+    assert any(r.startswith("quality_drop") for r in v.reasons)
+    # a drop inside the budget advances
+    v2 = evaluate_stage(
+        _clean_metrics(canary_quality=-0.06, baseline_quality=-0.05),
+        th)
+    assert v2.ok
+
+
+def test_parity_mismatch_rolls_back():
+    v = evaluate_stage(_clean_metrics(parity_mismatches=1))
+    assert v.decision == "rollback"
+    assert any(r.startswith("serving_parity") for r in v.reasons)
+
+
+def test_flight_recorder_trip_rolls_back():
+    v = evaluate_stage(_clean_metrics(flightrec_trips=1))
+    assert v.decision == "rollback"
+    assert any(r.startswith("flight_recorder") for r in v.reasons)
+
+
+def test_degraded_health_is_hard_abort():
+    v = evaluate_stage(_clean_metrics(
+        health_status="degraded",
+        last_reload_error={"code": "torn_model", "error": "x"}))
+    assert v.decision == "rollback"
+    assert any(r.startswith("fleet_health:degraded") for r in v.reasons)
+    assert any("torn_model" in r for r in v.reasons)
+    # a lingering last_reload_error alone also aborts
+    v2 = evaluate_stage(_clean_metrics(
+        last_reload_error={"code": "torn_model"}))
+    assert v2.decision == "rollback"
+
+
+def test_error_rate_rolls_back():
+    v = evaluate_stage(_clean_metrics(errors=3))
+    assert v.decision == "rollback"
+    assert any(r.startswith("error_rate") for r in v.reasons)
+
+
+def test_missing_samples_never_trip():
+    v = evaluate_stage(StageMetrics(requests=8))
+    assert v.ok
+
+
+def test_multiple_regressions_all_reported():
+    th = RampThresholds(quality_drop=0.01,
+                        latency_regression_pct=10.0)
+    v = evaluate_stage(_clean_metrics(
+        canary_p99_ms=100.0, baseline_p99_ms=10.0,
+        canary_quality=-0.5, parity_mismatches=2), th)
+    assert v.decision == "rollback" and len(v.reasons) == 3
+
+
+# ----------------------------------------------------------------------
+# replay log source: determinism + drift via the fault grammar
+def test_replay_source_is_deterministic():
+    a = ReplayLogSource(n_features=6, seed=9)
+    b = ReplayLogSource(n_features=6, seed=9)
+    for _ in range(3):
+        wa, wb = a.next_window(64), b.next_window(64)
+        np.testing.assert_array_equal(wa.X, wb.X)
+        np.testing.assert_array_equal(wa.y, wb.y)
+    c = ReplayLogSource(n_features=6, seed=10)
+    assert not np.array_equal(c.next_window(64).X,
+                              ReplayLogSource(6, 9).next_window(64).X)
+
+
+def test_replay_drift_shift_fires_and_persists():
+    set_fault_plan("drift@window=1,shift=2.0,feature=1")
+    src = ReplayLogSource(n_features=4, seed=0)
+    clean = src.next_window(256)
+    assert clean.drift is None
+    drifted = src.next_window(256)
+    assert drifted.drift and drifted.drift["shift"] == 2.0
+    later = src.next_window(256)                  # drift persists
+    assert later.drift
+    base = ReplayLogSource(n_features=4, seed=0)
+    b0 = base.next_window(256)
+    np.testing.assert_array_equal(clean.X, b0.X)  # pre-drift identical
+    b1 = base.next_window(256)
+    assert abs(drifted.X[:, 1].mean() - (b1.X[:, 1].mean() + 2.0)) \
+        < 0.25
+
+
+def test_replay_drift_flip_once_disarms():
+    set_fault_plan("drift@window=0,flip=1.0,once=1")
+    src = ReplayLogSource(n_features=4, seed=3)
+    poisoned = src.next_window(128)
+    assert poisoned.drift and poisoned.drift["flip"] == 1.0
+    after = src.next_window(128)
+    assert after.drift is None                    # once=1 disarmed
+    clean = ReplayLogSource(n_features=4, seed=3).peek_window(0, 128)
+    np.testing.assert_array_equal(poisoned.y, 1.0 - clean.y)
+
+
+def test_replay_peek_window_reproduces_in_band_draw():
+    src = ReplayLogSource(n_features=4, seed=1)
+    w0 = src.next_window(64)
+    again = ReplayLogSource(n_features=4, seed=1).peek_window(0, 64)
+    np.testing.assert_array_equal(w0.X, again.X)
+    np.testing.assert_array_equal(w0.y, again.y)
+
+
+def test_tail_source_reads_appended_windows(tmp_path):
+    path = str(tmp_path / "serving_log.jsonl")
+    with open(path, "w") as fh:
+        for i in range(5):
+            fh.write(json.dumps({"x": [float(i), 1.0], "y": i % 2})
+                     + "\n")
+        fh.write("not json\n")                    # skipped, not fatal
+        fh.write(json.dumps({"x": [9.0], "y": 1}) + "\n")  # bad width
+    src = TailLogSource(path, n_features=2, wait_s=0.2)
+    w = src.next_window(3)
+    assert isinstance(w, LabeledWindow) and w.rows == 3
+    np.testing.assert_array_equal(w.X[:, 0], [0.0, 1.0, 2.0])
+    w2 = src.next_window(10)                      # partial remainder
+    assert w2.rows == 2
+    assert src.next_window(1) is None             # drained
+
+
+# ----------------------------------------------------------------------
+# the stage gauge: lgbm_pipeline_stage{stage} on /metrics
+def test_stage_gauge_is_one_hot_labeled():
+    get_metrics().reset()
+    set_stage("refit")
+    set_stage("canary_25")
+    text = metrics_text()
+    assert 'lgbm_pipeline_stage{stage="canary_25"} 1' in text
+    assert 'stage="refit"' not in text            # one-hot
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("lgbm_pipeline_stage")]
+    assert len(lines) == 1
+    get_metrics().reset()
+
+
+# ======================================================================
+# engine-backed halves (train + fleet): slow-marked — CI's full suite
+# and the pipeline-drill job run them on every PR
+@pytest.fixture(scope="module")
+def base_model():
+    import lightgbm_tpu as lgb
+    src = ReplayLogSource(n_features=8, seed=21)
+    w = src.next_window(500)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(w.X, label=w.y),
+                    num_boost_round=5)
+    return bst.model_to_string()
+
+
+def _fleet(text, replicas=1):
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.serving import FleetEngine, ServingConfig
+    return FleetEngine(
+        models={"default": Booster(model_str=text)},
+        config=ServingConfig(buckets=(1, 64, 512),
+                             flush_interval_ms=0.5),
+        replicas=replicas)
+
+
+@pytest.mark.slow
+def test_trainer_refit_is_deterministic_and_checkpointed(base_model,
+                                                         tmp_path):
+    from lightgbm_tpu.pipeline import RefitTrainer
+    src = ReplayLogSource(n_features=8, seed=21)
+    win = src.next_window(256)
+    t1 = RefitTrainer(base_model, mode="refit", decay=0.3,
+                      checkpoint_dir=str(tmp_path / "cands"))
+    t2 = RefitTrainer(base_model, mode="refit", decay=0.3)
+    c1, c2 = t1.refit(win), t2.refit(win)
+    assert c1.model_text == c2.model_text       # byte-stable
+    assert c1.checkpoint_path and os.path.exists(c1.checkpoint_path)
+    assert os.path.exists(os.path.join(c1.checkpoint_path,
+                                       "manifest.json"))
+
+
+@pytest.mark.slow
+def test_trainer_continue_mode_grows_trees(base_model):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.pipeline import RefitTrainer
+    src = ReplayLogSource(n_features=8, seed=22)
+    win = src.next_window(256)
+    tr = RefitTrainer(base_model,
+                      params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1},
+                      mode="continue", continue_iters=3)
+    cand = tr.refit(win)
+    n0 = lgb.Booster(model_str=base_model).num_trees()
+    assert lgb.Booster(model_str=cand.model_text).num_trees() == n0 + 3
+
+
+@pytest.mark.slow
+def test_publish_ramp_promote_and_poison_rollback(base_model):
+    from lightgbm_tpu.pipeline import (Publisher, RampController,
+                                       RefitTrainer)
+    fleet = _fleet(base_model, replicas=2)
+    try:
+        src = ReplayLogSource(n_features=8, seed=21)
+        trainer = RefitTrainer(base_model, mode="refit", decay=0.2)
+        pub = Publisher(fleet, model="default")
+        ramp = RampController(
+            pub, stages=[0.5], stage_requests=12,
+            thresholds=RampThresholds(latency_regression_pct=1000))
+        # clean candidate promotes
+        win, hold = src.next_window(256), src.next_window(128)
+        cand = trainer.refit(win)
+        assert pub.publish(cand) == cand.name
+        assert ramp.ramp(cand, (hold.X, hold.y))
+        assert cand.status == "promoted"
+        assert pub.primary_name() == cand.name
+        # poisoned candidate (labels flipped) regresses on the clean
+        # holdout -> quality watchdog -> rollback; primary unchanged
+        trainer.note_promoted(cand)
+        set_fault_plan(f"drift@window={src.next_index},"
+                       "flip=0.5,once=1")
+        bad = src.next_window(256)
+        assert bad.drift
+        hold2 = src.next_window(128)
+        cand2 = trainer.refit(bad)
+        pub.publish(cand2)
+        assert not ramp.ramp(cand2, (hold2.X, hold2.y))
+        assert cand2.status == "rolled_back"
+        assert "quality_drop" in cand2.reason
+        assert pub.primary_name() == cand.name
+        # availability: the promoted model answers bit-identically
+        import lightgbm_tpu as lgb
+        served = np.asarray(fleet.predict(hold2.X[:16]))
+        direct = np.asarray(lgb.Booster(
+            model_str=cand.model_text).predict(hold2.X[:16]))
+        np.testing.assert_array_equal(served, direct)
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.slow
+def test_rejected_publish_marks_candidate_and_degrades_health(
+        base_model):
+    from lightgbm_tpu.pipeline import (Publisher, RampController,
+                                       RefitTrainer)
+    from lightgbm_tpu.pipeline.trainer import Candidate
+    fleet = _fleet(base_model)
+    try:
+        pub = Publisher(fleet, model="default")
+        # torn model text: the registry's integrity check rejects it
+        torn = base_model[: len(base_model) // 2]
+        cand = Candidate(1, torn, "refit", 0)
+        assert pub.publish(cand) is None
+        assert cand.status == "rejected"
+        assert "publish_failed" in cand.reason
+        h = fleet.health()
+        assert h["status"] == "degraded"
+        assert h["last_reload_error"]["model"] == "default.cand00001"
+        # the ramp controller never canaries a rejected candidate
+        ramp = RampController(pub, stages=[0.5], stage_requests=4)
+        src = ReplayLogSource(n_features=8, seed=21)
+        hold = src.next_window(64)
+        assert not ramp.ramp(cand, (hold.X, hold.y))
+        assert cand.status == "rolled_back"
+        assert fleet.router.describe().get("default") is None \
+            or fleet.router.describe()["default"]["canary"] is None
+        # a successful publish clears the degraded state
+        good = RefitTrainer(base_model, mode="refit",
+                            decay=0.5).refit(
+            ReplayLogSource(n_features=8, seed=21).next_window(128))
+        assert pub.publish(good) is not None
+        assert fleet.health()["status"] == "ok"
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.slow
+def test_driver_cycle_end_to_end(base_model, tmp_path):
+    from lightgbm_tpu.pipeline import PipelineDriver
+    path = str(tmp_path / "base.txt")
+    with open(path, "w") as fh:
+        fh.write(base_model)
+    set_fault_plan("drift@window=0,shift=1.0,feature=1")
+    driver = PipelineDriver({
+        "task": "pipeline", "input_model": path, "verbosity": -1,
+        "refit_decay_rate": 0.3,
+        "pipeline_window_rows": 192, "pipeline_holdout_rows": 96,
+        "pipeline_stage_requests": 8,
+        "pipeline_canary_stages": "0.5",
+        "pipeline_latency_slo_pct": 1000,
+        "pipeline_dir": str(tmp_path / "cands"),
+        "pipeline_replay_seed": 21,
+        "serving_buckets": "1,64,512",
+    })
+    summary = driver.run(max_cycles=1)
+    assert summary["cycles"] == 1
+    assert summary["promoted"] == 1, summary
+    assert summary["primary"].startswith("default.cand")
+    rec = summary["history"][0]
+    assert rec["status"] == "promoted"
+    assert rec["window"]["drift"]["shift"] == 1.0
+    assert rec["stages"][0]["decision"] == "advance"

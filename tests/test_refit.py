@@ -70,3 +70,68 @@ def test_refit_binary_objective():
     # refitted model still discriminates
     auc_ok = p[y2b == 1].mean() > p[y2b == 0].mean()
     assert auc_ok
+
+
+# ----------------------------------------------------------------------
+# linear_tree refit: the per-leaf ridge coefficients are RE-FIT from
+# the new labels (the PR 6 "refit drops linear coeffs" gap, closed) —
+# never silently dropped
+LIN_PARAMS = {**PARAMS, "linear_tree": True, "linear_lambda": 0.01}
+
+
+def _trees_text(model_text: str) -> str:
+    """The tree sections only (config dump / feature_infos metadata
+    legitimately differ between refits on different data)."""
+    return model_text[model_text.index("Tree=0"):
+                      model_text.index("end of trees")]
+
+
+def test_refit_linear_refits_coefficients():
+    X, y = _data(10)
+    bst = lgb.train(LIN_PARAMS, lgb.Dataset(X, label=y),
+                    num_boost_round=6)
+    t0 = bst.model_to_string()
+    assert "is_linear=1" in t0
+    X2, y2 = _data(11)
+    new = bst.refit(X2, y2, decay_rate=0.5)
+    t1 = new.model_to_string()
+    # still linear, structures kept, coefficients moved
+    assert "is_linear=1" in t1
+    changed = 0
+    for a, b in zip(bst._src().models, new._src().models):
+        np.testing.assert_array_equal(a.split_feature, b.split_feature)
+        assert b.is_linear
+        np.testing.assert_array_equal(a.leaf_features, b.leaf_features)
+        if not np.allclose(a.leaf_coeff, b.leaf_coeff):
+            changed += 1
+    assert changed > 0, "no leaf coefficients were re-fit"
+    # the refit genuinely tracks the new data
+    mse_old = float(np.mean((bst.predict(X2) - y2) ** 2))
+    mse_new = float(np.mean((new.predict(X2) - y2) ** 2))
+    assert mse_new < mse_old
+    # decay=1.0 keeps every tree (constants AND coefficients)
+    # byte-identical — the blend rule is exact in f64
+    ident = bst.refit(X2, y2, decay_rate=1.0)
+    assert _trees_text(ident.model_to_string()) == _trees_text(t0)
+    # a loaded-from-text linear model refits too
+    loaded = lgb.Booster(model_str=t0)
+    new2 = loaded.refit(X2, y2, decay_rate=0.5)
+    assert "is_linear=1" in new2.model_to_string()
+
+
+def test_refit_linear_raw_missing_is_structured_error():
+    X, y = _data(12)
+    bst = lgb.train(LIN_PARAMS, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    # simulate a training dataset without raw values (sparse ingest):
+    # the refit must REFUSE with a clear error, not drop coefficients
+    gbdt = bst._gbdt
+    gbdt.train_data.raw_numeric = None
+    gbdt.train_data._raw_device = None
+    lp = bst.predict(X, pred_leaf=True)
+    with np.testing.assert_raises(lgb.basic.LightGBMError):
+        gbdt.refit(np.asarray(lp))
+    try:
+        gbdt.refit(np.asarray(lp))
+    except lgb.basic.LightGBMError as e:
+        assert "refit_linear_raw_missing" in str(e)

@@ -152,6 +152,24 @@ def _b_refit_tree():
                     nl=L, tid=0, l1=0.0, l2=0.0, mds=20.0)
 
 
+@builder("refit_tree_linear")
+def _b_refit_tree_linear():
+    import jax.numpy as jnp
+    fn = _spec_fn("refit_tree_linear")
+    return fn.lower(jnp.zeros((N, 1), jnp.float32),
+                    jnp.zeros((N,), jnp.int32),
+                    jnp.zeros((N,), jnp.float32),
+                    jnp.ones((N,), jnp.float32),
+                    jnp.zeros((N, F), jnp.float32),
+                    jnp.full((L, C), -1, jnp.int32),
+                    jnp.zeros((L,), jnp.float32),
+                    jnp.zeros((L,), jnp.float32),
+                    jnp.zeros((L, C), jnp.float32),
+                    jnp.float32(0.1), jnp.float32(0.9),
+                    nl=L, tid=0, l1=0.0, l2=0.0, mds=20.0,
+                    lam=0.01, l2lin=0.0)
+
+
 @builder("bag_mask")
 def _b_bag_mask():
     import jax

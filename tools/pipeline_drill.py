@@ -1,0 +1,192 @@
+"""Continuous refit-and-promote drill (the CI pipeline gate).
+
+Exercises the whole ``lightgbm_tpu/pipeline/`` loop end to end on the
+deterministic replay stream, in two legs (docs/Pipeline.md):
+
+**Leg A — drift -> refit -> canary -> auto-promote, byte-stable.**
+Train a base model on the stream's clean distribution, then run one
+pipeline cycle with a covariate drift armed through the fault grammar
+(``drift@window=0,shift=...``). The cycle must tail the drifted
+window, refit a candidate, publish it, walk the canary stages and
+promote. PASS iff
+
+* the promoted model text is **byte-identical** to a direct offline
+  retrain (``Booster(base).refit`` on the regenerated window — the
+  replay stream is a pure function of (seed, index), so the drill
+  re-derives the exact training window out of band);
+* post-promotion traffic is answered by the promoted model
+  bit-identically to its direct host prediction, with **zero**
+  steady-state recompiles on the serving replicas;
+* availability is 1.0 (no non-shed errors) over the whole leg.
+
+**Leg B — injected regression -> auto-rollback.** Continue the same
+loop with a single poisoned window (``drift@...,flip=0.45,once=1``):
+the refit candidate is genuinely worse on the clean holdout, the
+quality watchdog must trip during canary, the candidate must be
+rolled back, and the leg-A promoted model must still be primary and
+still serving — availability 1.0 throughout.
+
+Artifacts: run with ``LGBM_TPU_TELEMETRY`` / ``LGBM_TPU_TRACE`` set to
+get the telemetry + span-timeline artifacts (CI uploads them), plus a
+``pipeline_drill.json`` summary in the workdir.
+
+Usage: python tools/pipeline_drill.py [workdir]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+N_FEATURES = 8
+SEED = 5
+WINDOW_ROWS = 384
+HOLDOUT_ROWS = 192
+# low decay = the refit tracks each window hard; leg A's byte parity
+# is decay-agnostic, and leg B NEEDS the poisoned fit to express
+DECAY = 0.2
+STAGES = "0.25,0.5"
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "pipeline_drill_work"
+    os.makedirs(workdir, exist_ok=True)
+
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.basic import Booster, Dataset
+    from lightgbm_tpu.observability.telemetry import get_telemetry
+    from lightgbm_tpu.pipeline import PipelineDriver, ReplayLogSource
+    from lightgbm_tpu.robustness.faults import set_fault_plan
+
+    tel = get_telemetry()
+    tel.ensure_ring()   # jit.compiles counting even without env
+
+    # base model on the clean distribution
+    boot = ReplayLogSource(n_features=N_FEATURES, seed=SEED + 1)
+    w = boot.next_window(800)
+    base = engine.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        Dataset(w.X, label=w.y), num_boost_round=10,
+        verbose_eval=False)
+    base_path = os.path.join(workdir, "base_model.txt")
+    base.save_model(base_path)
+
+    drift_spec = "drift@window=0,shift=1.2,feature=1"
+    set_fault_plan(drift_spec)
+    driver = PipelineDriver({
+        "task": "pipeline", "input_model": base_path,
+        "verbosity": -1,
+        "refit_decay_rate": DECAY,
+        "pipeline_window_rows": WINDOW_ROWS,
+        "pipeline_holdout_rows": HOLDOUT_ROWS,
+        "pipeline_stage_requests": 24,
+        "pipeline_canary_stages": STAGES,
+        "pipeline_latency_slo_pct": 1000,   # this drill gates QUALITY
+        "pipeline_dir": os.path.join(workdir, "cands"),
+        "pipeline_replay_seed": SEED,
+        "serving_replicas": 2,
+        "serving_buckets": "1,64,512",
+    })
+
+    # ---- leg A: drift -> refit -> canary -> promote ------------------
+    a = driver.run(max_cycles=1, stop_fleet=False)
+    assert a["cycles"] == 1 and a["promoted"] == 1, a
+    cand = driver.publisher.history[-1]
+    assert cand.status == "promoted", cand.describe()
+    assert driver.publisher.primary_name() == cand.name
+    assert cand.checkpoint_path and os.path.exists(
+        cand.checkpoint_path), "candidate was not checkpointed"
+    print(f"[leg A 1/3] cycle promoted candidate {cand.cid} "
+          f"({cand.name})")
+
+    # byte-stable parity: regenerate the exact refit window out of
+    # band (same seed, same drift spec) and retrain directly
+    replay = ReplayLogSource(n_features=N_FEATURES, seed=SEED)
+    set_fault_plan(drift_spec)
+    win = replay.next_window(WINDOW_ROWS)
+    assert win.drift, "drift did not fire on the regenerated stream"
+    direct = Booster(model_file=base_path).refit(
+        win.X, win.y, decay_rate=DECAY)
+    direct_text = direct.model_to_string()
+    parity = direct_text == cand.model_text
+    assert parity, (
+        "promoted model is NOT byte-identical to the direct retrain "
+        f"(lens {len(cand.model_text)} vs {len(direct_text)})")
+    print("[leg A 2/3] promoted model is byte-identical to the "
+          "direct offline retrain")
+
+    # post-promotion: zero steady-state recompiles + bit parity on
+    # the live pool
+    fleet = driver.fleet
+    hold = replay.next_window(HOLDOUT_ROWS)
+    fleet.predict(hold.X[:1])   # routed warm probe (promoted target)
+    compiles0 = tel.counters.get("jit.compiles", 0)
+    served = np.asarray(fleet.predict(hold.X[:64]))
+    again = np.asarray(fleet.predict(hold.X[:1]))
+    assert tel.counters.get("jit.compiles", 0) == compiles0, \
+        "steady-state traffic on the promoted replicas recompiled"
+    expect = np.asarray(
+        Booster(model_str=cand.model_text).predict(hold.X[:64]))
+    assert served.shape == expect.shape \
+        and np.array_equal(served, expect), \
+        "promoted model served != its direct host prediction"
+    assert again.shape == (1,)
+    print("[leg A 3/3] promoted replicas: zero steady-state "
+          "recompiles, served output bit-identical")
+
+    # ---- leg B: poisoned window -> quality rollback ------------------
+    set_fault_plan(
+        f"drift@window={driver.source.next_index},flip=0.5,once=1")
+    b = driver.run(max_cycles=1, stop_fleet=False)
+    assert b["cycles"] == 1 and b["promoted"] == 0, b
+    cand2 = driver.publisher.history[-1]
+    assert cand2.status == "rolled_back", cand2.describe()
+    assert "quality_drop" in cand2.reason, cand2.reason
+    assert driver.publisher.primary_name() == cand.name, \
+        "rollback did not keep the leg-A model primary"
+    print(f"[leg B 1/2] poisoned candidate {cand2.cid} rolled back "
+          f"({cand2.reason})")
+
+    # the old version never stopped serving: availability 1.0
+    served2 = np.asarray(fleet.predict(hold.X[:32]))
+    assert np.array_equal(
+        served2,
+        np.asarray(Booster(model_str=cand.model_text)
+                   .predict(hold.X[:32]))), \
+        "post-rollback serving is not the promoted leg-A model"
+    stats = fleet.stats()
+    errors = int(stats.get("errors", 0))
+    requests = int(stats.get("requests", 0))
+    assert errors == 0 and requests > 0, stats
+    health = fleet.health()
+    assert health["status"] == "ok", health
+    print(f"[leg B 2/2] availability 1.0 over {requests} fleet "
+          "requests (0 non-shed errors); health ok")
+
+    driver.stop()
+    set_fault_plan(None)
+
+    summary = {
+        "leg_a": {k: v for k, v in a.items() if k != "history"},
+        "leg_b": {k: v for k, v in b.items() if k != "history"},
+        "byte_stable_parity": parity,
+        "promoted": cand.describe(),
+        "rolled_back": cand2.describe(),
+        "fleet_requests": requests,
+        "fleet_errors": errors,
+        "availability": 1.0 if errors == 0 else
+        round(1.0 - errors / max(requests, 1), 6),
+    }
+    out = os.path.join(workdir, "pipeline_drill.json")
+    with open(out, "w") as fh:
+        json.dump(summary, fh, indent=1, default=str)
+    tel.flush()
+    print(f"PASS: pipeline drill complete; summary at {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
