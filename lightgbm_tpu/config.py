@@ -240,6 +240,11 @@ class Config:
     # ---- learning control (config.h:236-517)
     force_col_wise: bool = False
     force_row_wise: bool = False
+    # fused split-step megakernel gate (ops/split_step_pallas.py):
+    # auto = on where the Mosaic lowering probe passes (compiled
+    # backends, numerical fast path), on/off force it. The
+    # LGBM_TPU_FUSED_SPLIT_KERNEL env var overrides per process.
+    fused_split_kernel: str = "auto"
     histogram_pool_size: float = -1.0
     max_depth: int = -1
     min_data_in_leaf: int = 20
@@ -555,6 +560,9 @@ class Config:
                 raise ValueError(f"{name} should be in (0.0, 1.0]")
         if self.learning_rate <= 0.0:
             raise ValueError("learning_rate should be greater than 0")
+        if self.fused_split_kernel not in ("auto", "on", "off"):
+            raise ValueError(
+                "fused_split_kernel should be auto, on or off")
         if self.is_single_machine():
             self.is_parallel = False
             if self.tree_learner not in ("serial", "partitioned") \

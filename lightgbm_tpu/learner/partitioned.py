@@ -37,23 +37,8 @@ from ..models.tree import Tree, TreeArrays
 from ..utils.jit_registry import register_jit
 from ..ops.hist_pallas import (build_matrix, extract_row_ids,
                                histogram_segment, pack_gh)
-from ..ops.partition_pallas import bitset_to_lut
-from ..ops.partition_pallas import partition_segment as _partition_v1
+from ..ops.partition_pallas import bitset_to_lut, partition_segment
 from ..ops.split_scan_pallas import scan_kernel_default as _scan_default
-
-# opt-in sub-tiled partition kernel (ops/partition_pallas_v2.py);
-# flipped by env until validated on hardware, then becomes the default.
-# Block size is width-dependent (pick_blk) so VMEM scratch stays
-# bounded on wide matrices.
-import os as _os
-USE_PART_V2 = _os.environ.get("LGBM_TPU_PART_V2", "0") == "1"
-if USE_PART_V2:
-    from ..ops.partition_pallas_v2 import (pick_blk as _pick_blk,
-                                           partition_segment_v2
-                                           as partition_segment)
-else:
-    partition_segment = _partition_v1
-    _pick_blk = None
 from ..ops.split import (MAX_CAT_WORDS,
                          _argmax_first, assemble_split,
                          leaf_output_no_constraint, per_feature_splits)
@@ -66,12 +51,45 @@ from .serial import (CegbStateMixin, GrowResult, NodeRandMixin,
                      forced_left_sums, forced_split_override,
                      make_node_rand, split_params_from_config)
 from .split_step import (StatePack, child_columns, child_constraints,
-                         make_grow_pack, order_child_pair,
-                         scan_children, set_bitsets,
-                         split_fusion_default)
+                         fused_split_eligible, make_grow_pack,
+                         make_scan_leaf, order_child_pair,
+                         scan_split_pair, set_bitsets,
+                         split_fusion_default, split_node_updates)
 
 HIST_BLK = 2048
 PART_BLK = 512
+
+
+def partition_decision_lut(meta, feat, thr, dleft, is_cat, bitset,
+                           bundled: bool):
+    """(grp_col, use_lut, lut) for one split's physical partition —
+    the 256-entry "group value -> goes left" table encoding decode +
+    missing handling in feature-bin space for bundled splits, the raw
+    bin bitset for categorical ones. ONE definition shared by the
+    foil's ``partition_segment`` call and the fused megakernel's
+    interpret twin (bit-exactness-critical)."""
+    lut = jnp.where(is_cat, bitset_to_lut(bitset),
+                    jnp.zeros((1, 256), jnp.float32))
+    grp_col = meta.group[feat] if bundled else feat
+    use_lut = is_cat
+    if bundled:
+        from ..data.bundling import decode_feature_bin
+        off = meta.offset[feat]
+        nbf = meta.num_bins[feat]
+        vals = jnp.arange(256, dtype=jnp.int32)
+        # offset 0 would pass values through; masked by
+        # is_bundled_split below, so raw splits keep the fast path
+        fbin = decode_feature_bin(vals, off, nbf)
+        mcode = meta.missing[feat]
+        is_miss = jnp.where(
+            mcode == 1, fbin == meta.default_bin[feat],
+            jnp.where(mcode == 2, fbin == nbf - 1, False))
+        go_left = jnp.where(is_miss, dleft, fbin <= thr)
+        blut = go_left.astype(jnp.float32).reshape(1, 256)
+        is_bundled_split = (off > 0) & ~is_cat
+        lut = jnp.where(is_bundled_split, blut, lut)
+        use_lut = is_cat | is_bundled_split
+    return grp_col, use_lut, lut
 
 # the partitioned loop's int state additionally carries the physical
 # segment bounds (learner/split_step.py:StatePack)
@@ -189,10 +207,17 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             ff_bynode=self.ff_bynode, bynode_count=self.bynode_count,
             forced_plan=self.forced_plan, hist_slots=self.hist_slots,
             has_monotone=self.has_monotone,
-            split_fusion=split_fusion_default())
+            split_fusion=split_fusion_default(),
+            fused_kernel=self._fused_kernel_on())
         res = GrowResult(tree=tree, leaf_id=leaf_id)
         self._cegb_after_tree(res)
         return res
+
+    def _fused_kernel_on(self) -> bool:
+        """Megakernel gate (ops/split_step_pallas.py), read per train()
+        call so env flips retrace."""
+        from ..ops.split_step_pallas import learner_fused_kernel_on
+        return learner_fused_kernel_on(self, "segment")
 
     # -- fused-scan training hook (models/gbdt.py _train_fused_blocks) --
     supports_fused_scan = True
@@ -224,6 +249,7 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
             cache_hists=self.cache_hists, hist_slots=self.hist_slots,
             has_monotone=self.has_monotone,
             split_fusion=split_fusion_default(),
+            fused_kernel=self._fused_kernel_on(),
             return_leaf_parts=True)
 
 
@@ -234,7 +260,8 @@ class PartitionedTreeLearner(PartitionedLearnerBase):
                               "num_groups", "n", "bundled", "interpret",
                               "extra_trees", "ff_bynode", "bynode_count",
                               "forced_plan", "cache_hists", "hist_slots",
-                              "has_monotone", "split_fusion"),
+                              "has_monotone", "split_fusion",
+                              "fused_kernel"),
     donate_argnums=(0, 1))
 def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       rand_key=None, cegb_used0=None, *, params,
@@ -243,7 +270,7 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       extra_trees=False, ff_bynode=1.0,
                       bynode_count=2, forced_plan=(), cache_hists=True,
                       hist_slots=None, has_monotone=True,
-                      split_fusion=True):
+                      split_fusion=True, fused_kernel=False):
     return grow_partitioned(
         mat, ws, grad, hess, bag_weight, feature_mask, meta,
         rand_key=rand_key, params=params, num_leaves=num_leaves,
@@ -253,7 +280,8 @@ def _grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         ff_bynode=ff_bynode, bynode_count=bynode_count,
         forced_plan=forced_plan, cache_hists=cache_hists,
         cegb_used0=cegb_used0, hist_slots=hist_slots,
-        has_monotone=has_monotone, split_fusion=split_fusion)
+        has_monotone=has_monotone, split_fusion=split_fusion,
+        fused_kernel=fused_kernel)
 
 
 def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
@@ -264,7 +292,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      row_id_base=0, n_total=None, cache_hists=True,
                      cegb_used0=None, hist_slots=None,
                      has_monotone=True, split_fusion=None,
-                     return_leaf_parts=False):
+                     fused_kernel=False, return_leaf_parts=False):
     """Traceable partitioned grow loop.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py)
@@ -337,17 +365,45 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     if params.cegb_on and cegb_used0 is None:
         cegb_used0 = jnp.zeros((num_features,), bool)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
-        if bundled:
-            from ..ops.histogram import debundle_leaf_hist
-            hist = debundle_leaf_hist(hist, meta, g, h, c,
-                                      comm.local_hist)
-        rb, nm = node_rand(salt)
-        fm = feature_mask if nm is None else nm  # nm already in-subset
-        res = comm.select_split(hist, g, h, c, meta, params,
-                                cmin, cmax, fm, rand_bins=rb)
-        blocked = (max_depth > 0) & (depth >= max_depth)
-        return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+    # ---- fused split-step megakernel gate (ops/split_step_pallas.py):
+    # the whole split — leaf pick, physical partition, smaller-child
+    # segment histogram + sibling subtraction, both children's scans,
+    # state/tree/hist writes — becomes ONE pallas_call; ineligible
+    # configs (CEGB / RNG / pool-bounded / mesh comms) keep the foil
+    use_fused = bool(fused_kernel) and fused_split_eligible(
+        params, cache_hists=cache_hists, merged=split_fusion,
+        extra_trees=extra_trees, ff_bynode=ff_bynode,
+        serial_comm=comm is _SER, num_leaves=big_l) \
+        and (interpret or not forced_plan)
+    if use_fused:
+        from ..ops.split_step_pallas import (fused_split_step_segment,
+                                             pack_meta_tables)
+        imeta_tab, fmeta_tab = pack_meta_tables(meta, feature_mask)
+
+        def body_fused(st_packed):
+            k = st_packed["k"]
+            res = fused_split_step_segment(
+                k, st_packed["S"], st_packed["T"], st_packed["mat"],
+                st_packed["ws"], st_packed["hist"], imeta_tab,
+                fmeta_tab, st_packed.get("bs_bitset"),
+                st_packed.get("cat_bitsets"), params=params,
+                si_prefix=SEG_SI_PREFIX, big_l=big_l,
+                max_depth=max_depth, b=b, f=f, n=n, bundled=bundled,
+                has_monotone=has_monotone, blk=HIST_BLK,
+                interpret=interpret)
+            st2 = dict(st_packed)
+            st2.update(S=res[0], T=res[1], mat=res[2], ws=res[3],
+                       hist=res[4], k=k + 1)
+            # static dict-key membership, not a traced condition
+            if "bs_bitset" in st_packed:  # graftlint: allow[GL104]
+                st2.update(bs_bitset=res[5], cat_bitsets=res[6])
+            return st2
+
+    # shared scan-leaf composition (learner/split_step.py — the fused
+    # megakernel twin calls the SAME maker, keeping both paths
+    # bit-identical)
+    scan_leaf = make_scan_leaf(comm, meta, params, feature_mask,
+                               node_rand, bundled, max_depth)
 
     def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used):
         # CEGB candidate-cache scan (see learner/serial.py): best from
@@ -434,8 +490,15 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                       root_split.cat_bitset),
         cat_bitsets=jnp.zeros((big_l - 1, MAX_CAT_WORDS), jnp.uint32))
     if cache_hists:
-        fields["hist"] = at0(
-            jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
+        if use_fused and not interpret:
+            # compiled megakernel: channels-major cache rows so every
+            # plane the kernel touches is a static-leading-index slab
+            fields["hist"] = at0(
+                jnp.zeros((big_l, 3, f, b), jnp.float32),
+                jnp.moveaxis(root_hist, -1, 0))
+        else:
+            fields["hist"] = at0(
+                jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
     if pool_mode:
         # bounded LRU pool: slot 0 holds the root; slot_used carries
         # the split tick of the last touch (-1 = empty, filled first)
@@ -483,6 +546,10 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     kEps = 1e-15
 
     def body(st_packed, forced=None, forced_hist=None):
+        if use_fused and forced is None:
+            # the whole split is ONE pallas_call (megakernel); forced
+            # pre-steps keep the per-phase foil below
+            return body_fused(st_packed)
         st = pack.view(st_packed)  # row views, folded by XLA
         k = st["k"]
         new = k
@@ -522,35 +589,15 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
         # ---- physical partition of the leaf's segment ----------------
         # bundled numerical splits route through the kernel's LUT path:
         # the 256-entry table encodes "group value -> goes left"
-        # including missing handling in feature-bin space
-        lut = jnp.where(is_cat, bitset_to_lut(bitset),
-                        jnp.zeros((1, 256), jnp.float32))
-        grp_col = meta.group[feat] if bundled else feat
-        use_lut = is_cat
-        if bundled:
-            from ..data.bundling import decode_feature_bin
-            off = meta.offset[feat]
-            nbf = meta.num_bins[feat]
-            vals = jnp.arange(256, dtype=jnp.int32)
-            # offset 0 would pass values through; masked by
-            # is_bundled_split below, so raw splits keep the fast path
-            fbin = decode_feature_bin(vals, off, nbf)
-            mcode = meta.missing[feat]
-            is_miss = jnp.where(
-                mcode == 1, fbin == meta.default_bin[feat],
-                jnp.where(mcode == 2, fbin == nbf - 1, False))
-            go_left = jnp.where(is_miss, dleft, fbin <= thr)
-            blut = go_left.astype(jnp.float32).reshape(1, 256)
-            is_bundled_split = (off > 0) & ~is_cat
-            lut = jnp.where(is_bundled_split, blut, lut)
-            use_lut = is_cat | is_bundled_split
+        # including missing handling in feature-bin space (shared with
+        # the megakernel twin: partition_decision_lut)
+        grp_col, use_lut, lut = partition_decision_lut(
+            meta, feat, thr, dleft, is_cat, bitset, bundled)
         mat2, ws2, nl1 = partition_segment(
             st["mat"], st["ws"], begin, cnt, grp_col, thr,
             dleft.astype(jnp.int32), meta.missing[feat],
             meta.default_bin[feat], meta.num_bins[feat],
-            use_lut.astype(jnp.int32), lut,
-            blk=_pick_blk(st["mat"].shape[1]) if USE_PART_V2
-            else PART_BLK,
+            use_lut.astype(jnp.int32), lut, blk=PART_BLK,
             interpret=interpret,
             # STATIC: only categorical or EFB-bundled splits consult
             # the LUT; compile it out otherwise (hot bench path)
@@ -604,17 +651,13 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
             hist_left = seg_hist(mat2, begin, nl)
             hist_right = seg_hist(mat2, begin + nl, nr)
 
-        # ---- tree arrays (same bookkeeping as learner/serial.py) -----
-        dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
-        ref_node = site["ref_node"]
-        upd = ref_node >= 0
-        pnode = jnp.where(upd, ref_node, 0)
+        # ---- tree arrays (split_node_updates — the shared helper the
+        # fused megakernel twin also calls) -----------------------------
         pside = site["ref_side"]
-
         depth = site["leaf_depth"] + 1
-        parent_out = leaf_output_no_constraint(
-            pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
-            params.max_delta_step)
+        treef, treei, pnode, upd = split_node_updates(
+            params, gain, feat, thr, dleft, is_cat, pg, ph, pc,
+            site["ref_node"], leaf, new)
 
         # ---- monotone constraint propagation (compiled out when no
         # feature has a monotone constraint) ---------------------------
@@ -651,14 +694,10 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                 hist_a, hist_b = hist_left, hist_right
                 begin_a, cnt_a, begin_b, cnt_b = (begin, nl,
                                                   begin + nl, nr)
-            o = order_child_pair(
-                a_is_left, k, lg, lh, lc, rg, rh, rc, lout, rout,
+            o, split_a, split_b = scan_split_pair(
+                comm, scan_leaf, a_is_left, k, depth, hist_a, hist_b,
+                lg, lh, lc, rg, rh, rc, lout, rout,
                 cmin_l, cmax_l, cmin_r, cmax_r)
-            split_a, split_b = scan_children(
-                comm, scan_leaf, hist_a, hist_b, o["ga"], o["ha"],
-                o["ca"], o["gb"], o["hb"], o["cb"], depth, o["cmin_a"],
-                o["cmax_a"], o["cmin_b"], o["cmax_b"], o["salt_a"],
-                o["salt_b"])
 
         # ---- packed column writes (learner/split_step.py) ------------
         fa, ia = child_columns(split_a, o["ga"], o["ha"], o["ca"],
@@ -675,13 +714,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                if kk not in StatePack._MATS}
         st2.update(pack.set_state_cols(st_packed, idx_a, idx_b,
                                        fa, fb, ia, ib))
-        st2.update(pack.set_tree_col(
-            st_packed, s,
-            dict(split_gain_arr=gain, internal_value=parent_out,
-                 internal_weight=ph, internal_count=pc),
-            dict(split_feature=feat, threshold_bin=thr,
-                 decision_type=dec, left_child=~leaf, right_child=~new),
-            pnode, upd, pside))
+        st2.update(pack.set_tree_col(st_packed, s, treef, treei,
+                                     pnode, upd, pside))
         st2.update(k=k + 1, mat=mat2, ws=ws2)
         st2.update(set_bitsets(pack, st, idx_a, idx_b,
                                split_a.cat_bitset, split_b.cat_bitset,

@@ -46,9 +46,10 @@ from ..ops.split import (MAX_CAT_WORDS, MISSING_NAN_CODE, MISSING_NONE_CODE,
 from ..ops.split_scan_pallas import \
     scan_kernel_default as _scan_kernel_default
 from .split_step import (StatePack, child_columns, child_constraints,
-                         make_grow_pack, order_child_pair,
-                         scan_children, set_bitsets,
-                         split_fusion_default)
+                         fused_split_eligible, make_grow_pack,
+                         make_scan_leaf, order_child_pair,
+                         scan_split_pair, set_bitsets,
+                         split_fusion_default, split_node_updates)
 
 _MISSING_CODE = {MISSING_NONE: MISSING_NONE_CODE,
                  MISSING_ZERO: MISSING_ZERO_CODE,
@@ -635,6 +636,12 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
         self._ones_rows = jnp.ones((dataset.num_data,), jnp.float32)
         self._all_features = jnp.ones((dataset.num_features,), bool)
 
+    def _fused_kernel_on(self) -> bool:
+        """Megakernel gate (ops/split_step_pallas.py), read per train()
+        call so env flips retrace."""
+        from ..ops.split_step_pallas import learner_fused_kernel_on
+        return learner_fused_kernel_on(self, "leaf")
+
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               bag_weight: Optional[jnp.ndarray] = None,
               feature_mask: Optional[jnp.ndarray] = None) -> GrowResult:
@@ -664,7 +671,8 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
                         mv_slots=self.mv_slots,
                         mv_groups=self.mv_groups,
                         has_monotone=self.has_monotone,
-                        split_fusion=split_fusion_default())
+                        split_fusion=split_fusion_default(),
+                        fused_kernel=self._fused_kernel_on())
         self._cegb_after_tree(res)
         if res.cegb_charged is not None:
             self._cegb_charged = res.cegb_charged
@@ -688,7 +696,8 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
                               "num_bins_max", "hist_method", "bundled",
                               "extra_trees", "ff_bynode", "bynode_count",
                               "forced_plan", "cache_hists", "mv_groups",
-                              "has_monotone", "split_fusion"),
+                              "has_monotone", "split_fusion",
+                              "fused_kernel"),
     # the CEGB lazy charged matrix [N, F] is replaced by the grow
     # result every tree — the input buffer is dead the moment the
     # program launches, so donate it (the largest state array a CEGB
@@ -700,7 +709,8 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
               params, num_leaves, max_depth, num_bins_max, hist_method,
               bundled=False, extra_trees=False, ff_bynode=1.0,
               bynode_count=2, forced_plan=(), cache_hists=True,
-              mv_groups=0, has_monotone=True, split_fusion=True):
+              mv_groups=0, has_monotone=True, split_fusion=True,
+              fused_kernel=False):
     return grow_tree(binned, grad, hess, bag_weight, feature_mask,
                      meta=meta, params=params, num_leaves=num_leaves,
                      max_depth=max_depth, num_bins_max=num_bins_max,
@@ -711,7 +721,8 @@ def _grow_jit(binned, grad, hess, bag_weight, feature_mask, meta,
                      cegb_used0=cegb_used0, cegb_charged0=cegb_charged0,
                      mv_slots=mv_slots, mv_groups=mv_groups,
                      has_monotone=has_monotone,
-                     split_fusion=split_fusion)
+                     split_fusion=split_fusion,
+                     fused_kernel=fused_kernel)
 
 
 def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
@@ -725,7 +736,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               cegb_used0=None, cegb_charged0=None,
               mv_slots=None, mv_groups: int = 0,
               has_monotone: bool = True,
-              split_fusion: bool | None = None) -> GrowResult:
+              split_fusion: bool | None = None,
+              fused_kernel: bool = False) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
@@ -790,6 +802,57 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                                meta_hist.num_bins, extra_trees, ff_bynode,
                                bynode_cap=bynode_cap)
 
+    # ---- fused split-step megakernel gate (ops/split_step_pallas.py):
+    # the whole split — leaf pick, partition, smaller-child histogram +
+    # sibling subtraction, both children's scans, state/tree/hist
+    # writes — becomes ONE pallas_call; statically ineligible configs
+    # (CEGB / per-node RNG / pool-bounded hist memory / multi-val /
+    # non-serial comms) keep the per-phase foil
+    from .comm import SERIAL_COMM as _SERIAL_C
+    fused_interpret = jax.default_backend() not in ("tpu", "axon")
+    use_fused = bool(fused_kernel) and fused_split_eligible(
+        params, cache_hists=cache_hists, merged=split_fusion,
+        extra_trees=extra_trees, ff_bynode=ff_bynode,
+        mv_groups=mv_groups, serial_comm=comm is _SERIAL_C,
+        num_leaves=big_l) \
+        and (fused_interpret or not forced_plan)
+    n_lid = n               # leaf_id length (padded on compiled fused)
+    if use_fused:
+        from ..ops.split_step_pallas import (FUSED_BLK,
+                                             fused_split_step_leaf,
+                                             pack_meta_tables)
+        imeta_tab, fmeta_tab = pack_meta_tables(meta_hist,
+                                                feature_mask)
+        if fused_interpret:
+            binned_k, ghc_k = binned_hist, ghc
+        else:
+            # the compiled kernel streams whole blk-row blocks; pad
+            # the row streams once (loop-invariant — XLA hoists) and
+            # carry a padded leaf_id (padding rows have zero ghc and
+            # contribute nothing)
+            n_lid = -(-n // FUSED_BLK) * FUSED_BLK
+            binned_k = jnp.pad(binned_hist, ((0, n_lid - n), (0, 0)))
+            ghc_k = jnp.pad(ghc, ((0, n_lid - n), (0, 0)))
+
+        def body_fused(st_packed):
+            k = st_packed["k"]
+            res = fused_split_step_leaf(
+                k, st_packed["S"], st_packed["T"],
+                st_packed["leaf_id"], st_packed["hist"], binned_k,
+                ghc_k, imeta_tab, fmeta_tab,
+                st_packed.get("bs_bitset"),
+                st_packed.get("cat_bitsets"), params=params,
+                si_prefix=(), big_l=big_l, max_depth=max_depth, b=b,
+                bundled=bundled, has_monotone=has_monotone,
+                hist_method=hist_method, interpret=fused_interpret)
+            st2 = dict(st_packed)
+            st2.update(S=res[0], T=res[1], leaf_id=res[2],
+                       hist=res[3], k=k + 1)
+            # static dict-key membership, not a traced condition
+            if "bs_bitset" in st_packed:  # graftlint: allow[GL104]
+                st2.update(bs_bitset=res[4], cat_bitsets=res[5])
+            return st2
+
     f_logical = meta_hist.num_bins.shape[0]
     if params.cegb_on and cegb_used0 is None:
         cegb_used0 = jnp.zeros((f_logical,), bool)
@@ -805,18 +868,11 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         return m.sum() - (charged.astype(jnp.float32)
                           * m[:, None]).sum(axis=0)
 
-    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
-        if bundled:
-            # EFB: group histograms -> per-feature histograms
-            from ..ops.histogram import debundle_leaf_hist
-            hist = debundle_leaf_hist(hist, meta_hist, g, h, c,
-                                      comm.local_hist)
-        rb, nm = node_rand(salt)
-        fm = feature_mask if nm is None else nm  # nm already in-subset
-        res = comm.select_split(hist, g, h, c, meta_hist, params,
-                                cmin, cmax, fm, rand_bins=rb)
-        blocked = (max_depth > 0) & (depth >= max_depth)
-        return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+    # shared scan-leaf composition (learner/split_step.py — the fused
+    # megakernel's interpret twin calls the SAME maker, which is what
+    # keeps the two paths bit-identical)
+    scan_leaf = make_scan_leaf(comm, meta_hist, params, feature_mask,
+                               node_rand, bundled, max_depth)
 
     def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used,
                      uncharged=None):
@@ -904,14 +960,23 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
     )
     fields.update(
         k=jnp.int32(1),
-        leaf_id=jnp.zeros((n,), jnp.int32),
+        leaf_id=jnp.zeros((n_lid,), jnp.int32),
         bs_bitset=at0(jnp.zeros((big_l, MAX_CAT_WORDS), jnp.uint32),
                       root_split.cat_bitset),
         cat_bitsets=jnp.zeros((big_l - 1, MAX_CAT_WORDS), jnp.uint32))
     if cache_hists:
-        fields["hist"] = at0(
-            jnp.zeros((big_l, num_features_hist, b, 3), jnp.float32),
-            root_hist)
+        if use_fused and not fused_interpret:
+            # compiled megakernel: channels-major cache rows so every
+            # plane the kernel touches is a static-leading-index slab
+            fields["hist"] = at0(
+                jnp.zeros((big_l, 3, num_features_hist, b),
+                          jnp.float32),
+                jnp.moveaxis(root_hist, -1, 0))
+        else:
+            fields["hist"] = at0(
+                jnp.zeros((big_l, num_features_hist, b, 3),
+                          jnp.float32),
+                root_hist)
     if params.cegb_on:
         fields["cegb_used"] = cegb_used0
         fields.update(cegb_pf_state(big_l, f_logical))
@@ -937,6 +1002,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         return (st["k"] < big_l) & (open_gain.max() > 0.0)
 
     def body(st_packed, forced=None, forced_hist=None):
+        if use_fused and forced is None:
+            # the whole split is ONE pallas_call (megakernel); forced
+            # pre-steps keep the per-phase foil below
+            return body_fused(st_packed)
         st = pack.view(st_packed)  # row views, folded by XLA
         k = st["k"]
         new = k
@@ -1007,17 +1076,13 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             meta.missing[feat], meta.default_bin[feat],
             meta.num_bins[feat], is_cat, bitset)
 
-        # ---- tree arrays ---------------------------------------------
-        dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
-        ref_node = site["ref_node"]
-        upd = ref_node >= 0
-        pnode = jnp.where(upd, ref_node, 0)
+        # ---- tree arrays (split_node_updates — the shared helper the
+        # fused megakernel twin also calls) -----------------------------
         pside = site["ref_side"]
-
         depth = site["leaf_depth"] + 1
-        parent_out = leaf_output_no_constraint(
-            pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
-            params.max_delta_step)
+        treef, treei, pnode, upd = split_node_updates(
+            params, gain, feat, thr, dleft, is_cat, pg, ph, pc,
+            site["ref_node"], leaf, new)
 
         # ---- histograms: smaller child built, sibling by subtraction
         # (pool-bounded mode: no parent cache -> build both directly).
@@ -1085,14 +1150,10 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 a_is_left = jnp.bool_(True)
                 idx_a, idx_b = leaf, new
                 hist_a, hist_b = hist_left, hist_right
-            o = order_child_pair(
-                a_is_left, k, lg, lh, lc, rg, rh, rc, lout, rout,
+            o, split_a, split_b = scan_split_pair(
+                comm, scan_leaf, a_is_left, k, depth, hist_a, hist_b,
+                lg, lh, lc, rg, rh, rc, lout, rout,
                 cmin_l, cmax_l, cmin_r, cmax_r)
-            split_a, split_b = scan_children(
-                comm, scan_leaf, hist_a, hist_b, o["ga"], o["ha"],
-                o["ca"], o["gb"], o["hb"], o["cb"], depth, o["cmin_a"],
-                o["cmax_a"], o["cmin_b"], o["cmax_b"], o["salt_a"],
-                o["salt_b"])
 
         # ---- packed column writes (learner/split_step.py): fused =
         # one scatter per state/tree matrix; legacy = the r05 writes --
@@ -1106,13 +1167,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                if kk not in StatePack._MATS}
         st2.update(pack.set_state_cols(st_packed, idx_a, idx_b,
                                        fa, fb, ia, ib))
-        st2.update(pack.set_tree_col(
-            st_packed, s,
-            dict(split_gain_arr=gain, internal_value=parent_out,
-                 internal_weight=ph, internal_count=pc),
-            dict(split_feature=feat, threshold_bin=thr,
-                 decision_type=dec, left_child=~leaf, right_child=~new),
-            pnode, upd, pside))
+        st2.update(pack.set_tree_col(st_packed, s, treef, treei,
+                                     pnode, upd, pside))
         st2.update(k=k + 1, leaf_id=leaf_id)
         st2.update(set_bitsets(pack, st, idx_a, idx_b,
                                split_a.cat_bitset, split_b.cat_bitset,
@@ -1178,5 +1234,6 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
         leaf_depth=vf["leaf_depth"],
         cat_bitsets=vf["cat_bitsets"],
     )
-    return GrowResult(tree=tree, leaf_id=st["leaf_id"],
+    leaf_id_out = st["leaf_id"][:n] if n_lid != n else st["leaf_id"]
+    return GrowResult(tree=tree, leaf_id=leaf_id_out,
                       cegb_charged=st.get("cegb_charged"))

@@ -55,6 +55,45 @@ def split_fusion_default() -> bool:
         not in ("0", "false", "off")
 
 
+def fused_split_kernel_mode(config_value: str = "auto") -> str:
+    """Resolve the fused split-step megakernel gate
+    (ops/split_step_pallas.py) to one of "on" / "off" / "auto".
+
+    The LGBM_TPU_FUSED_SPLIT_KERNEL env var overrides the config param
+    (same kill-switch ergonomics as LGBM_TPU_SPLIT_FUSION): 0/false/off
+    force the per-phase foil, 1/on force the kernel (interpret twin on
+    CPU — the census/test vehicle), anything else keeps "auto" =
+    default on where lowerable (compiled backends whose Mosaic accepts
+    the kernel; the probe emits a reason_code when it cannot lower)."""
+    env = os.environ.get("LGBM_TPU_FUSED_SPLIT_KERNEL", "").lower()
+    if env in ("0", "false", "off"):
+        return "off"
+    if env in ("1", "on", "force"):
+        return "on"
+    if env in ("auto",):
+        return "auto"
+    return config_value if config_value in ("on", "off") else "auto"
+
+
+def fused_split_eligible(params, *, cache_hists: bool, merged: bool,
+                         extra_trees: bool, ff_bynode: float,
+                         mv_groups: int = 0, serial_comm: bool = True,
+                         num_leaves: int = 0) -> bool:
+    """STATIC eligibility of the fused split-step megakernel for one
+    grow trace. The kernel owns the whole split — leaf pick, partition,
+    smaller-child histogram + sibling subtraction, both children's
+    scans, state/tree/hist writes — so anything that injects per-split
+    work the kernel does not model falls back to the per-phase foil:
+    CEGB (candidate-cache bookkeeping), per-node RNG (extra-trees /
+    by-node sampling), pool-bounded histogram memory (no parent to
+    subtract from), multi-val pseudo-groups, and non-serial comms
+    (collectives must sit between phases, never inside one kernel)."""
+    return (merged and cache_hists and serial_comm
+            and not params.cegb_on and not extra_trees
+            and ff_bynode >= 1.0 and mv_groups == 0
+            and num_leaves >= 2)
+
+
 def _bitcast_f32(x):
     return jax.lax.bitcast_convert_type(x, jnp.float32)
 
@@ -313,7 +352,15 @@ def child_constraints(meta, feat, is_cat, lout, rout, pcmin, pcmax,
     forever) when no feature has a monotone constraint."""
     if not has_monotone:
         return pcmin, pcmax, pcmin, pcmax
-    mono = meta.monotone[feat]
+    return child_constraints_mono(meta.monotone[feat], is_cat, lout,
+                                  rout, pcmin, pcmax)
+
+
+def child_constraints_mono(mono, is_cat, lout, rout, pcmin, pcmax):
+    """``child_constraints`` on a pre-gathered per-feature monotone
+    direction — the fused megakernel's Mosaic body extracts ``mono``
+    with a select-sum (dynamic gathers do not lower) and shares the
+    rest of the math here."""
     mid = (lout + rout) * 0.5
     numerical = ~is_cat
     cmin_l = jnp.where(numerical & (mono < 0),
@@ -371,6 +418,62 @@ def child_columns(split, g, h, c, out, cmin, cmax, s, side, depth,
     if extra_i:
         i.update(extra_i)
     return f, i
+
+
+def make_scan_leaf(comm, meta_scan, params, feature_mask, node_rand,
+                   bundled: bool, max_depth: int):
+    """One leaf's best-split scan (debundle -> per-node randomness ->
+    comm.select_split -> max_depth blocking) — ONE definition shared by
+    the serial and partitioned grow bodies AND the fused megakernel's
+    interpret twin (ops/split_step_pallas.py). The twin's byte-exact
+    parity with the foil rests on this being the same function."""
+    def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
+        if bundled:
+            from ..ops.histogram import debundle_leaf_hist
+            hist = debundle_leaf_hist(hist, meta_scan, g, h, c,
+                                      comm.local_hist)
+        rb, nm = node_rand(salt)
+        fm = feature_mask if nm is None else nm  # nm already in-subset
+        res = comm.select_split(hist, g, h, c, meta_scan, params,
+                                cmin, cmax, fm, rand_bins=rb)
+        blocked = (max_depth > 0) & (depth >= max_depth)
+        return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
+    return scan_leaf
+
+
+def scan_split_pair(comm, scan_leaf, a_is_left, k, depth,
+                    hist_a, hist_b, lg, lh, lc, rg, rh, rc, lout, rout,
+                    cmin_l, cmax_l, cmin_r, cmax_r):
+    """Order the (a, b) child pair and scan both fresh children — the
+    shared non-CEGB composition of ``order_child_pair`` +
+    ``scan_children`` used by both grow bodies and the megakernel
+    twin."""
+    o = order_child_pair(a_is_left, k, lg, lh, lc, rg, rh, rc, lout,
+                         rout, cmin_l, cmax_l, cmin_r, cmax_r)
+    split_a, split_b = scan_children(
+        comm, scan_leaf, hist_a, hist_b, o["ga"], o["ha"], o["ca"],
+        o["gb"], o["hb"], o["cb"], depth, o["cmin_a"], o["cmax_a"],
+        o["cmin_b"], o["cmax_b"], o["salt_a"], o["salt_b"])
+    return o, split_a, split_b
+
+
+def split_node_updates(params, gain, feat, thr, dleft, is_cat,
+                       pg, ph, pc, ref_node, leaf, new):
+    """Tree-array column dicts + parent-pointer fixup scalars of one
+    split — one definition shared by the grow bodies and the fused
+    megakernel twin (``set_tree_col`` consumes the result)."""
+    from ..ops.split import leaf_output_no_constraint
+    dec = jnp.where(is_cat, 1, 0) + jnp.where(dleft, 2, 0)
+    upd = ref_node >= 0
+    pnode = jnp.where(upd, ref_node, 0)
+    parent_out = leaf_output_no_constraint(
+        pg, ph + 2e-15, params.lambda_l1, params.lambda_l2,
+        params.max_delta_step)
+    treef = dict(split_gain_arr=gain, internal_value=parent_out,
+                 internal_weight=ph, internal_count=pc)
+    treei = dict(split_feature=feat, threshold_bin=thr,
+                 decision_type=dec, left_child=~leaf, right_child=~new)
+    return treef, treei, pnode, upd
 
 
 def scan_children(comm, scan_leaf, hist_a, hist_b, ga, ha, ca,

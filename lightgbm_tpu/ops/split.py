@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 kEpsilon = 1e-15
@@ -238,7 +239,10 @@ def per_feature_numerical(hist: jnp.ndarray, parent_g, parent_h, parent_c,
                          jnp.asarray(parent_h_eps, jnp.float32),
                          jnp.asarray(parent_c, jnp.float32)]
                         )[:, None, None]
-    ch_is_h = jnp.asarray([False, True, False])[:, None, None]
+    # iota-compare instead of a materialized [3] constant: the fused
+    # split-step megakernel traces this scan INSIDE a Pallas kernel
+    # body, which rejects captured non-scalar constants
+    ch_is_h = jax.lax.broadcasted_iota(jnp.int32, (3, 1, 1), 0) == 1
 
     def seed_h(x):
         return jnp.where(ch_is_h, x + kEpsilon, x)

@@ -55,26 +55,26 @@ O_SCORE, O_THR, O_LG, O_LH, O_LC, O_DLEFT, O_WL, O_WR = range(8)
 
 def _scan_kernel(scal_ref, imeta_ref, fmeta_ref, hg_ref, hh_ref, hc_ref,
                  out_ref, *, f: int, b: int, p: SplitParams):
-    g = hg_ref[...]                                  # [F, B] f32
-    h = hh_ref[...]
-    c = hc_ref[...]
     # scal is [1, 5]: a 1-D SMEM operand would batch to an illegal
     # (1, 5)-block-over-(K, 5) spec under vmap (Mosaic requires the
     # trailing two block dims to equal the array dims); with the
     # explicit leading 1 the vmapped block (1, 1, 5) stays legal
-    pg = scal_ref[0, 0]
-    ph = scal_ref[0, 1]
-    pc = scal_ref[0, 2]
-    cmin = scal_ref[0, 3]
-    cmax = scal_ref[0, 4]
+    out_ref[...] = scan_core(
+        scal_ref[0, 0], scal_ref[0, 1], scal_ref[0, 2],
+        scal_ref[0, 3], scal_ref[0, 4],
+        imeta_ref[:, 0:1], imeta_ref[:, 1:2], imeta_ref[:, 2:3],
+        imeta_ref[:, 3:4], fmeta_ref[:, 0:1], fmeta_ref[:, 1:2],
+        hg_ref[...], hh_ref[...], hc_ref[...], f=f, b=b, p=p)
 
-    nb = imeta_ref[:, 0:1]                           # [F, 1] i32
-    missing = imeta_ref[:, 1:2]
-    defbin = imeta_ref[:, 2:3]
-    mono = imeta_ref[:, 3:4]
-    penalty = fmeta_ref[:, 0:1]                      # [F, 1] f32
-    fmask = fmeta_ref[:, 1:2]
 
+def scan_core(pg, ph, pc, cmin, cmax, nb, missing, defbin, mono,
+              penalty, fmask, g, h, c, *, f: int, b: int,
+              p: SplitParams):
+    """The fused numerical best-split scan on VALUES: per-leaf scalars,
+    [F, 1] metadata columns and [F, B] g/h/c planes in, the packed
+    [F, 8] result table out. Factored from ``_scan_kernel`` so the
+    split-step megakernel (ops/split_step_pallas.py) runs the SAME
+    Mosaic-proven scan for both fresh children inside one kernel."""
     bins = jax.lax.broadcasted_iota(jnp.int32, (f, b), 1)
 
     # gain algebra: the SHARED split.py helpers (pure jnp, static-param
@@ -163,11 +163,15 @@ def _scan_kernel(scal_ref, imeta_ref, fmeta_ref, hg_ref, hh_ref, hc_ref,
     score_m = jnp.where(ok_m, gains_m, NEG_INF)
 
     # ---- per-feature best with reference iteration-order tie-breaks ----
+    # threshold arg-extrema run in f32 (bins <= 65535 are exact): this
+    # jax's Mosaic cannot lower integer reductions, and the split-step
+    # megakernel reuses this core compiled
+    bins_f = bins.astype(jnp.float32)
     best_m = jnp.max(score_m, axis=1, keepdims=True)           # [F, 1]
     # _argmax_last: the -1 scan records the LARGEST winning threshold
-    t_m = jnp.max(jnp.where(score_m == best_m, bins, -1), axis=1,
+    t_m = jnp.max(jnp.where(score_m == best_m, bins_f, -1.0), axis=1,
                   keepdims=True)                               # [F, 1]
-    sel_m = (bins == t_m).astype(jnp.float32)                  # [F, B]
+    sel_m = (bins_f == t_m).astype(jnp.float32)                # [F, B]
     lg_m_t = jnp.sum(gl_m * sel_m, axis=1, keepdims=True)
     lh_m_t = jnp.sum(hl_m * sel_m, axis=1, keepdims=True)
     lc_m_t = jnp.sum(cl_m * sel_m, axis=1, keepdims=True)
@@ -175,9 +179,10 @@ def _scan_kernel(scal_ref, imeta_ref, fmeta_ref, hg_ref, hh_ref, hc_ref,
     if p.any_missing:
         best_p = jnp.max(score_p, axis=1, keepdims=True)
         # +1 scan records the SMALLEST winning threshold
-        t_p = jnp.min(jnp.where(score_p == best_p, bins, b), axis=1,
+        t_p = jnp.min(jnp.where(score_p == best_p, bins_f,
+                                jnp.float32(b)), axis=1,
                       keepdims=True)
-        sel_p = (bins == t_p).astype(jnp.float32)
+        sel_p = (bins_f == t_p).astype(jnp.float32)
         lg_p_t = jnp.sum(lg_p * sel_p, axis=1, keepdims=True)
         lh_p_t = jnp.sum(hl_p * sel_p, axis=1, keepdims=True)
         lc_p_t = jnp.sum(lc_p * sel_p, axis=1, keepdims=True)
@@ -204,7 +209,7 @@ def _scan_kernel(scal_ref, imeta_ref, fmeta_ref, hg_ref, hh_ref, hc_ref,
     wl_f = out_con(lg_f, lh_f)
     wr_f = out_con(pg - lg_f, parent_h_eps - lh_f)
 
-    out_ref[...] = jnp.concatenate(
+    return jnp.concatenate(
         [feat_score, feat_t.astype(jnp.float32), lg_f, lh_f, lc_f,
          dleft, wl_f, wr_f], axis=1)                           # [F, 8]
 
@@ -337,12 +342,18 @@ def scan_kernel_ok(params: SplitParams, rand_bins, cegb_uncharged) -> bool:
 def per_feature_numerical_pallas(hist, parent_g, parent_h, parent_c,
                                  meta, params: SplitParams,
                                  constraint_min, constraint_max,
-                                 feature_mask) -> PerFeatureSplits:
+                                 feature_mask,
+                                 interpret: bool | None = None
+                                 ) -> PerFeatureSplits:
     """Fused-kernel drop-in for ``per_feature_numerical`` (same output
     contract; categorical features come back masked with score=-inf and
-    must be merged by the caller exactly as with the XLA scan)."""
+    must be merged by the caller exactly as with the XLA scan).
+    ``interpret=None`` resolves per backend; the Mosaic-lowering tests
+    pass False explicitly (a backend-resolved default on a CPU host
+    would silently lower the interpret path instead of Mosaic)."""
     f, b, _ = hist.shape
-    interpret = jax.default_backend() not in ("tpu", "axon")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
     scal = jnp.stack([
         jnp.asarray(parent_g, jnp.float32),
         jnp.asarray(parent_h, jnp.float32),

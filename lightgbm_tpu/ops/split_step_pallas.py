@@ -1,0 +1,1410 @@
+"""One fused Pallas split-step megakernel (ROADMAP item 1).
+
+The reference wins its grow loop by doing almost nothing per split
+beyond one smaller-child histogram plus a subtraction
+(``serial_tree_learner.cpp:434-436``). PR 8 collapsed the XLA analog
+to 44 compiled ops/split (serial); this module collapses it to ONE:
+an entire split — best-leaf pick, leaf partition / row movement,
+smaller-child histogram build, sibling histogram subtraction, and the
+channel-stacked best-split scan of both fresh children — executes as a
+single ``pallas_call`` whose carry (per-leaf state ``S``, tree arrays
+``T``, the chosen leaf's histograms and every scan intermediate) never
+leaves VMEM between phases. The grow ``while_loop`` body shrinks to
+the kernel call plus the loop counter, measured by
+``tools/hlo_census.py`` (committed budget ``serial_grow_fused`` /
+``partitioned_grow_fused``: <= 10 dispatches/split vs the foil's
+44/78).
+
+Two layouts, one contract:
+
+* **leaf** (``fused_split_step_leaf``) — the serial learner's
+  ``leaf_id[N]`` layout: the kernel streams ``binned``/``ghc``/
+  ``leaf_id`` blocks, updates leaf membership in place and builds the
+  smaller child's histogram in the same pass over the leaf's rows.
+* **segment** (``fused_split_step_segment``) — the partitioned
+  learner's single row-major u8 training matrix
+  (``ops/hist_pallas.py`` layout): the kernel physically moves the
+  leaf's rows (stable partition, ``ops/partition_pallas.py``
+  semantics) and then streams the smaller child's contiguous segment.
+
+Each layout ships TWO kernel bodies behind one wrapper:
+
+* the **Mosaic TPU body** — real streamed DMA phases grounded in the
+  proven per-phase kernels (hist one-hot matmuls with exact bf16
+  hi/lo payload pairs, f32 one-hot lane selects instead of the i32
+  reductions this jax's Mosaic cannot lower, the split-scan core from
+  ``ops/split_scan_pallas.py``). Numerical-only scope (like
+  ``scan_kernel_ok``): categorical / EFB-bundled / multi-val configs
+  fall back to the per-phase foil.
+* the **interpret-mode CPU twin** — the SAME pallas_call contract, but
+  the body replicates the per-phase foil bit-for-bit by calling the
+  exact shared helpers the foil body calls (``split_leaf``,
+  ``build_histogram``/``histogram_segment``, ``make_scan_leaf``,
+  ``scan_split_pair``, ``StatePack.set_state_cols``/``set_tree_col``)
+  on ref-loaded values. Models trained through the twin are therefore
+  byte-identical to the foil by construction — the contract
+  ``tests/test_split_megakernel.py`` pins across bagging, categorical,
+  linear_tree and monotone configs on both learners. The twin covers
+  the FULL ``ops/split.py`` semantics (categorical + monotone paths).
+
+Capability gate: ``LGBM_TPU_FUSED_SPLIT_KERNEL`` /
+``Config.fused_split_kernel`` (default ``auto`` = on where lowerable).
+``fused_kernel_lowerable()`` runs the real Mosaic lowering pass
+host-side (``.trace().lower(lowering_platforms=("tpu",))``) and, when
+it rejects the kernel, classifies the failure into a
+``tools/probe_taxonomy.py`` reason code and records a
+``fused_split.not_lowerable`` telemetry event — the fallback to the
+per-phase foil is visible, never silent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.jit_registry import register_jit
+from .pallas_compat import tpu_compiler_params
+from .split import (MISSING_NAN_CODE, MISSING_ZERO_CODE, FeatureMeta,
+                    kEpsilon)
+
+NEG_INF = float("-inf")  # python scalar: kernels fold it as a constant
+
+# the megakernel runs a static 2-step grid (phase 0: partition +
+# smaller-child histogram; phase 1: sibling subtraction + both
+# children's scans + state/tree writes). Two steps also keep the
+# interpret twin's grid loop a real ``while`` in the compiled CPU HLO
+# (a 1-trip loop is inlined by XLA's simplifier), so the whole split
+# censuses as ONE dispatch — exactly what it is on TPU.
+FUSED_PHASES = 2
+
+FUSED_BLK = 2048          # row block of the compiled streaming phases
+SEG_BLK = 512             # compiled segment-partition block (the tri
+#                           permutation matmuls scale O(blk^2))
+ALIGN = 8                 # Mosaic u8/row DMA offset granule
+VMEM_LIMIT = 100 * 1024 * 1024
+
+_COMPILER_PARAMS = tpu_compiler_params(
+    has_side_effects=True, vmem_limit_bytes=VMEM_LIMIT)
+
+# imeta table columns (one [F, 8] i32 operand instead of eight [F]
+# gathers per split)
+IM_NBINS, IM_MISS, IM_DEFBIN, IM_MOSTFREQ, IM_MONO, IM_GROUP, \
+    IM_OFFSET, IM_ISCAT = range(8)
+
+
+def pack_meta_tables(meta: FeatureMeta, feature_mask):
+    """FeatureMeta + per-tree feature mask -> (imeta [F, 8] i32,
+    fmeta [F, 2] f32) kernel operands. Built once per grow trace
+    (loop-invariant; XLA hoists them out of the while body)."""
+    f = meta.num_bins.shape[0]
+    zeros = jnp.zeros((f,), jnp.int32)
+    group = meta.group if meta.group is not None else jnp.arange(f)
+    offset = meta.offset if meta.offset is not None else zeros
+    imeta = jnp.stack(
+        [meta.num_bins, meta.missing, meta.default_bin,
+         meta.most_freq_bin, meta.monotone, group, offset,
+         meta.is_categorical.astype(jnp.int32)], axis=1).astype(
+        jnp.int32)
+    fmeta = jnp.stack([meta.penalty,
+                       feature_mask.astype(jnp.float32)], axis=1)
+    return imeta, fmeta
+
+
+def _meta_from_tables(imeta, fmeta):
+    """Kernel-side FeatureMeta reconstruction (ref values in, the same
+    NamedTuple the shared scan helpers consume out)."""
+    f = imeta.shape[0]
+    return FeatureMeta(
+        num_bins=imeta[:, IM_NBINS], missing=imeta[:, IM_MISS],
+        default_bin=imeta[:, IM_DEFBIN],
+        most_freq_bin=imeta[:, IM_MOSTFREQ],
+        monotone=imeta[:, IM_MONO],
+        penalty=fmeta[:, 0],
+        is_categorical=imeta[:, IM_ISCAT].astype(bool),
+        group=imeta[:, IM_GROUP], offset=imeta[:, IM_OFFSET],
+        global_id=jnp.arange(f, dtype=jnp.int32)), fmeta[:, 1] > 0
+
+
+def _grow_pack(si_prefix, params, has_monotone, big_l):
+    from ..learner.split_step import make_grow_pack
+    return make_grow_pack(si_prefix, merged=True,
+                          has_cat=params.has_categorical,
+                          has_monotone=has_monotone, big_l=big_l)
+
+
+# =====================================================================
+# interpret-mode CPU twin bodies
+# =====================================================================
+
+def _twin_split_site(pack, s_ref, t_ref, bsb_ref, cbs_ref, k, big_l):
+    """Leaf pick + split-site read on ref-loaded values — the exact
+    ops the foil body runs (``jnp.argmax`` over the masked gain row,
+    one ``read_site`` column slice)."""
+    st = {"S": s_ref[...], "T": t_ref[...]}
+    if bsb_ref is not None:
+        st["bs_bitset"] = bsb_ref[...]
+        st["cat_bitsets"] = cbs_ref[...]
+    view = pack.view(st)
+    open_gain = jnp.where(jnp.arange(big_l) < k, view["bs_gain"],
+                          -jnp.inf)
+    leaf = jnp.argmax(open_gain).astype(jnp.int32)
+    site = pack.read_site(st, leaf)
+    bitset = view["bs_bitset"][leaf]
+    return st, view, leaf, site, bitset
+
+
+def _twin_finish(pack, params, meta, fmask, comm, st, site, leaf, new,
+                 s, k, gain, feat, thr, dleft, is_cat, hist_small,
+                 hist_other, small_is_left, *, bundled, has_monotone,
+                 max_depth, extra_a=None, extra_b=None):
+    """Shared tail of both twins: both children's scans + the packed
+    state/tree/bitset writes, via the SAME helpers the foil bodies
+    call (learner/split_step.py) so every value is bit-identical."""
+    from ..learner.split_step import (child_columns, child_constraints,
+                                      make_scan_leaf, scan_split_pair,
+                                      set_bitsets, split_node_updates)
+    inf = jnp.float32(jnp.inf)
+    lg, lh, lc = site["bs_lg"], site["bs_lh"], site["bs_lc"]
+    pg, ph, pc = site["leaf_g"], site["leaf_h"], site["leaf_c"]
+    rg, rh, rc = pg - lg, ph - lh, pc - lc
+    lout, rout = site["bs_lout"], site["bs_rout"]
+    pcmin = site.get("leaf_cmin", -inf)
+    pcmax = site.get("leaf_cmax", inf)
+    depth = site["leaf_depth"] + 1
+
+    cmin_l, cmax_l, cmin_r, cmax_r = child_constraints(
+        meta, feat, is_cat, lout, rout, pcmin, pcmax, has_monotone)
+    scan_leaf = make_scan_leaf(comm, meta, params, fmask,
+                               lambda salt: (None, None), bundled,
+                               max_depth)
+    idx_a = jnp.where(small_is_left, leaf, new)
+    idx_b = jnp.where(small_is_left, new, leaf)
+    o, split_a, split_b = scan_split_pair(
+        comm, scan_leaf, small_is_left, k, depth, hist_small,
+        hist_other, lg, lh, lc, rg, rh, rc, lout, rout,
+        cmin_l, cmax_l, cmin_r, cmax_r)
+    fa, ia = child_columns(split_a, o["ga"], o["ha"], o["ca"],
+                           o["out_a"], o["cmin_a"], o["cmax_a"],
+                           s, o["side_a"], depth,
+                           extra_i=extra_a(idx_a) if extra_a else None)
+    fb, ib = child_columns(split_b, o["gb"], o["hb"], o["cb"],
+                           o["out_b"], o["cmin_b"], o["cmax_b"],
+                           s, o["side_b"], depth,
+                           extra_i=extra_b(idx_b) if extra_b else None)
+    treef, treei, pnode, upd = split_node_updates(
+        params, gain, feat, thr, dleft, is_cat, pg, ph, pc,
+        site["ref_node"], leaf, new)
+    upds = pack.set_state_cols(st, idx_a, idx_b, fa, fb, ia, ib)
+    upds.update(pack.set_tree_col(st, s, treef, treei, pnode, upd,
+                                  site["ref_side"]))
+    view = pack.view(st)
+    upds.update(set_bitsets(pack, view, idx_a, idx_b,
+                            split_a.cat_bitset, split_b.cat_bitset, s,
+                            view["bs_bitset"][leaf]))
+    return upds, idx_a, idx_b
+
+
+def _leaf_kernel_ref(iscal, s_in, t_in, lid_in, hist_in, binned_ref,
+                     ghc_ref, imeta_ref, fmeta_ref,
+                     s_out, t_out, lid_out, hist_out,
+                     *, params, si_prefix, big_l, max_depth, b,
+                     bundled, has_monotone, hist_method,
+                     bsb_in=None, cbs_in=None, bsb_out=None,
+                     cbs_out=None):
+    """Interpret twin, leaf layout: the serial foil body transliterated
+    onto ref-loaded values (same helpers, same op order -> bit-exact).
+    """
+    del s_in, t_in, lid_in, hist_in  # aliased; all access via out refs
+    from ..learner.comm import SERIAL_COMM
+    from ..ops.histogram import build_histogram
+    from ..ops.partition import split_leaf
+    from ..data.bundling import decode_feature_bin
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        pack = _grow_pack(si_prefix, params, has_monotone, big_l)
+        meta, fmask = _meta_from_tables(imeta_ref[...], fmeta_ref[...])
+        k = iscal[0]
+        new = k
+        s = k - 1
+        st, view, leaf, site, bitset = _twin_split_site(
+            pack, s_out, t_out, bsb_out, cbs_out, k, big_l)
+        feat = site["bs_feat"]
+        thr = site["bs_thr"]
+        dleft = site["bs_dleft"]
+        gain = site["bs_gain"]
+        is_cat = site["bs_iscat"]
+        lc = site["bs_lc"]
+        rc = site["leaf_c"] - lc
+
+        # ---- partition (ops/partition.py split_leaf, as the foil) ---
+        binned = binned_ref[...]
+        ghc = ghc_ref[...]
+        bin_col = jnp.take(binned, meta.group[feat], axis=1)
+        if bundled:
+            bin_col = decode_feature_bin(
+                bin_col.astype(jnp.int32), meta.offset[feat],
+                meta.num_bins[feat]).astype(bin_col.dtype)
+        leaf_id = split_leaf(
+            lid_out[...], bin_col, leaf, new, thr, dleft,
+            meta.missing[feat], meta.default_bin[feat],
+            meta.num_bins[feat], is_cat, bitset)
+        lid_out[...] = leaf_id
+
+        # ---- smaller-child histogram + sibling subtraction ----------
+        small_is_left = lc <= rc
+        sm = jnp.where(small_is_left, leaf, new)
+        ghc_small = ghc * (leaf_id == sm).astype(jnp.float32)[:, None]
+        hist_small = build_histogram(binned, ghc_small, b,
+                                     method=hist_method)
+        parent_hist = hist_out[leaf]
+        hist_other = parent_hist - hist_small
+
+        # ---- scans + packed writes (shared tail) --------------------
+        upds, idx_a, idx_b = _twin_finish(
+            pack, params, meta, fmask, SERIAL_COMM, st, site, leaf,
+            new, s, k, gain, feat, thr, dleft, is_cat, hist_small,
+            hist_other, small_is_left, bundled=bundled,
+            has_monotone=has_monotone, max_depth=max_depth)
+        s_out[...] = upds["S"]
+        t_out[...] = upds["T"]
+        hist_out[idx_a] = hist_small
+        hist_out[idx_b] = hist_other
+        if bsb_out is not None:
+            bsb_out[...] = upds["bs_bitset"]
+            cbs_out[...] = upds["cat_bitsets"]
+
+
+def _segment_kernel_ref(iscal, s_in, t_in, mat_in, ws_in, hist_in,
+                        imeta_ref, fmeta_ref,
+                        s_out, t_out, mat_out, ws_out, hist_out,
+                        *, params, si_prefix, big_l, max_depth, b, f,
+                        n, bundled, has_monotone, blk,
+                        bsb_in=None, cbs_in=None, bsb_out=None,
+                        cbs_out=None):
+    """Interpret twin, segment layout: the partitioned foil body on
+    ref-loaded values. The stable partition is computed as an exact
+    prefix-sum permutation (bit-identical row content to
+    ``partition_segment``); the smaller child's histogram reuses the
+    SAME interpret-mode nibble kernel the foil streams
+    (``hist_pallas.histogram_segment``), so the float accumulation
+    order — and therefore the model — is bit-identical."""
+    del s_in, t_in, mat_in, ws_in, hist_in
+    from ..learner.comm import SERIAL_COMM
+    from ..learner.partitioned import partition_decision_lut
+    from ..ops.hist_pallas import histogram_segment
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        pack = _grow_pack(si_prefix, params, has_monotone, big_l)
+        meta, fmask = _meta_from_tables(imeta_ref[...], fmeta_ref[...])
+        k = iscal[0]
+        new = k
+        s = k - 1
+        st, view, leaf, site, bitset = _twin_split_site(
+            pack, s_out, t_out, bsb_out, cbs_out, k, big_l)
+        feat = site["bs_feat"]
+        thr = site["bs_thr"]
+        dleft = site["bs_dleft"]
+        gain = site["bs_gain"]
+        is_cat = site["bs_iscat"]
+        lc = site["bs_lc"]
+        rc = site["leaf_c"] - lc
+        begin = site["leaf_begin"]
+        cnt = site["leaf_cnt"]
+
+        # ---- stable in-place partition of [begin, begin+cnt) --------
+        # the EXACT decision of partition_pallas._partition_kernel
+        # (shared LUT construction; group-bin-space missing handling),
+        # applied as an exact integer prefix-sum permutation — bitwise
+        # the same row content the v1 kernel produces
+        grp_col, use_lut, lut = partition_decision_lut(
+            meta, feat, thr, dleft, is_cat, bitset, bundled)
+        mat = mat_out[...]
+        npad = mat.shape[0]
+        pos = jnp.arange(npad)
+        in_seg = (pos >= begin) & (pos < begin + cnt)
+        bv = jnp.take(mat, grp_col, axis=1).astype(jnp.int32)
+        miss = meta.missing[feat]
+        is_missing = jnp.where(
+            miss == MISSING_ZERO_CODE, bv == meta.default_bin[feat],
+            jnp.where(miss == MISSING_NAN_CODE,
+                      bv == meta.num_bins[feat] - 1, False))
+        num_left = jnp.where(is_missing, dleft.astype(bool),
+                             bv <= thr)
+        cat_left = jnp.take(lut[0], jnp.clip(bv, 0, 255)) > 0.5
+        go_left = jnp.where(use_lut, cat_left, num_left)
+        sel_l = in_seg & go_left
+        sel_r = in_seg & ~go_left
+        nl = sel_l.sum().astype(jnp.int32)
+        dst = jnp.where(
+            sel_l, begin + jnp.cumsum(sel_l) - 1,
+            jnp.where(sel_r, begin + nl + jnp.cumsum(sel_r) - 1, pos))
+        mat2 = jnp.zeros_like(mat).at[dst].set(mat)
+        mat_out[...] = mat2
+        nr = cnt - nl
+
+        # ---- smaller-child segment histogram + subtraction ----------
+        # the SAME interpret nibble kernel the foil streams — nested
+        # pallas_call, bit-identical block accumulation order
+        small_is_left = lc <= rc
+        sb = jnp.where(small_is_left, begin, begin + nl)
+        sc = jnp.where(small_is_left, nl, nr)
+        hist_small = histogram_segment(mat2, sb, sc, b, f, blk=blk,
+                                       interpret=True)
+        parent_hist = hist_out[leaf]
+        hist_other = parent_hist - hist_small
+
+        begin_b = jnp.where(small_is_left, begin + nl, begin)
+        cnt_b = cnt - sc
+
+        upds, idx_a, idx_b = _twin_finish(
+            pack, params, meta, fmask, SERIAL_COMM, st, site, leaf,
+            new, s, k, gain, feat, thr, dleft, is_cat, hist_small,
+            hist_other, small_is_left, bundled=bundled,
+            has_monotone=has_monotone, max_depth=max_depth,
+            extra_a=lambda _i: dict(leaf_begin=sb, leaf_cnt=sc),
+            extra_b=lambda _i: dict(leaf_begin=begin_b,
+                                    leaf_cnt=cnt_b))
+        s_out[...] = upds["S"]
+        t_out[...] = upds["T"]
+        hist_out[idx_a] = hist_small
+        hist_out[idx_b] = hist_other
+        if bsb_out is not None:
+            bsb_out[...] = upds["bs_bitset"]
+            cbs_out[...] = upds["cat_bitsets"]
+
+
+# =====================================================================
+# wrappers
+# =====================================================================
+
+def _whole(shape):
+    nd = len(shape)
+    return pl.BlockSpec(shape, lambda i, _nd=nd: (0,) * _nd)
+
+
+def _smem_spec(shape):
+    nd = len(shape)
+    return pl.BlockSpec(shape, lambda i, _nd=nd: (0,) * _nd,
+                        memory_space=pltpu.SMEM)
+
+
+def _call_common(alias_pairs, interpret):
+    return dict(
+        grid=(FUSED_PHASES,),
+        input_output_aliases=dict(alias_pairs),
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )
+
+
+@register_jit("fused_split_step_leaf", donate=("S", "T", "lid", "hist"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "si_prefix", "big_l", "max_depth", "b",
+                     "bundled", "has_monotone", "hist_method",
+                     "interpret"),
+    donate_argnames=("S", "T", "lid", "hist"))
+def fused_split_step_leaf(k, S, T, lid, hist, binned, ghc, imeta,
+                          fmeta, bsb=None, cbs=None, *, params,
+                          si_prefix=(), big_l, max_depth, b, bundled,
+                          has_monotone, hist_method, interpret,
+                          blk=FUSED_BLK):
+    """ONE whole split of the serial grow loop as one ``pallas_call``.
+
+    Carry in/out (aliased, donated): merged state ``S`` [Ks, L] f32,
+    tree arrays ``T`` [Kt, L-1] f32, ``lid`` [N] i32 leaf membership,
+    ``hist`` f32 per-leaf histogram cache — [L, G, B, 3] on the
+    interpret twin (the foil's layout), channels-major [L, 3, G, B]
+    on the compiled path (+ the categorical ``bsb``/``cbs`` bitset
+    arrays when the config carries them). Read-only: ``binned``
+    [N, G], ``ghc`` [N, 3], ``imeta``/``fmeta`` metadata tables.
+    ``k`` is the split index (new leaf id). Compiled path: ``N`` must
+    be padded to a multiple of ``blk`` (padding rows carry zero ghc).
+    """
+    iscal = jnp.reshape(jnp.asarray(k, jnp.int32), (1,))
+    has_cat = bsb is not None
+    if interpret:
+        ins = [iscal, S, T, lid, hist, binned, ghc, imeta, fmeta]
+        out_shape = [jax.ShapeDtypeStruct(S.shape, S.dtype),
+                     jax.ShapeDtypeStruct(T.shape, T.dtype),
+                     jax.ShapeDtypeStruct(lid.shape, lid.dtype),
+                     jax.ShapeDtypeStruct(hist.shape, hist.dtype)]
+        alias = [(1, 0), (2, 1), (3, 2), (4, 3)]
+        kern = functools.partial(
+            _leaf_kernel_ref,
+            params=params, si_prefix=si_prefix, big_l=big_l,
+            max_depth=max_depth, b=b, bundled=bundled,
+            has_monotone=has_monotone, hist_method=hist_method)
+        if has_cat:
+            ins += [bsb, cbs]
+            out_shape += [jax.ShapeDtypeStruct(bsb.shape, bsb.dtype),
+                          jax.ShapeDtypeStruct(cbs.shape, cbs.dtype)]
+            alias += [(9, 4), (10, 5)]
+
+            def kern2(iscal, s_i, t_i, l_i, h_i, bn, gh, im, fm,
+                      bsb_i, cbs_i, s_o, t_o, l_o, h_o, bsb_o, cbs_o,
+                      *scr):
+                return kern(iscal, s_i, t_i, l_i, h_i, bn, gh, im, fm,
+                            s_o, t_o, l_o, h_o, *scr, bsb_in=bsb_i,
+                            cbs_in=cbs_i, bsb_out=bsb_o, cbs_out=cbs_o)
+        else:
+            kern2 = kern
+        in_specs = [_smem_spec(iscal.shape)] + \
+            [_whole(x.shape) for x in ins[1:]]
+        out_specs = [_whole(s.shape) for s in out_shape]
+        res = pl.pallas_call(
+            kern2,
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            **_call_common(alias, interpret),
+        )(*ins)
+        return tuple(res)
+
+    # ---- compiled Mosaic path (numerical unbundled fast path) -------
+    f = binned.shape[1]
+    if has_cat or params.has_categorical or bundled:
+        raise NotImplementedError(
+            "fused split-step Mosaic body covers the numerical "
+            "unbundled fast path; categorical/EFB configs use the "
+            "per-phase kernels")
+    if b > 256 or f > MAX_FUSED_F:
+        raise NotImplementedError(
+            f"fused split-step Mosaic body: b={b} f={f} exceeds the "
+            f"u8-bin / {MAX_FUSED_F}-feature static scope")
+    if binned.shape[0] % blk or blk % ALIGN:
+        raise ValueError("compiled fused_split_step_leaf needs rows "
+                         f"padded to blk={blk}")
+    lid2 = lid.reshape(-1, 1)
+    ins = [iscal, S, T, lid2, hist, binned, ghc, imeta, fmeta]
+    out_shape = [jax.ShapeDtypeStruct(S.shape, S.dtype),
+                 jax.ShapeDtypeStruct(T.shape, T.dtype),
+                 jax.ShapeDtypeStruct(lid2.shape, lid2.dtype),
+                 jax.ShapeDtypeStruct(hist.shape, hist.dtype)]
+    alias = [(1, 0), (2, 1), (3, 2), (4, 3)]
+    kern = functools.partial(
+        _leaf_kernel_tpu,
+        params=params, si_prefix=si_prefix, big_l=big_l,
+        max_depth=max_depth, b=b, bundled=bundled,
+        has_monotone=has_monotone, hist_method=hist_method, blk=blk)
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs = [_smem_spec(iscal.shape), _whole(S.shape),
+                _whole(T.shape), any_spec, any_spec, any_spec,
+                any_spec, _whole(imeta.shape), _whole(fmeta.shape)]
+    out_specs = [_whole(S.shape), _whole(T.shape), any_spec, any_spec]
+    scratch = [
+        pltpu.VMEM((2, blk, f), jnp.uint8),          # bbuf
+        pltpu.VMEM((2, blk, 3), jnp.float32),        # gbuf
+        pltpu.VMEM((2, blk, 1), jnp.int32),          # lbuf
+        pltpu.VMEM((blk, 1), jnp.int32),             # lwb
+        pltpu.VMEM((5, f, b), jnp.float32),          # hpl planes
+        pltpu.VMEM((3, f, b), jnp.float32),          # pbuf parent
+        pltpu.VMEM((2, 3, f, b), jnp.float32),       # cbuf children
+        pltpu.SemaphoreType.DMA((2, 3)),             # sems (in)
+        pltpu.SemaphoreType.DMA((2,)),               # sem_w
+    ]
+    res = pl.pallas_call(
+        kern,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        **_call_common(alias, interpret),
+    )(*ins)
+    return (res[0], res[1], res[2].reshape(-1), res[3])
+
+
+@register_jit("fused_split_step_segment",
+              donate=("S", "T", "mat", "ws", "hist"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("params", "si_prefix", "big_l", "max_depth", "b",
+                     "f", "n", "bundled", "has_monotone", "blk",
+                     "interpret"),
+    donate_argnames=("S", "T", "mat", "ws", "hist"))
+def fused_split_step_segment(k, S, T, mat, ws, hist, imeta, fmeta,
+                             bsb=None, cbs=None, *, params,
+                             si_prefix, big_l, max_depth, b, f, n,
+                             bundled, has_monotone, blk=FUSED_BLK,
+                             interpret=True):
+    """ONE whole split of the partitioned grow loop as one
+    ``pallas_call`` over the training matrix (``mat``/``ws`` aliased
+    in place like ``partition_segment``). The interpret twin keeps the
+    foil's ``[L, F, B, 3]`` histogram cache; the compiled path takes
+    the channels-major ``[L, 3, F, B]`` layout (see
+    ``fused_split_step_leaf``)."""
+    iscal = jnp.reshape(jnp.asarray(k, jnp.int32), (1,))
+    has_cat = bsb is not None
+    if interpret:
+        ins = [iscal, S, T, mat, ws, hist, imeta, fmeta]
+        out_shape = [jax.ShapeDtypeStruct(S.shape, S.dtype),
+                     jax.ShapeDtypeStruct(T.shape, T.dtype),
+                     jax.ShapeDtypeStruct(mat.shape, mat.dtype),
+                     jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+                     jax.ShapeDtypeStruct(hist.shape, hist.dtype)]
+        alias = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4)]
+        kern = functools.partial(
+            _segment_kernel_ref,
+            params=params, si_prefix=si_prefix, big_l=big_l,
+            max_depth=max_depth, b=b, f=f, n=n, bundled=bundled,
+            has_monotone=has_monotone, blk=blk)
+        if has_cat:
+            ins += [bsb, cbs]
+            out_shape += [jax.ShapeDtypeStruct(bsb.shape, bsb.dtype),
+                          jax.ShapeDtypeStruct(cbs.shape, cbs.dtype)]
+            alias += [(8, 5), (9, 6)]
+
+            def kern2(iscal, s_i, t_i, m_i, w_i, h_i, im, fm, bsb_i,
+                      cbs_i, s_o, t_o, m_o, w_o, h_o, bsb_o, cbs_o,
+                      *scr):
+                return kern(iscal, s_i, t_i, m_i, w_i, h_i, im, fm,
+                            s_o, t_o, m_o, w_o, h_o, *scr,
+                            bsb_in=bsb_i, cbs_in=cbs_i, bsb_out=bsb_o,
+                            cbs_out=cbs_o)
+        else:
+            kern2 = kern
+        in_specs = [_smem_spec(iscal.shape)] + \
+            [_whole(x.shape) for x in ins[1:]]
+        out_specs = [_whole(s.shape) for s in out_shape]
+        res = pl.pallas_call(
+            kern2,
+            out_shape=out_shape,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            **_call_common(alias, interpret),
+        )(*ins)
+        return tuple(res)
+
+    # ---- compiled Mosaic path (numerical unbundled fast path) -------
+    if has_cat or params.has_categorical or bundled:
+        raise NotImplementedError(
+            "fused split-step Mosaic body covers the numerical "
+            "unbundled fast path; categorical/EFB configs use the "
+            "per-phase kernels")
+    if b > 256 or f > MAX_FUSED_F:
+        raise NotImplementedError(
+            f"fused split-step Mosaic body: b={b} f={f} exceeds the "
+            f"u8-bin / {MAX_FUSED_F}-feature static scope")
+    seg_blk = SEG_BLK
+    win = seg_blk + ALIGN
+    cols = mat.shape[1]
+    ins = [iscal, S, T, mat, ws, hist, imeta, fmeta]
+    out_shape = [jax.ShapeDtypeStruct(S.shape, S.dtype),
+                 jax.ShapeDtypeStruct(T.shape, T.dtype),
+                 jax.ShapeDtypeStruct(mat.shape, mat.dtype),
+                 jax.ShapeDtypeStruct(ws.shape, ws.dtype),
+                 jax.ShapeDtypeStruct(hist.shape, hist.dtype)]
+    alias = [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4)]
+    kern = functools.partial(
+        _segment_kernel_tpu,
+        params=params, si_prefix=si_prefix, big_l=big_l,
+        max_depth=max_depth, b=b, f=f, n=n, bundled=bundled,
+        has_monotone=has_monotone, blk=seg_blk)
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs = [_smem_spec(iscal.shape), _whole(S.shape),
+                _whole(T.shape), any_spec, any_spec, any_spec,
+                _whole(imeta.shape), _whole(fmeta.shape)]
+    out_specs = [_whole(S.shape), _whole(T.shape), any_spec, any_spec,
+                 any_spec]
+    scratch = [
+        pltpu.VMEM((win, cols), jnp.uint8),          # inbuf
+        pltpu.VMEM((win, cols), jnp.float32),        # staged
+        pltpu.VMEM((win, cols), jnp.uint8),          # flushbuf
+        pltpu.VMEM((win, cols), jnp.uint8),          # rbuf
+        pltpu.VMEM((5, f, b), jnp.float32),          # hpl planes
+        pltpu.VMEM((3, f, b), jnp.float32),          # pbuf parent
+        pltpu.VMEM((2, 3, f, b), jnp.float32),       # cbuf children
+        pltpu.SMEM((1,), jnp.int32),                 # nl carry
+        pltpu.SemaphoreType.DMA((3,)),               # sems
+        pltpu.SemaphoreType.DMA((2,)),               # sem_w
+    ]
+    res = pl.pallas_call(
+        kern,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        **_call_common(alias, interpret),
+    )(*ins)
+    return tuple(res)
+
+
+# =====================================================================
+# capability gate: config/env mode + static scope + Mosaic lowerability
+# =====================================================================
+
+def fused_compiled_ok(params, *, bundled: bool,
+                      num_bins_max: int) -> bool:
+    """Static scope of the COMPILED Mosaic bodies. The interpret twin
+    covers the full ``ops/split.py`` semantics; the Mosaic bodies keep
+    the numerical fast path (like ``scan_kernel_ok``): no categorical
+    scan, unbundled columns, u8-expressible bins."""
+    return (not params.has_categorical and not bundled
+            and num_bins_max <= 256)
+
+
+_LOWER_CACHE: dict = {}
+
+
+def probe_fused_lowering(layout: str):
+    """Run the REAL Mosaic lowering pass host-side on the megakernel at
+    a tiny canonical shape. Returns ``(ok, reason_code, detail)`` —
+    the reason code comes from ``tools/probe_taxonomy.py`` so a
+    capability-gate fallback is diagnosable from telemetry instead of
+    silent (ROADMAP item 6 discipline)."""
+    if layout in _LOWER_CACHE:
+        return _LOWER_CACHE[layout]
+    try:
+        _lower_for_tpu(layout)
+        res = (True, "", "")
+    except NotImplementedError as e:
+        res = (False, "not_lowerable", f"{type(e).__name__}: {e}")
+    except Exception as e:  # noqa: BLE001 - classify every failure
+        try:
+            import sys
+            sys.path.insert(0, __file__.rsplit("/lightgbm_tpu", 1)[0])
+            from tools.probe_taxonomy import classify_probe_failure
+            code = classify_probe_failure(f"{type(e).__name__}: {e}")
+        except Exception:  # noqa: BLE001
+            code = "unknown"
+        res = (False, code, f"{type(e).__name__}: {str(e)[:300]}")
+    _LOWER_CACHE[layout] = res
+    if not res[0]:
+        from ..utils.log import log_warning
+        from ..observability.telemetry import get_telemetry
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.count(f"fused_split.{res[1]}", 1)
+        log_warning(
+            f"fused split-step megakernel ({layout}) cannot lower on "
+            f"this Mosaic (reason_code={res[1]}); falling back to the "
+            f"per-phase kernels. Detail: {res[2][:200]}")
+    return res
+
+
+def _probe_pack_shapes(layout: str):
+    from ..learner.split_step import make_grow_pack
+    from ..ops.split import SplitParams
+    params = SplitParams(
+        lambda_l1=0.0, lambda_l2=1.0, max_delta_step=0.0,
+        min_data_in_leaf=1.0, min_sum_hessian_in_leaf=1e-3,
+        min_gain_to_split=0.0, any_missing=False)
+    big_l = 15
+    prefix = ("leaf_begin", "leaf_cnt") if layout == "segment" else ()
+    pack = make_grow_pack(prefix, merged=True, has_cat=False,
+                          has_monotone=False, big_l=big_l)
+    ks = len(pack.sf_fields) + len(pack.si_fields)
+    kt = len(pack.tf_fields) + len(pack.ti_fields)
+    return params, pack, big_l, ks, kt, prefix
+
+
+def _lower_for_tpu(layout: str):
+    """Trace + Mosaic-lower the compiled kernel body at a tiny
+    canonical shape (no TPU needed — the same mechanism as
+    tests/test_mosaic_lowering.py)."""
+    params, pack, big_l, ks, kt, prefix = _probe_pack_shapes(layout)
+    f, b, n = 8, 16, FUSED_BLK
+    imeta = jnp.zeros((f, 8), jnp.int32)
+    fmeta = jnp.ones((f, 2), jnp.float32)
+    S = jnp.zeros((ks, big_l), jnp.float32)
+    T = jnp.zeros((kt, big_l - 1), jnp.float32)
+    hist = jnp.zeros((big_l, f, b, 3), jnp.float32)
+    if layout == "leaf":
+        fn = functools.partial(
+            fused_split_step_leaf, params=params, si_prefix=prefix,
+            big_l=big_l, max_depth=-1, b=b, bundled=False,
+            has_monotone=False, hist_method="auto", interpret=False)
+        args = (jnp.int32(1), S, T, jnp.zeros((n,), jnp.int32), hist,
+                jnp.zeros((n, f), jnp.uint8),
+                jnp.zeros((n, 3), jnp.float32), imeta, fmeta)
+    else:
+        from .hist_pallas import matrix_cols, matrix_rows
+        mat = jnp.zeros((matrix_rows(n, FUSED_BLK), matrix_cols(f)),
+                        jnp.uint8)
+        fn = functools.partial(
+            fused_split_step_segment, params=params, si_prefix=prefix,
+            big_l=big_l, max_depth=-1, b=b, f=f, n=n, bundled=False,
+            has_monotone=False, blk=FUSED_BLK, interpret=False)
+        args = (jnp.int32(1), S, T, mat, jnp.zeros_like(mat), hist,
+                imeta, fmeta)
+    # probe-only jit: never dispatched, exists to run Mosaic lowering
+    jax.jit(fn).trace(*args).lower(  # graftlint: allow[GL506]
+        lowering_platforms=("tpu",))
+
+
+def fused_kernel_lowerable(layout: str) -> bool:
+    return probe_fused_lowering(layout)[0]
+
+
+def learner_fused_kernel_on(lrn, layout: str) -> bool:
+    """Resolve the megakernel gate for one learner instance: config
+    param (``fused_split_kernel``) + env override
+    (``LGBM_TPU_FUSED_SPLIT_KERNEL``) + static eligibility + Mosaic
+    lowerability in ``auto`` mode. Read per train() call so flipping
+    the env retraces."""
+    from ..learner.split_step import (fused_split_eligible,
+                                      fused_split_kernel_mode,
+                                      split_fusion_default)
+    mode = fused_split_kernel_mode(
+        getattr(lrn.config, "fused_split_kernel", "auto"))
+    if mode == "off":
+        return False
+    if not fused_split_eligible(
+            lrn.params, cache_hists=getattr(lrn, "cache_hists", False),
+            merged=split_fusion_default(),
+            extra_trees=lrn.extra_trees, ff_bynode=lrn.ff_bynode,
+            mv_groups=getattr(lrn, "mv_groups", 0),
+            serial_comm=True, num_leaves=lrn.num_leaves):
+        return False
+    if mode == "on":
+        return True
+    # auto = default on where lowerable: compiled backends whose
+    # Mosaic accepts the kernel at this config's static scope (the
+    # compiled path also hands forced-split pre-steps to the foil, so
+    # plans keep the per-phase kernels wholesale)
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    if getattr(lrn, "forced_plan", ()):
+        return False
+    if not fused_compiled_ok(lrn.params, bundled=lrn.bundled,
+                             num_bins_max=lrn.num_bins_max):
+        return False
+    return fused_kernel_lowerable(layout)
+
+
+# =====================================================================
+# Mosaic TPU bodies (compiled path; numerical-only scope)
+# =====================================================================
+#
+# Lowering discipline (this jax's Mosaic): no integer reductions (all
+# lane/row extractions are f32 select-sums — exact, every integer in
+# the state is < 2^24), no dynamic gathers (select-sum again), no
+# transposes (the hist accumulates per-feature [8, B] slabs and the
+# per-leaf histogram cache rides CHANNELS-MAJOR [L, 3, F, B] on the
+# compiled path so every plane is a static-leading-index slice), bool
+# vectors only as compare->select intermediates.
+
+MAX_FUSED_F = 192      # static per-feature unroll cap (program size)
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def _bitcast_col_f32(ivals):
+    """[K, 1] f32 bit-pattern column from i32 scalars. Assembled as an
+    i32 VECTOR first and bitcast once — Mosaic's tpu.bitcast only
+    accepts vectors, never scalars."""
+    kk = len(ivals)
+    rio = jax.lax.broadcasted_iota(jnp.int32, (kk, 1), 0)
+    col = jnp.zeros((kk, 1), jnp.int32)
+    for j, v in enumerate(ivals):
+        col = jnp.where(rio == j, jnp.asarray(v, jnp.int32), col)
+    return jax.lax.bitcast_convert_type(col, jnp.float32)
+
+
+def _select_sum(row, lane_iota, idx_f):
+    """Exact scalar extraction ``row[idx]`` without a dynamic gather:
+    select-then-sum (select, not multiply — masked -inf/NaN lanes must
+    not poison the sum)."""
+    return jnp.sum(jnp.where(lane_iota == idx_f, row, 0.0))
+
+
+class _SiteTPU:
+    """Split-site reads on the merged state matrix inside the Mosaic
+    body: float rows read directly, int rows via bitcast -> exact f32
+    convert -> select-sum -> i32."""
+
+    def __init__(self, pack, S, big_l):
+        self.pack = pack
+        self.nf = len(pack.sf_fields)
+        self.SF = S[:self.nf]
+        si = jax.lax.bitcast_convert_type(S[self.nf:], jnp.int32)
+        self.SI_f = si.astype(jnp.float32)     # exact: |v| < 2^24
+        self.lane = jax.lax.broadcasted_iota(jnp.float32, (1, big_l), 1)
+
+    def row_f(self, name):
+        i = self.pack.sf_idx[name]
+        return self.SF[i:i + 1]                # [1, L]
+
+    def f(self, name, leaf_f):
+        return _select_sum(self.row_f(name), self.lane, leaf_f)
+
+    def i_f(self, name, leaf_f):
+        """Int field as an exact f32 scalar."""
+        i = self.pack.si_idx[name]
+        return _select_sum(self.SI_f[i:i + 1], self.lane, leaf_f)
+
+
+def _imeta_col_f(imeta_f, col, fio, feat_f):
+    return _select_sum(imeta_f[:, col:col + 1], fio, feat_f)
+
+
+def _state_column(pack, fd, idd):
+    """[Ks, 1] f32 state column from the child_columns dicts — floats
+    verbatim, ints bitcast (selects preserve bit patterns exactly)."""
+    nf = len(pack.sf_fields)
+    rio = jax.lax.broadcasted_iota(jnp.float32, (nf, 1), 0)
+    colf = jnp.zeros((nf, 1), jnp.float32)
+    for j, name in enumerate(pack.sf_fields):
+        colf = jnp.where(rio == j, _f32(fd[name]), colf)
+    coli = _bitcast_col_f32([idd[name] for name in pack.si_fields])
+    return jnp.concatenate([colf, coli], axis=0)
+
+
+def _tree_column(pack, treef, treei):
+    nt = len(pack.tf_fields)
+    rio = jax.lax.broadcasted_iota(jnp.float32, (nt, 1), 0)
+    colf = jnp.zeros((nt, 1), jnp.float32)
+    for j, name in enumerate(pack.tf_fields):
+        colf = jnp.where(rio == j, _f32(treef[name]), colf)
+    coli = _bitcast_col_f32([treei[name] for name in pack.ti_fields])
+    return jnp.concatenate([colf, coli], axis=0)
+
+
+def _best_feature(out, f):
+    """assemble_split on the scan_core [F, 8] table, gather-free:
+    first-index argmax + per-column select-sums."""
+    from .split_scan_pallas import (O_SCORE, O_THR, O_LG, O_LH, O_LC,
+                                    O_DLEFT, O_WL, O_WR)
+    fio = jax.lax.broadcasted_iota(jnp.float32, (f, 1), 0)
+    score = out[:, O_SCORE:O_SCORE + 1]
+    best = jnp.max(score)
+    fidx = jnp.min(jnp.where(score == best, fio, jnp.float32(f)))
+
+    def col(j):
+        return _select_sum(out[:, j:j + 1], fio, fidx)
+
+    return dict(gain=col(O_SCORE), feature=fidx.astype(jnp.int32),
+                threshold=col(O_THR).astype(jnp.int32),
+                default_left=col(O_DLEFT) > 0.5,
+                left_g=col(O_LG), left_h=col(O_LH) - kEpsilon,
+                left_c=col(O_LC), left_output=col(O_WL),
+                right_output=col(O_WR))
+
+
+class _SplitScalars:
+    """Duck-typed stand-in for ops.split.SplitResult inside the Mosaic
+    body (child_columns only reads attributes)."""
+
+    def __init__(self, d):
+        self.gain = d["gain"]
+        self.feature = d["feature"]
+        self.threshold = d["threshold"]
+        self.default_left = d["default_left"]
+        self.left_g = d["left_g"]
+        self.left_h = d["left_h"]
+        self.left_c = d["left_c"]
+        self.left_output = d["left_output"]
+        self.right_output = d["right_output"]
+        self.is_cat = jnp.bool_(False)
+        self.cat_bitset = None
+
+
+def _scan_and_write_phase(pack, params, iscal, S, T, imeta_ref,
+                          fmeta_ref, s_out, t_out, g_sm, h_sm, c_sm,
+                          pbuf, cbuf, hist_out, sem_w, *, big_l,
+                          max_depth, b, f, has_monotone,
+                          extra_ab=None):
+    """Shared phase-1 tail of both Mosaic bodies: sibling subtraction,
+    both children's scan_core runs, best-feature extraction, and the
+    packed state/tree/hist writes. ``extra_ab(site, leaf_f,
+    small_is_left)`` optionally returns the segment-bound int fields
+    of each child (partitioned layout)."""
+    from ..learner.split_step import (child_columns,
+                                      child_constraints_mono,
+                                      order_child_pair,
+                                      split_node_updates)
+    from .split_scan_pallas import scan_core
+
+    k = iscal[0]
+    new = k
+    s = k - 1
+    site = _SiteTPU(pack, S, big_l)
+    kf = k.astype(jnp.float32)
+    open_gain = jnp.where(site.lane < kf, site.row_f("bs_gain"),
+                          NEG_INF)
+    best = jnp.max(open_gain)
+    leaf_f = jnp.min(jnp.where(open_gain == best, site.lane,
+                               jnp.float32(big_l)))
+    leaf = leaf_f.astype(jnp.int32)
+
+    gain = site.f("bs_gain", leaf_f)
+    lg = site.f("bs_lg", leaf_f)
+    lh = site.f("bs_lh", leaf_f)
+    lc = site.f("bs_lc", leaf_f)
+    lout = site.f("bs_lout", leaf_f)
+    rout = site.f("bs_rout", leaf_f)
+    pg = site.f("leaf_g", leaf_f)
+    ph = site.f("leaf_h", leaf_f)
+    pc = site.f("leaf_c", leaf_f)
+    feat = site.i_f("bs_feat", leaf_f).astype(jnp.int32)
+    feat_f = site.i_f("bs_feat", leaf_f)
+    thr = site.i_f("bs_thr", leaf_f).astype(jnp.int32)
+    dleft = site.i_f("bs_dleft", leaf_f) > 0.5
+    ref_node = site.i_f("ref_node", leaf_f).astype(jnp.int32)
+    pside = site.i_f("ref_side", leaf_f).astype(jnp.int32)
+    depth = site.i_f("leaf_depth", leaf_f).astype(jnp.int32) + 1
+    if has_monotone:
+        pcmin = site.f("leaf_cmin", leaf_f)
+        pcmax = site.f("leaf_cmax", leaf_f)
+    else:
+        pcmin = jnp.float32(-jnp.inf)
+        pcmax = jnp.float32(jnp.inf)
+    is_cat = jnp.bool_(False)
+
+    rg, rh, rc = pg - lg, ph - lh, pc - lc
+    small_is_left = lc <= rc
+    idx_a = jnp.where(small_is_left, leaf, new)
+    idx_b = jnp.where(small_is_left, new, leaf)
+
+    # sibling subtraction (channels-major parent slab)
+    g_ot = pbuf[0] - g_sm
+    h_ot = pbuf[1] - h_sm
+    c_ot = pbuf[2] - c_sm
+
+    imeta_f = imeta_ref[...].astype(jnp.float32)
+    fio = jax.lax.broadcasted_iota(jnp.float32, (f, 1), 0)
+    mono_feat = _imeta_col_f(imeta_f, IM_MONO, fio, feat_f) \
+        .astype(jnp.int32)
+    cmin_l, cmax_l, cmin_r, cmax_r = child_constraints_mono(
+        mono_feat, is_cat, lout, rout, pcmin, pcmax) \
+        if has_monotone else (pcmin, pcmax, pcmin, pcmax)
+
+    o = order_child_pair(small_is_left, k, lg, lh, lc, rg, rh, rc,
+                         lout, rout, cmin_l, cmax_l, cmin_r, cmax_r)
+
+    nb_col = imeta_ref[:, IM_NBINS:IM_NBINS + 1]
+    miss_col = imeta_ref[:, IM_MISS:IM_MISS + 1]
+    defbin_col = imeta_ref[:, IM_DEFBIN:IM_DEFBIN + 1]
+    mono_col = imeta_ref[:, IM_MONO:IM_MONO + 1]
+    pen_col = fmeta_ref[:, 0:1]
+    fmask_col = fmeta_ref[:, 1:2]
+
+    def scan(gch, hch, cch, gpar, hpar, cpar, cmin, cmax):
+        return scan_core(gpar, hpar, cpar, cmin, cmax, nb_col,
+                         miss_col, defbin_col, mono_col, pen_col,
+                         fmask_col, gch, hch, cch, f=f, b=b, p=params)
+
+    out_a = scan(g_sm, h_sm, c_sm, o["ga"], o["ha"], o["ca"],
+                 o["cmin_a"], o["cmax_a"])
+    out_b = scan(g_ot, h_ot, c_ot, o["gb"], o["hb"], o["cb"],
+                 o["cmin_b"], o["cmax_b"])
+    blocked = jnp.bool_(max_depth > 0) & (depth >= max_depth)
+    sa = _best_feature(out_a, f)
+    sb = _best_feature(out_b, f)
+    sa["gain"] = jnp.where(blocked, NEG_INF, sa["gain"])
+    sb["gain"] = jnp.where(blocked, NEG_INF, sb["gain"])
+
+    extra_a = extra_b = None
+    if extra_ab is not None:
+        extra_a, extra_b = extra_ab(site, leaf_f, small_is_left)
+    fa, ia = child_columns(_SplitScalars(sa), o["ga"], o["ha"],
+                           o["ca"], o["out_a"], o["cmin_a"],
+                           o["cmax_a"], s, o["side_a"], depth,
+                           extra_i=extra_a)
+    fb, ib = child_columns(_SplitScalars(sb), o["gb"], o["hb"],
+                           o["cb"], o["out_b"], o["cmin_b"],
+                           o["cmax_b"], s, o["side_b"], depth,
+                           extra_i=extra_b)
+    treef, treei, pnode, upd = split_node_updates(
+        params, gain, feat, thr, dleft, is_cat, pg, ph, pc, ref_node,
+        leaf, new)
+
+    # ---- packed state/tree writes (lane selects == foil scatters) ---
+    col_a = _state_column(pack, fa, ia)
+    col_b = _state_column(pack, fb, ib)
+    idx_a_f = idx_a.astype(jnp.float32)
+    idx_b_f = idx_b.astype(jnp.float32)
+    S2 = jnp.where(site.lane == idx_a_f, col_a,
+                   jnp.where(site.lane == idx_b_f, col_b, S))
+    s_out[...] = S2
+
+    kt = len(pack.tf_fields) + len(pack.ti_fields)
+    lane_t = jax.lax.broadcasted_iota(jnp.float32, (1, big_l - 1), 1)
+    rio_t = jax.lax.broadcasted_iota(jnp.float32, (kt, 1), 0)
+    s_f = s.astype(jnp.float32)
+    T2 = jnp.where(lane_t == s_f, _tree_column(pack, treef, treei), T)
+    r0 = len(pack.tf_fields) + pack.ti_idx["left_child"]
+    pnode_f = pnode.astype(jnp.float32)
+    ptr = jax.lax.bitcast_convert_type(
+        jnp.broadcast_to(jnp.asarray(s, jnp.int32), (1, 1)),
+        jnp.float32)
+    for side in (0, 1):
+        cond = (rio_t == r0 + side) & (lane_t == pnode_f) \
+            & upd & (pside == side)
+        T2 = jnp.where(cond, ptr, T2)
+    t_out[...] = T2
+
+    # ---- children -> channels-major per-leaf histogram cache --------
+    cbuf[0, 0] = g_sm
+    cbuf[0, 1] = h_sm
+    cbuf[0, 2] = c_sm
+    cbuf[1, 0] = g_ot
+    cbuf[1, 1] = h_ot
+    cbuf[1, 2] = c_ot
+    cp = pltpu.make_async_copy(cbuf.at[0], hist_out.at[idx_a],
+                               sem_w.at[0])
+    cp.start()
+    cp.wait()
+    cp = pltpu.make_async_copy(cbuf.at[1], hist_out.at[idx_b],
+                               sem_w.at[1])
+    cp.start()
+    cp.wait()
+
+
+def _leaf_site_scalars(pack, iscal, s_in, imeta_ref, big_l):
+    """Phase-0 split-site scalars: chosen leaf + partition decision
+    inputs, all f32 (gather-free select-sums)."""
+    k = iscal[0]
+    site = _SiteTPU(pack, s_in[...], big_l)
+    kf = k.astype(jnp.float32)
+    open_gain = jnp.where(site.lane < kf, site.row_f("bs_gain"),
+                          NEG_INF)
+    best = jnp.max(open_gain)
+    leaf_f = jnp.min(jnp.where(open_gain == best, site.lane,
+                               jnp.float32(big_l)))
+    leaf = leaf_f.astype(jnp.int32)
+    lc = site.f("bs_lc", leaf_f)
+    pc = site.f("leaf_c", leaf_f)
+    small_is_left = lc <= (pc - lc)
+    sm = jnp.where(small_is_left, leaf, k)
+    feat_f = site.i_f("bs_feat", leaf_f)
+    thr_f = site.i_f("bs_thr", leaf_f)
+    dleft_f = site.i_f("bs_dleft", leaf_f)
+    f = imeta_ref.shape[0]
+    imeta_f = imeta_ref[...].astype(jnp.float32)
+    fio = jax.lax.broadcasted_iota(jnp.float32, (f, 1), 0)
+    miss_f = _imeta_col_f(imeta_f, IM_MISS, fio, feat_f)
+    defbin_f = _imeta_col_f(imeta_f, IM_DEFBIN, fio, feat_f)
+    nbins_f = _imeta_col_f(imeta_f, IM_NBINS, fio, feat_f)
+    return leaf, k, sm, feat_f, thr_f, dleft_f, miss_f, defbin_f, \
+        nbins_f
+
+
+def _leaf_kernel_tpu(iscal, s_in, t_in, lid_in, hist_in, binned_in,
+                     ghc_in, imeta_ref, fmeta_ref,
+                     s_out, t_out, lid_out, hist_out,
+                     bbuf, gbuf, lbuf, lwb, hpl, pbuf, cbuf, sems,
+                     sem_w,
+                     *, params, si_prefix, big_l, max_depth, b,
+                     bundled, has_monotone, hist_method, blk):
+    """Mosaic body, leaf layout: phase 0 streams binned/ghc/leaf_id
+    blocks (double-buffered DMA), decides the chosen leaf's rows,
+    writes leaf membership in place and accumulates the SMALLER
+    child's histogram as per-feature one-hot matmuls with exact bf16
+    hi/lo payload pairs; phase 1 subtracts the cached parent
+    (channels-major cache row), runs scan_core for both children and
+    writes the packed state/tree/hist carry — no intermediate ever
+    leaves VMEM."""
+    del lid_in, hist_in, hist_method, bundled  # aliased / unused
+    from .hist_pallas import _split_hi_lo_f32
+    pack = _grow_pack(si_prefix, params, has_monotone, big_l)
+    pid = pl.program_id(0)
+    f = binned_in.shape[1]
+    nblk = binned_in.shape[0] // blk
+
+    @pl.when(pid == 0)
+    def _phase0():
+        (leaf, new, sm, feat_f, thr_f, dleft_f, miss_f, defbin_f,
+         nbins_f) = _leaf_site_scalars(pack, iscal, s_in, imeta_ref,
+                                       big_l)
+        for ch in range(5):
+            hpl[ch] = jnp.zeros_like(hpl[ch])
+
+        def in_dma(slot, i):
+            start = pl.multiple_of(i * blk, ALIGN)
+            return (
+                pltpu.make_async_copy(
+                    binned_in.at[pl.ds(start, blk), :], bbuf.at[slot],
+                    sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    ghc_in.at[pl.ds(start, blk), :], gbuf.at[slot],
+                    sems.at[slot, 1]),
+                pltpu.make_async_copy(
+                    lid_out.at[pl.ds(start, blk), :], lbuf.at[slot],
+                    sems.at[slot, 2]),
+            )
+
+        def start_dma(slot, i):
+            for cp in in_dma(slot, i):
+                cp.start()
+
+        def wait_dma(slot, i):
+            for cp in in_dma(slot, i):
+                cp.wait()
+
+        start_dma(0, 0)
+        lane_b = jax.lax.broadcasted_iota(jnp.float32, (1, f), 1)
+        bins_l = jax.lax.broadcasted_iota(jnp.float32, (1, b), 1)
+
+        def block_body(i, _):
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < nblk)
+            def _():
+                start_dma(1 - slot, i + 1)
+
+            wait_dma(slot, i)
+            bin_f = bbuf[slot].astype(jnp.int32) \
+                .astype(jnp.float32)                     # [blk, f]
+            lid_blk = lbuf[slot]                         # [blk, 1]
+            ghc_blk = gbuf[slot]                         # [blk, 3]
+
+            # split feature's bin per row: f32 one-hot lane reduce
+            # (bins <= 255 are exact in f32)
+            fsel = jnp.where(lane_b == feat_f, jnp.float32(1), 0.0)
+            bv = jnp.sum(bin_f * fsel, axis=1,
+                         keepdims=True)                  # [blk, 1]
+            is_missing = jnp.where(
+                miss_f == float(MISSING_ZERO_CODE), bv == defbin_f,
+                jnp.where(miss_f == float(MISSING_NAN_CODE),
+                          bv == nbins_f - 1.0, bv < -1.0))
+            go_left = jnp.where(is_missing, dleft_f > 0.5,
+                                bv <= thr_f)
+            in_leaf = lid_blk == leaf
+            new_lid = jnp.where(in_leaf & ~go_left, new, lid_blk)
+            lwb[...] = new_lid
+            cp = pltpu.make_async_copy(
+                lwb, lid_out.at[pl.ds(pl.multiple_of(i * blk, ALIGN),
+                                      blk), :], sem_w.at[0])
+            cp.start()
+            cp.wait()
+
+            # smaller-child rows only (exact 0/1 f32 mask; padding
+            # rows carry ghc == 0 and contribute nothing)
+            sel = jnp.where(new_lid == sm, jnp.float32(1), 0.0)
+            g = ghc_blk[:, 0:1] * sel
+            h = ghc_blk[:, 1:2] * sel
+            cnt = ghc_blk[:, 2:3] * sel
+            g_hi, g_lo = _split_hi_lo_f32(g)
+            h_hi, h_lo = _split_hi_lo_f32(h)
+            zero = jnp.zeros_like(g_hi)
+            pay = jnp.concatenate(
+                [g_hi, g_lo, h_hi, h_lo, cnt.astype(jnp.bfloat16),
+                 zero, zero, zero], axis=1)              # [blk, 8]
+
+            for fx in range(f):
+                fcol = bin_f[:, fx:fx + 1]               # [blk, 1]
+                onehot = jnp.where(fcol == bins_l, jnp.float32(1),
+                                   0.0).astype(jnp.bfloat16)
+                res = jax.lax.dot_general(
+                    pay, onehot, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [8, B]
+                for ch in range(5):
+                    hpl[ch, pl.ds(fx, 1), :] += res[ch:ch + 1, :]
+            return 0
+
+        jax.lax.fori_loop(0, nblk, block_body, 0)
+
+        # parent slab prefetch for phase 1 (channels-major cache row)
+        cp = pltpu.make_async_copy(hist_out.at[leaf], pbuf,
+                                   sem_w.at[1])
+        cp.start()
+        cp.wait()
+
+    @pl.when(pid == 1)
+    def _phase1():
+        g_sm = hpl[0] + hpl[1]
+        h_sm = hpl[2] + hpl[3]
+        c_sm = hpl[4]
+        _scan_and_write_phase(
+            pack, params, iscal, s_in[...], t_in[...],
+            imeta_ref, fmeta_ref, s_out, t_out, g_sm, h_sm, c_sm,
+            pbuf, cbuf, hist_out, sem_w, big_l=big_l,
+            max_depth=max_depth, b=b, f=f, has_monotone=has_monotone)
+
+
+def _segment_kernel_tpu(iscal, s_in, t_in, mat_in, ws_in, hist_in,
+                        imeta_ref, fmeta_ref,
+                        s_out, t_out, mat_out, ws_out, hist_out,
+                        inbuf, staged, flushbuf, rbuf, hpl, pbuf, cbuf,
+                        nl_ref, sems, sem_w,
+                        *, params, si_prefix, big_l, max_depth, b, f,
+                        n, bundled, has_monotone, blk):
+    """Mosaic body, segment layout: phase 0 streams the chosen leaf's
+    contiguous row segment ONCE — the stable in-place partition
+    (``partition_pallas`` v1 algorithm: tri-matmul prefix sums,
+    permutation matmuls, 8-aligned read-merge-write heads) and the
+    SMALLER child's histogram accumulate from the same window, so
+    partition + histogram cost one read of the rows. Phase 1 is the
+    shared subtract/scan/write tail. All lane/row extractions are f32
+    select-sums (this Mosaic lowers no integer reductions — the one
+    thing that kept partition v1 off-chip)."""
+    del mat_in, ws_in, hist_in  # aliased; all access via out refs
+    from .hist_pallas import _decode_block
+    pack = _grow_pack(si_prefix, params, has_monotone, big_l)
+    pid = pl.program_id(0)
+    cols = mat_out.shape[1]
+    win = blk + ALIGN
+
+    @pl.when(pid == 0)
+    def _phase0():
+        (leaf, new, sm, feat_f, thr_f, dleft_f, miss_f, defbin_f,
+         nbins_f) = _leaf_site_scalars(pack, iscal, s_in, imeta_ref,
+                                       big_l)
+        site = _SiteTPU(pack, s_in[...], big_l)
+        leaf_f = leaf.astype(jnp.float32)
+        begin = site.i_f("leaf_begin", leaf_f).astype(jnp.int32)
+        cnt = site.i_f("leaf_cnt", leaf_f).astype(jnp.int32)
+        lc = site.f("bs_lc", leaf_f)
+        pc = site.f("leaf_c", leaf_f)
+        small_is_left = lc <= (pc - lc)
+
+        for ch in range(5):
+            hpl[ch] = jnp.zeros_like(hpl[ch])
+
+        nblk = pl.cdiv(cnt, blk)
+        base = (begin // ALIGN) * ALIGN
+        shift = begin - base
+
+        lane_w = jax.lax.broadcasted_iota(jnp.float32, (1, cols), 1)
+        row_w = jax.lax.broadcasted_iota(jnp.int32, (win, 1), 0)
+        dst_w8 = jax.lax.broadcasted_iota(jnp.int32, (win, win), 1)
+        row8 = jax.lax.broadcasted_iota(jnp.int32, (win, 1), 0)
+        bins_l = jax.lax.broadcasted_iota(jnp.float32, (1, b), 1)
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (win, win), 0)
+               <= jax.lax.broadcasted_iota(jnp.int32, (win, win), 1))
+        tri_bf = jnp.where(tri, jnp.float32(1), 0.0).astype(
+            jnp.bfloat16)
+
+        def copy(src, dst, sem):
+            cp = pltpu.make_async_copy(src, dst, sem)
+            cp.start()
+            cp.wait()
+
+        def compact_and_write(mat_bf, sel, dest, out_hbm):
+            """partition_pallas._partition_kernel's stable compaction:
+            sel rows to ``out_hbm[dest, ...)`` via a permutation
+            matmul + 8-aligned read-merge-write."""
+            sel_bf = sel.astype(jnp.float32).astype(jnp.bfloat16)
+            cs = jax.lax.dot_general(
+                tri_bf, sel_bf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [win, 1]
+            nsel = cs[win - 1, 0].astype(jnp.int32)
+            wstart = (dest // ALIGN) * ALIGN
+            dshift = dest - wstart
+            slot = jnp.where(sel > 0,
+                             dshift + cs.astype(jnp.int32) - 1, -1)
+            pt = jnp.where(slot == dst_w8, jnp.float32(1),
+                           0.0).astype(jnp.bfloat16)     # [win, win]
+            staged[...] = jax.lax.dot_general(
+                pt, mat_bf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [win, C]
+            copy(out_hbm.at[pl.ds(pl.multiple_of(wstart, ALIGN), win),
+                            :], rbuf, sems.at[1])
+            keep = (row8 >= dshift) & (row8 < dshift + nsel)
+            flushbuf[...] = jnp.where(
+                keep, staged[...].astype(jnp.int32),
+                rbuf[...].astype(jnp.int32)).astype(jnp.uint8)
+            copy(flushbuf, out_hbm.at[pl.ds(pl.multiple_of(
+                wstart, ALIGN), win), :], sems.at[2])
+            return nsel
+
+        fsel = jnp.where(lane_w == feat_f, jnp.float32(1), 0.0)
+
+        def block_body(k_i, carry):
+            dest_l, dest_r = carry
+            copy(mat_out.at[pl.ds(pl.multiple_of(
+                base + k_i * blk, ALIGN), win), :], inbuf, sems.at[0])
+            mat_i32 = inbuf[...].astype(jnp.int32)       # [win, C]
+            mat_f = mat_i32.astype(jnp.float32)
+            mat_bf = mat_f.astype(jnp.bfloat16)
+
+            rem = jnp.minimum(cnt - k_i * blk, blk)
+            valid = jnp.where((row_w >= shift)
+                              & (row_w < shift + rem), 1, 0)
+
+            # split feature's bin per row: f32 one-hot lane reduce
+            bv = jnp.sum(mat_f * fsel, axis=1,
+                         keepdims=True)                  # [win, 1]
+            is_missing = jnp.where(
+                miss_f == float(MISSING_ZERO_CODE), bv == defbin_f,
+                jnp.where(miss_f == float(MISSING_NAN_CODE),
+                          bv == nbins_f - 1.0, bv < -1.0))
+            go_left = jnp.where(is_missing, dleft_f > 0.5,
+                                bv <= thr_f)
+            gl = valid * jnp.where(go_left, 1, 0)
+            gr = valid * jnp.where(go_left, 0, 1)
+
+            # smaller child's histogram from the SAME window (exact
+            # bf16 hi/lo payload pairs, f32 accumulation)
+            sel_small = jnp.where(small_is_left, gl, gr) \
+                .astype(jnp.float32)                     # [win, 1]
+            _, g_hi, g_lo, h_hi, h_lo, c_ch = _decode_block(
+                mat_i32, f, shift, rem, win)
+            sel_bf = sel_small.astype(jnp.bfloat16)
+            zero = jnp.zeros_like(g_hi)
+            pay = jnp.concatenate(
+                [g_hi * sel_bf, g_lo * sel_bf, h_hi * sel_bf,
+                 h_lo * sel_bf, (c_ch * sel_small).astype(
+                     jnp.bfloat16), zero, zero, zero],
+                axis=1)                                  # [win, 8]
+            for fx in range(f):
+                fcol = mat_f[:, fx:fx + 1]               # [win, 1]
+                onehot = jnp.where(fcol == bins_l, jnp.float32(1),
+                                   0.0).astype(jnp.bfloat16)
+                res = jax.lax.dot_general(
+                    pay, onehot, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [8, B]
+                for ch in range(5):
+                    hpl[ch, pl.ds(fx, 1), :] += res[ch:ch + 1, :]
+
+            nl_blk = compact_and_write(mat_bf, gl, dest_l, mat_out)
+            nr_blk = compact_and_write(mat_bf, gr, dest_r, ws_out)
+            return dest_l + nl_blk, dest_r + nr_blk
+
+        dest_l, _dest_r = jax.lax.fori_loop(
+            0, nblk, block_body, (begin, jnp.int32(0)))
+        nl_total = dest_l - begin
+        nl_ref[0] = nl_total
+
+        # rights from the workspace -> mat[begin+NL, begin+cnt)
+        nr_total = cnt - nl_total
+
+        def back_body(j, _):
+            copy(ws_out.at[pl.ds(pl.multiple_of(j * blk, ALIGN), win),
+                           :], inbuf, sems.at[0])
+            cnt_j = jnp.minimum(nr_total - j * blk, blk)
+            sel = ((row_w >= 0) & (row_w < cnt_j)).astype(jnp.int32)
+            mat_bf = inbuf[...].astype(jnp.int32).astype(
+                jnp.float32).astype(jnp.bfloat16)
+            compact_and_write(mat_bf, sel, dest_l + j * blk, mat_out)
+            return 0
+
+        jax.lax.fori_loop(0, pl.cdiv(nr_total, blk), back_body, 0)
+
+        # parent slab prefetch for phase 1 (channels-major cache row)
+        cp = pltpu.make_async_copy(hist_out.at[leaf], pbuf,
+                                   sem_w.at[1])
+        cp.start()
+        cp.wait()
+
+    @pl.when(pid == 1)
+    def _phase1():
+        g_sm = hpl[0] + hpl[1]
+        h_sm = hpl[2] + hpl[3]
+        c_sm = hpl[4]
+
+        def extra_ab(site, leaf_f, small_is_left):
+            nl = nl_ref[0]
+            begin = site.i_f("leaf_begin", leaf_f).astype(jnp.int32)
+            cnt = site.i_f("leaf_cnt", leaf_f).astype(jnp.int32)
+            sb = jnp.where(small_is_left, begin, begin + nl)
+            sc = jnp.where(small_is_left, nl, cnt - nl)
+            begin_b = jnp.where(small_is_left, begin + nl, begin)
+            return (dict(leaf_begin=sb, leaf_cnt=sc),
+                    dict(leaf_begin=begin_b, leaf_cnt=cnt - sc))
+
+        _scan_and_write_phase(
+            pack, params, iscal, s_in[...], t_in[...],
+            imeta_ref, fmeta_ref, s_out, t_out, g_sm, h_sm, c_sm,
+            pbuf, cbuf, hist_out, sem_w, big_l=big_l,
+            max_depth=max_depth, b=b, f=f, has_monotone=has_monotone,
+            extra_ab=extra_ab)

@@ -62,6 +62,16 @@ def iter_bytes_per_row(num_features: int) -> int:
         + part_bytes_per_row(num_features)
 
 
+def fused_leaf_bytes_per_row(num_features: int) -> int:
+    """HBM traffic per row of ONE fused split step in the leaf layout
+    (ops/split_step_pallas.py): the megakernel streams the u8 bins,
+    the f32 (g, h, c) payload and the i32 leaf_id once, writing the
+    leaf_id back — partition AND histogram ride the same pass, which
+    is the whole point of the fusion (vs hist + part streaming the
+    rows separately)."""
+    return num_features + 12 + 2 * 4
+
+
 def device_peaks(device=None) -> Dict[str, Any]:
     """Peak table entry for the current (or given) jax device.
 

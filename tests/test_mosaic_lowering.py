@@ -90,22 +90,20 @@ def test_partition_v1_lowers_for_tpu(use_lut):
             jnp.int32(0), jnp.int32(256), jnp.int32(0), lut)
 
 
-@needs_int_reduce
-@pytest.mark.parametrize("use_lut", [True, False])
-def test_partition_v2_lowers_for_tpu(use_lut):
-    """Round-4 regression: the v2 flush path cast f32 staging straight
-    to u8, which Mosaic only lowers via an i32 hop — interpret mode
-    passed, the first hardware compile died (PERF_RUN.log 03:59)."""
-    from lightgbm_tpu.ops.partition_pallas_v2 import (
-        partition_segment_v2, pick_blk)
-    mat = _mat()
-    lut = jnp.zeros((1, 256), jnp.float32)
-    _lowers(functools.partial(partition_segment_v2,
-                              blk=pick_blk(mat.shape[1]),
-                              interpret=False, use_lut_path=use_lut),
-            mat, jnp.zeros_like(mat), jnp.int32(13), jnp.int32(2000),
-            14, jnp.int32(128), jnp.int32(0), jnp.int32(0),
-            jnp.int32(0), jnp.int32(256), jnp.int32(0), lut)
+@pytest.mark.parametrize("layout", ["leaf", "segment"])
+def test_fused_split_step_lowers_for_tpu(layout):
+    """The split-step megakernel's Mosaic bodies lower on this host —
+    the same probe the capability gate runs
+    (ops/split_step_pallas.probe_fused_lowering); a regression here is
+    exactly what would push every TPU run back onto the per-phase
+    kernels (the gate would report it as a taxonomy reason code, but
+    CI fails FIRST). Notably the segment body's partition phase lowers
+    where partition v1 does not: all its lane/row extractions are f32
+    select-sums instead of the i32 reductions this Mosaic lacks."""
+    import lightgbm_tpu.ops.split_step_pallas as sp
+    sp._LOWER_CACHE.clear()
+    ok, code, detail = sp.probe_fused_lowering(layout)
+    assert ok, f"reason_code={code}: {detail}"
 
 
 def _scan_args(f=28, b=256, seed=1):
@@ -137,9 +135,16 @@ def test_split_scan_kernel_lowers_for_tpu():
         per_feature_numerical_pallas
     (hist, pg, ph, pc, lo, hi, fm), meta, params = _scan_args()
     # meta/params ride as closed-over constants like the grow loop's
-    # trace (params holds static python floats, never tracers)
+    # trace (params holds static python floats, never tracers).
+    # interpret=False is REQUIRED: the wrapper's backend-resolved
+    # default is True on this CPU host, which lowered the interpret
+    # emulation instead of Mosaic and silently passed while the real
+    # kernel carried unlowerable i32 reductions (fixed alongside the
+    # split-step megakernel: the threshold arg-extrema now run in
+    # exact f32)
     _lowers(lambda hh: per_feature_numerical_pallas(
-        hh, pg, ph, pc, meta, params, lo, hi, fm), hist)
+        hh, pg, ph, pc, meta, params, lo, hi, fm, interpret=False),
+        hist)
 
 
 def test_split_scan_vmapped_lowers_for_tpu():
@@ -153,7 +158,8 @@ def test_split_scan_vmapped_lowers_for_tpu():
 
     def batched(hh2):
         return jax.vmap(lambda hh: per_feature_numerical_pallas(
-            hh, pg, ph, pc, meta, params, lo, hi, fm))(hh2)
+            hh, pg, ph, pc, meta, params, lo, hi, fm,
+            interpret=False))(hh2)
     _lowers(batched, hist2)
 
 

@@ -1,4 +1,12 @@
-"""Sub-tiled partition kernel (v2) vs oracle + v1, interpret mode."""
+"""Partition kernel vs oracle, interpret mode.
+
+Historically this file covered the sub-tiled v2 partition kernel; the
+split-step megakernel (ops/split_step_pallas.py) made the v1/v2 split
+dead weight and v2 was deleted — the oracle suite now points at the
+surviving ``partition_segment`` so the consolidated module keeps the
+exact coverage the v2 kernel had (stability, missing routing,
+categorical LUT, all-one-side edge cases).
+"""
 
 import numpy as np
 import jax.numpy as jnp
@@ -8,7 +16,6 @@ from lightgbm_tpu.ops.hist_pallas import (build_matrix, extract_row_ids,
                                           pack_gh)
 from lightgbm_tpu.ops.partition_pallas import (bitset_to_lut,
                                                partition_segment)
-from lightgbm_tpu.ops.partition_pallas_v2 import partition_segment_v2
 
 
 def _mk(n, f, b, seed=0):
@@ -23,7 +30,7 @@ def _mk(n, f, b, seed=0):
 
 @pytest.mark.parametrize("begin,count", [
     (0, 3000), (8, 2992), (13, 2048), (517, 997), (2989, 11), (5, 3)])
-def test_v2_matches_oracle_numerical(begin, count):
+def test_partition_matches_oracle_numerical(begin, count):
     n, f, b = 3000, 7, 64
     binned, mat = _mk(n, f, b)
     col, thr = 3, 30
@@ -31,8 +38,8 @@ def test_v2_matches_oracle_numerical(begin, count):
     args = (jnp.int32(begin), jnp.int32(count), jnp.int32(col),
             jnp.int32(thr), jnp.int32(0), jnp.int32(0), jnp.int32(0),
             jnp.int32(b), jnp.int32(0), lut)
-    m2, _, nl = partition_segment_v2(mat, jnp.zeros_like(mat), *args,
-                                     blk=256, interpret=True)
+    m2, _, nl = partition_segment(mat, jnp.zeros_like(mat), *args,
+                                  blk=256, interpret=True)
     sl = slice(begin, begin + count)
     go_left = binned[sl, col] <= thr
     assert int(nl[0]) == int(go_left.sum())
@@ -45,14 +52,14 @@ def test_v2_matches_oracle_numerical(begin, count):
     np.testing.assert_array_equal(rid[:begin], rid_orig[:begin])
     np.testing.assert_array_equal(rid[begin + count:n],
                                   rid_orig[begin + count:n])
-    # full payload bytes preserved (not just ids)
+    # block size must not change the result (the old v2 coverage)
     m1, _, nl1 = partition_segment(mat, jnp.zeros_like(mat), *args,
                                    blk=512, interpret=True)
     assert int(nl1[0]) == int(nl[0])
     np.testing.assert_array_equal(np.asarray(m2)[:n], np.asarray(m1)[:n])
 
 
-def test_v2_missing_and_categorical():
+def test_partition_missing_and_categorical():
     n, f, b = 2000, 5, 32
     binned, mat = _mk(n, f, b, seed=3)
     # NaN-missing: bin b-1 is the NaN bin, default_left=1
@@ -60,8 +67,8 @@ def test_v2_missing_and_categorical():
     args = (jnp.int32(100), jnp.int32(1500), jnp.int32(col),
             jnp.int32(10), jnp.int32(1), jnp.int32(2), jnp.int32(0),
             jnp.int32(b), jnp.int32(0), jnp.zeros((1, 256), jnp.float32))
-    m2, _, nl = partition_segment_v2(mat, jnp.zeros_like(mat), *args,
-                                     blk=256, interpret=True)
+    m2, _, nl = partition_segment(mat, jnp.zeros_like(mat), *args,
+                                  blk=256, interpret=True)
     sl = slice(100, 1600)
     bv = binned[sl, col]
     go_left = np.where(bv == b - 1, True, bv <= 10)
@@ -76,8 +83,8 @@ def test_v2_missing_and_categorical():
     args = (jnp.int32(0), jnp.int32(n), jnp.int32(col), jnp.int32(0),
             jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(b),
             jnp.int32(1), lut)
-    m3, _, nl3 = partition_segment_v2(mat, jnp.zeros_like(mat), *args,
-                                      blk=256, interpret=True)
+    m3, _, nl3 = partition_segment(mat, jnp.zeros_like(mat), *args,
+                                   blk=256, interpret=True)
     left = np.isin(binned[:, col], cats)
     assert int(nl3[0]) == int(left.sum())
     rid = np.asarray(extract_row_ids(m3, f, mat.shape[0]))[:n]
@@ -85,12 +92,12 @@ def test_v2_missing_and_categorical():
         rid, np.concatenate([np.arange(n)[left], np.arange(n)[~left]]))
 
 
-def test_v2_all_one_side():
+def test_partition_all_one_side():
     n, f, b = 1500, 4, 16
     binned, mat = _mk(n, f, b, seed=5)
     lut = jnp.zeros((1, 256), jnp.float32)
     for thr, side in [(b, "left"), (-1, "right")]:
-        m2, _, nl = partition_segment_v2(
+        m2, _, nl = partition_segment(
             mat, jnp.zeros_like(mat), jnp.int32(11), jnp.int32(1200),
             jnp.int32(1), jnp.int32(thr), jnp.int32(0), jnp.int32(0),
             jnp.int32(0), jnp.int32(b), jnp.int32(0), lut,

@@ -211,6 +211,30 @@ def _dispatch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     return found
 
 
+def _fused_split_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's fused split-step megakernel per-split wall time
+    (bench.py run_fused_split_block), keyed by (backend, shape id) so
+    only like-for-like measurements chain — lower is better; a CPU
+    point tracks the interpret twin's structural cost, a TPU point the
+    compiled megakernel."""
+    found = None
+    for ln in lines:
+        fs = ln.get("fused_split")
+        if ln.get("metric") != "fused_split_kernel" \
+                or not isinstance(fs, dict) \
+                or fs.get("per_split_ms") is None:
+            continue
+        key = json.dumps({
+            "backend": ln.get("backend"),
+            "config": ln.get("baseline_config"),
+        }, sort_keys=True)
+        found = {"value": float(fs["per_split_ms"]), "key": key,
+                 "foil_per_split_ms": fs.get("foil_per_split_ms"),
+                 "speedup_vs_foil": fs.get("speedup_vs_foil"),
+                 "achieved_gbps": fs.get("achieved_gbps")}
+    return found
+
+
 def _headline_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     for ln in reversed(lines):
         if ln.get("metric") == HEADLINE_METRIC \
@@ -257,6 +281,7 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
 def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
     fixed, serving, headline, dispatch, fleet = [], [], [], [], []
+    fused = []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -273,12 +298,16 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _fleet_point(rnd["lines"])
         if p is not None:
             fleet.append((rnd["label"], p))
+        p = _fused_split_point(rnd["lines"])
+        if p is not None:
+            fused.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
     regressions += _gate(serving, False, threshold, "serving_p99_ms")
     regressions += _gate(dispatch, False, threshold, DISPATCH_METRIC)
     regressions += _gate(fleet, False, threshold, "fleet_p99_ms")
+    regressions += _gate(fused, False, threshold, "fused_split_ms")
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -296,6 +325,8 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in serving],
             "fleet_p99_ms": [
                 {"round": lb, **pt} for lb, pt in fleet],
+            "fused_split_ms": [
+                {"round": lb, **pt} for lb, pt in fused],
             DISPATCH_METRIC: [
                 {"round": lb, **pt} for lb, pt in dispatch],
             # informational only — config drifts across rounds
@@ -305,6 +336,7 @@ def analyze(rounds: List[Dict[str, Any]],
         "gated_points": {FIXED_METRIC: len(fixed),
                          "serving_p99_ms": len(serving),
                          "fleet_p99_ms": len(fleet),
+                         "fused_split_ms": len(fused),
                          DISPATCH_METRIC: len(dispatch)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",

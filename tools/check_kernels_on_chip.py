@@ -11,14 +11,21 @@ Round-5 redesign (VERDICT r4 #2): the check is SPLIT into independent
 stages so a timeout or tunnel death mid-run keeps every finished
 stage's verdict. Each stage's result is cached in
 docs/KERNEL_CHECKS.json (stage -> {ok, wall_s, ts}); partial passes
-promote partially (LGBM_TPU_PART_V2 flips on a green partition_v2
-alone).
+promote partially (a green fused_split alone promotes the
+LGBM_TPU_FUSED_SPLIT_KERNEL=1 bench run in the perf sequence).
 
 Run on the TPU host (sole tunnel client):
     python tools/check_kernels_on_chip.py [stage ...]
-Stages: hist partition_v1 partition_v2 split_scan (default: the ones
+Stages: hist partition_v1 split_scan fused_split (default: the ones
 not yet green in the cache, in that order; pass --all to force all).
 Exits non-zero if any stage it RAN failed.
+
+``--lowering`` runs ONLY the Mosaic lowerability probes (no TPU
+needed): every production kernel — including the split-step megakernel
+— is pushed through the real Mosaic lowering pass host-side, and a
+failure prints its ``tools/probe_taxonomy.py`` reason code (the same
+code the capability gate records in telemetry when it silently? no —
+VISIBLY — falls back to the per-phase kernels).
 """
 
 import json
@@ -36,7 +43,7 @@ CACHE = os.path.join(os.path.dirname(__file__), "..", "docs",
 # magnitude of the sums (~3e-6 relative observed)
 TOL = dict(rtol=1e-4, atol=1e-3)
 
-STAGES = ("hist", "partition_v1", "partition_v2", "split_scan")
+STAGES = ("hist", "partition_v1", "split_scan", "fused_split")
 
 
 def _load_cache() -> dict:
@@ -99,16 +106,12 @@ def stage_hist() -> int:
     return failures
 
 
-def _check_partition(v2: bool) -> int:
+def _check_partition() -> int:
     import jax.numpy as jnp
     import numpy as np
 
     from lightgbm_tpu.ops.hist_pallas import extract_row_ids
-    if v2:
-        from lightgbm_tpu.ops.partition_pallas_v2 import (
-            partition_segment_v2, pick_blk)
-    else:
-        from lightgbm_tpu.ops.partition_pallas import partition_segment
+    from lightgbm_tpu.ops.partition_pallas import partition_segment
     rng = np.random.RandomState(1)
     failures = 0
     for n, f, b in [(20000, 28, 256), (5000, 12, 64), (7333, 5, 16)]:
@@ -123,15 +126,9 @@ def _check_partition(v2: bool) -> int:
                 args = (jnp.int32(begin), jnp.int32(count), col,
                         jnp.int32(thr), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0), jnp.int32(b), jnp.int32(0), lut)
-                if v2:
-                    blk = pick_blk(mat.shape[1])
-                    m_c, _, nl_c = partition_segment_v2(
-                        mat, jnp.zeros_like(mat), *args, blk=blk,
-                        interpret=False, use_lut_path=use_lut)
-                else:
-                    m_c, _, nl_c = partition_segment(
-                        mat, jnp.zeros_like(mat), *args, blk=512,
-                        interpret=False, use_lut_path=use_lut)
+                m_c, _, nl_c = partition_segment(
+                    mat, jnp.zeros_like(mat), *args, blk=512,
+                    interpret=False, use_lut_path=use_lut)
                 sl = slice(begin, begin + count)
                 go_left = binned[sl, col] <= thr
                 nl_o = int(go_left.sum())
@@ -143,7 +140,7 @@ def _check_partition(v2: bool) -> int:
                                        rid_orig[~go_left]])
                 ok = (int(nl_c[0]) == nl_o
                       and np.array_equal(rid_seg[:count], want))
-                print(f"partition{'-v2' if v2 else ''} [{n}x{f}] "
+                print(f"partition [{n}x{f}] "
                       f"seg=({begin},{count}) lut={use_lut}: "
                       f"{'ok ' if ok else 'FAIL'} "
                       f"left={int(nl_c[0])}/{nl_o}", flush=True)
@@ -152,13 +149,71 @@ def _check_partition(v2: bool) -> int:
 
 
 def stage_partition_v1() -> int:
-    return _check_partition(v2=False)
+    return _check_partition()
 
 
-def stage_partition_v2() -> int:
-    """Promotion gate for LGBM_TPU_PART_V2: the double-buffered DMA
-    overlap and granule-flush behavior only exist compiled."""
-    return _check_partition(v2=True)
+def probe_fused_lowering_stage(require_segment: bool = True) -> int:
+    """Mosaic lowerability of the split-step megakernel (both
+    layouts), host-side — the exact probe the capability gate runs;
+    a failure prints its probe_taxonomy reason_code so the fallback
+    is diagnosable from THIS log and from the fused_split.* telemetry
+    counters."""
+    from lightgbm_tpu.ops.split_step_pallas import probe_fused_lowering
+    failures = 0
+    for layout, required in (("leaf", True),
+                             ("segment", require_segment)):
+        ok, code, detail = probe_fused_lowering(layout)
+        tag = "ok " if ok else (
+            "FAIL" if required else "skip")
+        print(f"fused_split[{layout}] mosaic-lowering: {tag}"
+              + ("" if ok else f" reason_code={code} {detail[:160]}"),
+              flush=True)
+        if required and not ok:
+            failures += 1
+    return failures
+
+
+def stage_fused_split() -> int:
+    """Split-step megakernel ON the chip: lowerability (reason-coded)
+    plus a compiled-vs-foil training comparison — the kernel's
+    histogram/scan roundings differ from the XLA path at f32 level
+    (like the reference's GPU learner), so the gate is
+    prediction-close + identical tree shapes, not byte-equality (the
+    interpret twin owns byte-equality in CI)."""
+    import os
+
+    import numpy as np
+
+    failures = probe_fused_lowering_stage()
+    from lightgbm_tpu.ops.split_step_pallas import probe_fused_lowering
+    if not probe_fused_lowering("leaf")[0]:
+        return failures + 1
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    x = rng.randn(20000, 12).astype("float32")
+    y = (x[:, 0] - 0.5 * x[:, 1] + 0.3 * rng.randn(20000) > 0) \
+        .astype("float32")
+    preds = {}
+    leaves = {}
+    for mode in ("0", "1"):
+        os.environ["LGBM_TPU_FUSED_SPLIT_KERNEL"] = mode
+        try:
+            ds = lgb.Dataset(x, label=y, free_raw_data=False)
+            bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                             "verbosity": -1, "metric": ""},
+                            ds, num_boost_round=5)
+            preds[mode] = bst.predict(x[:2048])
+            leaves[mode] = [t.num_leaves for t in bst._gbdt.models]
+        finally:
+            os.environ.pop("LGBM_TPU_FUSED_SPLIT_KERNEL", None)
+    ok = leaves["0"] == leaves["1"] and np.allclose(
+        preds["0"], preds["1"], rtol=1e-3, atol=1e-3)
+    err = float(np.abs(preds["0"] - preds["1"]).max())
+    print(f"fused_split compiled-vs-foil train: "
+          f"{'ok ' if ok else 'FAIL'} max|dpred|={err:.2e} "
+          f"leaves={leaves['1']}", flush=True)
+    return failures + (0 if ok else 1)
 
 
 def stage_split_scan() -> int:
@@ -229,9 +284,14 @@ def stage_split_scan() -> int:
 
 def main() -> int:
     import jax
+    if "--lowering" in sys.argv[1:]:
+        # host-side Mosaic lowerability probes only (no TPU needed) —
+        # the CI-facing half of the fused_split stage
+        return 1 if probe_fused_lowering_stage() else 0
     backend = jax.default_backend()
     if backend not in ("tpu", "axon"):
-        print(f"needs the real TPU (backend={backend})")
+        print(f"needs the real TPU (backend={backend}); use "
+              "--lowering for the host-side Mosaic probes")
         return 2
 
     argv = [a for a in sys.argv[1:]]
@@ -255,8 +315,8 @@ def main() -> int:
             return 0
 
     fns = {"hist": stage_hist, "partition_v1": stage_partition_v1,
-           "partition_v2": stage_partition_v2,
-           "split_scan": stage_split_scan}
+           "split_scan": stage_split_scan,
+           "fused_split": stage_fused_split}
     total_failures = 0
     for stage in todo:
         t0 = time.time()

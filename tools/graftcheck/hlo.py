@@ -78,16 +78,72 @@ def carry_stats(line: str) -> Tuple[int, int]:
     return len(shapes), sum(shape_bytes(s) for s in shapes)
 
 
+_CALLED_RE = re.compile(
+    r"(?:body|condition|calls|to_apply|true_computation|"
+    r"false_computation)=(%[\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+
+
+def _computation_graph(txt: str):
+    """(ops per computation, computations referenced per computation):
+    the call graph the grow-while selection walks."""
+    lines = txt.splitlines()
+    comps: Dict[str, Counter] = {}
+    refs: Dict[str, set] = {}
+    name = None
+    for ln in lines:
+        stripped = ln.strip()
+        if stripped.endswith("{") and "(" in stripped:
+            head = stripped.split("(", 1)[0].strip()
+            if head.startswith("ENTRY "):
+                head = head[len("ENTRY "):].strip()
+            name = head.split()[-1] if head else name
+            comps.setdefault(name, Counter())
+            refs.setdefault(name, set())
+            continue
+        if name is None or " = " not in ln:
+            continue
+        op = op_of(ln)
+        if op:
+            comps[name][op] += 1
+        for m in _CALLED_RE.finditer(ln):
+            refs[name].add(m.group(1))
+        for m in _BRANCHES_RE.finditer(ln):
+            refs[name].update(re.findall(r"%[\w.\-]+", m.group(1)))
+    return comps, refs
+
+
 def census_from_hlo(txt: str) -> dict:
     """Census of the grow while loop inside one compiled HLO module.
 
     The grow while is the ``while`` op WITHOUT a ``known_trip_count``
     backend_config (scatter expansions and pallas grid loops are
-    trip-counted) whose body holds the most non-trivial ops;
-    non-trivial = everything except parameter / constant / tuple /
+    trip-counted) whose body TRANSITIVELY holds the most non-trivial
+    ops — the outermost loop of the program, which always contains any
+    nested dynamic loop (e.g. the megakernel's interpret-mode DMA
+    streams). Reported counts are the body's DIRECT ops: non-trivial =
+    everything except parameter / constant / tuple /
     get-tuple-element / bitcast; inner ``while`` ops count as ONE op
     each (on TPU they are one kernel)."""
-    lines = txt.splitlines()
+    comps, refs = _computation_graph(txt)
+
+    def nontrivial_of(counter: Counter) -> int:
+        return sum(counter.values()) - sum(counter[t]
+                                           for t in TRIVIAL_OPS)
+
+    trans_cache: Dict[str, int] = {}
+
+    def trans_ops(name: str, stack=()):
+        if name in trans_cache:
+            return trans_cache[name]
+        if name not in comps or name in stack:
+            return 0
+        total = nontrivial_of(comps[name])
+        for r in refs.get(name, ()):
+            total += trans_ops(r, stack + (name,))
+        trans_cache[name] = total
+        return total
+
     candidates = []  # (body_name, carry_elems, carry_bytes)
     for m in re.finditer(r"body=(%[\w.\-]+)", txt):
         s = txt.rfind("\n", 0, m.start()) + 1
@@ -97,29 +153,18 @@ def census_from_hlo(txt: str) -> dict:
         elems, nbytes = carry_stats(line)
         candidates.append((m.group(1), elems, nbytes))
     best = None
+    best_trans = -1
     for body, elems, nbytes in candidates:
-        start = None
-        for i, ln in enumerate(lines):
-            if ln.startswith(body + " "):
-                start = i
-                break
-        if start is None:
+        if body not in comps:
             continue
-        ops = Counter()
-        for ln in lines[start + 1:]:
-            if ln.startswith("}"):
-                break
-            if " = " not in ln:
-                continue
-            op = op_of(ln)
-            if op:
-                ops[op] += 1
+        ops = comps[body]
         total = sum(ops.values())
-        nontrivial = total - sum(ops[t] for t in TRIVIAL_OPS)
-        if best is None or nontrivial > best["ops_per_split"]:
+        tr = trans_ops(body)
+        if best is None or tr > best_trans:
+            best_trans = tr
             best = dict(
                 body=body.lstrip("%"),
-                ops_per_split=nontrivial,
+                ops_per_split=nontrivial_of(ops),
                 total_instructions=total,
                 fusions=ops.get("fusion", 0),
                 inner_whiles=ops.get("while", 0),

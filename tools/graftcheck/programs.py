@@ -470,10 +470,58 @@ def _b_partition_segment():
     return _spec_fn("partition_segment").lower(*args, **kw)
 
 
-@builder("partition_segment_v2")
-def _b_partition_segment_v2():
-    args, kw = _partition_args(2048)
-    return _spec_fn("partition_segment_v2").lower(*args, **kw)
+def _fused_step_state(lrn, si_prefix):
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.learner.split_step import make_grow_pack
+    from lightgbm_tpu.ops.split_step_pallas import pack_meta_tables
+    pack = make_grow_pack(si_prefix, merged=True,
+                          has_cat=lrn.params.has_categorical,
+                          has_monotone=lrn.has_monotone,
+                          big_l=lrn.num_leaves)
+    ks = len(pack.sf_fields) + len(pack.si_fields)
+    kt = len(pack.tf_fields) + len(pack.ti_fields)
+    big_l = lrn.num_leaves
+    imeta, fmeta = pack_meta_tables(
+        lrn.meta, jnp.ones((lrn.meta.num_bins.shape[0],), bool))
+    return (jnp.zeros((ks, big_l), jnp.float32),
+            jnp.zeros((kt, big_l - 1), jnp.float32), imeta, fmeta)
+
+
+@builder("fused_split_step_leaf")
+def _b_fused_split_step_leaf():
+    import jax.numpy as jnp
+    lrn = _serial_learner()
+    S, T, imeta, fmeta = _fused_step_state(lrn, ())
+    n = lrn.dataset.num_data
+    g = lrn.dataset.num_groups
+    b = lrn.num_bins_max
+    hist = jnp.zeros((lrn.num_leaves, g, b, 3), jnp.float32)
+    return _spec_fn("fused_split_step_leaf").lower(
+        jnp.int32(1), S, T, jnp.zeros((n,), jnp.int32), hist,
+        lrn.binned, jnp.zeros((n, 3), jnp.float32), imeta, fmeta,
+        params=lrn.params, si_prefix=(), big_l=lrn.num_leaves,
+        max_depth=lrn.max_depth, b=b, bundled=lrn.bundled,
+        has_monotone=lrn.has_monotone, hist_method=lrn.hist_method,
+        interpret=True)
+
+
+@builder("fused_split_step_segment")
+def _b_fused_split_step_segment():
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner.partitioned import (HIST_BLK,
+                                                  SEG_SI_PREFIX)
+    lrn = _partitioned_learner()
+    S, T, imeta, fmeta = _fused_step_state(lrn, SEG_SI_PREFIX)
+    g = lrn.num_groups
+    b = lrn.num_bins_max
+    hist = jnp.zeros((lrn.num_leaves, g, b, 3), jnp.float32)
+    return _spec_fn("fused_split_step_segment").lower(
+        jnp.int32(1), S, T, lrn.mat, lrn.ws, hist, imeta, fmeta,
+        params=lrn.params, si_prefix=SEG_SI_PREFIX,
+        big_l=lrn.num_leaves, max_depth=lrn.max_depth, b=b, f=g,
+        n=lrn.num_data, bundled=lrn.bundled,
+        has_monotone=lrn.has_monotone, blk=HIST_BLK, interpret=True)
 
 
 @builder("split_scan_kernel")
@@ -591,8 +639,8 @@ def import_side_registrations() -> None:
     import lightgbm_tpu.objective.rank   # noqa: F401
     import lightgbm_tpu.ops.hist_pallas  # noqa: F401
     import lightgbm_tpu.ops.partition_pallas     # noqa: F401
-    import lightgbm_tpu.ops.partition_pallas_v2  # noqa: F401
     import lightgbm_tpu.ops.split_scan_pallas    # noqa: F401
+    import lightgbm_tpu.ops.split_step_pallas    # noqa: F401
     import lightgbm_tpu.predictor        # noqa: F401
     import lightgbm_tpu.robustness.guards        # noqa: F401
     # graftlint: allow[GL601]

@@ -75,9 +75,12 @@ def _build_dataset(rows=CENSUS_ROWS, features=CENSUS_FEATURES,
     return Dataset.from_numpy(x, cfg, label=y), cfg
 
 
-def lower_serial(ds, cfg):
+def lower_serial(ds, cfg, fused_kernel: bool = False):
     """jax Lowered of the serial grow program at this dataset/config
-    (shared with tools/graftcheck's serial_grow example builder)."""
+    (shared with tools/graftcheck's serial_grow example builder).
+    ``fused_kernel=True`` lowers the megakernel path
+    (ops/split_step_pallas.py — on CPU its interpret twin), the
+    ``serial_grow_fused`` census program."""
     import jax.numpy as jnp
 
     from lightgbm_tpu.learner.serial import SerialTreeLearner, _grow_jit
@@ -95,14 +98,14 @@ def lower_serial(ds, cfg):
         forced_plan=(), cache_hists=lrn.cache_hists,
         mv_slots=lrn.mv_slots, mv_groups=lrn.mv_groups,
         has_monotone=lrn.has_monotone,
-        split_fusion=_fusion_mode())
+        split_fusion=_fusion_mode(), fused_kernel=fused_kernel)
 
 
 def _compiled_serial(ds, cfg) -> str:
     return lower_serial(ds, cfg).compile().as_text()
 
 
-def lower_partitioned(ds, cfg):
+def lower_partitioned(ds, cfg, fused_kernel: bool = False):
     """jax Lowered of the partitioned grow program (shared with
     tools/graftcheck's partitioned_grow example builder)."""
     import jax.numpy as jnp
@@ -122,11 +125,20 @@ def lower_partitioned(ds, cfg):
         interpret=lrn.interpret, extra_trees=False, ff_bynode=1.0,
         bynode_count=2, forced_plan=(), cache_hists=lrn.cache_hists,
         hist_slots=lrn.hist_slots, has_monotone=lrn.has_monotone,
-        split_fusion=_fusion_mode())
+        split_fusion=_fusion_mode(), fused_kernel=fused_kernel)
 
 
 def _compiled_partitioned(ds, cfg) -> str:
     return lower_partitioned(ds, cfg).compile().as_text()
+
+
+def _compiled_serial_fused(ds, cfg) -> str:
+    return lower_serial(ds, cfg, fused_kernel=True).compile().as_text()
+
+
+def _compiled_partitioned_fused(ds, cfg) -> str:
+    return lower_partitioned(ds, cfg,
+                             fused_kernel=True).compile().as_text()
 
 
 def _fusion_mode() -> bool:
@@ -137,6 +149,11 @@ def _fusion_mode() -> bool:
 PROGRAMS = {
     "serial_grow": _compiled_serial,
     "partitioned_grow": _compiled_partitioned,
+    # the megakernel path (ops/split_step_pallas.py): the whole split
+    # as ONE pallas_call — the lax per-phase programs above stay the
+    # bit-exactness foil with their budgets unchanged
+    "serial_grow_fused": _compiled_serial_fused,
+    "partitioned_grow_fused": _compiled_partitioned_fused,
 }
 
 

@@ -138,9 +138,7 @@ def main():
                   f"({rate/1e6:8.1f} Mrow/s)"
                   + roof(rate, hist_bytes_per_row(f)) + flag)
 
-    # 3. chained partition_segment: v1 vs v2 (sub-tiled)
-    from lightgbm_tpu.ops import partition_pallas_v2 as pp2
-
+    # 3. chained partition_segment
     def mk_chain_part(fn, blk, k):
         def chain_part(m, w, count):
             lut = jnp.zeros((1, 256), jnp.float32)
@@ -174,9 +172,7 @@ def main():
         fetch_one(r)
         return time.perf_counter() - t0
 
-    for tag, fn, blk in (("v1 blk=512", pp.partition_segment, 512),
-                         ("v2", pp2.partition_segment_v2,
-                          pp2.pick_blk(int(mat.shape[1])))):
+    for tag, fn, blk in (("blk=512", pp.partition_segment, 512),):
         chain_long = mk_chain_part(fn, blk, k_chain)
         chain_short = mk_chain_part(fn, blk, k_short)
         print(f"partition_segment {tag} blk={blk}, "
@@ -258,6 +254,49 @@ def main():
     t = timeit(chain_scan_pl2_j, hist2)
     print(f"both-children scan (Pallas vmap) chained: "
           f"{t/k_chain*1e3:8.3f} ms/call-pair")
+
+    # 7. fused split-step megakernel (ops/split_step_pallas.py): the
+    # grow while-loop IS the chain (L-1 megakernel dispatches in one
+    # compiled program); per-split cost is DIFFERENCED across two
+    # leaf counts so the root histogram + fixed program overhead
+    # cancel, and the stream rate reads against the roofline with the
+    # fused bytes/row model (partition + histogram ride ONE pass)
+    import os as _os
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import Dataset as _DS
+    from lightgbm_tpu.learner.serial import SerialTreeLearner
+    from lightgbm_tpu.utils.roofline import fused_leaf_bytes_per_row
+
+    n_f = min(n, 200_000)
+    Xf = rng.randn(n_f, f).astype(np.float32)
+    yf = (Xf[:, 0] > 0).astype(np.float32)
+    gradf = jnp.asarray(yf - 0.5)
+    hessf = jnp.full((n_f,), 0.25, jnp.float32)
+
+    def tree_time(leaves, mode):
+        _os.environ["LGBM_TPU_FUSED_SPLIT_KERNEL"] = mode
+        try:
+            cfgf = Config.from_params({
+                "objective": "binary", "num_leaves": leaves,
+                "min_data_in_leaf": 20, "verbosity": -1})
+            lrn = SerialTreeLearner(_DS.from_numpy(Xf, cfgf, label=yf),
+                                    cfgf)
+            return timeit(lambda: lrn.train(gradf, hessf).tree
+                          .num_leaves, warmup=1, iters=3)
+        finally:
+            _os.environ.pop("LGBM_TPU_FUSED_SPLIT_KERNEL", None)
+
+    for tag, mode in (("fused megakernel", "1"),
+                      ("per-phase foil ", "0")):
+        t_hi = tree_time(63, mode)
+        t_lo = tree_time(31, mode)
+        per = (t_hi - t_lo) / 32
+        flag = "" if per > 0 else "  UNRELIABLE"
+        rate = n_f / max(per, 1e-9)
+        print(f"fused_split_kernel [{tag}] 31-vs-63-leaf trees: "
+              f"{per*1e3:8.3f} ms/split ({rate/1e6:8.1f} Mrow/s)"
+              + roof(rate, fused_leaf_bytes_per_row(f)) + flag)
 
 
 if __name__ == "__main__":

@@ -25,8 +25,8 @@ jax — a wedged tunnel would hang the orchestrator) and by
 
 from __future__ import annotations
 
-REASON_CODES = ("no_device", "init_timeout", "compile_error",
-                "transport", "unknown")
+REASON_CODES = ("no_device", "init_timeout", "not_lowerable",
+                "compile_error", "transport", "unknown")
 
 # signature -> code, checked in order: the FIRST match wins, so the
 # more specific transport/compile signatures are tested before the
@@ -36,6 +36,12 @@ _SIGNATURES = (
     # exact detail) or the subprocess layer timed out
     (("hung > ", "timeoutexpired", "timed out", "deadline_exceeded",
       "initialization timed out"), "init_timeout"),
+    # the kernel itself is rejected by the Mosaic LOWERING pass (a
+    # capability gap, not a device/toolchain crash): the split-step
+    # megakernel's capability gate emits this when it falls back to
+    # the per-phase kernels (ops/split_step_pallas.py)
+    (("loweringexception", "notimplementederror", "not implemented",
+      "verificationerror"), "not_lowerable"),
     # dialing the tunnel failed at the connection level
     (("connection refused", "connection reset", "unreachable",
       "failed to connect", "socket", "tunnel", "axon",

@@ -10,10 +10,10 @@ clients):
   4. bench.py                          -- 500k -> 2M -> 10.5M escalation
      + two attribution runs (fused blocks off / scan kernel off)
   5. tools/check_kernels_on_chip.py    -- FOUR per-stage children
-     (hist, partition_v1, partition_v2, split_scan), each validating
+     (hist, partition_v1, split_scan, fused_split), each validating
      the COMPILED kernel against a NumPy/XLA oracle and caching its
-     verdict in docs/KERNEL_CHECKS.json; a green partition_v2 from
-     THIS run promotes an LGBM_TPU_PART_V2=1 bench run
+     verdict in docs/KERNEL_CHECKS.json; a green fused_split from
+     THIS run promotes an LGBM_TPU_FUSED_SPLIT_KERNEL=1 bench run
   6. tools/bench_sweep.py              -- amortization curve + AUC gate
                                           into docs/PERF_SWEEP.json
 
@@ -124,8 +124,8 @@ def main():
     # kernel checks run ONE STAGE PER CHILD so a timeout or tunnel
     # death mid-stage keeps every finished stage's cached verdict
     # (docs/KERNEL_CHECKS.json); partial passes promote partially
-    for stage in ("hist", "partition_v1", "partition_v2",
-                  "split_scan"):
+    for stage in ("hist", "partition_v1", "split_scan",
+                  "fused_split"):
         ok.append(run(f"check_{stage}",
                       [sys.executable, "tools/check_kernels_on_chip.py",
                        stage],
@@ -134,23 +134,23 @@ def main():
     try:
         with open(os.path.join(REPO, "docs",
                                "KERNEL_CHECKS.json")) as fh:
-            entry = _json.load(fh).get("partition_v2", {})
+            entry = _json.load(fh).get("fused_split", {})
         # promotion needs a green verdict from THIS sequence: a stale
         # green from a previous round would bless a since-modified
         # kernel whose re-check was killed before it could save
         ts = time.mktime(time.strptime(entry.get("ts", ""),
                                        "%Y-%m-%d %H:%M:%S"))
-        part_v2_ok = bool(entry.get("ok")) and ts >= t0 - 60
+        fused_ok = bool(entry.get("ok")) and ts >= t0 - 60
     except (OSError, ValueError, OverflowError):
-        part_v2_ok = False
-    if part_v2_ok and left() > 900:
-        # compiled v2 partition validated -> measure it end-to-end at
-        # the 500k point for a direct v1-vs-v2 comparison
+        fused_ok = False
+    if fused_ok and left() > 900:
+        # compiled megakernel validated -> measure it end-to-end at
+        # the 500k point for a direct fused-vs-per-phase comparison
         envp = dict(os.environ)
-        envp["LGBM_TPU_PART_V2"] = "1"
+        envp["LGBM_TPU_FUSED_SPLIT_KERNEL"] = "1"
         envp["BENCH_ROWS"] = "500000"
         envp["BENCH_BUDGET_S"] = "600"
-        ok.append(run("bench_part_v2", [sys.executable, "bench.py"],
+        ok.append(run("bench_fused_split", [sys.executable, "bench.py"],
                       min(700.0, left()), envp))
     env2 = dict(os.environ)
     sweep_budget = int(max(left() - 120.0, 300.0))
