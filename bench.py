@@ -60,6 +60,17 @@ QUALITY_TIMEOUT_S = 900
 # chained round-over-round by tools/bench_trend.py
 CENSUS_TIMEOUT_S = 240
 
+# mesh-scaling block (ROADMAP item 2): 1 -> 8 virtual-device scaling
+# curve of steady-state time/split for every mesh learner mode on the
+# CPU backend — a structural cost of the partition-rule layer's
+# collective recipes (learner/comm.py), trend-gated round over round
+# by tools/bench_trend.py. Changing the shape requires a new id.
+MESH_SCALING = {"rows": 8192, "features": 16, "leaves": 15, "trees": 2}
+MESH_SCALING_ID = "mesh-scaling-v1-8192r-16f-15l"
+MESH_SCALING_DEVICES = (1, 2, 4, 8)
+MESH_SCALING_MODES = ("data", "feature", "voting", "partitioned")
+MESH_SCALING_TIMEOUT_S = 600
+
 # cached TPU probe verdict: one wedged-tunnel hang must not eat the
 # budget of every bench invocation in a round
 PROBE_CACHE_FILE = os.path.join(
@@ -408,6 +419,136 @@ def measure_fused_split():
     print(json.dumps(result))
 
 
+def measure_mesh_scaling():
+    """Mesh-learner scaling curve on the virtual CPU mesh: for each
+    parallel mode and device count, steady-state wall time per split
+    (one warmup tree absorbs the compile). The parent child-process
+    runs this under ``--xla_force_host_platform_device_count=8`` so
+    meshes of 1/2/4/8 shards all carve out of the same 8 virtual
+    devices. ``value`` is the 8-device total across modes (lower is
+    better — the number the trend gate chains); the full per-mode
+    curve rides the ``mesh_scaling`` block."""
+    import time as _time
+
+    import numpy as np
+
+    n = int(os.environ.get("BENCH_MESH_ROWS", MESH_SCALING["rows"]))
+    f = int(os.environ.get("BENCH_MESH_FEATURES",
+                           MESH_SCALING["features"]))
+    leaves = int(os.environ.get("BENCH_MESH_LEAVES",
+                                MESH_SCALING["leaves"]))
+    trees = int(os.environ.get("BENCH_MESH_TREES",
+                               MESH_SCALING["trees"]))
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import Dataset
+    from lightgbm_tpu.parallel.learners import (
+        DataParallelTreeLearner, FeatureParallelTreeLearner,
+        MeshPartitionedTreeLearner, VotingParallelTreeLearner)
+    from lightgbm_tpu.parallel.partition_rules import default_mesh
+
+    rng = np.random.RandomState(17)
+    x = rng.randn(n, f).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] + 0.3 * rng.randn(n) > 0) \
+        .astype(np.float32)
+    cfg = Config.from_params({"objective": "binary",
+                              "num_leaves": leaves,
+                              "min_data_in_leaf": 20,
+                              "verbosity": -1})
+    ds = Dataset.from_numpy(x, cfg, label=y)
+    grad = jnp.asarray(y - 0.5)
+    hess = jnp.full((n,), 0.25, jnp.float32)
+    splits = leaves - 1
+
+    def make(mode, nd):
+        mesh = default_mesh(nd)
+        if mode == "data":
+            return DataParallelTreeLearner(ds, cfg, mesh=mesh)
+        if mode == "feature":
+            return FeatureParallelTreeLearner(ds, cfg, mesh=mesh)
+        if mode == "voting":
+            return VotingParallelTreeLearner(ds, cfg, mesh=mesh)
+        return MeshPartitionedTreeLearner(ds, cfg, mesh=mesh,
+                                          mode="data", interpret=True)
+
+    devices = [d for d in MESH_SCALING_DEVICES
+               if d <= jax.device_count()]
+    modes: dict = {}
+    errors: dict = {}
+    for mode in MESH_SCALING_MODES:
+        curve = {}
+        for nd in devices:
+            try:
+                lrn = make(mode, nd)
+                res = lrn.train(grad, hess)       # warmup + compile
+                jax.block_until_ready(res.tree.num_leaves)
+                t0 = _time.perf_counter()
+                for _ in range(trees):
+                    res = lrn.train(grad, hess)
+                jax.block_until_ready(res.tree.num_leaves)
+                dt = (_time.perf_counter() - t0) / trees
+                curve[str(nd)] = round(dt / splits * 1e3, 4)
+            except Exception as e:  # noqa: BLE001 - record, keep going
+                errors[f"{mode}@{nd}"] = str(e)[:160]
+        if curve:
+            modes[mode] = curve
+    top = [m[str(devices[-1])] for m in modes.values()
+           if str(devices[-1]) in m]
+    result = {
+        "metric": "mesh_scaling",
+        "value": round(sum(top), 4) if top else None,
+        "unit": "ms/split (sum over modes, max devices)",
+        "backend": jax.default_backend(),
+        "baseline_config": MESH_SCALING_ID,
+        "mesh_scaling": {
+            "devices": devices,
+            "rows": n, "features": f, "leaves": leaves,
+            "modes": modes,
+            # scaling efficiency: 1-device time / max-device time
+            "speedup": {
+                m: round(c[str(devices[0])] / c[str(devices[-1])], 3)
+                for m, c in modes.items()
+                if str(devices[0]) in c and str(devices[-1]) in c
+                and c[str(devices[-1])] > 0},
+        },
+    }
+    if errors:
+        result["mesh_scaling"]["errors"] = errors
+    print(json.dumps(result))
+
+
+def run_mesh_scaling_block(env, remaining):
+    """Run the mesh-scaling child on the CPU backend with the 8-device
+    virtual mesh. Prints its JSON line and returns it."""
+    if os.environ.get("BENCH_NO_MESH") or remaining < 120:
+        return None
+    envc = _cpu_env(env)
+    envc.pop("_BENCH_CHILD", None)
+    envc["_BENCH_CHILD_MESH"] = "1"
+    flags = envc.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        envc["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=envc,
+            capture_output=True, text=True,
+            timeout=max(120.0, min(MESH_SCALING_TIMEOUT_S, remaining)))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("mesh-scaling child timed out\n")
+        return None
+    parsed = find_result_line(proc.stdout)
+    if parsed is None:
+        sys.stderr.write("mesh-scaling child failed:\n"
+                         + proc.stderr[-2000:] + "\n")
+        return None
+    print(json.dumps(parsed), flush=True)
+    return parsed
+
+
 def run_fused_split_block(env, remaining):
     """Run the fused-split child on the CPU backend (trend-gated
     structural cost; the on-chip number comes from the perf-sequence
@@ -735,6 +876,9 @@ def main():
     if os.environ.get("_BENCH_CHILD_FUSED") == "1":
         measure_fused_split()
         return
+    if os.environ.get("_BENCH_CHILD_MESH") == "1":
+        measure_mesh_scaling()
+        return
     budget = float(os.environ.get("BENCH_BUDGET_S", 1500))
     t_start = time.monotonic()
     env = dict(os.environ)
@@ -775,6 +919,8 @@ def main():
         run_linear_convergence(
             env, budget - (time.monotonic() - t_start))
         run_fused_split_block(
+            env, budget - (time.monotonic() - t_start))
+        run_mesh_scaling_block(
             env, budget - (time.monotonic() - t_start))
         qp = run_quality_gate(
             env, budget - (time.monotonic() - t_start))

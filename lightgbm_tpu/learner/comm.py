@@ -1,52 +1,68 @@
-"""Communication strategies for the leaf-wise grow loop.
+"""Communication recipes for the leaf-wise grow loop.
 
 Reference analog: the parallel tree learners
 (``src/treelearner/{feature,data,voting}_parallel_tree_learner.cpp``)
 layered over the hand-rolled ``Network`` collectives (``src/network/``).
 On TPU the whole Network layer is replaced by XLA mesh collectives
-(psum / all_gather over ICI) inside ``shard_map``; what remains of each
-parallel algorithm is captured here as three hooks injected into ONE
-shared grow loop (``learner/serial.py:grow_tree``):
+inside ``shard_map``; what remains of each parallel algorithm is a
+RECIPE of hooks injected into ONE shared grow loop
+(``learner/serial.py:grow_tree`` / ``learner/partitioned.py``), with
+the array placement owned by the partition-rule layer
+(``parallel/partition_rules.py``).
 
-  * ``reduce_hist``  — histogram aggregation after each build.
-      data-parallel: ``psum`` (the reduce-scatter + aggregate of
-      data_parallel_tree_learner.cpp:149-164, fused by XLA);
-      serial / feature-parallel / voting: identity (histograms stay
-      local by design).
-  * ``reduce_sums``  — (Σg, Σh, Σcount) root aggregation
-      (data_parallel_tree_learner.cpp:120-145).
-  * ``select_split`` — best-split choice for one leaf.
-      serial & data-parallel: local argmax over the (global) histogram;
-      feature-parallel: local scan on the feature shard + all_gather
-      argmax (SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213);
-      voting: local top-k -> all_gather -> weighted-gain GlobalVoting ->
-      psum of only the winning features' histograms -> global scan
-      (voting_parallel_tree_learner.cpp:244-430).
+The collective budget is a CONTRACT: graftcheck GC401 pins the exact
+per-program multiset (``tools/graftcheck/contracts.json``), so every
+recipe below states its count. The collapse levers:
+
+* **packed winner gather** — a shard's best-split candidate is ONE
+  f32 buffer (ints/bitsets bitcast, bit patterns preserved), so the
+  winner exchange is ONE ``all_gather`` instead of a tree-map gather
+  per SplitResult field (the old feature-parallel cost: ~10 gathers
+  per select, 30 per split).
+* **pair batching** — both fresh children's selects run under
+  ``jax.vmap`` (``vmap_safe=True``); XLA batches the collective, so a
+  split pays ONE gather (and, for voting, one psum) for both children.
+* **reduce-scatter histograms (data-parallel)** — the per-split child
+  histogram is ``psum_scatter``'d over the (permuted) group axis and
+  each shard scans ITS slice of the globally-reduced histogram — the
+  reference's ReduceScatter + SyncUpGlobalBestSplit shape
+  (data_parallel_tree_learner.cpp:149-164) instead of a full-histogram
+  all-reduce followed by a redundant replicated scan.
+* **packed root reduce** — the root histogram and the root (g, h, c)
+  sums ride ONE psum (concatenated), not two.
+
+Per-mode collective multisets (whole compiled grow program):
+
+  data     {all-reduce: 1, reduce-scatter: 1, all-gather: 1}  (was 3ar)
+  feature  {all-gather: 2}                                    (was 30ag)
+  voting   {all-gather: 2, all-reduce: 3}                     (was 6ag+4ar)
 
 Every hook returns values REPLICATED across mesh devices so the grow
 loop's control flow stays identical everywhere; only row partitioning
-(leaf_id) and histogram work are sharded.
+and histogram work are sharded.
 """
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ..ops.split import (FeatureMeta, SplitParams, _argmax_first,
-                         assemble_split, best_split,
-                         per_feature_splits)
+from ..ops.split import (MAX_CAT_WORDS, FeatureMeta, SplitParams,
+                         SplitResult, _argmax_first, assemble_split,
+                         best_split, per_feature_splits)
 
 
 def _count_collective(name: str, tree):
-    """Telemetry: add the payload bytes of a collective to counter
-    ``comm.<name>_bytes`` and return the payload unchanged. The comm
-    hooks run inside jitted grow programs, so this executes at TRACE
-    time over abstract values — the counter records bytes moved per
-    compiled-program invocation (grow-loop collectives execute once per
-    while-loop step at runtime), with zero cost inside the program."""
+    """Telemetry seam: add the payload bytes of a collective to counter
+    ``comm.<name>_bytes`` (+ ``comm.<name>_calls``) and return the
+    payload unchanged. The comm hooks run inside jitted grow programs,
+    so this executes at TRACE time over abstract values — the counter
+    records bytes moved per compiled-program invocation (grow-loop
+    collectives execute once per while-loop step at runtime), with
+    zero cost inside the program. ``tools/run_report.py`` renders the
+    counters as the per-op comms table."""
     from ..observability.telemetry import get_telemetry, traced_bytes
     tel = get_telemetry()
     if tel.enabled:
@@ -55,22 +71,43 @@ def _count_collective(name: str, tree):
     return tree
 
 
+def _bitcast_f32(x):
+    return jax.lax.bitcast_convert_type(
+        jnp.asarray(x, jnp.int32), jnp.float32)
+
+
+def _bitcast_i32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
 class Comm(NamedTuple):
-    """Static strategy object (functions close over mesh axis names)."""
+    """Static strategy object (functions close over mesh axis names).
+
+    ``reduce_hist``/``select_split`` define the PER-SPLIT path: the
+    child histogram reduce (which may change layout — data-parallel
+    returns the shard's reduce-scattered slice) and the best-split
+    scan over that layout. ``reduce_root``/``select_root``/``to_scan``
+    define the ROOT path where it differs: data-parallel reduces the
+    full root histogram once (packed with the root sums), scans it
+    replicated, and ``to_scan`` slices it into the per-split cache
+    layout. ``None`` fields fall back to the per-split hooks."""
     reduce_hist: Callable
     reduce_sums: Callable
     select_split: Callable
-    # True when select_split is a pure local computation the grow loop
-    # may jax.vmap over both children at once. OPT-IN: a comm whose
-    # select carries mesh collectives must never be batched, so the
-    # default fails safe
+    # True when select_split may run under jax.vmap over both fresh
+    # children: XLA batches any inner collective into ONE op, so the
+    # pair costs one gather. Set on every recipe whose select is
+    # batching-safe (all of the below).
     vmap_safe: bool = False
     # True when the histogram handed to select_split is shard-LOCAL
     # (voting keeps hists local until the winners' psum). The grow
     # loop's EFB debundle must then reconstruct most-freq-bin counts
-    # from LOCAL leaf totals (derived from the local group hist), not
-    # the globally reduced g/h/c
+    # from LOCAL leaf totals, not the globally reduced g/h/c
     local_hist: bool = False
+    # root-path overrides (None -> derive from the per-split hooks)
+    reduce_root: Optional[Callable] = None   # (hist, sums) -> (hist, sums)
+    select_root: Optional[Callable] = None
+    to_scan: Optional[Callable] = None       # root hist -> cache layout
 
 
 def _serial_select(hist, g, h, c, meta, params, cmin, cmax, fmask,
@@ -84,57 +121,153 @@ SERIAL_COMM = Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
                    select_split=_serial_select, vmap_safe=True)
 
 
-def make_data_parallel_comm(axis: str) -> Comm:
-    """Histograms and root sums are psum'ed; split selection then runs
-    identically (and redundantly — cheap) on every device."""
-    return Comm(
-        reduce_hist=lambda x: jax.lax.psum(
-            _count_collective("psum", x), axis),
-        reduce_sums=lambda x: jax.lax.psum(
-            _count_collective("psum", x), axis),
-        select_split=_serial_select, vmap_safe=True)
+# ---------------------------------------------------------------------
+# packed SplitResult exchange: ONE f32 buffer per candidate.
+_PACK_WORDS = 10 + MAX_CAT_WORDS
 
 
-def make_feature_parallel_comm(axis: str) -> Comm:
-    """Every device holds all rows but scans only its feature shard
-    (contiguous blocks for raw features, whole EFB bundle groups for
-    bundled datasets — meta_local.global_id maps the local scan slot
-    back to the global feature); winners are compared via all_gather of
-    the tiny SplitResult (the Allreduce of SplitInfo,
-    parallel_tree_learner.h:190-213)."""
+def pack_split(res: SplitResult) -> jnp.ndarray:
+    """SplitResult -> f32[10 + MAX_CAT_WORDS]. Ints and the bitset are
+    bitcast (value bits preserved exactly); bools ride as 0/1."""
+    scal = jnp.stack([
+        res.gain,
+        _bitcast_f32(res.feature),
+        _bitcast_f32(res.threshold),
+        res.default_left.astype(jnp.float32),
+        res.left_g, res.left_h, res.left_c,
+        res.left_output, res.right_output,
+        res.is_cat.astype(jnp.float32)])
+    bits = jax.lax.bitcast_convert_type(res.cat_bitset, jnp.float32)
+    return jnp.concatenate([scal, bits])
+
+
+def unpack_split(row: jnp.ndarray) -> SplitResult:
+    return SplitResult(
+        gain=row[0],
+        feature=_bitcast_i32(row[1]),
+        threshold=_bitcast_i32(row[2]),
+        default_left=row[3] > 0.5,
+        left_g=row[4], left_h=row[5], left_c=row[6],
+        left_output=row[7], right_output=row[8],
+        is_cat=row[9] > 0.5,
+        cat_bitset=jax.lax.bitcast_convert_type(row[10:], jnp.uint32))
+
+
+def gather_best_split(res: SplitResult, axis: str) -> SplitResult:
+    """The SyncUpGlobalBestSplit exchange
+    (parallel_tree_learner.h:190-213) as ONE packed all_gather:
+    max gain wins, ties broken by LOWER global feature id so
+    equal-gain splits match serial's first-index rule even when
+    bundled group blocks scramble the shard<->feature-id order."""
+    rows = jax.lax.all_gather(
+        _count_collective("all_gather", pack_split(res)), axis)
+    gains = rows[:, 0]
+    feats = _bitcast_i32(rows[:, 1])
+    best = jnp.max(gains)
+    tied = jnp.where(gains >= best, feats, jnp.iinfo(jnp.int32).max)
+    return unpack_split(rows[jnp.argmin(tied)])
+
+
+def make_sharded_select(axis: str):
+    """Best-split select over a column-sharded scan axis: local scan
+    of the shard's slice (``meta_local.global_id`` maps the local slot
+    back to the global feature) + the packed winner gather. Shared by
+    the feature-parallel learner (locally-built sharded histograms)
+    and the data-parallel reduce-scatter recipe (slices of the
+    globally-reduced histogram)."""
 
     def select(hist, g, h, c, meta_local, params, cmin, cmax, fmask,
                rand_bins=None):
         pf = per_feature_splits(hist, g, h, c, meta_local, params,
                                 cmin, cmax, fmask, rand_bins)
         lb = _argmax_first(pf.score).astype(jnp.int32)
-        gid = meta_local.global_id[lb]
-        res = assemble_split(pf, lb, feature_id=gid)
-        stacked = jax.tree.map(
-            lambda x: jax.lax.all_gather(x, axis),
-            _count_collective("all_gather", res))
-        # winner: max gain, ties broken by LOWER global feature id so
-        # equal-gain splits match serial's first-index rule even when
-        # bundled group blocks scramble the shard<->feature-id order
-        best = jnp.max(stacked.gain)
-        tied_id = jnp.where(stacked.gain >= best, stacked.feature,
-                            jnp.iinfo(jnp.int32).max)
-        w = jnp.argmin(tied_id)
-        return jax.tree.map(lambda x: x[w], stacked)
+        res = assemble_split(pf, lb,
+                             feature_id=meta_local.global_id[lb])
+        return gather_best_split(res, axis)
 
+    return select
+
+
+# ---------------------------------------------------------------------
+def make_data_parallel_comm(axis: str, plan=None) -> Comm:
+    """Data-parallel (data_parallel_tree_learner.cpp semantics).
+
+    With ``plan`` (a ``partition_rules.FeatureShardPlan``): the
+    reduce-scatter recipe — per-split child histograms are permuted to
+    shard-slice order and ``psum_scatter``'d (each shard receives the
+    globally-reduced histograms of ITS groups), scanned locally
+    against ``plan.meta_local``, and the winner is exchanged via the
+    packed gather. The root histogram is psum'ed ONCE (packed with the
+    root sums), scanned replicated, and ``to_scan`` slices it into the
+    cache layout. 3 collectives per program: {ar:1, rs:1, ag:1}.
+
+    Without ``plan``: the legacy replicated recipe — full-histogram
+    psum + redundant replicated select. Kept for the configs whose
+    bookkeeping needs a replicated global-feature histogram (CEGB's
+    candidate cache, forced splits reading the leaf histogram cache).
+    """
+    if plan is None:
+        return Comm(
+            reduce_hist=lambda x: jax.lax.psum(
+                _count_collective("psum", x), axis),
+            reduce_sums=lambda x: jax.lax.psum(
+                _count_collective("psum", x), axis),
+            select_split=_serial_select, vmap_safe=True)
+
+    g_local = plan.g_local
+
+    def reduce_hist(hist):
+        hp = plan.permute_hist(hist)
+        return jax.lax.psum_scatter(
+            _count_collective("psum_scatter", hp), axis,
+            scatter_dimension=0, tiled=True)
+
+    def reduce_root(hist, sums):
+        flat = jnp.concatenate([hist.reshape(-1), sums])
+        flat = jax.lax.psum(_count_collective("psum", flat), axis)
+        return flat[:-3].reshape(hist.shape), flat[-3:]
+
+    def to_scan(hist_full):
+        hp = plan.permute_hist(hist_full)
+        idx = jax.lax.axis_index(axis)
+        return jax.lax.dynamic_slice_in_dim(
+            hp, idx * g_local, g_local, axis=0)
+
+    return Comm(
+        reduce_hist=reduce_hist,
+        reduce_sums=lambda x: jax.lax.psum(
+            _count_collective("psum", x), axis),
+        select_split=make_sharded_select(axis), vmap_safe=True,
+        reduce_root=reduce_root, select_root=_serial_select,
+        to_scan=to_scan)
+
+
+def make_feature_parallel_comm(axis: str) -> Comm:
+    """Every device holds all rows but scans only its feature shard
+    (whole EFB bundle groups; ``meta_local.global_id`` maps the local
+    scan slot back to the global feature); winners are compared via
+    the packed single-buffer gather (the Allreduce of SplitInfo,
+    parallel_tree_learner.h:190-213). 2 collectives per program: the
+    root select's gather + the vmapped pair's batched gather."""
     return Comm(reduce_hist=lambda x: x, reduce_sums=lambda x: x,
-                select_split=select)
+                select_split=make_sharded_select(axis), vmap_safe=True)
 
 
 def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
                               params_local: SplitParams) -> Comm:
-    """PV-Tree. Per leaf: local per-feature scan (with min_data /
-    min_hessian divided by num_machines, voting_parallel_tree_learner.cpp
-    :57-59) -> local top-k -> all_gather(2·top_k LightSplitInfo analog)
-    -> GlobalVoting by gain weighted with local leaf count / mean count
-    (:152-183) -> aggregate only the winning features' histogram columns
-    (CopyLocalHistogram + ReduceScatter, :186-242,344) -> full-parameter
-    scan on the aggregated columns -> replicated winner."""
+    """PV-Tree (arxiv 1611.01276; voting_parallel_tree_learner.cpp).
+    Per leaf: local per-feature scan (with min_data / min_hessian
+    divided by num_machines, :57-59) -> local top-k -> ONE packed
+    all_gather of (weighted gain, feature id) pairs (the 2*top_k
+    LightSplitInfo exchange) -> GlobalVoting by gain weighted with
+    local leaf count / mean count (:152-183) -> psum of ONLY the
+    winning features' histogram columns (CopyLocalHistogram +
+    ReduceScatter, :186-242,344 — O(top_k) not O(F)) -> full-parameter
+    scan on the aggregated columns -> replicated winner.
+
+    5 collectives per program: root sums psum + (gather, psum) at the
+    root select + ONE batched (gather, psum) for the vmapped child
+    pair."""
 
     def select(hist_local, g, h, c, meta, params, cmin, cmax, fmask,
                rand_bins=None):
@@ -151,10 +284,13 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
         w_gain = jnp.where(jnp.isfinite(top_gain),
                            top_gain * loc[2] / jnp.maximum(mean_cnt, 1.0),
                            -jnp.inf)
-        all_ids = jax.lax.all_gather(
-            _count_collective("all_gather", top_ids), axis).reshape(-1)
-        all_gain = jax.lax.all_gather(
-            _count_collective("all_gather", w_gain), axis).reshape(-1)
+        # ONE packed gather for the whole vote: [2k] = gains ++ ids
+        buf = jnp.concatenate([w_gain,
+                               _bitcast_f32(top_ids.astype(jnp.int32))])
+        rows = jax.lax.all_gather(
+            _count_collective("all_gather", buf), axis)
+        all_gain = rows[:, :k].reshape(-1)
+        all_ids = _bitcast_i32(rows[:, k:]).reshape(-1)
         # per-feature max weighted gain over all candidates, then top-k
         feat_gain = jnp.full((f,), -jnp.inf).at[all_ids].max(
             jnp.where(jnp.isfinite(all_gain), all_gain, -jnp.inf))
@@ -174,4 +310,30 @@ def make_voting_parallel_comm(axis: str, num_machines: int, top_k: int,
     return Comm(reduce_hist=lambda x: x,
                 reduce_sums=lambda x: jax.lax.psum(
                     _count_collective("psum", x), axis),
-                select_split=select, local_hist=True)
+                select_split=select, vmap_safe=True, local_hist=True)
+
+
+# ---------------------------------------------------------------------
+class ShardScanCtx(NamedTuple):
+    """Per-shard scan context the grow loops use for the PER-SPLIT
+    scans when the scan axis is column-sharded but the histogram build
+    is not (the data-parallel reduce-scatter recipe): the permuted
+    local meta, the shard's slice of the feature mask, the
+    shard-folded RNG key pair and the shard's slice of the by-node
+    feature budget. ``None`` ctx -> per-split scans reuse the root
+    scan's (global) context."""
+    meta: FeatureMeta
+    fmask: jnp.ndarray
+    rand_key: Optional[jnp.ndarray]
+    bynode_count: object        # traced int (uneven budget split)
+    bynode_cap: int             # static cap for the top_k draw
+
+
+def comm_root_hooks(comm: Comm):
+    """(reduce_root, select_root, to_scan) with the per-split hooks as
+    fallbacks — one definition for both grow loops."""
+    reduce_root = comm.reduce_root or (
+        lambda hh, ss: (comm.reduce_hist(hh), comm.reduce_sums(ss)))
+    select_root = comm.select_root or comm.select_split
+    to_scan = comm.to_scan or (lambda hh: hh)
+    return reduce_root, select_root, to_scan

@@ -292,7 +292,8 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                      row_id_base=0, n_total=None, cache_hists=True,
                      cegb_used0=None, hist_slots=None,
                      has_monotone=True, split_fusion=None,
-                     fused_kernel=False, return_leaf_parts=False):
+                     fused_kernel=False, return_leaf_parts=False,
+                     body_scan=None):
     """Traceable partitioned grow loop.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py)
@@ -303,7 +304,10 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
     ``row_id_base``/``n_total``: a shard's matrix carries GLOBAL row ids
     in [row_id_base, row_id_base + n); ``grad``/``hess``/``bag_weight``
     are the shard's LOCAL [n] slices (rows never leave their shard, so
-    nothing larger is ever needed).
+    nothing larger is ever needed). ``body_scan`` (ShardScanCtx)
+    switches per-split scans onto the column-sharded local context of
+    the data-parallel reduce-scatter recipe (learner/comm.py) while
+    the root scan stays replicated.
     """
     if comm is None:
         from .comm import SERIAL_COMM
@@ -401,9 +405,24 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
 
     # shared scan-leaf composition (learner/split_step.py — the fused
     # megakernel twin calls the SAME maker, keeping both paths
-    # bit-identical)
-    scan_leaf = make_scan_leaf(comm, meta, params, feature_mask,
-                               node_rand, bundled, max_depth)
+    # bit-identical). Root and per-split scans may differ in layout —
+    # see grow_tree (learner/serial.py) for the recipe split.
+    from .comm import comm_root_hooks
+    reduce_root, select_root, to_scan = comm_root_hooks(comm)
+    scan_root = make_scan_leaf(comm, meta, params, feature_mask,
+                               node_rand, bundled, max_depth,
+                               select=select_root)
+    if body_scan is None:
+        scan_body = make_scan_leaf(comm, meta, params, feature_mask,
+                                   node_rand, bundled, max_depth)
+    else:
+        node_rand_body = make_node_rand(
+            body_scan.rand_key, body_scan.fmask,
+            body_scan.bynode_count, body_scan.meta.num_bins,
+            extra_trees, ff_bynode, bynode_cap=body_scan.bynode_cap)
+        scan_body = make_scan_leaf(comm, body_scan.meta, params,
+                                   body_scan.fmask, node_rand_body,
+                                   bundled, max_depth)
 
     def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used):
         # CEGB candidate-cache scan (see learner/serial.py): best from
@@ -427,18 +446,23 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                 pf._replace(score=raw), blocked)
 
     # root sums reduce from the LOCAL histogram (voting keeps hists
-    # local, so reduce_hist alone would leave the sums shard-local)
+    # local, so reduce_hist alone would leave the sums shard-local);
+    # recipes with a packed root reduce carry the sums in the SAME
+    # collective as the histogram (learner/comm.py)
     local_root = histogram_segment(mat, jnp.int32(0), jnp.int32(n), b, f,
                                    blk=HIST_BLK, interpret=interpret)
-    sums = comm.reduce_sums(local_root[0].sum(axis=0))
-    root_hist = comm.reduce_hist(local_root)
+    root_hist, sums = reduce_root(local_root,
+                                  local_root[0].sum(axis=0))
     root_g, root_h, root_c = sums[0], sums[1], sums[2]
+    # per-split scan/cache layout of the root histogram (identity for
+    # every recipe except data-parallel's reduce-scatter slice)
+    hist0 = to_scan(root_hist)
     if params.cegb_on:
         root_split, root_pf, root_blocked = scan_leaf_pf(
             root_hist, root_g, root_h, root_c, jnp.int32(0), -inf, inf,
             jnp.int32(0), cegb_used0)
     else:
-        root_split = scan_leaf(root_hist, root_g, root_h, root_c,
+        root_split = scan_root(root_hist, root_g, root_h, root_c,
                                jnp.int32(0), -inf, inf, jnp.int32(0))
     root_out = leaf_output_no_constraint(
         root_g, root_h + 2e-15, params.lambda_l1, params.lambda_l2,
@@ -498,7 +522,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                 jnp.moveaxis(root_hist, -1, 0))
         else:
             fields["hist"] = at0(
-                jnp.zeros((big_l, f, b, 3), jnp.float32), root_hist)
+                jnp.zeros((big_l,) + hist0.shape, jnp.float32), hist0)
     if pool_mode:
         # bounded LRU pool: slot 0 holds the root; slot_used carries
         # the split tick of the last touch (-1 = empty, filled first)
@@ -695,7 +719,7 @@ def grow_partitioned(mat, ws, grad, hess, bag_weight, feature_mask, meta,
                 begin_a, cnt_a, begin_b, cnt_b = (begin, nl,
                                                   begin + nl, nr)
             o, split_a, split_b = scan_split_pair(
-                comm, scan_leaf, a_is_left, k, depth, hist_a, hist_b,
+                comm, scan_body, a_is_left, k, depth, hist_a, hist_b,
                 lg, lh, lc, rg, rh, rc, lout, rout,
                 cmin_l, cmax_l, cmin_r, cmax_r)
 

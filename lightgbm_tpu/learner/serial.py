@@ -594,6 +594,9 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
     ``linear_tree`` subsystem, models/linear.py)."""
 
     _count_tree_telemetry = count_tree_telemetry
+    # mesh subclasses flip this off and place the matrix through the
+    # sharded ingest layer instead (parallel/ingest.py)
+    _stage_binned_on_device = True
 
     def __init__(self, dataset: Dataset, config: Config,
                  hist_method: str = "auto"):
@@ -615,7 +618,13 @@ class SerialTreeLearner(NodeRandMixin, CegbStateMixin,
             # (categorical/CEGB) skip the probe compile entirely.
             use_scan_kernel=_scan_kernel_default(
                 eligible=not has_cat and not base_params.cegb_on))
-        self.binned = jnp.asarray(dataset.binned)
+        # the mesh learners defer device placement to the sharded
+        # ingest path (parallel/ingest.py): a plain jnp.asarray here
+        # would stage the FULL matrix on the default device before the
+        # re-shard — exactly the replicated host-0 copy the ingest
+        # layer exists to avoid
+        self.binned = jnp.asarray(dataset.binned) \
+            if self._stage_binned_on_device else dataset.binned
         # multi-val pseudo-groups (no physical column; bundling.py)
         self.mv_slots = dataset.mv_slots_device
         self.mv_groups = dataset.num_groups - dataset.num_dense_groups
@@ -737,13 +746,20 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
               mv_slots=None, mv_groups: int = 0,
               has_monotone: bool = True,
               split_fusion: bool | None = None,
-              fused_kernel: bool = False) -> GrowResult:
+              fused_kernel: bool = False,
+              body_scan=None) -> GrowResult:
     """One full leaf-wise tree; jit-compiled once per shape.
 
     ``comm`` injects the parallel-learner collectives (learner/comm.py);
     ``binned_hist``/``meta_hist`` override the histogram-build inputs for
     feature-parallel mode (feature-sharded) while ``binned``/``meta``
     stay global for row partitioning and the tree arrays.
+    ``body_scan`` (a ``learner/comm.py:ShardScanCtx``) switches the
+    PER-SPLIT scans onto a column-sharded local context (permuted
+    meta, local feature mask, shard-folded RNG) while the root scan
+    keeps the global one — the data-parallel reduce-scatter recipe,
+    where the root histogram is reduced replicated but every per-split
+    histogram arrives as the shard's reduce-scattered slice.
 
     ``cache_hists=False`` is the pool-bounded mode (the reference's
     ``histogram_pool_size`` LRU, serial_tree_learner.cpp:313-353,
@@ -783,10 +799,17 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 axis=0)
         return h
 
+    from .comm import comm_root_hooks
+    reduce_root, select_root, to_scan = comm_root_hooks(comm)
     ghc = make_ghc(grad, hess, bag_weight)
-    root_hist = comm.reduce_hist(full_hist(ghc))
-    root_sums = comm.reduce_sums(ghc.sum(axis=0))
+    # ONE packed collective where the recipe supports it (the root
+    # histogram and the root sums ride the same psum — learner/comm.py)
+    root_hist, root_sums = reduce_root(full_hist(ghc),
+                                       ghc.sum(axis=0))
     root_g, root_h, root_c = root_sums[0], root_sums[1], root_sums[2]
+    # per-split scan/cache layout of the root histogram (identity for
+    # every recipe except data-parallel's reduce-scatter slice)
+    hist0 = to_scan(root_hist)
 
     inf = jnp.float32(jnp.inf)
     # static per-trace packing of the grow-loop carry
@@ -870,9 +893,26 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
 
     # shared scan-leaf composition (learner/split_step.py — the fused
     # megakernel's interpret twin calls the SAME maker, which is what
-    # keeps the two paths bit-identical)
-    scan_leaf = make_scan_leaf(comm, meta_hist, params, feature_mask,
-                               node_rand, bundled, max_depth)
+    # keeps the two paths bit-identical). The root and per-split scans
+    # may differ in layout: the root scans ``root_hist`` with the
+    # global meta (and the recipe's select_root), per-split scans use
+    # the ``body_scan`` shard context when the comm reduces child
+    # histograms into a column-sharded slice.
+    scan_root = make_scan_leaf(comm, meta_hist, params, feature_mask,
+                               node_rand, bundled, max_depth,
+                               select=select_root)
+    if body_scan is None:
+        scan_body = make_scan_leaf(comm, meta_hist, params,
+                                   feature_mask, node_rand, bundled,
+                                   max_depth)
+    else:
+        node_rand_body = make_node_rand(
+            body_scan.rand_key, body_scan.fmask,
+            body_scan.bynode_count, body_scan.meta.num_bins,
+            extra_trees, ff_bynode, bynode_cap=body_scan.bynode_cap)
+        scan_body = make_scan_leaf(comm, body_scan.meta, params,
+                                   body_scan.fmask, node_rand_body,
+                                   bundled, max_depth)
 
     def scan_leaf_pf(hist, g, h, c, depth, cmin, cmax, salt, cegb_used,
                      uncharged=None):
@@ -907,7 +947,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
             root_hist, root_g, root_h, root_c, jnp.int32(0), -inf, inf,
             jnp.int32(0), cegb_used0, unch_root)
     else:
-        root_split = scan_leaf(root_hist, root_g, root_h, root_c,
+        root_split = scan_root(root_hist, root_g, root_h, root_c,
                                jnp.int32(0), -inf, inf, jnp.int32(0))
 
     def at0(arr, val):
@@ -974,9 +1014,8 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 jnp.moveaxis(root_hist, -1, 0))
         else:
             fields["hist"] = at0(
-                jnp.zeros((big_l, num_features_hist, b, 3),
-                          jnp.float32),
-                root_hist)
+                jnp.zeros((big_l,) + hist0.shape, jnp.float32),
+                hist0)
     if params.cegb_on:
         fields["cegb_used"] = cegb_used0
         fields.update(cegb_pf_state(big_l, f_logical))
@@ -1151,7 +1190,7 @@ def grow_tree(binned, grad, hess, bag_weight, feature_mask, *,
                 idx_a, idx_b = leaf, new
                 hist_a, hist_b = hist_left, hist_right
             o, split_a, split_b = scan_split_pair(
-                comm, scan_leaf, a_is_left, k, depth, hist_a, hist_b,
+                comm, scan_body, a_is_left, k, depth, hist_a, hist_b,
                 lg, lh, lc, rg, rh, rc, lout, rout,
                 cmin_l, cmax_l, cmin_r, cmax_r)
 

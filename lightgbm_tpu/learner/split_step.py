@@ -421,12 +421,18 @@ def child_columns(split, g, h, c, out, cmin, cmax, s, side, depth,
 
 
 def make_scan_leaf(comm, meta_scan, params, feature_mask, node_rand,
-                   bundled: bool, max_depth: int):
+                   bundled: bool, max_depth: int, select=None):
     """One leaf's best-split scan (debundle -> per-node randomness ->
     comm.select_split -> max_depth blocking) — ONE definition shared by
     the serial and partitioned grow bodies AND the fused megakernel's
     interpret twin (ops/split_step_pallas.py). The twin's byte-exact
-    parity with the foil rests on this being the same function."""
+    parity with the foil rests on this being the same function.
+    ``select`` overrides ``comm.select_split`` where the root and
+    per-split scan layouts differ (the data-parallel reduce-scatter
+    recipe scans the root replicated, learner/comm.py)."""
+    if select is None:
+        select = comm.select_split
+
     def scan_leaf(hist, g, h, c, depth, cmin, cmax, salt):
         if bundled:
             from ..ops.histogram import debundle_leaf_hist
@@ -434,8 +440,8 @@ def make_scan_leaf(comm, meta_scan, params, feature_mask, node_rand,
                                       comm.local_hist)
         rb, nm = node_rand(salt)
         fm = feature_mask if nm is None else nm  # nm already in-subset
-        res = comm.select_split(hist, g, h, c, meta_scan, params,
-                                cmin, cmax, fm, rand_bins=rb)
+        res = select(hist, g, h, c, meta_scan, params,
+                     cmin, cmax, fm, rand_bins=rb)
         blocked = (max_depth > 0) & (depth >= max_depth)
         return res._replace(gain=jnp.where(blocked, -jnp.inf, res.gain))
     return scan_leaf

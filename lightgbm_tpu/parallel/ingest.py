@@ -1,0 +1,117 @@
+"""Sharded dataset ingest: the binned matrix goes host -> mesh shards
+directly, never through a replicated device copy.
+
+Reference analog: ``pre_partition`` + the per-machine data loading of
+``dataset_loader.cpp`` — each machine materializes only its own rows.
+The TPU-native failure mode this module exists to kill is different:
+a naive ``jnp.asarray(binned)`` stages the FULL matrix on the default
+device (host 0's first chip) before ``device_put`` re-shards it, so a
+100M-row binned matrix transits one HBM no matter how large the mesh
+is. Every mesh learner routes its row-sharded arrays through
+``shard_rows`` instead:
+
+* single process — ONE ``jax.device_put(host_array, row_sharding)``;
+  jax transfers each shard host->device individually, and no
+  replicated device buffer ever exists;
+* multi process — each host passes only its OWN row block
+  (``local=True``) and the global array is assembled from the
+  process-local shards (``jax.make_array_from_process_local_data``),
+  so no host ever holds — let alone transfers — rows it does not own.
+
+``host_row_range`` is the one definition of "which rows are mine" for
+per-host ingest, and the telemetry counters (``ingest.sharded_bytes``,
+``ingest.shards``) make the shard-local path auditable in any trace.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .partition_rules import AXIS, mesh_shards
+
+
+def host_row_range(num_rows: int, process_index: Optional[int] = None,
+                   process_count: Optional[int] = None
+                   ) -> Tuple[int, int]:
+    """[start, stop) of this host's row block for ``num_rows`` global
+    rows split evenly over the processes (remainder rows go to the
+    first ``num_rows % P`` hosts, matching the reference's
+    pre-partition convention of contiguous per-machine blocks)."""
+    p = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    base, rem = divmod(int(num_rows), n)
+    start = p * base + min(p, rem)
+    return start, start + base + (1 if p < rem else 0)
+
+
+def _count_ingest(nbytes: int, shards: int, local: bool) -> None:
+    from ..observability.telemetry import get_telemetry
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.count("ingest.sharded_bytes", float(nbytes))
+        tel.count("ingest.sharded_puts", 1)
+        tel.gauge("ingest.shards", shards)
+        tel.gauge("ingest.local_build", int(bool(local)))
+
+
+def shard_rows(arr, mesh: Mesh, *, axis: str = AXIS,
+               local: bool = False, global_rows: Optional[int] = None):
+    """Row-shard a HOST array over ``mesh`` without a replicated
+    device copy.
+
+    ``arr`` must be host-resident (numpy) with ``arr.shape[0]`` a
+    multiple of the mesh size (callers pad rows first — padding rows
+    carry zero gradient weight so they never affect training).
+
+    ``local=True`` declares ``arr`` to be THIS process's row block
+    only (``host_row_range`` order); ``global_rows`` then gives the
+    global row count (default: local rows x process_count, the
+    even-split case). Single-process runs ignore ``local``.
+    """
+    arr = np.asarray(arr)
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    _count_ingest(arr.nbytes, mesh_shards(mesh), local)
+    if local and jax.process_count() > 1:
+        n_global = int(global_rows) if global_rows is not None \
+            else arr.shape[0] * jax.process_count()
+        global_shape = (n_global,) + arr.shape[1:]
+        if hasattr(jax, "make_array_from_process_local_data"):
+            return jax.make_array_from_process_local_data(
+                sharding, arr, global_shape)
+        # older jax: assemble from per-device slices of the local block
+        dev_arrays = []
+        addressable = [d for d in mesh.devices.flat
+                       if d.process_index == jax.process_index()]
+        rows_per_dev = arr.shape[0] // max(len(addressable), 1)
+        for i, dev in enumerate(addressable):
+            lo = i * rows_per_dev
+            dev_arrays.append(jax.device_put(
+                arr[lo:lo + rows_per_dev], dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, dev_arrays)
+    # one call; jax transfers each shard host->device individually —
+    # the host-0 path never materializes a replicated device matrix
+    return jax.device_put(arr, sharding)
+
+
+def pad_rows(arr: np.ndarray, n_pad: int) -> np.ndarray:
+    """Host-side zero row padding to the mesh-divisible length (a
+    numpy pad, NOT jnp.pad — padding on device would stage the full
+    matrix through the default device first)."""
+    arr = np.asarray(arr)
+    n = arr.shape[0]
+    if n_pad == n:
+        return arr
+    return np.pad(arr, ((0, n_pad - n),) + ((0, 0),) * (arr.ndim - 1))
+
+
+def replicate(arr, mesh: Mesh):
+    """Replicated placement (feature-parallel's row matrix: the
+    algorithm requires every shard to hold all rows)."""
+    return jax.device_put(np.asarray(arr),
+                          NamedSharding(mesh, P()))

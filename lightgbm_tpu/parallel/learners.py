@@ -6,16 +6,26 @@ replaced wholesale by XLA collectives over the device mesh (ICI/DCN):
 
   reference                         TPU-native
   ---------                         ----------
-  ReduceScatter(histograms)         psum inside shard_map (data-parallel)
-  Allreduce(SplitInfo best)         all_gather + argmax (feature-parallel)
-  Allgather(top-k LightSplitInfo)   all_gather + scatter-max voting
+  ReduceScatter(histograms)         psum_scatter inside shard_map
+  Allreduce(SplitInfo best)         ONE packed all_gather + argmax
+  Allgather(top-k LightSplitInfo)   ONE packed all_gather + scatter-max
   Linkers socket/MPI mesh           jax.sharding.Mesh (jax.distributed
                                     for multi-host DCN)
 
-All three learners run the SAME jitted grow loop (learner/serial.py) —
-only the Comm hooks (learner/comm.py) and the input shardings differ.
-The driver-facing API matches SerialTreeLearner: train(grad, hess, ...)
--> GrowResult with a full-length leaf_id.
+All learners run the SAME jitted grow loops (learner/serial.py,
+learner/partitioned.py); each parallelism mode here is
+
+  * ONE spec table (``parallel/partition_rules.py:MODE_RULES``) naming
+    how every training array shards over the mesh, and
+  * ONE comm recipe (``learner/comm.py``) with a pinned collective
+    budget (graftcheck GC401, tools/graftcheck/contracts.json):
+    data {ar:1, rs:1, ag:1}, feature {ag:2}, voting {ag:2, ar:3}.
+
+Row-sharded arrays are placed through the sharded ingest layer
+(``parallel/ingest.py``) — host numpy -> per-shard transfers, never a
+replicated staging copy on the default device. The driver-facing API
+matches SerialTreeLearner: train(grad, hess, ...) -> GrowResult with a
+full-length leaf_id.
 """
 
 from __future__ import annotations
@@ -26,86 +36,57 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-
-def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
-    if hasattr(jax, "shard_map"):  # jax >= 0.8
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_rep)
-    from jax.experimental.shard_map import shard_map as _sm
-    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               check_rep=check_rep)
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import Config
-from ..utils.jit_registry import register_dynamic
 from ..data.dataset import Dataset
-from ..learner.comm import (make_data_parallel_comm,
+from ..learner.comm import (ShardScanCtx, make_data_parallel_comm,
                             make_feature_parallel_comm,
                             make_voting_parallel_comm)
 from ..learner.serial import (GrowResult, SerialTreeLearner, grow_tree,
                               split_params_from_config)
-from ..ops.split import FeatureMeta
+from ..utils.jit_registry import register_dynamic
+from . import ingest
+from .partition_rules import (AXIS, default_mesh, in_specs_for,
+                              local_feature_mask, mesh_from_config,
+                              mesh_shards, plan_feature_shards,
+                              shard_arrays, shard_map, spec_for,
+                              split_bynode_budget)
 
-AXIS = "data"  # single mesh axis; rows or features are sharded over it
-
-
-def default_mesh(num_devices: Optional[int] = None) -> Mesh:
-    devices = jax.devices()
-    if num_devices is not None:
-        if num_devices > len(devices):
-            from ..utils.log import log_warning
-            log_warning(
-                f"num_machines={num_devices} but only {len(devices)} "
-                "devices are visible; using all of them")
-            num_devices = len(devices)
-        devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (AXIS,))
-
-
-def mesh_from_config(config: Config) -> Mesh:
-    """Resolve the shard count the way the reference resolves
-    num_machines (config.h:866): an explicit num_machines > 1 or
-    n_devices > 0 caps the mesh; otherwise every visible device joins."""
-    if config.num_machines > 1:
-        return default_mesh(config.num_machines)
-    if config.n_devices > 0:
-        return default_mesh(config.n_devices)
-    return default_mesh()
+__all__ = [
+    "AXIS", "DataParallelTreeLearner", "FeatureParallelTreeLearner",
+    "MeshPartitionedTreeLearner", "VotingParallelTreeLearner",
+    "create_tree_learner", "default_mesh", "mesh_from_config",
+    "shard_map",
+]
 
 
 def _round_up(n: int, d: int) -> int:
     return (n + d - 1) // d * d
 
 
-def _pad_meta(meta: FeatureMeta, fpad: int, f: int) -> FeatureMeta:
-    """Pad a per-feature meta with never-splittable dummy features
-    (2 bins, no missing, masked off by the padded feature mask)."""
-    if not fpad:
-        return meta
-    return FeatureMeta(
-        num_bins=jnp.pad(meta.num_bins, (0, fpad), constant_values=2),
-        missing=jnp.pad(meta.missing, (0, fpad)),
-        default_bin=jnp.pad(meta.default_bin, (0, fpad)),
-        most_freq_bin=jnp.pad(meta.most_freq_bin, (0, fpad)),
-        monotone=jnp.pad(meta.monotone, (0, fpad)),
-        penalty=jnp.pad(meta.penalty, (0, fpad), constant_values=1.0),
-        is_categorical=jnp.pad(meta.is_categorical, (0, fpad)),
-        group=jnp.pad(meta.group, (0, fpad)),
-        offset=jnp.pad(meta.offset, (0, fpad)),
-        cegb_coupled_penalty=jnp.pad(meta.cegb_coupled_penalty, (0, fpad)),
-        cegb_lazy_penalty=jnp.pad(meta.cegb_lazy_penalty, (0, fpad)),
-        global_id=jnp.pad(meta.global_id, (0, fpad),
-                          constant_values=f))
+def _fold_shard_key(rkey, axis: str = AXIS):
+    """Shard-distinct RNG streams for column-sharded scans: fold the
+    mesh position into both key pairs (extra-trees / by-node)."""
+    idx = jax.lax.axis_index(axis)
+    return jax.vmap(jax.random.fold_in, in_axes=(0, None))(rkey, idx)
 
 
 class _MeshLearnerBase(SerialTreeLearner):
-    """Shared setup: mesh, padding, shard_map-wrapped grow program."""
+    """Shared setup: mesh, padding, shard_map-wrapped grow program.
+    Subclasses define ``_build()`` producing ``self._fn``; the array
+    placement and shard_map specs both come from the partition-rule
+    table of ``self._mode``."""
 
-    # data-parallel keeps a GLOBAL feature axis, so CEGB's feature-used
-    # state works unchanged; the feature-sharded learners scan local
-    # shards and drop it (learner/serial.py CegbStateMixin._drop_cegb)
+    # matrices are placed through the sharded ingest layer, never via
+    # a replicated jnp.asarray staging copy (learner/serial.py)
+    _stage_binned_on_device = False
+
+    # data-parallel keeps CEGB support through its replicated fallback
+    # recipe; the feature-sharded learners scan local shards and drop
+    # it (learner/serial.py CegbStateMixin._drop_cegb)
     _supports_cegb = False
+    _mode = "data"
 
     def __init__(self, dataset: Dataset, config: Config,
                  mesh: Optional[Mesh] = None, hist_method: str = "auto"):
@@ -113,7 +94,7 @@ class _MeshLearnerBase(SerialTreeLearner):
         if not self._supports_cegb:
             self._drop_cegb()
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
-        self.num_shards = int(np.prod(list(self.mesh.shape.values())))
+        self.num_shards = mesh_shards(self.mesh)
         self._build()
 
     def _cegb_arg(self):
@@ -128,18 +109,14 @@ class _MeshLearnerBase(SerialTreeLearner):
         dataset has none, so shard_map specs stay shape-stable)."""
         mv = self.dataset.mv_slots_device
         if mv is None:
-            mv = jnp.zeros((self.dataset.num_data, 1), jnp.int32)
-        if self._n_pad != self.dataset.num_data:
-            mv = jnp.pad(mv, ((0, self._n_pad - self.dataset.num_data),
-                              (0, 0)))
-        return jax.device_put(mv, NamedSharding(self.mesh, P(AXIS, None)))
+            mv = np.zeros((self.dataset.num_data, 1), np.int32)
+        mv = ingest.pad_rows(np.asarray(mv), self._n_pad)
+        return ingest.shard_rows(mv, self.mesh)
 
     @property
     def _mv_groups(self):
         return (self.dataset.num_groups
                 - self.dataset.num_dense_groups)
-
-    # subclasses define _build() producing self._fn and padding info
 
     def train(self, grad, hess, bag_weight=None, feature_mask=None
               ) -> GrowResult:
@@ -157,83 +134,130 @@ class _MeshLearnerBase(SerialTreeLearner):
         rkey = self.next_tree_key()
         if rkey is None:  # shard_map needs a concrete array either way
             rkey = jnp.zeros((2, 2), jnp.uint32)  # shape of a key pair
-        res = self._fn(grad, hess, bag_weight,
-                       self._pad_feature_mask(feature_mask), rkey,
+        res = self._fn(grad, hess, bag_weight, feature_mask, rkey,
                        self._cegb_arg())
         if pad:
             res = GrowResult(tree=res.tree, leaf_id=res.leaf_id[:n])
         self._cegb_after_tree(res)
         return res
 
-    def _pad_feature_mask(self, fmask):
-        return fmask
-
     def _drop_forced_plan(self, kind: str) -> None:
         """Forced splits read the leaf histogram cache, which is shard-
-        LOCAL in the voting/feature learners — sums would be wrong."""
+        LOCAL in the voting/feature learners and in the data learner's
+        reduce-scatter layout — sums would be wrong."""
         if self.forced_plan:
             from ..utils.log import log_warning
             log_warning(f"forcedsplits_filename is not supported by the "
                         f"{kind}-parallel learner; ignoring it")
             self.forced_plan = ()
 
+    def _out_specs(self):
+        return GrowResult(tree=P(), leaf_id=spec_for(self._mode,
+                                                     "leaf_id"))
+
 
 class DataParallelTreeLearner(_MeshLearnerBase):
-    """Rows sharded over the mesh; per-leaf histograms psum'ed; split
-    selection replicated (data_parallel_tree_learner.cpp semantics)."""
+    """Rows sharded over the mesh (data_parallel_tree_learner.cpp
+    semantics). Default recipe: per-split histograms reduce-scattered
+    over the permuted group axis, shard-local scan of the slice,
+    packed winner gather — {all-reduce: 1, reduce-scatter: 1,
+    all-gather: 1} per compiled tree. Configs that need a replicated
+    global-feature histogram (CEGB's candidate cache, forced splits)
+    fall back to the full-psum recipe with a replicated select."""
 
     _supports_cegb = True
+    _mode = "data"
 
     def _build(self):
         self._drop_cegb_lazy("row-sharded learners would need a "
                              "sharded charged-state matrix")
         d = self.num_shards
         n = self.dataset.num_data
+        f = self.dataset.num_features
         self._n_pad = _round_up(n, d)
-        binned = self.binned
-        if self._n_pad != n:
-            binned = jnp.pad(binned, ((0, self._n_pad - n), (0, 0)))
-        # shard once; drop the unsharded device copy (HBM)
-        self.binned = jax.device_put(
-            binned, NamedSharding(self.mesh, P(AXIS, None)))
-        comm = make_data_parallel_comm(AXIS)
+        # sharded ingest: host rows -> per-shard transfers, no
+        # replicated staging copy (parallel/ingest.py)
+        self.binned = ingest.shard_rows(
+            ingest.pad_rows(np.asarray(self.binned), self._n_pad),
+            self.mesh)
         meta = self.meta
         mv_groups = self._mv_groups
+        # reduce-scatter recipe unless the config's bookkeeping needs
+        # the replicated global-feature histogram
+        use_rs = not self.params.cegb_on and not self.forced_plan
+        self._use_rs = use_rs
+        if use_rs:
+            plan = plan_feature_shards(meta, f, self.dataset.num_groups,
+                                       d)
+            comm = make_data_parallel_comm(AXIS, plan=plan)
+            meta_l = shard_arrays(self.mesh, self._mode,
+                                  {"meta_local": plan.meta_local}
+                                  )["meta_local"]
+            bn_floor, bn_rem, bn_cap = split_bynode_budget(
+                self.bynode_count, d)
+        else:
+            comm = make_data_parallel_comm(AXIS)
 
-        def body(binned_l, mv_l, grad, hess, bag, fmask, rkey, cegb0):
-            # key replicated: every shard draws identical node randomness
-            # (the feature axis is global here), like the reference's
-            # identically-seeded per-machine samplers
-            return grow_tree(
-                binned_l, grad, hess, bag, fmask, meta=meta,
-                params=self.params, num_leaves=self.num_leaves,
-                max_depth=self.max_depth, num_bins_max=self.num_bins_max,
-                hist_method=self.hist_method, comm=comm,
-                bundled=self.bundled, rand_key=rkey,
-                extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
-                bynode_count=self.bynode_count,
-                forced_plan=self.forced_plan,  # hist cache is psum'ed
-                cache_hists=self.cache_hists,
-                cegb_used0=cegb0 if self.params.cegb_on else None,
-                mv_slots=mv_l, mv_groups=mv_groups,
-                has_monotone=self.has_monotone)
+        def mk_body(with_ctx):
+            def body(*args):
+                if with_ctx:
+                    (binned_l, mv_l, meta_loc, grad, hess, bag, fmask,
+                     rkey, cegb0) = args
+                    idx = jax.lax.axis_index(AXIS)
+                    ctx = ShardScanCtx(
+                        meta=meta_loc,
+                        fmask=local_feature_mask(meta_loc, fmask, f),
+                        rand_key=_fold_shard_key(rkey),
+                        bynode_count=(bn_floor
+                                      + (idx < bn_rem).astype(jnp.int32)),
+                        bynode_cap=bn_cap)
+                else:
+                    (binned_l, mv_l, grad, hess, bag, fmask, rkey,
+                     cegb0) = args
+                    ctx = None
+                # key replicated at the ROOT scan: every shard draws
+                # identical root randomness; per-split scans fold the
+                # shard index into their stream (ctx)
+                return grow_tree(
+                    binned_l, grad, hess, bag, fmask, meta=meta,
+                    params=self.params, num_leaves=self.num_leaves,
+                    max_depth=self.max_depth,
+                    num_bins_max=self.num_bins_max,
+                    hist_method=self.hist_method, comm=comm,
+                    bundled=self.bundled, rand_key=rkey,
+                    extra_trees=self.extra_trees,
+                    ff_bynode=self.ff_bynode,
+                    bynode_count=self.bynode_count,
+                    forced_plan=self.forced_plan,
+                    cache_hists=self.cache_hists,
+                    cegb_used0=cegb0 if self.params.cegb_on else None,
+                    mv_slots=mv_l, mv_groups=mv_groups,
+                    has_monotone=self.has_monotone, body_scan=ctx)
+            return body
 
+        names = {"binned": 2, "mv_slots": 2}
+        if use_rs:
+            names["meta_local"] = 1
+        names.update(grad=1, hess=1, bag_weight=1, feature_mask=1,
+                     rand_key=2, cegb_used=1)
         mapped = shard_map(
-            body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS),
-                      P(AXIS), P(), P(), P()),
-            out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
-            check_rep=False)
+            mk_body(use_rs), mesh=self.mesh,
+            in_specs=in_specs_for(self._mode, names),
+            out_specs=self._out_specs(), check_rep=False)
         sharded = register_dynamic("mesh_data_grow", jax.jit(mapped),
                                    collective=True)
-        self._fn = functools.partial(sharded, self.binned,
-                                     self._mv_sharded())
+        bound = (self.binned, self._mv_sharded()) \
+            + ((meta_l,) if use_rs else ())
+        self._fn = functools.partial(sharded, *bound)
 
 
 class FeatureParallelTreeLearner(_MeshLearnerBase):
-    """All rows on every device; features sharded for histogram build and
-    split search; winners exchanged by all_gather + argmax
+    """All rows on every device; features sharded for histogram build
+    and split search; winners exchanged by ONE packed all_gather per
+    scan — {all-gather: 2} per compiled tree
     (feature_parallel_tree_learner.cpp semantics)."""
+
+    _mode = "feature"
 
     def _build(self):
         if self.dataset.has_multival:
@@ -248,154 +272,68 @@ class FeatureParallelTreeLearner(_MeshLearnerBase):
         self._n_pad = n  # rows are replicated, no row padding
         f = self.dataset.num_features
         meta = self.meta
-        if self.bundled:
-            # EFB: shard whole bundle GROUPS (a bundle's features must
-            # stay together — its group histogram debundles locally).
-            # Groups are assigned largest-first to the least-loaded
-            # shard (by feature count) and the histogram matrix columns
-            # are permuted so each shard's groups are contiguous; the
-            # scan axis becomes a per-shard permuted/padded feature
-            # list. meta_h.group holds LOCAL group (column) indices and
-            # meta_h.global_id maps winners back to global feature ids
-            # (dataset.cpp:97-314 bundles; feature_parallel_tree_
-            # learner.cpp partitions raw columns — bundling there is
-            # disabled for distributed runs, ours keeps it).
-            groups = np.asarray(self.meta.group)           # [F] global
-            g_total = self.binned.shape[1]
-            feat_of_group = [np.where(groups == g)[0]
-                             for g in range(g_total)]
-            order = np.argsort([-len(fg) for fg in feat_of_group],
-                               kind="stable")
-            shard_groups: list = [[] for _ in range(d)]
-            load = [0] * d
-            for g in order:
-                s = min(range(d), key=lambda i: (load[i], i))
-                shard_groups[s].append(int(g))
-                load[s] += len(feat_of_group[int(g)])
-            g_local = max(1, max(len(sg) for sg in shard_groups))
-            self._f_local = max(1, max(load))
-            self._f_pad = d * self._f_local
-            # column permutation of the histogram matrix
-            col_perm = np.zeros(d * g_local, np.int64)
-            col_live = np.zeros(d * g_local, bool)
-            local_col_of_group = np.zeros(g_total, np.int32)
-            for s, sg in enumerate(shard_groups):
-                for j, g in enumerate(sg):
-                    col_perm[s * g_local + j] = g
-                    col_live[s * g_local + j] = True
-                    local_col_of_group[g] = j
-            # per-shard feature slots: ascending global id inside each
-            # shard (keeps serial's first-index tie-break within shard)
-            perm = np.full(self._f_pad, -1, np.int64)
-            for s, sg in enumerate(shard_groups):
-                fl = np.sort(np.concatenate(
-                    [feat_of_group[g] for g in sg]).astype(np.int64)) \
-                    if sg else np.zeros(0, np.int64)
-                perm[s * self._f_local:s * self._f_local + len(fl)] = fl
-            live = perm >= 0
-            safe = np.where(live, perm, 0)
-
-            def permute(arr, pad_value, dtype=None):
-                a = np.asarray(arr)
-                out = np.where(live, a[safe], pad_value)
-                return jnp.asarray(out if dtype is None
-                                   else out.astype(dtype))
-
-            meta_h = FeatureMeta(
-                num_bins=permute(meta.num_bins, 2),
-                missing=permute(meta.missing, 0),
-                default_bin=permute(meta.default_bin, 0),
-                most_freq_bin=permute(meta.most_freq_bin, 0),
-                monotone=permute(meta.monotone, 0),
-                penalty=permute(meta.penalty, 1.0, np.float32),
-                is_categorical=permute(meta.is_categorical, False),
-                # LOCAL column index inside the shard's histogram slice
-                group=jnp.asarray(np.where(
-                    live, local_col_of_group[groups[safe]],
-                    0).astype(np.int32)),
-                offset=permute(meta.offset, 0),
-                cegb_coupled_penalty=permute(
-                    meta.cegb_coupled_penalty, 0.0, np.float32),
-                cegb_lazy_penalty=permute(
-                    meta.cegb_lazy_penalty, 0.0, np.float32),
-                global_id=jnp.asarray(
-                    np.where(live, perm, f).astype(np.int32)))
-            self._fmask_perm = (jnp.asarray(live),
-                                jnp.asarray(safe.astype(np.int32)))
-            binned_hist = jnp.where(
-                jnp.asarray(col_live)[None, :],
-                jnp.take(self.binned,
-                         jnp.asarray(np.where(col_live, col_perm, 0)),
-                         axis=1),
-                jnp.zeros((), self.binned.dtype))
-        else:
-            self._f_pad = _round_up(f, d)
-            self._f_local = self._f_pad // d
-            self._fmask_perm = None
-            meta_h = _pad_meta(meta, self._f_pad - f, f)
-            binned_hist = self.binned
-            if self._f_pad != f:
-                binned_hist = jnp.pad(binned_hist,
-                                      ((0, 0), (0, self._f_pad - f)))
+        # ONE balanced group->shard plan for the column-sharded scan
+        # axis (EFB bundles shard as whole groups; unbundled features
+        # are singleton groups) — partition_rules.plan_feature_shards
+        plan = plan_feature_shards(meta, f, self.dataset.num_groups, d)
+        self._f_local, self._f_pad = plan.f_local, plan.f_pad
+        binned_np = np.asarray(self.binned)
         comm = make_feature_parallel_comm(AXIS)
+        bn_floor, bn_rem, bn_cap = split_bynode_budget(
+            self.bynode_count, d)
 
-        # the scan axis is the LOCAL feature shard: each shard draws its
-        # own stream (fold in the shard index) over its exact slice of
-        # the global by-node budget — floor(count/d) per shard plus one
-        # for the first count%d shards, so the total matches the config
-        bn_floor, bn_rem = divmod(self.bynode_count, d)
-        bn_cap = bn_floor + (1 if bn_rem else 0)
-
-        def body(binned_g, binned_h, meta_hist, grad, hess, bag, fmask,
+        def body(binned_g, binned_h, meta_h, grad, hess, bag, fmask,
                  rkey, cegb0):
             del cegb0          # CEGB dropped for feature-sharded scans
             idx = jax.lax.axis_index(AXIS)
-            rkey = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                rkey, idx)
-            bn_local = bn_floor + (idx < bn_rem).astype(jnp.int32)
+            # the scan axis is the LOCAL feature shard: each shard
+            # draws its own stream over its exact slice of the global
+            # by-node budget, and reads its slice of the feature mask
+            # through the permuted meta's global ids
             return grow_tree(
-                binned_g, grad, hess, bag, fmask, meta=meta,
+                binned_g, grad, hess, bag,
+                local_feature_mask(meta_h, fmask, f), meta=meta,
                 params=self.params, num_leaves=self.num_leaves,
                 max_depth=self.max_depth, num_bins_max=self.num_bins_max,
                 hist_method=self.hist_method, comm=comm,
-                binned_hist=binned_h, meta_hist=meta_hist, rand_key=rkey,
+                binned_hist=binned_h, meta_hist=meta_h,
+                rand_key=_fold_shard_key(rkey),
                 bundled=self.bundled,
                 extra_trees=self.extra_trees, ff_bynode=self.ff_bynode,
-                bynode_count=bn_local, bynode_cap=bn_cap,
+                bynode_count=(bn_floor
+                              + (idx < bn_rem).astype(jnp.int32)),
+                bynode_cap=bn_cap,
                 cache_hists=self.cache_hists,
                 has_monotone=self.has_monotone)
 
+        names = dict(binned=2, binned_hist=2, meta_local=1, grad=1,
+                     hess=1, bag_weight=1, feature_mask=1, rand_key=2,
+                     cegb_used=1)
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(), P(None, AXIS), P(AXIS), P(), P(), P(), P(AXIS),
-                      P(), P()),
-            out_specs=GrowResult(tree=P(), leaf_id=P()),
-            check_rep=False)
+            in_specs=in_specs_for(self._mode, names),
+            out_specs=self._out_specs(), check_rep=False)
         sharded = register_dynamic("mesh_feature_grow",
                                    jax.jit(mapped), collective=True)
-        # place once with the mesh shardings (replicated rows for the
-        # partition path, feature-sharded copy for histogram build)
-        self.binned = jax.device_put(
-            self.binned, NamedSharding(self.mesh, P()))
-        binned_hist = jax.device_put(
-            binned_hist, NamedSharding(self.mesh, P(None, AXIS)))
-        meta_h = jax.device_put(meta_h, NamedSharding(self.mesh, P(AXIS)))
-        self._fn = functools.partial(sharded, self.binned, binned_hist,
-                                     meta_h)
-
-    def _pad_feature_mask(self, fmask):
-        if self._fmask_perm is not None:  # bundled: permuted scan axis
-            live, safe = self._fmask_perm
-            return jnp.where(live, fmask[safe], False)
-        fpad = self._f_pad - self.dataset.num_features
-        if fpad:
-            fmask = jnp.pad(fmask, (0, fpad))  # padded features masked off
-        return fmask
+        # place once with the mode's rule table (replicated rows for
+        # the partition path, column-sharded permuted copy + permuted
+        # meta for the histogram build/scan)
+        placed = shard_arrays(self.mesh, self._mode, {
+            "binned": binned_np,
+            "binned_hist": plan.permute_binned(binned_np),
+            "meta_local": plan.meta_local})
+        self.binned = placed["binned"]
+        self._fn = functools.partial(sharded, self.binned,
+                                     placed["binned_hist"],
+                                     placed["meta_local"])
 
 
 class VotingParallelTreeLearner(_MeshLearnerBase):
     """PV-Tree voting-parallel (voting_parallel_tree_learner.cpp): rows
-    sharded; only top-k candidate features' histograms are aggregated."""
+    sharded; only top-k candidate features' histograms are aggregated —
+    {all-gather: 2, all-reduce: 3} per compiled tree."""
+
+    _mode = "voting"
 
     def _build(self):
         # EFB-bundled input is fine: each shard debundles its LOCAL
@@ -405,11 +343,9 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
         d = self.num_shards
         n = self.dataset.num_data
         self._n_pad = _round_up(n, d)
-        binned = self.binned
-        if self._n_pad != n:
-            binned = jnp.pad(binned, ((0, self._n_pad - n), (0, 0)))
-        self.binned = jax.device_put(
-            binned, NamedSharding(self.mesh, P(AXIS, None)))
+        self.binned = ingest.shard_rows(
+            ingest.pad_rows(np.asarray(self.binned), self._n_pad),
+            self.mesh)
         # local constraints relaxed by the machine count
         # (voting_parallel_tree_learner.cpp:57-59)
         params_local = self.params._replace(
@@ -435,12 +371,13 @@ class VotingParallelTreeLearner(_MeshLearnerBase):
                 mv_slots=mv_l, mv_groups=mv_groups,
                 has_monotone=self.has_monotone)
 
+        names = dict(binned=2, mv_slots=2, grad=1, hess=1,
+                     bag_weight=1, feature_mask=1, rand_key=2,
+                     cegb_used=1)
         mapped = shard_map(
             body, mesh=self.mesh,
-            in_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS),
-                      P(AXIS), P(), P(), P()),
-            out_specs=GrowResult(tree=P(), leaf_id=P(AXIS)),
-            check_rep=False)
+            in_specs=in_specs_for(self._mode, names),
+            out_specs=self._out_specs(), check_rep=False)
         sharded = register_dynamic("mesh_voting_grow",
                                    jax.jit(mapped), collective=True)
         self._fn = functools.partial(sharded, self.binned,
@@ -457,10 +394,11 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
     """Data- or voting-parallel learner on the SEGMENT KERNELS: each
     shard keeps its row block physically partitioned by leaf (one
     training matrix per device) and runs the partitioned grow loop
-    (learner/partitioned.py) with the parallel Comm hooks injected —
-    Pallas histogram/partition per shard, psum / voting collectives
-    across the mesh. This is the multi-chip TPU production path; the
-    einsum-based learners above remain the wide-bin / CPU fallbacks.
+    (learner/partitioned.py) with the parallel Comm recipes injected —
+    Pallas histogram/partition per shard, reduce-scatter / voting
+    collectives across the mesh. This is the multi-chip TPU production
+    path; the einsum-based learners above remain the wide-bin / CPU
+    fallbacks.
 
     Reference analog: data_parallel_tree_learner.cpp (mode="data") and
     voting_parallel_tree_learner.cpp (mode="voting") layered over the
@@ -470,18 +408,17 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
     def __init__(self, dataset: Dataset, config: Config,
                  mesh: Optional[Mesh] = None, mode: str = "data",
                  interpret: Optional[bool] = None):
-        from ..learner.comm import (make_data_parallel_comm,
-                                    make_voting_parallel_comm)
         self._setup_partitioned(dataset, config, interpret)
         if mode == "voting":
             # voting's local pre-scan uses shard-local leaf counts; the
             # split penalty would be mis-scaled -> keep CEGB off there
             self._drop_cegb()
         self.mesh = mesh if mesh is not None else mesh_from_config(config)
-        d = self.num_shards = int(np.prod(list(self.mesh.shape.values())))
+        d = self.num_shards = mesh_shards(self.mesh)
         n = dataset.num_data
         self._n_pad = _round_up(n, d)
         self.n_local = self._n_pad // d
+        self._mode = f"partitioned-{mode}"
 
         if mode == "voting":
             if self.forced_plan:
@@ -495,8 +432,16 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                     self.params.min_sum_hessian_in_leaf / d))
             self.comm = make_voting_parallel_comm(
                 AXIS, d, int(config.top_k), params_local)
+            self._use_rs = False
         else:
-            self.comm = make_data_parallel_comm(AXIS)
+            # reduce-scatter recipe unless CEGB / forced splits need
+            # the replicated global-feature histogram (learner/comm.py)
+            self._use_rs = not self.params.cegb_on \
+                and not self.forced_plan
+            self._plan = plan_feature_shards(
+                self.meta, self.num_features, self.num_groups, d) \
+                if self._use_rs else None
+            self.comm = make_data_parallel_comm(AXIS, plan=self._plan)
         self.mode = mode
 
         # one training matrix per shard, rows carrying GLOBAL ids
@@ -514,20 +459,43 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
             for kk in range(4):
                 mats[s, :self.n_local, g0 + RID_OFF + kk] = \
                     ((rid >> np.uint32(8 * kk)) & 0xFF).astype(np.uint8)
-        # device_put straight from numpy: shards transfer host->device
-        # individually, never materializing the full matrix in one HBM
-        sh = NamedSharding(self.mesh, P(AXIS, None, None))
-        self.mat = jax.device_put(mats, sh)
-        self.ws = jax.device_put(np.zeros_like(mats), sh)
+        # sharded ingest: shards transfer host->device individually,
+        # never materializing the full matrix in one HBM
+        self.mat = ingest.shard_rows(mats, self.mesh)
+        self.ws = ingest.shard_rows(np.zeros_like(mats), self.mesh)
         self._build()
 
     def _build(self):
         n_local = self.n_local
         n_pad = self._n_pad
         comm = self.comm
+        use_rs = self._use_rs
+        f = self.num_features
+        if use_rs:
+            meta_l = shard_arrays(self.mesh, self._mode,
+                                  {"meta_local": self._plan.meta_local}
+                                  )["meta_local"]
+            bn_floor, bn_rem, bn_cap = split_bynode_budget(
+                self.bynode_count, self.num_shards)
+            self._grow_extra = (meta_l,)
+        else:
+            self._grow_extra = ()
 
-        def grow_shard(mat3, ws3, grad, hess, bag, fmask, rkey, cegb0,
-                       leaf_parts):
+        def grow_shard(*args, leaf_parts):
+            if use_rs:
+                (mat3, ws3, meta_loc, grad, hess, bag, fmask, rkey,
+                 cegb0) = args
+                idx = jax.lax.axis_index(AXIS)
+                ctx = ShardScanCtx(
+                    meta=meta_loc,
+                    fmask=local_feature_mask(meta_loc, fmask, f),
+                    rand_key=_fold_shard_key(rkey),
+                    bynode_count=(bn_floor
+                                  + (idx < bn_rem).astype(jnp.int32)),
+                    bynode_cap=bn_cap)
+            else:
+                mat3, ws3, grad, hess, bag, fmask, rkey, cegb0 = args
+                ctx = None
             base = jax.lax.axis_index(AXIS) * n_local
             out = grow_partitioned(
                 mat3[0], ws3[0], grad, hess, bag, fmask, self.meta,
@@ -544,7 +512,7 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
                 cache_hists=self.cache_hists,
                 cegb_used0=cegb0 if self.params.cegb_on else None,
                 has_monotone=self.has_monotone,
-                return_leaf_parts=leaf_parts)
+                return_leaf_parts=leaf_parts, body_scan=ctx)
             if leaf_parts:
                 mat_l, ws_l, tree, (rid_l, pos_leaf) = out
                 # GLOBAL ids: unique across shards; the caller's
@@ -555,14 +523,22 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
             mat_l, ws_l, tree, leaf_id = out
             return mat_l[None], ws_l[None], tree, leaf_id
 
+        names = {"mat": 3, "ws": 3}
+        if use_rs:
+            names["meta_local"] = 1
+        names.update(grad=1, hess=1, bag_weight=1, feature_mask=1,
+                     rand_key=2, cegb_used=1)
+
         def mk_mapped(leaf_parts):
-            out_tail = (P(AXIS), P(AXIS)) if leaf_parts else (P(AXIS),)
+            lid_spec = spec_for(self._mode, "leaf_id")
+            out_tail = (lid_spec, lid_spec) if leaf_parts \
+                else (lid_spec,)
             return shard_map(
                 functools.partial(grow_shard, leaf_parts=leaf_parts),
                 mesh=self.mesh,
-                in_specs=(P(AXIS, None, None), P(AXIS, None, None),
-                          P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
-                out_specs=(P(AXIS, None, None), P(AXIS, None, None),
+                in_specs=in_specs_for(self._mode, names),
+                out_specs=(spec_for(self._mode, "mat", 3),
+                           spec_for(self._mode, "ws", 3),
                            TreeArrays_spec()) + out_tail,
                 check_rep=False)
 
@@ -592,8 +568,8 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
             if getattr(self, "_cegb_used", None) is not None \
             else jnp.zeros((self.num_features,), bool)
         self.mat, self.ws, tree, leaf_id = self._fn(
-            self.mat, self.ws, grad, hess, bag_weight, feature_mask,
-            rkey, cegb0)
+            self.mat, self.ws, *self._grow_extra, grad, hess,
+            bag_weight, feature_mask, rkey, cegb0)
         res = GrowResult(tree=tree, leaf_id=leaf_id[:n])
         self._cegb_after_tree(res)
         return res
@@ -623,8 +599,10 @@ class MeshPartitionedTreeLearner(PartitionedLearnerBase):
         rkey = jnp.zeros((2, 2), jnp.uint32)
         cegb0 = jnp.zeros((self.num_features,), bool)
         mat, ws, tree, rids, pos_leaf = self._mapped_parts(
-            mat, ws, grad, hess, bag, fmask, rkey, cegb0)
+            mat, ws, *self._grow_extra, grad, hess, bag, fmask, rkey,
+            cegb0)
         return mat, ws, tree, (rids, pos_leaf)
+
 
 def TreeArrays_spec():
     """Replicated out_spec for every TreeArrays field."""

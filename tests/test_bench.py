@@ -157,6 +157,44 @@ def test_bench_dispatch_census_line():
 
 
 @pytest.mark.slow
+def test_bench_mesh_scaling_child():
+    """The mesh-scaling child (ISSUE 14): one JSON line with the
+    1->N-device time/split curve for every mesh learner mode, on the
+    virtual CPU mesh."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(_BENCH_CHILD_MESH="1", JAX_PLATFORMS="cpu",
+               BENCH_MESH_ROWS="2048", BENCH_MESH_FEATURES="6",
+               BENCH_MESH_LEAVES="7", BENCH_MESH_TREES="1")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + " --xla_force_host_platform_device_count=8").strip()
+    if "xla_cpu_max_isa" not in flags:
+        flags = (flags + " --xla_cpu_max_isa=AVX2").strip()
+    env["XLA_FLAGS"] = flags
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], env=env,
+        capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    sys.path.insert(0, REPO)
+    from bench import find_result_line
+    line = find_result_line(proc.stdout)
+    assert line is not None, proc.stdout[-2000:]
+    assert line["metric"] == "mesh_scaling"
+    assert line["value"] and line["value"] > 0
+    ms = line["mesh_scaling"]
+    assert ms["devices"] == [1, 2, 4, 8]
+    # every mode produced a full curve with no recorded errors
+    assert sorted(ms["modes"]) == ["data", "feature", "partitioned",
+                                   "voting"], ms.get("errors")
+    assert "errors" not in ms, ms["errors"]
+    for mode, curve in ms["modes"].items():
+        assert set(curve) == {"1", "2", "4", "8"}, (mode, curve)
+        assert all(v > 0 for v in curve.values())
+    assert set(ms["speedup"]) == set(ms["modes"])
+
+
 def test_bench_linear_convergence_child():
     """The linear_tree=true bench block (ISSUE 6): the convergence
     child prints a JSON line with the iteration ratio that the parent

@@ -245,30 +245,46 @@ def test_distributed_sparse_bins_match_pooled_bins(monkeypatch):
 
 # ---------------------------------------------------------------------
 # Real multi-process coverage (VERDICT r5 weak #3): everything above
-# fakes the collectives; this spawns two actual processes.
+# fakes the collectives; this spawns two actual processes and — per
+# ISSUE 14 — covers every unified-spec-layer mode (data / voting /
+# feature), with the trained model additionally bit-equal to a
+# SINGLE-process run over a 2-virtual-device mesh (rank = -1): same
+# partition rules, same comm recipe, gloo DCN vs in-process ICI.
 
 _CHILD_SRC = """
 import os, sys, hashlib
-rank, port = int(sys.argv[1]), int(sys.argv[2])
+rank, port, mode = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["LIGHTGBM_TPU_RANK"] = str(rank)
+solo = rank < 0
+if solo:
+    # single-process reference: one process, 2 virtual devices
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+else:
+    os.environ["LIGHTGBM_TPU_RANK"] = str(rank)
 import numpy as np
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.parallel import distributed as dist
 
-cfg = Config.from_params({
-    "objective": "regression", "num_leaves": 7, "tree_learner": "data",
-    "num_machines": 2,
-    "machines": "127.0.0.1:%d,127.0.0.1:%d" % (port, port + 1),
-    "verbosity": -1, "metric": ""})
-assert dist.init_distributed(cfg) is True
+params = {
+    "objective": "regression", "num_leaves": 7, "tree_learner": mode,
+    "num_machines": 2, "verbosity": -1, "metric": ""}
+if not solo:
+    params["machines"] = "127.0.0.1:%d,127.0.0.1:%d" % (port, port + 1)
+cfg = Config.from_params(params)
+if solo:
+    assert dist.init_distributed(cfg) is False
+else:
+    assert dist.init_distributed(cfg) is True
 import jax
-assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 2, jax.device_count()
+if not solo:
+    assert jax.process_count() == 2, jax.process_count()
 
-# the data-parallel learner shards rows of the (replicated) matrix
-# over the 2-process mesh; histograms cross the process boundary via
-# psum, so identical trees on both ranks prove the collectives ran
+# row/feature/voting sharding over the 2-device mesh; histograms and
+# packed winner buffers cross the process boundary via the comm
+# recipe's collectives, so identical trees on both ranks (and vs the
+# single-process mesh) prove the spec layer end to end
 rng = np.random.RandomState(0)
 X = rng.randn(400, 5).astype(np.float32)
 y = (X[:, 0] + 0.5 * X[:, 1]).astype(np.float32)
@@ -303,11 +319,15 @@ def _free_port_pair() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_data_parallel_training(tmp_path):
-    """Two REAL processes: jax.distributed.initialize on localhost,
-    gloo CPU collectives, one tiny data-parallel model — both ranks
-    must build bit-identical trees (the psum'ed histograms and the
-    replicated split choice are the whole correctness story)."""
+@pytest.mark.parametrize("mode", ["data", "voting", "feature"])
+def test_two_process_parallel_training(tmp_path, mode):
+    """Two REAL processes per mode: jax.distributed.initialize on
+    localhost, gloo CPU collectives, one tiny parallel model — both
+    ranks must build bit-identical trees, and the model must ALSO be
+    bit-equal to a single-process run over a 2-virtual-device mesh
+    (the unified spec layer + comm recipe are process-topology-blind:
+    the reduce-scatter/packed-gather traffic crosses gloo DCN in one
+    case and stays in-process in the other)."""
     child = tmp_path / "dist_child.py"
     child.write_text(_CHILD_SRC)
     env = dict(os.environ)
@@ -329,7 +349,7 @@ def test_two_process_data_parallel_training(tmp_path):
     for _attempt in range(2):  # one retry for a port race
         port = _free_port_pair()
         procs = [subprocess.Popen(
-            [sys.executable, str(child), str(rank), str(port)],
+            [sys.executable, str(child), str(rank), str(port), mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True) for rank in range(2)]
         outs = []
@@ -362,6 +382,16 @@ def test_two_process_data_parallel_training(tmp_path):
     assert set(digests) == {0, 1}
     assert digests[0] == digests[1], digests
     assert digests[0][1] == 2  # both iterations produced real trees
+    # single-process reference over the same 2-shard mesh (rank -1)
+    solo = subprocess.run(
+        [sys.executable, str(child), "-1", "0", mode],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert solo.returncode == 0, solo.stderr[-2000:]
+    line = [ln for ln in solo.stdout.splitlines()
+            if ln.startswith("DIGEST")][-1]
+    _tag, _rank, digest, ntrees, pred = line.split()
+    assert (digest, int(ntrees), float(pred)) == digests[0], \
+        (line, digests)
 
 
 def test_sync_bin_find_seed(monkeypatch):

@@ -510,6 +510,42 @@ def test_bench_trend_dispatch_census_series(tmp_path):
     assert bt.main([a, b, "--quiet"]) == 0
 
 
+def test_bench_trend_mesh_scaling_synthetic_regression(tmp_path):
+    """The ISSUE-14 mesh_scaling series: total ms/split across the
+    mesh learner modes at max devices chains per (backend, shape id)
+    — a >20% slowdown fails the gate, an improvement passes, a config
+    bump breaks the chain deliberately."""
+    bt = _load_tool("bench_trend")
+    a, b = str(tmp_path / "BENCH_r06.json"), \
+        str(tmp_path / "BENCH_r07.json")
+    mesh = {"metric": "mesh_scaling", "value": 8.0,
+            "unit": "ms/split (sum over modes, max devices)",
+            "backend": "cpu",
+            "baseline_config": "mesh-scaling-v1-8192r-16f-15l",
+            "mesh_scaling": {
+                "devices": [1, 2, 4, 8],
+                "modes": {"data": {"1": 4.0, "8": 2.0},
+                          "voting": {"1": 5.0, "8": 2.5}},
+                "speedup": {"data": 2.0, "voting": 2.0}}}
+    _mk_round(a, 6, [mesh, _FIXED, _HEAD])
+    _mk_round(b, 7, [dict(mesh, value=10.4), _FIXED, _HEAD])  # +30%
+    rep = str(tmp_path / "rep.json")
+    assert bt.main([a, b, "--report", rep, "--quiet"]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    assert any(r["series"] == "mesh_scaling_ms"
+               for r in report["regressions"])
+    assert report["gated_points"]["mesh_scaling_ms"] == 2
+    # faster never regresses
+    _mk_round(b, 7, [dict(mesh, value=6.0), _FIXED, _HEAD])
+    assert bt.main([a, b, "--quiet"]) == 0
+    # shape-id bump breaks the chain (no bogus regression)
+    _mk_round(b, 7, [dict(mesh, value=99.0,
+                          baseline_config="mesh-scaling-v2"),
+                     _FIXED, _HEAD])
+    assert bt.main([a, b, "--quiet"]) == 0
+
+
 def test_bench_trend_fleet_p99_synthetic_regression(tmp_path):
     """The fleet soak p99 chains per (backend, replicas, models,
     buckets, batch_sizes, qps): a >20% worsening fails the gate, a

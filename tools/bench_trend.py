@@ -211,6 +211,30 @@ def _dispatch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     return found
 
 
+def _mesh_scaling_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's mesh-scaling number (bench.py
+    run_mesh_scaling_block): total ms/split across the mesh learner
+    modes at the max device count, keyed by (backend, shape id) —
+    lower is better. The per-mode curves and scaling efficiencies
+    ride along for the report."""
+    found = None
+    for ln in lines:
+        ms = ln.get("mesh_scaling")
+        if ln.get("metric") != "mesh_scaling" \
+                or not isinstance(ms, dict) \
+                or ln.get("value") is None:
+            continue
+        key = json.dumps({
+            "backend": ln.get("backend"),
+            "config": ln.get("baseline_config"),
+        }, sort_keys=True)
+        found = {"value": float(ln["value"]), "key": key,
+                 "devices": ms.get("devices"),
+                 "modes": ms.get("modes"),
+                 "speedup": ms.get("speedup")}
+    return found
+
+
 def _fused_split_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     """The round's fused split-step megakernel per-split wall time
     (bench.py run_fused_split_block), keyed by (backend, shape id) so
@@ -281,7 +305,7 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
 def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
     fixed, serving, headline, dispatch, fleet = [], [], [], [], []
-    fused = []
+    fused, mesh = [], []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -301,6 +325,9 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _fused_split_point(rnd["lines"])
         if p is not None:
             fused.append((rnd["label"], p))
+        p = _mesh_scaling_point(rnd["lines"])
+        if p is not None:
+            mesh.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
@@ -308,6 +335,7 @@ def analyze(rounds: List[Dict[str, Any]],
     regressions += _gate(dispatch, False, threshold, DISPATCH_METRIC)
     regressions += _gate(fleet, False, threshold, "fleet_p99_ms")
     regressions += _gate(fused, False, threshold, "fused_split_ms")
+    regressions += _gate(mesh, False, threshold, "mesh_scaling_ms")
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -327,6 +355,8 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in fleet],
             "fused_split_ms": [
                 {"round": lb, **pt} for lb, pt in fused],
+            "mesh_scaling_ms": [
+                {"round": lb, **pt} for lb, pt in mesh],
             DISPATCH_METRIC: [
                 {"round": lb, **pt} for lb, pt in dispatch],
             # informational only — config drifts across rounds
@@ -337,6 +367,7 @@ def analyze(rounds: List[Dict[str, Any]],
                          "serving_p99_ms": len(serving),
                          "fleet_p99_ms": len(fleet),
                          "fused_split_ms": len(fused),
+                         "mesh_scaling_ms": len(mesh),
                          DISPATCH_METRIC: len(dispatch)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",

@@ -556,8 +556,7 @@ def _mesh_einsum_lower(name: str, cls_name: str, env_key: str):
     grad = jnp.zeros((n_pad,), jnp.float32)
     hess = jnp.ones((n_pad,), jnp.float32)
     bag = jnp.ones((n_pad,), jnp.float32)
-    fmask = lrn._pad_feature_mask(
-        jnp.ones((lrn.dataset.num_features,), bool))
+    fmask = jnp.ones((lrn.dataset.num_features,), bool)
     rkey = jnp.zeros((2, 2), jnp.uint32)
     return pf.func.lower(*pf.args, grad, hess, bag, fmask, rkey,
                          lrn._cegb_arg())
@@ -601,7 +600,8 @@ def _b_mesh_partitioned_grow():
     rkey = jnp.zeros((2, 2), jnp.uint32)
     cegb0 = jnp.zeros((lrn.num_features,), bool)
     return _spec_fn("mesh_partitioned_grow").lower(
-        lrn.mat, lrn.ws, grad, hess, bag, fmask, rkey, cegb0)
+        lrn.mat, lrn.ws, *lrn._grow_extra, grad, hess, bag, fmask,
+        rkey, cegb0)
 
 
 # ---------------------------------------------------------------------

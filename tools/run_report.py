@@ -151,9 +151,24 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
                                    "faults."))}
+    # mesh collective traffic: the comm recipes' per-op byte/call
+    # counters (learner/comm.py _count_collective — trace-time bytes
+    # per compiled grow program) -> {op: {bytes, calls}}
+    comms: Dict[str, Dict[str, float]] = {}
+    for k, v in counters_all.items():
+        if not k.startswith("comm."):
+            continue
+        for suffix in ("_bytes", "_calls"):
+            if k.endswith(suffix):
+                op = k[len("comm."):-len(suffix)]
+                comms.setdefault(op, {})[suffix[1:]] = float(v)
+    ingest = {k.split(".", 1)[1]: v for k, v in counters_all.items()
+              if k.startswith("ingest.")}
 
     return {
         "robustness": robustness,
+        "comms": comms,
+        "ingest": ingest,
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
         "serving": serving,
@@ -257,12 +272,37 @@ def render(records: List[Dict[str, Any]]) -> str:
 
     interesting = {k: v for k, v in d["counters"].items()
                    if not k.startswith(("jit.", "guard.", "checkpoint.",
-                                        "retry.", "faults."))}
+                                        "retry.", "faults.", "comm.",
+                                        "ingest."))}
     if interesting:
         L.append("")
         L.append("== counters ==")
         for k, v in sorted(interesting.items()):
             L.append(f"{k:<32}{v:>16,.0f}")
+
+    if d.get("comms"):
+        # per-op collective traffic of the mesh comm recipes
+        # (trace-time payload bytes per compiled grow program; the
+        # GC401 contract pins the op multiset, this table shows the
+        # weight behind each op)
+        L.append("")
+        L.append("== mesh comms (collective payload per compiled "
+                 "program) ==")
+        L.append(f"{'op':<16}{'calls':>8}{'bytes':>16}"
+                 f"{'bytes/call':>14}")
+        for op, row in sorted(d["comms"].items(),
+                              key=lambda kv: -kv[1].get("bytes", 0)):
+            calls = row.get("calls", 0)
+            nbytes = row.get("bytes", 0)
+            per = nbytes / calls if calls else 0.0
+            L.append(f"{op:<16}{calls:>8,.0f}{nbytes:>16,.0f}"
+                     f"{per:>14,.0f}")
+        if d.get("ingest"):
+            ing = d["ingest"]
+            L.append(
+                "ingest: "
+                + " ".join(f"{k}={v:,.0f}"
+                           for k, v in sorted(ing.items())))
 
     if d.get("robustness"):
         r = d["robustness"]
