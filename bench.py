@@ -267,8 +267,69 @@ def measure():
             fl.stop()
         except Exception as e:  # noqa: BLE001
             result["fleet_error"] = str(e)[:200]
+    if os.environ.get("BENCH_FLEET_ISOLATION", "1") != "0":
+        # process- vs thread-mode serving cost (serving/procfleet.py):
+        # same pool shape and host route in both modes, so the delta
+        # IS the isolation bill (socket + JSON framing + supervisor),
+        # plus the restart-to-ready latency of a killed worker. The
+        # process p99 chains as the gated fleet_isolation_p99_ms
+        # bench_trend series. Failures recorded, never fatal.
+        try:
+            result["fleet_isolation"] = measure_fleet_isolation(
+                booster, X[:2048])
+        except Exception as e:  # noqa: BLE001
+            result["fleet_isolation_error"] = str(e)[:200]
     tel.flush()
     print(json.dumps(result))
+
+
+def measure_fleet_isolation(booster, X):
+    """Thread vs process fleet p99 + restart-to-ready (item 4b)."""
+    import os
+    import signal
+    import time as _time
+
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      ServingConfig)
+    from lightgbm_tpu.serving.loadgen import soak_loop
+    dur = float(os.environ.get("BENCH_FLEET_ISO_S", 2))
+    qps = float(os.environ.get("BENCH_FLEET_ISO_QPS", 120))
+    cfg = ServingConfig(buckets=(1, 64), device="never",
+                        flush_interval_ms=1.0)
+    out = {"duration_s": dur, "offered_qps": qps,
+           "replicas": 2, "buckets": [1, 64]}
+    for mode in ("thread", "process"):
+        fl = FleetEngine(models={"base": booster}, config=cfg,
+                         replicas=2, default_model="base",
+                         isolation=mode,
+                         proc_opts=ProcFleetOptions(restart_max=3))
+        try:
+            blk = soak_loop(fl, X, duration_s=dur, qps=qps,
+                            batch_sizes=(1, 8), models=["base"],
+                            timeout_ms=20000)
+            out[f"{mode}_p50_ms"] = blk["p50_ms"]
+            out[f"{mode}_p99_ms"] = blk["p99_ms"]
+            out[f"{mode}_throughput_rps"] = blk["throughput_rps"]
+            out[f"{mode}_availability"] = blk["availability"]
+            if mode == "process":
+                # restart-to-ready: SIGKILL one worker, wait for the
+                # supervisor to respawn it warm
+                victim = fl.replicas[0]
+                os.kill(victim.pid, signal.SIGKILL)
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline \
+                        and victim.state != "ok":
+                    _time.sleep(0.05)
+                out["restart_ready_ms"] = victim.restart_ready_ms \
+                    if victim.state == "ok" else None
+                out["restart_state"] = victim.state
+        finally:
+            fl.stop()
+    if out.get("thread_p99_ms") and out.get("process_p99_ms"):
+        out["process_overhead_pct"] = round(
+            100.0 * (out["process_p99_ms"] / out["thread_p99_ms"]
+                     - 1.0), 1)
+    return out
 
 
 def measure_linear():

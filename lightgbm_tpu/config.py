@@ -145,6 +145,10 @@ _PARAM_ALIASES: Dict[str, str] = {
     "serving_canary": "serving_canary_model",
     "serving_shadow": "serving_shadow_model",
     "serving_quota_rate": "serving_quota_qps",
+    "isolation": "serving_isolation",
+    "replica_isolation": "serving_isolation",
+    "serving_replica_restart_max": "replica_restart_max",
+    "replica_restarts_max": "replica_restart_max",
     "checkpoint_path": "checkpoint_dir", "ckpt_dir": "checkpoint_dir",
     "pipeline_stages": "pipeline_canary_stages",
     "pipeline_window": "pipeline_window_rows",
@@ -406,6 +410,21 @@ class Config:
     serving_canary_model: str = ""
     serving_canary_weight: float = 0.0
     serving_shadow_model: str = ""
+    # ---- process isolation (serving/procfleet.py, docs/Serving.md
+    # "Process isolation"): serving_isolation=process runs every
+    # replica's ServingEngine in its own spawned OS process (own JAX
+    # runtime, own flight recorder) behind a length-prefixed local
+    # socket, so a device OOM / runtime abort / segfault kills ONE
+    # replica, never the pool. A dead worker's requests re-dispatch
+    # eagerly to survivors and the worker respawns with the bounded
+    # deterministic backoff from robustness/retry.py, capped by
+    # replica_restart_max; a flapping replica is quarantined (the
+    # fleet degrades, it never dies).
+    serving_isolation: str = "thread"  # thread | process
+    replica_restart_max: int = 3       # respawns before quarantine
+    replica_heartbeat_ms: float = 200.0
+    replica_heartbeat_timeout_ms: float = 3000.0
+    replica_spawn_timeout_s: float = 120.0
 
     # ---- pipeline task (lightgbm_tpu/pipeline/, docs/Pipeline.md) —
     # the continuous refit-and-promote loop: a log source (replay
@@ -636,6 +655,18 @@ class Config:
                 "serving_canary_weight must be in [0, 1]")
         if self.serving_quota_qps < 0 or self.serving_quota_burst < 0:
             raise ValueError("serving_quota_* must be >= 0")
+        if self.serving_isolation not in ("thread", "process"):
+            raise ValueError(
+                f"serving_isolation={self.serving_isolation!r} is not "
+                "thread|process")
+        if self.replica_restart_max < 0:
+            raise ValueError("replica_restart_max must be >= 0")
+        if self.replica_heartbeat_ms <= 0 \
+                or self.replica_heartbeat_timeout_ms <= 0 \
+                or self.replica_spawn_timeout_s <= 0:
+            raise ValueError("replica_heartbeat_ms, "
+                             "replica_heartbeat_timeout_ms and "
+                             "replica_spawn_timeout_s must be > 0")
         if self.serving_canary_weight > 0 \
                 and not self.serving_canary_model:
             log_warning("serving_canary_weight is set without "

@@ -184,18 +184,34 @@ def _jsonable(o):
 _ACTIVE: List[Optional[FlightRecorder]] = [None]
 
 
+def worker_dump_path(base: str, rid) -> str:
+    """The per-worker dump path derived from the supervisor's path:
+    ``<crash_dump>.worker<rid>.json``. A worker process writing to the
+    parent's path verbatim would RACE the supervisor's own dump (both
+    os.replace the same target); the suffix keeps every black box."""
+    if base.endswith(".json"):
+        base = base[:-len(".json")]
+    return f"{base}.worker{rid}.json"
+
+
 def resolve_dump_path(config=None) -> Optional[str]:
     env = os.environ.get("LGBM_TPU_CRASH_DUMP", "").strip()
-    if env:
-        return env
     explicit = (getattr(config, "crash_dump", "") or "").strip()
-    if explicit:
-        return explicit
-    tel_path = (getattr(config, "telemetry_out", "") or "").strip() \
-        or os.environ.get("LGBM_TPU_TELEMETRY", "").strip()
-    if tel_path:
-        return tel_path + ".crash.json"
-    return None
+    path = env or explicit
+    if not path:
+        tel_path = (getattr(config, "telemetry_out", "") or "").strip() \
+            or os.environ.get("LGBM_TPU_TELEMETRY", "").strip()
+        if tel_path:
+            path = tel_path + ".crash.json"
+    if not path:
+        return None
+    # a process-fleet worker (serving/worker.py exports its replica id)
+    # resolves its OWN dump file next to the parent's — never the
+    # parent's path itself
+    rid = os.environ.get("LGBM_TPU_WORKER_RID", "").strip()
+    if rid:
+        path = worker_dump_path(path, rid)
+    return path
 
 
 def arm_recorder(config=None, gbdt=None,

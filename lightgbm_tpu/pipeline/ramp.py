@@ -191,7 +191,13 @@ class RampController:
         when a rollback recovers)."""
         from ..observability.flightrec import active_recorder
         rec = active_recorder()
-        n = len(rec.trips) if rec is not None else 0
+        # worker deaths are excluded: a process-fleet worker dying is
+        # already the availability/health signal, and the supervisor
+        # heals it — counting its dump as a "trip" would make every
+        # chaos-window ramp roll back a healthy candidate
+        n = len([t for t in rec.trips
+                 if t.get("kind") not in ("worker_death",)]) \
+            if rec is not None else 0
         tel = get_telemetry()
         n += int(sum(v for k, v in tel.counters.items()
                      if k.startswith("guard.")))
@@ -315,8 +321,18 @@ class RampController:
 
         m.flightrec_trips = self._trips_fn() - trips0
         h = self.fleet.health()
-        m.health_status = "ok" if h.get("status") in ("ok",) \
-            else str(h.get("status"))
+        status = str(h.get("status"))
+        if status == "degraded" and h.get("last_reload_error") is None \
+                and h.get("isolation") == "process" \
+                and not h.get("replicas_quarantined"):
+            # a worker died and the supervisor is respawning it: the
+            # process fleet SELF-HEALS, requests re-dispatched to
+            # survivors (availability holds) — not candidate-
+            # correlated regression, so the ramp proceeds. Quarantine
+            # (respawn exhausted) stays a hard abort.
+            get_telemetry().count("pipeline.ramp_through_respawn")
+            status = "ok"
+        m.health_status = status
         m.last_reload_error = h.get("last_reload_error")
         return m
 

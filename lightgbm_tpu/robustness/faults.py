@@ -32,6 +32,17 @@ Supported kinds and their args:
   flip with probability ``P``; ``once=1`` poisons only window ``K``
   (``lightgbm_tpu/pipeline/logsource.py`` — the continuous-refit
   drill's deterministic drift injection).
+* ``crash_replica@rid=K[,signal=9]`` — the process-fleet supervisor
+  (``serving/procfleet.py``) arms replica ``K``'s worker process to
+  kill itself with ``signal`` (default SIGKILL): the hard-death
+  drill — the supervisor must re-dispatch its requests and respawn
+  it within the backoff budget.
+* ``hang_replica@rid=K,ms=V`` — replica ``K``'s worker stops
+  answering (its receive loop sleeps ``V`` ms): heartbeats go stale
+  and the supervisor must declare ``heartbeat_lost`` and recover.
+* ``oom_replica@rid=K`` — replica ``K``'s worker exits with the
+  OOM-kill status (137), simulating the kernel/device OOM reaper;
+  classified ``oom_killed`` by the supervisor.
 
 Every event fires a bounded number of times (``times``, default 1 —
 ``nth``-style events always once) and is *consumed*: reruns inside the
@@ -51,7 +62,7 @@ from typing import Any, Dict, List, Optional
 from ..utils.log import log_warning
 
 _KNOWN_KINDS = ("nan_grad", "sigterm", "torn_checkpoint", "fail_read",
-                "drift")
+                "drift", "crash_replica", "hang_replica", "oom_replica")
 
 
 class Fault:
@@ -77,6 +88,9 @@ class Fault:
                 return False
         if "window" in self.params:
             if int(ctx.get("window", -1)) != int(self.params["window"]):
+                return False
+        if "rid" in self.params:
+            if int(ctx.get("rid", -1)) != int(self.params["rid"]):
                 return False
         match = str(self.params.get("match", ""))
         if match and match not in str(ctx.get("path", "")):

@@ -17,11 +17,33 @@ from __future__ import annotations
 
 import signal
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..utils.log import log_info, log_warning
 
 _SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+# cleanups that MUST run even on the forced (second-signal) path —
+# e.g. the process-fleet supervisor's child reaper
+# (serving/procfleet.py): escalation may kill this process outright,
+# and orphaned worker processes would outlive it. Callables must be
+# signal-safe and never raise.
+_ESCALATION_CLEANUPS: List[Callable[[], None]] = []
+
+
+def register_escalation_cleanup(fn: Callable[[], None]) -> None:
+    """Run ``fn`` before a second SIGTERM/SIGINT escalates to the
+    default disposition (and before KeyboardInterrupt propagates)."""
+    if fn not in _ESCALATION_CLEANUPS:
+        _ESCALATION_CLEANUPS.append(fn)
+
+
+def _run_escalation_cleanups() -> None:
+    for fn in list(_ESCALATION_CLEANUPS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - escalation must proceed
+            pass
 
 
 class PreemptionGuard:
@@ -35,9 +57,12 @@ class PreemptionGuard:
 
     def _handler(self, signum, frame):
         if self.requested:
-            # second signal: escalate to the previous disposition
+            # second signal: escalate to the previous disposition —
+            # but reap supervised children first (a process fleet's
+            # workers must never outlive an escalated supervisor)
             log_warning(f"preemption: second signal {signum}; "
                         "escalating")
+            _run_escalation_cleanups()
             self.uninstall()
             if signum == signal.SIGINT:
                 raise KeyboardInterrupt

@@ -35,6 +35,8 @@ from .errors import (EngineStoppedError, InvalidRequestError,
                      QuotaExceededError, ReplicaUnavailableError,
                      RequestTimeoutError, ServingError)
 from .fleet import FleetEngine, ModelFleet, Replica
+from .procfleet import (ProcessReplica, ProcFleetOptions,
+                        WorkerSupervisor)
 from .registry import ModelRegistry, save_model_npz
 from .router import RouteDecision, Router
 from .tenants import TenantQuotas, TokenBucket
@@ -46,5 +48,6 @@ __all__ = ["ServingEngine", "ServingConfig", "ModelRegistry",
            "ModelNotFoundError", "QuotaExceededError",
            "ReplicaUnavailableError",
            "FleetEngine", "ModelFleet", "Replica",
+           "ProcessReplica", "ProcFleetOptions", "WorkerSupervisor",
            "Router", "RouteDecision",
            "TenantQuotas", "TokenBucket"]

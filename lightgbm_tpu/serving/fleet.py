@@ -40,8 +40,16 @@ Request lifecycle::
 
 Observability: every response lands in the
 ``fleet_request_latency_ms{model, tenant}`` histogram (Prometheus
-``GET /metrics``, docs/Observability.md), and fleet gauges
-(pending, healthy replicas, quota sheds) ride a scrape-time collector.
+``GET /metrics``, docs/Observability.md), fleet gauges (pending,
+healthy replicas, quota sheds) ride a scrape-time collector, and
+every replica state transition sets ``lgbm_fleet_replica_state{rid}``.
+
+Isolation: ``serving_isolation=process`` swaps each :class:`Replica`
+for a :class:`~lightgbm_tpu.serving.procfleet.ProcessReplica` — the
+same dispatch/recovery seam, but the replica's engines live in their
+own supervised worker OS process, so a device OOM or runtime crash
+kills one replica, never the pool (docs/Serving.md "Process
+isolation").
 """
 
 from __future__ import annotations
@@ -63,6 +71,8 @@ from .errors import (EngineStoppedError, InvalidRequestError,
                      ModelNotFoundError, QueueFullError,
                      QuotaExceededError, ReplicaUnavailableError,
                      RequestTimeoutError, ServingError)
+from .procfleet import (STATE_CODES, ProcFleetOptions,
+                        WorkerSupervisor)
 from .registry import ModelRegistry, ModelVersion
 from .router import Router
 from .tenants import TenantQuotas
@@ -280,8 +290,15 @@ class FleetFuture:
     def _try_redispatch(self) -> None:
         """Fleet-driven eager re-dispatch after a replica kill: move a
         failed (EngineStoppedError) request to a survivor NOW, before
-        its deadline burns down waiting for the caller to collect."""
-        with self._rlock:
+        its deadline burns down waiting for the caller to collect.
+        Non-blocking on the future's lock: a held lock means the
+        caller's ``result()`` loop (or another death's eager pass) is
+        already re-dispatching this future — skipping is correct, and
+        waiting could deadlock when a re-dispatch inside the lock
+        discovers ANOTHER dead replica and eagerly sweeps its futures."""
+        if not self._rlock.acquire(blocking=False):
+            return
+        try:
             fut = self._fut
             if self._finished or not fut.done():
                 return
@@ -293,6 +310,8 @@ class FleetFuture:
                 self._redispatches += 1
             except ServingError:
                 pass   # the caller's result() surfaces the failure
+        finally:
+            self._rlock.release()
 
     def _remaining_s(self) -> Optional[float]:
         if self._deadline is None:
@@ -317,12 +336,24 @@ class FleetEngine:
                  replicas: int = 2, router: Optional[Router] = None,
                  quotas: Optional[TenantQuotas] = None,
                  default_model: str = DEFAULT_MODEL,
-                 max_pending: int = 0):
+                 max_pending: int = 0,
+                 isolation: str = "thread",
+                 proc_opts: Optional[ProcFleetOptions] = None):
+        if isolation not in ("thread", "process"):
+            raise ValueError(
+                f"isolation must be thread|process, got {isolation!r}")
         self.config = config or ServingConfig()
         self.fleet = ModelFleet()
         self.router = router or Router()
         self.quotas = quotas or TenantQuotas()
         self.default_model = default_model
+        self.isolation = isolation
+        # process isolation (serving/procfleet.py): every replica's
+        # engines live in a supervised worker process; this side is
+        # the thin host that dispatches, heals and reaps
+        self._proc_supervisor: Optional[WorkerSupervisor] = \
+            WorkerSupervisor(self, proc_opts) \
+            if isolation == "process" else None
         self._lock = threading.Lock()
         self._replicas: List[Replica] = []
         self._next_rid = 0
@@ -353,6 +384,9 @@ class FleetEngine:
                 out["fleet_replicas"] = len(fl._replicas)
                 out["fleet_replicas_ok"] = sum(
                     1 for r in fl._replicas if r.state == "ok")
+                out["fleet_replicas_quarantined"] = sum(
+                    1 for r in fl._replicas
+                    if r.state == "quarantined")
             return out
 
         self._metrics.register_collector(_collect, owner=self)
@@ -362,8 +396,22 @@ class FleetEngine:
                 models = {default_model: models}
             for name, source in models.items():
                 self.load_model(name, source)
-        for _ in range(max(int(replicas), 1)):
-            self.add_replica()
+        n = max(int(replicas), 1)
+        if self._proc_supervisor is not None:
+            # spawn the pool concurrently: every worker pays a full
+            # interpreter + JAX import; serializing multiplies it
+            reps = [self._proc_supervisor.new_replica()
+                    for _ in range(n)]
+            self._proc_supervisor.spawn_pool(reps)
+            with self._lock:
+                self._replicas.extend(reps)
+                self._next_rid = len(reps)
+            for rep in reps:
+                self._count("replica_starts")
+                self._note_replica_state(rep)
+        else:
+            for _ in range(n):
+                self.add_replica()
 
     @classmethod
     def from_config(cls, cfg, models=None) -> "FleetEngine":
@@ -393,7 +441,10 @@ class FleetEngine:
                    quotas=TenantQuotas.from_config(cfg),
                    default_model=default,
                    max_pending=int(getattr(cfg, "serving_max_pending",
-                                           0)))
+                                           0)),
+                   isolation=str(getattr(cfg, "serving_isolation",
+                                         "thread")),
+                   proc_opts=ProcFleetOptions.from_config(cfg))
 
     # -- model lifecycle ----------------------------------------------
     def load_model(self, name: str, source) -> int:
@@ -402,12 +453,21 @@ class FleetEngine:
         compiles (or cache-replays) every shape bucket ONCE for the
         whole pool — replicas share the version's pinned arrays and
         the compiled programs."""
-        pin = self.config.device != "never"
+        pin = self.config.device != "never" \
+            and self._proc_supervisor is None
         try:
+            if self._proc_supervisor is not None:
+                # workers own the device arrays and the warmup; the
+                # parent registry holds the metadata (names, versions,
+                # health) and the replayable source for respawns
+                self._proc_supervisor.set_model_source(name, source)
             mv = self.fleet.load(name, source, pin_device=pin)
-            rep = self._pick_replica(allow_none=True)
-            if rep is not None and self.config.warmup:
-                rep.engine_for(name)._warmup(mv)
+            if self._proc_supervisor is not None:
+                self._proc_supervisor.broadcast_model(name)
+            else:
+                rep = self._pick_replica(allow_none=True)
+                if rep is not None and self.config.warmup:
+                    rep.engine_for(name)._warmup(mv)
         except Exception as e:
             # a rejected publish (torn model file, integrity failure,
             # warmup crash) keeps every previous version serving and
@@ -445,7 +505,17 @@ class FleetEngine:
     def add_replica(self) -> Replica:
         """Cold-start one replica: build engines for every model and
         replay the bucket programs (zero compiles when warm — the
-        replica records what it actually paid)."""
+        replica records what it actually paid). In process mode the
+        replica is a spawned, supervised worker process."""
+        if self._proc_supervisor is not None:
+            rep = self._proc_supervisor.new_replica()
+            self._proc_supervisor.spawn(rep)
+            with self._lock:
+                self._replicas.append(rep)
+                self._next_rid = max(self._next_rid, rep.rid + 1)
+            self._count("replica_starts")
+            self._note_replica_state(rep)
+            return rep
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -454,6 +524,7 @@ class FleetEngine:
         with self._lock:
             self._replicas.append(rep)
         self._count("replica_starts")
+        self._note_replica_state(rep)
         log_info(f"serving fleet: replica {rid} up "
                  f"(cold_start_compiles={rep.cold_start_compiles}, "
                  f"cold_start_s={rep.cold_start_s})")
@@ -473,18 +544,26 @@ class FleetEngine:
         already queued, then stop its engines."""
         rep = self._replica(rid)
         rep.state = "draining"
+        self._note_replica_state(rep)
         rep.stop(drain=True)
         rep.state = "dead"
         self._count("replica_drains")
+        self._note_replica_state(rep, event="drained")
 
     def kill_replica(self, rid: int) -> None:
         """Hard death: queued requests fail with EngineStoppedError and
-        re-dispatch to the surviving replicas via their FleetFutures."""
+        re-dispatch to the surviving replicas via their FleetFutures.
+        A process replica's worker is killed and NOT respawned (this
+        is the operator's kill, not a crash the supervisor heals)."""
         rep = self._replica(rid)
-        rep.state = "dead"
-        rep.deaths += 1
+        if getattr(rep, "is_process", False):
+            rep._no_respawn = True
+        if rep.state not in ("dead", "quarantined"):
+            rep.state = "dead"
+            rep.deaths += 1
+            self._count("replica_deaths")
         rep.stop(drain=False)
-        self._count("replica_deaths")
+        self._note_replica_state(rep, event="killed")
         # eager failover: everything the dead engines just failed with
         # EngineStoppedError moves to a survivor immediately, not when
         # its caller eventually calls result()
@@ -492,10 +571,79 @@ class FleetEngine:
             ff._try_redispatch()
 
     def _mark_dead(self, rep: Replica) -> None:
-        if rep.state != "dead":
-            rep.state = "dead"
-            rep.deaths += 1
-            self._count("replica_deaths")
+        """Declare a replica dead and recover EVERYTHING it held:
+        stop its engines non-drain so requests still QUEUED there fail
+        with EngineStoppedError (not only the in-flight ones), then
+        eagerly re-dispatch every fleet future it carried. Idempotent;
+        discovery paths (submit-time failure, result-time failure,
+        supervisor heartbeat) all funnel here."""
+        if getattr(rep, "is_process", False) \
+                and rep.state not in ("dead", "quarantined"):
+            # run the full process-death path (classify, fail pending,
+            # collect the worker dump, schedule the respawn); it calls
+            # back into _on_replica_death for the fleet-side recovery
+            if self._proc_supervisor is not None:
+                self._proc_supervisor._declare_death(
+                    rep, "socket_lost",
+                    "submit failed on a live-looking worker",
+                    kill=True)
+                return
+        if rep.state in ("dead", "quarantined"):
+            # already declared: the first declaration swept the
+            # futures. Sweeping again here would recurse (every
+            # re-dispatch calls _mark_dead on the source replica) one
+            # stack frame per queued future.
+            return
+        rep.state = "dead"
+        rep.deaths += 1
+        self._count("replica_deaths")
+        rep.stop(drain=False)
+        self._note_replica_state(rep)
+        for ff in list(rep.futures):
+            ff._try_redispatch()
+
+    def _on_replica_death(self, rep, reason_code: str) -> None:
+        """Supervisor callback (serving/procfleet.py): the worker
+        process behind ``rep`` died; its pending requests were already
+        failed — account the death and re-dispatch eagerly."""
+        rep.deaths += 1
+        self._count("replica_deaths")
+        get_telemetry().count(f"fleet.worker_death.{reason_code}")
+        self._note_replica_state(rep)
+        for ff in list(rep.futures):
+            ff._try_redispatch()
+
+    def inject_replica_fault(self, rid: int, kind: str = "crash",
+                             **params) -> bool:
+        """Chaos lever: deliver a process-level fault to a replica's
+        worker (``crash`` / ``hang`` / ``oom`` — the fault-grammar
+        kinds, robustness/faults.py). Thread-mode fleets approximate
+        ``crash``/``oom`` with :meth:`kill_replica`; ``hang`` has no
+        thread analog and returns False."""
+        if self._proc_supervisor is not None:
+            return self._proc_supervisor.inject_fault(rid, kind,
+                                                      **params)
+        if kind in ("crash", "oom"):
+            try:
+                self.kill_replica(rid)
+                return True
+            except ServingError:
+                return False
+        return False
+
+    def _note_replica_state(self, rep, event: Optional[str] = None
+                            ) -> None:
+        """The ``lgbm_fleet_replica_state{rid}`` gauge + a ``replica``
+        telemetry record on every state transition (both isolation
+        modes; docs/Observability.md)."""
+        get_metrics().set_gauge(
+            "lgbm_fleet_replica_state",
+            STATE_CODES.get(rep.state, -1), labels={"rid": rep.rid})
+        if event is not None:
+            get_telemetry().record(
+                "replica", rid=rep.rid, event=event, state=rep.state,
+                isolation="process"
+                if getattr(rep, "is_process", False) else "thread")
 
     def _pick_replica(self, exclude: Tuple[int, ...] = (),
                       allow_none: bool = False) -> Optional[Replica]:
@@ -827,10 +975,13 @@ class FleetEngine:
         out = {
             "status": status,
             "fleet": True,
+            "isolation": self.isolation,
             "pending": pending,
             "max_pending": self.max_pending,
             "default_model": self.default_model,
             "replicas": [r.describe() for r in reps],
+            "replicas_quarantined": sum(
+                1 for r in reps if r.state == "quarantined"),
             "models": models,
             "router": self.router.describe(),
             "quotas": self.quotas.describe(),
@@ -854,6 +1005,10 @@ class FleetEngine:
             except queue.Full:
                 pass
             self._shadow_thread.join(timeout)
+        if self._proc_supervisor is not None:
+            # drains every worker, closes the listener, stops the
+            # monitor and reaps anything still alive — no orphans
+            self._proc_supervisor.shutdown(drain=drain)
         for rep in self.replicas:
             if rep.state != "dead":
                 rep.stop(drain=drain)

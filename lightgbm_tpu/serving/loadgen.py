@@ -186,6 +186,9 @@ def soak_loop(engine, X: np.ndarray, duration_s: float = 30.0,
               reload_every_s: float = 0.0,
               reload_sources: Optional[Dict[str, object]] = None,
               replica_storm_every_s: float = 0.0,
+              kill_storm_every_s: float = 0.0,
+              kill_storm_kinds: Sequence[str] = ("crash", "oom",
+                                                 "hang"),
               fault_spec: str = "") -> Dict:
     """Sustained open-loop soak with chaos; see module docstring.
 
@@ -194,11 +197,17 @@ def soak_loop(engine, X: np.ndarray, duration_s: float = 30.0,
     ``reload_every_s`` one storm cycle hot-reloads each of them
     back-to-back. ``replica_storm_every_s`` kills one healthy replica
     and cold-starts a replacement per cycle (fleet only, and only
-    while >1 replica is healthy). ``fault_spec`` installs a
-    deterministic ``robustness/faults.py`` plan for the soak's
-    duration (``fail_read`` faults land on the storm's model-file
-    reads and are absorbed by the registry's retry/degraded-reload
-    machinery — availability must not move).
+    while >1 replica is healthy). ``kill_storm_every_s`` is the
+    PROCESS-fault storm (serving/procfleet.py): every cycle one live
+    replica takes the next ``kill_storm_kinds`` fault (crash = SIGKILL
+    its worker, oom = exit 137, hang = go silent past the heartbeat
+    timeout) through ``FleetEngine.inject_replica_fault`` — the
+    supervisor must re-dispatch, heal and respawn; thread fleets
+    approximate crash/oom with kill+cold-start. ``fault_spec``
+    installs a deterministic ``robustness/faults.py`` plan for the
+    soak's duration (``fail_read`` faults land on the storm's
+    model-file reads and are absorbed by the registry's retry/
+    degraded-reload machinery — availability must not move).
     """
     from ..robustness.faults import get_fault_plan, set_fault_plan
     is_fleet = bool(getattr(engine, "is_fleet", False))
@@ -209,11 +218,13 @@ def soak_loop(engine, X: np.ndarray, duration_s: float = 30.0,
     plan = set_fault_plan(fault_spec) if fault_spec else None
     stop = threading.Event()
     chaos = {"reloads": 0, "reload_failures": 0, "replica_kills": 0,
-             "cold_starts": 0}
+             "cold_starts": 0, "fault_storms": 0}
+    storm_i = [0]
 
     def chaos_loop() -> None:
         next_reload = time.monotonic() + reload_every_s
         next_storm = time.monotonic() + replica_storm_every_s
+        next_kill = time.monotonic() + kill_storm_every_s
         while not stop.wait(0.05):
             now = time.monotonic()
             if reload_every_s > 0 and reload_sources \
@@ -244,9 +255,28 @@ def soak_loop(engine, X: np.ndarray, duration_s: float = 30.0,
                         chaos["cold_starts"] += 1
                     except Exception:  # noqa: BLE001 - keep soaking
                         pass
+            if is_fleet and kill_storm_every_s > 0 \
+                    and now >= next_kill:
+                next_kill = now + kill_storm_every_s
+                live = [r for r in engine.replicas if r.state == "ok"]
+                if len(live) > 1:
+                    kind = kill_storm_kinds[
+                        storm_i[0] % len(kill_storm_kinds)]
+                    storm_i[0] += 1
+                    params = {}
+                    if kind == "hang":
+                        sup = getattr(engine, "_proc_supervisor",
+                                      None)
+                        to = sup.opts.heartbeat_timeout_ms \
+                            if sup is not None else 1000.0
+                        params["ms"] = int(to * 1.5)
+                    if engine.inject_replica_fault(
+                            live[-1].rid, kind, **params):
+                        chaos["fault_storms"] += 1
 
     chaos_thread = None
-    if reload_every_s > 0 or replica_storm_every_s > 0:
+    if reload_every_s > 0 or replica_storm_every_s > 0 \
+            or kill_storm_every_s > 0:
         chaos_thread = threading.Thread(target=chaos_loop, daemon=True,
                                         name="lgbm-soak-chaos")
         chaos_thread.start()
@@ -348,10 +378,12 @@ def soak_loop(engine, X: np.ndarray, duration_s: float = 30.0,
         for key in ("redispatches", "replica_deaths", "quota_shed",
                     "shadow_mirrored", "shadow_parity_ok",
                     "shadow_parity_mismatch", "shadow_skipped",
-                    "promotions"):
+                    "promotions", "replica_restarts",
+                    "replica_quarantines"):
             block[key] = int(st.get(key, 0))
         block["replicas"] = len(engine.replicas)
         block["models"] = engine.fleet.names()
+        block["isolation"] = getattr(engine, "isolation", "thread")
     block["batch_sizes"] = list(batch_sizes)
     block["buckets"] = list(engine.config.buckets)
     return block

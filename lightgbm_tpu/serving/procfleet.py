@@ -1,0 +1,982 @@
+"""Process-per-replica fleet isolation: supervisor side.
+
+A thread-mode :class:`~lightgbm_tpu.serving.fleet.Replica` is a set of
+engines inside the serving process — a device OOM, runtime abort or
+segfault in any replica kills the whole pool, the HTTP frontend and
+the refit pipeline with it. ``serving_isolation=process`` moves each
+replica's engines into their OWN spawned OS process (own JAX runtime,
+own flight recorder; ``serving/worker.py`` is the child entry point),
+supervised from this thin host over a length-prefixed local socket:
+
+* **framing** — 4-byte big-endian length + one JSON object per frame
+  (rows/results as nested lists: ``json`` round-trips float64 exactly,
+  so process-mode responses stay bit-identical to thread mode);
+* **handshake** — the worker dials the supervisor's listener with the
+  bounded deterministic backoff from ``robustness/retry.py`` (the
+  reference's socket-linker design: retried point-to-point connects)
+  and authenticates with a per-incarnation token;
+* **heartbeats** — the monitor pings every ``replica_heartbeat_ms``;
+  any frame from the worker refreshes liveness. A worker that exits
+  (nonzero status, OOM kill) or goes quiet past
+  ``replica_heartbeat_timeout_ms`` is declared dead: its reason is
+  classified into the ``tools/probe_taxonomy.py`` worker codes
+  (``spawn_failed`` / ``heartbeat_lost`` / ``oom_killed`` /
+  ``respawn_exhausted``), its in-flight AND queued requests fail with
+  ``EngineStoppedError`` so the fleet's eager re-dispatch moves them
+  to survivors, its crash dump (``<crash_dump>.worker<rid>.json``) is
+  collected into the parent's flight-recorder artifact, and the
+  worker **respawns** with bounded deterministic backoff — warm
+  through the persistent compile cache — capped by
+  ``replica_restart_max``. A flapping replica is quarantined:
+  ``health()`` degrades, the pool never dies.
+
+Process-level fault kinds (``crash_replica`` / ``hang_replica`` /
+``oom_replica``, robustness/faults.py) are armed in the SUPERVISOR's
+fault plan (consumed-once stays consumed-once across respawns) and
+honored inside the worker via a ``fault`` frame.
+
+See docs/Serving.md "Process isolation" for the replica state machine
+and the thread-vs-process tradeoff table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..observability.metrics import get_metrics
+from ..observability.telemetry import get_telemetry
+from ..observability.tracing import get_tracer
+from ..utils.log import log_info, log_warning
+from .engine import ServingFuture, _Request
+from .errors import (EngineStoppedError, InvalidRequestError,
+                     ModelLoadError, ModelNotFoundError, QueueFullError,
+                     QuotaExceededError, ReplicaUnavailableError,
+                     RequestTimeoutError, ServingError)
+
+_FRAME_MAX = 256 << 20
+_ERROR_BY_CODE = {cls.code: cls for cls in (
+    ServingError, QueueFullError, RequestTimeoutError,
+    EngineStoppedError, ModelLoadError, ModelNotFoundError,
+    QuotaExceededError, ReplicaUnavailableError, InvalidRequestError)}
+
+# replica state machine (docs/Serving.md "Process isolation"); the
+# numeric codes are the lgbm_fleet_replica_state{rid} gauge values
+STATE_CODES = {"ok": 0, "draining": 1, "dead": 2, "quarantined": 3}
+
+
+# ---------------------------------------------------------------------
+# wire framing (shared with serving/worker.py)
+def send_frame(sock_, obj: Dict[str, Any],
+               lock: Optional[threading.Lock] = None) -> None:
+    body = json.dumps(obj).encode()
+    if len(body) > _FRAME_MAX:
+        raise ServingError(f"frame too large ({len(body)} bytes)")
+    payload = struct.pack(">I", len(body)) + body
+    if lock is not None:
+        with lock:
+            sock_.sendall(payload)
+    else:
+        sock_.sendall(payload)
+
+
+def _recv_exact(sock_, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock_.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock_) -> Optional[Dict[str, Any]]:
+    """One frame, or None on a clean/broken EOF."""
+    head = _recv_exact(sock_, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > _FRAME_MAX:
+        raise ServingError(f"oversized frame ({n} bytes)")
+    body = _recv_exact(sock_, n)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def error_from_frame(msg: Dict[str, Any]) -> ServingError:
+    cls = _ERROR_BY_CODE.get(str(msg.get("code")), ServingError)
+    err = cls(str(msg.get("message", msg.get("code", "worker error"))))
+    err.details = dict(msg.get("details") or {})
+    return err
+
+
+@dataclass
+class ProcFleetOptions:
+    """Supervisor tuning (the ``replica_*`` config params)."""
+
+    restart_max: int = 3
+    heartbeat_ms: float = 200.0
+    heartbeat_timeout_ms: float = 3000.0
+    spawn_timeout_s: float = 120.0
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    # a worker that stays alive this long earns its restart budget
+    # back: the cap is for FLAPPING replicas, not for a long-lived
+    # pool that absorbs an occasional crash a day
+    flap_reset_s: float = 30.0
+
+    @classmethod
+    def from_config(cls, cfg) -> "ProcFleetOptions":
+        return cls(
+            restart_max=int(getattr(cfg, "replica_restart_max", 3)),
+            heartbeat_ms=float(getattr(cfg, "replica_heartbeat_ms",
+                                       200.0)),
+            heartbeat_timeout_ms=float(getattr(
+                cfg, "replica_heartbeat_timeout_ms", 3000.0)),
+            spawn_timeout_s=float(getattr(
+                cfg, "replica_spawn_timeout_s", 120.0)))
+
+
+class _WorkerHandle:
+    """One incarnation of a worker process: Popen + socket + pending."""
+
+    def __init__(self, proc: subprocess.Popen, conn: socket.socket,
+                 rid: int, incarnation: int):
+        self.proc = proc
+        self.conn = conn
+        self.rid = rid
+        self.incarnation = incarnation
+        self.pid = proc.pid
+        self.wlock = threading.Lock()
+        self.plock = threading.Lock()
+        self.pending: Dict[int, _Request] = {}
+        self.next_id = 0
+        self.last_seen = time.monotonic()
+        self.created_at = time.monotonic()
+        self.closed = False
+        self.worker_stats: Dict[str, Any] = {}
+        self.worker_load = 0
+        self._acks: Dict[int, Dict[str, Any]] = {}
+        self._ack_cond = threading.Condition()
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"lgbm-worker{rid}-recv")
+        self._recv_thread.start()
+
+    # -- request plumbing ---------------------------------------------
+    def _new_id(self) -> int:
+        with self.plock:
+            self.next_id += 1
+            return self.next_id
+
+    def submit(self, model: str, rows: np.ndarray, kind: str,
+               timeout_ms: Optional[float],
+               trace: Optional[Dict[str, str]]) -> ServingFuture:
+        t = None if timeout_ms is None or timeout_ms <= 0 \
+            else timeout_ms / 1000.0
+        req = _Request(rows, kind, t)
+        mid = self._new_id()
+        with self.plock:
+            if self.closed:
+                raise EngineStoppedError(
+                    f"replica {self.rid} worker is down",
+                    replica=self.rid)
+            self.pending[mid] = req
+        try:
+            send_frame(self.conn, {
+                "type": "submit", "id": mid, "model": model,
+                "kind": kind, "rows": rows.tolist(),
+                "timeout_ms": timeout_ms, "trace": trace},
+                lock=self.wlock)
+        except OSError as e:
+            with self.plock:
+                self.pending.pop(mid, None)
+            raise EngineStoppedError(
+                f"replica {self.rid} worker socket failed: {e}",
+                replica=self.rid) from e
+        return ServingFuture(req)
+
+    def request_sync(self, frame: Dict[str, Any],
+                     timeout_s: float) -> Dict[str, Any]:
+        """A control round trip (load_model / warm): send, await ack."""
+        mid = self._new_id()
+        frame = dict(frame, id=mid)
+        try:
+            send_frame(self.conn, frame, lock=self.wlock)
+        except OSError as e:
+            raise EngineStoppedError(
+                f"replica {self.rid} worker socket failed: {e}",
+                replica=self.rid) from e
+        deadline = time.monotonic() + timeout_s
+        with self._ack_cond:
+            while mid not in self._acks:
+                left = deadline - time.monotonic()
+                if left <= 0 or self.closed:
+                    raise EngineStoppedError(
+                        f"replica {self.rid} worker did not ack "
+                        f"{frame['type']} within {timeout_s}s",
+                        replica=self.rid)
+                self._ack_cond.wait(min(left, 0.2))
+            return self._acks.pop(mid)
+
+    def send(self, frame: Dict[str, Any]) -> bool:
+        try:
+            send_frame(self.conn, frame, lock=self.wlock)
+            return True
+        except OSError:
+            return False
+
+    # -- receiver ------------------------------------------------------
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_frame(self.conn)
+                if msg is None:
+                    return
+                self.last_seen = time.monotonic()
+                t = msg.get("type")
+                if t == "result":
+                    self._resolve(msg, error=False)
+                elif t == "error":
+                    self._resolve(msg, error=True)
+                elif t == "pong":
+                    self.worker_stats = msg.get("stats") or {}
+                    self.worker_load = int(msg.get("load", 0))
+                elif t == "ack":
+                    with self._ack_cond:
+                        self._acks[int(msg.get("id", -1))] = msg
+                        self._ack_cond.notify_all()
+                # "bye" and unknown frames only refresh liveness
+        except (OSError, ValueError, ServingError):
+            return   # monitor declares the death; receivers just stop
+
+    def _resolve(self, msg: Dict[str, Any], error: bool) -> None:
+        with self.plock:
+            req = self.pending.pop(int(msg.get("id", -1)), None)
+        if req is None:
+            return
+        if error:
+            req.error = error_from_frame(msg)
+            req.meta.update(error=req.error.code,
+                            replica_pid=self.pid)
+        else:
+            req.result = np.asarray(msg.get("result"))
+            req.meta.update(msg.get("meta") or {})
+            req.meta["replica_pid"] = self.pid
+        req.t_perf_done = time.perf_counter()
+        req.event.set()
+
+    # -- teardown ------------------------------------------------------
+    def fail_pending(self, err: ServingError) -> int:
+        with self.plock:
+            reqs = list(self.pending.values())
+            self.pending.clear()
+        for req in reqs:
+            req.error = err
+            req.meta.update(error=err.code)
+            req.t_perf_done = time.perf_counter()
+            req.event.set()
+        return len(reqs)
+
+    def close(self) -> None:
+        self.closed = True
+        with self._ack_cond:
+            self._ack_cond.notify_all()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class _WorkerEngineProxy:
+    """The per-model engine facade of a ProcessReplica: quacks enough
+    of ServingEngine for FleetEngine's dispatch/stats paths (submit,
+    stop, queue_depth, stats); the real engine lives in the worker."""
+
+    def __init__(self, replica: "ProcessReplica", name: str):
+        self._replica = replica
+        self._name = name
+
+    @property
+    def queue_depth(self) -> int:
+        return 0      # queued work is counted by the replica's load()
+
+    def submit(self, rows, kind: str = "predict",
+               timeout_ms: Optional[float] = None,
+               trace_ctx=None) -> ServingFuture:
+        return self._replica._submit(self._name, rows, kind,
+                                     timeout_ms, trace_ctx)
+
+    def stop(self, drain: bool = True) -> None:
+        pass          # worker lifetime is replica-level
+
+    def _warmup(self, mv) -> None:
+        pass          # the worker warms itself on load_model/warm
+
+    def stats(self) -> Dict[str, Any]:
+        h = self._replica._handle
+        if h is None:
+            return {}
+        models = (h.worker_stats or {}).get("models") or {}
+        return dict(models.get(self._name) or {})
+
+
+class ProcessReplica:
+    """One supervised worker process; duck-types fleet.Replica."""
+
+    STATES = ("ok", "draining", "dead", "quarantined")
+    is_process = True
+
+    def __init__(self, rid: int, supervisor: "WorkerSupervisor"):
+        self.rid = rid
+        self._supervisor = supervisor
+        self._lock = threading.Lock()
+        self._engines: Dict[str, _WorkerEngineProxy] = {}
+        self.state = "dead"          # ok only after hello + warm
+        self.outstanding = 0
+        self.futures: "weakref.WeakSet" = weakref.WeakSet()
+        self.started_at = time.time()
+        self.cold_start_compiles: Optional[int] = None
+        self.cold_start_s: Optional[float] = None
+        self.deaths = 0
+        self.restarts = 0
+        self.incarnation = 0
+        self.last_death: Dict[str, Any] = {}
+        self.restart_ready_ms: Optional[float] = None
+        self._handle: Optional[_WorkerHandle] = None
+        self._no_respawn = False
+        self._respawning = False
+        # inf until the first death: a replica the supervisor has not
+        # spawned yet must never be "healed" by the respawn pump
+        self._next_respawn_at = float("inf")
+
+    @property
+    def pid(self) -> Optional[int]:
+        h = self._handle
+        return None if h is None else h.pid
+
+    def engine_for(self, name: str) -> _WorkerEngineProxy:
+        with self._lock:
+            eng = self._engines.get(name)
+            if eng is None:
+                eng = self._engines[name] = _WorkerEngineProxy(
+                    self, name)
+            return eng
+
+    def _submit(self, name: str, rows, kind: str,
+                timeout_ms: Optional[float], trace_ctx) -> ServingFuture:
+        h = self._handle
+        if h is None or h.closed or self.state not in ("ok", "draining"):
+            raise EngineStoppedError(
+                f"replica {self.rid} worker is not serving "
+                f"(state={self.state})", replica=self.rid)
+        trace = None
+        if trace_ctx is not None:
+            trace = {"trace_id": trace_ctx.trace_id,
+                     "span_id": trace_ctx.span_id}
+        arr = np.asarray(rows, np.float64)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        fut = h.submit(name, arr, kind, timeout_ms, trace)
+        if trace_ctx is not None:
+            # join the worker's side of the request to the parent
+            # trace: one complete event per request, emitted when the
+            # worker answers, carrying its queue/compute decomposition
+            self._supervisor._trace_worker_request(
+                self.rid, fut, trace_ctx)
+        return fut
+
+    def warm(self, names: Optional[List[str]] = None) -> None:
+        h = self._handle
+        if h is None:
+            return
+        ack = h.request_sync(
+            {"type": "warm", "names": names},
+            timeout_s=self._supervisor.opts.spawn_timeout_s)
+        self.cold_start_compiles = ack.get("compiles")
+        self.cold_start_s = ack.get("dur_s")
+
+    def load(self) -> int:
+        h = self._handle
+        with self._lock:
+            out = self.outstanding
+        pending = 0 if h is None else len(h.pending)
+        worker_q = 0 if h is None else h.worker_load
+        return out + max(pending, worker_q)
+
+    def stop(self, drain: bool = True) -> None:
+        self._supervisor.stop_worker(self, drain=drain)
+
+    def stats_lite(self) -> Dict[str, Any]:
+        h = self._handle
+        return {} if h is None else dict(h.worker_stats or {})
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            models = sorted(self._engines)
+        return {"replica": self.rid, "state": self.state,
+                "isolation": "process", "pid": self.pid,
+                "load": self.load(), "models": models,
+                "cold_start_compiles": self.cold_start_compiles,
+                "cold_start_s": self.cold_start_s,
+                "started_at": self.started_at,
+                "incarnation": self.incarnation,
+                "restarts": self.restarts,
+                "restart_ready_ms": self.restart_ready_ms,
+                "last_death": dict(self.last_death)}
+
+
+class WorkerSupervisor:
+    """Spawns, monitors, heals and reaps the fleet's worker processes.
+
+    Owned by a FleetEngine in ``serving_isolation=process`` mode; the
+    fleet calls back into :meth:`FleetEngine._on_replica_death
+    <lightgbm_tpu.serving.fleet.FleetEngine>` for the re-dispatch /
+    accounting side of a death, and this class owns everything
+    process-shaped: sockets, heartbeats, fault pumping, respawn
+    backoff, quarantine, crash-dump collection and child reaping.
+    """
+
+    def __init__(self, fleet, opts: Optional[ProcFleetOptions] = None):
+        self._fleet_ref = weakref.ref(fleet)
+        self.opts = opts or ProcFleetOptions()
+        self._lock = threading.Lock()
+        self._replicas: List[ProcessReplica] = []
+        # publish-ordered model state replayed to every (re)spawned
+        # worker: name -> load_model frame (text or path source)
+        self._model_state: Dict[str, Dict[str, Any]] = {}
+        self._awaiting: Dict[str, "_HelloSlot"] = {}
+        self._stopping = False
+        self.worker_dumps: List[Dict[str, Any]] = []
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="lgbm-procfleet-accept")
+        self._accept_thread.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="lgbm-procfleet-monitor")
+        self._monitor_thread.start()
+        # escalation / interpreter-exit safety net: a supervisor that
+        # dies ungracefully must still reap its children (satellite:
+        # "second signal escalates and still reaps children")
+        from ..robustness.preempt import register_escalation_cleanup
+        register_escalation_cleanup(self.reap)
+        import atexit
+        atexit.register(self.reap)
+
+    # -- spawn / handshake --------------------------------------------
+    def new_replica(self) -> ProcessReplica:
+        with self._lock:
+            rid = len(self._replicas)
+            rep = ProcessReplica(rid, self)
+            self._replicas.append(rep)
+        return rep
+
+    def _worker_env(self, rep: ProcessReplica,
+                    token: str) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["LGBM_TPU_WORKER_RID"] = str(rep.rid)
+        env["LGBM_TPU_WORKER_TOKEN"] = token
+        cfg = getattr(self._fleet_ref(), "config", None)
+        env["LGBM_TPU_WORKER_CONFIG"] = json.dumps({
+            "buckets": list(getattr(cfg, "buckets", (1,))),
+            "max_queue": getattr(cfg, "max_queue", 1024),
+            "flush_interval_ms": getattr(cfg, "flush_interval_ms", 2.0),
+            "request_timeout_ms": getattr(cfg, "request_timeout_ms",
+                                          1000.0),
+            "shed_policy": getattr(cfg, "shed_policy", "reject_new"),
+            "device": getattr(cfg, "device", "auto"),
+            "warmup": bool(getattr(cfg, "warmup", True)),
+        })
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        # the supervisor's plan drives process faults (consumed-once
+        # must survive respawns); a worker re-parsing the spec would
+        # re-arm every event from scratch
+        env.pop("LGBM_TPU_FAULTS", None)
+        # per-worker observability sinks: appending to the parent's
+        # JSONL from many processes would interleave torn lines
+        for var in ("LGBM_TPU_TELEMETRY", "LGBM_TPU_TRACE"):
+            if env.get(var):
+                env[var] = f"{env[var]}.worker{rep.rid}"
+        return env
+
+    def spawn(self, rep: ProcessReplica) -> None:
+        """Spawn + handshake + model replay + warm; raises on failure
+        (the caller decides whether that is fatal or a respawn miss)."""
+        token = secrets.token_hex(16)
+        slot = _HelloSlot()
+        with self._lock:
+            self._awaiting[token] = slot
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "lightgbm_tpu.serving.worker",
+                 "--connect", f"127.0.0.1:{self.port}",
+                 "--rid", str(rep.rid)],
+                env=self._worker_env(rep, token))
+        except OSError as e:
+            with self._lock:
+                self._awaiting.pop(token, None)
+            raise ServingError(f"worker spawn failed: {e}") from e
+        conn = slot.wait(self.opts.spawn_timeout_s)
+        with self._lock:
+            self._awaiting.pop(token, None)
+        if conn is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            raise ServingError(
+                f"replica {rep.rid} worker never said hello within "
+                f"{self.opts.spawn_timeout_s}s "
+                f"(exit={proc.poll()})")
+        rep.incarnation += 1
+        handle = _WorkerHandle(proc, conn, rep.rid, rep.incarnation)
+        rep._handle = handle
+        # replay the fleet's published model state, then warm: with
+        # the persistent compile cache shared across incarnations the
+        # respawned worker replays the bucket programs instead of
+        # recompiling them (cold_start_compiles records what it paid)
+        for name, frame in list(self._model_state.items()):
+            ack = handle.request_sync(dict(frame),
+                                      self.opts.spawn_timeout_s)
+            if not ack.get("ok"):
+                raise ServingError(
+                    f"replica {rep.rid} worker failed to load "
+                    f"{name!r}: {ack.get('message')}")
+        rep.warm()
+        rep.state = "ok"
+        ready_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+        rep.restart_ready_ms = ready_ms
+        self._note(rep, "ready", ready_ms=ready_ms,
+                   compiles=rep.cold_start_compiles)
+        log_info(f"procfleet: replica {rep.rid} worker up "
+                 f"(pid={handle.pid}, inc={rep.incarnation}, "
+                 f"ready_ms={ready_ms}, "
+                 f"compiles={rep.cold_start_compiles})")
+
+    def spawn_pool(self, reps: List[ProcessReplica]) -> None:
+        """Spawn several workers concurrently (a worker pays a full
+        interpreter + JAX import on start; serializing the pool would
+        multiply that bill by the replica count)."""
+        errs: List[BaseException] = []
+
+        def one(rep):
+            try:
+                self.spawn(rep)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(r,), daemon=True)
+                   for r in reps]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.opts.spawn_timeout_s + 10.0)
+        if errs:
+            raise errs[0]
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(10.0)
+                hello = recv_frame(conn)
+                conn.settimeout(None)
+            except (OSError, ValueError, ServingError):
+                conn.close()
+                continue
+            slot = None
+            if isinstance(hello, dict) and hello.get("type") == "hello":
+                with self._lock:
+                    slot = self._awaiting.get(str(hello.get("token")))
+            if slot is None:
+                conn.close()      # stale incarnation / stranger
+                continue
+            slot.put(conn)
+
+    # -- model lifecycle ----------------------------------------------
+    def set_model_source(self, name: str, source) -> None:
+        """Record (and normalize) the source for replay on respawn."""
+        frame: Dict[str, Any] = {"type": "load_model", "name": name}
+        if isinstance(source, str):
+            if "\n" in source:
+                frame["text"] = source
+            else:
+                frame["path"] = source
+        elif hasattr(source, "model_to_string"):    # basic.Booster
+            frame["text"] = source.model_to_string()
+        else:
+            raise ModelLoadError(
+                "process-isolated fleets need a file path, model text "
+                f"or Booster source for {name!r}, got "
+                f"{type(source).__name__}")
+        self._model_state[name] = frame
+
+    def broadcast_model(self, name: str) -> None:
+        """Push a (re)published model to every live worker; a worker
+        that fails the load is declared dead (the respawn replays the
+        new state, so it can never serve a stale version)."""
+        frame = self._model_state[name]
+        for rep in self.live_replicas():
+            h = rep._handle
+            if h is None:
+                continue
+            try:
+                ack = h.request_sync(dict(frame),
+                                     self.opts.spawn_timeout_s)
+                if not ack.get("ok"):
+                    raise ServingError(str(ack.get("message")))
+            except ServingError as e:
+                log_warning(f"procfleet: replica {rep.rid} rejected "
+                            f"model {name!r} ({e}); recycling worker")
+                self._declare_death(rep, "load_failed", str(e),
+                                    kill=True)
+
+    # -- monitor / healing --------------------------------------------
+    def live_replicas(self) -> List[ProcessReplica]:
+        with self._lock:
+            return [r for r in self._replicas
+                    if r.state in ("ok", "draining")]
+
+    def _monitor_loop(self) -> None:
+        from ..robustness.faults import get_fault_plan
+        interval = max(self.opts.heartbeat_ms / 1000.0, 0.02)
+        while not self._stopping:
+            time.sleep(interval)
+            plan = get_fault_plan()
+            now = time.monotonic()
+            for rep in self.live_replicas():
+                h = rep._handle
+                if h is None:
+                    continue
+                if plan is not None:
+                    self._pump_faults(plan, rep, h)
+                if rep.restarts and rep.state == "ok" \
+                        and (now - h.created_at) \
+                        > self.opts.flap_reset_s:
+                    rep.restarts = 0    # earned the budget back
+                code = h.proc.poll()
+                if code is not None:
+                    self._declare_death(
+                        rep, _classify_exit(code),
+                        f"worker pid {h.pid} exited with {code}")
+                    continue
+                if (now - h.last_seen) * 1000.0 \
+                        > self.opts.heartbeat_timeout_ms:
+                    self._declare_death(
+                        rep, "heartbeat_lost",
+                        f"no frame from pid {h.pid} for "
+                        f"{(now - h.last_seen):.2f}s", kill=True)
+                    continue
+                h.send({"type": "ping", "t": time.time()})
+            self._pump_respawns()
+
+    def _pump_faults(self, plan, rep: ProcessReplica,
+                     h: _WorkerHandle) -> None:
+        ev = plan.take("crash_replica", rid=rep.rid)
+        if ev is not None:
+            h.send({"type": "fault", "kind": "crash",
+                    "signal": int(ev.params.get("signal", 9))})
+            return
+        ev = plan.take("hang_replica", rid=rep.rid)
+        if ev is not None:
+            h.send({"type": "fault", "kind": "hang",
+                    "ms": int(ev.params.get("ms", 0))})
+            return
+        ev = plan.take("oom_replica", rid=rep.rid)
+        if ev is not None:
+            h.send({"type": "fault", "kind": "oom"})
+
+    def inject_fault(self, rid: int, kind: str, **params) -> bool:
+        """Direct process-fault injection (the chaos storm's lever;
+        the fault-plan grammar is the declarative front of the same
+        frames). kind in crash|hang|oom."""
+        with self._lock:
+            reps = [r for r in self._replicas if r.rid == rid]
+        if not reps or reps[0]._handle is None \
+                or reps[0].state != "ok":
+            return False
+        frame = {"type": "fault", "kind": kind}
+        frame.update(params)
+        return reps[0]._handle.send(frame)
+
+    def _declare_death(self, rep: ProcessReplica, reason_code: str,
+                       detail: str, kill: bool = False) -> None:
+        with rep._lock:
+            if rep.state == "dead" or rep.state == "quarantined":
+                return
+            rep.state = "dead"
+        h = rep._handle
+        rep._handle = None
+        rep.last_death = {"reason_code": reason_code,
+                          "detail": detail[:240],
+                          "at": time.time(),
+                          "incarnation": rep.incarnation}
+        if h is not None:
+            if kill:
+                _kill_proc(h.proc)
+            h.close()
+            failed = h.fail_pending(EngineStoppedError(
+                f"replica {rep.rid} worker died ({reason_code})",
+                replica=rep.rid, reason_code=reason_code))
+        else:
+            failed = 0
+        self._collect_worker_dump(rep, reason_code)
+        self._note(rep, "dead", reason_code=reason_code,
+                   detail=detail[:240], failed_requests=failed)
+        log_warning(f"procfleet: replica {rep.rid} worker DEAD "
+                    f"({reason_code}: {detail}); {failed} request(s) "
+                    "failed for re-dispatch")
+        fleet = self._fleet_ref()
+        if fleet is not None:
+            fleet._on_replica_death(rep, reason_code)
+        rep._next_respawn_at = time.monotonic() + self._backoff(rep)
+
+    def _backoff(self, rep: ProcessReplica) -> float:
+        from ..robustness.retry import backoff_delays
+        delays = list(backoff_delays(
+            attempts=self.opts.restart_max + 2,
+            base_delay_s=self.opts.backoff_base_s,
+            max_delay_s=self.opts.backoff_max_s,
+            desc=f"replica{rep.rid} respawn"))
+        i = min(rep.restarts, len(delays) - 1) if delays else 0
+        return delays[i] if delays else 0.0
+
+    def _pump_respawns(self) -> None:
+        with self._lock:
+            reps = list(self._replicas)
+        now = time.monotonic()
+        for rep in reps:
+            if rep.state != "dead" or rep._no_respawn \
+                    or rep._respawning or self._stopping:
+                continue
+            if now < getattr(rep, "_next_respawn_at", 0.0):
+                continue
+            if rep.restarts >= self.opts.restart_max:
+                rep.state = "quarantined"
+                self._note(rep, "quarantined",
+                           restarts=rep.restarts,
+                           reason_code="respawn_exhausted")
+                fleet = self._fleet_ref()
+                if fleet is not None:
+                    fleet._count("replica_quarantines")
+                    fleet._note_replica_state(rep)
+                log_warning(
+                    f"procfleet: replica {rep.rid} QUARANTINED after "
+                    f"{rep.restarts} restart(s) (respawn_exhausted); "
+                    "the pool degrades but keeps serving")
+                continue
+            rep._respawning = True
+            threading.Thread(target=self._respawn, args=(rep,),
+                             daemon=True,
+                             name=f"lgbm-respawn-{rep.rid}").start()
+
+    def _respawn(self, rep: ProcessReplica) -> None:
+        fleet = self._fleet_ref()
+        try:
+            rep.restarts += 1
+            if fleet is not None:
+                fleet._count("replica_restarts")
+            get_telemetry().count("fleet.replica_restarts")
+            self.spawn(rep)
+            self._note(rep, "respawned", restarts=rep.restarts,
+                       ready_ms=rep.restart_ready_ms)
+            if fleet is not None:
+                fleet._note_replica_state(rep)
+        except Exception as e:  # noqa: BLE001 - retried by the monitor
+            rep.state = "dead"
+            rep.last_death = {"reason_code": "spawn_failed",
+                              "detail": str(e)[:240],
+                              "at": time.time()}
+            self._note(rep, "dead", reason_code="spawn_failed",
+                       detail=str(e)[:240])
+            rep._next_respawn_at = time.monotonic() + self._backoff(rep)
+        finally:
+            rep._respawning = False
+
+    # -- dump collection ----------------------------------------------
+    def _collect_worker_dump(self, rep: ProcessReplica,
+                             reason_code: str) -> None:
+        """Fold the child's flight-recorder dump and exit reason into
+        the parent artifact (satellite 2: the parent's black box holds
+        the whole fleet's last words, not just its own)."""
+        from ..observability.flightrec import (active_recorder,
+                                               resolve_dump_path,
+                                               worker_dump_path)
+        entry: Dict[str, Any] = {
+            "rid": rep.rid, "reason_code": reason_code,
+            "incarnation": rep.incarnation, "wall_time": time.time()}
+        base = os.environ.get("LGBM_TPU_CRASH_DUMP", "").strip() \
+            or (resolve_dump_path() or "")
+        if base:
+            path = worker_dump_path(base, rep.rid)
+            try:
+                with open(path) as fh:
+                    entry["dump"] = json.load(fh)
+                entry["dump_path"] = path
+            except (OSError, ValueError):
+                pass
+        self.worker_dumps.append(entry)
+        del self.worker_dumps[:-16]
+        rec = active_recorder()
+        if rec is not None:
+            rec.note("worker_death", rid=rep.rid,
+                     reason_code=reason_code)
+            rec.dump(f"worker_death:{reason_code}",
+                     worker_dumps=list(self.worker_dumps))
+
+    # -- teardown ------------------------------------------------------
+    def stop_worker(self, rep: ProcessReplica,
+                    drain: bool = True) -> None:
+        h = rep._handle
+        rep._no_respawn = True
+        if rep.state in ("ok", "draining"):
+            rep.state = "draining" if drain else "dead"
+        if h is None:
+            rep.state = "dead"
+            return
+        if drain:
+            h.send({"type": "drain"})
+            deadline = time.monotonic() + 10.0
+            while h.proc.poll() is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+        if h.proc.poll() is None:
+            _kill_proc(h.proc, term_first=drain)
+        h.close()
+        h.fail_pending(EngineStoppedError(
+            f"replica {rep.rid} stopped", replica=rep.rid))
+        rep._handle = None
+        rep.state = "dead"
+        self._note(rep, "stopped", drained=bool(drain))
+
+    def shutdown(self, drain: bool = True) -> None:
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            self.stop_worker(rep, drain=drain)
+        self.reap()
+
+    def reap(self) -> None:
+        """Last-resort child reaper: kill any worker still alive. Safe
+        from signal handlers and atexit; never raises."""
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            h = rep._handle
+            if h is not None and h.proc.poll() is None:
+                _kill_proc(h.proc)
+
+    # -- observability -------------------------------------------------
+    def _note(self, rep: ProcessReplica, event: str, **info) -> None:
+        get_telemetry().record(
+            "replica", rid=rep.rid, event=event, pid=rep.pid,
+            incarnation=rep.incarnation, state=rep.state, **info)
+        get_metrics().set_gauge(
+            "lgbm_fleet_replica_state",
+            STATE_CODES.get(rep.state, -1),
+            labels={"rid": rep.rid})
+
+    def _trace_worker_request(self, rid: int, fut: ServingFuture,
+                              ctx) -> None:
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        req = fut._req
+
+        def emit():
+            req.event.wait(60.0)
+            end = req.t_perf_done or time.perf_counter()
+            meta = dict(req.meta)
+            tracer.emit_complete(
+                "worker.request", req.t_perf, end, cat="fleet",
+                ctx=ctx,
+                args={"replica": rid,
+                      "pid": meta.get("replica_pid"),
+                      "kind": req.kind,
+                      "queue_ms": meta.get("queue_ms"),
+                      "compute_ms": meta.get("compute_ms"),
+                      "error": meta.get("error")})
+
+        threading.Thread(target=emit, daemon=True,
+                         name=f"lgbm-worker{rid}-trace").start()
+
+
+class _HelloSlot:
+    """Rendezvous for one spawn's authenticated hello connection."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._conn: Optional[socket.socket] = None
+
+    def put(self, conn: socket.socket) -> None:
+        with self._cond:
+            self._conn = conn
+            self._cond.notify_all()
+
+    def wait(self, timeout_s: float) -> Optional[socket.socket]:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._conn is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._cond.wait(min(left, 0.2))
+            return self._conn
+
+
+def _classify_exit(code: int) -> str:
+    """Worker exit status -> a probe_taxonomy worker reason code."""
+    if code == 137 or code == -signal.SIGKILL:
+        return "oom_killed"
+    if code < 0:
+        return f"signal_{-code}"
+    return "crashed" if code else "exited"
+
+
+def _kill_proc(proc: subprocess.Popen, term_first: bool = False) -> None:
+    try:
+        if term_first:
+            proc.terminate()
+            try:
+                proc.wait(2.0)
+                return
+            except subprocess.TimeoutExpired:
+                pass
+        proc.kill()
+        proc.wait(5.0)
+    except (OSError, subprocess.TimeoutExpired):
+        pass

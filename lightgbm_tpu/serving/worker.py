@@ -1,0 +1,342 @@
+"""Process-fleet worker: one ServingEngine pool in its own process.
+
+``python -m lightgbm_tpu.serving.worker --connect HOST:PORT --rid K``
+is spawned by the :class:`~lightgbm_tpu.serving.procfleet.
+WorkerSupervisor`. The worker owns a full serving stack — its own JAX
+runtime, its own model registries and engines, its own flight
+recorder (dump path ``<crash_dump>.worker<rid>.json`` via the
+``LGBM_TPU_WORKER_RID`` env the supervisor sets) — and talks to the
+supervisor over one length-prefixed JSON socket:
+
+  supervisor -> worker: ``load_model`` / ``warm`` / ``submit`` /
+      ``ping`` / ``fault`` / ``drain`` / ``shutdown``
+  worker -> supervisor: ``hello`` / ``ack`` / ``result`` / ``error``
+      / ``pong`` / ``bye``
+
+The connect is retried with the bounded deterministic backoff from
+``robustness/retry.py`` (the socket-linker pattern). The persistent
+compile cache (``LGBM_TPU_COMPILE_CACHE``) is enabled before the
+first compile, so a respawned worker's warmup REPLAYS the bucket
+programs instead of recompiling them.
+
+Crash containment is the whole point: the worker honors the
+process-level fault kinds (``crash`` kills itself with a signal,
+``hang`` stops answering, ``oom`` exits with the OOM-kill status 137)
+and a worker death of ANY kind — fault-injected or real — is visible
+to the supervisor only as a dead process / stale heartbeat, exactly
+like a real device OOM or runtime abort would be. When the control
+socket reaches EOF (the supervisor died), the worker stops its
+engines and exits: workers can never outlive their supervisor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _connect(host: str, port: int, rid: int) -> socket.socket:
+    from ..robustness.retry import backoff_delays
+    delays = list(backoff_delays(attempts=8, base_delay_s=0.05,
+                                 max_delay_s=2.0,
+                                 desc=f"worker{rid} connect"))
+    last: Optional[OSError] = None
+    for i in range(len(delays) + 1):
+        try:
+            return socket.create_connection((host, port), timeout=10.0)
+        except OSError as e:
+            last = e
+            if i < len(delays):
+                time.sleep(delays[i])
+    raise last or OSError("connect failed")
+
+
+class _Worker:
+    def __init__(self, conn: socket.socket, rid: int):
+        from .procfleet import recv_frame, send_frame
+        self._recv_frame = recv_frame
+        self._send_frame = send_frame
+        self.conn = conn
+        self.rid = rid
+        self.wlock = threading.Lock()
+        self.engines: Dict[str, Any] = {}     # name -> ServingEngine
+        self.cfg = self._serving_config()
+        # (id, fut) pairs the completion thread resolves back over the
+        # socket as the engine fulfills them
+        self.outstanding: List[Tuple[int, Any]] = []
+        self.out_lock = threading.Lock()
+        self.out_event = threading.Event()
+        self.draining = False
+        threading.Thread(target=self._completion_loop, daemon=True,
+                         name="lgbm-worker-complete").start()
+
+    @staticmethod
+    def _serving_config():
+        from .engine import ServingConfig
+        raw = os.environ.get("LGBM_TPU_WORKER_CONFIG", "").strip()
+        if not raw:
+            return ServingConfig()
+        kw = json.loads(raw)
+        return ServingConfig(**kw)
+
+    def send(self, obj: Dict[str, Any]) -> None:
+        try:
+            self._send_frame(self.conn, obj, lock=self.wlock)
+        except OSError:
+            pass        # the supervisor is gone; the recv loop exits
+
+    # -- model lifecycle ----------------------------------------------
+    def _engine_for(self, name: str):
+        from .engine import ServingEngine
+        from .registry import ModelRegistry
+        eng = self.engines.get(name)
+        if eng is None:
+            eng = ServingEngine(config=self.cfg,
+                                registry=ModelRegistry())
+            self.engines[name] = eng
+        return eng
+
+    def _compiles(self) -> int:
+        from ..observability.telemetry import get_telemetry
+        return int(get_telemetry().counters.get("jit.compiles", 0))
+
+    def load_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        name = str(msg.get("name"))
+        source = msg.get("text") if msg.get("text") is not None \
+            else msg.get("path")
+        eng = self._engine_for(name)
+        before = self._compiles()
+        version = eng.load(source)
+        return {"ok": True, "version": version,
+                "compiles": self._compiles() - before}
+
+    def warm(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        names = msg.get("names") or sorted(self.engines)
+        before = self._compiles()
+        t0 = time.perf_counter()
+        for name in names:
+            eng = self.engines.get(name)
+            if eng is None:
+                continue
+            mv = eng.registry.current()
+            if mv is not None and self.cfg.warmup:
+                eng._warmup(mv)
+        return {"ok": True, "compiles": self._compiles() - before,
+                "dur_s": round(time.perf_counter() - t0, 4)}
+
+    # -- requests ------------------------------------------------------
+    def submit(self, msg: Dict[str, Any]) -> None:
+        import numpy as np
+
+        from .errors import ModelNotFoundError, ServingError
+        mid = int(msg.get("id", -1))
+        name = str(msg.get("model"))
+        try:
+            eng = self.engines.get(name)
+            if eng is None:
+                raise ModelNotFoundError(
+                    f"model {name!r} is not loaded on worker "
+                    f"{self.rid}", model=name)
+            rows = np.asarray(msg.get("rows"), np.float64)
+            fut = eng.submit(rows, str(msg.get("kind", "predict")),
+                             timeout_ms=msg.get("timeout_ms"))
+        except ServingError as e:
+            self.send({"type": "error", "id": mid, "code": e.code,
+                       "message": str(e), "details": e.details})
+            return
+        except Exception as e:  # noqa: BLE001 - wire it, don't die
+            self.send({"type": "error", "id": mid,
+                       "code": "serving_error", "message": str(e)})
+            return
+        with self.out_lock:
+            self.outstanding.append((mid, fut))
+        self.out_event.set()
+
+    def _completion_loop(self) -> None:
+        from .errors import ServingError
+        while True:
+            with self.out_lock:
+                items = list(self.outstanding)
+            if not items:
+                self.out_event.wait(0.05)
+                self.out_event.clear()
+                continue
+            done: List[Tuple[int, Any]] = []
+            for mid, fut in items:
+                if fut.done():
+                    done.append((mid, fut))
+            if not done:
+                time.sleep(0.001)
+                continue
+            with self.out_lock:
+                self.outstanding = [p for p in self.outstanding
+                                    if p not in done]
+            for mid, fut in done:
+                try:
+                    out = fut.result(timeout=0)
+                    self.send({"type": "result", "id": mid,
+                               "result": out.tolist(),
+                               "meta": _jsonable_meta(fut.meta)})
+                except ServingError as e:
+                    self.send({"type": "error", "id": mid,
+                               "code": e.code, "message": str(e),
+                               "details": _jsonable_meta(e.details)})
+                except Exception as e:  # noqa: BLE001
+                    self.send({"type": "error", "id": mid,
+                               "code": "serving_error",
+                               "message": str(e)})
+
+    def pong(self, msg: Dict[str, Any]) -> None:
+        from ..utils.compile_cache import maybe_enable_compile_cache
+        stats = {"models": {}, "jit_compiles": self._compiles(),
+                 # idempotent: reports the armed cache dir (or None)
+                 "compile_cache": maybe_enable_compile_cache()}
+        load = 0
+        for name, eng in self.engines.items():
+            s = eng.stats()
+            stats["models"][name] = {
+                k: v for k, v in s.items()
+                if isinstance(v, (int, float)) and not isinstance(
+                    v, bool)}
+            load += eng.queue_depth
+        with self.out_lock:
+            load += len(self.outstanding)
+        self.send({"type": "pong", "t": msg.get("t"), "load": load,
+                   "stats": stats})
+
+    # -- faults --------------------------------------------------------
+    def fault(self, msg: Dict[str, Any]) -> None:
+        kind = str(msg.get("kind"))
+        from ..utils.log import log_warning
+        log_warning(f"worker {self.rid}: honoring injected fault "
+                    f"{kind!r}")
+        if kind == "crash":
+            os.kill(os.getpid(), int(msg.get("signal", 9)))
+        elif kind == "hang":
+            # sleeping the RECEIVE loop is the hang: pings pile up
+            # unanswered and the supervisor's heartbeat timeout fires
+            time.sleep(float(msg.get("ms", 0)) / 1000.0)
+        elif kind == "oom":
+            os._exit(137)   # the kernel OOM reaper's signature status
+
+    # -- teardown ------------------------------------------------------
+    def drain(self) -> None:
+        self.draining = True
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with self.out_lock:
+                if not self.outstanding:
+                    break
+            time.sleep(0.01)
+        for eng in self.engines.values():
+            eng.stop(drain=True)
+        self.send({"type": "bye", "rid": self.rid})
+
+    def shutdown(self, drain: bool = False) -> None:
+        for eng in self.engines.values():
+            try:
+                eng.stop(drain=drain)
+            except Exception:  # noqa: BLE001 - exiting anyway
+                pass
+
+    # -- main loop -----------------------------------------------------
+    def run(self) -> int:
+        while True:
+            try:
+                msg = self._recv_frame(self.conn)
+            except (OSError, ValueError):
+                msg = None
+            if msg is None:
+                # supervisor gone (EOF/reset): stop and exit — a
+                # worker never outlives its supervisor (no orphans)
+                self.shutdown(drain=False)
+                return 0
+            t = msg.get("type")
+            if t == "submit":
+                self.submit(msg)
+            elif t == "ping":
+                self.pong(msg)
+            elif t in ("load_model", "warm"):
+                try:
+                    ack = self.load_model(msg) if t == "load_model" \
+                        else self.warm(msg)
+                except Exception as e:  # noqa: BLE001 - wire it
+                    ack = {"ok": False, "message": str(e)[:500]}
+                ack.update(type="ack", id=msg.get("id"))
+                self.send(ack)
+            elif t == "fault":
+                self.fault(msg)
+            elif t == "drain":
+                self.drain()
+                return 0
+            elif t == "shutdown":
+                self.shutdown()
+                return 0
+
+
+def _jsonable_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in (meta or {}).items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--connect", required=True,
+                    help="supervisor listener host:port")
+    ap.add_argument("--rid", type=int, required=True)
+    args = ap.parse_args(argv)
+    host, _, port = args.connect.rpartition(":")
+
+    os.environ.setdefault("LGBM_TPU_WORKER_RID", str(args.rid))
+    conn = _connect(host or "127.0.0.1", int(port), args.rid)
+    conn.settimeout(None)
+
+    # authenticate FIRST (the supervisor's spawn timeout is ticking),
+    # then bring the serving stack up
+    from .procfleet import send_frame
+    send_frame(conn, {"type": "hello", "rid": args.rid,
+                      "pid": os.getpid(),
+                      "token": os.environ.get("LGBM_TPU_WORKER_TOKEN",
+                                              "")})
+
+    from ..observability.flightrec import arm_recorder, dump_exception
+    from ..observability.telemetry import get_telemetry
+    from ..utils.compile_cache import maybe_enable_compile_cache
+    get_telemetry().ensure_started()
+    get_telemetry().ensure_ring()
+    maybe_enable_compile_cache()
+    arm_recorder()           # own black box at <dump>.worker<rid>.json
+
+    # SIGTERM = the supervisor's graceful stop path racing a socket
+    # drain; treat it as "stop now, cleanly"
+    worker = _Worker(conn, args.rid)
+
+    def _term(signum, frame):
+        worker.shutdown(drain=False)
+        os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except (ValueError, OSError):
+        pass
+
+    try:
+        return worker.run()
+    except BaseException as e:  # noqa: BLE001 - last words, then die
+        dump_exception(e if isinstance(e, Exception)
+                       else RuntimeError(repr(e)))
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

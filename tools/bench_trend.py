@@ -211,6 +211,31 @@ def _dispatch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     return found
 
 
+def _fleet_isolation_point(lines: List[Dict]
+                           ) -> Optional[Dict[str, Any]]:
+    """The round's process-isolation p99 (bench.py
+    measure_fleet_isolation): the process-mode fleet soak p99, keyed
+    by the measurement shape, with the thread-mode p99 and the
+    restart-to-ready latency carried alongside. Higher is worse."""
+    found = None
+    for ln in lines:
+        fi = ln.get("fleet_isolation")
+        if not isinstance(fi, dict) or fi.get("process_p99_ms") is None:
+            continue
+        key = json.dumps({
+            "backend": ln.get("backend"),
+            "replicas": fi.get("replicas"),
+            "buckets": fi.get("buckets"),
+            "qps": fi.get("offered_qps"),
+        }, sort_keys=True)
+        found = {"value": float(fi["process_p99_ms"]), "key": key,
+                 "thread_p99_ms": fi.get("thread_p99_ms"),
+                 "restart_ready_ms": fi.get("restart_ready_ms"),
+                 "process_overhead_pct": fi.get(
+                     "process_overhead_pct")}
+    return found
+
+
 def _mesh_scaling_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     """The round's mesh-scaling number (bench.py
     run_mesh_scaling_block): total ms/split across the mesh learner
@@ -305,7 +330,7 @@ def _gate(series: List[Tuple[str, Dict]], higher_is_better: bool,
 def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
     fixed, serving, headline, dispatch, fleet = [], [], [], [], []
-    fused, mesh = [], []
+    fused, mesh, fleet_iso = [], [], []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -328,6 +353,9 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _mesh_scaling_point(rnd["lines"])
         if p is not None:
             mesh.append((rnd["label"], p))
+        p = _fleet_isolation_point(rnd["lines"])
+        if p is not None:
+            fleet_iso.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
@@ -336,6 +364,8 @@ def analyze(rounds: List[Dict[str, Any]],
     regressions += _gate(fleet, False, threshold, "fleet_p99_ms")
     regressions += _gate(fused, False, threshold, "fused_split_ms")
     regressions += _gate(mesh, False, threshold, "mesh_scaling_ms")
+    regressions += _gate(fleet_iso, False, threshold,
+                         "fleet_isolation_p99_ms")
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -357,6 +387,8 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in fused],
             "mesh_scaling_ms": [
                 {"round": lb, **pt} for lb, pt in mesh],
+            "fleet_isolation_p99_ms": [
+                {"round": lb, **pt} for lb, pt in fleet_iso],
             DISPATCH_METRIC: [
                 {"round": lb, **pt} for lb, pt in dispatch],
             # informational only — config drifts across rounds
@@ -368,6 +400,7 @@ def analyze(rounds: List[Dict[str, Any]],
                          "fleet_p99_ms": len(fleet),
                          "fused_split_ms": len(fused),
                          "mesh_scaling_ms": len(mesh),
+                         "fleet_isolation_p99_ms": len(fleet_iso),
                          DISPATCH_METRIC: len(dispatch)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",

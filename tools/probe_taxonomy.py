@@ -28,6 +28,48 @@ from __future__ import annotations
 REASON_CODES = ("no_device", "init_timeout", "not_lowerable",
                 "compile_error", "transport", "unknown")
 
+# process-fleet worker lifecycle codes (serving/procfleet.py): the
+# supervisor classifies every worker death into this vocabulary so the
+# run_report replica timeline and the chaos-soak artifacts are
+# trendable the same way the TPU probe's failures are
+WORKER_REASON_CODES = ("spawn_failed", "heartbeat_lost", "oom_killed",
+                       "respawn_exhausted", "socket_lost",
+                       "load_failed", "crashed", "exited")
+
+_WORKER_SIGNATURES = (
+    (("never said hello", "spawn failed", "worker spawn"),
+     "spawn_failed"),
+    (("no frame from", "heartbeat", "went quiet"), "heartbeat_lost"),
+    (("exited with 137", "exited with -9", "oom", "out of memory",
+      "resource_exhausted"), "oom_killed"),
+    (("quarantin", "respawn budget", "restart budget",
+      "respawn_exhausted"), "respawn_exhausted"),
+    (("socket failed", "broken pipe", "connection reset",
+      "socket_lost"), "socket_lost"),
+)
+
+
+def classify_worker_failure(detail: str,
+                            exit_code=None) -> str:
+    """Worker death evidence -> one of :data:`WORKER_REASON_CODES`.
+
+    ``exit_code`` (Popen returncode) wins when decisive: 137 and
+    SIGKILL are the OOM reaper's signature, any other signal is a
+    crash. Free-text evidence (supervisor log detail, spawn errors)
+    falls back to signature matching.
+    """
+    if exit_code is not None:
+        code = int(exit_code)
+        if code == 137 or code == -9:
+            return "oom_killed"
+        if code < 0 or code > 0:
+            return "crashed"
+    d = (detail or "").lower()
+    for needles, code in _WORKER_SIGNATURES:
+        if any(n in d for n in needles):
+            return code
+    return "crashed" if d.strip() else "exited"
+
 # signature -> code, checked in order: the FIRST match wins, so the
 # more specific transport/compile signatures are tested before the
 # broad device-assert one

@@ -147,6 +147,27 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "dur_s": r.get("dur_s"),
             "wall_time": r.get("wall_time")})
 
+    # replica lifecycle timeline (serving/procfleet.py + fleet.py):
+    # every spawn/ready/death/respawn/quarantine event in the trace,
+    # with the worker reason codes (tools/probe_taxonomy.py
+    # WORKER_REASON_CODES) — the same diagnosability treatment the
+    # TPU probe history gets below
+    replica_timeline = []
+    for r in records:
+        if r.get("kind") != "replica":
+            continue
+        replica_timeline.append({
+            "t": r.get("t"),
+            "rid": r.get("rid"),
+            "event": r.get("event"),
+            "state": r.get("state"),
+            "pid": r.get("pid"),
+            "incarnation": r.get("incarnation"),
+            "reason_code": r.get("reason_code"),
+            "ready_ms": r.get("ready_ms"),
+            "restarts": r.get("restarts"),
+            "detail": str(r.get("detail", ""))[:80]})
+
     counters_all = end.get("counters") or {}
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
@@ -169,6 +190,7 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "robustness": robustness,
         "comms": comms,
         "ingest": ingest,
+        "replica_timeline": replica_timeline,
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
         "serving": serving,
@@ -390,6 +412,38 @@ def render(records: List[Dict[str, Any]]) -> str:
                  f"parity_ok={f.get('shadow_parity_ok', 0)} "
                  f"mismatch={f.get('shadow_parity_mismatch', 0)} "
                  f"skipped={f.get('shadow_skipped', 0)}")
+        if f.get("replica_restarts") or f.get("replica_quarantines"):
+            L.append(f"isolation: restarts="
+                     f"{f.get('replica_restarts', 0)} "
+                     f"quarantines={f.get('replica_quarantines', 0)}")
+
+    tl = d.get("replica_timeline") or []
+    if tl:
+        L.append("")
+        L.append("== replica lifecycle (serving/procfleet.py) ==")
+        L.append(f"{'t':>9} {'rid':>4} {'event':<12}{'state':<12}"
+                 f"{'inc':>4} {'reason_code':<18}detail")
+        for e in tl:
+            t = e.get("t")
+            extra = e.get("detail") or ""
+            if e.get("ready_ms") is not None:
+                extra = f"ready_ms={e['ready_ms']} {extra}".strip()
+            L.append(f"{t if t is not None else '-':>9} "
+                     f"{str(e.get('rid')):>4} "
+                     f"{str(e.get('event')):<12}"
+                     f"{str(e.get('state')):<12}"
+                     f"{str(e.get('incarnation') or '-'):>4} "
+                     f"{str(e.get('reason_code') or '-'):<18}"
+                     f"{extra[:50]}")
+        codes: Dict[str, int] = {}
+        for e in tl:
+            if e.get("reason_code"):
+                codes[e["reason_code"]] = \
+                    codes.get(e["reason_code"], 0) + 1
+        if codes:
+            L.append("death modes: " + " ".join(
+                f"{k}={v}" for k, v in sorted(codes.items(),
+                                              key=lambda kv: -kv[1])))
 
     if d.get("hists"):
         L.append("")
@@ -672,6 +726,18 @@ def render_crash(d: Dict[str, Any]) -> str:
             desc = " ".join(f"{k}={v}" for k, v in sorted(t.items())
                             if k != "wall_time")
             L.append(f"  {desc}")
+    workers = d.get("worker_dumps") or []
+    if workers:
+        L.append("")
+        L.append("== collected worker dumps (process fleet) ==")
+        for w in workers:
+            dump = w.get("dump") or {}
+            L.append(f"  rid={w.get('rid')} "
+                     f"reason={w.get('reason_code')} "
+                     f"inc={w.get('incarnation')} "
+                     f"dump={'yes (' + str(dump.get('reason')) + ')' if dump else 'none'}"
+                     + (f" path={w.get('dump_path')}"
+                        if w.get("dump_path") else ""))
     spans = d.get("trace_spans") or []
     if spans:
         L.append("")

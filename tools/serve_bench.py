@@ -20,6 +20,16 @@ Usage:
         --faults 'fail_read@times=3,match=serve_bench_model' \
         --quota-tenants 'burst_tenant=20' \
         --assert-availability 1.0 --json soak.json
+    # combined pipeline + kill-storm chaos drill on a PROCESS-mode
+    # fleet (CI chaos-soak job; serving/procfleet.py): crash/oom/hang
+    # storms against supervised worker processes, concurrently with a
+    # refit-and-promote loop on the same fleet — gated on
+    # availability 1.0 AND a promoted model byte-identical to the
+    # fault-free run:
+    python tools/serve_bench.py --mode soak --isolation process \
+        --replicas 3 --duration 75 --qps 40 --device never \
+        --kill-storm-every 12 --pipeline-cycles 1 \
+        --assert-availability 1.0 --assert-promote-parity
 
 Without ``--model`` a small binary booster is trained in-process (the
 CI smoke path); ``--fleet`` without ``--model`` trains TWO variants
@@ -59,8 +69,9 @@ def _build_fleet(args, workdir):
     """FleetEngine + row pool + reload sources for the soak."""
     import numpy as np
 
-    from lightgbm_tpu.serving import (FleetEngine, Router,
-                                      ServingConfig, TenantQuotas)
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      Router, ServingConfig,
+                                      TenantQuotas)
     from lightgbm_tpu.serving.tenants import parse_tenant_specs
     models = {}
     if args.model:
@@ -85,7 +96,10 @@ def _build_fleet(args, workdir):
     cfg = ServingConfig(buckets=args.buckets, device=args.device)
     fleet = FleetEngine(models=models, config=cfg,
                         replicas=args.replicas, router=router,
-                        quotas=quotas, default_model="base")
+                        quotas=quotas, default_model="base",
+                        isolation=args.isolation,
+                        proc_opts=ProcFleetOptions(
+                            restart_max=args.replica_restart_max))
     # reload storms re-read the models from disk, through the
     # registry's guarded (fault-injectable) file reads
     reload_sources = {}
@@ -98,7 +112,95 @@ def _build_fleet(args, workdir):
             else:
                 src.save_model(path)
             reload_sources[name] = path
-    return fleet, X, reload_sources
+    return fleet, X, reload_sources, models
+
+
+# ----------------------------------------------------------------------
+# combined pipeline + chaos drill (ROADMAP item 4b acceptance): the
+# refit-and-promote loop runs against the SAME fleet the kill storm is
+# tearing at, and the promoted model must be byte-identical to the
+# fault-free run — chaos may never leak into training outcomes.
+def _pipeline_reference(base_text, n_features, cycles, seed,
+                        window_rows, holdout_rows, decay):
+    """The fault-free run's promoted model texts: the replay stream is
+    a pure function of (seed, index), so the exact per-cycle refit is
+    re-derivable out of band (same derivation tools/pipeline_drill.py
+    uses for its byte-stable gate)."""
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.pipeline import ReplayLogSource
+    replay = ReplayLogSource(n_features=n_features, seed=seed,
+                             task="binary")
+    texts = []
+    cur = base_text
+    for _ in range(cycles):
+        win = replay.next_window(window_rows)
+        replay.next_window(holdout_rows)     # the driver's holdout
+        direct = Booster(model_str=cur).refit(win.X, win.y,
+                                              decay_rate=decay)
+        cur = direct.model_to_string()
+        texts.append(cur)
+    return texts
+
+
+def _start_pipeline(args, fleet, workdir):
+    """Spin the refit-and-promote loop on its own thread against the
+    soak fleet; returns (thread, holder) — holder['summary'] lands
+    when the loop finishes."""
+    import threading
+
+    from lightgbm_tpu.pipeline import PipelineDriver
+    base_path = os.path.join(workdir, "serve_bench_pipeline_base.txt")
+    mv = fleet.fleet.current("base")
+    base_text = mv.booster.model_to_string() if mv.booster is not None \
+        else open(args.model).read()
+    with open(base_path, "w") as fh:
+        fh.write(base_text)
+    driver = PipelineDriver({
+        "task": "pipeline", "input_model": base_path,
+        "verbosity": -1,
+        "refit_decay_rate": 0.2,
+        "pipeline_window_rows": 384,
+        "pipeline_holdout_rows": 192,
+        "pipeline_stage_requests": 16,
+        "pipeline_canary_stages": "0.25,0.5",
+        "pipeline_latency_slo_pct": 10000,   # chaos gates AVAILABILITY
+        "pipeline_dir": os.path.join(workdir, "cands"),
+        "pipeline_replay_seed": 5,
+    }, fleet=fleet)
+    holder = {"driver": driver, "base_text": base_text}
+
+    def run():
+        holder["summary"] = driver.run(
+            max_cycles=args.pipeline_cycles, stop_fleet=False)
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name="lgbm-soak-pipeline")
+    thread.start()
+    return thread, holder
+
+
+def _pipeline_verdict(args, holder):
+    """Fold the loop's outcome + the byte-parity gate into one block."""
+    summary = holder.get("summary") or {}
+    driver = holder["driver"]
+    promoted = [c for c in driver.publisher.history
+                if c.status == "promoted"]
+    refs = _pipeline_reference(
+        holder["base_text"], driver.n_features, len(promoted), seed=5,
+        window_rows=384, holdout_rows=192, decay=0.2)
+    parity = len(promoted) == args.pipeline_cycles and all(
+        c.model_text == ref for c, ref in zip(promoted, refs))
+    return {
+        "cycles": summary.get("cycles"),
+        "promoted": summary.get("promoted"),
+        "rolled_back": summary.get("rolled_back"),
+        "stage_history": [
+            {"cycle": rec.get("cycle"), "status": rec.get("status"),
+             "reason": rec.get("reason"),
+             "stages": rec.get("stages")}
+            for rec in summary.get("history") or []],
+        "promote_parity": bool(parity),
+    }
 
 
 def _http_probe(engine, X, n: int = 3):
@@ -188,6 +290,26 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="serve through a FleetEngine replica pool")
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--isolation", default="thread",
+                    choices=["thread", "process"],
+                    help="replica isolation: process = one supervised "
+                         "worker OS process per replica "
+                         "(serving/procfleet.py)")
+    ap.add_argument("--replica-restart-max", type=int, default=5,
+                    help="respawns before a flapping process replica "
+                         "is quarantined")
+    ap.add_argument("--kill-storm-every", type=float, default=0.0,
+                    help="seconds between process-fault storm cycles "
+                         "(crash/oom/hang rotation on one live "
+                         "replica; soak)")
+    ap.add_argument("--pipeline-cycles", type=int, default=0,
+                    help="run this many refit-and-promote cycles "
+                         "(task=pipeline) against the soak fleet, "
+                         "CONCURRENTLY with the chaos storms")
+    ap.add_argument("--assert-promote-parity", action="store_true",
+                    help="exit 1 unless every pipeline cycle promoted "
+                         "a model byte-identical to the fault-free "
+                         "run")
     ap.add_argument("--reload-every", type=float, default=0.0,
                     help="seconds between reload-storm cycles (soak)")
     ap.add_argument("--replica-storm-every", type=float, default=0.0,
@@ -238,14 +360,20 @@ def main(argv=None) -> int:
 
     if fleet_mode:
         os.makedirs(args.workdir, exist_ok=True)
-        engine, X, reload_sources = _build_fleet(args, args.workdir)
+        engine, X, reload_sources, _models = _build_fleet(
+            args, args.workdir)
         result["metric"] = "fleet_serving"
+        result["isolation"] = args.isolation
         state = {"preempted": False}
         _arm_sigterm(engine, state)
         tenants = [t for t in args.tenants.split(",") if t] or None
         models = engine.fleet.names()
         if tracer_on:
             result["http_traced_requests"] = _http_probe(engine, X)
+        pipe_thread = pipe_holder = None
+        if args.pipeline_cycles > 0:
+            pipe_thread, pipe_holder = _start_pipeline(
+                args, engine, args.workdir)
         block = soak_loop(
             engine, X, duration_s=args.duration, qps=args.qps,
             batch_sizes=batch_sizes, models=models, tenants=tenants,
@@ -253,7 +381,11 @@ def main(argv=None) -> int:
             reload_every_s=args.reload_every,
             reload_sources=reload_sources,
             replica_storm_every_s=args.replica_storm_every,
+            kill_storm_every_s=args.kill_storm_every,
             fault_spec=args.faults)
+        if pipe_thread is not None:
+            pipe_thread.join(120.0)
+            result["pipeline"] = _pipeline_verdict(args, pipe_holder)
         block["preempted"] = state["preempted"]
         block["backend"] = result["backend"]
         result["fleet"] = block
@@ -336,6 +468,13 @@ def main(argv=None) -> int:
                 f"--assert-availability {args.assert_availability} "
                 f"gate ({head.get('non_shed_errors')} non-shed "
                 "errors)\n")
+            return 1
+    if fleet_mode and args.assert_promote_parity:
+        pv = result.get("pipeline") or {}
+        if not pv.get("promote_parity"):
+            sys.stderr.write(
+                "serve_bench: promoted model NOT byte-identical to "
+                f"the fault-free run (pipeline block: {pv})\n")
             return 1
     return 0
 
