@@ -456,13 +456,17 @@ class FleetEngine:
         pin = self.config.device != "never" \
             and self._proc_supervisor is None
         try:
+            mv = self.fleet.load(name, source, pin_device=pin)
             if self._proc_supervisor is not None:
                 # workers own the device arrays and the warmup; the
                 # parent registry holds the metadata (names, versions,
-                # health) and the replayable source for respawns
+                # health) and the replayable source for respawns.
+                # Record the replay source only AFTER the parent
+                # registry validated the publish: a rejected publish
+                # must never poison the respawn replay state (or every
+                # later worker death would replay the bad source and
+                # quarantine the replica)
                 self._proc_supervisor.set_model_source(name, source)
-            mv = self.fleet.load(name, source, pin_device=pin)
-            if self._proc_supervisor is not None:
                 self._proc_supervisor.broadcast_model(name)
             else:
                 rep = self._pick_replica(allow_none=True)

@@ -170,6 +170,12 @@ class _WorkerHandle:
         self.closed = False
         self.worker_stats: Dict[str, Any] = {}
         self.worker_load = 0
+        # control round-trips outstanding (load_model / warm): the
+        # worker's control loop is single-threaded, so a long compile
+        # legitimately silences it — the monitor must not read that
+        # silence as heartbeat_lost (request_sync's own timeout owns
+        # liveness while this is nonzero)
+        self.control_inflight = 0
         self._acks: Dict[int, Dict[str, Any]] = {}
         self._ack_cond = threading.Condition()
         self._recv_thread = threading.Thread(
@@ -215,23 +221,29 @@ class _WorkerHandle:
         """A control round trip (load_model / warm): send, await ack."""
         mid = self._new_id()
         frame = dict(frame, id=mid)
+        with self.plock:
+            self.control_inflight += 1
         try:
-            send_frame(self.conn, frame, lock=self.wlock)
-        except OSError as e:
-            raise EngineStoppedError(
-                f"replica {self.rid} worker socket failed: {e}",
-                replica=self.rid) from e
-        deadline = time.monotonic() + timeout_s
-        with self._ack_cond:
-            while mid not in self._acks:
-                left = deadline - time.monotonic()
-                if left <= 0 or self.closed:
-                    raise EngineStoppedError(
-                        f"replica {self.rid} worker did not ack "
-                        f"{frame['type']} within {timeout_s}s",
-                        replica=self.rid)
-                self._ack_cond.wait(min(left, 0.2))
-            return self._acks.pop(mid)
+            try:
+                send_frame(self.conn, frame, lock=self.wlock)
+            except OSError as e:
+                raise EngineStoppedError(
+                    f"replica {self.rid} worker socket failed: {e}",
+                    replica=self.rid) from e
+            deadline = time.monotonic() + timeout_s
+            with self._ack_cond:
+                while mid not in self._acks:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or self.closed:
+                        raise EngineStoppedError(
+                            f"replica {self.rid} worker did not ack "
+                            f"{frame['type']} within {timeout_s}s",
+                            replica=self.rid)
+                    self._ack_cond.wait(min(left, 0.2))
+                return self._acks.pop(mid)
+        finally:
+            with self.plock:
+                self.control_inflight -= 1
 
     def send(self, frame: Dict[str, Any]) -> bool:
         try:
@@ -557,18 +569,28 @@ class WorkerSupervisor:
         rep.incarnation += 1
         handle = _WorkerHandle(proc, conn, rep.rid, rep.incarnation)
         rep._handle = handle
-        # replay the fleet's published model state, then warm: with
-        # the persistent compile cache shared across incarnations the
-        # respawned worker replays the bucket programs instead of
-        # recompiling them (cold_start_compiles records what it paid)
-        for name, frame in list(self._model_state.items()):
-            ack = handle.request_sync(dict(frame),
-                                      self.opts.spawn_timeout_s)
-            if not ack.get("ok"):
-                raise ServingError(
-                    f"replica {rep.rid} worker failed to load "
-                    f"{name!r}: {ack.get('message')}")
-        rep.warm()
+        try:
+            # replay the fleet's published model state, then warm:
+            # with the persistent compile cache shared across
+            # incarnations the respawned worker replays the bucket
+            # programs instead of recompiling them
+            # (cold_start_compiles records what it paid)
+            for name, frame in list(self._model_state.items()):
+                ack = handle.request_sync(dict(frame),
+                                          self.opts.spawn_timeout_s)
+                if not ack.get("ok"):
+                    raise ServingError(
+                        f"replica {rep.rid} worker failed to load "
+                        f"{name!r}: {ack.get('message')}")
+            rep.warm()
+        except BaseException:
+            # a failed replay/warm must not leak a live worker: the
+            # next respawn would overwrite rep._handle and make this
+            # incarnation invisible to reap()/shutdown
+            rep._handle = None
+            handle.close()
+            _kill_proc(proc)
+            raise
         rep.state = "ok"
         ready_ms = round((time.perf_counter() - t0) * 1000.0, 3)
         rep.restart_ready_ms = ready_ms
@@ -599,6 +621,16 @@ class WorkerSupervisor:
             t.join(self.opts.spawn_timeout_s + 10.0)
         if errs:
             raise errs[0]
+        # a spawn thread that outlived its join timeout (or finished
+        # without bringing the replica to "ok") must be a loud failure:
+        # proceeding would hand the fleet replicas in an indeterminate,
+        # possibly never-ready state
+        stuck = [r.rid for r, t in zip(reps, threads)
+                 if t.is_alive() or r.state != "ok"]
+        if stuck:
+            raise ServingError(
+                f"replica spawn did not complete for rid(s) {stuck} "
+                f"within {self.opts.spawn_timeout_s + 10.0:.0f}s")
 
     def _accept_loop(self) -> None:
         while not self._stopping:
@@ -688,6 +720,16 @@ class WorkerSupervisor:
                     self._declare_death(
                         rep, _classify_exit(code),
                         f"worker pid {h.pid} exited with {code}")
+                    continue
+                if h.control_inflight > 0:
+                    # a load_model/warm round-trip is outstanding: the
+                    # worker's single-threaded control loop cannot
+                    # answer pings while it compiles, and killing a
+                    # healthy worker mid-publish would turn every slow
+                    # hot-reload into a respawn storm. request_sync's
+                    # own timeout (and broadcast_model's death path)
+                    # covers a worker that truly hangs here.
+                    h.last_seen = now
                     continue
                 if (now - h.last_seen) * 1000.0 \
                         > self.opts.heartbeat_timeout_ms:

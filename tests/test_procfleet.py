@@ -373,6 +373,38 @@ def test_process_fleet_fault_grammar_honored(proc_fleet):
 
 
 @pytest.mark.slow
+def test_rejected_publish_keeps_respawn_state_clean(proc_fleet):
+    """A rejected publish (torn/invalid model) must keep previous
+    versions serving AND leave the supervisor's respawn replay state
+    on the last good source: a worker that dies AFTER the rejection
+    replays the good model and comes back ok. (Regression: the replay
+    frame used to be recorded before validation, so every respawn
+    replayed the bad source until the replica was quarantined.)"""
+    fl, alpha, beta, X = proc_fleet
+    assert _wait(lambda: all(r.state == "ok" for r in fl.replicas), 40)
+    sup = fl._proc_supervisor
+    good = dict(sup._model_state["beta"])
+    with pytest.raises(Exception):
+        fl.reload("/no/such/model.txt", model="beta")
+    assert fl._last_reload_error is not None
+    assert sup._model_state["beta"] == good, \
+        "rejected publish poisoned the respawn replay state"
+    ref = _published_ref(beta, X)
+    np.testing.assert_array_equal(
+        fl.predict(X[:4], model="beta"), ref[:4])
+    # a death after the rejection heals: the respawn replays the GOOD
+    # state (the old bug spawn-failed on replay, every time)
+    victim = fl.replicas[0]
+    inc0 = victim.incarnation
+    os.kill(victim.pid, signal.SIGKILL)
+    assert _wait(lambda: victim.state == "ok"
+                 and victim.incarnation > inc0, 40), \
+        f"state={victim.state} last_death={victim.last_death}"
+    np.testing.assert_array_equal(
+        fl.predict(X[:4], model="beta"), ref[:4])
+
+
+@pytest.mark.slow
 def test_warm_respawn_zero_compiles_cache_armed(tmp_path,
                                                 monkeypatch):
     """The acceptance bar for respawn cost: a respawned worker warms
