@@ -279,6 +279,17 @@ def measure():
                 booster, X[:2048])
         except Exception as e:  # noqa: BLE001
             result["fleet_isolation_error"] = str(e)[:200]
+    if os.environ.get("BENCH_OBS_OVERHEAD", "1") != "0":
+        # the observability plane's serving cost: process-fleet p99
+        # with metrics federation on vs off (identical pool/load both
+        # ways, so the delta IS the piggyback bill: delta building in
+        # the worker pong + merge on the parent). Must stay within
+        # trend-gate noise — a tracked series from day one.
+        try:
+            result["obs_overhead"] = measure_obs_overhead(
+                booster, X[:2048])
+        except Exception as e:  # noqa: BLE001
+            result["obs_overhead_error"] = str(e)[:200]
     tel.flush()
     print(json.dumps(result))
 
@@ -328,6 +339,56 @@ def measure_fleet_isolation(booster, X):
     if out.get("thread_p99_ms") and out.get("process_p99_ms"):
         out["process_overhead_pct"] = round(
             100.0 * (out["process_p99_ms"] / out["thread_p99_ms"]
+                     - 1.0), 1)
+    return out
+
+
+def measure_obs_overhead(booster, X):
+    """Serving p99 with metrics federation on vs off (ISSUE 16
+    satellite): same process-mode pool and offered load both ways,
+    the only difference is ProcFleetOptions.federation (worker-side
+    delta building + parent-side merge_snapshot on every heartbeat).
+    Also records how many federated series the parent scrape held at
+    the end of the ON run — zero series would mean the overhead
+    number measured nothing."""
+    import os
+
+    from lightgbm_tpu.observability.metrics import get_metrics
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      ServingConfig)
+    from lightgbm_tpu.serving.loadgen import soak_loop
+    dur = float(os.environ.get("BENCH_OBS_OVERHEAD_S", 2))
+    qps = float(os.environ.get("BENCH_OBS_OVERHEAD_QPS", 120))
+    cfg = ServingConfig(buckets=(1, 64), device="never",
+                        flush_interval_ms=1.0)
+    out = {"duration_s": dur, "offered_qps": qps, "replicas": 2,
+           "heartbeat_ms": 50.0}
+    for fed in (True, False):
+        key = "fed_on" if fed else "fed_off"
+        fl = FleetEngine(models={"base": booster}, config=cfg,
+                         replicas=2, default_model="base",
+                         isolation="process",
+                         proc_opts=ProcFleetOptions(
+                             restart_max=3, heartbeat_ms=50.0,
+                             federation=fed))
+        try:
+            blk = soak_loop(fl, X, duration_s=dur, qps=qps,
+                            batch_sizes=(1, 8), models=["base"],
+                            timeout_ms=20000)
+            out[f"{key}_p50_ms"] = blk["p50_ms"]
+            out[f"{key}_p99_ms"] = blk["p99_ms"]
+            out[f"{key}_throughput_rps"] = blk["throughput_rps"]
+            if fed:
+                out["federated_series"] = sum(
+                    w.get("series", 0) for w in
+                    get_metrics().federation_workers())
+        finally:
+            fl.stop()
+            for w in get_metrics().federation_workers():
+                get_metrics().drop_worker(w["worker"])
+    if out.get("fed_off_p99_ms") and out.get("fed_on_p99_ms"):
+        out["federation_overhead_pct"] = round(
+            100.0 * (out["fed_on_p99_ms"] / out["fed_off_p99_ms"]
                      - 1.0), 1)
     return out
 

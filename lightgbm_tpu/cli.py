@@ -122,7 +122,9 @@ def run_train(params: Dict[str, str]) -> None:
     get_telemetry().ensure_started(cfg)
     # live metrics plane: metrics_port=<p> / LGBM_TPU_METRICS_PORT
     # serves GET /metrics (Prometheus text) for the whole run
-    from .observability.metrics import maybe_start_exporter
+    from .observability.metrics import maybe_configure, \
+        maybe_start_exporter
+    maybe_configure(cfg)
     maybe_start_exporter(cfg)
     if cfg.machines or cfg.machine_list_filename:
         from .parallel.distributed import init_distributed
@@ -258,7 +260,9 @@ def run_serve(params: Dict[str, str]) -> None:
     get_telemetry().ensure_started(cfg)
     # the frontend serves /metrics on its own port; metrics_port
     # additionally exports on a dedicated port when configured
-    from .observability.metrics import maybe_start_exporter
+    from .observability.metrics import maybe_configure, \
+        maybe_start_exporter
+    maybe_configure(cfg)
     maybe_start_exporter(cfg)
     # zero-compile cold start: with compile_cache_dir (or
     # LGBM_TPU_COMPILE_CACHE) pointing at a warm persistent cache,
@@ -278,7 +282,17 @@ def run_serve(params: Dict[str, str]) -> None:
         booster = Booster(model_file=cfg.input_model)
         engine = ServingEngine(booster,
                                config=ServingConfig.from_config(cfg))
-    serve_forever(engine, cfg.serving_host, int(cfg.serving_port))
+    # SLO burn-rate engine (observability/slo.py): evaluates the
+    # configured objectives over the merged (local + federated)
+    # metrics for the lifetime of the serve loop; GET /slo and the
+    # lgbm_slo_burn gauges expose the evaluations
+    from .observability.slo import engine_from_config
+    slo = engine_from_config(
+        cfg, counts_fn=getattr(engine, "slo_counts", None)).start()
+    try:
+        serve_forever(engine, cfg.serving_host, int(cfg.serving_port))
+    finally:
+        slo.stop()
 
 
 def run_pipeline(params: Dict[str, str]) -> None:

@@ -159,6 +159,9 @@ _PARAM_ALIASES: Dict[str, str] = {
     "nonfinite_policy": "guard_policy", "guard": "guard_policy",
     "loss_spike_factor": "guard_loss_spike",
     "fault_spec": "faults",
+    "slos": "slo_specs", "slo_spec": "slo_specs",
+    "max_slo_burn": "pipeline_max_slo_burn",
+    "federation": "serving_federation",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -425,6 +428,25 @@ class Config:
     replica_heartbeat_ms: float = 200.0
     replica_heartbeat_timeout_ms: float = 3000.0
     replica_spawn_timeout_s: float = 120.0
+    # ---- observability federation + SLOs (observability/{metrics,
+    # slo}.py, docs/Observability.md "Federation"): process-mode
+    # workers piggyback metrics deltas on their heartbeat pongs so ONE
+    # parent /metrics scrape renders the whole fleet under a `worker`
+    # label; the SLO engine evaluates declarative objectives
+    # ("name:kind:objective[:threshold_ms]"; kinds availability |
+    # latency | error_rate) as multi-window burn rates over the
+    # merged state and publishes lgbm_slo_burn{slo,window} gauges
+    serving_federation: bool = True
+    slo_specs: List[str] = field(default_factory=list)
+    slo_windows: List[str] = field(default_factory=list)
+    slo_eval_interval_s: float = 5.0
+    # >0 arms the ramp's SLO gate: a canary stage observing a worst
+    # burn above this rolls back (pipeline/ramp.py max_slo_burn)
+    pipeline_max_slo_burn: float = 0.0
+    # per-metric cap on distinct label sets in the metrics registry;
+    # overflow series are dropped and counted in
+    # lgbm_metrics_dropped_series (0 = unbounded)
+    metrics_max_series: int = 256
 
     # ---- pipeline task (lightgbm_tpu/pipeline/, docs/Pipeline.md) —
     # the continuous refit-and-promote loop: a log source (replay
@@ -695,6 +717,20 @@ class Config:
                 or self.pipeline_holdout_rows <= 0:
             raise ValueError("pipeline_window_rows and "
                              "pipeline_holdout_rows must be > 0")
+        if self.slo_eval_interval_s <= 0:
+            raise ValueError("slo_eval_interval_s must be > 0")
+        if self.pipeline_max_slo_burn < 0:
+            raise ValueError("pipeline_max_slo_burn must be >= 0")
+        if self.metrics_max_series < 0:
+            raise ValueError("metrics_max_series must be >= 0")
+        if self.slo_specs or self.slo_windows:
+            # fail at configure time, not inside the background
+            # evaluator thread
+            from .observability.slo import (parse_slo_specs,
+                                            parse_window)
+            parse_slo_specs(self.slo_specs)
+            for w in self.slo_windows:
+                parse_window(w)
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objectives")
         if self.objective not in ("multiclass", "multiclassova", "custom",

@@ -164,6 +164,20 @@ class FlightRecorder:
         try:
             from .metrics import get_metrics
             out["histograms"] = get_metrics().snapshots()
+            # fleet observability: which worker shards the federation
+            # held at trip time (and how stale), plus the last SLO
+            # evaluation — a crash dump should answer "was the fleet
+            # healthy and within objective when it died?"
+            workers = get_metrics().federation_workers()
+            if workers:
+                out["federation_workers"] = workers
+        except Exception:
+            pass
+        try:
+            from .slo import last_evaluation
+            ev = last_evaluation()
+            if ev is not None:
+                out["slo"] = ev
         except Exception:
             pass
         if extra:

@@ -37,7 +37,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..utils.log import Timer, get_verbosity, global_timer, log_info, \
     log_warning
@@ -390,6 +390,13 @@ class Telemetry:
                     d[1] += v
                     d[2] = min(d[2], v)
                     d[3] = max(d[3], v)
+
+    def counter_state(self) -> Tuple[Dict[str, float], Dict[str, Any]]:
+        """Consistent (counters, gauges) copies under one lock hold —
+        the federation client's snapshot source (metrics.py
+        ``FederationClient``); also handy for tests."""
+        with self._lock:
+            return dict(self.counters), dict(self.gauges)
 
     # -- records -------------------------------------------------------
     def record(self, kind: str, **fields) -> None:

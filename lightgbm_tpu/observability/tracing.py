@@ -382,6 +382,56 @@ class Tracer:
                 "pid": os.getpid(),
                 "tid": threading.get_ident(), "args": a})
 
+    def perf_from_wall(self, wall_s: float) -> float:
+        """Map a ``time.time()`` reading onto THIS tracer's
+        ``perf_counter`` timeline. Worker processes report their span
+        boundaries as wall-clock seconds (the only clock two processes
+        share); this converts them so :meth:`emit_complete` renders
+        remote spans on the parent timeline."""
+        return float(wall_s) - self._epoch_us / 1e6
+
+    def replay_remote_spans(self, records: List[Dict[str, Any]],
+                            ctx: TraceContext,
+                            cat: str = "worker") -> int:
+        """Re-emit span records shipped back from a worker process
+        under the parent trace.
+
+        ``records`` is the worker's ``spans`` reply payload: dicts of
+        ``{"name", "t0", "t1"}`` (wall-clock seconds) plus optional
+        ``"args"`` and ``"root": True`` on the request-level span.
+        The root is re-parented under ``ctx`` (the parent-side span
+        that dispatched the request); every other record becomes a
+        child of the root, so Perfetto shows one cross-process tree
+        per trace id. Returns the number of spans emitted."""
+        if not self._enabled or not records:
+            return 0
+        recs = [r for r in records if isinstance(r, dict)]
+        roots = [r for r in recs if r.get("root")]
+        root = roots[0] if roots else (recs[0] if recs else None)
+        if root is None:
+            return 0
+        root_ctx = ctx.child()
+        n = 0
+        for rec in recs:
+            try:
+                t0 = self.perf_from_wall(float(rec["t0"]))
+                t1 = self.perf_from_wall(float(rec["t1"]))
+                name = str(rec.get("name", "worker.span"))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if rec is root:
+                sctx, parent = root_ctx, ctx.span_id
+            else:
+                sctx = TraceContext(ctx.trace_id, _gen_id(4))
+                parent = root_ctx.span_id
+            args = rec.get("args")
+            self.emit_complete(name, t0, t1, cat=cat, ctx=sctx,
+                               parent_id=parent,
+                               args=dict(args) if isinstance(
+                                   args, dict) else None)
+            n += 1
+        return n
+
     # -- event plumbing ------------------------------------------------
     def _ts_us(self, t_perf: float) -> float:
         return round(self._epoch_us + t_perf * 1e6, 3)

@@ -77,6 +77,16 @@ class PipelineDriver:
             continue_iters=int(cfg.pipeline_continue_iters),
             checkpoint_dir=cfg.pipeline_dir,
             checkpoint_keep=int(cfg.checkpoint_keep))
+        # SLO engine (observability/slo.py): burn rates over the
+        # fleet's merged counters/histograms — including every
+        # federated worker shard in process isolation — evaluated in
+        # the background for the lifetime of the loop and gating ramp
+        # stages when pipeline_max_slo_burn arms the gate
+        from ..observability.slo import engine_from_config
+        self.slo = engine_from_config(
+            cfg, counts_fn=self.fleet.slo_counts).start()
+        max_burn = float(getattr(cfg, "pipeline_max_slo_burn", 0.0)
+                         or 0.0)
         self.ramp = RampController(
             self.publisher,
             stages=list(cfg.pipeline_canary_stages)
@@ -85,7 +95,9 @@ class PipelineDriver:
             thresholds=RampThresholds(
                 latency_regression_pct=float(
                     cfg.pipeline_latency_slo_pct),
-                quality_drop=float(cfg.pipeline_quality_drop)))
+                quality_drop=float(cfg.pipeline_quality_drop),
+                max_slo_burn=max_burn),
+            slo_fn=self.slo.max_burn)
         if source is not None:
             self.source = source
         elif cfg.pipeline_source == "tail":
@@ -165,6 +177,8 @@ class PipelineDriver:
                             0.05, max(deadline - time.monotonic(), 0)))
             preempted = guard.requested
         set_stage("stopped")
+        self.slo.evaluate()     # final sample before the report
+        slo_report = self.slo.report()
         summary = {
             "cycles": cycles, "promoted": promoted,
             "rolled_back": rolled_back, "preempted": preempted,
@@ -172,10 +186,15 @@ class PipelineDriver:
             "model": self.model,
             "primary": self.publisher.primary_name(),
             "history": list(self.history),
+            "slo": slo_report,
         }
         tel.record("pipeline_summary", **{
             k: v for k, v in summary.items()
             if isinstance(v, (int, float, str, bool))})
+        tel.record("slo_report",
+                   max_burn=(slo_report.get("last") or {}).get(
+                       "max_burn") if slo_report else None,
+                   specs=len(self.slo.specs))
         if stop_fleet or preempted:
             self.stop()
         return summary
@@ -238,7 +257,8 @@ class PipelineDriver:
             rec["model_text_sha"] = _sha16(cand.model_text)
             rec["stages"] = [
                 {"stage": m.stage, "weight": m.weight,
-                 "decision": v.decision, "reasons": v.reasons}
+                 "decision": v.decision, "reasons": v.reasons,
+                 "slo_burn": m.slo_burn}
                 for m, v in self.ramp.verdicts]
             tel.record("pipeline_cycle", cycle=index,
                        candidate=cand.cid, status=cand.status,
@@ -248,6 +268,7 @@ class PipelineDriver:
 
     # ------------------------------------------------------------------
     def stop(self) -> None:
+        self.slo.stop()
         if self._http_server is not None:
             try:
                 self._http_server.shutdown()

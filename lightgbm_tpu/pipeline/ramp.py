@@ -67,6 +67,11 @@ class RampThresholds:
     max_parity_mismatches: int = 0
     max_flightrec_trips: int = 0
     max_error_rate: float = 0.0            # non-shed errors / requests
+    # SLO burn gate (observability/slo.py): a stage observing a worst
+    # burn rate above this rolls back. 0.0 disables the gate — burn
+    # only gates a ramp when the pipeline declares a tolerance
+    # (``pipeline_max_slo_burn`` config)
+    max_slo_burn: float = 0.0
 
 
 @dataclasses.dataclass
@@ -86,6 +91,9 @@ class StageMetrics:
     errors: int = 0
     health_status: str = "ok"
     last_reload_error: Optional[Dict[str, Any]] = None
+    # worst SLO burn rate observed during the stage (None = no SLO
+    # engine running; never trips a gate)
+    slo_burn: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -106,7 +114,8 @@ def evaluate_stage(m: StageMetrics,
     or a down replica — the hard aborts), a non-shed error rate above
     ``max_error_rate``, any serving-parity mismatch past
     ``max_parity_mismatches``, any flight-recorder trip past
-    ``max_flightrec_trips``, a quality drop beyond ``quality_drop``,
+    ``max_flightrec_trips``, an SLO burn rate above ``max_slo_burn``
+    (when that gate is armed), a quality drop beyond ``quality_drop``,
     or a canary p99 exceeding the primary p99 by more than
     ``latency_regression_pct`` percent (only when the canary p99 is
     above ``latency_floor_ms`` — micro-benchmark noise below the
@@ -131,6 +140,10 @@ def evaluate_stage(m: StageMetrics,
                        " mismatched probes")
     if m.flightrec_trips > th.max_flightrec_trips:
         reasons.append(f"flight_recorder:{m.flightrec_trips} trips")
+    if th.max_slo_burn > 0 and m.slo_burn is not None \
+            and m.slo_burn > th.max_slo_burn:
+        reasons.append(f"slo_burn:{m.slo_burn:.3g} "
+                       f"(> {th.max_slo_burn:g})")
     if m.canary_quality is not None and m.baseline_quality is not None:
         drop = m.baseline_quality - m.canary_quality
         if drop > th.quality_drop:
@@ -168,7 +181,8 @@ class RampController:
                                       float] = default_quality,
                  parity_rows: int = 32,
                  trips_fn: Optional[Callable[[], int]] = None,
-                 collect_fn: Optional[Callable] = None):
+                 collect_fn: Optional[Callable] = None,
+                 slo_fn: Optional[Callable[[], float]] = None):
         self.publisher = publisher
         self.fleet = publisher.fleet
         self.stages = [float(w) for w in stages]
@@ -182,6 +196,9 @@ class RampController:
         self.parity_rows = int(parity_rows)
         self._trips_fn = trips_fn or self._default_trips
         self._collect_fn = collect_fn
+        # worst current SLO burn (observability/slo.py SLOEngine
+        # .max_burn); None = no SLO engine wired, gate stays silent
+        self._slo_fn = slo_fn
         self.verdicts: List[Tuple[StageMetrics, StageVerdict]] = []
 
     @staticmethod
@@ -232,7 +249,8 @@ class RampController:
                        weight=weight, decision=v.decision,
                        reasons=";".join(v.reasons),
                        requests=m.requests,
-                       canary_requests=m.canary_requests)
+                       canary_requests=m.canary_requests,
+                       slo_burn=m.slo_burn)
             if not v.ok:
                 set_stage("rollback")
                 self.publisher.rollback(cand, "; ".join(v.reasons))
@@ -320,6 +338,11 @@ class RampController:
             m.errors = errors
 
         m.flightrec_trips = self._trips_fn() - trips0
+        if self._slo_fn is not None:
+            try:
+                m.slo_burn = float(self._slo_fn())
+            except Exception:  # noqa: BLE001 - a broken SLO probe
+                m.slo_burn = None   # must not fail the stage itself
         h = self.fleet.health()
         status = str(h.get("status"))
         if status == "degraded" and h.get("last_reload_error") is None \

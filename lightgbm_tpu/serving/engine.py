@@ -127,7 +127,7 @@ class ServingConfig:
 class _Request:
     __slots__ = ("rows", "kind", "t_enqueue", "deadline", "event",
                  "result", "error", "meta", "ctx", "qspan", "t_perf",
-                 "t_perf_done")
+                 "t_perf_done", "wspans")
 
     def __init__(self, rows: np.ndarray, kind: str,
                  timeout_s: Optional[float]):
@@ -148,6 +148,10 @@ class _Request:
         self.ctx = None
         self.qspan = None
         self.t_perf_done: Optional[float] = None
+        # span records a process-fleet worker shipped back with the
+        # reply (procfleet._resolve fills it; the supervisor's request
+        # watcher replays them under the parent trace)
+        self.wspans: Optional[List[Dict[str, Any]]] = None
 
 
 class ServingFuture:

@@ -731,9 +731,15 @@ class FleetEngine:
                 rep, fut = self._dispatch(
                     decision.target, arr, kind, timeout_ms,
                     trace_ctx=None if span is None else span.ctx)
-            except ServingError:
+            except ServingError as e:
                 with self._lock:
                     self._pending -= 1
+                if isinstance(e, ReplicaUnavailableError):
+                    # a no-healthy-replica rejection is an AVAILABILITY
+                    # event, not a shed: without this the SLO source
+                    # would read a fully-dead pool as 100% available
+                    # (zero requests, zero errors)
+                    self._count("unavailable")
                 raise
         except ServingError as e:
             if span is not None:
@@ -923,6 +929,18 @@ class FleetEngine:
         with self._lock:
             self._counts[name] = self._counts.get(name, 0.0) + value
         get_telemetry().count(f"fleet.{name}", value)
+
+    def slo_counts(self) -> Dict[str, int]:
+        """Cumulative counts the SLO engine samples (observability/
+        slo.py): total attempts and the bad-event classes. ``shed``
+        is intentional backpressure — excluded from the error SLI but
+        reported so an error-rate spec can opt in."""
+        with self._lock:
+            c = dict(self._counts)
+        return {"requests": int(c.get("requests", 0)),
+                "errors": int(c.get("errors", 0)),
+                "shed": int(c.get("shed", 0)),
+                "unavailable": int(c.get("unavailable", 0))}
 
     @property
     def replicas(self) -> List[Replica]:

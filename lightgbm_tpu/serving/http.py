@@ -13,7 +13,11 @@ Endpoints (all JSON):
 * ``GET  /stats``      counter/latency snapshot
 * ``GET  /metrics``    Prometheus text exposition (the live metrics
   plane, docs/Observability.md: serving latency histograms, queue
-  depth, shed/timeout counters, device-memory gauges)
+  depth, shed/timeout counters, device-memory gauges; in process
+  isolation also every federated worker shard under a ``worker``
+  label)
+* ``GET  /slo``        latest SLO burn-rate evaluation
+  (observability/slo.py; ``{"enabled": false}`` when no engine runs)
 * ``POST /reload``     ``{"model_file": path}`` or ``{"model_str": txt}``
 
 When the engine is a :class:`~lightgbm_tpu.serving.fleet.FleetEngine`
@@ -95,6 +99,12 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self._send_json(200, self.engine.stats())
             elif self.path == "/metrics":
                 self._send_metrics()
+            elif self.path == "/slo":
+                from ..observability.slo import get_slo_engine
+                eng = get_slo_engine()
+                self._send_json(200, {
+                    "enabled": eng is not None,
+                    **(eng.report() if eng is not None else {})})
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": self.path})

@@ -138,6 +138,10 @@ class ProcFleetOptions:
     # back: the cap is for FLAPPING replicas, not for a long-lived
     # pool that absorbs an occasional crash a day
     flap_reset_s: float = 30.0
+    # metrics federation (docs/Observability.md): workers piggyback
+    # registry/telemetry deltas on their heartbeat pongs and the
+    # parent scrape renders the whole fleet under a ``worker`` label
+    federation: bool = True
 
     @classmethod
     def from_config(cls, cfg) -> "ProcFleetOptions":
@@ -148,7 +152,8 @@ class ProcFleetOptions:
             heartbeat_timeout_ms=float(getattr(
                 cfg, "replica_heartbeat_timeout_ms", 3000.0)),
             spawn_timeout_s=float(getattr(
-                cfg, "replica_spawn_timeout_s", 120.0)))
+                cfg, "replica_spawn_timeout_s", 120.0)),
+            federation=bool(getattr(cfg, "serving_federation", True)))
 
 
 class _WorkerHandle:
@@ -268,6 +273,16 @@ class _WorkerHandle:
                 elif t == "pong":
                     self.worker_stats = msg.get("stats") or {}
                     self.worker_load = int(msg.get("load", 0))
+                    fed = msg.get("fed")
+                    if fed is not None:
+                        # heartbeat-piggybacked metrics delta: merge
+                        # into the parent registry under this worker's
+                        # shard (any pong also refreshes staleness)
+                        try:
+                            get_metrics().merge_snapshot(
+                                str(self.rid), fed)
+                        except Exception:  # noqa: BLE001 - a bad
+                            pass           # delta must not kill recv
                 elif t == "ack":
                     with self._ack_cond:
                         self._acks[int(msg.get("id", -1))] = msg
@@ -289,6 +304,9 @@ class _WorkerHandle:
             req.result = np.asarray(msg.get("result"))
             req.meta.update(msg.get("meta") or {})
             req.meta["replica_pid"] = self.pid
+        spans = msg.get("spans")
+        if spans:
+            req.wspans = spans
         req.t_perf_done = time.perf_counter()
         req.event.set()
 
@@ -474,6 +492,11 @@ class WorkerSupervisor:
         self._awaiting: Dict[str, "_HelloSlot"] = {}
         self._stopping = False
         self.worker_dumps: List[Dict[str, Any]] = []
+        # federated-shard staleness: a worker whose last snapshot is
+        # older than the heartbeat timeout is rendered stale even if
+        # nobody declared it dead yet (slow-worker semantics)
+        get_metrics().fed_stale_after_s = \
+            max(self.opts.heartbeat_timeout_ms / 1000.0, 0.05)
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -534,6 +557,8 @@ class WorkerSupervisor:
         for var in ("LGBM_TPU_TELEMETRY", "LGBM_TPU_TRACE"):
             if env.get(var):
                 env[var] = f"{env[var]}.worker{rep.rid}"
+        env["LGBM_TPU_FEDERATION"] = \
+            "1" if self.opts.federation else "0"
         return env
 
     def spawn(self, rep: ProcessReplica) -> None:
@@ -592,6 +617,10 @@ class WorkerSupervisor:
             _kill_proc(proc)
             raise
         rep.state = "ok"
+        # a (re)spawned worker's shard is live again the moment it can
+        # heartbeat; its first pong replaces the dead incarnation's
+        # cumulative series wholesale
+        get_metrics().set_worker_stale(str(rep.rid), False)
         ready_ms = round((time.perf_counter() - t0) * 1000.0, 3)
         rep.restart_ready_ms = ready_ms
         self._note(rep, "ready", ready_ms=ready_ms,
@@ -791,6 +820,10 @@ class WorkerSupervisor:
                 replica=rep.rid, reason_code=reason_code))
         else:
             failed = 0
+        # the shard stays visible (last-known counts) but is marked
+        # stale within this monitor tick — dead series read as stale,
+        # never as frozen-fresh
+        get_metrics().set_worker_stale(str(rep.rid), True)
         self._collect_worker_dump(rep, reason_code)
         self._note(rep, "dead", reason_code=reason_code,
                    detail=detail[:240], failed_requests=failed)
@@ -918,6 +951,7 @@ class WorkerSupervisor:
             f"replica {rep.rid} stopped", replica=rep.rid))
         rep._handle = None
         rep.state = "dead"
+        get_metrics().set_worker_stale(str(rep.rid), True)
         self._note(rep, "stopped", drained=bool(drain))
 
     def shutdown(self, drain: bool = True) -> None:
@@ -963,6 +997,20 @@ class WorkerSupervisor:
             req.event.wait(60.0)
             end = req.t_perf_done or time.perf_counter()
             meta = dict(req.meta)
+            wspans = getattr(req, "wspans", None)
+            if wspans:
+                # the worker shipped its own span records back with
+                # the reply: replay them under this request's trace so
+                # Perfetto shows decode -> queue wait -> device ->
+                # encode INSIDE the worker as one cross-process tree
+                try:
+                    if tracer.replay_remote_spans(
+                            wspans, ctx, cat="worker"):
+                        return
+                except Exception:  # noqa: BLE001 - fall back below
+                    pass
+            # no worker spans (federation off / old worker): keep the
+            # parent-side opaque interval so the request still shows
             tracer.emit_complete(
                 "worker.request", req.t_perf, end, cat="fleet",
                 ctx=ctx,

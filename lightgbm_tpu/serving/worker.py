@@ -68,9 +68,15 @@ class _Worker:
         self.wlock = threading.Lock()
         self.engines: Dict[str, Any] = {}     # name -> ServingEngine
         self.cfg = self._serving_config()
-        # (id, fut) pairs the completion thread resolves back over the
-        # socket as the engine fulfills them
-        self.outstanding: List[Tuple[int, Any]] = []
+        # metrics federation (docs/Observability.md): deltas of this
+        # worker's registry/telemetry state ride each heartbeat pong
+        self._fed: Any = None
+        self._fed_on = os.environ.get("LGBM_TPU_FEDERATION",
+                                      "1") != "0"
+        # (id, fut, tinfo) triples the completion thread resolves back
+        # over the socket as the engine fulfills them; tinfo carries
+        # the wall-clock span anchors when the submit was traced
+        self.outstanding: List[Tuple[int, Any, Any]] = []
         self.out_lock = threading.Lock()
         self.out_event = threading.Event()
         self.draining = False
@@ -138,13 +144,23 @@ class _Worker:
         from .errors import ModelNotFoundError, ServingError
         mid = int(msg.get("id", -1))
         name = str(msg.get("model"))
+        # wall-clock span anchors (time.time() is the only clock this
+        # process shares with the supervisor; the parent tracer maps
+        # the readings onto its perf_counter timeline on replay)
+        tinfo = None
+        if msg.get("trace"):
+            tinfo = {"t0": time.time(),
+                     "kind": str(msg.get("kind", "predict"))}
         try:
             eng = self.engines.get(name)
             if eng is None:
                 raise ModelNotFoundError(
                     f"model {name!r} is not loaded on worker "
                     f"{self.rid}", model=name)
+            d0 = time.time()
             rows = np.asarray(msg.get("rows"), np.float64)
+            if tinfo is not None:
+                tinfo["decode"] = (d0, time.time())
             fut = eng.submit(rows, str(msg.get("kind", "predict")),
                              timeout_ms=msg.get("timeout_ms"))
         except ServingError as e:
@@ -156,7 +172,7 @@ class _Worker:
                        "code": "serving_error", "message": str(e)})
             return
         with self.out_lock:
-            self.outstanding.append((mid, fut))
+            self.outstanding.append((mid, fut, tinfo))
         self.out_event.set()
 
     def _completion_loop(self) -> None:
@@ -168,30 +184,92 @@ class _Worker:
                 self.out_event.wait(0.05)
                 self.out_event.clear()
                 continue
-            done: List[Tuple[int, Any]] = []
-            for mid, fut in items:
+            done: List[Tuple[int, Any, Any]] = []
+            for mid, fut, tinfo in items:
                 if fut.done():
-                    done.append((mid, fut))
+                    done.append((mid, fut, tinfo))
             if not done:
                 time.sleep(0.001)
                 continue
             with self.out_lock:
                 self.outstanding = [p for p in self.outstanding
                                     if p not in done]
-            for mid, fut in done:
+            for mid, fut, tinfo in done:
                 try:
                     out = fut.result(timeout=0)
-                    self.send({"type": "result", "id": mid,
-                               "result": out.tolist(),
-                               "meta": _jsonable_meta(fut.meta)})
+                    e0 = time.time()
+                    payload = out.tolist()
+                    meta = _jsonable_meta(fut.meta)
+                    frame = {"type": "result", "id": mid,
+                             "result": payload, "meta": meta}
+                    spans = self._spans(tinfo, meta, encode=(
+                        e0, time.time()))
+                    if spans:
+                        frame["spans"] = spans
+                    self.send(frame)
                 except ServingError as e:
-                    self.send({"type": "error", "id": mid,
-                               "code": e.code, "message": str(e),
-                               "details": _jsonable_meta(e.details)})
+                    frame = {"type": "error", "id": mid,
+                             "code": e.code, "message": str(e),
+                             "details": _jsonable_meta(e.details)}
+                    spans = self._spans(
+                        tinfo, _jsonable_meta(getattr(
+                            fut, "meta", {}) or {}))
+                    if spans:
+                        frame["spans"] = spans
+                    self.send(frame)
                 except Exception as e:  # noqa: BLE001
                     self.send({"type": "error", "id": mid,
                                "code": "serving_error",
                                "message": str(e)})
+
+    def _spans(self, tinfo: Optional[Dict[str, Any]],
+               meta: Dict[str, Any],
+               encode: Optional[Tuple[float, float]] = None
+               ) -> Optional[List[Dict[str, Any]]]:
+        """Build the wall-clock span records shipped back with a
+        traced reply: the request root plus the decode / queue-wait /
+        device / encode decomposition. Queue and device intervals are
+        reconstructed from the engine's own measured ``queue_ms`` /
+        ``compute_ms`` meta, anchored at decode end — the engine
+        measures them, this just places them on the shared clock."""
+        if tinfo is None:
+            return None
+        try:
+            now = time.time()
+            t0 = float(tinfo["t0"])
+            recs: List[Dict[str, Any]] = [{
+                "name": "worker.request", "root": True,
+                "t0": t0, "t1": now,
+                "args": {"replica": self.rid, "pid": os.getpid(),
+                         "kind": tinfo.get("kind"),
+                         "queue_ms": meta.get("queue_ms"),
+                         "compute_ms": meta.get("compute_ms"),
+                         "error": meta.get("error")}}]
+            cursor = t0
+            dec = tinfo.get("decode")
+            if dec:
+                recs.append({"name": "worker.decode",
+                             "t0": float(dec[0]), "t1": float(dec[1])})
+                cursor = float(dec[1])
+            q_ms = meta.get("queue_ms")
+            if isinstance(q_ms, (int, float)):
+                q1 = min(cursor + float(q_ms) / 1000.0, now)
+                recs.append({"name": "worker.queue_wait",
+                             "t0": cursor, "t1": q1})
+                cursor = q1
+            c_ms = meta.get("compute_ms")
+            if isinstance(c_ms, (int, float)):
+                c1 = min(cursor + float(c_ms) / 1000.0, now)
+                recs.append({"name": "worker.device",
+                             "t0": cursor, "t1": c1,
+                             "args": {"bucket": meta.get("bucket")}})
+            if encode:
+                recs.append({"name": "worker.encode",
+                             "t0": float(encode[0]),
+                             "t1": float(encode[1])})
+            return recs
+        except Exception:  # noqa: BLE001 - spans must never block
+            return None    # the reply itself
 
     def pong(self, msg: Dict[str, Any]) -> None:
         from ..utils.compile_cache import maybe_enable_compile_cache
@@ -208,8 +286,22 @@ class _Worker:
             load += eng.queue_depth
         with self.out_lock:
             load += len(self.outstanding)
-        self.send({"type": "pong", "t": msg.get("t"), "load": load,
-                   "stats": stats})
+        frame = {"type": "pong", "t": msg.get("t"), "load": load,
+                 "stats": stats}
+        if self._fed_on:
+            # piggyback the metrics-federation delta: cumulative
+            # per-series state for everything that changed since the
+            # previous pong (idempotent to merge, safe to lose — the
+            # next delta re-ships whatever is still changing)
+            try:
+                if self._fed is None:
+                    from ..observability.metrics import \
+                        FederationClient
+                    self._fed = FederationClient()
+                frame["fed"] = self._fed.delta()
+            except Exception:  # noqa: BLE001 - never break heartbeat
+                pass
+        self.send(frame)
 
     # -- faults --------------------------------------------------------
     def fault(self, msg: Dict[str, Any]) -> None:

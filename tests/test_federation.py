@@ -1,0 +1,721 @@
+"""Federated observability plane (ISSUE 16): worker metric/span
+aggregation over the control socket + the declarative SLO burn-rate
+engine.
+
+Acceptance gates:
+  * one parent ``GET /metrics`` scrape renders every process worker's
+    latency histogram and device gauges under a ``worker`` label —
+    no new sockets, the deltas ride the heartbeat pong;
+  * the merge is replace-per-series over cumulative state, so it is
+    idempotent (redelivery-safe) and bucket-merge is exact: the fleet
+    p99 derived from merged shards matches the single-registry
+    (thread-mode) p99 within one bucket width;
+  * worker-side spans replay under the parent trace id, decomposing
+    a remote request into decode / queue-wait / device / encode;
+  * killing a worker mid-load flips its staleness gauge within one
+    heartbeat interval AND trips the availability SLO burn;
+  * label cardinality is bounded (overflow counted in
+    ``lgbm_metrics_dropped_series``, merged totals stay honest).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability.metrics import (FederationClient,
+                                                LogHistogram,
+                                                MetricsRegistry,
+                                                get_metrics,
+                                                hist_layout)
+from lightgbm_tpu.observability.slo import (SLOEngine, SLOSpec,
+                                            engine_from_config,
+                                            parse_slo_spec,
+                                            parse_slo_specs,
+                                            parse_window,
+                                            specs_from_config)
+from lightgbm_tpu.observability.telemetry import get_telemetry
+from lightgbm_tpu.observability.tracing import TraceContext, get_tracer
+from lightgbm_tpu.pipeline.ramp import (RampThresholds, StageMetrics,
+                                        evaluate_stage)
+
+from test_observability_plane import validate_prometheus
+
+
+def _wait(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def reg():
+    """A private registry — federation unit tests never touch the
+    process-global one."""
+    return MetricsRegistry()
+
+
+@pytest.fixture
+def global_state():
+    get_metrics().reset()
+    get_telemetry().reset()
+    yield
+    get_metrics().reset()
+    get_telemetry().reset()
+
+
+# ---------------------------------------------------------------------
+# bucket-merge: exactness / associativity (the federation premise)
+def test_hist_layout_deterministic_per_name():
+    a = hist_layout("serving_request_latency_ms")
+    b = hist_layout("serving_request_latency_ms")
+    assert a == b
+    start, factor, n = a
+    assert start > 0 and factor > 1 and n > 4
+    # a worker and the parent agree on the counts-vector length
+    h = LogHistogram(start, factor, n)
+    assert len(h.counts) == n + 1          # + overflow bucket
+
+
+def _observe_all(h, values):
+    for v in values:
+        h.observe(float(v))
+    return h
+
+
+def test_bucket_merge_associative_any_order():
+    """N worker snapshots merged in ANY order (and any grouping)
+    produce the identical histogram a single registry would have —
+    same buckets AND same derived quantiles. This is what makes the
+    federated fleet p99 exact rather than approximate."""
+    start, factor, n = hist_layout("serving_request_latency_ms")
+    rng = np.random.RandomState(7)
+    chunks = [np.abs(rng.lognormal(mean=m, sigma=1.0, size=200)) * 5
+              for m in (0.0, 1.0, 2.0, 0.5, 1.5)]
+    parts = [_observe_all(LogHistogram(start, factor, n), c)
+             for c in chunks]
+    combined = _observe_all(LogHistogram(start, factor, n),
+                            np.concatenate(chunks))
+
+    def merged(order):
+        out = LogHistogram(start, factor, n)
+        for i in order:
+            h = parts[i]
+            assert out.merge_counts(list(h.counts), h.count, h.sum)
+        return out
+
+    for order in ([0, 1, 2, 3, 4], [4, 3, 2, 1, 0], [2, 0, 4, 1, 3]):
+        m = merged(order)
+        assert m.counts == combined.counts
+        assert m.count == combined.count
+        assert m.sum == pytest.approx(combined.sum)
+        for q in (0.5, 0.95, 0.99):
+            assert m.quantile(q) == combined.quantile(q)
+    # grouped merge (merge-of-merges) is the same histogram too
+    left = merged([0, 1])
+    right = merged([2, 3, 4])
+    tree = LogHistogram(start, factor, n)
+    tree.merge_counts(list(left.counts), left.count, left.sum)
+    tree.merge_counts(list(right.counts), right.count, right.sum)
+    assert tree.counts == combined.counts and tree.count \
+        == combined.count
+
+
+def test_merge_rejects_layout_mismatch():
+    start, factor, n = hist_layout("serving_request_latency_ms")
+    h = LogHistogram(start, factor, n)
+    assert not h.merge_counts([1] * (n - 3))
+    assert h.count == 0
+
+
+def test_fleet_p99_matches_thread_mode_within_bucket(reg):
+    """Acceptance: the SAME deterministic latency stream observed (a)
+    in one registry (thread mode) and (b) split across three worker
+    shards merged via ``merge_snapshot`` yields the same p99 within
+    one bucket width (here: exactly, since the merge is elementwise)."""
+    name = "serving_request_latency_ms"
+    start, factor, n = hist_layout(name)
+    rng = np.random.RandomState(3)
+    lat = np.abs(rng.lognormal(mean=1.2, sigma=0.8, size=900)) * 3
+    thread_reg = MetricsRegistry()
+    for v in lat:
+        thread_reg.observe(name, float(v))
+    for w in range(3):
+        shard = LogHistogram(start, factor, n)
+        _observe_all(shard, lat[w::3])
+        reg.merge_snapshot(str(w), {"hists": [
+            {"n": name, "l": {}, "c": list(shard.counts),
+             "t": shard.count, "s": shard.sum}]})
+    merged = reg.merged_hist(name)
+    ref = thread_reg.merged_hist(name)
+    assert merged.counts == ref.counts
+    for q in (0.5, 0.95, 0.99):
+        p_m, p_t = merged.quantile(q), ref.quantile(q)
+        assert p_m is not None and p_t is not None
+        # "within one bucket width": adjacent geometric buckets differ
+        # by `factor`, so the ratio must stay within one rung
+        assert max(p_m, p_t) / min(p_m, p_t) <= factor + 1e-9
+
+
+# ---------------------------------------------------------------------
+# merge_snapshot semantics + rendering
+def _snap(name, values, labels=None):
+    start, factor, n = hist_layout(name)
+    h = _observe_all(LogHistogram(start, factor, n), values)
+    return {"hists": [{"n": name, "l": dict(labels or {}),
+                       "c": list(h.counts), "t": h.count,
+                       "s": h.sum}],
+            "gauges": [{"n": "device_bytes_in_use", "v": 12345.0}],
+            "counters": {"jit.compiles": 4}}
+
+
+def test_merge_snapshot_idempotent_and_rendered(reg):
+    snap = _snap("serving_request_latency_ms", [1.0, 2.0, 4.0, 8.0],
+                 labels={"kind": "predict", "bucket": "8"})
+    reg.merge_snapshot("0", snap)
+    reg.merge_snapshot("0", snap)      # redelivered pong: no change
+    merged = reg.merged_hist("serving_request_latency_ms")
+    assert merged.count == 4, "redelivery double-counted"
+    text = reg.render()
+    samples, types = validate_prometheus(text)
+    worker_samples = [k for k in samples if 'worker="0"' in k[1]]
+    assert worker_samples, text
+    # the worker's histogram renders as a proper cumulative histogram
+    assert any(k[0] == "lgbm_serving_request_latency_ms_bucket"
+               and 'le="+Inf"' in k[1] and 'worker="0"' in k[1]
+               for k in samples)
+    assert any(k[0] == "lgbm_device_bytes_in_use"
+               and 'worker="0"' in k[1] for k in samples)
+    assert ("lgbm_jit_compiles_total",
+            'worker="0"') in samples
+    # freshness gauges are part of the shard render
+    assert ("lgbm_worker_stale", 'worker="0"') in samples
+    assert samples[("lgbm_worker_stale", 'worker="0"')] == 0.0
+
+
+def test_merge_snapshot_rejects_bad_count_vectors(reg):
+    reg.merge_snapshot("0", {"hists": [
+        {"n": "serving_request_latency_ms", "l": {}, "c": [1, 2, 3]}]})
+    assert reg.merged_hist("serving_request_latency_ms").count == 0
+
+
+def test_worker_staleness_flag_and_age(reg):
+    reg.merge_snapshot("0", _snap("serving_request_latency_ms", [1.0]))
+    [w] = reg.federation_workers()
+    assert w["worker"] == "0" and not w["stale"] and w["series"] >= 1
+    # the supervisor's explicit kill-path flag
+    reg.set_worker_stale("0", True)
+    assert reg.federation_workers()[0]["stale"]
+    samples, _ = validate_prometheus(reg.render())
+    assert samples[("lgbm_worker_stale", 'worker="0"')] == 1.0
+    # respawn marks fresh again
+    reg.set_worker_stale("0", False)
+    assert not reg.federation_workers()[0]["stale"]
+    # render-time age threshold catches silently-wedged workers too
+    reg.fed_stale_after_s = 0.05
+    time.sleep(0.12)
+    assert reg.federation_workers()[0]["stale"]
+    reg.drop_worker("0")
+    assert reg.federation_workers() == []
+
+
+# ---------------------------------------------------------------------
+# cardinality bound
+def test_cardinality_cap_counts_dropped_series(reg):
+    reg.max_series_per_metric = 4
+    for i in range(10):
+        reg.observe("serving_request_latency_ms", 1.0 + i,
+                 labels={"bucket": str(i)})
+    text = reg.render()
+    samples, _ = validate_prometheus(text)
+    rendered = {k[1] for k in samples
+                if k[0] == "lgbm_serving_request_latency_ms_count"}
+    assert len(rendered) == 4, "cap did not bound the render"
+    dropped = reg.dropped_series()
+    assert dropped.get("serving_request_latency_ms") == 6
+    assert ("lgbm_metrics_dropped_series",
+            'metric="serving_request_latency_ms"') in samples
+    # overflow observations are NOT lost: merged totals stay honest
+    assert reg.merged_hist("serving_request_latency_ms").count == 10
+    # gauges past the cap are dropped + counted the same way
+    reg.max_series_per_metric = 2
+    for i in range(5):
+        reg.set_gauge("pipeline_stage", 1.0, labels={"stage": str(i)})
+    assert reg.dropped_series().get("pipeline_stage") == 3
+
+
+# ---------------------------------------------------------------------
+# worker-side delta client
+def test_federation_client_ships_changes_once(global_state):
+    reg = get_metrics()
+    tel = get_telemetry()
+    tel.ensure_ring()
+    client = FederationClient(registry=reg, telemetry=tel)
+    reg.observe("serving_request_latency_ms", 3.0,
+             labels={"kind": "predict", "bucket": "1"})
+    tel.count("jit.compiles", 2)
+    d1 = client.delta()
+    assert any(h["n"] == "serving_request_latency_ms"
+               for h in d1["hists"])
+    assert d1["counters"]["jit.compiles"] == 2
+    # quiet series do not re-ship
+    d2 = client.delta()
+    assert "hists" not in d2 and "counters" not in d2
+    # a change re-ships the FULL cumulative state (replace-merge)
+    reg.observe("serving_request_latency_ms", 5.0,
+             labels={"kind": "predict", "bucket": "1"})
+    d3 = client.delta()
+    [h] = [h for h in d3["hists"]
+           if h["n"] == "serving_request_latency_ms"]
+    assert h["t"] == 2 and sum(h["c"]) == 2
+    # a fresh client (worker respawn) re-ships everything once
+    d4 = FederationClient(registry=reg, telemetry=tel).delta()
+    assert any(h["t"] == 2 for h in d4["hists"])
+
+
+def test_client_delta_merge_roundtrip_is_exact(global_state):
+    worker_reg = get_metrics()
+    for v in (1.0, 2.0, 300.0):
+        worker_reg.observe("serving_request_latency_ms", v,
+                        labels={"kind": "predict", "bucket": "1"})
+    delta = FederationClient(registry=worker_reg,
+                             telemetry=get_telemetry()).delta()
+    parent = MetricsRegistry()
+    parent.merge_snapshot("w1", delta)
+    m = parent.merged_hist("serving_request_latency_ms")
+    ref = worker_reg.merged_hist("serving_request_latency_ms")
+    assert m.counts == ref.counts and m.count == ref.count
+    assert m.sum == pytest.approx(ref.sum, rel=1e-6)
+
+
+# ---------------------------------------------------------------------
+# SLO specs: parsing + validation
+def test_parse_window_units():
+    assert parse_window("90s") == 90.0
+    assert parse_window("5m") == 300.0
+    assert parse_window("1h") == 3600.0
+    assert parse_window("500ms") == 0.5
+    with pytest.raises(ValueError):
+        parse_window("tomorrow")
+
+
+def test_parse_slo_spec_grammar():
+    s = parse_slo_spec("latency_p99:latency:0.99:250")
+    assert s.kind == "latency" and s.threshold_ms == 250.0
+    assert s.budget == pytest.approx(0.01)
+    a = parse_slo_spec("avail:availability:0.999")
+    assert a.budget == pytest.approx(0.001)
+    for bad in ("x:latency:0.99",          # latency needs threshold
+                "x:availability:1.5",      # objective out of range
+                "x:availability:1.0",      # no budget left
+                "x:nope:0.9",              # unknown kind
+                "justaname"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+    with pytest.raises(ValueError):
+        parse_slo_specs(["a:availability:0.9", "a:error_rate:0.9"])
+
+
+def test_specs_from_config_env_fallback(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_SLOS",
+                       "tight:availability:0.9999")
+    specs = specs_from_config(None)
+    assert [s.name for s in specs] == ["tight"]
+    monkeypatch.delenv("LGBM_TPU_SLOS")
+    names = {s.name for s in specs_from_config(None)}
+    assert "availability" in names and "latency_p99" in names
+
+
+# ---------------------------------------------------------------------
+# SLO engine: burn-rate math over cumulative samples
+def _engine(counts, specs, windows=("1m",), reg=None):
+    return SLOEngine(specs=parse_slo_specs(specs),
+                     windows=list(windows),
+                     counts_fn=lambda: dict(counts),
+                     interval_s=5.0, registry=reg or MetricsRegistry())
+
+
+def test_availability_burn_math():
+    counts = {"requests": 0, "errors": 0, "shed": 0, "unavailable": 0}
+    eng = _engine(counts, ["avail:availability:0.999"])
+    eng.sample(now=0.0)
+    counts.update(requests=1000, errors=3)
+    ev = eng.evaluate(now=61.0)
+    [entry] = ev["slos"]
+    # bad/total = 3/1000 = 0.003; budget = 0.001 -> burn 3.0
+    assert entry["windows"]["1m"]["burn"] == pytest.approx(3.0)
+    assert entry["breached"]
+    assert eng.max_burn() == pytest.approx(3.0)
+    assert eng.max_burn("1m") == pytest.approx(3.0)
+
+
+def test_shed_excluded_from_availability_by_default():
+    counts = {"requests": 0, "errors": 0, "shed": 0, "unavailable": 0}
+    eng = _engine(counts, ["avail:availability:0.999"])
+    eng.sample(now=0.0)
+    counts.update(requests=1000, shed=500)   # backpressure, not failure
+    ev = eng.evaluate(now=61.0)
+    assert ev["slos"][0]["windows"]["1m"]["burn"] == 0.0
+
+
+def test_unavailable_dispatch_burns_availability():
+    """A pool with no live replica produces zero requests but nonzero
+    `unavailable` — that must read as burning, not as 100% available
+    (the dead-fleet regression)."""
+    counts = {"requests": 0, "errors": 0, "shed": 0, "unavailable": 0}
+    eng = _engine(counts, ["avail:availability:0.999"])
+    eng.sample(now=0.0)
+    counts.update(unavailable=50)
+    ev = eng.evaluate(now=61.0)
+    # bad/total = 50/50 = 1.0 -> burn = 1000x budget
+    assert ev["slos"][0]["windows"]["1m"]["burn"] \
+        == pytest.approx(1000.0)
+
+
+def test_latency_burn_from_bucket_counts():
+    reg = MetricsRegistry()
+    # 990 fast + 10 slow observations; objective 0.99 under 250 ms:
+    # bad fraction 1% == the budget -> burn exactly 1.0
+    for _ in range(990):
+        reg.observe("fleet_request_latency_ms", 10.0,
+                 labels={"model": "m", "tenant": "default"})
+    for _ in range(10):
+        reg.observe("fleet_request_latency_ms", 5000.0,
+                 labels={"model": "m", "tenant": "default"})
+    eng = _engine({}, ["p99:latency:0.99:250"], reg=reg)
+    eng.sample(now=0.0)  # cumulative pair baseline is (1000, 10)...
+    ev = eng.evaluate(now=61.0)
+    burn = ev["slos"][0]["windows"]["1m"]["burn"]
+    # the baseline sample already holds the full histogram, so the
+    # window delta is zero -> re-observe to create a delta
+    assert burn == 0.0
+    for _ in range(990):
+        reg.observe("fleet_request_latency_ms", 10.0,
+                 labels={"model": "m", "tenant": "default"})
+    for _ in range(10):
+        reg.observe("fleet_request_latency_ms", 5000.0,
+                 labels={"model": "m", "tenant": "default"})
+    ev = eng.evaluate(now=122.0)
+    burn = ev["slos"][0]["windows"]["1m"]["burn"]
+    assert burn == pytest.approx(1.0, rel=0.05)
+
+
+def test_latency_burn_reads_federated_shards():
+    """The latency SLI must see worker-shard observations merged in —
+    the whole point of judging a process fleet fleet-wide."""
+    reg = MetricsRegistry()
+    eng = _engine({}, ["p99:latency:0.99:250"], reg=reg)
+    eng.sample(now=0.0)
+    name = "fleet_request_latency_ms"
+    start, factor, n = hist_layout(name)
+    shard = _observe_all(LogHistogram(start, factor, n),
+                         [10.0] * 90 + [9000.0] * 10)
+    reg.merge_snapshot("w0", {"hists": [
+        {"n": name, "l": {}, "c": list(shard.counts),
+         "t": shard.count, "s": shard.sum}]})
+    ev = eng.evaluate(now=61.0)
+    assert ev["slos"][0]["windows"]["1m"]["burn"] \
+        == pytest.approx(10.0, rel=0.05)
+
+
+def test_backwards_counters_start_new_origin():
+    counts = {"requests": 1000, "errors": 10}
+    eng = _engine(counts, ["err:error_rate:0.999"])
+    eng.sample(now=0.0)
+    # registry reset / respawn: cumulative counters went backwards
+    counts.update(requests=100, errors=100)
+    ev = eng.evaluate(now=61.0)
+    w = ev["slos"][0]["windows"]["1m"]
+    # latest sample is the new origin — never a negative delta
+    assert w["bad"] == 100 and w["total"] == 100
+    assert w["burn"] > 0
+
+
+def test_breach_requires_every_window_burning():
+    counts = {"requests": 0, "errors": 0}
+    eng = _engine(counts, ["err:error_rate:0.999"],
+                  windows=("1m", "5m"))
+    # long clean history, then a 1m spike: the 5m window dilutes it
+    eng.sample(now=0.0)
+    counts.update(requests=100000, errors=0)
+    eng.sample(now=240.0)
+    counts.update(requests=100100, errors=5)
+    ev = eng.evaluate(now=301.0)
+    w = ev["slos"][0]["windows"]
+    assert w["1m"]["burn"] > 1.0       # fast window on fire
+    assert w["5m"]["burn"] < 1.0       # slow window says "blip"
+    assert not ev["slos"][0]["breached"]
+
+
+def test_evaluate_publishes_burn_gauges_and_telemetry():
+    reg = MetricsRegistry()
+    tel = get_telemetry()
+    tel.reset()
+    counts = {"requests": 0, "errors": 0}
+    eng = _engine(counts, ["err:error_rate:0.999"], reg=reg)
+    eng.sample(now=0.0)
+    counts.update(requests=1000, errors=2)
+    eng.evaluate(now=61.0)
+    samples, _ = validate_prometheus(reg.render())
+    key = ("lgbm_slo_burn", 'slo="err",window="1m"')
+    assert key in samples and samples[key] == pytest.approx(2.0)
+    tel.reset()
+
+
+def test_engine_from_config_reads_params():
+    class Cfg:
+        slo_specs = ["a:availability:0.99"]
+        slo_windows = ["30s", "2m"]
+        slo_eval_interval_s = 1.0
+    eng = engine_from_config(Cfg())
+    assert [s.name for s in eng.specs] == ["a"]
+    assert eng.windows == ["30s", "2m"]
+    assert eng.interval_s == 1.0
+
+
+# ---------------------------------------------------------------------
+# ramp gate on SLO burn
+def test_ramp_slo_burn_gate():
+    m = StageMetrics(stage=0, weight=0.25, requests=64,
+                     canary_requests=16, canary_p99_ms=10.0,
+                     baseline_p99_ms=10.0, health_status="ok")
+    # default: the gate is OFF — even a screaming burn doesn't trip
+    m.slo_burn = 50.0
+    assert evaluate_stage(m).ok
+    th = RampThresholds(max_slo_burn=2.0)
+    v = evaluate_stage(m, th)
+    assert v.decision == "rollback"
+    assert any(r.startswith("slo_burn") for r in v.reasons)
+    # burn inside tolerance, or no engine running -> advance
+    m.slo_burn = 1.5
+    assert evaluate_stage(m, th).ok
+    m.slo_burn = None
+    assert evaluate_stage(m, th).ok
+
+
+# ---------------------------------------------------------------------
+# remote span replay
+def test_replay_remote_spans_one_cross_process_tree(global_state):
+    tr = get_tracer()
+    tr.reset()
+    tr.configure()
+    try:
+        ctx = TraceContext("beefbeefbeefbeef", "cafe0001")
+        now = time.time()
+        records = [
+            {"name": "worker.request", "root": True,
+             "t0": now - 0.050, "t1": now,
+             "args": {"replica": 1, "pid": 4242, "kind": "predict",
+                      "queue_ms": 12.0, "compute_ms": 30.0}},
+            {"name": "worker.decode", "t0": now - 0.050,
+             "t1": now - 0.048},
+            {"name": "worker.queue_wait", "t0": now - 0.048,
+             "t1": now - 0.036},
+            {"name": "worker.device", "t0": now - 0.036,
+             "t1": now - 0.006, "args": {"bucket": 8}},
+            {"name": "worker.encode", "t0": now - 0.006, "t1": now},
+        ]
+        assert tr.replay_remote_spans(records, ctx) == 5
+        evs = {e["name"]: e for e in tr.events if e.get("ph") == "X"}
+        assert set(evs) == {"worker.request", "worker.decode",
+                            "worker.queue_wait", "worker.device",
+                            "worker.encode"}
+        # every span joined the PARENT trace
+        assert all(e["args"]["trace_id"] == ctx.trace_id
+                   for e in evs.values())
+        root = evs["worker.request"]
+        assert root["args"]["parent_id"] == ctx.span_id
+        for name in ("worker.decode", "worker.queue_wait",
+                     "worker.device", "worker.encode"):
+            assert evs[name]["args"]["parent_id"] \
+                == root["args"]["span_id"]
+        # queue-wait vs device decomposition survives the replay
+        assert evs["worker.queue_wait"]["dur"] \
+            == pytest.approx(12000.0, rel=0.01)
+        assert evs["worker.device"]["dur"] \
+            == pytest.approx(30000.0, rel=0.01)
+        # malformed records are skipped, not fatal
+        assert tr.replay_remote_spans(
+            [{"name": "x"}, "junk"], ctx) == 0
+        assert tr.replay_remote_spans([], ctx) == 0
+    finally:
+        tr.reset()
+
+
+# ---------------------------------------------------------------------
+# process-fleet integration (slow: spawns real worker processes)
+def _toy(n=300, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train():
+    X, y = _toy()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    return bst, X
+
+
+@pytest.mark.slow
+def test_process_fleet_parent_scrape_federates(global_state):
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      ServingConfig)
+    bst, X = _train()
+    fl = FleetEngine(
+        models={"m": bst},
+        config=ServingConfig(buckets=(4, 16), device="never",
+                             flush_interval_ms=1.0,
+                             request_timeout_ms=30000),
+        replicas=2, default_model="m", isolation="process",
+        proc_opts=ProcFleetOptions(heartbeat_ms=50,
+                                   heartbeat_timeout_ms=2000,
+                                   spawn_timeout_s=90,
+                                   backoff_base_s=0.05,
+                                   restart_max=3))
+    try:
+        for i in range(12):
+            fl.predict(X[i:i + 4])
+        reg = get_metrics()
+        # deltas ride the pong cadence: wait until every worker has
+        # shipped a shard AND the merged histogram covers all requests
+        assert _wait(lambda: len([w for w in
+                                  reg.federation_workers()
+                                  if w["series"] > 0]) == 2
+                     and reg.merged_hist(
+                         "serving_request_latency_ms",
+                         include_local=False).count >= 12, 20), \
+            reg.federation_workers()
+        text = reg.render()
+        samples, _ = validate_prometheus(text)
+        for rid in ("0", "1"):
+            lab = f'worker="{rid}"'
+            # acceptance: every worker's latency histogram + device
+            # gauges under the worker label, from ONE parent scrape
+            assert any(
+                k[0] == "lgbm_serving_request_latency_ms_bucket"
+                and lab in k[1] for k in samples), (rid, text[:2000])
+            assert any(k[0] in ("lgbm_live_bytes",
+                                "lgbm_device_bytes_in_use")
+                       and lab in k[1] for k in samples), rid
+            assert samples.get(("lgbm_worker_stale", lab)) == 0.0
+        # merged fleet histogram covers every request exactly once
+        merged = reg.merged_hist("serving_request_latency_ms",
+                                 include_local=False)
+        assert merged.count >= 12
+    finally:
+        fl.stop()
+
+
+@pytest.mark.slow
+def test_process_fleet_remote_spans_join_parent_trace(global_state):
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      ServingConfig)
+    tr = get_tracer()
+    tr.reset()
+    tr.configure()
+    bst, X = _train()
+    fl = FleetEngine(
+        models={"m": bst},
+        config=ServingConfig(buckets=(4, 16), device="never",
+                             flush_interval_ms=1.0,
+                             request_timeout_ms=30000),
+        replicas=1, default_model="m", isolation="process",
+        proc_opts=ProcFleetOptions(heartbeat_ms=50,
+                                   heartbeat_timeout_ms=2000,
+                                   spawn_timeout_s=90,
+                                   backoff_base_s=0.05,
+                                   restart_max=3))
+    try:
+        for i in range(4):
+            fl.predict(X[i:i + 2])
+        evs = [e for e in tr.events if e.get("ph") == "X"]
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        assert "worker.request" in by_name, sorted(by_name)
+        # acceptance: parent + worker spans under ONE trace id, with
+        # the queue-wait vs device-time decomposition present
+        roots = by_name["fleet.request"]
+        trace_ids = {e["args"]["trace_id"] for e in roots}
+        wr = by_name["worker.request"][-1]
+        assert wr["args"]["trace_id"] in trace_ids
+        assert "worker.queue_wait" in by_name
+        assert "worker.device" in by_name
+        wq = by_name["worker.queue_wait"][-1]
+        wd = by_name["worker.device"][-1]
+        assert wq["args"]["trace_id"] == wr["args"]["trace_id"]
+        assert wd["args"]["parent_id"]
+        # worker pid differs from the parent's: truly cross-process
+        assert wr["args"].get("pid") not in (None, os.getpid())
+    finally:
+        fl.stop()
+        tr.reset()
+
+
+@pytest.mark.slow
+def test_kill_mid_load_flips_staleness_and_burns_slo(global_state):
+    """Acceptance regression: killing a worker mid-load (a) flips the
+    staleness gauge within one heartbeat interval, (b) trips the
+    availability SLO burn once the pool cannot dispatch."""
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      ServingConfig)
+    bst, X = _train()
+    hb_timeout_ms = 1500
+    fl = FleetEngine(
+        models={"m": bst},
+        config=ServingConfig(buckets=(4, 16), device="never",
+                             flush_interval_ms=1.0,
+                             request_timeout_ms=4000),
+        replicas=1, default_model="m", isolation="process",
+        proc_opts=ProcFleetOptions(heartbeat_ms=50,
+                                   heartbeat_timeout_ms=hb_timeout_ms,
+                                   spawn_timeout_s=90,
+                                   backoff_base_s=0.05,
+                                   restart_max=0))  # no respawn
+    eng = SLOEngine(specs=parse_slo_specs(
+        ["avail:availability:0.999"]), windows=["1m"],
+        counts_fn=fl.slo_counts, interval_s=5.0,
+        registry=get_metrics())
+    try:
+        fl.predict(X[:4])                     # healthy baseline
+        eng.sample(now=0.0)
+        reg = get_metrics()
+        victim = fl.replicas[0]
+        t_kill = time.monotonic()
+        os.kill(victim.pid, signal.SIGKILL)
+
+        def _stale():
+            return any(w["stale"]
+                       for w in reg.federation_workers())
+        assert _wait(_stale, hb_timeout_ms / 1000.0 + 2.0), \
+            "staleness gauge never flipped after the kill"
+        # flagged within ~one heartbeat-timeout interval of the death
+        assert time.monotonic() - t_kill \
+            <= hb_timeout_ms / 1000.0 + 2.0
+        samples, _ = validate_prometheus(reg.render())
+        assert samples.get(("lgbm_worker_stale",
+                            f'worker="{victim.rid}"')) == 1.0
+        # a dead pool fails dispatch -> unavailable counts -> burn
+        _wait(lambda: victim.state != "ok", 10)
+        for i in range(5):
+            try:
+                fl.predict(X[:2])
+            except Exception:
+                pass
+        assert fl.slo_counts()["unavailable"] >= 1, fl.slo_counts()
+        ev = eng.evaluate(now=61.0)
+        [entry] = ev["slos"]
+        assert entry["windows"]["1m"]["burn"] > 1.0, ev
+        assert entry["breached"]
+    finally:
+        eng.stop()
+        fl.stop()

@@ -234,6 +234,58 @@ def test_metrics_render_is_valid_prometheus(tel):
         assert samples[count_key] == cums[-1]
 
 
+def _unescape_label(v):
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def test_hostile_label_values_escape_conformant(tel):
+    """Escaping conformance (exposition format 0.0.4): label values
+    containing backslashes, double quotes and newlines must render as
+    \\\\, \\" and \\n — single-character grammar check is not enough,
+    the ROUND-TRIP must recover the original value exactly."""
+    reg = get_metrics()
+    hostile = ['back\\slash', 'quo"te', 'new\nline',
+               'every\\"\nkind', '\\n literal', 'trailing\\']
+    for i, v in enumerate(hostile):
+        reg.set_gauge("pipeline_stage", float(i), labels={"stage": v})
+    # hostile values arriving over the federation socket render the
+    # same way (worker shards go through the same escaper)
+    reg.merge_snapshot("w9", {"gauges": [
+        {"n": "fleet_replica_state", "l": {"rid": 'r"\\\n0'},
+         "v": 2.0}]})
+    text = metrics_text()
+    samples, _ = validate_prometheus(text)   # grammar: every line parses
+    label_re = re.compile(
+        r'stage="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+    seen = set()
+    for (name, labels) in samples:
+        if name != "lgbm_pipeline_stage":
+            continue
+        m = label_re.search(labels)
+        assert m, labels
+        seen.add(_unescape_label(m.group(1)))
+    assert seen == set(hostile)
+    # the federated hostile value round-trips too
+    fed = [l for (n, l) in samples
+           if n == "lgbm_fleet_replica_state" and 'worker="w9"' in l]
+    assert fed, text
+    m = re.search(r'rid="((?:[^"\\\n]|\\\\|\\"|\\n)*)"', fed[0])
+    assert m and _unescape_label(m.group(1)) == 'r"\\\n0'
+    # raw control characters never leak into the exposition
+    for line in text.split("\n"):
+        assert "\r" not in line
+    reg.drop_worker("w9")
+
+
 def test_metrics_endpoint_under_load_zero_recompiles(tel, model,
                                                      monkeypatch):
     """Scrape ``GET /metrics`` on the serving frontend DURING a loadgen

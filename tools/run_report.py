@@ -168,6 +168,23 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "restarts": r.get("restarts"),
             "detail": str(r.get("detail", ""))[:80]})
 
+    # SLO burn-rate history (observability/slo.py `slo` telemetry
+    # records): latest state per spec plus how often it was breached
+    # (every configured window burning > 1.0 at once)
+    slo: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") != "slo" or not r.get("name"):
+            continue
+        e = slo.setdefault(str(r["name"]),
+                           {"evaluations": 0, "breaches": 0})
+        e["evaluations"] += 1
+        if r.get("breached"):
+            e["breaches"] += 1
+        e["slo_kind"] = r.get("slo_kind")
+        e["objective"] = r.get("objective")
+        e["max_burn"] = r.get("max_burn")
+        e["windows"] = r.get("windows")
+
     counters_all = end.get("counters") or {}
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
@@ -195,6 +212,7 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "device_count": run.get("device_count"),
         "serving": serving,
         "fleet": fleet,
+        "slo": slo,
         "hists": hists,
         "tpu_probe": None if probe_rec is None else {
             k: probe_rec.get(k) for k in
@@ -444,6 +462,23 @@ def render(records: List[Dict[str, Any]]) -> str:
             L.append("death modes: " + " ".join(
                 f"{k}={v}" for k, v in sorted(codes.items(),
                                               key=lambda kv: -kv[1])))
+
+    if d.get("slo"):
+        L.append("")
+        L.append("== slo burn rates (observability/slo.py) ==")
+        L.append(f"{'slo':<16}{'kind':<14}{'objective':>10}"
+                 f"{'max_burn':>10}{'breaches':>10}  windows")
+        for name, e in sorted(d["slo"].items()):
+            wins = e.get("windows") or {}
+            wtxt = " ".join(f"{w}={b:g}" for w, b in sorted(
+                wins.items())) if isinstance(wins, dict) else "-"
+            burn = e.get("max_burn")
+            br = f"{e['breaches']}/{e['evaluations']}"
+            L.append(
+                f"{name:<16}{str(e.get('slo_kind')):<14}"
+                f"{e.get('objective'):>10}"
+                f"{'-' if burn is None else format(burn, '.3g'):>10}"
+                f"{br:>10}  {wtxt}")
 
     if d.get("hists"):
         L.append("")

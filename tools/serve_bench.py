@@ -242,6 +242,80 @@ def _http_probe(engine, X, n: int = 3):
     return ok
 
 
+def _start_obs_scraper(engine, interval_s: float = 1.0):
+    """Scrape the parent /metrics endpoint at ~1 Hz for the duration of
+    the soak, the way a real Prometheus would, and tally what the CI
+    observability gate needs: every scrape must parse, dead workers
+    must show up as stale (not silently frozen), and the cardinality
+    cap must never trip under the storm. Returns a finish() closure
+    that stops the scraper, shuts the server down, and hands back the
+    tallies; returns None if the HTTP frontend cannot bind."""
+    import re
+    import threading
+    import time
+    import urllib.request
+
+    from lightgbm_tpu.serving.http import make_http_server
+    try:
+        server = make_http_server(engine, port=0)
+    except OSError:
+        return None
+    srv_thread = threading.Thread(target=server.serve_forever, daemon=True)
+    srv_thread.start()
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}/metrics"
+
+    stale_re = re.compile(r'^lgbm_worker_stale\{worker="[^"]+"\} 1(?:\.0)?\s*$',
+                          re.MULTILINE)
+    worker_re = re.compile(r'\{[^}]*worker="[^"]+"[^}]*\}')
+    dropped_re = re.compile(r'^lgbm_metrics_dropped_series\{[^}]*\} (\d+)',
+                            re.MULTILINE)
+
+    out = {"scrapes": 0, "failures": 0, "stale_seen": 0,
+           "worker_series_seen": 0, "max_scrape_ms": 0.0,
+           "dropped_series_final": 0}
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def loop():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    text = resp.read().decode("utf-8", "replace")
+                ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    out["scrapes"] += 1
+                    out["max_scrape_ms"] = max(out["max_scrape_ms"], ms)
+                    if stale_re.search(text):
+                        out["stale_seen"] += 1
+                    out["worker_series_seen"] = max(
+                        out["worker_series_seen"],
+                        len(worker_re.findall(text)))
+                    dropped = sum(int(m) for m in dropped_re.findall(text))
+                    out["dropped_series_final"] = dropped
+            except Exception:  # noqa: BLE001 - gate counts, never raises
+                with lock:
+                    out["failures"] += 1
+            stop.wait(interval_s)
+
+    scr_thread = threading.Thread(target=loop, daemon=True)
+    scr_thread.start()
+
+    def finish():
+        stop.set()
+        scr_thread.join(timeout=10.0)
+        try:
+            server.shutdown()
+            server.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+        with lock:
+            return dict(out)
+
+    return finish
+
+
 def _arm_sigterm(fleet, state):
     """SIGTERM mid-soak: flight-recorder dump + graceful drain; the
     soak block still prints (flagged preempted). The recorder arms
@@ -334,6 +408,12 @@ def main(argv=None) -> int:
     ap.add_argument("--assert-availability", type=float, default=-1.0,
                     help="exit 1 when soak availability drops below "
                          "this (e.g. 1.0 = zero non-shed errors)")
+    ap.add_argument("--obs-soak", action="store_true",
+                    help="scrape the parent /metrics once per second "
+                         "for the whole soak and report an 'obs' "
+                         "block (scrape failures, federated worker "
+                         "series, stale sightings, dropped-series "
+                         "overflow) — the CI observability-soak gate")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -374,6 +454,8 @@ def main(argv=None) -> int:
         if args.pipeline_cycles > 0:
             pipe_thread, pipe_holder = _start_pipeline(
                 args, engine, args.workdir)
+        obs_finish = _start_obs_scraper(engine) if args.obs_soak \
+            else None
         block = soak_loop(
             engine, X, duration_s=args.duration, qps=args.qps,
             batch_sizes=batch_sizes, models=models, tenants=tenants,
@@ -386,6 +468,8 @@ def main(argv=None) -> int:
         if pipe_thread is not None:
             pipe_thread.join(120.0)
             result["pipeline"] = _pipeline_verdict(args, pipe_holder)
+        if obs_finish is not None:
+            result["obs"] = obs_finish()
         block["preempted"] = state["preempted"]
         block["backend"] = result["backend"]
         result["fleet"] = block
