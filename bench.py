@@ -340,6 +340,109 @@ def measure_fleet_isolation(booster, X):
         out["process_overhead_pct"] = round(
             100.0 * (out["process_p99_ms"] / out["thread_p99_ms"]
                      - 1.0), 1)
+    out.update(measure_aot_serving(booster, X))
+    if out.get("restart_ready_ms") and out.get("aot_restart_ready_ms"):
+        # how much of the host-route respawn bill the AOT artifact
+        # replay saves (positive = AOT respawns faster)
+        out["aot_restart_improvement_pct"] = round(
+            100.0 * (1.0 - out["aot_restart_ready_ms"]
+                     / out["restart_ready_ms"]), 1)
+    return out
+
+
+def measure_aot_serving(booster, X):
+    """The zero-Python hot path legs of the fleet_isolation block:
+
+    * AOT column — a process fleet serving an AOT-published model on
+      the device route (replayed executables, zero retraces):
+      soak p50/p99 + the gated ``single_row_p99_ms`` series from a
+      sequential single-row loop, plus the warm AOT respawn cost
+      (``aot_restart_ready_ms``, vs the host-route respawn above);
+    * shm vs JSON transport — the same large-batch loop through the
+      shm ring and through ProcFleetOptions(shm=False); the delta is
+      the JSON encode/decode bill (``shm_speedup_pct``, gated via
+      the shm leg attribution in tools/bench_trend.py).
+    """
+    import os
+    import signal
+    import time as _time
+
+    import numpy as np
+
+    from lightgbm_tpu.serving import (FleetEngine, ProcFleetOptions,
+                                      ServingConfig)
+    from lightgbm_tpu.serving.loadgen import soak_loop
+    dur = float(os.environ.get("BENCH_FLEET_ISO_S", 2))
+    qps = float(os.environ.get("BENCH_FLEET_ISO_QPS", 120))
+    text = booster.model_to_string()
+    big = X[:512] if len(X) >= 512 else X
+    out = {"aot_batch_rows": int(len(big))}
+
+    def _timed_loop(fl, data, budget_s):
+        lats, deadline = [], _time.monotonic() + budget_s
+        while _time.monotonic() < deadline:
+            t0 = _time.perf_counter()
+            fl.predict(data, timeout_ms=20000)
+            lats.append((_time.perf_counter() - t0) * 1000.0)
+        return lats
+
+    def _pcts(prefix, lats):
+        if not lats:
+            return {}
+        arr = np.asarray(lats)
+        return {f"{prefix}_p50_ms": round(float(np.percentile(arr, 50)), 3),
+                f"{prefix}_p99_ms": round(float(np.percentile(arr, 99)), 3),
+                f"{prefix}_calls": len(lats)}
+
+    for transport in ("shm", "json"):
+        fl = FleetEngine(
+            config=ServingConfig(buckets=(1, 64, 1024),
+                                 device="always",
+                                 flush_interval_ms=1.0,
+                                 request_timeout_ms=20000),
+            replicas=1, default_model="base", isolation="process",
+            proc_opts=ProcFleetOptions(restart_max=3,
+                                       shm=(transport == "shm"),
+                                       shm_min_bytes=4096))
+        try:
+            fl.load_model("base", text, aot_booster=booster)
+            rep = fl._proc_supervisor._replicas[0]
+            if transport == "shm":
+                out["aot_route"] = bool(rep.aot_models.get("base"))
+                blk = soak_loop(fl, X, duration_s=dur, qps=qps,
+                                batch_sizes=(1, 64), models=["base"],
+                                timeout_ms=20000)
+                out["aot_p50_ms"] = blk["p50_ms"]
+                out["aot_p99_ms"] = blk["p99_ms"]
+                out["aot_throughput_rps"] = blk["throughput_rps"]
+                out["aot_availability"] = blk["availability"]
+                # the gated single-row cost model series: sequential
+                # closed-loop single rows = pure per-call floor
+                out.update(_pcts("single_row", _timed_loop(
+                    fl, X[:1], min(dur, 2.0))))
+            out.update(_pcts(f"{transport}_large_batch", _timed_loop(
+                fl, big, min(dur, 2.0))))
+            if transport == "shm":
+                shm = rep.describe().get("shm") or {}
+                out["shm_writes"] = shm.get("writes")
+                # AOT respawn: artifact + executables replay from the
+                # persistent cache — compare with the host-route
+                # restart_ready_ms of the process leg above
+                os.kill(rep.pid, signal.SIGKILL)
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline \
+                        and rep.state != "ok":
+                    _time.sleep(0.05)
+                out["aot_restart_ready_ms"] = rep.restart_ready_ms \
+                    if rep.state == "ok" else None
+                out["aot_restart_compiles"] = rep.cold_start_compiles
+        finally:
+            fl.stop()
+    if out.get("shm_large_batch_p99_ms") \
+            and out.get("json_large_batch_p99_ms"):
+        out["shm_speedup_pct"] = round(
+            100.0 * (out["json_large_batch_p99_ms"]
+                     / out["shm_large_batch_p99_ms"] - 1.0), 1)
     return out
 
 

@@ -145,6 +145,13 @@ _PARAM_ALIASES: Dict[str, str] = {
     "serving_canary": "serving_canary_model",
     "serving_shadow": "serving_shadow_model",
     "serving_quota_rate": "serving_quota_qps",
+    "quota_unit": "serving_quota_unit",
+    "serving_quota_cost_unit": "serving_quota_unit",
+    "aot": "serving_aot", "serving_aot_artifacts": "serving_aot",
+    "shm": "serving_shm", "serving_shm_transport": "serving_shm",
+    "shm_slots": "serving_shm_slots",
+    "shm_slot_bytes": "serving_shm_slot_bytes",
+    "shm_min_bytes": "serving_shm_min_bytes",
     "isolation": "serving_isolation",
     "replica_isolation": "serving_isolation",
     "serving_replica_restart_max": "replica_restart_max",
@@ -413,6 +420,18 @@ class Config:
     serving_canary_model: str = ""
     serving_canary_weight: float = 0.0
     serving_shadow_model: str = ""
+    # what one quota token buys: "requests" (one call, one token) or
+    # "bytes" (a call costs its decoded f64 payload bytes — rates
+    # above become bytes/second, bounding data volume not call count)
+    serving_quota_unit: str = "requests"
+    # ---- AOT predict artifacts (serving/aot.py, docs/Serving.md
+    # "AOT artifacts"): when on, a model publish builds a serialized
+    # predict artifact (stacked tree arrays + bin mappers + the
+    # AOT-compiled shape-bucket executables persisted in the compile
+    # cache) that process workers replay at load/respawn, so the
+    # device route serves with ZERO retraces and no training dataset
+    # in the worker
+    serving_aot: bool = True
     # ---- process isolation (serving/procfleet.py, docs/Serving.md
     # "Process isolation"): serving_isolation=process runs every
     # replica's ServingEngine in its own spawned OS process (own JAX
@@ -425,6 +444,15 @@ class Config:
     # fleet degrades, it never dies).
     serving_isolation: str = "thread"  # thread | process
     replica_restart_max: int = 3       # respawns before quarantine
+    # shared-memory row transport (serving/shm_ring.py): each process
+    # worker gets a seqlock'd shared-memory ring; batches whose f64
+    # payload is >= serving_shm_min_bytes travel as raw row blocks
+    # instead of JSON arrays (the socket frame stays the control
+    # channel and the small-batch / ring-full fallback path)
+    serving_shm: bool = True
+    serving_shm_slots: int = 4
+    serving_shm_slot_bytes: int = 1048576   # 1 MiB per slot
+    serving_shm_min_bytes: int = 16384      # below this, JSON framing
     replica_heartbeat_ms: float = 200.0
     replica_heartbeat_timeout_ms: float = 3000.0
     replica_spawn_timeout_s: float = 120.0
@@ -677,6 +705,17 @@ class Config:
                 "serving_canary_weight must be in [0, 1]")
         if self.serving_quota_qps < 0 or self.serving_quota_burst < 0:
             raise ValueError("serving_quota_* must be >= 0")
+        if self.serving_quota_unit not in ("requests", "bytes"):
+            raise ValueError(
+                f"serving_quota_unit={self.serving_quota_unit!r} is "
+                "not requests|bytes")
+        if self.serving_shm_slots < 1:
+            raise ValueError("serving_shm_slots must be >= 1")
+        if self.serving_shm_slot_bytes < 4096:
+            raise ValueError(
+                "serving_shm_slot_bytes must be >= 4096")
+        if self.serving_shm_min_bytes < 0:
+            raise ValueError("serving_shm_min_bytes must be >= 0")
         if self.serving_isolation not in ("thread", "process"):
             raise ValueError(
                 f"serving_isolation={self.serving_isolation!r} is not "

@@ -56,8 +56,14 @@ class Publisher:
                                      "name": name}) as sp:
             try:
                 with tel.span("pipeline.publish"):
+                    # the candidate's dataset-backed booster is the
+                    # AOT artifact donor: the fleet validates and
+                    # serves the TEXT (the parity standard), while the
+                    # artifact built from the booster unlocks the
+                    # zero-compile device route in process workers
                     cand.version = self.fleet.load_model(
-                        name, cand.model_text)
+                        name, cand.model_text,
+                        aot_booster=cand.booster)
             except Exception as e:
                 cand.mark("rejected", f"publish_failed: {e}")
                 tel.count("pipeline.publish_failures")

@@ -399,6 +399,34 @@ def _scan_trees(binned, col, off, thr, dec, left, right, miss, dbin, nbin,
     return acc
 
 
+@register_jit("predict_scan_leaf_idx")
+@functools.partial(jax.jit, static_argnames=("mv_present",))
+def _scan_leaf_idx(binned, col, off, thr, dec, left, right, miss, dbin,
+                   nbin, cat, leaf_vals, n_leaves, tree_class,
+                   mv_slots=None, mv_present=False):
+    """Leaf INDICES for all trees x all rows in one dispatch: the
+    bin-space traversal without the f32 leaf gather. The AOT serving
+    artifact (serving/aot.py) runs this on device and gathers the
+    float64 leaf values on host in tree order — the summation then
+    matches the vectorized host loop bit for bit, which the f32
+    ``_scan_trees`` accumulator cannot. Returns [N, T] int32."""
+    import jax.numpy as jnp
+    from .models.tree import _traverse_arrays_idx
+
+    def body(carry, tree):
+        (c, o, th, d, lt, r, mi, db, nb, ct, lv, nl, _cls) = tree
+        idx = _traverse_arrays_idx(binned, c, o, th, d, lt, r, mi, db,
+                                   nb, ct, lv, nl, mv_slots=mv_slots,
+                                   mv_present=mv_present)
+        return carry, idx
+
+    _, out = jax.lax.scan(
+        body, 0,
+        (col, off, thr, dec, left, right, miss, dbin, nbin, cat,
+         leaf_vals, n_leaves, tree_class))
+    return jnp.transpose(out).astype(jnp.int32)
+
+
 @register_jit("predict_scan_trees_linear")
 @functools.partial(jax.jit, static_argnames=("k", "mv_present"))
 def _scan_trees_linear(binned, col, off, thr, dec, left, right, miss,

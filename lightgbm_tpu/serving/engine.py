@@ -95,6 +95,7 @@ class ServingConfig:
     warmup: bool = True
     warmup_kinds: Tuple[str, ...] = ("predict", "raw_score")
     fallback_to_host: bool = True
+    aot: bool = True                 # publish/attach AOT artifacts
 
     def __post_init__(self):
         self.buckets = _pow2_buckets(self.buckets)
@@ -118,7 +119,8 @@ class ServingConfig:
                                "request_timeout_ms"),
                               ("serving_shed_policy", "shed_policy"),
                               ("serving_device", "device"),
-                              ("serving_warmup", "warmup")):
+                              ("serving_warmup", "warmup"),
+                              ("serving_aot", "aot")):
             if hasattr(cfg, src_name):
                 kw[dst] = getattr(cfg, src_name)
         return cls(**kw)
@@ -229,10 +231,18 @@ class ServingEngine:
             self.load(source)
 
     # -- model lifecycle -----------------------------------------------
-    def load(self, source) -> int:
+    def load(self, source, aot=None) -> int:
         """Load + warm up + atomically activate a model version; the
         previous version (if any) drains. Returns the new version id.
         In-flight and queued requests never fail across the swap.
+
+        ``aot`` names an AOT predict artifact (serving/aot.py) built
+        by the publisher for this exact model text — attaching it
+        unlocks the device route for text-published models with zero
+        compiles (the executables replay from the persistent cache).
+        Artifact trouble degrades to the host route rather than
+        failing the load: the model text itself is intact, and the
+        host route is the parity standard anyway.
 
         A failed (re)load — e.g. a torn model file rejected by the
         registry's integrity checks — raises, KEEPS the previous
@@ -241,6 +251,9 @@ class ServingEngine:
         pin = self.config.device != "never"
         try:
             mv = self.registry.load(source, pin_device=pin)
+            if aot and self.config.aot \
+                    and self.config.device != "never":
+                self._attach_aot(mv, aot, source)
             if self.config.warmup:
                 self._warmup(mv)
         except Exception as e:
@@ -263,6 +276,23 @@ class ServingEngine:
 
     reload = load
 
+    def _attach_aot(self, mv, path: str, source) -> None:
+        """Attach an AOT artifact to a fresh version; the sha binds it
+        to the model text being loaded. Failure counts + degrades to
+        host (publish-time round-trip already validated the bundle, so
+        a failure here is artifact loss — e.g. a cleaned cache dir
+        between respawn replays — not a correctness hazard)."""
+        from .aot import load_artifact, text_sha
+        try:
+            expected = text_sha(source) if isinstance(source, str) \
+                and "\n" in source else None
+            mv.attach_aot(load_artifact(path, expected_sha=expected))
+            self._count("aot_attach")
+        except Exception as e:
+            self._count("aot_attach_failures")
+            log_warning(f"serving: AOT artifact unusable ({e}); "
+                        "serving the host route")
+
     def _warmup(self, mv) -> None:
         """Eagerly compile every configured bucket for the new version
         BEFORE it takes traffic (reload pays compile off the hot path).
@@ -270,7 +300,7 @@ class ServingEngine:
         if not mv.device_ready:
             return
         tel = get_telemetry()
-        nfeat = mv.dataset.num_total_features
+        nfeat = self._num_features(mv)
         t0 = time.perf_counter()
         with tel.span("serving.warmup"):
             for b in self.config.buckets:
@@ -371,6 +401,8 @@ class ServingEngine:
     def _num_features(mv) -> int:
         if mv.dataset is not None:
             return int(mv.dataset.num_total_features)
+        if getattr(mv, "aot", None) is not None:
+            return int(mv.aot.num_total_features)
         return int(getattr(mv.src, "max_feature_idx", 0)) + 1
 
     def submit(self, rows, kind: str = "predict",
@@ -647,12 +679,26 @@ class ServingEngine:
         # (shape-stable -> no new eager-op compiles), slice back
         cap = self.config.buckets[-1]
         tracer = get_tracer()
+        # text-published models with an attached AOT artifact have no
+        # stacked dataset arrays; their device route is the leaf-index
+        # scan + host f64 gather (bit-identical to the host loop)
+        use_aot = mv.stacked is None and getattr(mv, "aot", None) \
+            is not None
         # the jit_registry program this dispatch runs — every device
         # span on the timeline is attributable to a graftcheck-
         # registered compiled program by name
-        program = "predict_scan_trees_linear" \
-            if getattr(mv.stacked, "any_linear", False) \
-            else "predict_scan_trees"
+        if use_aot:
+            program = "predict_scan_leaf_idx"
+        else:
+            program = "predict_scan_trees_linear" \
+                if getattr(mv.stacked, "any_linear", False) \
+                else "predict_scan_trees"
+
+        def _raw(chunk):
+            if use_aot:
+                return mv.aot.predict_raw(chunk)
+            return predictor.predict(mv.src, chunk, raw_score=True,
+                                     device=True, stacked=mv.stacked)
         parts: List[np.ndarray] = []
         for lo in range(0, len(x), cap):
             chunk = x[lo:lo + cap]
@@ -672,13 +718,9 @@ class ServingEngine:
                 dargs.update(bucket=b, rows=n, version=mv.version)
                 with tracer.span("device.dispatch", cat="device",
                                  args=dargs):
-                    raw = predictor.predict(mv.src, chunk,
-                                            raw_score=True,
-                                            device=True,
-                                            stacked=mv.stacked)
+                    raw = _raw(chunk)
             else:
-                raw = predictor.predict(mv.src, chunk, raw_score=True,
-                                        device=True, stacked=mv.stacked)
+                raw = _raw(chunk)
             out = convert_output(mv.src, raw) if kind == "predict" \
                 else raw
             parts.append(np.asarray(out)[:n])
@@ -757,6 +799,11 @@ class ServingEngine:
         total_b = out["bucket_hits"] + out["bucket_misses"]
         out["bucket_hit_rate"] = round(out["bucket_hits"] / total_b, 4) \
             if total_b else None
+        # AOT artifact lifecycle (serving/aot.py): attaches replay the
+        # published executables; failures mean the host route served
+        for k in ("aot_attach", "aot_attach_failures"):
+            if k in counts:
+                out[k] = int(counts[k])
         if slowest:
             out["slowest_request"] = slowest
         if lats:

@@ -447,12 +447,18 @@ class FleetEngine:
                    proc_opts=ProcFleetOptions.from_config(cfg))
 
     # -- model lifecycle ----------------------------------------------
-    def load_model(self, name: str, source) -> int:
+    def load_model(self, name: str, source,
+                   aot_booster=None) -> int:
         """Load + warm + atomically activate a version of ``name``
         (the multi-model analog of ``ServingEngine.load``). The warmup
         compiles (or cache-replays) every shape bucket ONCE for the
         whole pool — replicas share the version's pinned arrays and
-        the compiled programs."""
+        the compiled programs.
+
+        ``aot_booster`` (pipeline publishes) is the dataset-backed
+        booster behind a text ``source``: process-mode publishes build
+        an AOT predict artifact from it (serving/aot.py) so workers
+        serve the device route with zero compiles."""
         pin = self.config.device != "never" \
             and self._proc_supervisor is None
         try:
@@ -466,7 +472,18 @@ class FleetEngine:
                 # must never poison the respawn replay state (or every
                 # later worker death would replay the bad source and
                 # quarantine the replica)
-                self._proc_supervisor.set_model_source(name, source)
+                aot_path = None
+                if self.config.aot and self.config.device != "never":
+                    from .aot import maybe_build_artifact
+                    donor = aot_booster
+                    if donor is None and not isinstance(source, str):
+                        donor = source   # booster published directly
+                    aot_path = maybe_build_artifact(
+                        donor, source, self.config.buckets)
+                    if aot_path:
+                        self._count("aot_publishes")
+                self._proc_supervisor.set_model_source(
+                    name, source, aot_path=aot_path)
                 self._proc_supervisor.broadcast_model(name)
             else:
                 rep = self._pick_replica(allow_none=True)
@@ -687,11 +704,23 @@ class FleetEngine:
             args={"model": name, "tenant": tenant, "kind": kind}) \
             if tracer.enabled else None
         try:
+            # decode BEFORE admission: byte-costed quotas charge the
+            # actual request payload, so the size must be known at the
+            # admission decision (decode of a shed request is wasted
+            # work, but a tenant paying per-byte must be charged for
+            # what it actually sent)
+            try:
+                arr = np.asarray(rows, np.float64)
+            except (TypeError, ValueError) as e:
+                raise InvalidRequestError(
+                    f"rows not numeric: {e}") from e
             try:
                 # tenant admission runs attached to the root span so a
                 # quota denial's marker lands on this request's trace
                 with tracer.attach(None if span is None else span.ctx):
-                    self.quotas.check(tenant)
+                    self.quotas.check(
+                        tenant, cost=self.quotas.request_cost(
+                            arr.nbytes))
             except QuotaExceededError:
                 self._count("quota_shed")
                 self._count("shed")
@@ -710,11 +739,6 @@ class FleetEngine:
                     f"model {decision.target!r} is not served by this "
                     "fleet", model=decision.target,
                     known=self.fleet.names())
-            try:
-                arr = np.asarray(rows, np.float64)
-            except (TypeError, ValueError) as e:
-                raise InvalidRequestError(
-                    f"rows not numeric: {e}") from e
             with self._lock:
                 full = self._pending >= self.max_pending
                 if not full:

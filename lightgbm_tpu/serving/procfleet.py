@@ -142,6 +142,14 @@ class ProcFleetOptions:
     # registry/telemetry deltas on their heartbeat pongs and the
     # parent scrape renders the whole fleet under a ``worker`` label
     federation: bool = True
+    # shared-memory row transport (shm_ring.py): one ring per worker
+    # incarnation; batches whose payload reaches shm_min_bytes move as
+    # raw f64 blocks instead of JSON arrays (below it, JSON framing is
+    # cheaper than the slot round-trip)
+    shm: bool = True
+    shm_slots: int = 4
+    shm_slot_bytes: int = 1 << 20
+    shm_min_bytes: int = 16384
 
     @classmethod
     def from_config(cls, cfg) -> "ProcFleetOptions":
@@ -153,19 +161,32 @@ class ProcFleetOptions:
                 cfg, "replica_heartbeat_timeout_ms", 3000.0)),
             spawn_timeout_s=float(getattr(
                 cfg, "replica_spawn_timeout_s", 120.0)),
-            federation=bool(getattr(cfg, "serving_federation", True)))
+            federation=bool(getattr(cfg, "serving_federation", True)),
+            shm=bool(getattr(cfg, "serving_shm", True)),
+            shm_slots=int(getattr(cfg, "serving_shm_slots", 4)),
+            shm_slot_bytes=int(getattr(cfg, "serving_shm_slot_bytes",
+                                       1 << 20)),
+            shm_min_bytes=int(getattr(cfg, "serving_shm_min_bytes",
+                                      16384)))
 
 
 class _WorkerHandle:
     """One incarnation of a worker process: Popen + socket + pending."""
 
     def __init__(self, proc: subprocess.Popen, conn: socket.socket,
-                 rid: int, incarnation: int):
+                 rid: int, incarnation: int, shm_ring=None,
+                 shm_min_bytes: int = 0):
         self.proc = proc
         self.conn = conn
         self.rid = rid
         self.incarnation = incarnation
         self.pid = proc.pid
+        # per-incarnation shm ring (shm_ring.py); torn down with the
+        # handle so a dead reader's busy slots can never wedge a fresh
+        # incarnation
+        self.shm_ring = shm_ring
+        self.shm_min_bytes = int(shm_min_bytes)
+        self.shm_fallbacks = 0
         self.wlock = threading.Lock()
         self.plock = threading.Lock()
         self.pending: Dict[int, _Request] = {}
@@ -207,12 +228,26 @@ class _WorkerHandle:
                     f"replica {self.rid} worker is down",
                     replica=self.rid)
             self.pending[mid] = req
+        frame = {"type": "submit", "id": mid, "model": model,
+                 "kind": kind, "timeout_ms": timeout_ms,
+                 "trace": trace}
+        # large payloads ride the shm ring (a memcpy + tiny ticket);
+        # small batches, a full ring, or an oversized block fall back
+        # to JSON rows — same bytes either way (f64 end to end)
+        ticket = None
+        ring = self.shm_ring
+        want_shm = ring is not None and rows.nbytes >= self.shm_min_bytes
+        if want_shm:
+            with self.wlock:     # single writer per ring
+                ticket = ring.try_write(rows)
+        if ticket is not None:
+            frame["shm"] = ticket
+        else:
+            if want_shm:
+                self.shm_fallbacks += 1
+            frame["rows"] = rows.tolist()
         try:
-            send_frame(self.conn, {
-                "type": "submit", "id": mid, "model": model,
-                "kind": kind, "rows": rows.tolist(),
-                "timeout_ms": timeout_ms, "trace": trace},
-                lock=self.wlock)
+            send_frame(self.conn, frame, lock=self.wlock)
         except OSError as e:
             with self.plock:
                 self.pending.pop(mid, None)
@@ -330,6 +365,10 @@ class _WorkerHandle:
             self.conn.close()
         except OSError:
             pass
+        ring, self.shm_ring = self.shm_ring, None
+        if ring is not None:
+            with self.wlock:     # let an in-flight try_write finish
+                ring.destroy()
 
 
 class _WorkerEngineProxy:
@@ -387,6 +426,11 @@ class ProcessReplica:
         self.incarnation = 0
         self.last_death: Dict[str, Any] = {}
         self.restart_ready_ms: Optional[float] = None
+        # per-model AOT attach state from the worker's load acks: True
+        # means the worker serves that model's device route from the
+        # published artifact (zero retraces); False means it degraded
+        # to the host route
+        self.aot_models: Dict[str, bool] = {}
         self._handle: Optional[_WorkerHandle] = None
         self._no_respawn = False
         self._respawning = False
@@ -455,12 +499,22 @@ class ProcessReplica:
         h = self._handle
         return {} if h is None else dict(h.worker_stats or {})
 
+    def shm_stats(self) -> Optional[Dict[str, Any]]:
+        h = self._handle
+        if h is None or h.shm_ring is None:
+            return None
+        out = h.shm_ring.stats()
+        out["fallbacks"] = h.shm_fallbacks
+        return out
+
     def describe(self) -> Dict[str, Any]:
         with self._lock:
             models = sorted(self._engines)
         return {"replica": self.rid, "state": self.state,
                 "isolation": "process", "pid": self.pid,
                 "load": self.load(), "models": models,
+                "shm": self.shm_stats(),
+                "aot_models": dict(self.aot_models),
                 "cold_start_compiles": self.cold_start_compiles,
                 "cold_start_s": self.cold_start_s,
                 "started_at": self.started_at,
@@ -543,7 +597,11 @@ class WorkerSupervisor:
             "shed_policy": getattr(cfg, "shed_policy", "reject_new"),
             "device": getattr(cfg, "device", "auto"),
             "warmup": bool(getattr(cfg, "warmup", True)),
+            "aot": bool(getattr(cfg, "aot", True)),
         })
+        # each incarnation gets its own ring (or none): never inherit
+        # a stale segment name from the supervisor's environment
+        env.pop("LGBM_TPU_WORKER_SHM", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep \
@@ -569,15 +627,35 @@ class WorkerSupervisor:
         with self._lock:
             self._awaiting[token] = slot
         t0 = time.perf_counter()
+        # per-incarnation row-transport ring, created BEFORE the child
+        # so its geometry can ride the spawn env; shm trouble (e.g.
+        # /dev/shm unavailable) degrades to JSON framing, never fails
+        # the spawn
+        ring = None
+        if self.opts.shm:
+            try:
+                from .shm_ring import ShmRing
+                ring = ShmRing.create(self.opts.shm_slots,
+                                      self.opts.shm_slot_bytes)
+            except Exception as e:  # noqa: BLE001 - degrade to JSON
+                log_warning(f"procfleet: shm ring unavailable for "
+                            f"replica {rep.rid} ({e}); JSON framing")
+                ring = None
+        env = self._worker_env(rep, token)
+        if ring is not None:
+            from .shm_ring import ENV_VAR as _SHM_ENV
+            env[_SHM_ENV] = ring.env_spec()
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "lightgbm_tpu.serving.worker",
                  "--connect", f"127.0.0.1:{self.port}",
                  "--rid", str(rep.rid)],
-                env=self._worker_env(rep, token))
+                env=env)
         except OSError as e:
             with self._lock:
                 self._awaiting.pop(token, None)
+            if ring is not None:
+                ring.destroy()
             raise ServingError(f"worker spawn failed: {e}") from e
         conn = slot.wait(self.opts.spawn_timeout_s)
         with self._lock:
@@ -587,12 +665,16 @@ class WorkerSupervisor:
                 proc.kill()
             except OSError:
                 pass
+            if ring is not None:
+                ring.destroy()
             raise ServingError(
                 f"replica {rep.rid} worker never said hello within "
                 f"{self.opts.spawn_timeout_s}s "
                 f"(exit={proc.poll()})")
         rep.incarnation += 1
-        handle = _WorkerHandle(proc, conn, rep.rid, rep.incarnation)
+        handle = _WorkerHandle(proc, conn, rep.rid, rep.incarnation,
+                               shm_ring=ring,
+                               shm_min_bytes=self.opts.shm_min_bytes)
         rep._handle = handle
         try:
             # replay the fleet's published model state, then warm:
@@ -607,6 +689,7 @@ class WorkerSupervisor:
                     raise ServingError(
                         f"replica {rep.rid} worker failed to load "
                         f"{name!r}: {ack.get('message')}")
+                rep.aot_models[name] = bool(ack.get("aot"))
             rep.warm()
         except BaseException:
             # a failed replay/warm must not leak a live worker: the
@@ -684,8 +767,12 @@ class WorkerSupervisor:
             slot.put(conn)
 
     # -- model lifecycle ----------------------------------------------
-    def set_model_source(self, name: str, source) -> None:
-        """Record (and normalize) the source for replay on respawn."""
+    def set_model_source(self, name: str, source,
+                         aot_path: Optional[str] = None) -> None:
+        """Record (and normalize) the source for replay on respawn.
+        ``aot_path`` names the publish-time AOT artifact bundle
+        (serving/aot.py); it rides the same frame so every respawn
+        replays the executables instead of recompiling."""
         frame: Dict[str, Any] = {"type": "load_model", "name": name}
         if isinstance(source, str):
             if "\n" in source:
@@ -699,6 +786,8 @@ class WorkerSupervisor:
                 "process-isolated fleets need a file path, model text "
                 f"or Booster source for {name!r}, got "
                 f"{type(source).__name__}")
+        if aot_path:
+            frame["aot"] = aot_path
         self._model_state[name] = frame
 
     def broadcast_model(self, name: str) -> None:
@@ -715,6 +804,7 @@ class WorkerSupervisor:
                                      self.opts.spawn_timeout_s)
                 if not ack.get("ok"):
                     raise ServingError(str(ack.get("message")))
+                rep.aot_models[name] = bool(ack.get("aot"))
             except ServingError as e:
                 log_warning(f"procfleet: replica {rep.rid} rejected "
                             f"model {name!r} ({e}); recycling worker")

@@ -151,6 +151,7 @@ class ModelVersion:
         if getattr(src, "learner", None) is not None:
             self.dataset = src.learner.dataset
         self.stacked = None
+        self.aot = None                 # serving.aot.AotPredict or None
         self._lock = threading.Lock()
         self._inflight = 0
         self._draining = False
@@ -174,9 +175,23 @@ class ModelVersion:
         self.stacked = st
         return True
 
+    def attach_aot(self, art) -> None:
+        """Attach an AOT predict artifact (serving/aot.py): the device
+        route for text-published models, whose arrays were rebuilt from
+        the artifact instead of a live dataset. Shape agreement with
+        the parsed model text is a publish invariant — a mismatch means
+        the publisher shipped the wrong bundle, so fail loudly."""
+        if int(art.num_trees) != int(self.num_trees) \
+                or int(art.k) != int(self.k):
+            raise ModelLoadError(
+                f"AOT artifact does not match model: artifact has "
+                f"{art.num_trees} trees / k={art.k}, model text has "
+                f"{self.num_trees} trees / k={self.k}")
+        self.aot = art
+
     @property
     def device_ready(self) -> bool:
-        return self.stacked is not None
+        return self.stacked is not None or self.aot is not None
 
     # -- draining ------------------------------------------------------
     def acquire(self) -> "ModelVersion":
@@ -204,6 +219,7 @@ class ModelVersion:
     def _free(self) -> None:
         # drop the pinned device buffers; the python trees stay (cheap)
         self.stacked = None
+        self.aot = None
 
     @property
     def inflight(self) -> int:
@@ -217,6 +233,7 @@ class ModelVersion:
         return {"version": self.version, "source": self.source_desc,
                 "num_trees": self.num_trees, "k": self.k,
                 "device_ready": self.device_ready,
+                "aot": self.aot is not None,
                 "draining": self._draining, "inflight": self._inflight,
                 "created_at": self.created_at}
 

@@ -9,15 +9,20 @@ immediately with a ``retry_after_s`` hint; its traffic never occupies
 queue slots other tenants paid for, and never degrades into a timeout.
 
 A bucket holds up to ``burst`` tokens and refills continuously at
-``rate`` tokens/second (the classic token bucket); one request costs
-one token. ``rate <= 0`` means unlimited (the default tenant when no
-quota is configured). The clock is injectable so tests are
-deterministic.
+``rate`` tokens/second (the classic token bucket). What one token
+buys is the COST UNIT: ``requests`` (the default — one request, one
+token, whatever its size) or ``bytes`` (a request costs its decoded
+f64 payload bytes, so a tenant's quota bounds the data volume it can
+push through the fleet rather than its call count — one 512-row batch
+and 512 single-row calls now draw the same budget). ``rate <= 0``
+means unlimited (the default tenant when no quota is configured). The
+clock is injectable so tests are deterministic.
 
 Config surface (``Config.serving_quota_*``)::
 
     serving_quota_qps    = 100          # default per-tenant rate
     serving_quota_burst  = 200          # default burst (0 -> 2x rate)
+    serving_quota_unit   = requests     # or: bytes (rate = bytes/s)
     serving_quota_tenants = tenantA=10,tenantB=500:1000
                                         # per-tenant rate[:burst]
 """
@@ -95,12 +100,20 @@ class TenantQuotas:
     unlimited (quota enforcement applies only to named tenants).
     """
 
+    COST_UNITS = ("requests", "bytes")
+
     def __init__(self, default_rate: float = 0.0,
                  default_burst: float = 0.0,
                  tenants: Optional[Dict[str, Tuple[float, float]]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 cost_unit: str = "requests"):
+        if cost_unit not in self.COST_UNITS:
+            raise ValueError(
+                f"unknown quota cost unit {cost_unit!r}; one of "
+                f"{self.COST_UNITS}")
         self.default_rate = float(default_rate)
         self.default_burst = float(default_burst)
+        self.cost_unit = cost_unit
         self._clock = clock
         self._lock = threading.Lock()
         self._buckets: Dict[str, TokenBucket] = {}
@@ -116,7 +129,16 @@ class TenantQuotas:
             default_burst=float(getattr(cfg, "serving_quota_burst", 0.0)),
             tenants=parse_tenant_specs(
                 getattr(cfg, "serving_quota_tenants", [])),
-            clock=clock)
+            clock=clock,
+            cost_unit=str(getattr(cfg, "serving_quota_unit",
+                                  "requests")))
+
+    def request_cost(self, payload_bytes: int) -> float:
+        """Token cost of one request whose decoded f64 payload is
+        ``payload_bytes`` under the configured cost unit."""
+        if self.cost_unit == "bytes":
+            return float(max(int(payload_bytes), 1))
+        return 1.0
 
     def set_quota(self, tenant: str, rate: float,
                   burst: float = 0.0) -> None:
@@ -148,9 +170,11 @@ class TenantQuotas:
             get_tracer().instant(
                 "tenant.quota_denied", cat="fleet",
                 args={"tenant": tenant, "rate": bucket.rate,
+                      "cost": cost, "unit": self.cost_unit,
                       "retry_after_s": round(retry_after, 4)})
+            unit = "byte" if self.cost_unit == "bytes" else "request"
             raise QuotaExceededError(
-                f"tenant {tenant!r} exceeded its request quota "
+                f"tenant {tenant!r} exceeded its {unit} quota "
                 f"({bucket.rate:g}/s, burst {bucket.burst:g})",
                 tenant=tenant, rate=bucket.rate, burst=bucket.burst,
                 retry_after_s=round(retry_after, 4))
@@ -161,6 +185,7 @@ class TenantQuotas:
         out: Dict[str, Any] = {
             "default_rate": self.default_rate,
             "default_burst": self.default_burst,
+            "cost_unit": self.cost_unit,
             "tenants": {t: b.snapshot() for t, b in sorted(
                 buckets.items())},
         }

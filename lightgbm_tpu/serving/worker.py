@@ -68,6 +68,12 @@ class _Worker:
         self.wlock = threading.Lock()
         self.engines: Dict[str, Any] = {}     # name -> ServingEngine
         self.cfg = self._serving_config()
+        # shared-memory row transport (shm_ring.py): the supervisor
+        # creates one ring per worker incarnation and hands its
+        # geometry down via LGBM_TPU_WORKER_SHM; absent/broken env
+        # means every submit carries JSON rows (the fallback path)
+        from .shm_ring import ShmRing
+        self.shm = ShmRing.attach_from_env()
         # metrics federation (docs/Observability.md): deltas of this
         # worker's registry/telemetry state ride each heartbeat pong
         self._fed: Any = None
@@ -85,12 +91,19 @@ class _Worker:
 
     @staticmethod
     def _serving_config():
+        import dataclasses
+
         from .engine import ServingConfig
         raw = os.environ.get("LGBM_TPU_WORKER_CONFIG", "").strip()
         if not raw:
             return ServingConfig()
         kw = json.loads(raw)
-        return ServingConfig(**kw)
+        # a newer supervisor may ship knobs this worker build doesn't
+        # know (or fleet-level extras like shm geometry); keep only
+        # real ServingConfig fields instead of dying on TypeError
+        known = {f.name for f in dataclasses.fields(ServingConfig)}
+        return ServingConfig(**{k: v for k, v in kw.items()
+                                if k in known})
 
     def send(self, obj: Dict[str, Any]) -> None:
         try:
@@ -119,8 +132,10 @@ class _Worker:
             else msg.get("path")
         eng = self._engine_for(name)
         before = self._compiles()
-        version = eng.load(source)
+        version = eng.load(source, aot=msg.get("aot"))
+        mv = eng.registry.current()
         return {"ok": True, "version": version,
+                "aot": bool(getattr(mv, "aot", None)),
                 "compiles": self._compiles() - before}
 
     def warm(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -158,7 +173,15 @@ class _Worker:
                     f"model {name!r} is not loaded on worker "
                     f"{self.rid}", model=name)
             d0 = time.time()
-            rows = np.asarray(msg.get("rows"), np.float64)
+            ticket = msg.get("shm")
+            if ticket is not None:
+                if self.shm is None:
+                    raise ServingError(
+                        "submit names an shm slot but this worker has "
+                        "no ring attached")
+                rows = np.asarray(self.shm.read(ticket), np.float64)
+            else:
+                rows = np.asarray(msg.get("rows"), np.float64)
             if tinfo is not None:
                 tinfo["decode"] = (d0, time.time())
             fut = eng.submit(rows, str(msg.get("kind", "predict")),
@@ -276,6 +299,8 @@ class _Worker:
         stats = {"models": {}, "jit_compiles": self._compiles(),
                  # idempotent: reports the armed cache dir (or None)
                  "compile_cache": maybe_enable_compile_cache()}
+        if self.shm is not None:
+            stats["shm_reads"] = self.shm.reads
         load = 0
         for name, eng in self.engines.items():
             s = eng.stats()
@@ -337,6 +362,8 @@ class _Worker:
                 eng.stop(drain=drain)
             except Exception:  # noqa: BLE001 - exiting anyway
                 pass
+        if self.shm is not None:
+            self.shm.close()    # never unlink: the supervisor owns it
 
     # -- main loop -----------------------------------------------------
     def run(self) -> int:

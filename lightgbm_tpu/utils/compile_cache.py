@@ -39,6 +39,27 @@ def resolve_cache_dir(config=None) -> str:
     return path
 
 
+def artifact_dir(config=None) -> str:
+    """Directory for serving AOT predict artifacts (serving/aot.py).
+
+    Lives under the compile cache (``<cache>/aot``) so the npz bundle
+    and the serialized executables it references share one lifecycle
+    and one cleanup policy. When no cache is configured the artifacts
+    fall back to a per-process temp directory — still correct (workers
+    read the path they are handed), just without cross-run reuse.
+    """
+    base = resolve_cache_dir(config)
+    if not base:
+        if _STATE.get("artifact_tmp") is None:
+            import tempfile
+            _STATE["artifact_tmp"] = tempfile.mkdtemp(
+                prefix="lgbm_tpu_aot_")
+        base = _STATE["artifact_tmp"]
+    path = os.path.join(base, "aot")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
 def maybe_enable_compile_cache(config=None,
                                min_compile_secs: Optional[float] = None
                                ) -> Optional[str]:

@@ -1,0 +1,424 @@
+"""AOT predict artifacts + shared-memory row transport tests.
+
+Covers the zero-Python serving hot path:
+
+* ``serving/aot.py`` — artifact build/load round-trips that stay
+  BIT-IDENTICAL to host prediction of the published model text
+  (binary, multiclass, random-forest averaging, NaN rows), the
+  sha-binding integrity checks, and the refusal surface (linear
+  trees, missing donor);
+* ``serving/shm_ring.py`` — the seqlock'd ring protocol: write/read
+  round-trip parity, wrap-around reuse, ring exhaustion and
+  oversized batches falling back to JSON framing, torn-read
+  detection, and reader-death slot retention;
+* byte-based tenant quota costing (``serving/tenants.py``) and the
+  fleet's 429 path under ``serving_quota_unit=bytes``;
+* the worker's tolerance for unknown keys in the shipped
+  ``LGBM_TPU_WORKER_CONFIG`` (a newer supervisor must not kill an
+  older worker build with a TypeError).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (FleetEngine, ServingConfig,
+                                  ServingEngine)
+from lightgbm_tpu.serving.aot import (AotUnavailable, build_artifact,
+                                      load_artifact,
+                                      maybe_build_artifact, text_sha)
+from lightgbm_tpu.serving.errors import (ModelLoadError,
+                                         QuotaExceededError)
+from lightgbm_tpu.serving.shm_ring import ShmRing, ShmTornRead
+from lightgbm_tpu.serving.tenants import TenantQuotas
+
+
+def _toy(seed=0, n=300, d=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(seed=0, leaves=7, rounds=6, **params):
+    X, y = _toy(seed=seed)
+    p = {"objective": "binary", "num_leaves": leaves,
+         "verbosity": -1}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X
+
+
+def _published_ref(bst, X, **kw):
+    return lgb.Booster(model_str=bst.model_to_string()).predict(X, **kw)
+
+
+# ======================================================================
+# shm ring protocol
+# ======================================================================
+@pytest.fixture
+def ring():
+    r = ShmRing.create(slots=2, slot_bytes=4096)
+    # same-process reader view: untrack=False keeps the creator's
+    # resource_tracker entry intact (production workers attach from
+    # another process and DO untrack)
+    reader = ShmRing.attach(r.name, r.slots, r.slot_bytes,
+                            untrack=False)
+    yield r, reader
+    reader.close()
+    r.destroy()
+
+
+def test_shm_roundtrip_bit_exact(ring):
+    w, r = ring
+    arr = np.random.default_rng(0).normal(size=(16, 8))
+    arr[3, 2] = np.nan
+    ticket = w.try_write(arr)
+    assert ticket is not None
+    out = r.read(ticket)
+    assert out.dtype == np.float64
+    assert arr.tobytes() == out.tobytes()      # bit-exact, NaNs too
+    assert w.writes == 1 and r.reads == 1
+
+
+def test_shm_f32_roundtrip(ring):
+    w, r = ring
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = r.read(w.try_write(arr))
+    assert out.dtype == np.float32
+    assert arr.tobytes() == out.tobytes()
+
+
+def test_shm_wrap_around_reuses_slots(ring):
+    w, r = ring
+    for i in range(20):                      # 10 full cycles of 2 slots
+        arr = np.full((4, 4), float(i))
+        ticket = w.try_write(arr)
+        assert ticket is not None, f"cycle {i} found no free slot"
+        np.testing.assert_array_equal(r.read(ticket), arr)
+    assert w.writes == 20 and r.reads == 20
+    assert w.full_misses == 0
+
+
+def test_shm_exhaustion_falls_back(ring):
+    w, r = ring
+    arr = np.zeros((2, 2))
+    t1, t2 = w.try_write(arr), w.try_write(arr)
+    assert t1 and t2
+    assert w.try_write(arr) is None          # both slots busy
+    assert w.full_misses == 1
+    r.read(t1)                               # release one slot
+    assert w.try_write(arr) is not None
+
+
+def test_shm_reader_death_keeps_slot_busy(ring):
+    """A reader that dies mid-slot never writes ``consumed``; the
+    slot stays busy (no corruption) until the ring is torn down with
+    the worker incarnation."""
+    w, r = ring
+    arr = np.ones((2, 2))
+    t1 = w.try_write(arr)
+    assert t1 is not None                    # never read: reader died
+    t2 = w.try_write(arr)
+    assert t2 is not None and t2["slot"] != t1["slot"]
+    assert w.try_write(arr) is None          # ring full, JSON fallback
+    # the unread slot's payload is still intact for a late reader
+    np.testing.assert_array_equal(r.read(t1), arr)
+
+
+def test_shm_oversized_falls_back(ring):
+    w, _ = ring
+    big = np.zeros((64, 64))                 # 32 KiB > 4 KiB slot
+    assert big.nbytes > w.slot_bytes
+    assert w.try_write(big) is None
+    assert w.oversize_misses == 1
+    assert w.try_write(np.zeros((2, 2))) is not None
+
+
+def test_shm_rejects_unsupported_shapes(ring):
+    w, _ = ring
+    assert w.try_write(np.zeros(8)) is None            # 1-D
+    assert w.try_write(np.zeros((2, 2), np.int32)) is None
+
+
+def test_shm_torn_read_detected(ring):
+    w, r = ring
+    t = w.try_write(np.zeros((2, 2)))
+    stale = dict(t)
+    r.read(t)
+    w.try_write(np.ones((2, 2)))             # slot 1
+    # force reuse of slot 0 with a bumped seq, then replay the ticket
+    w.try_write(np.ones((2, 2)))
+    with pytest.raises(ShmTornRead):
+        r.read(stale)
+    with pytest.raises(ShmTornRead):
+        r.read({"slot": 99, "seq": 2})       # out-of-range slot
+
+
+def test_shm_env_spec_attach_roundtrip(monkeypatch):
+    w = ShmRing.create(slots=2, slot_bytes=4096)
+    try:
+        spec = json.loads(w.env_spec())
+        assert spec == {"name": w.name, "slots": 2,
+                        "slot_bytes": 4096}
+        monkeypatch.setenv("LGBM_TPU_WORKER_SHM", "not json")
+        assert ShmRing.attach_from_env() is None
+    finally:
+        w.destroy()
+
+
+# ======================================================================
+# AOT artifacts
+# ======================================================================
+def _nan_rows(X):
+    Xn = X[:32].copy()
+    Xn[::3, 0] = np.nan
+    Xn[1::5, 3] = np.nan
+    return Xn
+
+
+def test_aot_artifact_bit_parity_binary(tmp_path):
+    bst, X = _train()
+    text = bst.model_to_string()
+    path = build_artifact(bst, text, buckets=(1, 64),
+                          out_dir=str(tmp_path), compile=False)
+    art = load_artifact(path, expected_sha=text_sha(text))
+    Xn = _nan_rows(X)
+    for data in (X, X[:1], Xn):
+        np.testing.assert_array_equal(
+            art.predict_raw(np.asarray(data, np.float64)),
+            _published_ref(bst, data, raw_score=True))
+    d = art.describe()
+    assert d["num_trees"] == 6 and d["k"] == 1
+    assert text_sha(text).startswith(d["model_sha"])
+
+
+def test_aot_artifact_bit_parity_multiclass(tmp_path):
+    X, _ = _toy()
+    y = (np.arange(len(X)) % 3).astype(np.float64)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    path = build_artifact(bst, bst.model_to_string(),
+                          out_dir=str(tmp_path), compile=False)
+    art = load_artifact(path)
+    assert art.k == 3
+    np.testing.assert_array_equal(
+        art.predict_raw(X), _published_ref(bst, X, raw_score=True))
+
+
+def test_aot_artifact_bit_parity_rf_averaging(tmp_path):
+    X, y = _toy()
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_freq": 1, "bagging_fraction": 0.8,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    path = build_artifact(bst, bst.model_to_string(),
+                          out_dir=str(tmp_path), compile=False)
+    art = load_artifact(path)
+    assert art.average_output
+    np.testing.assert_array_equal(
+        art.predict_raw(X), _published_ref(bst, X, raw_score=True))
+
+
+def test_aot_refuses_linear_trees(tmp_path):
+    X, y = _toy()
+    bst = lgb.train({"objective": "regression", "linear_tree": True,
+                     "num_leaves": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(AotUnavailable):
+        build_artifact(bst, bst.model_to_string(),
+                       out_dir=str(tmp_path), compile=False)
+
+
+def test_aot_refuses_refit_candidate_trees(tmp_path):
+    # pipeline refit candidates deep-copy TEXT-parsed trees (raw
+    # thresholds, no _col/threshold_bin binding to the window
+    # dataset): a clean AotUnavailable, never an AttributeError out
+    # of stack_tree_arrays
+    bst, X = _train()
+    _, y = _toy()
+    cand = lgb.Booster(model_str=bst.model_to_string()).refit(
+        X, y, decay_rate=0.9)
+    with pytest.raises(AotUnavailable, match="binned representation"):
+        build_artifact(cand, cand.model_to_string(),
+                       out_dir=str(tmp_path), compile=False)
+    from lightgbm_tpu.serving.aot import maybe_build_artifact
+    assert maybe_build_artifact(cand, cand.model_to_string(),
+                                buckets=(1,)) is None
+
+
+def test_aot_sha_binding(tmp_path):
+    bst, _ = _train()
+    other, _ = _train(seed=7)
+    text = bst.model_to_string()
+    # donor text must match the published text at build time
+    with pytest.raises(ModelLoadError):
+        build_artifact(bst, other.model_to_string(),
+                       out_dir=str(tmp_path), compile=False)
+    path = build_artifact(bst, text, out_dir=str(tmp_path),
+                          compile=False)
+    with pytest.raises(ModelLoadError):
+        load_artifact(path, expected_sha=text_sha("not the model\n"))
+    # corrupt file -> structured load error, not a crash
+    with open(path, "wb") as fh:
+        fh.write(b"garbage")
+    with pytest.raises(ModelLoadError):
+        load_artifact(path)
+
+
+def test_maybe_build_artifact_degrades(tmp_path):
+    bst, _ = _train()
+    text = bst.model_to_string()
+    assert maybe_build_artifact(None, text, ()) is None
+    assert maybe_build_artifact("no donor here", text, ()) is None
+
+
+def test_registry_attach_aot_validates_shape(tmp_path):
+    bst, _ = _train(rounds=6)
+    other, _ = _train(seed=3, rounds=4)       # different num_trees
+    text = bst.model_to_string()
+    path = build_artifact(other, other.model_to_string(),
+                          out_dir=str(tmp_path), compile=False)
+    eng = ServingEngine(config=ServingConfig(buckets=(4,), warmup=False,
+                                      device="never"))
+    mv = eng.registry.load(text, pin_device=False)
+    with pytest.raises(ModelLoadError):
+        mv.attach_aot(load_artifact(path))
+
+
+def test_engine_attach_failure_degrades_to_host(tmp_path):
+    """A missing/corrupt artifact at load time must not reject the
+    publish — the engine serves the host route and counts the
+    failure (availability first; host is the parity standard)."""
+    bst, X = _train()
+    eng = ServingEngine(config=ServingConfig(buckets=(4,), warmup=False,
+                                      device="auto"))
+    v = eng.load(bst.model_to_string(),
+                 aot=str(tmp_path / "missing.npz"))
+    assert v == 1
+    mv = eng.registry.current()
+    assert mv.aot is None
+    assert eng.stats().get("aot_attach_failures", 0) == 1
+    np.testing.assert_array_equal(eng.predict(X[:4]),
+                                  _published_ref(bst, X[:4]))
+
+
+def test_engine_serves_aot_device_route(tmp_path):
+    """Text-loaded model + artifact: the engine's device route runs
+    the AOT leaf-index program and stays bit-identical to host."""
+    bst, X = _train()
+    text = bst.model_to_string()
+    path = build_artifact(bst, text, buckets=(1, 64),
+                          out_dir=str(tmp_path), compile=False)
+    eng = ServingEngine(config=ServingConfig(buckets=(1, 64), warmup=False,
+                                      device="always"))
+    eng.load(text, aot=path)
+    mv = eng.registry.current()
+    assert mv.aot is not None and mv.stacked is None
+    assert mv.device_ready
+    Xn = _nan_rows(X)
+    for data in (X[:64], X[:1], Xn):
+        np.testing.assert_array_equal(
+            eng.predict(data), _published_ref(bst, data))
+        np.testing.assert_array_equal(
+            eng.predict(data, kind="raw_score"),
+            _published_ref(bst, data, raw_score=True))
+    assert eng.stats().get("aot_attach", 0) == 1
+
+
+# ======================================================================
+# byte-based tenant quota costing
+# ======================================================================
+def test_quota_cost_unit_validation():
+    with pytest.raises(ValueError):
+        TenantQuotas(cost_unit="gallons")
+    q = TenantQuotas(cost_unit="bytes")
+    assert q.describe()["cost_unit"] == "bytes"
+
+
+def test_quota_request_cost():
+    req = TenantQuotas(cost_unit="requests")
+    assert req.request_cost(10_000_000) == 1.0
+    byt = TenantQuotas(cost_unit="bytes")
+    assert byt.request_cost(4096) == 4096.0
+    assert byt.request_cost(0) == 1.0         # floor: never free
+
+
+def test_quota_byte_costing_drains_by_volume():
+    clock = [0.0]
+    q = TenantQuotas(tenants={"t": (1000.0, 10000.0)},
+                     clock=lambda: clock[0], cost_unit="bytes")
+    q.check("t", cost=q.request_cost(8000))   # fits the burst
+    with pytest.raises(QuotaExceededError) as ei:
+        q.check("t", cost=q.request_cost(8000))
+    assert "byte quota" in str(ei.value)
+    assert ei.value.details["retry_after_s"] > 0
+    clock[0] += 10.0                          # refill 10k bytes
+    q.check("t", cost=q.request_cost(8000))
+
+
+def test_fleet_429_under_byte_quota():
+    """The fleet decodes the payload BEFORE the quota check and
+    charges its f64 byte size: a large batch trips the byte quota
+    where the same tenant's single rows pass."""
+    bst, X = _train()
+    big_cost = np.asarray(X[:64], np.float64).nbytes
+    fl = FleetEngine(
+        models={"m": bst},
+        config=ServingConfig(buckets=(4, 64), warmup=False,
+                             device="never",
+                             request_timeout_ms=30000),
+        replicas=1, default_model="m",
+        quotas=TenantQuotas(tenants={"t": (1.0, float(big_cost) - 1)},
+                            cost_unit="bytes"))
+    try:
+        fl.predict(X[:1], tenant="t")         # small: fits
+        with pytest.raises(QuotaExceededError) as ei:
+            fl.predict(X[:64], tenant="t")    # big: 429
+        assert "byte quota" in str(ei.value)
+        assert fl.stats()["quota_shed"] >= 1
+    finally:
+        fl.stop()
+
+
+def test_fleet_request_quota_message_unchanged():
+    bst, X = _train()
+    fl = FleetEngine(
+        models={"m": bst},
+        config=ServingConfig(buckets=(4,), warmup=False,
+                             device="never",
+                             request_timeout_ms=30000),
+        replicas=1, default_model="m",
+        quotas=TenantQuotas(tenants={"t": (0.001, 1.0)}))
+    try:
+        fl.predict(X[:1], tenant="t")
+        with pytest.raises(QuotaExceededError) as ei:
+            fl.predict(X[:1], tenant="t")
+        assert "request quota" in str(ei.value)
+    finally:
+        fl.stop()
+
+
+def test_quotas_from_config_reads_unit():
+    from lightgbm_tpu.config import Config
+    q = TenantQuotas.from_config(Config(serving_quota_unit="bytes"))
+    assert q.cost_unit == "bytes"
+    with pytest.raises(ValueError):
+        Config.from_params({"serving_quota_unit": "gallons"})
+
+
+# ======================================================================
+# worker config forwarding
+# ======================================================================
+def test_worker_config_drops_unknown_keys(monkeypatch):
+    from lightgbm_tpu.serving.worker import _Worker
+    monkeypatch.setenv("LGBM_TPU_WORKER_CONFIG", json.dumps(
+        {"buckets": [4, 16], "device": "never", "aot": True,
+         "knob_from_the_future": 7}))
+    cfg = _Worker._serving_config()
+    assert cfg.buckets == (4, 16)
+    assert cfg.device == "never" and cfg.aot is True

@@ -669,6 +669,64 @@ def test_bench_trend_fused_split_synthetic_regression(tmp_path):
     assert bt.main([a, b, "--quiet"]) == 0
 
 
+def test_bench_trend_single_row_and_shm_leg_attribution(tmp_path):
+    """The zero-Python hot path series: single_row_p99_ms and
+    shm_large_batch_p99_ms chain from the fleet_isolation block; a
+    >20% worsening fails the gate, and the trip names whether the
+    AOT or the shm leg regressed."""
+    bt = _load_tool("bench_trend")
+    fi = {"process_p99_ms": 5.0, "thread_p99_ms": 4.0,
+          "replicas": 2, "buckets": [1, 64], "offered_qps": 120,
+          "restart_ready_ms": 3000.0, "aot_batch_rows": 512,
+          "aot_p99_ms": 3.0, "single_row_p99_ms": 2.0,
+          "shm_large_batch_p99_ms": 6.0,
+          "json_large_batch_p99_ms": 30.0, "shm_speedup_pct": 400.0,
+          "aot_restart_ready_ms": 1500.0}
+    line = dict(_HEAD, fleet_isolation=fi)
+    a, b = str(tmp_path / "BENCH_r06.json"), \
+        str(tmp_path / "BENCH_r07.json")
+    _mk_round(a, 6, [_FIXED, line])
+    # only the single-row (AOT) leg regresses: +50%, shm leg flat
+    worse = dict(line, fleet_isolation=dict(fi,
+                                            single_row_p99_ms=3.0))
+    _mk_round(b, 7, [_FIXED, worse])
+    rep = str(tmp_path / "rep.json")
+    assert bt.main([a, b, "--quiet", "--report", rep]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    [r] = [r for r in report["regressions"]
+           if r["series"] == "single_row_p99_ms"]
+    assert r["change_pct"] == 50.0
+    assert r["leg"] == "aot"
+    assert report["gated_points"]["single_row_p99_ms"] == 2
+    assert report["gated_points"]["shm_large_batch_p99_ms"] == 2
+    # only the shm transport leg regresses: named "shm"
+    worse = dict(line, fleet_isolation=dict(
+        fi, shm_large_batch_p99_ms=9.0))
+    _mk_round(b, 7, [_FIXED, worse])
+    assert bt.main([a, b, "--quiet", "--report", rep]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    [r] = [r for r in report["regressions"]
+           if r["series"] == "shm_large_batch_p99_ms"]
+    assert r["leg"] == "shm"
+    # both legs worsen past the gate: named "both" on both trips
+    worse = dict(line, fleet_isolation=dict(
+        fi, single_row_p99_ms=3.0, shm_large_batch_p99_ms=9.0))
+    _mk_round(b, 7, [_FIXED, worse])
+    assert bt.main([a, b, "--quiet", "--report", rep]) == 1
+    with open(rep) as fh:
+        report = json.load(fh)
+    legs = {r["series"]: r.get("leg")
+            for r in report["regressions"]}
+    assert legs.get("single_row_p99_ms") == "both"
+    assert legs.get("shm_large_batch_p99_ms") == "both"
+    # within the threshold passes, and the render names the leg
+    _mk_round(b, 7, [_FIXED, dict(line, fleet_isolation=dict(
+        fi, single_row_p99_ms=2.2))])
+    assert bt.main([a, b, "--quiet"]) == 0
+
+
 def test_bench_trend_serving_p99_and_config_bump(tmp_path):
     bt = _load_tool("bench_trend")
     a, b = str(tmp_path / "BENCH_r06.json"), \

@@ -408,12 +408,12 @@ def test_rejected_publish_keeps_respawn_state_clean(proc_fleet):
 def test_warm_respawn_zero_compiles_cache_armed(tmp_path,
                                                 monkeypatch):
     """The acceptance bar for respawn cost: a respawned worker warms
-    with ZERO compiles (published model text serves the host route
-    today — device-route process serving is item 4a's AOT-publish
-    opening), serves bit-identically, compiles nothing in steady
-    state, and has the persistent compile cache ARMED (reported over
-    the wire) so device-capable publishes replay instead of
-    recompiling."""
+    with ZERO compiles, serves bit-identically, compiles nothing in
+    steady state, and has the persistent compile cache ARMED
+    (reported over the wire). Booster publishes now also ship an AOT
+    artifact (serving/aot.py), so the respawn replays the device
+    route's executables too — test_aot_publish_zero_retrace_parity_
+    and_shm pins that path explicitly."""
     cache = tmp_path / "xla_cache"
     cache.mkdir()
     monkeypatch.setenv("LGBM_TPU_COMPILE_CACHE", str(cache))
@@ -452,6 +452,137 @@ def test_warm_respawn_zero_compiles_cache_armed(tmp_path,
         if base is not None and after is not None:
             assert after == base, "steady-state recompiles after " \
                 "warm respawn"
+    finally:
+        fl.stop()
+
+
+# ----------------------------------------------------------------------
+# AOT publish + shared-memory transport acceptance (the zero-Python
+# serving hot path): a text publish with a dataset-backed donor ships
+# an AOT artifact; the worker serves the DEVICE route from replayed
+# executables with zero retraces across warm-up, steady state and a
+# respawn, stays bit-identical to host prediction of the published
+# text, and large batches travel over the shm ring
+def test_config_aot_shm_params():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"serving_aot": False,
+                              "serving_shm_slots": 8,
+                              "serving_shm_min_bytes": 0,
+                              "serving_quota_unit": "bytes"})
+    assert cfg.serving_aot is False and cfg.serving_shm_slots == 8
+    assert Config.from_params({"shm": False}).serving_shm is False
+    assert Config.from_params({"aot": False}).serving_aot is False
+    with pytest.raises(ValueError):
+        Config.from_params({"serving_shm_slots": 0})
+    with pytest.raises(ValueError):
+        Config.from_params({"serving_shm_slot_bytes": 16})
+    opts = ProcFleetOptions.from_config(cfg)
+    assert opts.shm_slots == 8 and opts.shm_min_bytes == 0
+    from lightgbm_tpu.serving.engine import ServingConfig as SC
+    assert SC.from_config(cfg).aot is False
+
+
+@pytest.mark.slow
+def test_aot_publish_zero_retrace_parity_and_shm(tmp_path,
+                                                 monkeypatch):
+    """Acceptance: process-mode serving of an AOT-published model does
+    ZERO retraces after replay (compile counter flat across warm-up,
+    steady state and one respawn) AND stays bit-identical to host
+    prediction of the same model text; batches >= shm_min_bytes
+    travel the shm ring, oversized ones fall back to JSON framing
+    with identical results."""
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+    monkeypatch.setenv("LGBM_TPU_COMPILE_CACHE", str(cache))
+    bst, X = _train()
+    text = bst.model_to_string()
+    ref = _published_ref(bst, X)
+    fl = FleetEngine(
+        config=ServingConfig(buckets=(1, 16, 64), device="always",
+                             flush_interval_ms=1.0,
+                             request_timeout_ms=30000),
+        replicas=1, default_model="m", isolation="process",
+        proc_opts=ProcFleetOptions(heartbeat_ms=50,
+                                   heartbeat_timeout_ms=3000,
+                                   spawn_timeout_s=90,
+                                   backoff_base_s=0.05, restart_max=3,
+                                   shm=True, shm_min_bytes=1024,
+                                   shm_slot_bytes=16384))
+    try:
+        # publish-time AOT: the parent compiles the bucket programs
+        # into the shared persistent cache and ships the artifact
+        fl.load_model("m", text, aot_booster=bst)
+        assert fl._counts.get("aot_publishes") == 1
+        rep = fl._proc_supervisor._replicas[0]
+        assert rep.aot_models.get("m") is True, rep.describe()
+
+        # warm-up + steady state: bit parity, zero compiles
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:64])), ref[:64])
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:1])), ref[:1])
+        base = rep.stats_lite().get("jit_compiles")
+        for i in range(3):
+            fl.predict(X[i:i + 16])
+        after = rep.stats_lite().get("jit_compiles")
+        if base is not None and after is not None:
+            assert after == base, "steady-state retraces on the " \
+                "AOT route"
+
+        # the 64-row batch (4 KiB) rode the ring; single rows stayed
+        # on JSON framing (below shm_min_bytes)
+        shm = rep.describe()["shm"]
+        assert shm is not None and shm["writes"] >= 1, shm
+
+        # oversized batch: > slot_bytes falls back to JSON framing
+        # transparently, bit-identically
+        big = np.repeat(X, 8, axis=0)[:2048]          # 128 KiB
+        assert big.nbytes > 16384
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(big)), _published_ref(bst, big))
+        shm = rep.describe()["shm"]
+        assert shm["oversize_misses"] + shm["fallbacks"] >= 1, shm
+
+        # respawn: the worker replays the artifact from the model
+        # frame and the executables from the persistent cache — zero
+        # compiles, AOT route still live, parity preserved
+        inc0, pid0 = rep.incarnation, rep.pid
+        os.kill(pid0, signal.SIGKILL)
+        assert _wait(lambda: rep.state == "ok"
+                     and rep.incarnation > inc0, 60), rep.describe()
+        assert rep.cold_start_compiles == 0, rep.describe()
+        assert rep.aot_models.get("m") is True, rep.describe()
+        assert rep.restart_ready_ms is not None
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:64])), ref[:64])
+        assert fl.stats()["errors"] == 0
+    finally:
+        fl.stop()
+
+
+@pytest.mark.slow
+def test_aot_disabled_still_serves_host_route(tmp_path, monkeypatch):
+    """serving_aot=False publishes plain text: no artifact, host
+    route, same results — the opt-out is a clean degrade."""
+    bst, X = _train()
+    fl = FleetEngine(
+        config=ServingConfig(buckets=(4,), device="always",
+                             flush_interval_ms=1.0,
+                             request_timeout_ms=30000, aot=False),
+        replicas=1, default_model="m", isolation="process",
+        proc_opts=ProcFleetOptions(heartbeat_ms=50,
+                                   heartbeat_timeout_ms=3000,
+                                   spawn_timeout_s=90,
+                                   backoff_base_s=0.05,
+                                   restart_max=3))
+    try:
+        fl.load_model("m", bst.model_to_string(), aot_booster=bst)
+        assert fl._counts.get("aot_publishes") is None
+        rep = fl._proc_supervisor._replicas[0]
+        assert rep.aot_models.get("m") is False
+        np.testing.assert_array_equal(
+            np.asarray(fl.predict(X[:4])),
+            _published_ref(bst, X[:4]))
     finally:
         fl.stop()
 

@@ -236,6 +236,110 @@ def _fleet_isolation_point(lines: List[Dict]
     return found
 
 
+def _single_row_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's AOT single-row serving p99 (bench.py
+    measure_aot_serving inside the fleet_isolation block): a
+    sequential closed loop of 1-row predicts through the process
+    fleet's AOT device route — the per-call floor of the zero-Python
+    hot path. Higher is worse. The shm/JSON large-batch legs ride
+    along for gate-trip leg attribution."""
+    found = None
+    for ln in lines:
+        fi = ln.get("fleet_isolation")
+        if not isinstance(fi, dict) \
+                or fi.get("single_row_p99_ms") is None:
+            continue
+        key = json.dumps({
+            "backend": ln.get("backend"),
+            "buckets": fi.get("buckets"),
+        }, sort_keys=True)
+        found = {"value": float(fi["single_row_p99_ms"]), "key": key,
+                 "aot_p99_ms": fi.get("aot_p99_ms"),
+                 "shm_large_batch_p99_ms": fi.get(
+                     "shm_large_batch_p99_ms"),
+                 "json_large_batch_p99_ms": fi.get(
+                     "json_large_batch_p99_ms"),
+                 "aot_restart_ready_ms": fi.get(
+                     "aot_restart_ready_ms")}
+    return found
+
+
+def _shm_batch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's shm-transport large-batch p99 (same bench block):
+    the batch leg that rides the shared-memory ring instead of JSON
+    framing, keyed by the batch shape. Higher is worse."""
+    found = None
+    for ln in lines:
+        fi = ln.get("fleet_isolation")
+        if not isinstance(fi, dict) \
+                or fi.get("shm_large_batch_p99_ms") is None:
+            continue
+        key = json.dumps({
+            "backend": ln.get("backend"),
+            "batch_rows": fi.get("aot_batch_rows"),
+        }, sort_keys=True)
+        found = {"value": float(fi["shm_large_batch_p99_ms"]),
+                 "key": key,
+                 "single_row_p99_ms": fi.get("single_row_p99_ms"),
+                 "json_large_batch_p99_ms": fi.get(
+                     "json_large_batch_p99_ms"),
+                 "shm_speedup_pct": fi.get("shm_speedup_pct")}
+    return found
+
+
+def _rel_change(a, b) -> Optional[float]:
+    try:
+        a, b = float(a), float(b)
+    except (TypeError, ValueError):
+        return None
+    return (b - a) / a if a > 0 else None
+
+
+def attribute_hot_path_leg(trips: List[Dict[str, Any]],
+                           series_name: str,
+                           series: List[Tuple[str, Dict]],
+                           threshold: float) -> None:
+    """Name which leg of the zero-Python hot path a gate trip lives
+    in: single rows travel JSON framing but run the AOT executables
+    (the ``aot`` leg), large batches additionally ride the shm ring
+    (the ``shm`` leg). A trip where BOTH legs worsened past the
+    threshold is ``both``; a trip where only the other leg's series
+    stayed flat pins the regression to this one."""
+    pts = {label: pt for label, pt in series}
+    for reg in trips:
+        if reg.get("series") != series_name:
+            continue
+        prev = pts.get(reg["from_round"])
+        cur = pts.get(reg["to_round"])
+        if not prev or not cur:
+            continue
+        if series_name == "single_row_p99_ms":
+            aot_chg = _rel_change(prev["value"], cur["value"])
+            shm_chg = _rel_change(prev.get("shm_large_batch_p99_ms"),
+                                  cur.get("shm_large_batch_p99_ms"))
+        else:
+            shm_chg = _rel_change(prev["value"], cur["value"])
+            aot_chg = _rel_change(prev.get("single_row_p99_ms"),
+                                  cur.get("single_row_p99_ms"))
+        aot_bad = aot_chg is not None and aot_chg > threshold
+        shm_bad = shm_chg is not None and shm_chg > threshold
+        if aot_bad and shm_bad:
+            leg = "both"
+        elif aot_bad:
+            leg = "aot"
+        elif shm_bad:
+            leg = "shm"
+        else:
+            leg = "aot" if series_name == "single_row_p99_ms" \
+                else "shm"
+        reg["leg"] = leg
+        reg["leg_changes"] = {
+            "aot_single_row_pct":
+                None if aot_chg is None else round(aot_chg * 100, 2),
+            "shm_large_batch_pct":
+                None if shm_chg is None else round(shm_chg * 100, 2)}
+
+
 def _mesh_scaling_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
     """The round's mesh-scaling number (bench.py
     run_mesh_scaling_block): total ms/split across the mesh learner
@@ -331,6 +435,7 @@ def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
     fixed, serving, headline, dispatch, fleet = [], [], [], [], []
     fused, mesh, fleet_iso = [], [], []
+    single_row, shm_batch = [], []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -356,6 +461,12 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _fleet_isolation_point(rnd["lines"])
         if p is not None:
             fleet_iso.append((rnd["label"], p))
+        p = _single_row_point(rnd["lines"])
+        if p is not None:
+            single_row.append((rnd["label"], p))
+        p = _shm_batch_point(rnd["lines"])
+        if p is not None:
+            shm_batch.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
@@ -366,6 +477,15 @@ def analyze(rounds: List[Dict[str, Any]],
     regressions += _gate(mesh, False, threshold, "mesh_scaling_ms")
     regressions += _gate(fleet_iso, False, threshold,
                          "fleet_isolation_p99_ms")
+    sr_trips = _gate(single_row, False, threshold,
+                     "single_row_p99_ms")
+    attribute_hot_path_leg(sr_trips, "single_row_p99_ms",
+                           single_row, threshold)
+    shm_trips = _gate(shm_batch, False, threshold,
+                      "shm_large_batch_p99_ms")
+    attribute_hot_path_leg(shm_trips, "shm_large_batch_p99_ms",
+                           shm_batch, threshold)
+    regressions += sr_trips + shm_trips
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -389,6 +509,10 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in mesh],
             "fleet_isolation_p99_ms": [
                 {"round": lb, **pt} for lb, pt in fleet_iso],
+            "single_row_p99_ms": [
+                {"round": lb, **pt} for lb, pt in single_row],
+            "shm_large_batch_p99_ms": [
+                {"round": lb, **pt} for lb, pt in shm_batch],
             DISPATCH_METRIC: [
                 {"round": lb, **pt} for lb, pt in dispatch],
             # informational only — config drifts across rounds
@@ -401,6 +525,8 @@ def analyze(rounds: List[Dict[str, Any]],
                          "fused_split_ms": len(fused),
                          "mesh_scaling_ms": len(mesh),
                          "fleet_isolation_p99_ms": len(fleet_iso),
+                         "single_row_p99_ms": len(single_row),
+                         "shm_large_batch_p99_ms": len(shm_batch),
                          DISPATCH_METRIC: len(dispatch)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",
@@ -445,6 +571,14 @@ def render(report: Dict[str, Any]) -> str:
                     f"span share {100 * attr['from_share']:.1f}% -> "
                     f"{100 * attr['to_share']:.1f}% "
                     f"({100 * attr['share_delta']:+.1f}pp)")
+            if r.get("leg"):
+                chg = r.get("leg_changes", {})
+                L.append(
+                    f"    attributed to the {r['leg']} leg "
+                    f"(aot single-row "
+                    f"{chg.get('aot_single_row_pct')}%, shm "
+                    f"large-batch "
+                    f"{chg.get('shm_large_batch_pct')}%)")
     else:
         L.append("verdict: ok (no gated regression)")
     return "\n".join(L) + "\n"

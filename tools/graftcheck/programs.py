@@ -298,6 +298,18 @@ def _b_predict_scan_trees():
         binned, *stacked.device(), 1, None, False)
 
 
+@builder("predict_scan_leaf_idx")
+def _b_predict_scan_leaf_idx():
+    import jax.numpy as jnp
+    from lightgbm_tpu.predictor import stack_tree_arrays
+    bst = _booster()
+    models = list(bst._gbdt.models)
+    stacked = _env("stacked", lambda: stack_tree_arrays(models, 1))
+    binned = bst._gbdt.train_data.binned_device
+    return _spec_fn("predict_scan_leaf_idx").lower(
+        binned, *stacked.device(), None, False)
+
+
 @builder("predict_scan_trees_linear")
 def _b_predict_scan_trees_linear():
     import jax.numpy as jnp

@@ -434,6 +434,11 @@ def render(records: List[Dict[str, Any]]) -> str:
             L.append(f"isolation: restarts="
                      f"{f.get('replica_restarts', 0)} "
                      f"quarantines={f.get('replica_quarantines', 0)}")
+        if f.get("aot_publishes"):
+            # zero-Python hot path (serving/aot.py): publishes that
+            # shipped an AOT artifact so process workers replay the
+            # device route with zero retraces
+            L.append(f"aot: publishes={f.get('aot_publishes', 0)}")
 
     tl = d.get("replica_timeline") or []
     if tl:

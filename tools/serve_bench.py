@@ -477,6 +477,20 @@ def main(argv=None) -> int:
             k: v for k, v in engine.stats().items()
             if isinstance(v, (int, float, str))}
         result["health"] = engine.health()
+        sup = getattr(engine, "_proc_supervisor", None)
+        if sup is not None:
+            # the zero-Python hot path surface: per-replica AOT route
+            # state and shm transport counters (the chaos-soak CI job
+            # asserts the storm tore at AOT-published models, not a
+            # host-route fallback)
+            result["aot_shm"] = {
+                "aot_publishes": int(
+                    engine._counts.get("aot_publishes", 0)),
+                "replicas": [
+                    {"rid": r.rid,
+                     "aot_models": dict(r.aot_models),
+                     "shm": r.shm_stats()}
+                    for r in sup._replicas]}
         head = block
         engine.stop()
     else:
