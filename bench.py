@@ -60,6 +60,15 @@ QUALITY_TIMEOUT_S = 900
 # chained round-over-round by tools/bench_trend.py
 CENSUS_TIMEOUT_S = 240
 
+# multiboost sweep dryrun (tools/multiboost_dryrun.py): a 16-model
+# hyperparameter sweep trained as ONE compiled program vs the
+# train-in-a-loop foil — byte-identity + dispatch-budget checked, and
+# the wall speedup chained round-over-round by tools/bench_trend.py.
+# Changing the shape changes the trend key (the chain breaks cleanly).
+MULTIBOOST_SWEEP = {"models": 16, "rows": 2048, "features": 16,
+                    "iters": 10}
+MULTIBOOST_TIMEOUT_S = 420
+
 # mesh-scaling block (ROADMAP item 2): 1 -> 8 virtual-device scaling
 # curve of steady-state time/split for every mesh learner mode on the
 # CPU backend — a structural cost of the partition-rule layer's
@@ -1053,6 +1062,57 @@ def run_dispatch_census(env, remaining):
     return result
 
 
+def run_multiboost_sweep(env, remaining):
+    """Multiboost sweep dryrun (tools/multiboost_dryrun.py) on the CPU
+    backend: trains the MULTIBOOST_SWEEP 16-model sweep once through
+    engine.train_many (every boosting iteration = ONE jitted grow
+    dispatch for the whole sweep) and once as a per-model train loop,
+    then prints one JSON line (metric multiboost_speedup; value = loop
+    wall seconds / batched wall seconds). The child exits non-zero if
+    any model is not byte-identical to its loop twin, any model
+    silently fell back to the loop, or the batched dispatch count
+    exceeds foil/8 — that verdict rides the line as ``ok``."""
+    if os.environ.get("BENCH_NO_MULTIBOOST") or remaining < 90:
+        return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = os.path.join(here, "bench_multiboost.json")
+    # a stale artifact from an earlier run must never be mistaken for
+    # this run's measurement (the child may crash before writing)
+    try:
+        os.remove(art)
+    except OSError:
+        pass
+    envc = _cpu_env(env)
+    envc.pop("_BENCH_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.multiboost_dryrun",
+             "--json", art,
+             "--models", str(MULTIBOOST_SWEEP["models"]),
+             "--rows", str(MULTIBOOST_SWEEP["rows"]),
+             "--features", str(MULTIBOOST_SWEEP["features"]),
+             "--iters", str(MULTIBOOST_SWEEP["iters"])],
+            env=envc, capture_output=True, text=True, cwd=here,
+            timeout=max(90.0, min(MULTIBOOST_TIMEOUT_S, remaining)))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("multiboost sweep timed out\n")
+        return None
+    try:
+        with open(art) as fh:
+            result = json.load(fh)
+    except OSError:
+        sys.stderr.write("multiboost sweep child failed "
+                         "(no artifact):\n"
+                         + proc.stderr[-2000:] + "\n")
+        return None
+    print(json.dumps(result), flush=True)
+    if proc.returncode != 0:
+        sys.stderr.write("MULTIBOOST SWEEP contract failed (byte "
+                         "identity / batching / dispatch budget):\n"
+                         + proc.stderr[-1500:] + "\n")
+    return result
+
+
 def run_quality_gate(env, remaining):
     """The >=100-iteration fixed-config accuracy gate: same generator
     and params as the CPU fixed baseline, QUALITY_GATE['iters']
@@ -1146,6 +1206,8 @@ def main():
         run_fused_split_block(
             env, budget - (time.monotonic() - t_start))
         run_mesh_scaling_block(
+            env, budget - (time.monotonic() - t_start))
+        run_multiboost_sweep(
             env, budget - (time.monotonic() - t_start))
         qp = run_quality_gate(
             env, budget - (time.monotonic() - t_start))

@@ -169,6 +169,11 @@ _PARAM_ALIASES: Dict[str, str] = {
     "slos": "slo_specs", "slo_spec": "slo_specs",
     "max_slo_burn": "pipeline_max_slo_burn",
     "federation": "serving_federation",
+    "use_multiboost": "multiboost", "multi_boost": "multiboost",
+    "multiboost_batch": "multiboost_max_batch",
+    "max_models_per_batch": "multiboost_max_batch",
+    "tenants": "pipeline_tenants",
+    "pipeline_tenant_models": "pipeline_tenants",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -499,6 +504,19 @@ class Config:
     pipeline_replay_seed: int = 0      # replay stream seed
     pipeline_replay_noise: float = 0.1  # replay label noise
     pipeline_serve_http: bool = False  # serve HTTP during the loop
+    # per-tenant refit loops: each named tenant owns a logical model
+    # in the fleet registry; every cycle refits ALL tenants' candidates
+    # as one multiboost batch and ramps/promotes them independently
+    pipeline_tenants: List[str] = field(default_factory=list)
+
+    # ---- multiboost (lightgbm_tpu/multiboost/): many-model training
+    # as ONE compiled program. "auto" batches whenever the models are
+    # eligible (and, for cv, the learning rate is an exact power of
+    # two so the batched path is bit-identical to the loop path);
+    # "on" forces batching for every eligible bucket; "off" restores
+    # the per-model Python loop everywhere.
+    multiboost: str = "auto"           # auto | on | off
+    multiboost_max_batch: int = 64     # max models per compiled batch
 
     # ---- objective (config.h:761-832)
     objective_seed: int = 5

@@ -539,6 +539,14 @@ def cv(params: Dict[str, Any], train_set: Dataset,
         if train_set.params else dict(params)
     folds = _make_n_folds(train_set, folds, nfold, params, seed,
                           stratified, shuffle)
+
+    mb_out = _cv_multiboost(
+        params, train_set, folds, num_boost_round, fobj, feval,
+        early_stopping_rounds, verbose_eval, show_stdv, callbacks,
+        eval_train_metric, return_cvbooster)
+    if mb_out is not None:
+        return mb_out
+
     cvbooster = CVBooster()
     for train_idx, test_idx in folds:
         tr = train_set.subset(np.asarray(train_idx))
@@ -597,3 +605,305 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     if return_cvbooster:
         results["cvbooster"] = cvbooster
     return dict(results)
+
+
+# ----------------------------------------------------------------------
+def _lr_is_pow2(lr: float) -> bool:
+    """True when the f32/f64 shrink paths agree bitwise: a power-of-two
+    learning rate makes f32(leaf) * f32(lr) == f32(f64(leaf) * lr)."""
+    import math
+    m, _ = math.frexp(float(lr))
+    return m == 0.5
+
+
+def _cv_sorted_callbacks(callbacks, early_stopping_rounds, verbose_eval,
+                         show_stdv):
+    callbacks = set(callbacks) if callbacks is not None else set()
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.add(early_stopping(early_stopping_rounds,
+                                     verbose=False))
+    if verbose_eval is True:
+        callbacks.add(print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        callbacks.add(print_evaluation(verbose_eval, show_stdv))
+    before = {cb for cb in callbacks
+              if getattr(cb, "before_iteration", False)}
+    after = callbacks - before
+    return (sorted(before, key=lambda cb: getattr(cb, "order", 0)),
+            sorted(after, key=lambda cb: getattr(cb, "order", 0)))
+
+
+def _cv_multiboost(params, train_set, folds, num_boost_round, fobj,
+                   feval, early_stopping_rounds, verbose_eval,
+                   show_stdv, callbacks, eval_train_metric,
+                   return_cvbooster):
+    """Batched cv: every fold's booster grows its tree in ONE compiled
+    program per iteration over the SHARED bin layout (one BinMapper
+    pass for the whole cv, not one per fold).
+
+    Returns the cv results dict, or None to fall back to the per-fold
+    loop. Gates (multiboost=auto): eligibility of the config for the
+    vmapped grow body, no bagging (fold masks own the row-weight
+    slot), no custom fobj/feval, no before-iteration callbacks, and a
+    power-of-two learning rate — the batched async score update uses
+    f32(leaf)*f32(lr) while the legacy host-stepped loop rounds
+    through f64, and only pow2 rates make them bitwise equal.
+    multiboost=on forces batching for any rate (model TEXT stays
+    f64-shrunk either way; the ulp story is documented in
+    docs/MultiModel.md).
+    """
+    import jax.numpy as jnp
+
+    from .config import Config
+    from .metric import create_metrics
+    from .metric.metrics import batched_eval
+    from .multiboost.batch import (BoosterBatch, ModelSpec,
+                                   MultiboostError, _meta_view,
+                                   multiboost_ineligible_reason,
+                                   multiboost_mode)
+
+    cfg = Config.from_params(params)
+    mode = multiboost_mode(cfg)
+    if mode == "off" or fobj is not None or feval is not None:
+        return None
+    reason = multiboost_ineligible_reason(cfg, train_set._inner)
+    if reason is None and cfg.bagging_freq > 0 \
+            and cfg.bagging_fraction < 1.0:
+        reason = "bagging (fold masks own the row-weight slot)"
+    if reason is None and mode == "auto" \
+            and not _lr_is_pow2(cfg.learning_rate):
+        reason = f"learning_rate={cfg.learning_rate} not a power of " \
+                 "two (set multiboost=on to force)"
+    cb_before, cb_after = _cv_sorted_callbacks(
+        callbacks, early_stopping_rounds, verbose_eval, show_stdv)
+    if reason is None and cb_before:
+        reason = "before-iteration callbacks (reset_parameter)"
+    if reason is not None:
+        if mode == "on":
+            raise LightGBMError(f"multiboost=on but cv cannot batch: "
+                                f"{reason}")
+        log_info(f"multiboost: cv falls back to per-fold loop "
+                 f"({reason})")
+        return None
+
+    specs = [ModelSpec(params=copy.deepcopy(params),
+                       row_index=np.asarray(tr_idx), name=f"fold{f}")
+             for f, (tr_idx, _te) in enumerate(folds)]
+    try:
+        bb = BoosterBatch(train_set, specs, num_boost_round)
+        bb.setup()
+    except MultiboostError as e:
+        if mode == "on":
+            raise LightGBMError(f"multiboost=on but cv cannot batch: "
+                                f"{e}") from e
+        log_info(f"multiboost: cv falls back to per-fold loop ({e})")
+        return None
+
+    md = train_set._inner.metadata
+    tel = get_telemetry()
+    valid_metrics, train_metrics = [], []
+    te_dev, tr_dev = [], []
+    for f, (tr_idx, te_idx) in enumerate(folds):
+        te_idx = np.sort(np.asarray(te_idx, np.int64))
+        ms = create_metrics(cfg.resolved_metrics(), cfg)
+        for m in ms:
+            m.init(_meta_view(md, te_idx), int(len(te_idx)))
+        valid_metrics.append(ms)
+        te_dev.append(jnp.asarray(te_idx))
+        if eval_train_metric:
+            tr_idx = np.sort(np.asarray(tr_idx, np.int64))
+            mt = create_metrics(cfg.resolved_metrics(), cfg)
+            for m in mt:
+                m.init(_meta_view(md, tr_idx), int(len(tr_idx)))
+            train_metrics.append(mt)
+            tr_dev.append(jnp.asarray(tr_idx))
+
+    cvbooster = CVBooster()
+    results = collections.defaultdict(list)
+    objective = bb._obj_eval[0]
+    for i in range(num_boost_round):
+        for cb in cb_before:
+            cb(CallbackEnv(model=cvbooster, params=params, iteration=i,
+                           begin_iteration=0,
+                           end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        bb.step()
+        jobs, shape = [], []
+        score = bb.scores
+        for f in range(len(folds)):
+            if eval_train_metric:
+                jobs.append((train_metrics[f], score[f][tr_dev[f]],
+                             "train"))
+            jobs.append((valid_metrics[f], score[f][te_dev[f]],
+                         "valid"))
+            shape.append(2 if eval_train_metric else 1)
+        tel.count_iter("host.syncs")
+        tel.count_iter("host.dispatches", len(jobs))
+        per_job = batched_eval(jobs, objective)
+        raw, k = [], 0
+        for njobs in shape:
+            one = []
+            for rows in per_job[k:k + njobs]:
+                one.extend(rows)
+            raw.append(one)
+            k += njobs
+        res = _agg_cv_result(raw, eval_train_metric)
+        for _, key, mean, _, std in res:
+            results[f"{key}-mean"].append(mean)
+            results[f"{key}-stdv"].append(std)
+        try:
+            for cb in cb_after:
+                cb(CallbackEnv(model=cvbooster, params=params,
+                               iteration=i, begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=res))
+        except EarlyStopException as earlyStopException:
+            cvbooster.best_iteration = \
+                earlyStopException.best_iteration + 1
+            for k in results:
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+    bb.finalize()
+    if return_cvbooster:
+        for f in range(len(folds)):
+            cvbooster._append(bb.booster(f))
+        results["cvbooster"] = cvbooster
+    return dict(results)
+
+
+# ----------------------------------------------------------------------
+def train_many(params_list: List[Dict[str, Any]], train_set: Dataset,
+               num_boost_round: int = 100, row_indices=None,
+               return_report: bool = False):
+    """Train MANY boosters over one Dataset, batching models whose
+    static shapes agree into single compiled grow programs.
+
+    ``params_list`` is one params dict per model (each may carry its
+    own ``num_boost_round`` alias). Models are bucketed by their
+    static configuration (num_leaves, max_bin, objective, ... —
+    everything but the vmapped hyperparameter axes), each bucket
+    trains as ONE :class:`~lightgbm_tpu.multiboost.BoosterBatch`, and
+    ineligible or solo models fall back to :func:`train`. Results come
+    back in input order; batched models are byte-identical to their
+    unbatched twins.
+
+    ``row_indices`` optionally gives a per-model row subset (tenant
+    partitions). ``return_report=True`` additionally returns the
+    bucketing report dict rendered by tools/run_report.py.
+    """
+    from .config import Config
+    from .multiboost.batch import (BoosterBatch, ModelSpec,
+                                   MultiboostError, bucket_models,
+                                   multiboost_ineligible_reason,
+                                   multiboost_mode)
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    if row_indices is not None and len(row_indices) != len(params_list):
+        raise ValueError("row_indices must align with params_list")
+
+    specs: List[ModelSpec] = []
+    rounds: List[int] = []
+    configs: List[Config] = []
+    for i, p in enumerate(params_list):
+        p = copy.deepcopy(p)
+        nbr = int(num_boost_round)
+        for alias in _ROUND_ALIASES:
+            if alias in p:
+                nbr = int(p.pop(alias))
+        for alias in _ES_ALIASES:
+            p.pop(alias, None)
+        idx = None if row_indices is None else row_indices[i]
+        if idx is not None:
+            idx = np.asarray(idx)
+        specs.append(ModelSpec(params=p, row_index=idx,
+                               name=f"model{i}"))
+        rounds.append(nbr)
+        configs.append(Config.from_params(p))
+
+    train_set.construct()
+    inner = train_set._inner
+
+    def _loop_reason(i: int) -> Optional[str]:
+        cfg = configs[i]
+        if multiboost_mode(cfg) == "off":
+            return "multiboost=off"
+        r = multiboost_ineligible_reason(cfg, inner)
+        if r is not None:
+            return r
+        if specs[i].row_index is not None and cfg.bagging_freq > 0 \
+                and cfg.bagging_fraction < 1.0:
+            return "bagging combined with row masks"
+        return None
+
+    boosters: List[Optional[Booster]] = [None] * len(specs)
+    report = {"models": len(specs), "buckets": [], "loop_fallback": []}
+    batchable: List[int] = []
+    for i in range(len(specs)):
+        r = _loop_reason(i)
+        if r is None:
+            batchable.append(i)
+        else:
+            report["loop_fallback"].append(
+                {"model": specs[i].name, "reason": r})
+
+    # rounds are part of the static key: one program steps one bucket
+    by_rounds: Dict[int, List[int]] = collections.defaultdict(list)
+    for i in batchable:
+        by_rounds[rounds[i]].append(i)
+    t0 = time.perf_counter()
+    for nbr, group in by_rounds.items():
+        cap = max(int(configs[group[0]].multiboost_max_batch), 1)
+        buckets = bucket_models([specs[i] for i in group],
+                                [configs[i] for i in group],
+                                max_batch=cap)
+        for bucket in buckets:
+            orig = [group[j] for j, _s, _c in bucket]
+            if len(bucket) == 1 and \
+                    multiboost_mode(configs[orig[0]]) != "on":
+                report["loop_fallback"].append(
+                    {"model": specs[orig[0]].name,
+                     "reason": "solo bucket (auto mode)"})
+                continue
+            try:
+                bb = BoosterBatch(train_set,
+                                  [s for _i, s, _c in bucket], nbr,
+                                  configs=[c for _i, _s, c in bucket])
+                bb.train()
+            except MultiboostError as e:
+                for i in orig:
+                    report["loop_fallback"].append(
+                        {"model": specs[i].name, "reason": str(e)})
+                continue
+            for b, i in enumerate(orig):
+                boosters[i] = bb.booster(b)
+            report["buckets"].append(
+                {"models": [specs[i].name for i in orig],
+                 "rounds": nbr, "size": len(orig)})
+    report["batched_seconds"] = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    for i in range(len(specs)):
+        if boosters[i] is not None:
+            continue
+        ds = train_set if specs[i].row_index is None \
+            else train_set.subset(specs[i].row_index)
+        boosters[i] = train(dict(specs[i].params), ds,
+                            num_boost_round=rounds[i])
+    report["loop_seconds"] = time.perf_counter() - t1
+    report["batched_models"] = sum(b["size"] for b in report["buckets"])
+    get_telemetry().record(
+        "multiboost_report",
+        models=report["models"],
+        batched_models=report["batched_models"],
+        buckets=len(report["buckets"]),
+        bucket_sizes=",".join(str(b["size"])
+                              for b in report["buckets"]),
+        loop_fallback=len(report["loop_fallback"]),
+        fallback_reasons="; ".join(sorted(
+            {f["reason"] for f in report["loop_fallback"]})),
+        batched_seconds=round(report["batched_seconds"], 6),
+        loop_seconds=round(report["loop_seconds"], 6))
+    if return_report:
+        return boosters, report
+    return boosters

@@ -126,9 +126,18 @@ def threshold_l1(s, l1):
 
 
 def leaf_output_no_constraint(g, h, l1, l2, max_delta_step):
-    """CalculateSplittedLeafOutput (feature_histogram.hpp:497-504)."""
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:497-504).
+
+    ``max_delta_step`` is a python float on the serial path (the clip
+    is compiled in or out statically) but a traced per-model scalar
+    under multiboost's vmap — there the cap widens to +inf when the
+    step is 0, which is a bitwise no-op (clip(x, -inf, inf) == x,
+    NaNs propagate through max/min unchanged)."""
     out = -threshold_l1(g, l1) / (h + l2)
-    if max_delta_step > 0.0:
+    if isinstance(max_delta_step, jnp.ndarray):
+        cap = jnp.where(max_delta_step > 0.0, max_delta_step, jnp.inf)
+        out = jnp.clip(out, -cap, cap)
+    elif max_delta_step > 0.0:
         out = jnp.clip(out, -max_delta_step, max_delta_step)
     return out
 

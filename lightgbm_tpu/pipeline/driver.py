@@ -34,7 +34,7 @@ from ..utils.log import log_fatal, log_info, log_warning
 from .logsource import ReplayLogSource, TailLogSource
 from .publisher import Publisher
 from .ramp import RampController, RampThresholds, set_stage
-from .trainer import RefitTrainer
+from .trainer import RefitTrainer, TenantRefitTrainer
 
 
 class PipelineDriver:
@@ -65,11 +65,37 @@ class PipelineDriver:
             model_text = fh.read()
         booster = Booster(model_str=model_text)
         self.n_features = booster.num_feature()
+        obj = ""
+        for line in model_text.splitlines():
+            if line.startswith("objective="):
+                obj = line[len("objective="):]
+                break
 
+        # per-tenant logical models all start from the production
+        # model; each tenant's refit/promote lifecycle then advances
+        # its own registry entry independently
+        self.tenants = [str(t) for t in (cfg.pipeline_tenants or [])]
+        models = {"default": booster}
+        for t in self.tenants:
+            models.setdefault(t, booster)
         self.fleet = fleet if fleet is not None else \
-            FleetEngine.from_config(cfg, models={"default": booster})
+            FleetEngine.from_config(cfg, models=models)
         self.model = self.fleet.default_model
         self.publisher = Publisher(self.fleet, model=self.model)
+        self.tenant_publishers: Dict[str, Publisher] = {}
+        self.tenant_trainer = None
+        if self.tenants:
+            for t in self.tenants:
+                if not self.fleet.fleet.has(t):
+                    self.fleet.load_model(t, model_text)
+            self.tenant_publishers = {
+                t: Publisher(self.fleet, model=t) for t in self.tenants}
+            self.tenant_trainer = TenantRefitTrainer(
+                self.tenants, params=self.params,
+                num_boost_round=int(cfg.pipeline_continue_iters),
+                objective=obj.split(" ")[0] if obj else "",
+                checkpoint_dir=cfg.pipeline_dir,
+                checkpoint_keep=int(cfg.checkpoint_keep))
         self.trainer = RefitTrainer(
             model_text, params=self.params,
             mode=cfg.pipeline_mode,
@@ -107,11 +133,6 @@ class PipelineDriver:
             self.source = TailLogSource(cfg.pipeline_log_path,
                                         self.n_features)
         else:
-            obj = ""
-            for line in model_text.splitlines():
-                if line.startswith("objective="):
-                    obj = line[len("objective="):]
-                    break
             self.source = ReplayLogSource(
                 n_features=self.n_features,
                 seed=int(cfg.pipeline_replay_seed),
@@ -188,6 +209,14 @@ class PipelineDriver:
             "history": list(self.history),
             "slo": slo_report,
         }
+        if self.tenants:
+            summary["tenants"] = {
+                t: {"promoted": sum(
+                    1 for r in self.history
+                    if (r.get("tenants") or {}).get(t, {}).get(
+                        "promoted")),
+                    "primary": self.tenant_publishers[t].primary_name()}
+                for t in self.tenants}
         tel.record("pipeline_summary", **{
             k: v for k, v in summary.items()
             if isinstance(v, (int, float, str, bool))})
@@ -201,6 +230,8 @@ class PipelineDriver:
 
     # ------------------------------------------------------------------
     def _cycle(self, index: int, guard=None) -> Dict[str, Any]:
+        if self.tenants:
+            return self._cycle_tenants(index, guard)
         tel = get_telemetry()
         tracer = get_tracer()
         rec: Dict[str, Any] = {"cycle": index}
@@ -265,6 +296,167 @@ class PipelineDriver:
                        promoted=bool(promoted),
                        window=window.index, rows=window.rows)
         return rec
+
+    # ------------------------------------------------------------------
+    def _cycle_tenants(self, index: int, guard=None) -> Dict[str, Any]:
+        """One refit-and-promote cycle PER TENANT over one shared
+        window: admit each tenant's row slice against its byte quota,
+        train every admitted tenant's candidate as ONE multiboost
+        batch, then publish + quality-gate + promote/rollback each
+        tenant's candidate against its own registry entry. Emits a
+        per-tenant stage timeline (``rec["timeline"]``) rendered by
+        tools/run_report.py."""
+        from ..serving.errors import QuotaExceededError
+        tel = get_telemetry()
+        tracer = get_tracer()
+        rec: Dict[str, Any] = {"cycle": index, "tenants": {}}
+        timeline: List[Dict[str, Any]] = []
+        t_cycle0 = time.monotonic()
+
+        def mark(tenant: str, stage: str, t0: float) -> None:
+            timeline.append({
+                "tenant": tenant, "stage": stage,
+                "start_s": round(t0 - t_cycle0, 6),
+                "dur_s": round(time.monotonic() - t0, 6)})
+
+        with tracer.span("pipeline.cycle", cat="pipeline",
+                         args={"cycle": index,
+                               "tenants": len(self.tenants)}):
+            set_stage("ingest")
+            with tel.span("pipeline.ingest"):
+                window = self.source.next_window(self.window_rows)
+                holdout_w = None
+                if window is not None:
+                    holdout_w = self.source.next_window(
+                        self.holdout_rows)
+            if window is None or holdout_w is None:
+                rec["status"] = "no_data"
+                tel.count("pipeline.empty_windows")
+                return rec
+            rec["window"] = window.describe()
+            parts = self.tenant_trainer.partition(window.rows)
+            hold_parts = self.tenant_trainer.partition(holdout_w.rows)
+
+            # admission: each tenant's refit is charged its window
+            # slice's decoded f64 bytes BEFORE any training happens —
+            # a throttled tenant skips this cycle, the others proceed
+            admitted: List[str] = []
+            for t in self.tenants:
+                nbytes = int(parts[t].size) * (self.n_features + 1) * 8
+                t0 = time.monotonic()
+                trec: Dict[str, Any] = {
+                    "window_rows": int(parts[t].size)}
+                try:
+                    self.fleet.charge_tenant_bytes(t, nbytes)
+                    admitted.append(t)
+                    trec["status"] = "admitted"
+                    trec["charged_bytes"] = nbytes
+                except QuotaExceededError as e:
+                    trec["status"] = "quota_exceeded"
+                    trec["reason"] = str(e)[:128]
+                    trec["charged_bytes"] = 0
+                    tel.count("pipeline.tenant_quota_denials")
+                    log_warning(f"pipeline: tenant {t!r} throttled "
+                                f"for cycle {index}: {e}")
+                rec["tenants"][t] = trec
+                mark(t, "admit", t0)
+            if not admitted:
+                rec["status"] = "all_tenants_throttled"
+                rec["timeline"] = timeline
+                return rec
+
+            set_stage("refit")
+            t0 = time.monotonic()
+            try:
+                cands = self.tenant_trainer.refit_all(window, admitted)
+            except Exception as e:
+                log_warning(f"pipeline: tenant refit failed for "
+                            f"window {window.index}: {e}")
+                tel.count("pipeline.refit_failures")
+                rec["status"] = "refit_failed"
+                rec["error"] = str(e)[:256]
+                rec["timeline"] = timeline
+                return rec
+            # ONE batched refit covers every admitted tenant: the
+            # shared span lands on each tenant's timeline row
+            for t in admitted:
+                mark(t, "refit", t0)
+            report = self.tenant_trainer.last_report or {}
+            rec["refit_report"] = {
+                k: report.get(k) for k in
+                ("models", "buckets", "loop_fallback",
+                 "batched_models", "batched_seconds")}
+
+            promoted_n = 0
+            for t in admitted:
+                cand = cands[t]
+                pub = self.tenant_publishers[t]
+                trec = rec["tenants"][t]
+                trec["candidate"] = cand.cid
+                set_stage("publish")
+                t0 = time.monotonic()
+                name = pub.publish(cand)
+                mark(t, "publish", t0)
+                if name is None:
+                    trec["status"] = cand.status     # rejected
+                    trec["reason"] = cand.reason
+                    continue
+                if guard is not None and guard.requested:
+                    trec["status"] = "preempted_before_ramp"
+                    continue
+                set_stage("ramp")
+                t0 = time.monotonic()
+                hidx = hold_parts[t]
+                ok = self._tenant_gate(pub, cand, holdout_w.X[hidx],
+                                       holdout_w.y[hidx])
+                mark(t, "ramp", t0)
+                trec["status"] = cand.status
+                trec["reason"] = cand.reason
+                trec["promoted"] = ok
+                trec["model_text_sha"] = _sha16(cand.model_text)
+                if ok:
+                    promoted_n += 1
+                tel.record("pipeline_tenant_cycle", cycle=index,
+                           tenant=t, candidate=cand.cid,
+                           status=cand.status, promoted=ok,
+                           window=window.index,
+                           rows=int(parts[t].size))
+            rec["status"] = "tenants"
+            rec["promoted"] = promoted_n > 0
+            rec["promoted_tenants"] = promoted_n
+            rec["timeline"] = timeline
+        return rec
+
+    def _tenant_gate(self, pub: Publisher, cand, Xh, yh) -> bool:
+        """Single-stage quality gate for one tenant's candidate: score
+        candidate vs current primary on the tenant's OWN holdout slice
+        (``ramp.default_quality``), promote unless the drop exceeds
+        ``pipeline_quality_drop``, roll back otherwise. The full
+        staged-canary RampController stays the single-model path's
+        gate; T tenants x S stages x stage_requests live requests per
+        cycle would swamp the loop."""
+        from .ramp import default_quality
+        # the promote below flips the CANARY rule to primary, so the
+        # candidate must hold the canary slot while it is gated
+        pub.start_canary(cand, 1.0)
+        if len(yh) == 0:
+            pub.promote(cand)
+            return True
+        try:
+            cq = default_quality(
+                self.fleet.predict(Xh, model=cand.name), yh)
+            pq = default_quality(
+                self.fleet.predict(Xh, model=pub.primary_name()), yh)
+        except Exception as e:
+            pub.rollback(cand, f"quality_probe_failed: {e}")
+            return False
+        drop = pq - cq
+        if drop > float(self.config.pipeline_quality_drop):
+            pub.rollback(cand, f"quality_drop:{drop:.6g} (> "
+                         f"{float(self.config.pipeline_quality_drop):g})")
+            return False
+        pub.promote(cand)
+        return True
 
     # ------------------------------------------------------------------
     def stop(self) -> None:

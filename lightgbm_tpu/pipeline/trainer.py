@@ -140,25 +140,143 @@ class RefitTrainer:
         """Atomic candidate checkpoint (robustness/checkpoint.py) under
         ``<checkpoint_dir>/cand_<id>/`` — model text + training state
         + digest manifest, keep-last-K over candidate directories."""
-        if not self.checkpoint_dir:
-            return
+        checkpoint_candidate(cand, self.checkpoint_dir,
+                             self.checkpoint_keep)
+
+
+def checkpoint_candidate(cand: Candidate, checkpoint_dir: str,
+                         keep: int) -> None:
+    """Atomic keep-last-K candidate checkpoint; shared by the single-
+    model and per-tenant trainers (no-op without a directory)."""
+    if not checkpoint_dir:
+        return
+    path = os.path.join(checkpoint_dir, f"cand_{cand.cid:05d}")
+    if getattr(cand.booster, "_gbdt", None) is not None:
         from ..robustness.checkpoint import CheckpointManager
-        path = os.path.join(self.checkpoint_dir, f"cand_{cand.cid:05d}")
         mgr = CheckpointManager(path, freq=0, keep=1)
         cand.checkpoint_path = mgr.save(cand.booster, [], 0)
-        get_telemetry().count("pipeline.candidate_checkpoints")
-        self._retain_candidates()
+    else:
+        # a text-backed candidate (multiboost tenant batches) has no
+        # live training state; the model text IS the whole artifact
+        from ..robustness.checkpoint import atomic_write_text
+        os.makedirs(path, exist_ok=True)
+        atomic_write_text(os.path.join(path, "model.txt"),
+                          cand.model_text)
+        cand.checkpoint_path = path
+    get_telemetry().count("pipeline.candidate_checkpoints")
+    if not os.path.isdir(checkpoint_dir):
+        return
+    dirs: List[str] = sorted(d for d in os.listdir(checkpoint_dir)
+                             if d.startswith("cand_"))
+    import shutil
+    for stale in dirs[:-max(int(keep), 1)]:
+        shutil.rmtree(os.path.join(checkpoint_dir, stale),
+                      ignore_errors=True)
 
-    def _retain_candidates(self) -> None:
-        if not os.path.isdir(self.checkpoint_dir):
-            return
-        dirs: List[str] = sorted(
-            d for d in os.listdir(self.checkpoint_dir)
-            if d.startswith("cand_"))
-        import shutil
-        for stale in dirs[:-max(self.checkpoint_keep, 1)]:
-            shutil.rmtree(os.path.join(self.checkpoint_dir, stale),
-                          ignore_errors=True)
+
+class TenantRefitTrainer:
+    """Per-tenant candidates from one window, batched as ONE compiled
+    multiboost program.
+
+    Every tenant owns a deterministic round-robin partition of the
+    window's rows and a deterministic per-tenant seed; the candidates
+    for ALL admitted tenants train through
+    :func:`lightgbm_tpu.engine.train_many` — one
+    :class:`~lightgbm_tpu.multiboost.BoosterBatch` bucket, so a fleet
+    of T tenant models pays ONE grow dispatch per boosting iteration
+    instead of T (the per-tenant row masks ride the batch's mask axis,
+    the per-tenant seeds its vmapped hyperparameter axes). Candidates
+    are fresh models over the tenant's slice — the multi-tenant analog
+    of ``pipeline_mode=continue``'s full retrain, sized by
+    ``pipeline_continue_iters``.
+    """
+
+    def __init__(self, tenants, params: Optional[Dict[str, Any]] = None,
+                 num_boost_round: int = 10, objective: str = "",
+                 checkpoint_dir: str = "", checkpoint_keep: int = 3):
+        self.tenants = [str(t) for t in tenants]
+        if not self.tenants:
+            raise ValueError("TenantRefitTrainer requires >= 1 tenant")
+        self.params = dict(params or {})
+        self.num_boost_round = int(num_boost_round)
+        self.objective = objective
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_keep = int(checkpoint_keep)
+        self.last_report: Optional[Dict[str, Any]] = None
+        self._next_cid = 1
+
+    @staticmethod
+    def tenant_seed(tenant: str) -> int:
+        """Deterministic per-tenant seed (stable across processes —
+        NOT ``hash()``, which is salted per interpreter)."""
+        import zlib
+        return int(zlib.crc32(str(tenant).encode()) % 100003) + 1
+
+    def partition(self, n_rows: int) -> Dict[str, Any]:
+        """Round-robin row partition: tenant ``i`` of T owns rows
+        ``i, i+T, i+2T, ...`` — every tenant sees the same traffic mix
+        and the union covers the window exactly once."""
+        import numpy as np
+        T = len(self.tenants)
+        return {t: np.arange(i, int(n_rows), T)
+                for i, t in enumerate(self.tenants)}
+
+    def _base_params(self) -> Dict[str, Any]:
+        params = {k: v for k, v in self.params.items()
+                  if not str(k).startswith(("pipeline_", "serving_"))
+                  and k not in ("task", "input_model", "output_model",
+                                "data", "config", "num_iterations")}
+        if self.objective and "objective" not in params:
+            params["objective"] = self.objective
+        return params
+
+    def refit_all(self, window: LabeledWindow,
+                  tenants=None) -> Dict[str, Candidate]:
+        """One candidate per (admitted) tenant from one window; all of
+        them trained by one ``train_many`` call."""
+        from .. import engine
+        from ..basic import Dataset
+        tel = get_telemetry()
+        tenants = [str(t) for t in (tenants or self.tenants)]
+        parts = self.partition(window.rows)
+        base = self._base_params()
+        params_list = []
+        rows = []
+        for t in tenants:
+            p = dict(base)
+            # the per-tenant seed rides the VMAPPED bagging_seed axis
+            # (plain ``seed`` is a static bucket key and would split
+            # every tenant into its own bucket, defeating the batch)
+            p["bagging_seed"] = self.tenant_seed(t)
+            params_list.append(p)
+            rows.append(parts[t])
+        with get_tracer().span("pipeline.tenant_refit", cat="pipeline",
+                               args={"tenants": len(tenants),
+                                     "window": window.index,
+                                     "rows": window.rows}):
+            with tel.span("pipeline.refit"):
+                boosters, report = engine.train_many(
+                    params_list,
+                    Dataset(window.X, label=window.y),
+                    num_boost_round=self.num_boost_round,
+                    row_indices=rows, return_report=True)
+        self.last_report = report
+        out: Dict[str, Candidate] = {}
+        for t, booster in zip(tenants, boosters):
+            cand = Candidate(self._next_cid, booster.model_to_string(),
+                             "multiboost", window.index,
+                             booster=booster)
+            self._next_cid += 1
+            tel.count("pipeline.candidates")
+            checkpoint_candidate(cand, self.checkpoint_dir,
+                                 self.checkpoint_keep)
+            out[t] = cand
+        log_info(f"pipeline: {len(out)} tenant candidates from window "
+                 f"{window.index} ({report['batched_models']} batched "
+                 f"in {len(report['buckets'])} bucket(s), "
+                 f"{len(report['loop_fallback'])} loop fallback)")
+        return out
 
 
-__all__ = ["Candidate", "RefitTrainer", "MODES"]
+__all__ = ["Candidate", "RefitTrainer", "TenantRefitTrainer",
+           "checkpoint_candidate", "MODES"]

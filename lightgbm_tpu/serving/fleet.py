@@ -522,6 +522,20 @@ class FleetEngine:
             self._count("promotions")
         return promoted
 
+    def charge_tenant_bytes(self, tenant: str, nbytes: int) -> None:
+        """Charge ``nbytes`` of control-plane traffic (a pipeline
+        refit window slice, a republished model text) against the
+        tenant's admission bucket. Under ``serving_quota_unit=bytes``
+        the cost is the byte count itself, so a tenant's refit volume
+        draws from the SAME budget as its data-plane payloads; under
+        ``requests`` the charge costs one token. Raises the structured
+        :class:`QuotaExceededError` exactly like the data plane — the
+        pipeline skips that tenant's cycle and retries after the
+        bucket refills."""
+        self.quotas.check(tenant,
+                          cost=self.quotas.request_cost(int(nbytes)))
+        self._count("tenant_byte_charges")
+
     # -- replica lifecycle --------------------------------------------
     def add_replica(self) -> Replica:
         """Cold-start one replica: build engines for every model and

@@ -1,0 +1,328 @@
+"""Multiboost: many-model training as ONE compiled program (ISSUE 18,
+lightgbm_tpu/multiboost/ + engine.train_many — docs/MultiModel.md).
+
+Fast halves (no engine): static bucketing rules (vmapped axes never
+split buckets; static params always do), eligibility reasons, mode
+parsing, and the bench-trend ``multiboost_speedup`` gate over
+synthetic rounds.
+
+Slow halves (train): the byte-identity contract — every batched
+model's text equals its unbatched ``engine.train`` twin's, with and
+without bagging (per-model threefry draws keyed on
+``(bagging_seed, iter)``), at B=1 (forced) and B=3; batched ``cv``
+fold-metric parity vs the ``multiboost=off`` loop; train_many
+fallback/report behavior; and the per-tenant pipeline cycle
+(byte-quota admission -> ONE batched refit -> per-tenant promote).
+CI's ``multiboost-dryrun`` job additionally runs the 16-model sweep
+gate (tools/multiboost_dryrun.py) on every PR.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.multiboost import (VMAPPED_PARAMS, bucket_key,
+                                     bucket_models,
+                                     multiboost_ineligible_reason,
+                                     multiboost_mode)
+from lightgbm_tpu.multiboost.batch import ModelSpec
+
+
+def _cfg(**over):
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    params.update(over)
+    return Config.from_params(params)
+
+
+# ----------------------------------------------------------------------
+# bucketing: vmapped axes never split a bucket, static params always do
+def test_vmapped_axes_share_a_bucket_key():
+    base = _cfg()
+    for name, val in [("learning_rate", 0.5), ("lambda_l1", 1.0),
+                      ("lambda_l2", 3.0), ("min_data_in_leaf", 40),
+                      ("bagging_fraction", 0.5),
+                      ("bagging_seed", 777)]:
+        assert name in VMAPPED_PARAMS
+        assert bucket_key(_cfg(**{name: val})) == bucket_key(base), name
+
+
+def test_static_params_split_buckets():
+    base = bucket_key(_cfg())
+    assert bucket_key(_cfg(num_leaves=31)) != base
+    assert bucket_key(_cfg(max_bin=63)) != base
+    assert bucket_key(_cfg(objective="regression")) != base
+    # plain `seed` is static: per-model seeds must ride bagging_seed
+    # (docs/MultiModel.md) or every model lands in its own bucket
+    assert bucket_key(_cfg(seed=99)) != base
+
+
+def test_bucket_models_groups_and_chunks():
+    specs = [ModelSpec(params={"objective": "binary", "num_leaves": 7,
+                               "verbosity": -1,
+                               "learning_rate": 0.1 + 0.01 * i})
+             for i in range(5)]
+    specs.append(ModelSpec(params={"objective": "binary",
+                                   "num_leaves": 31, "verbosity": -1}))
+    buckets = bucket_models(specs)
+    assert sorted(len(b) for b in buckets) == [1, 5]
+    # results keep input order inside a bucket and carry the index
+    big = max(buckets, key=len)
+    assert [i for i, _, _ in big] == [0, 1, 2, 3, 4]
+    # max_batch chunks the model axis
+    chunked = bucket_models(specs[:5], max_batch=2)
+    assert [len(b) for b in chunked] == [2, 2, 1]
+
+
+def test_ineligibility_reasons_and_mode():
+    assert multiboost_ineligible_reason(_cfg()) is None
+    assert "objective=lambdarank" in multiboost_ineligible_reason(
+        _cfg(objective="lambdarank", num_class=1))
+    assert "linear_tree" in multiboost_ineligible_reason(
+        _cfg(linear_tree=True))
+    assert multiboost_mode(_cfg(multiboost="on")) == "on"
+    with pytest.raises(ValueError, match="auto|on|off"):
+        multiboost_mode(_cfg(multiboost="sometimes"))
+
+
+def test_multiboost_param_aliases_resolve():
+    cfg = Config.from_params({"use_multiboost": "off",
+                              "multiboost_batch": 8,
+                              "tenants": "acme,initech"})
+    assert cfg.multiboost == "off"
+    assert cfg.multiboost_max_batch == 8
+    assert cfg.pipeline_tenants == ["acme", "initech"]
+
+
+# ----------------------------------------------------------------------
+# bench-trend gate: the multiboost_speedup series trips on regression
+def _round(label, value, ok=True, models=16):
+    line = {"metric": "multiboost_speedup", "value": value, "ok": ok,
+            "models": models, "rows": 2048, "iters": 10,
+            "dispatch_ratio": 0.02}
+    return {"label": label, "lines": [line]}
+
+
+def test_bench_trend_gates_multiboost_speedup_regression():
+    from tools.bench_trend import analyze
+    rep = analyze([_round("r1", 2.0), _round("r2", 1.2)],
+                  threshold=0.2)
+    trips = [r for r in rep["regressions"]
+             if r["series"] == "multiboost_speedup"]
+    assert len(trips) == 1 and rep["verdict"] == "regression"
+    assert trips[0]["from_value"] == 2.0
+    assert trips[0]["to_value"] == 1.2
+    # a within-threshold wobble passes
+    rep = analyze([_round("r1", 2.0), _round("r2", 1.9)],
+                  threshold=0.2)
+    assert not [r for r in rep["regressions"]
+                if r["series"] == "multiboost_speedup"]
+    assert rep["gated_points"]["multiboost_speedup"] == 2
+
+
+def test_bench_trend_skips_failed_and_reshaped_points():
+    from tools.bench_trend import analyze
+    # a failing dryrun (ok=false) must not seed the trend
+    rep = analyze([_round("r1", 2.0), _round("r2", 0.1, ok=False)],
+                  threshold=0.2)
+    assert rep["gated_points"]["multiboost_speedup"] == 1
+    assert rep["verdict"] == "ok"
+    # a shape change breaks the comparison chain deliberately
+    rep = analyze([_round("r1", 2.0), _round("r2", 0.5, models=32)],
+                  threshold=0.2)
+    assert not [r for r in rep["regressions"]
+                if r["series"] == "multiboost_speedup"]
+
+
+# ======================================================================
+# engine-backed halves: the byte-identity contract
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(11)
+    X = rng.rand(400, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + 0.2 * rng.randn(400) > 0.75).astype(np.float64)
+    return X, y
+
+
+def _sweep(n, **extra):
+    out = []
+    for i in range(n):
+        p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+             "multiboost": "on", "learning_rate": 0.05 + 0.02 * i}
+        p.update(extra)
+        out.append(p)
+    return out
+
+
+@pytest.mark.slow
+def test_train_many_b3_byte_identical_to_loop(data):
+    from lightgbm_tpu import Dataset, engine
+    X, y = data
+    params = _sweep(3)
+    batched, report = engine.train_many(
+        [dict(p) for p in params], Dataset(X, label=y),
+        num_boost_round=4, return_report=True)
+    assert report["batched_models"] == 3 and not report["loop_fallback"]
+    assert [b["size"] for b in report["buckets"]] == [3]
+    for p, bst in zip(params, batched):
+        twin = engine.train(dict(p), Dataset(X, label=y),
+                            num_boost_round=4)
+        assert bst.model_to_string() == twin.model_to_string()
+
+
+@pytest.mark.slow
+def test_train_many_bagging_byte_identical(data):
+    # per-model subsample draws are threefry keyed on
+    # (bagging_seed, iter) — exactly the serial trainer's draw
+    from lightgbm_tpu import Dataset, engine
+    X, y = data
+    params = _sweep(3, bagging_fraction=0.7, bagging_freq=1)
+    for i, p in enumerate(params):
+        p["bagging_seed"] = 40 + i
+    batched, report = engine.train_many(
+        [dict(p) for p in params], Dataset(X, label=y),
+        num_boost_round=4, return_report=True)
+    assert report["batched_models"] == 3, report
+    for p, bst in zip(params, batched):
+        twin = engine.train(dict(p), Dataset(X, label=y),
+                            num_boost_round=4)
+        assert bst.model_to_string() == twin.model_to_string()
+
+
+@pytest.mark.slow
+def test_train_many_b1_forced_byte_identical(data):
+    # multiboost=on batches even a solo model (auto would loop it);
+    # the B=1 vmap must still be bit-equal to the serial path
+    from lightgbm_tpu import Dataset, engine
+    X, y = data
+    p = _sweep(1)[0]
+    batched, report = engine.train_many(
+        [dict(p)], Dataset(X, label=y), num_boost_round=4,
+        return_report=True)
+    assert report["batched_models"] == 1, report
+    twin = engine.train(dict(p), Dataset(X, label=y),
+                        num_boost_round=4)
+    assert batched[0].model_to_string() == twin.model_to_string()
+
+
+@pytest.mark.slow
+def test_train_many_fallback_keeps_order_and_reasons(data):
+    from lightgbm_tpu import Dataset, engine
+    X, y = data
+    params = _sweep(2)
+    params.insert(1, {"objective": "binary", "num_leaves": 7,
+                      "verbosity": -1, "multiboost": "off",
+                      "learning_rate": 0.1})
+    boosters, report = engine.train_many(
+        [dict(p) for p in params], Dataset(X, label=y),
+        num_boost_round=3, return_report=True)
+    assert len(boosters) == 3
+    assert report["batched_models"] == 2
+    assert [f["model"] for f in report["loop_fallback"]] == ["model1"]
+    assert "multiboost=off" in report["loop_fallback"][0]["reason"]
+    # the fallback model still equals its direct twin
+    twin = engine.train(dict(params[1]), Dataset(X, label=y),
+                        num_boost_round=3)
+    assert boosters[1].model_to_string() == twin.model_to_string()
+
+
+@pytest.mark.slow
+def test_cv_batched_fold_boosters_equal_train_many_twins(data):
+    # ONE bin layout + one grow program across folds: the batched cv's
+    # per-fold boosters must be BYTE-IDENTICAL to a train_many call
+    # over the same fold masks (the same BoosterBatch machinery fed
+    # the same row subsets). learning_rate=0.25 is a power of two so
+    # the async f32 score step matches the host-stepped f64 loop
+    # (docs/MultiModel.md; non-pow2 rates gate off in auto mode).
+    from lightgbm_tpu import Dataset, engine
+    X, y = data
+    base = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+            "learning_rate": 0.25, "metric": "binary_logloss",
+            "multiboost": "on"}
+    idx = np.arange(len(y))
+    folds = [(np.delete(idx, idx[f::3]), idx[f::3]) for f in range(3)]
+    res = engine.cv(dict(base), Dataset(X, label=y),
+                    num_boost_round=4, folds=folds,
+                    return_cvbooster=True)
+    twins = engine.train_many(
+        [dict(base) for _ in folds], Dataset(X, label=y),
+        num_boost_round=4, row_indices=[tr for tr, _ in folds])
+    cv_boosters = res["cvbooster"].boosters
+    assert len(cv_boosters) == 3
+    for fold_bst, twin in zip(cv_boosters, twins):
+        assert fold_bst.model_to_string() == twin.model_to_string()
+    assert len(res["binary_logloss-mean"]) == 4
+
+
+@pytest.mark.slow
+def test_cv_batched_matches_loop_foil_metrics(data):
+    # fold metrics vs the legacy per-fold loop: the batched path
+    # evaluates from device scores while the loop's boosters round
+    # through model text, so parity is allclose, not bitwise
+    from lightgbm_tpu import Dataset, engine
+    X, y = data
+    base = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+            "learning_rate": 0.25, "metric": "binary_logloss"}
+    batched = engine.cv(dict(base, multiboost="on"),
+                        Dataset(X, label=y), num_boost_round=4,
+                        nfold=3, seed=3)
+    loop = engine.cv(dict(base, multiboost="off"),
+                     Dataset(X, label=y), num_boost_round=4,
+                     nfold=3, seed=3)
+    assert sorted(batched) == sorted(loop)
+    for k in batched:
+        np.testing.assert_allclose(batched[k], loop[k], rtol=1e-5,
+                                   atol=1e-7, err_msg=k)
+
+
+@pytest.mark.slow
+def test_tenant_pipeline_cycle_quota_refit_promote(tmp_path):
+    """One driver cycle with three tenants: the byte-quota plane
+    throttles 'initech' (10 B/s burst 100 B vs a multi-KB window), the
+    two admitted tenants refit in ONE batched bucket, and each
+    admitted tenant's candidate canaries and promotes under its own
+    model name with the stage timeline recorded."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.pipeline import ReplayLogSource
+    from lightgbm_tpu.pipeline.driver import PipelineDriver
+    src = ReplayLogSource(n_features=8, seed=21)
+    w = src.next_window(500)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(w.X, label=w.y),
+                    num_boost_round=5)
+    path = str(tmp_path / "base.txt")
+    with open(path, "w") as fh:
+        fh.write(bst.model_to_string())
+    driver = PipelineDriver({
+        "task": "pipeline", "input_model": path, "verbosity": -1,
+        "pipeline_window_rows": 240, "pipeline_holdout_rows": 120,
+        "pipeline_continue_iters": 3,
+        "pipeline_quality_drop": 0.05,
+        "pipeline_tenants": "acme,globex,initech",
+        "pipeline_dir": str(tmp_path / "cands"),
+        "pipeline_replay_seed": 21,
+        "num_leaves": 7,
+        "serving_buckets": "1,64,512",
+        "serving_quota_unit": "bytes",
+        "serving_quota_tenants": "initech=10:100",
+    })
+    summary = driver.run(max_cycles=1)
+    rec = summary["history"][0]
+    assert rec["status"] == "tenants"
+    t = rec["tenants"]
+    assert t["initech"]["status"] == "quota_exceeded"
+    assert t["acme"]["promoted"] and t["globex"]["promoted"]
+    # ONE batched refit for both admitted tenants
+    rep = rec["refit_report"]
+    assert rep["batched_models"] == 2 and not rep["loop_fallback"]
+    # per-tenant primaries advanced; throttled tenant's did not
+    tsum = summary["tenants"]
+    assert tsum["acme"]["primary"].startswith("acme.cand")
+    assert tsum["globex"]["primary"].startswith("globex.cand")
+    assert tsum["initech"]["primary"] == "initech"
+    # the cycle timeline names every stage for the admitted tenants
+    stages = {(e["tenant"], e["stage"]) for e in rec["timeline"]}
+    for tenant in ("acme", "globex"):
+        for stage in ("admit", "refit", "publish", "ramp"):
+            assert (tenant, stage) in stages
+    assert ("initech", "admit") in stages

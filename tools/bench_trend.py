@@ -51,6 +51,7 @@ DEFAULT_THRESHOLD = 0.20
 FIXED_METRIC = "cpu_fixed_baseline_throughput"
 HEADLINE_METRIC = "higgs_like_train_throughput"
 DISPATCH_METRIC = "dispatches_per_split"
+MULTIBOOST_METRIC = "multiboost_speedup"
 
 
 def extract_lines(text: str) -> List[Dict[str, Any]]:
@@ -208,6 +209,29 @@ def _dispatch_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
         if v is not None and ln.get("baseline_config"):
             found = {"value": float(v),
                      "key": str(ln["baseline_config"])}
+    return found
+
+
+def _multiboost_point(lines: List[Dict]) -> Optional[Dict[str, Any]]:
+    """The round's multiboost sweep speedup (bench.py
+    run_multiboost_sweep → tools/multiboost_dryrun): batched-sweep
+    wall time vs the train-in-a-loop foil for the same models, keyed
+    by the sweep shape — higher is better. Only ``ok`` runs (all
+    models batched, byte-identical, dispatch budget met) chain; a
+    failing dryrun trips CI's own exit code and must not seed the
+    trend with a broken point."""
+    found = None
+    for ln in lines:
+        if ln.get("metric") != MULTIBOOST_METRIC \
+                or ln.get("value") is None or not ln.get("ok"):
+            continue
+        key = json.dumps({"models": ln.get("models"),
+                          "rows": ln.get("rows"),
+                          "iters": ln.get("iters")}, sort_keys=True)
+        found = {"value": float(ln["value"]), "key": key,
+                 "dispatch_ratio": ln.get("dispatch_ratio"),
+                 "batched_s": ln.get("batched_s"),
+                 "loop_s": ln.get("loop_s")}
     return found
 
 
@@ -435,7 +459,7 @@ def analyze(rounds: List[Dict[str, Any]],
             threshold: float = DEFAULT_THRESHOLD) -> Dict[str, Any]:
     fixed, serving, headline, dispatch, fleet = [], [], [], [], []
     fused, mesh, fleet_iso = [], [], []
-    single_row, shm_batch = [], []
+    single_row, shm_batch, mboost = [], [], []
     for rnd in rounds:
         p = _fixed_point(rnd["lines"])
         if p is not None:
@@ -467,6 +491,9 @@ def analyze(rounds: List[Dict[str, Any]],
         p = _shm_batch_point(rnd["lines"])
         if p is not None:
             shm_batch.append((rnd["label"], p))
+        p = _multiboost_point(rnd["lines"])
+        if p is not None:
+            mboost.append((rnd["label"], p))
 
     regressions = _gate(fixed, True, threshold,
                         FIXED_METRIC)
@@ -486,6 +513,7 @@ def analyze(rounds: List[Dict[str, Any]],
     attribute_hot_path_leg(shm_trips, "shm_large_batch_p99_ms",
                            shm_batch, threshold)
     regressions += sr_trips + shm_trips
+    regressions += _gate(mboost, True, threshold, MULTIBOOST_METRIC)
     return {
         "rounds": [r["label"] for r in rounds],
         "threshold_pct": round(threshold * 100.0, 2),
@@ -515,6 +543,8 @@ def analyze(rounds: List[Dict[str, Any]],
                 {"round": lb, **pt} for lb, pt in shm_batch],
             DISPATCH_METRIC: [
                 {"round": lb, **pt} for lb, pt in dispatch],
+            MULTIBOOST_METRIC: [
+                {"round": lb, **pt} for lb, pt in mboost],
             # informational only — config drifts across rounds
             HEADLINE_METRIC + "_ungated": [
                 {"round": lb, **pt} for lb, pt in headline],
@@ -527,7 +557,8 @@ def analyze(rounds: List[Dict[str, Any]],
                          "fleet_isolation_p99_ms": len(fleet_iso),
                          "single_row_p99_ms": len(single_row),
                          "shm_large_batch_p99_ms": len(shm_batch),
-                         DISPATCH_METRIC: len(dispatch)},
+                         DISPATCH_METRIC: len(dispatch),
+                         MULTIBOOST_METRIC: len(mboost)},
         "regressions": regressions,
         "verdict": "regression" if regressions else "ok",
     }

@@ -363,6 +363,53 @@ def _b_linear_leaf_fit():
         jnp.zeros((L,), jnp.float32), lam=0.1, l2=0.0)
 
 
+# --- multiboost: B models' iteration as ONE program ------------------
+def _multiboost_batch():
+    def make():
+        import numpy as np
+
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.multiboost.batch import (BoosterBatch,
+                                                   ModelSpec)
+        rng = np.random.RandomState(0)
+        x = rng.randn(GROW_ROWS, GROW_FEATURES).astype(np.float32)
+        y = (x[:, 0] - 0.5 * x[:, 1]
+             + 0.2 * rng.randn(GROW_ROWS) > 0).astype(np.float32)
+        specs = [ModelSpec(params={
+            "objective": "binary", "num_leaves": GROW_LEAVES,
+            "min_data_in_leaf": 5, "verbosity": -1,
+            "learning_rate": 0.1 + 0.1 * i}) for i in range(3)]
+        bb = BoosterBatch(lgb.Dataset(x, label=y), specs,
+                          num_boost_round=3)
+        return bb.setup()
+    return _env("multiboost_batch", make)
+
+
+@builder("multiboost_grow")
+def _b_multiboost_grow():
+    """The vmapped grow program at its hot (async) boundary: the
+    [B, N] score is donated and the contract pins zero collectives —
+    vmap widening a cross-device op along the model axis is exactly
+    the regression GC401 catches here (see the bad_multiboost
+    fixture)."""
+    import jax.numpy as jnp
+    bb = _multiboost_batch()
+    fn = _spec_fn("multiboost_grow")
+    score = jnp.zeros((bb.B, bb.N), jnp.float32)
+    return fn.lower(score, jnp.int32(1), bb._attrs, bb._masks,
+                    bb._hyp, sync0=False)
+
+
+@builder("multiboost_score_add")
+def _b_multiboost_score_add():
+    import jax.numpy as jnp
+    fn = _spec_fn("multiboost_score_add")
+    B = 3
+    return fn.lower(jnp.zeros((B, N), jnp.float32),
+                    jnp.zeros((B, L), jnp.float32),
+                    jnp.zeros((B, N), jnp.int32))
+
+
 # --- grow programs (shared with the hlo_census front-end) ------------
 @builder("serial_grow")
 def _b_serial_grow():
@@ -648,6 +695,7 @@ def import_side_registrations() -> None:
     import lightgbm_tpu.models.linear    # noqa: F401
     import lightgbm_tpu.models.tree      # noqa: F401
     import lightgbm_tpu.models.variants  # noqa: F401
+    import lightgbm_tpu.multiboost.program       # noqa: F401
     import lightgbm_tpu.objective.rank   # noqa: F401
     import lightgbm_tpu.ops.hist_pallas  # noqa: F401
     import lightgbm_tpu.ops.partition_pallas     # noqa: F401

@@ -1,0 +1,168 @@
+"""Multiboost dryrun: a 16-model sweep as ONE compiled program.
+
+Trains a hyperparameter sweep twice on the same synthetic problem:
+
+* **batched** — ``engine.train_many`` with ``multiboost=on``: every
+  model rides one :class:`~lightgbm_tpu.multiboost.BoosterBatch`
+  bucket, so each boosting iteration is ONE jitted grow dispatch for
+  the whole sweep;
+* **foil** — the same models trained one ``engine.train`` call at a
+  time (the loop a sweep would otherwise pay).
+
+Hard checks (exit 1 on any failure — CI's ``multiboost-dryrun`` job):
+
+* every batched model's text is BYTE-IDENTICAL to its loop twin's
+  (the multiboost correctness contract);
+* all models actually batched (no silent loop fallback);
+* the batched path's ``host.dispatches`` telemetry counter is at most
+  ``foil / 8`` (the many-models-one-program point of the subsystem).
+
+Usage::
+
+    python -m tools.multiboost_dryrun [--models 16] [--rows 4096]
+        [--features 16] [--iters 20] [--json out.json]
+
+Prints one JSON result line (metric ``multiboost_speedup``; value =
+foil wall seconds / batched wall seconds) that bench.py forwards and
+tools/bench_trend.py gates round over round.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def sweep_params(n: int):
+    """An n-point sweep along the BYTE-EXACT vmapped axes:
+    learning_rate (host-side shrink, never enters the grow graph) and
+    the per-model bagging draw (threefry keyed on the model's seed).
+    Regularization axes (lambda_l1/l2, min_child stats) batch too, but
+    when they VARY within a bucket they enter the grow graph as traced
+    scalars and trade last-ulp recorded-gain identity
+    (docs/MultiModel.md) — this dryrun pins the byte-identity
+    contract, so it sweeps only the exact axes."""
+    out = []
+    for i in range(n):
+        out.append({
+            "objective": "binary",
+            "num_leaves": 15,
+            "verbosity": -1,
+            # in BOTH paths (a params difference would show up in the
+            # model text's parameters dump and break the byte diff);
+            # engine.train simply ignores it
+            "multiboost": "on",
+            "learning_rate": 0.05 + 0.01 * i,
+            "bagging_fraction": 0.8,
+            "bagging_freq": 1,
+            "bagging_seed": 100 + i,
+        })
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--models", type=int, default=16)
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--features", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--max-dispatch-ratio", type=float,
+                    default=1.0 / 8.0)
+    ap.add_argument("--json", default="",
+                    help="also write the result object to this path")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.basic import Dataset
+    from lightgbm_tpu.observability.telemetry import get_telemetry
+
+    rng = np.random.RandomState(7)
+    X = rng.rand(args.rows, args.features)
+    logit = X[:, 0] + 0.5 * X[:, 1] - 0.8 * X[:, 2] \
+        + 0.3 * rng.randn(args.rows)
+    y = (logit > np.median(logit)).astype(np.float64)
+
+    tel = get_telemetry()
+    tel.ensure_ring()
+    # ring gives us counters unconditionally; ensure_started layers the
+    # LGBM_TPU_TELEMETRY JSONL sink on top when CI asks for the trace
+    tel.ensure_started()
+    params_list = sweep_params(args.models)
+
+    def dispatches() -> float:
+        return float(tel.counters.get("host.dispatches", 0.0))
+
+    d0 = dispatches()
+    t0 = time.perf_counter()
+    batched, report = engine.train_many(
+        [dict(p) for p in params_list],
+        Dataset(X, label=y), num_boost_round=args.iters,
+        return_report=True)
+    batched_s = time.perf_counter() - t0
+    batched_disp = dispatches() - d0
+
+    d1 = dispatches()
+    t1 = time.perf_counter()
+    loop = [engine.train(dict(p), Dataset(X, label=y),
+                         num_boost_round=args.iters)
+            for p in params_list]
+    loop_s = time.perf_counter() - t1
+    loop_disp = dispatches() - d1
+
+    mismatched = [i for i, (b, f) in enumerate(zip(batched, loop))
+                  if b.model_to_string() != f.model_to_string()]
+    ratio = batched_disp / max(loop_disp, 1.0)
+    all_batched = report["batched_models"] == args.models
+    ok = (not mismatched) and all_batched \
+        and ratio <= args.max_dispatch_ratio
+
+    result = {
+        "metric": "multiboost_speedup",
+        "value": round(loop_s / max(batched_s, 1e-9), 4),
+        "unit": "x-vs-loop",
+        "models": args.models,
+        "rows": args.rows,
+        "iters": args.iters,
+        "batched_s": round(batched_s, 4),
+        "loop_s": round(loop_s, 4),
+        "batched_dispatches": batched_disp,
+        "loop_dispatches": loop_disp,
+        "dispatch_ratio": round(ratio, 5),
+        "max_dispatch_ratio": args.max_dispatch_ratio,
+        "byte_identical": not mismatched,
+        "mismatched_models": mismatched,
+        "batched_models": report["batched_models"],
+        "buckets": len(report["buckets"]),
+        "loop_fallback": report["loop_fallback"],
+        "ok": ok,
+    }
+    print(json.dumps(result), flush=True)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(result, fh, indent=1, sort_keys=True)
+    if not ok:
+        if mismatched:
+            sys.stderr.write(
+                f"multiboost dryrun: models {mismatched} are NOT "
+                "byte-identical to their loop twins\n")
+        if not all_batched:
+            sys.stderr.write(
+                "multiboost dryrun: silent loop fallback — "
+                f"{report['loop_fallback']}\n")
+        if ratio > args.max_dispatch_ratio:
+            sys.stderr.write(
+                f"multiboost dryrun: dispatch ratio {ratio:.4f} over "
+                f"the {args.max_dispatch_ratio:g} budget "
+                f"({batched_disp:.0f} vs {loop_disp:.0f})\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,6 +185,26 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         e["max_burn"] = r.get("max_burn")
         e["windows"] = r.get("windows")
 
+    # multiboost bucketing report (engine.train_many / batched lgb.cv):
+    # how many models rode batched grow programs vs the loop fallback
+    mb = _last(records, "multiboost_report")
+    multiboost = None if mb is None else {
+        k: v for k, v in mb.items() if k not in ("kind", "t")}
+
+    # per-tenant pipeline cycles (pipeline/driver.py tenant mode): one
+    # row per (cycle, tenant) — the refit-and-promote timeline of the
+    # whole tenant fleet
+    tenant_cycles = []
+    for r in records:
+        if r.get("kind") != "pipeline_tenant_cycle":
+            continue
+        tenant_cycles.append({
+            "cycle": r.get("cycle"), "tenant": r.get("tenant"),
+            "candidate": r.get("candidate"),
+            "status": r.get("status"),
+            "promoted": r.get("promoted"),
+            "rows": r.get("rows")})
+
     counters_all = end.get("counters") or {}
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
@@ -205,6 +225,8 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 
     return {
         "robustness": robustness,
+        "multiboost": multiboost,
+        "tenant_cycles": tenant_cycles,
         "comms": comms,
         "ingest": ingest,
         "replica_timeline": replica_timeline,
@@ -467,6 +489,44 @@ def render(records: List[Dict[str, Any]]) -> str:
             L.append("death modes: " + " ".join(
                 f"{k}={v}" for k, v in sorted(codes.items(),
                                               key=lambda kv: -kv[1])))
+
+    if d.get("multiboost"):
+        mb = d["multiboost"]
+        L.append("")
+        L.append("== multiboost (many-model batched training) ==")
+        L.append(f"models={mb.get('models', 0)} "
+                 f"batched={mb.get('batched_models', 0)} "
+                 f"buckets={mb.get('buckets', 0)}"
+                 + (f" sizes=[{mb['bucket_sizes']}]"
+                    if mb.get("bucket_sizes") else ""))
+        bs = float(mb.get("batched_seconds") or 0.0)
+        ls = float(mb.get("loop_seconds") or 0.0)
+        L.append(f"batched_s={bs:.3f} loop_fallback_s={ls:.3f} "
+                 f"loop_fallback_models={mb.get('loop_fallback', 0)}")
+        if mb.get("fallback_reasons"):
+            L.append(f"fallback reasons: {mb['fallback_reasons']}")
+
+    tc = d.get("tenant_cycles") or []
+    if tc:
+        L.append("")
+        L.append("== tenant pipeline cycles (pipeline/driver.py) ==")
+        L.append(f"{'cycle':>6} {'tenant':<16}{'cand':>6} "
+                 f"{'status':<14}{'promoted':<9}{'rows':>8}")
+        for e in tc:
+            L.append(f"{str(e.get('cycle')):>6} "
+                     f"{str(e.get('tenant')):<16}"
+                     f"{str(e.get('candidate')):>6} "
+                     f"{str(e.get('status')):<14}"
+                     f"{str(bool(e.get('promoted'))):<9}"
+                     f"{str(e.get('rows')):>8}")
+        by_tenant: Dict[str, List[int]] = {}
+        for e in tc:
+            row = by_tenant.setdefault(str(e.get("tenant")), [0, 0])
+            row[0] += 1
+            row[1] += 1 if e.get("promoted") else 0
+        L.append("per tenant: " + " ".join(
+            f"{t}={p}/{n} promoted"
+            for t, (n, p) in sorted(by_tenant.items())))
 
     if d.get("slo"):
         L.append("")
