@@ -168,16 +168,25 @@ def run_train(params: Dict[str, str]) -> None:
         valid_names=valid_names or None,
         init_model=cfg.input_model or None,
         callbacks=callbacks or None)
+    # release the jax.distributed coordinator/client sockets on every
+    # clean exit shape (idempotent — engine.train already shut down the
+    # plain path; the preempt-ESCALATION path is covered separately via
+    # preempt.register_escalation_cleanup in init_distributed)
+    from .parallel.distributed import shutdown_distributed
     if getattr(booster, "preempted", False):
         # preemption-safe shutdown: the final checkpoint is already on
         # disk (engine.train wrote it before returning); do NOT publish
         # a partial output model
+        if bool(cfg.elastic_shutdown):
+            shutdown_distributed()
         get_telemetry().flush()
         log_info(
             f"Training preempted at iteration {booster._gbdt.iter}; "
             f"checkpoint saved under {cfg.checkpoint_dir} — rerun the "
             "same command (resume=auto) to continue")
         return
+    if bool(cfg.elastic_shutdown):
+        shutdown_distributed()
     from .robustness.checkpoint import atomic_write_text
     atomic_write_text(output_model, booster.model_to_string())
     get_telemetry().flush()

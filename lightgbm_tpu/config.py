@@ -174,6 +174,11 @@ _PARAM_ALIASES: Dict[str, str] = {
     "max_models_per_batch": "multiboost_max_batch",
     "tenants": "pipeline_tenants",
     "pipeline_tenant_models": "pipeline_tenants",
+    "elastic_hb_ms": "elastic_heartbeat_ms",
+    "elastic_hb_timeout_ms": "elastic_heartbeat_timeout_ms",
+    "stall_timeout_ms": "elastic_stall_timeout_ms",
+    "elastic_ckpt_barrier_s": "elastic_barrier_s",
+    "reshard_resume": "elastic_resume",
 }
 
 _OBJECTIVE_ALIASES: Dict[str, str] = {
@@ -378,6 +383,27 @@ class Config:
     guard_loss_spike: float = 0.0      # >1 = eval-loss spike factor
     guard_max_rollbacks: int = 3       # bound on guard-driven restores
     faults: str = ""                   # fault spec (LGBM_TPU_FAULTS analog)
+    # ---- elastic distributed training (robustness/elastic.py,
+    # docs/Robustness.md "Elastic distributed training"): collective
+    # watchdog over a rank heartbeat side-channel, coordinated
+    # (two-phase) multi-rank checkpoints, and resume across mesh sizes
+    elastic_watchdog: bool = True      # watchdog on for multi-process runs
+    elastic_heartbeat_ms: float = 500.0   # rank heartbeat send period
+    # rank declared peer_lost / coordinator_lost after this silence
+    elastic_heartbeat_timeout_ms: float = 10000.0
+    # no local iteration boundary for this long => collective_stall
+    elastic_stall_timeout_ms: float = 120000.0
+    # grace between classified abort and forced exit of a wedged rank
+    elastic_abort_grace_ms: float = 5000.0
+    # side-channel TCP port; 0 = coordinator port + 521
+    elastic_port: int = 0
+    # allow resume=auto onto a machine list that mismatches the
+    # checkpoint manifest (elastic N->M reshard); off = structured error
+    elastic_resume: bool = False
+    # call jax.distributed.shutdown() on clean exit / preempt escalation
+    elastic_shutdown: bool = True
+    # bound on the two-phase checkpoint commit barrier (all-ranks fsync)
+    elastic_barrier_s: float = 120.0
 
     # ---- predict task (config.h:675-741)
     num_iteration_predict: int = -1
@@ -713,6 +739,24 @@ class Config:
                 "off|raise|skip_iter|rollback")
         if self.resume not in ("auto", "off"):
             raise ValueError(f"resume={self.resume!r} is not auto|off")
+        if self.elastic_heartbeat_ms <= 0 \
+                or self.elastic_heartbeat_timeout_ms <= 0 \
+                or self.elastic_stall_timeout_ms <= 0 \
+                or self.elastic_abort_grace_ms <= 0 \
+                or self.elastic_barrier_s <= 0:
+            raise ValueError("elastic_heartbeat_ms, "
+                             "elastic_heartbeat_timeout_ms, "
+                             "elastic_stall_timeout_ms, "
+                             "elastic_abort_grace_ms and "
+                             "elastic_barrier_s must be > 0")
+        if not (0 <= self.elastic_port <= 65535):
+            raise ValueError(
+                f"elastic_port={self.elastic_port} is not a port")
+        if self.elastic_heartbeat_timeout_ms \
+                <= self.elastic_heartbeat_ms:
+            raise ValueError(
+                "elastic_heartbeat_timeout_ms must exceed "
+                "elastic_heartbeat_ms")
         if not (0 <= self.metrics_port <= 65535):
             raise ValueError(
                 f"metrics_port={self.metrics_port} is not a port")

@@ -163,9 +163,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if float(getattr(cfg_obj, "guard_loss_spike", 0.0)) > 1.0:
         from .robustness.guards import LossSpikeDetector
         guard_spike = LossSpikeDetector(cfg_obj.guard_loss_spike)
+    # elastic watchdog (robustness/elastic.py): in a multi-process run,
+    # convert a rank death / collective hang into a bounded classified
+    # abort instead of a wedged pod. Host-side sockets/threads only —
+    # no collectives enter the training programs.
+    from .parallel.distributed import (current_world, parse_machines,
+                                       shutdown_distributed)
+    elastic = None
+    world = current_world()
+    if world is not None and bool(getattr(cfg_obj, "elastic_watchdog",
+                                          True)):
+        from .robustness.elastic import ElasticWatchdog
+        with get_telemetry().span("elastic.watchdog_start"):
+            elastic = ElasticWatchdog.from_config(
+                cfg_obj, world.rank, world.size,
+                parse_machines(cfg_obj)).start()
     robust_active = ckpt is not None or guard_spike is not None \
         or getattr(cfg_obj, "guard_policy", "off") != "off" \
-        or fault_plan_active()
+        or fault_plan_active() or elastic is not None
 
     # crash flight recorder (observability/flightrec.py): armed when a
     # dump path resolves (crash_dump param / LGBM_TPU_CRASH_DUMP /
@@ -221,6 +236,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         finally:
             disarm_recorder(flightrec)
         booster.best_iteration = -1
+        if world is not None and bool(getattr(cfg_obj,
+                                              "elastic_shutdown", True)):
+            shutdown_distributed()
         return booster
 
     # per-iteration loop (engine.py:221-276); iteration numbers are
@@ -281,8 +299,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                         NonFiniteGradientError)
         i = booster._gbdt.iter
         while not stopped_early and i < end_iter:
+            if elastic is not None:
+                # surface a watchdog verdict at the iteration boundary
+                # (the clean half of the bounded abort)
+                elastic.check()
             if fault_plan_active():
                 maybe_sigterm(i)
+                if world is not None:
+                    from .robustness.faults import maybe_rank_fault
+                    maybe_rank_fault(i, world.rank)
             for cb in callbacks_before:
                 cb(CallbackEnv(model=booster, params=params,
                                iteration=i, begin_iteration=base_iter,
@@ -397,6 +422,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                             checkpoint_dir=ckpt.directory,
                             signum=preempt.signum)
                     break
+            if elastic is not None:
+                elastic.progress(i)  # resets the stall clock
             i += 1
     except BaseException as e:
         if flightrec is not None:
@@ -404,6 +431,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         raise
     finally:
         disarm_recorder(flightrec)
+        if elastic is not None:
+            # idempotent: a watchdog-raised abort already stopped it
+            # unclean; this is the clean goodbye/bye on normal exits
+            elastic.stop()
         if preempt is not None:
             preempt.uninstall()
         # close a profiler capture still in flight and persist the
@@ -420,6 +451,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_score[name][metric] = score
     if booster.best_iteration <= 0:
         booster.best_iteration = -1
+    if world is not None and bool(getattr(cfg_obj, "elastic_shutdown",
+                                          True)):
+        # clean exit releases the coordinator port (NetworkFree
+        # analog) — a finished rank holding it is exactly the
+        # TIME_WAIT flake the init retry exists to paper over
+        shutdown_distributed()
     return booster
 
 

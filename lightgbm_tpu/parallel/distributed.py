@@ -28,23 +28,34 @@ from __future__ import annotations
 
 import os
 import socket
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from ..config import Config
-from ..utils.log import log_fatal, log_info, log_warning
+from ..utils.log import LightGBMError, log_info, log_warning
 
 
 def distributed_initialized() -> bool:
-    """``jax.distributed.is_initialized()`` with version drift handled
-    (absent before jax 0.5; fall back to the client attribute)."""
+    """Is the jax.distributed runtime up? The live client on
+    ``global_state`` is the authoritative signal — some jax versions
+    ship an ``is_initialized()`` that stays False after a successful
+    ``initialize()`` — with the API call as a fallback for versions
+    that hide the state object."""
     import jax
     dist = jax.distributed
+    state = getattr(dist, "global_state", None)
+    if state is None:
+        try:  # jax 0.4.x keeps the state off the public module
+            from jax._src import distributed as _impl
+            state = getattr(_impl, "global_state", None)
+        except Exception:  # pragma: no cover - jax API drift
+            state = None
+    if state is not None:
+        return getattr(state, "client", None) is not None
     if hasattr(dist, "is_initialized"):
         return bool(dist.is_initialized())
-    state = getattr(dist, "global_state", None)
-    return state is not None and getattr(state, "client", None) is not None
+    return False
 
 
 def parse_machines(config: Config) -> List[Tuple[str, int]]:
@@ -111,8 +122,17 @@ def find_local_rank(machines: List[Tuple[str, int]],
             if machines[i][1] == port:
                 return i
         return candidates[0]
-    log_fatal("Could not locate this host in the machine list; set "
-              "LIGHTGBM_TPU_RANK explicitly")
+    # structured, debuggable failure: name BOTH sides of the match that
+    # did not happen, so a mis-rendered machine list or a NATed
+    # interface is obvious from the message alone
+    mlist = ", ".join(f"[{i}] {h}:{p}"
+                      for i, (h, p) in enumerate(machines))
+    raise LightGBMError(
+        "Could not locate this host in the machine list. "
+        f"machines=({mlist}); local addresses="
+        f"({', '.join(sorted(local))}); local_listen_port={port}. "
+        "Set LIGHTGBM_TPU_RANK (or JAX_PROCESS_ID) explicitly, or fix "
+        "the machine list to name one of the local addresses.")
 
 
 def init_distributed(config: Config,
@@ -162,8 +182,45 @@ def init_distributed(config: Config,
         max_delay_s=30.0,
         retry_on=(RuntimeError, OSError),
         desc="jax.distributed.initialize")
+    # a preempt-escalation (second SIGTERM) must release the
+    # coordinator port too, or the restarted job eats the TIME_WAIT
+    # flake the init retry above papers over (NetworkFree analog)
+    from ..robustness.preempt import register_escalation_cleanup
+    register_escalation_cleanup(shutdown_distributed)
     sync_bin_find_seed(config)
     return True
+
+
+class WorldInfo(NamedTuple):
+    """This process's place in the multi-process runtime."""
+    rank: int
+    size: int
+
+
+def current_world() -> Optional[WorldInfo]:
+    """``WorldInfo(rank, size)`` when a multi-process runtime is up,
+    else None (single-process runs, or before init_distributed)."""
+    import jax
+    if not distributed_initialized():
+        return None
+    n = jax.process_count()
+    if n <= 1:
+        return None
+    return WorldInfo(rank=jax.process_index(), size=n)
+
+
+def shutdown_distributed() -> None:
+    """``Network::Dispose`` analog: release the jax.distributed
+    coordinator/client sockets. Idempotent and exception-proof — safe
+    from clean exits, preempt escalation, and atexit-ish paths alike.
+    """
+    try:
+        import jax
+        if distributed_initialized():
+            jax.distributed.shutdown()
+            log_info("Distributed runtime shut down")
+    except Exception as e:  # pragma: no cover - teardown best-effort
+        log_warning(f"jax.distributed.shutdown failed: {e}")
 
 
 def sync_bin_find_seed(config: Config) -> int:

@@ -9,8 +9,12 @@
   for distributed init, checkpoint/model reads, serving loads.
 * :mod:`.faults`     — the deterministic fault-injection harness every
   robustness test drives (``LGBM_TPU_FAULTS`` / ``faults`` param).
+* :mod:`.elastic`    — elastic distributed training: the collective
+  watchdog (rank heartbeat side-channel, classified bounded aborts)
+  behind the coordinated-checkpoint + N->M resume story.
 """
 
+from .elastic import ELASTIC_EXIT_CODE, ElasticError, ElasticWatchdog
 from .faults import (FaultPlan, fault_plan_active, get_fault_plan,
                      set_fault_plan)
 from .guards import (GUARD_POLICIES, LossSpikeDetector, LossSpikeError,
@@ -19,6 +23,7 @@ from .preempt import PreemptionGuard
 from .retry import backoff_delays, retry_call
 
 __all__ = [
+    "ELASTIC_EXIT_CODE", "ElasticError", "ElasticWatchdog",
     "FaultPlan", "fault_plan_active", "get_fault_plan",
     "set_fault_plan", "GUARD_POLICIES", "LossSpikeDetector",
     "LossSpikeError", "NonFiniteGradientError", "finite_ok",

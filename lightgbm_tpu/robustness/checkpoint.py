@@ -39,6 +39,36 @@ Config: ``checkpoint_dir`` (enables the subsystem), ``checkpoint_freq``
 (iterations between periodic checkpoints; preemption always writes a
 final one), ``checkpoint_keep``, ``checkpoint_score_cache``,
 ``resume=auto|off``.
+
+**Coordinated (multi-rank) checkpoints.** In a multi-process run the
+score cache is a mesh-row-sharded *global* jax.Array — no single rank
+can serialize it — and per-rank independent writes give no agreement
+on the last complete version. The coordinated layout commits in two
+phases over the shared checkpoint directory::
+
+    <checkpoint_dir>/
+      ckpt_00000020/
+        model.txt          # rank 0 (model state is replicated)
+        shard_00000.npz    # rank r's addressable score rows + ranges
+        shard_00001.npz    #   ... + RNG states, one per rank
+        done_00000.json    # rank r's fsync receipt (size + sha256)
+        done_00001.json
+        manifest.json      # rank 0, after ALL done markers: + world
+        COMMIT.json        # rank 0, AFTER the dir rename + fsync
+
+Phase 1: every rank fsyncs its shard then its ``done`` marker (the
+markers double as the commit barrier — no sockets in the checkpoint
+path). Phase 2: rank 0 collects all markers (bounded by
+``elastic_barrier_s``), writes the manifest with a ``world`` section
+(size, machine list, per-rank bin-layout fingerprints), renames the
+temp dir into place, and only then drops the ``COMMIT.json`` marker. A
+coordinated checkpoint without its marker is torn by definition —
+validation skips it and rank 0 prunes it — so resume always picks the
+newest version with a **full quorum**. Shards store raw f32 score rows
+with their global row ranges, so resume on ANY world size (elastic
+``N -> M`` reshard, gated by ``elastic_resume``) reassembles the exact
+bytes and stays bit-identical to an uninterrupted run — sharding moves
+data, never values.
 """
 
 from __future__ import annotations
@@ -48,6 +78,7 @@ import io as _io
 import json
 import os
 import shutil
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -59,6 +90,10 @@ from .retry import read_bytes, read_text, retry_call
 CKPT_FORMAT = "lightgbm_tpu.checkpoint.v1"
 CKPT_PREFIX = "ckpt_"
 _TMP_PREFIX = ".tmp_ckpt_"
+# phase-2 marker of a coordinated checkpoint: its presence IS the
+# full-quorum commit (rank 0 writes it only after every rank's shard
+# fsync'd and the dir rename + fsync landed)
+COMMIT_MARKER = "COMMIT.json"
 
 # host RNG streams that advance per iteration on some paths; every one
 # present on the booster is captured so resume continues the stream
@@ -93,6 +128,17 @@ _FINGERPRINT_EXCLUDE = frozenset({
     "pipeline_continue_iters", "pipeline_replay_seed",
     "pipeline_replay_noise", "pipeline_serve_http",
     "num_threads",
+    # the machine list names WHERE the job runs, not WHAT it computes:
+    # elastic resume onto a different host set must reach the explicit
+    # world-size check below, not die on a silent fingerprint mismatch
+    # (num_machines stays IN the fingerprint — it selects the learner
+    # mesh and therefore the training programs)
+    "machines", "machine_list_filename", "local_listen_port",
+    "time_out",
+    "elastic_watchdog", "elastic_heartbeat_ms",
+    "elastic_heartbeat_timeout_ms", "elastic_stall_timeout_ms",
+    "elastic_abort_grace_ms", "elastic_port", "elastic_resume",
+    "elastic_shutdown", "elastic_barrier_s",
 })
 
 
@@ -149,6 +195,72 @@ def config_fingerprint(config) -> str:
               if k not in _FINGERPRINT_EXCLUDE}
     payload = json.dumps(params, sort_keys=True, default=str)
     return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _local_score_blocks(arr) -> List[Tuple[int, int, np.ndarray]]:
+    """``[(row_start, row_stop, block)]`` for the rows of ``arr`` this
+    process can address. A fully-addressable array is one block
+    covering everything; a mesh-row-sharded global jax.Array yields its
+    unique local row ranges (replicas across local devices deduped)."""
+    try:
+        fully = bool(getattr(arr, "is_fully_addressable", True))
+    except Exception:
+        fully = True
+    if fully:
+        a = np.asarray(arr, np.float32)
+        return [(0, int(a.shape[0]), a)]
+    blocks: Dict[Tuple[int, int], np.ndarray] = {}
+    for sh in arr.addressable_shards:
+        idx = sh.index[0] if sh.index else slice(None)
+        start = int(idx.start or 0)
+        data = np.asarray(sh.data, np.float32)
+        blocks[(start, start + int(data.shape[0]))] = data
+    return [(s, e, d) for (s, e), d in sorted(blocks.items())]
+
+
+def _pack_blocked(arrays: Dict[str, np.ndarray], key: str,
+                  arr) -> None:
+    """Store ``arr`` into the npz dict as global shape + this rank's
+    row-range blocks (the shard half of the reassembly protocol)."""
+    blocks = _local_score_blocks(arr)
+    arrays[f"{key}_shape"] = np.asarray(arr.shape, np.int64)
+    arrays[f"{key}_ranges"] = np.asarray(
+        [[s, e] for s, e, _ in blocks], np.int64).reshape(-1, 2)
+    for j, (_s, _e, d) in enumerate(blocks):
+        arrays[f"{key}_block_{j}"] = d
+
+
+def _reassemble_blocked(shards: List[Any], key: str,
+                        what: str) -> Optional[np.ndarray]:
+    """Rebuild the FULL host array named ``key`` from every rank's
+    recorded row ranges — raw f32 values, no arithmetic, so the result
+    is byte-identical regardless of the world size that wrote it or
+    the one reading it. None when no shard carries the key; raises on
+    incomplete row coverage (a shard from a third world size slipped
+    in)."""
+    shape = None
+    for z in shards:
+        if f"{key}_shape" in z.files:
+            shape = tuple(int(v) for v in z[f"{key}_shape"])
+            break
+    if shape is None:
+        return None
+    full = np.zeros(shape, np.float32)
+    filled = np.zeros(shape[0] if shape else 0, bool)
+    for z in shards:
+        if f"{key}_ranges" not in z.files:
+            continue
+        for j, (s, e) in enumerate(np.asarray(z[f"{key}_ranges"],
+                                              np.int64)):
+            full[int(s):int(e)] = z[f"{key}_block_{j}"]
+            filled[int(s):int(e)] = True
+    if not filled.all():
+        missing = int((~filled).sum())
+        raise LightGBMError(
+            f"coordinated checkpoint: {what} row coverage incomplete "
+            f"({missing} of {shape[0]} rows missing across "
+            f"{len(shards)} shards)")
+    return full
 
 
 class ResumeInfo(NamedTuple):
@@ -223,11 +335,27 @@ class CheckpointManager:
             path = self._write(booster, it, eval_history,
                                begin_iteration)
         self._last_saved = it
-        self._retain()
+        world = self._world()
+        if world is None or world.rank == 0:
+            self._retain()  # retention races are rank 0's job alone
         return path
+
+    @staticmethod
+    def _world():
+        """This process's WorldInfo when a multi-process runtime is up
+        (routes the write/restore paths to the coordinated protocol)."""
+        try:
+            from ..parallel.distributed import current_world
+            return current_world()
+        except Exception:
+            return None
 
     def _write(self, booster, it: int, eval_history: List,
                begin_iteration: int) -> str:
+        world = self._world()
+        if world is not None:
+            return self._write_coordinated(booster, it, eval_history,
+                                           begin_iteration, world)
         gbdt = booster._gbdt
         os.makedirs(self.directory, exist_ok=True)
         self._cleanup_tmp()
@@ -301,6 +429,176 @@ class CheckpointManager:
         log_info(f"checkpoint: wrote iteration {it} -> {final}")
         return final
 
+    # -- coordinated (multi-rank) writing ------------------------------
+    def _write_coordinated(self, booster, it: int, eval_history: List,
+                           begin_iteration: int,
+                           world) -> Optional[str]:
+        """Two-phase commit over the shared checkpoint directory (see
+        module docstring): write-all-fsync (per-rank shards + done
+        markers), then rank 0 publishes manifest + rename + COMMIT."""
+        gbdt = booster._gbdt
+        os.makedirs(self.directory, exist_ok=True)
+        name = f"{CKPT_PREFIX}{it:08d}"
+        final = os.path.join(self.directory, name)
+        # deterministic temp name: every rank of this iteration must
+        # land in the SAME directory (contrast the pid-suffixed serial
+        # temp, which exists to isolate concurrent writers)
+        tmp = os.path.join(self.directory, f"{_TMP_PREFIX}{it:08d}")
+        os.makedirs(tmp, exist_ok=True)
+        barrier_s = float(getattr(gbdt.config, "elastic_barrier_s",
+                                  120.0))
+        from ..observability.telemetry import get_telemetry
+        tel = get_telemetry()
+
+        def put(fname: str, data: bytes) -> Dict[str, Any]:
+            with open(os.path.join(tmp, fname), "wb") as fh:
+                fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
+            return {"bytes": len(data), "sha256": _digest(data)}
+
+        # phase 1 (every rank): shard, then the fsync receipt. A stale
+        # marker from a crashed attempt is harmless: rank 0 only
+        # accepts a marker whose digest matches the shard on disk, and
+        # this attempt overwrites both.
+        shard_name = f"shard_{world.rank:05d}.npz"
+        shard_bytes = self._shard_npz_bytes(gbdt, world)
+        info = put(shard_name, shard_bytes)
+        put(f"done_{world.rank:05d}.json", json.dumps({
+            "rank": world.rank, "file": shard_name, **info,
+            "data_fingerprint":
+                gbdt.train_data.bin_layout_fingerprint(),
+        }).encode("utf-8"))
+        _fsync_dir(tmp)
+
+        if world.rank != 0:
+            # wait for rank 0's phase 2; a timeout does NOT fail
+            # training — the torn attempt is simply never committed
+            # and the validator will skip it
+            deadline = time.monotonic() + barrier_s
+            commit = os.path.join(final, COMMIT_MARKER)
+            while time.monotonic() < deadline:
+                if os.path.exists(commit):
+                    return final
+                time.sleep(0.05)
+            tel.count("elastic.barrier_timeouts")
+            log_warning(
+                f"checkpoint: rank {world.rank} timed out after "
+                f"{barrier_s:.0f}s waiting for the iteration-{it} "
+                "commit marker; continuing without this checkpoint")
+            return None
+
+        # phase 2 (rank 0): model text, quorum, manifest, publish
+        files: Dict[str, Dict[str, Any]] = {shard_name: info}
+        from ..io.model_text import save_model_to_string
+        files["model.txt"] = put(
+            "model.txt", save_model_to_string(gbdt).encode("utf-8"))
+        fingerprints: Dict[str, str] = {}
+        got: Dict[int, Dict[str, Any]] = {}
+        deadline = time.monotonic() + barrier_s
+        while len(got) < world.size - 1:
+            for r in range(1, world.size):
+                if r in got:
+                    continue
+                mpath = os.path.join(tmp, f"done_{r:05d}.json")
+                if not os.path.exists(mpath):
+                    continue
+                try:
+                    marker = json.loads(read_text(mpath))
+                    data = read_bytes(os.path.join(
+                        tmp, marker["file"]))
+                except (OSError, ValueError, KeyError):
+                    continue  # mid-write; poll again
+                if _digest(data) != marker.get("sha256"):
+                    continue  # stale marker vs fresh shard: re-poll
+                got[r] = marker
+                files[marker["file"]] = {
+                    "bytes": marker["bytes"],
+                    "sha256": marker["sha256"]}
+                fingerprints[str(r)] = marker.get(
+                    "data_fingerprint", "")
+            if time.monotonic() > deadline:
+                tel.count("elastic.barrier_timeouts")
+                log_warning(
+                    f"checkpoint: quorum timeout at iteration {it}: "
+                    f"{len(got) + 1}/{world.size} ranks fsync'd "
+                    f"within {barrier_s:.0f}s; abandoning this "
+                    "checkpoint (not committed)")
+                return None
+            if len(got) < world.size - 1:
+                time.sleep(0.05)
+        fingerprints["0"] = gbdt.train_data.bin_layout_fingerprint()
+
+        manifest = {
+            "format": CKPT_FORMAT,
+            "iteration": it,
+            "begin_iteration": int(begin_iteration),
+            "num_models": len(gbdt.models),
+            "num_tree_per_iteration": gbdt.num_tree_per_iteration,
+            "num_valid_sets": len(gbdt.valid_scores),
+            "shrinkage_rate": float(gbdt.shrinkage_rate),
+            "score_cache": self.save_scores,
+            "config_fingerprint": config_fingerprint(gbdt.config),
+            "data_fingerprint": fingerprints["0"],
+            "eval_history": eval_history,
+            "files": files,
+            "world": {
+                "size": world.size,
+                "machines": self._machine_strings(gbdt.config),
+                "data_fingerprints": fingerprints,
+            },
+        }
+        put("manifest.json", json.dumps(manifest,
+                                        default=float).encode("utf-8"))
+        if os.path.isdir(final):  # pre-rollback / torn leftover
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        _fsync_dir(self.directory)
+        # the commit marker goes in LAST: rename without marker = torn
+        atomic_write_text(os.path.join(final, COMMIT_MARKER),
+                          json.dumps({"iteration": it,
+                                      "world_size": world.size}))
+        tel.count("checkpoint.writes")
+        tel.count("checkpoint.coordinated_writes")
+        tel.count("checkpoint.bytes",
+                  sum(f["bytes"] for f in files.values()))
+        log_info(f"checkpoint: committed coordinated iteration {it} "
+                 f"({world.size} ranks) -> {final}")
+        return final
+
+    @staticmethod
+    def _machine_strings(config) -> List[str]:
+        try:
+            from ..parallel.distributed import parse_machines
+            return [f"{h}:{p}" for h, p in parse_machines(config)]
+        except Exception:
+            return []
+
+    def _shard_npz_bytes(self, gbdt, world) -> bytes:
+        """This rank's half of phase 1: addressable score rows with
+        their global row ranges (raw f32 — reassembly does no
+        arithmetic), plus the host RNG states (identical streams on
+        every rank; restore reads rank 0's)."""
+        arrays: Dict[str, np.ndarray] = {}
+        if self.save_scores:
+            _pack_blocked(arrays, "train_score", gbdt.train_score)
+            for i, vs in enumerate(gbdt.valid_scores):
+                _pack_blocked(arrays, f"valid_score_{i}", vs)
+        if gbdt.bag_weight is not None and not gbdt._device_bagging():
+            _pack_blocked(arrays, "bag_weight", gbdt.bag_weight)
+        for attr in _RNG_ATTRS:
+            rng = getattr(gbdt, attr, None)
+            if isinstance(rng, np.random.RandomState):
+                name, keys, pos, has_gauss, cached = rng.get_state()
+                arrays[f"rng{attr}_keys"] = np.asarray(keys, np.uint32)
+                arrays[f"rng{attr}_meta"] = np.asarray(
+                    [pos, has_gauss], np.int64)
+                arrays[f"rng{attr}_cached"] = np.asarray(
+                    [cached], np.float64)
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
     def _state_npz_bytes(self, gbdt) -> bytes:
         arrays: Dict[str, np.ndarray] = {}
         if self.save_scores:
@@ -355,6 +653,13 @@ class CheckpointManager:
                 log_warning(f"checkpoint: {path} has unknown format "
                             f"{manifest.get('format')!r}")
                 return None
+            if manifest.get("world") and not os.path.exists(
+                    os.path.join(path, COMMIT_MARKER)):
+                # a coordinated checkpoint without its phase-2 marker
+                # never reached full quorum — torn by definition
+                log_warning(f"checkpoint: {path} lacks the commit "
+                            "marker (torn coordinated write)")
+                return None
             for fname, info in manifest.get("files", {}).items():
                 data = retry_call(read_bytes,
                                   os.path.join(path, fname),
@@ -384,7 +689,30 @@ class CheckpointManager:
             get_telemetry().count("checkpoint.fallbacks")
             log_warning(f"checkpoint: {path} failed validation; "
                         "falling back to the previous checkpoint")
+            self._maybe_prune_torn(path)
         return None
+
+    def _maybe_prune_torn(self, path: str) -> None:
+        """Remove a torn COORDINATED checkpoint (world manifest, no
+        commit marker) so it never shadows an older full-quorum
+        version again. Rank 0 / single-process only; serial torn
+        checkpoints are left for post-mortems (unchanged behavior)."""
+        world = self._world()
+        if world is not None and world.rank != 0:
+            return
+        try:
+            manifest = json.loads(read_text(
+                os.path.join(path, "manifest.json")))
+        except (OSError, ValueError):
+            return
+        if not manifest.get("world") or os.path.exists(
+                os.path.join(path, COMMIT_MARKER)):
+            return
+        shutil.rmtree(path, ignore_errors=True)
+        from ..observability.telemetry import get_telemetry
+        get_telemetry().count("checkpoint.pruned_torn")
+        log_warning(f"checkpoint: pruned torn coordinated checkpoint "
+                    f"{path}")
 
     def restore_latest(self, booster) -> Optional[ResumeInfo]:
         """Restore the newest valid, fingerprint-matching checkpoint
@@ -414,6 +742,7 @@ class CheckpointManager:
                 "checkpoint: validation-set count changed since the "
                 f"checkpoint was written; ignoring {path}")
             return None
+        self._check_world_compat(manifest, gbdt.config, path)
         self._apply(booster, path, manifest)
         from ..observability.telemetry import get_telemetry
         get_telemetry().count("checkpoint.restores")
@@ -423,8 +752,50 @@ class CheckpointManager:
                           int(manifest.get("begin_iteration", 0)),
                           manifest.get("eval_history") or [], path)
 
+    def _check_world_compat(self, manifest: Dict[str, Any], config,
+                            path: str) -> None:
+        """World-shape agreement between the checkpoint and this run:
+        a mismatch is a structured error naming BOTH sides — never a
+        silent wrong-mesh resume — unless ``elastic_resume=true``
+        explicitly opts into the N->M reshard."""
+        world_m = manifest.get("world") or {}
+        cur = self._world()
+        if not world_m and cur is None:
+            return  # serial checkpoint, serial run: nothing to agree on
+        ck_size = int(world_m.get("size", 1))
+        ck_machines = [str(m) for m in world_m.get("machines", [])]
+        cur_size = cur.size if cur is not None else 1
+        cur_machines = self._machine_strings(config) \
+            if cur is not None else []
+        if ck_size == cur_size and ck_machines == cur_machines:
+            return
+        if bool(getattr(config, "elastic_resume", False)):
+            log_info(
+                f"checkpoint: elastic resume {ck_size} -> {cur_size} "
+                f"ranks (checkpoint machines={ck_machines or ['-']}, "
+                f"current={cur_machines or ['-']}); re-sharding "
+                f"{path}")
+            return
+        raise LightGBMError(
+            "checkpoint: world mismatch — checkpoint was written by "
+            f"{ck_size} rank(s) on machines "
+            f"[{', '.join(ck_machines) or '-'}] but this run has "
+            f"{cur_size} rank(s) on machines "
+            f"[{', '.join(cur_machines) or '-'}]. Set "
+            "elastic_resume=true to re-shard onto the new world, or "
+            "restart on the original machine list. "
+            f"(checkpoint: {path})")
+
     def _apply(self, booster, path: str,
                manifest: Dict[str, Any]) -> None:
+        self._apply_model(booster, path, manifest)
+        if manifest.get("world"):
+            self._apply_world_state(booster, path, manifest)
+        else:
+            self._apply_serial_state(booster, path)
+
+    def _apply_model(self, booster, path: str,
+                     manifest: Dict[str, Any]) -> None:
         gbdt = booster._gbdt
         from ..io.model_text import load_model_from_string
         model_text = read_text(os.path.join(path, "model.txt"))
@@ -435,11 +806,30 @@ class CheckpointManager:
                 "checkpoint model has "
                 f"{loaded.num_tree_per_iteration} trees/iteration; "
                 f"booster expects {gbdt.num_tree_per_iteration}")
-        import jax.numpy as jnp
         gbdt.models = list(loaded.models)
         gbdt.iter = int(manifest["iteration"])
         gbdt.shrinkage_rate = float(
             manifest.get("shrinkage_rate", gbdt.shrinkage_rate))
+
+    @staticmethod
+    def _apply_rngs(gbdt, z) -> None:
+        names = set(z.files)
+        for attr in _RNG_ATTRS:
+            if f"rng{attr}_keys" not in names:
+                continue
+            rng = getattr(gbdt, attr, None)
+            if not isinstance(rng, np.random.RandomState):
+                continue
+            meta = z[f"rng{attr}_meta"]
+            rng.set_state((
+                "MT19937", np.asarray(z[f"rng{attr}_keys"],
+                                      np.uint32),
+                int(meta[0]), int(meta[1]),
+                float(z[f"rng{attr}_cached"][0])))
+
+    def _apply_serial_state(self, booster, path: str) -> None:
+        gbdt = booster._gbdt
+        import jax.numpy as jnp
         with np.load(_io.BytesIO(
                 read_bytes(os.path.join(path, "state.npz"))),
                 allow_pickle=False) as z:
@@ -457,18 +847,45 @@ class CheckpointManager:
                                               jnp.float32)
             else:
                 gbdt.bag_weight = None
-            for attr in _RNG_ATTRS:
-                if f"rng{attr}_keys" not in names:
-                    continue
-                rng = getattr(gbdt, attr, None)
-                if not isinstance(rng, np.random.RandomState):
-                    continue
-                meta = z[f"rng{attr}_meta"]
-                rng.set_state((
-                    "MT19937", np.asarray(z[f"rng{attr}_keys"],
-                                          np.uint32),
-                    int(meta[0]), int(meta[1]),
-                    float(z[f"rng{attr}_cached"][0])))
+            self._apply_rngs(gbdt, z)
+
+    def _apply_world_state(self, booster, path: str,
+                           manifest: Dict[str, Any]) -> None:
+        """Coordinated restore: reassemble the FULL score arrays from
+        every writer rank's recorded row ranges (raw values, no
+        arithmetic), then hand them to jax exactly like a fresh run's
+        initial scores — the current mesh re-shards them on first use,
+        so any reader world size M continues bit-identical to the
+        writer's N."""
+        gbdt = booster._gbdt
+        import jax.numpy as jnp
+        shard_names = sorted(
+            f for f in manifest.get("files", {})
+            if f.startswith("shard_") and f.endswith(".npz"))
+        shards = [np.load(_io.BytesIO(
+            read_bytes(os.path.join(path, f))), allow_pickle=False)
+            for f in shard_names]
+        try:
+            train = _reassemble_blocked(shards, "train_score",
+                                        "train_score")
+            if train is not None:
+                gbdt.train_score = jnp.asarray(train, jnp.float32)
+                for i in range(len(gbdt.valid_scores)):
+                    v = _reassemble_blocked(
+                        shards, f"valid_score_{i}", f"valid_score_{i}")
+                    gbdt.valid_scores[i] = jnp.asarray(v, jnp.float32)
+            else:
+                self._recompute_scores(booster)
+            bag = _reassemble_blocked(shards, "bag_weight",
+                                      "bag_weight")
+            gbdt.bag_weight = (jnp.asarray(bag, jnp.float32)
+                               if bag is not None else None)
+            # rank 0's RNG states: the host streams advance in lockstep
+            # on every rank, so one copy continues them all
+            self._apply_rngs(gbdt, shards[0])
+        finally:
+            for z in shards:
+                z.close()
 
     def _recompute_scores(self, booster) -> None:
         """Score-cache-less restore: rebuild the score buffers by

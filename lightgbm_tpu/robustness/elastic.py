@@ -1,0 +1,532 @@
+"""Elastic distributed training: the collective watchdog side-channel.
+
+A multi-host training job's data plane is XLA collectives — and a
+collective has no timeout. When a rank dies mid-iteration (preempted
+host, OOM kill, segfault), every surviving rank blocks inside gloo/ICI
+forever: the pod is wedged, burning reservation, with no evidence of
+what happened. This module converts that indefinite hang into a
+**bounded, classified failure**:
+
+* a lightweight **heartbeat side-channel** over stdlib TCP sockets —
+  rank 0 (the jax.distributed coordinator host) listens, every other
+  rank dials in with the bounded backoff from :mod:`.retry` and sends
+  a heartbeat frame every ``elastic_heartbeat_ms`` (4-byte big-endian
+  length + JSON, the same framing the process-fleet supervisor uses in
+  ``serving/procfleet.py``);
+* a **monitor thread per rank** classifying failures into the elastic
+  reason codes of ``tools/probe_taxonomy.py``:
+
+  - ``peer_lost``        — a rank's connection dropped or its
+                           heartbeats went stale past
+                           ``elastic_heartbeat_timeout_ms`` (rank 0's
+                           verdict, broadcast to every survivor);
+  - ``collective_stall`` — the channel is healthy but THIS rank saw no
+                           iteration boundary for
+                           ``elastic_stall_timeout_ms`` (a peer is
+                           wedged inside a dispatch, not dead);
+  - ``coordinator_lost`` — rank 0's socket closed or went quiet
+                           (non-zero ranks' verdict);
+
+* a **bounded abort**: the failure is flagged, counted
+  (``elastic.aborts`` / ``elastic.abort.<reason>``), recorded on the
+  telemetry timeline (``elastic`` records; rendered by
+  ``tools/run_report.py``), and the training loop raises a structured
+  :class:`ElasticError` at the next iteration boundary. A rank that
+  never reaches a boundary — it is wedged inside the very collective
+  that can no longer complete — is force-exited with
+  :data:`ELASTIC_EXIT_CODE` after ``elastic_abort_grace_ms``, printing
+  one ``ELASTIC_ABORT reason=<code> rank=<r>`` line that
+  ``classify_elastic_failure`` parses back.
+
+The watchdog adds **no collectives**: everything here is host-side
+threads + sockets, so the graftcheck GC401 collective multisets of the
+mesh grow programs are untouched.
+
+Fault grammar integration (:mod:`.faults`): ``drop_heartbeat@rank=R``
+silences rank R's sender (rank 0 must declare ``peer_lost`` while R
+still trains); ``kill_rank`` / ``stall_rank`` are honored at the
+engine's iteration boundary via :func:`~.faults.maybe_rank_fault`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.log import LightGBMError, log_info, log_warning
+
+# exit status of a force-aborted (wedged-in-a-collective) rank; chosen
+# outside the shell/signal ranges so drill harnesses can assert on it
+ELASTIC_EXIT_CODE = 43
+
+# elastic_port=0 resolves to coordinator port + this offset (keeps the
+# side-channel off the jax.distributed coordinator socket)
+ELASTIC_PORT_OFFSET = 521
+
+_FRAME_MAX = 1 << 20  # heartbeat frames are tiny; bound hostile input
+
+
+def send_frame(sock_, obj: Dict[str, Any],
+               lock: Optional[threading.Lock] = None) -> None:
+    """procfleet-style framing: 4-byte big-endian length + one JSON
+    object (re-implemented here so the training plane never imports
+    the serving package)."""
+    body = json.dumps(obj).encode()
+    payload = struct.pack(">I", len(body)) + body
+    if lock is not None:
+        with lock:
+            sock_.sendall(payload)
+    else:
+        sock_.sendall(payload)
+
+
+def _recv_exact(sock_, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock_.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock_) -> Optional[Dict[str, Any]]:
+    """One frame, or None on EOF/reset/oversize (all treated as a lost
+    peer by the callers)."""
+    head = _recv_exact(sock_, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > _FRAME_MAX:
+        return None
+    body = _recv_exact(sock_, n)
+    if body is None:
+        return None
+    try:
+        return json.loads(body)
+    except ValueError:
+        return None
+
+
+class ElasticError(LightGBMError):
+    """A watchdog-classified distributed failure (bounded, not hung)."""
+
+    def __init__(self, reason_code: str, rank: int, detail: str = ""):
+        self.reason_code = reason_code
+        self.rank = int(rank)
+        self.detail = detail
+        super().__init__(
+            f"elastic: distributed training aborted "
+            f"(reason={reason_code} rank={rank}): {detail}")
+
+
+def resolve_elastic_port(config, machines) -> int:
+    """The side-channel port: ``elastic_port`` when set, else the
+    coordinator port + :data:`ELASTIC_PORT_OFFSET`."""
+    p = int(getattr(config, "elastic_port", 0) or 0)
+    if p:
+        return p
+    base = machines[0][1] if machines else 12400
+    return int(base) + ELASTIC_PORT_OFFSET
+
+
+class ElasticWatchdog:
+    """Per-rank collective watchdog over the heartbeat side-channel.
+
+    Rank 0 hosts the listener and declares ``peer_lost``; other ranks
+    dial in and declare ``coordinator_lost``; every rank watches its
+    own iteration progress for ``collective_stall``. One instance per
+    training run; ``start()`` / ``progress(i)`` / ``check()`` /
+    ``stop()`` are the whole driver-facing API.
+    """
+
+    def __init__(self, rank: int, world_size: int, host: str,
+                 port: int, *, heartbeat_ms: float = 500.0,
+                 heartbeat_timeout_ms: float = 10000.0,
+                 stall_timeout_ms: float = 120000.0,
+                 abort_grace_ms: float = 5000.0):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.host = host
+        self.port = int(port)
+        self.heartbeat_s = max(float(heartbeat_ms), 10.0) / 1000.0
+        self.hb_timeout_s = max(float(heartbeat_timeout_ms),
+                                50.0) / 1000.0
+        self.stall_timeout_s = max(float(stall_timeout_ms),
+                                   100.0) / 1000.0
+        self.grace_s = max(float(abort_grace_ms), 100.0) / 1000.0
+        self.iteration = -1
+        self.timeline: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._failure: Optional[Tuple[str, int, str]] = None
+        self._stopped = False
+        self._started = False
+        self._grace_timer: Optional[threading.Timer] = None
+        self._threads: List[threading.Thread] = []
+        self._last_progress = time.monotonic()
+        # rank 0 state
+        self._listener: Optional[socket.socket] = None
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_locks: Dict[int, threading.Lock] = {}
+        self._last_seen: Dict[int, float] = {}
+        self._clean_bye: set = set()
+        # rank >0 state
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._drop_heartbeats = False
+        self._coord_bye = False
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_config(cls, config, rank: int, world_size: int,
+                    machines) -> "ElasticWatchdog":
+        host = machines[0][0] if machines else "127.0.0.1"
+        return cls(
+            rank, world_size, host,
+            resolve_elastic_port(config, machines),
+            heartbeat_ms=float(getattr(config, "elastic_heartbeat_ms",
+                                       500.0)),
+            heartbeat_timeout_ms=float(getattr(
+                config, "elastic_heartbeat_timeout_ms", 10000.0)),
+            stall_timeout_ms=float(getattr(
+                config, "elastic_stall_timeout_ms", 120000.0)),
+            abort_grace_ms=float(getattr(
+                config, "elastic_abort_grace_ms", 5000.0)))
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "ElasticWatchdog":
+        if self._started:
+            return self
+        self._started = True
+        self._last_progress = time.monotonic()
+        if self.rank == 0:
+            self._start_coordinator()
+        else:
+            self._start_client()
+        self._spawn(self._stall_monitor, "elastic-stall")
+        self._event("watchdog_start", rank=self.rank,
+                    world_size=self.world_size, port=self.port)
+        log_info(f"elastic: watchdog up (rank {self.rank}/"
+                 f"{self.world_size}, side-channel port {self.port})")
+        return self
+
+    def progress(self, iteration: int) -> None:
+        """Mark an iteration boundary (resets the stall clock)."""
+        self.iteration = int(iteration)
+        self._last_progress = time.monotonic()
+
+    def failure(self) -> Optional[Tuple[str, int, str]]:
+        with self._lock:
+            return self._failure
+
+    def check(self) -> None:
+        """Raise the pending :class:`ElasticError` (called at iteration
+        boundaries — the clean half of the bounded abort)."""
+        f = self.failure()
+        if f is None:
+            return
+        self.stop(clean=False)
+        raise ElasticError(*f)
+
+    def stop(self, clean: bool = True) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            timer, self._grace_timer = self._grace_timer, None
+        if timer is not None:
+            timer.cancel()
+        if clean and self.rank != 0 and self._sock is not None:
+            try:
+                send_frame(self._sock, {"type": "goodbye",
+                                        "rank": self.rank},
+                           self._send_lock)
+            except OSError:
+                pass
+        if clean and self.rank == 0:
+            self._broadcast({"type": "bye"})
+        self._event("watchdog_stop", rank=self.rank, clean=clean)
+        for s in list(self._conns.values()) + [self._sock,
+                                               self._listener]:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # -- internals -----------------------------------------------------
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _event(self, event: str, **fields) -> None:
+        rec = {"event": event, **fields}
+        self.timeline.append({"t": time.time(), **rec})
+        try:
+            from ..observability.telemetry import get_telemetry
+            get_telemetry().record("elastic", **rec)
+        except Exception:  # telemetry must never break the watchdog
+            pass
+
+    def _fail(self, reason: str, rank: int, detail: str) -> None:
+        with self._lock:
+            if self._failure is not None or self._stopped:
+                return
+            self._failure = (reason, int(rank), detail)
+        log_warning(f"elastic: {reason} (rank {rank}): {detail}")
+        self._event("abort", reason_code=reason, rank=int(rank),
+                    detail=detail[:200], iteration=self.iteration)
+        try:
+            from ..observability.telemetry import get_telemetry
+            tel = get_telemetry()
+            tel.count("elastic.aborts")
+            tel.count(f"elastic.abort.{reason}")
+            tel.flush()
+        except Exception:
+            pass
+        if self.rank == 0:
+            # every surviving rank must abort, not just the one that
+            # noticed: broadcast the verdict over the side-channel
+            self._broadcast({"type": "abort", "reason": reason,
+                             "rank": int(rank), "detail": detail})
+        # the unclean half of the bounded abort: a rank wedged inside
+        # a collective never reaches check() — give the loop one grace
+        # window, then force-exit with a classified, parseable line
+        timer = threading.Timer(self.grace_s, self._hard_abort)
+        timer.daemon = True
+        with self._lock:
+            if not self._stopped:
+                self._grace_timer = timer
+                timer.start()
+
+    def _hard_abort(self) -> None:
+        with self._lock:
+            if self._stopped or self._failure is None:
+                return
+            reason, rank, detail = self._failure
+        sys.stderr.write(
+            f"ELASTIC_ABORT reason={reason} rank={rank} "
+            f"iter={self.iteration} detail={detail[:200]}\n")
+        sys.stderr.flush()
+        try:
+            from ..observability.telemetry import get_telemetry
+            get_telemetry().flush()
+        except Exception:
+            pass
+        os._exit(ELASTIC_EXIT_CODE)
+
+    # -- stall monitor (every rank) ------------------------------------
+    def _stall_monitor(self) -> None:
+        while True:
+            time.sleep(min(self.heartbeat_s, 0.2))
+            with self._lock:
+                if self._stopped or self._failure is not None:
+                    return
+            idle = time.monotonic() - self._last_progress
+            if idle > self.stall_timeout_s:
+                self._fail(
+                    "collective_stall", self.rank,
+                    f"no iteration boundary for {idle:.1f}s "
+                    f"(stall timeout {self.stall_timeout_s:.1f}s) "
+                    f"at iteration {self.iteration}")
+                return
+
+    # -- coordinator (rank 0) ------------------------------------------
+    def _start_coordinator(self) -> None:
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(("", self.port))
+        ls.listen(max(self.world_size, 8))
+        self._listener = ls
+        self._spawn(self._accept_loop, "elastic-accept")
+        self._spawn(self._peer_monitor, "elastic-peers")
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._spawn(lambda c=conn: self._serve_conn(c),
+                        "elastic-conn")
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rank = None
+        while True:
+            msg = recv_frame(conn)
+            if msg is None:
+                break
+            kind = msg.get("type")
+            if kind == "hello":
+                rank = int(msg.get("rank", -1))
+                with self._lock:
+                    self._conns[rank] = conn
+                    self._conn_locks[rank] = threading.Lock()
+                    self._last_seen[rank] = time.monotonic()
+                self._event("peer_hello", rank=rank,
+                            pid=msg.get("pid"))
+            elif kind == "hb" and rank is not None:
+                with self._lock:
+                    self._last_seen[rank] = time.monotonic()
+                try:
+                    from ..observability.telemetry import get_telemetry
+                    get_telemetry().count("elastic.heartbeats")
+                except Exception:
+                    pass
+            elif kind == "goodbye" and rank is not None:
+                self._clean_bye.add(rank)
+                self._event("peer_goodbye", rank=rank)
+        # EOF: a clean goodbye is a finished rank; anything else is a
+        # dead one — declare it immediately, don't wait for staleness
+        if rank is not None and rank not in self._clean_bye:
+            with self._lock:
+                stopped = self._stopped
+            if not stopped:
+                self._fail("peer_lost", rank,
+                           f"rank {rank} heartbeat connection closed "
+                           "without goodbye")
+
+    def _peer_monitor(self) -> None:
+        # ranks get one full timeout window to dial in before absence
+        # itself is a failure
+        t0 = time.monotonic()
+        expected = set(range(1, self.world_size))
+        while True:
+            time.sleep(min(self.heartbeat_s, 0.2))
+            with self._lock:
+                if self._stopped or self._failure is not None:
+                    return
+                seen = dict(self._last_seen)
+            now = time.monotonic()
+            missing = expected - set(seen) - self._clean_bye
+            if missing and now - t0 > self.hb_timeout_s:
+                r = min(missing)
+                self._fail("peer_lost", r,
+                           f"rank {r} never joined the heartbeat "
+                           f"channel within {self.hb_timeout_s:.1f}s")
+                return
+            for r, last in seen.items():
+                if r in self._clean_bye:
+                    continue
+                if now - last > self.hb_timeout_s:
+                    self._fail(
+                        "peer_lost", r,
+                        f"rank {r} heartbeats stale for "
+                        f"{now - last:.1f}s (timeout "
+                        f"{self.hb_timeout_s:.1f}s)")
+                    return
+            # keepalive pings let clients distinguish a live-but-idle
+            # coordinator from a dead one
+            self._broadcast({"type": "hb", "rank": 0,
+                             "iter": self.iteration})
+
+    def _broadcast(self, obj: Dict[str, Any]) -> None:
+        with self._lock:
+            conns = dict(self._conns)
+        for r, c in conns.items():
+            try:
+                send_frame(c, obj, self._conn_locks.get(r))
+            except OSError:
+                pass
+
+    # -- client (rank > 0) ---------------------------------------------
+    def _start_client(self) -> None:
+        from .retry import retry_call
+        self._sock = retry_call(
+            socket.create_connection, (self.host, self.port),
+            timeout=self.hb_timeout_s,
+            attempts=int(os.environ.get("LGBM_TPU_ELASTIC_ATTEMPTS",
+                                        8)),
+            base_delay_s=float(os.environ.get(
+                "LGBM_TPU_ELASTIC_BACKOFF_S", 0.25)),
+            max_delay_s=5.0, retry_on=(OSError,),
+            desc=f"elastic side-channel {self.host}:{self.port}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_frame(self._sock, {"type": "hello", "rank": self.rank,
+                                "pid": os.getpid()}, self._send_lock)
+        self._spawn(self._sender_loop, "elastic-send")
+        self._spawn(self._client_recv_loop, "elastic-recv")
+
+    def _sender_loop(self) -> None:
+        from .faults import get_fault_plan
+        while True:
+            time.sleep(self.heartbeat_s)
+            with self._lock:
+                if self._stopped or self._failure is not None:
+                    return
+            if not self._drop_heartbeats:
+                plan = get_fault_plan()
+                if plan is not None and plan.take(
+                        "drop_heartbeat", rank=self.rank) is not None:
+                    # fault drill: the rank stays alive and training,
+                    # but goes silent — rank 0 must declare peer_lost
+                    self._drop_heartbeats = True
+                    self._event("heartbeats_dropped", rank=self.rank)
+            if self._drop_heartbeats:
+                continue
+            try:
+                send_frame(self._sock, {"type": "hb",
+                                        "rank": self.rank,
+                                        "iter": self.iteration},
+                           self._send_lock)
+                from ..observability.telemetry import get_telemetry
+                get_telemetry().count("elastic.heartbeats")
+            except Exception:
+                pass  # EOF surfaces in the recv loop with a verdict
+
+    def _client_recv_loop(self) -> None:
+        import select
+        # blocking socket + select for staleness: a socket-level read
+        # timeout is indistinguishable from EOF inside recv_frame
+        # (socket.timeout IS an OSError), so readiness is polled here
+        self._sock.settimeout(None)
+        last_from_coord = time.monotonic()
+        while True:
+            with self._lock:
+                if self._stopped or self._failure is not None:
+                    return
+            try:
+                readable, _w, _x = select.select(
+                    [self._sock], [], [], min(self.heartbeat_s, 0.5))
+            except (OSError, ValueError):
+                return  # socket closed under us by stop()
+            if not readable:
+                if time.monotonic() - last_from_coord \
+                        > self.hb_timeout_s:
+                    self._fail("coordinator_lost", 0,
+                               "coordinator went quiet past "
+                               f"{self.hb_timeout_s:.1f}s")
+                    return
+                continue
+            msg = recv_frame(self._sock)
+            if msg is None:
+                if self._coord_bye:
+                    return  # clean shutdown
+                with self._lock:
+                    stopped = self._stopped
+                if not stopped:
+                    self._fail("coordinator_lost", 0,
+                               "coordinator heartbeat connection "
+                               "closed")
+                return
+            last_from_coord = time.monotonic()
+            kind = msg.get("type")
+            if kind == "abort":
+                self._fail(str(msg.get("reason", "peer_lost")),
+                           int(msg.get("rank", -1)),
+                           f"coordinator broadcast: "
+                           f"{msg.get('detail', '')}")
+                return
+            if kind == "bye":
+                self._coord_bye = True

@@ -43,6 +43,18 @@ Supported kinds and their args:
 * ``oom_replica@rid=K`` — replica ``K``'s worker exits with the
   OOM-kill status (137), simulating the kernel/device OOM reaper;
   classified ``oom_killed`` by the supervisor.
+* ``kill_rank@rank=R,iter=N`` — in a multi-process training run, rank
+  ``R`` SIGKILLs itself at the start of boosting iteration ``N``: the
+  pod-preemption drill — the elastic watchdog
+  (``robustness/elastic.py``) must classify ``peer_lost`` on every
+  surviving rank and abort them within its timeout instead of leaving
+  the pod hung in a collective.
+* ``stall_rank@rank=R,iter=N,ms=V`` — rank ``R`` sleeps ``V`` ms at
+  iteration ``N`` while its heartbeats keep flowing: the survivors'
+  stall monitors must classify ``collective_stall``.
+* ``drop_heartbeat@rank=R`` — rank ``R`` keeps training but silences
+  its heartbeat sender: rank 0 must declare ``peer_lost`` on staleness
+  alone (the network-partition drill).
 
 Every event fires a bounded number of times (``times``, default 1 —
 ``nth``-style events always once) and is *consumed*: reruns inside the
@@ -62,7 +74,8 @@ from typing import Any, Dict, List, Optional
 from ..utils.log import log_warning
 
 _KNOWN_KINDS = ("nan_grad", "sigterm", "torn_checkpoint", "fail_read",
-                "drift", "crash_replica", "hang_replica", "oom_replica")
+                "drift", "crash_replica", "hang_replica", "oom_replica",
+                "kill_rank", "stall_rank", "drop_heartbeat")
 
 
 class Fault:
@@ -91,6 +104,9 @@ class Fault:
                 return False
         if "rid" in self.params:
             if int(ctx.get("rid", -1)) != int(self.params["rid"]):
+                return False
+        if "rank" in self.params:
+            if int(ctx.get("rank", -1)) != int(self.params["rank"]):
                 return False
         match = str(self.params.get("match", ""))
         if match and match not in str(ctx.get("path", "")):
@@ -218,3 +234,22 @@ def maybe_sigterm(iteration: int) -> None:
     if plan is not None and plan.take("sigterm",
                                       iteration=iteration) is not None:
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_rank_fault(iteration: int, rank: int) -> None:
+    """Call at a distributed iteration boundary; honors the armed
+    ``kill_rank`` / ``stall_rank`` drills for this (rank, iteration).
+    (``drop_heartbeat`` is consumed inside the elastic heartbeat
+    sender, not here — it must NOT perturb the training loop.)"""
+    plan = get_fault_plan()
+    if plan is None:
+        return
+    if plan.take("kill_rank", iteration=iteration,
+                 rank=rank) is not None:
+        # SIGKILL, not SIGTERM: the point is an *unannounced* death the
+        # watchdog must detect — no handlers, no cleanup, no goodbye
+        os.kill(os.getpid(), signal.SIGKILL)
+    ev = plan.take("stall_rank", iteration=iteration, rank=rank)
+    if ev is not None:
+        import time
+        time.sleep(float(ev.params.get("ms", 1000)) / 1000.0)

@@ -394,6 +394,118 @@ def test_two_process_parallel_training(tmp_path, mode):
         (line, digests)
 
 
+# ---------------------------------------------------------------------
+# Elastic drill legs (ISSUE 19): the REAL 2-process kill/resume story.
+# The full leg matrix (stall, drop_heartbeat, world-mismatch guard)
+# runs in tools/elastic_drill.py — the CI elastic-drill job; this test
+# keeps the four load-bearing legs in the tier-marked suite.
+
+def _run_elastic_leg(child, workdir, leg, ckpt_dir, ranks, extra,
+                     n_round, timeout=240):
+    """Spawn the drill child once per rank; returns
+    [(rank, rc, stdout, stderr)] or None on a sandbox hang."""
+    import json as _json
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "LGBM_TPU_TELEMETRY",
+              "LGBM_TPU_FAULTS"):
+        env.pop(k, None)
+    env["LGBM_TPU_DIST_INIT_ATTEMPTS"] = "4"
+    env["LGBM_TPU_DIST_INIT_BACKOFF_S"] = "0.5"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["XLA_FLAGS"] = "--xla_cpu_max_isa=AVX2"
+    for _attempt in range(2):  # one retry for a port race
+        port = _free_port_pair()
+        procs = [(r, subprocess.Popen(
+            [sys.executable, str(child), str(r), str(port),
+             str(ckpt_dir), str(n_round), _json.dumps(extra)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)) for r in ranks]
+        results = []
+        try:
+            for r, p in procs:
+                out, err = p.communicate(timeout=timeout)
+                results.append((r, p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for _r, p in procs:
+                p.kill()
+            return None
+        joined = "\n".join(e for _r, _rc, _o, e in results)
+        if "Failed to bind" in joined or "address already in use" \
+                in joined.lower():
+            continue
+        return results
+    return results
+
+
+def _elastic_digest(results, leg):
+    digests = {}
+    for r, rc, out, err in results:
+        assert rc == 0, (leg, r, rc, err[-2000:])
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("DIGEST")][-1]
+        _tag, _rank, digest, ntrees = line.split()
+        digests[r] = (digest, int(ntrees))
+    assert len(set(digests.values())) == 1, (leg, digests)
+    return next(iter(digests.values()))
+
+
+@pytest.mark.slow
+def test_two_process_elastic_kill_and_resume(tmp_path):
+    """The watchdog + coordinated-checkpoint story end to end: rank 1
+    SIGKILLed mid-train -> rank 0 exits bounded with a classified
+    ``peer_lost`` (no hung rank); ``resume=auto`` on the SAME machine
+    list and an ``elastic_resume`` reshard onto ONE process must both
+    train to a model byte-identical to the fault-free run."""
+    import shutil
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.elastic_drill import CHILD_SRC, KILL_ITER, N_ROUND
+    from tools.probe_taxonomy import classify_elastic_failure
+    child = tmp_path / "elastic_child.py"
+    child.write_text(CHILD_SRC)
+
+    def leg(name, ckdir, ranks, extra, n_round=N_ROUND):
+        res = _run_elastic_leg(child, tmp_path, name, ckdir, ranks,
+                               extra, n_round)
+        if res is None:
+            pytest.skip("distributed children hung (sandbox "
+                        "networking); covered by tools/elastic_drill.py"
+                        " in CI")
+        return res
+
+    # 1. fault-free reference digest
+    ref = _elastic_digest(
+        leg("ref", tmp_path / "ck_ref", (0, 1), {}), "ref")
+    assert ref[1] == N_ROUND
+
+    # 2. kill rank 1 mid-train: rank 0 must exit (bounded by the
+    # communicate timeout above == no hung rank) and classify the
+    # failure; rank 1 shows the raw SIGKILL
+    kill_ck = tmp_path / "ck_kill"
+    res = leg("kill", kill_ck, (0, 1),
+              {"faults": f"kill_rank@rank=1,iter={KILL_ITER}"})
+    by_rank = {r: (rc, out, err) for r, rc, out, err in res}
+    assert by_rank[1][0] == -9, by_rank[1]
+    rc0, out0, err0 = by_rank[0]
+    assert rc0 != 0, "rank 0 exited clean despite a dead peer"
+    assert classify_elastic_failure(out0 + "\n" + err0) == \
+        "peer_lost", (rc0, err0[-1500:])
+    shrink_ck = tmp_path / "ck_shrink"
+    shutil.copytree(kill_ck, shrink_ck)
+
+    # 3. resume=auto on the same machine list -> byte-identical
+    got = _elastic_digest(
+        leg("resume", kill_ck, (0, 1), {}), "resume")
+    assert got == ref, "same-list resume diverged from fault-free run"
+
+    # 4. elastic 2 -> 1 reshard resume -> still byte-identical
+    got = _elastic_digest(
+        leg("shrink", shrink_ck, (-1,), {"elastic_resume": True}),
+        "shrink")
+    assert got == ref, "elastic reshard resume diverged"
+
+
 def test_sync_bin_find_seed(monkeypatch):
     """application.cpp:96: cooperative bin finding syncs
     data_random_seed to the fleet minimum; serial learners and

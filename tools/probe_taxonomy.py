@@ -49,6 +49,46 @@ _WORKER_SIGNATURES = (
 )
 
 
+# elastic distributed-training codes (robustness/elastic.py): the
+# collective watchdog classifies every mid-train distributed failure
+# into this vocabulary; the abort line every aborting rank prints
+# (``ELASTIC_ABORT reason=<code> rank=<r> ...``) round-trips through
+# classify_elastic_failure so drill harnesses and the run_report
+# elastic timeline agree with the watchdog's verdict
+ELASTIC_REASON_CODES = ("peer_lost", "collective_stall",
+                        "coordinator_lost", "unknown")
+
+_ELASTIC_SIGNATURES = (
+    (("coordinator_lost", "coordinator went quiet",
+      "coordinator heartbeat"), "coordinator_lost"),
+    (("collective_stall", "no iteration boundary",
+      "stall timeout"), "collective_stall"),
+    (("peer_lost", "heartbeat connection closed",
+      "heartbeats stale", "never joined"), "peer_lost"),
+)
+
+
+def classify_elastic_failure(detail: str) -> str:
+    """Elastic abort evidence -> one of :data:`ELASTIC_REASON_CODES`.
+
+    The explicit ``reason=<code>`` token (watchdog abort lines,
+    telemetry records) wins; free-text evidence falls back to
+    signature matching.
+    """
+    d = (detail or "").lower()
+    if not d.strip():
+        return "unknown"
+    for tok in d.replace(",", " ").split():
+        if tok.startswith("reason="):
+            code = tok[len("reason="):]
+            if code in ELASTIC_REASON_CODES:
+                return code
+    for needles, code in _ELASTIC_SIGNATURES:
+        if any(n in d for n in needles):
+            return code
+    return "unknown"
+
+
 def classify_worker_failure(detail: str,
                             exit_code=None) -> str:
     """Worker death evidence -> one of :data:`WORKER_REASON_CODES`.

@@ -168,6 +168,23 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "restarts": r.get("restarts"),
             "detail": str(r.get("detail", ""))[:80]})
 
+    # elastic distributed-training timeline (robustness/elastic.py):
+    # watchdog lifecycle, peer hellos/goodbyes, and classified aborts
+    # (ELASTIC_REASON_CODES) — the training-side twin of the replica
+    # timeline above
+    elastic_timeline = []
+    for r in records:
+        if r.get("kind") not in ("elastic", "elastic_abort"):
+            continue
+        elastic_timeline.append({
+            "t": r.get("t"),
+            "event": r.get("event") or r.get("kind"),
+            "rank": r.get("rank"),
+            "iteration": r.get("iteration"),
+            "reason_code": r.get("reason_code"),
+            "world_size": r.get("world_size"),
+            "detail": str(r.get("detail", ""))[:80]})
+
     # SLO burn-rate history (observability/slo.py `slo` telemetry
     # records): latest state per spec plus how often it was breached
     # (every configured window burning > 1.0 at once)
@@ -208,7 +225,7 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     counters_all = end.get("counters") or {}
     robustness = {k: v for k, v in counters_all.items()
                   if k.startswith(("guard.", "checkpoint.", "retry.",
-                                   "faults."))}
+                                   "faults.", "elastic."))}
     # mesh collective traffic: the comm recipes' per-op byte/call
     # counters (learner/comm.py _count_collective — trace-time bytes
     # per compiled grow program) -> {op: {bytes, calls}}
@@ -230,6 +247,7 @@ def digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "comms": comms,
         "ingest": ingest,
         "replica_timeline": replica_timeline,
+        "elastic_timeline": elastic_timeline,
         "backend": run.get("backend"),
         "device_count": run.get("device_count"),
         "serving": serving,
@@ -393,6 +411,16 @@ def render(records: List[Dict[str, Any]]) -> str:
                          for k, v in sorted(r.items())
                          if k.startswith("faults.")
                          and k != "faults.injected"))
+        if any(k.startswith("elastic.") for k in r):
+            L.append(f"elastic: heartbeats="
+                     f"{r.get('elastic.heartbeats', 0):.0f} "
+                     f"aborts={r.get('elastic.aborts', 0):.0f} "
+                     f"barrier_timeouts="
+                     f"{r.get('elastic.barrier_timeouts', 0):.0f} "
+                     + " ".join(
+                         f"{k.split('.', 1)[1]}={v:.0f}"
+                         for k, v in sorted(r.items())
+                         if k.startswith("elastic.abort.")))
 
     if d["memory"]:
         m = d["memory"]
@@ -488,6 +516,30 @@ def render(records: List[Dict[str, Any]]) -> str:
         if codes:
             L.append("death modes: " + " ".join(
                 f"{k}={v}" for k, v in sorted(codes.items(),
+                                              key=lambda kv: -kv[1])))
+
+    etl = d.get("elastic_timeline") or []
+    if etl:
+        L.append("")
+        L.append("== elastic training (robustness/elastic.py) ==")
+        L.append(f"{'t':>9} {'rank':>4} {'event':<20}{'iter':>6} "
+                 f"{'reason_code':<18}detail")
+        for e in etl:
+            t = e.get("t")
+            L.append(f"{t if t is not None else '-':>9} "
+                     f"{str(e.get('rank')):>4} "
+                     f"{str(e.get('event')):<20}"
+                     f"{str(e.get('iteration') or '-'):>6} "
+                     f"{str(e.get('reason_code') or '-'):<18}"
+                     f"{(e.get('detail') or '')[:50]}")
+        acodes: Dict[str, int] = {}
+        for e in etl:
+            if e.get("reason_code"):
+                acodes[e["reason_code"]] = \
+                    acodes.get(e["reason_code"], 0) + 1
+        if acodes:
+            L.append("abort modes: " + " ".join(
+                f"{k}={v}" for k, v in sorted(acodes.items(),
                                               key=lambda kv: -kv[1])))
 
     if d.get("multiboost"):
