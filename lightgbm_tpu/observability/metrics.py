@@ -858,7 +858,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         pass
 
 
-_EXPORTER: List[Optional[ThreadingHTTPServer]] = [None]
+_EXPORTER: List[Optional[Tuple[ThreadingHTTPServer,
+                               threading.Thread]]] = [None]
 
 
 def start_exporter(port: int,
@@ -867,23 +868,25 @@ def start_exporter(port: int,
     ephemeral port (``server.server_address`` has the real one).
     Idempotent per process: a running exporter is returned as-is."""
     if _EXPORTER[0] is not None:
-        return _EXPORTER[0]
+        return _EXPORTER[0][0]
     server = ThreadingHTTPServer((host, int(port)), _MetricsHandler)
     thread = threading.Thread(target=server.serve_forever,
                               name="lgbm-metrics-exporter", daemon=True)
     thread.start()
-    _EXPORTER[0] = server
+    _EXPORTER[0] = (server, thread)
     addr = server.server_address
     log_info(f"metrics exporter on http://{addr[0]}:{addr[1]}/metrics")
     return server
 
 
 def stop_exporter() -> None:
-    server = _EXPORTER[0]
+    entry = _EXPORTER[0]
     _EXPORTER[0] = None
-    if server is not None:
+    if entry is not None:
+        server, thread = entry
         server.shutdown()
         server.server_close()
+        thread.join(timeout=2.0)
 
 
 def maybe_start_exporter(config=None) -> Optional[ThreadingHTTPServer]:

@@ -172,6 +172,9 @@ class ElasticWatchdog:
         self._started = False
         self._grace_timer: Optional[threading.Timer] = None
         self._threads: List[threading.Thread] = []
+        # monitor loops tick on this instead of bare time.sleep so
+        # stop()/_fail() interrupt a wait instead of riding it out
+        self._wake = threading.Event()
         self._last_progress = time.monotonic()
         # rank 0 state
         self._listener: Optional[socket.socket] = None
@@ -243,6 +246,7 @@ class ElasticWatchdog:
                 return
             self._stopped = True
             timer, self._grace_timer = self._grace_timer, None
+        self._wake.set()
         if timer is not None:
             timer.cancel()
         if clean and self.rank != 0 and self._sock is not None:
@@ -258,10 +262,21 @@ class ElasticWatchdog:
         for s in list(self._conns.values()) + [self._sock,
                                                self._listener]:
             if s is not None:
+                # shutdown (not just close) wakes threads blocked in
+                # accept()/recv() on this socket; close alone leaves
+                # them parked until the next frame arrives
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     s.close()
                 except OSError:
                     pass
+        me = threading.current_thread()
+        for t in self._threads:
+            if t is not me:
+                t.join(timeout=max(self.heartbeat_s, 1.0))
 
     # -- internals -----------------------------------------------------
     def _spawn(self, fn, name: str) -> None:
@@ -283,6 +298,7 @@ class ElasticWatchdog:
             if self._failure is not None or self._stopped:
                 return
             self._failure = (reason, int(rank), detail)
+        self._wake.set()
         log_warning(f"elastic: {reason} (rank {rank}): {detail}")
         self._event("abort", reason_code=reason, rank=int(rank),
                     detail=detail[:200], iteration=self.iteration)
@@ -328,7 +344,7 @@ class ElasticWatchdog:
     # -- stall monitor (every rank) ------------------------------------
     def _stall_monitor(self) -> None:
         while True:
-            time.sleep(min(self.heartbeat_s, 0.2))
+            self._wake.wait(min(self.heartbeat_s, 0.2))
             with self._lock:
                 if self._stopped or self._failure is not None:
                     return
@@ -357,6 +373,13 @@ class ElasticWatchdog:
                 conn, _addr = self._listener.accept()
             except OSError:
                 return  # listener closed by stop()
+            with self._lock:
+                if self._stopped:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._spawn(lambda c=conn: self._serve_conn(c),
                         "elastic-conn")
@@ -403,7 +426,7 @@ class ElasticWatchdog:
         t0 = time.monotonic()
         expected = set(range(1, self.world_size))
         while True:
-            time.sleep(min(self.heartbeat_s, 0.2))
+            self._wake.wait(min(self.heartbeat_s, 0.2))
             with self._lock:
                 if self._stopped or self._failure is not None:
                     return
@@ -461,7 +484,7 @@ class ElasticWatchdog:
     def _sender_loop(self) -> None:
         from .faults import get_fault_plan
         while True:
-            time.sleep(self.heartbeat_s)
+            self._wake.wait(self.heartbeat_s)
             with self._lock:
                 if self._stopped or self._failure is not None:
                     return
@@ -490,7 +513,10 @@ class ElasticWatchdog:
         # blocking socket + select for staleness: a socket-level read
         # timeout is indistinguishable from EOF inside recv_frame
         # (socket.timeout IS an OSError), so readiness is polled here
-        self._sock.settimeout(None)
+        try:
+            self._sock.settimeout(None)
+        except OSError:
+            return  # stop() closed the socket before the loop began
         last_from_coord = time.monotonic()
         while True:
             with self._lock:

@@ -545,6 +545,9 @@ class WorkerSupervisor:
         self._model_state: Dict[str, Dict[str, Any]] = {}
         self._awaiting: Dict[str, "_HelloSlot"] = {}
         self._stopping = False
+        # monitor ticks on this instead of bare time.sleep so
+        # shutdown() interrupts the wait instead of riding it out
+        self._stop_evt = threading.Event()
         self.worker_dumps: List[Dict[str, Any]] = []
         # federated-shard staleness: a worker whose last snapshot is
         # older than the heartbeat timeout is rendered stale even if
@@ -821,7 +824,7 @@ class WorkerSupervisor:
         from ..robustness.faults import get_fault_plan
         interval = max(self.opts.heartbeat_ms / 1000.0, 0.02)
         while not self._stopping:
-            time.sleep(interval)
+            self._stop_evt.wait(interval)
             plan = get_fault_plan()
             now = time.monotonic()
             for rep in self.live_replicas():
@@ -1046,6 +1049,7 @@ class WorkerSupervisor:
 
     def shutdown(self, drain: bool = True) -> None:
         self._stopping = True
+        self._stop_evt.set()
         try:
             self._listener.close()
         except OSError:

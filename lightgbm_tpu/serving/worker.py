@@ -212,7 +212,10 @@ class _Worker:
                 if fut.done():
                     done.append((mid, fut, tinfo))
             if not done:
-                time.sleep(0.001)
+                # deliberate 1ms completion poll: device futures have
+                # no event to wait on, and the thread is daemon inside
+                # a worker process that dies with its supervisor
+                time.sleep(0.001)  # graftsync: allow[GS302]
                 continue
             with self.out_lock:
                 self.outstanding = [p for p in self.outstanding

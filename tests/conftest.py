@@ -74,3 +74,27 @@ def _clear_jax_caches_between_modules(request):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running; excluded from the tier-1 budgeted run (-m 'not slow')")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _no_session_thread_leaks():
+    """No non-daemon thread born during the suite may outlive it: an
+    engine whose stop()/shutdown() forgets a join shows up here as a
+    hard failure naming the thread, instead of as a hanging pytest
+    process (graftsync GS301; docs/StaticAnalysis.md)."""
+    import threading
+    import time
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + 5.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon]
+        if not leaked or time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
+    assert not leaked, (
+        "non-daemon thread(s) outlived the test session: "
+        + ", ".join(t.name for t in leaked)
+        + " — some stop()/shutdown() is missing a join "
+          "(graftsync GS301)")

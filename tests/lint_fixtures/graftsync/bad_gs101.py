@@ -1,0 +1,18 @@
+"""GS101: two methods acquire the same pair of locks in opposite order."""
+import threading
+
+
+class ShardPool:
+    def __init__(self):
+        self._slots = threading.Lock()
+        self._stats = threading.Lock()
+
+    def dispatch(self):
+        with self._slots:
+            with self._stats:
+                return 1
+
+    def report(self):
+        with self._stats:
+            with self._slots:  # VIOLATION
+                return 2

@@ -1,0 +1,19 @@
+"""GS102: unbounded blocking calls made while a lock is held."""
+import queue
+import threading
+import time
+
+
+class Feeder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+
+    def next_batch(self):
+        with self._lock:
+            item = self._inbox.get()  # VIOLATION
+        return item
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.5)  # VIOLATION

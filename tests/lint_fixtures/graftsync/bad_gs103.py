@@ -1,0 +1,20 @@
+"""GS103: user callbacks invoked while a lock is held."""
+import threading
+
+
+class RampController:
+    def __init__(self, verdict_fn):
+        self._lock = threading.Lock()
+        self._verdict_fn = verdict_fn
+
+    def evaluate(self, stage):
+        with self._lock:
+            verdict = self._verdict_fn(stage)  # VIOLATION
+        return verdict
+
+    def on_replica_death(self, replica):
+        return None
+
+    def notice(self, replica):
+        with self._lock:
+            self.on_replica_death(replica)  # VIOLATION

@@ -1,0 +1,20 @@
+"""GS201: a counter written by a background thread AND by public callers,
+with no lock guarding either write."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stopped():
+            self._total += 1  # VIOLATION
+
+    def _stopped(self):
+        return False
+
+    def add(self, n):
+        self._total += n

@@ -1,0 +1,11 @@
+"""GS301: a non-daemon thread with no join/cleanup path anywhere."""
+import threading
+
+
+class Pump:
+    def start(self):
+        self._worker = threading.Thread(target=self._run)  # VIOLATION
+        self._worker.start()
+
+    def _run(self):
+        return None

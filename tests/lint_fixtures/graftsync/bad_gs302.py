@@ -1,0 +1,22 @@
+"""GS302: thread loops that stop() cannot interrupt — one ticking on a
+bare time.sleep, one spinning on while True with no stop check."""
+import threading
+import time
+
+
+class Monitor:
+    def __init__(self):
+        self._stop = False
+        self._ticker = threading.Thread(target=self._tick, daemon=True)
+        self._spinner = threading.Thread(target=self._spin, daemon=True)
+
+    def _tick(self):
+        while not self._stop:
+            time.sleep(0.2)  # VIOLATION
+
+    def _spin(self):
+        while True:  # VIOLATION
+            self._work()
+
+    def _work(self):
+        return None

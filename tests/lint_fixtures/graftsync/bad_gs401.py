@@ -1,0 +1,17 @@
+"""GS401: a signal handler that takes a lock — deadlocks if the signal
+lands while the main thread already holds it."""
+import signal
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        with self._lock:  # VIOLATION
+            self._flush()
+
+    def _flush(self):
+        return None

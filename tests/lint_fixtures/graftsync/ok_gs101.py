@@ -1,0 +1,25 @@
+"""GS101 clean: every path takes the pair in the same order; Condition
+aliases to its underlying lock so cv-then-b is not a fresh edge."""
+import threading
+
+
+class ShardPool:
+    def __init__(self):
+        self._slots = threading.Lock()
+        self._stats = threading.Lock()
+        self._cv = threading.Condition(self._slots)
+
+    def dispatch(self):
+        with self._slots:
+            with self._stats:
+                return 1
+
+    def report(self):
+        with self._slots:
+            with self._stats:
+                return 2
+
+    def wait_and_count(self):
+        with self._cv:  # same lock as _slots via the Condition alias
+            with self._stats:
+                return 3

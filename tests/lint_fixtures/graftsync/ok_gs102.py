@@ -1,0 +1,28 @@
+"""GS102 clean: bounded waits under the lock, unbounded ones outside it."""
+import queue
+import threading
+import time
+
+
+class Feeder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+
+    def next_batch(self):
+        with self._lock:
+            item = self._inbox.get(timeout=0.1)
+        return item
+
+    def join_names(self, parts):
+        with self._lock:
+            return ",".join(parts)  # str.join, not thread.join
+
+    def backoff(self):
+        time.sleep(0.5)
+        with self._lock:
+            return len(parts_or_none(self._inbox))
+
+
+def parts_or_none(q):
+    return []

@@ -1,0 +1,21 @@
+"""GS103 clean: snapshot the callback under the lock, invoke it outside."""
+import threading
+
+
+class RampController:
+    def __init__(self, verdict_fn):
+        self._lock = threading.Lock()
+        self._verdict_fn = verdict_fn
+
+    def evaluate(self, stage):
+        with self._lock:
+            fn = self._verdict_fn
+        return fn(stage)
+
+    def on_replica_death(self, replica):
+        return None
+
+    def notice(self, replica):
+        with self._lock:
+            dead = replica
+        self.on_replica_death(dead)

@@ -1,0 +1,26 @@
+"""GS201 clean: same shape as the bad fixture, but every access to the
+shared counter happens under the owning lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stopped():
+            with self._lock:
+                self._total += 1
+
+    def _stopped(self):
+        return False
+
+    def add(self, n):
+        with self._lock:
+            self._total += n
+
+    def snapshot(self):
+        with self._lock:
+            return self._total

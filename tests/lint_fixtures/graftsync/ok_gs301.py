@@ -1,0 +1,33 @@
+"""GS301 clean: the three accepted lifecycles — daemonized, joined in a
+stop() method, or appended to a list the class later joins in a loop."""
+import threading
+
+
+def _work():
+    return None
+
+
+class Pump:
+    def start(self):
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+        self._helper = threading.Thread(target=self._run, daemon=True)
+        self._helper.start()
+
+    def stop(self):
+        self._worker.join(timeout=1.0)
+
+    def _run(self):
+        return None
+
+
+class Pool:
+    def __init__(self):
+        self._threads = []
+
+    def launch(self):
+        self._threads.append(threading.Thread(target=_work))
+
+    def stop(self):
+        for t in self._threads:
+            t.join(timeout=1.0)

@@ -1,0 +1,22 @@
+"""GS302 clean: loops tick on an Event wait (interruptible by stop())
+or check a stop flag and break out of while True."""
+import threading
+
+
+class Monitor:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._ticker = threading.Thread(target=self._tick, daemon=True)
+        self._drainer = threading.Thread(target=self._drain, daemon=True)
+
+    def _tick(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.2)
+
+    def _drain(self):
+        while True:
+            if self._stop.is_set():
+                break
+
+    def stop(self):
+        self._stop.set()

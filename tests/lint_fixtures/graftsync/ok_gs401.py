@@ -1,0 +1,19 @@
+"""GS401 clean: the handler only flips a flag; the lock-taking work
+happens later on a normal thread that polls the flag."""
+import signal
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = False
+        signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self._pending = True
+
+    def poll(self):
+        with self._lock:
+            pending, self._pending = self._pending, False
+        return pending

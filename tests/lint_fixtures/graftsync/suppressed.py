@@ -1,0 +1,22 @@
+"""Seeded graftsync violations, each silenced with an inline allow —
+one same-line form, one comment-line-above form."""
+import threading
+import time
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ticker = threading.Thread(target=self._tick, daemon=True)
+
+    def _tick(self):
+        while not self._closed():
+            time.sleep(0.2)  # graftsync: allow[GS302] deliberate test poll
+
+    def _closed(self):
+        return False
+
+    def hold_and_sleep(self):
+        with self._lock:
+            # graftsync: allow[GS102] fixture: comment-line suppression
+            time.sleep(0.1)

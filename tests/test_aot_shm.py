@@ -19,7 +19,6 @@ Covers the zero-Python serving hot path:
 """
 
 import json
-import os
 
 import numpy as np
 import pytest

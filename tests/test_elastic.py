@@ -45,6 +45,18 @@ from tools.probe_taxonomy import (ELASTIC_REASON_CODES,
                                   classify_elastic_failure)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guarded():
+    # dynamic graftsync: every lock the watchdogs create is
+    # instrumented; a lock-order inversion fails the module outright
+    if os.environ.get("LGBM_SYNC_GUARDS", "1") == "0":
+        yield
+        return
+    from tools.graftsync.runtime import lock_order_guard
+    with lock_order_guard():
+        yield
+
+
 @pytest.fixture(autouse=True)
 def _clean_faults():
     set_fault_plan(None)
@@ -461,7 +473,6 @@ def test_coordinated_resume_bit_identical(tmp_path, fake_world):
 
 
 def test_torn_coordinated_checkpoint_pruned(tmp_path, fake_world):
-    from lightgbm_tpu.observability.telemetry import get_telemetry
     X, y = _data()
     _train(_params(tmp_path / "ck"), 4, X, y)  # versions at iter 2, 4
     versions = sorted(p for p in (tmp_path / "ck").iterdir()
@@ -505,3 +516,26 @@ def test_exit_code_constant_out_of_signal_range():
     # drills assert on rc 43; keep it clear of shell/signal encodings
     assert ELASTIC_EXIT_CODE == 43
     assert not (128 <= ELASTIC_EXIT_CODE <= 165)
+
+
+def test_stop_interrupts_heartbeat_wait_and_joins_threads():
+    # graftsync GS302 regression: the sender/monitor loops used to
+    # tick via bare time.sleep, so stop() on a 30s heartbeat rode out
+    # the full sleep. The _wake event must interrupt it and stop()
+    # must join every helper thread before returning.
+    coord, client = _pair(heartbeat_ms=30000.0,
+                          heartbeat_timeout_ms=120000.0)
+    try:
+        coord.start()
+        client.start()
+        assert _wait(lambda: 1 in coord._conns)
+        t0 = time.monotonic()
+        client.stop()
+        coord.stop()
+        assert time.monotonic() - t0 < 5.0
+        for wd in (coord, client):
+            assert all(not t.is_alive() for t in wd._threads), \
+                [t.name for t in wd._threads if t.is_alive()]
+    finally:
+        client.stop()
+        coord.stop()

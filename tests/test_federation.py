@@ -31,7 +31,7 @@ from lightgbm_tpu.observability.metrics import (FederationClient,
                                                 MetricsRegistry,
                                                 get_metrics,
                                                 hist_layout)
-from lightgbm_tpu.observability.slo import (SLOEngine, SLOSpec,
+from lightgbm_tpu.observability.slo import (SLOEngine,
                                             engine_from_config,
                                             parse_slo_spec,
                                             parse_slo_specs,
@@ -43,6 +43,18 @@ from lightgbm_tpu.pipeline.ramp import (RampThresholds, StageMetrics,
                                         evaluate_stage)
 
 from test_observability_plane import validate_prometheus
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guarded():
+    # dynamic graftsync: every lock the engines under test create is
+    # instrumented; a lock-order inversion fails the module outright
+    if os.environ.get("LGBM_SYNC_GUARDS", "1") == "0":
+        yield
+        return
+    from tools.graftsync.runtime import lock_order_guard
+    with lock_order_guard():
+        yield
 
 
 def _wait(cond, timeout=30.0, interval=0.05):

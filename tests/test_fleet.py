@@ -33,6 +33,18 @@ from lightgbm_tpu.serving.tenants import TokenBucket, parse_tenant_specs
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guarded():
+    # dynamic graftsync: every lock the engines under test create is
+    # instrumented; a lock-order inversion fails the module outright
+    if os.environ.get("LGBM_SYNC_GUARDS", "1") == "0":
+        yield
+        return
+    from tools.graftsync.runtime import lock_order_guard
+    with lock_order_guard():
+        yield
+
+
 def _toy(n=500, f=6, seed=0):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f)

@@ -1,0 +1,262 @@
+"""graftsync self-tests: fixture corpus + dynamic guards (ISSUE 20).
+
+Static half: the analyzer detects 100% of the seeded concurrency
+violations — exact rule id AND exact line (the ``# VIOLATION``
+markers) — with zero findings on any line NOT seeded, zero findings
+on every clean counterpart, and correct inline-suppression behavior.
+Pure AST analysis: no jax import, no threads, tier-1 cheap.
+
+Dynamic half: the instrumented-lock guard demonstrably trips on a
+seeded lock-order inversion, ``no_leaked_threads`` on a seeded
+non-daemon leak, and a well-ordered program passes clean with
+populated hold-time histograms.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tools.graftsync import (ALL_RULES, RULES_BY_ID, analyze_file,
+                             select_rules)
+from tools.graftsync.runtime import (LockOrderError, ThreadLeakError,
+                                     guard_active, guard_stats,
+                                     lock_order_guard,
+                                     no_leaked_threads)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures", "graftsync")
+RULE_IDS = sorted(RULES_BY_ID)
+
+
+def _violation_lines(path):
+    with open(path) as f:
+        return [i for i, line in enumerate(f, start=1)
+                if "# VIOLATION" in line]
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+# -- static: corpus ---------------------------------------------------
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_bad_fixture_detected_exactly(rule_id):
+    """Each seeded violation is reported at its exact line, under its
+    exact rule id, and nothing else in the file fires."""
+    path = _fixture(f"bad_{rule_id.lower()}.py")
+    assert os.path.exists(path), f"missing fixture for {rule_id}"
+    expected = _violation_lines(path)
+    assert expected, f"{path} seeds no violation"
+    findings = analyze_file(path, ALL_RULES)
+    assert [f.line for f in findings] == expected, \
+        (rule_id, [(f.rule, f.line, f.message) for f in findings])
+    assert [f.rule for f in findings] == [rule_id] * len(expected), \
+        [(f.rule, f.line) for f in findings]
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_ok_fixture_clean(rule_id):
+    """The clean counterpart exercises the same constructs without
+    tripping ANY rule — the zero-false-positive half of the bar."""
+    path = _fixture(f"ok_{rule_id.lower()}.py")
+    assert os.path.exists(path), f"missing clean fixture for {rule_id}"
+    findings = analyze_file(path, ALL_RULES)
+    assert findings == [], \
+        [(f.rule, f.line, f.message) for f in findings]
+
+
+# -- static: suppression ----------------------------------------------
+def test_suppression_silences_only_allowed_rule():
+    findings = analyze_file(_fixture("suppressed.py"), ALL_RULES)
+    assert findings == [], \
+        [(f.rule, f.line, f.message) for f in findings]
+    # the same shapes without the allow comments DO fire
+    bad = analyze_file(_fixture("bad_gs302.py"), ALL_RULES)
+    assert "GS302" in {f.rule for f in bad}
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    src = (
+        "import threading\nimport time\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def hold(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)  # graftsync: allow[GS999]\n")
+    p = tmp_path / "wrong_rule.py"
+    p.write_text(src)
+    findings = analyze_file(str(p), ALL_RULES)
+    assert [f.rule for f in findings] == ["GS102"]  # not silenced
+
+
+def test_select_rules_validates_ids():
+    with pytest.raises(KeyError):
+        select_rules(["GS101", "GS9999"])
+    assert [r.rule_id for r in select_rules(["GS201"])] == ["GS201"]
+
+
+# -- static: CLI ------------------------------------------------------
+def test_cli_exit_codes_and_json_report(tmp_path):
+    repo = os.path.dirname(os.path.dirname(FIXTURES))
+    repo = os.path.dirname(repo)
+    env = dict(os.environ, PYTHONPATH=repo)
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftsync", *args],
+            capture_output=True, text=True, cwd=repo, env=env)
+
+    bad = _fixture("bad_gs101.py")
+    ok = _fixture("ok_gs101.py")
+    out_json = str(tmp_path / "report.json")
+    r = run(bad, "--no-baseline", "--format", "json",
+            "--output", out_json)
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["ok"] is False and doc["counts"]["new"] == 1
+    assert doc["findings"][0]["rule"] == "GS101"
+    with open(out_json) as f:
+        assert json.load(f)["findings"][0]["rule"] == "GS101"
+
+    assert run(ok, "--no-baseline").returncode == 0
+    assert run("--list-rules").returncode == 0
+    assert run("no/such/path.py").returncode == 2
+    assert run(ok, "--rules", "GS9999").returncode == 2
+
+    # baseline workflow: update on the bad file -> subsequent run OK
+    bl = str(tmp_path / "bl.json")
+    assert run(bad, "--baseline", bl,
+               "--update-baseline").returncode == 0
+    assert run(bad, "--baseline", bl).returncode == 0
+    # strict mode fails once the finding is fixed but still baselined
+    r2 = run(ok, "--baseline", bl, "--strict-baseline")
+    assert r2.returncode == 1 and "stale" in r2.stdout
+
+
+# -- dynamic: lock-order guard ----------------------------------------
+def test_lock_order_guard_trips_on_seeded_inversion():
+    """forward() records A->B from a worker thread; the main thread
+    then takes B->A — the guard must raise, not deadlock-someday."""
+    with pytest.raises(LockOrderError, match="inversion"):
+        with lock_order_guard():
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def forward():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            t = threading.Thread(target=forward)
+            t.start()
+            t.join()
+            with lock_b:
+                with lock_a:
+                    pass
+    assert not guard_active()  # fully unpatched after the raise
+
+
+def test_lock_order_guard_reports_violation_swallowed_in_worker():
+    """A worker thread that catches the release-time error can't hide
+    the inversion: the scope exit re-raises from the global record."""
+    with pytest.raises(LockOrderError, match="inversion"):
+        with lock_order_guard():
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            with lock_a:
+                with lock_b:
+                    pass
+
+            def backward():
+                try:
+                    with lock_b:
+                        with lock_a:
+                            pass
+                except LockOrderError:
+                    pass  # swallowed — must still fail the scope
+
+            t = threading.Thread(target=backward)
+            t.start()
+            t.join()
+
+
+def test_lock_order_guard_clean_program_passes():
+    """Consistent ordering + RLock reentrancy + Condition wait/notify
+    all pass, and the stats snapshot carries hold-time histograms."""
+    with lock_order_guard() as stats:
+        lock_a = threading.Lock()
+        lock_b = threading.RLock()
+        cond = threading.Condition()
+        ready = []
+
+        def worker():
+            with lock_a:
+                with lock_b:
+                    with lock_b:  # reentrant re-acquire: no self-edge
+                        pass
+            with cond:
+                ready.append(1)
+                cond.notify()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        with cond:
+            cond.wait_for(lambda: ready, timeout=5.0)
+        t.join()
+        with lock_a:
+            with lock_b:
+                pass
+        snap = stats()
+    assert snap["violations"] == []
+    assert snap["tool"] == "graftsync-runtime"
+    histograms = [d["hold_ms_hist"] for d in snap["sites"].values()]
+    assert any(h for h in histograms), snap["sites"]
+    assert sum(d["acquires"] for d in snap["sites"].values()) >= 3
+
+
+def test_guard_nesting_is_reentrant():
+    with lock_order_guard():
+        assert guard_active()
+        with lock_order_guard():
+            lock = threading.Lock()
+            with lock:
+                pass
+        assert guard_active()  # inner exit must not unpatch
+        with threading.Lock():
+            pass
+    assert not guard_active()
+    assert isinstance(guard_stats(), dict)
+
+
+# -- dynamic: thread-leak guard ---------------------------------------
+def test_no_leaked_threads_trips_on_seeded_leak():
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="seeded-leak")
+    try:
+        with pytest.raises(ThreadLeakError, match="seeded-leak"):
+            with no_leaked_threads(grace_s=0.1):
+                t.start()
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_no_leaked_threads_clean_and_allowlist():
+    with no_leaked_threads(grace_s=0.5):
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join(timeout=5.0)
+    release = threading.Event()
+    keep = threading.Thread(target=release.wait, name="pool-keeper")
+    try:
+        with no_leaked_threads(grace_s=0.1, allow=("pool-",)):
+            keep.start()  # whitelisted by name substring
+    finally:
+        release.set()
+        keep.join(timeout=5.0)

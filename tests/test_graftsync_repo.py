@@ -1,0 +1,73 @@
+"""The pytest-collected graftsync gate (ISSUE 20 tentpole).
+
+Runs the full concurrency rule set over ``lightgbm_tpu/`` against the
+committed baseline and fails on any NEW finding — the same check CI's
+``graftsync`` job runs, here so a plain local ``pytest tests/``
+catches a reintroduced lock-order hazard / blocking-under-lock /
+thread leak before review.
+
+Also pins the acceptance bar: the threaded planes this PR swept
+(procfleet, fleet, elastic, slo) must have an EMPTY baseline — their
+pre-existing findings were fixed or allow-marked in source with a
+justification, not grandfathered, and may not come back.
+"""
+
+import os
+
+import pytest
+
+from tools.graftsync import (ALL_RULES, apply_baseline, load_baseline,
+                             run_paths)
+from tools.graftsync.cli import DEFAULT_BASELINE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+THREADED_PLANE_FILES = (
+    "lightgbm_tpu/serving/procfleet.py",
+    "lightgbm_tpu/serving/fleet.py",
+    "lightgbm_tpu/robustness/elastic.py",
+    "lightgbm_tpu/observability/slo.py",
+)
+
+
+def _fmt(findings):
+    return "\n".join(f"  {f.path}:{f.line}  {f.rule}  {f.message}"
+                     for f in findings)
+
+
+@pytest.fixture(scope="module")
+def all_findings():
+    """ONE analysis pass with every rule (per-module model building
+    dominates; rule dispatch is cheap) — the tests below slice it."""
+    return run_paths([os.path.join(REPO, "lightgbm_tpu")], ALL_RULES,
+                     rel_to=REPO)
+
+
+def test_lightgbm_tpu_tree_has_no_new_findings(all_findings):
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new, _baselined, _stale = apply_baseline(all_findings, baseline)
+    assert not new, (
+        "graftsync found new concurrency violations (fix them or, for "
+        "a deliberate pattern, add an inline "
+        "`# graftsync: allow[rule]` with a justification):\n"
+        + _fmt(new))
+
+
+def test_threaded_planes_baseline_is_empty():
+    """The four threaded engines must stay baseline-clean FOREVER: a
+    future finding there is a bug to fix, never a line to baseline."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    grandfathered = [k for k in baseline
+                     if k[0] in THREADED_PLANE_FILES]
+    assert not grandfathered, (
+        "threaded-plane modules must stay baseline-clean, not "
+        f"grandfathered: {grandfathered}")
+
+
+def test_threaded_planes_have_zero_unsuppressed_findings(all_findings):
+    """Belt and braces over the baseline pin: the swept files carry no
+    findings at all (allow-marks in source are the only escape hatch,
+    and each one carries its justification next to the code)."""
+    findings = [f for f in all_findings
+                if f.path in THREADED_PLANE_FILES]
+    assert not findings, _fmt(findings)

@@ -853,3 +853,15 @@ def test_bench_probe_telemetry_and_cache_age(tmp_path, monkeypatch):
     assert info["tpu_probe_cached"] is True
     assert info["tpu_probe_detail"] == "hung"
     assert 95 <= info["tpu_probe_age_s"] <= 110
+
+
+def test_stop_exporter_joins_thread(tel):
+    # graftsync regression: stop_exporter used to discard the serve
+    # thread; it must now join it so shutdown leaks nothing
+    start_exporter(0)
+    assert any(t.name == "lgbm-metrics-exporter"
+               for t in threading.enumerate())
+    stop_exporter()
+    assert all(t.name != "lgbm-metrics-exporter"
+               for t in threading.enumerate())
+    stop_exporter()  # idempotent

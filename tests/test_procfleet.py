@@ -40,6 +40,18 @@ from lightgbm_tpu.serving.procfleet import (STATE_CODES, recv_frame,
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lock_order_guarded():
+    # dynamic graftsync: every lock the supervisor/engines create is
+    # instrumented; a lock-order inversion fails the module outright
+    if os.environ.get("LGBM_SYNC_GUARDS", "1") == "0":
+        yield
+        return
+    from tools.graftsync.runtime import lock_order_guard
+    with lock_order_guard():
+        yield
+
+
 def _toy(n=400, f=6, seed=0):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, f)
@@ -761,3 +773,24 @@ def test_telemetry_replica_records_emitted(proc_fleet):
     if not tel.enabled:
         pytest.skip("telemetry ring not armed in this run")
     assert any(r.get("event") in ("ready", "respawned") for r in recs)
+
+
+def test_shutdown_interrupts_monitor_wait():
+    # graftsync GS302 regression: _monitor_loop used to tick via bare
+    # time.sleep(interval), so shutdown() on a long heartbeat waited
+    # out the sleep. The stop event must interrupt it.
+    from lightgbm_tpu.serving.procfleet import WorkerSupervisor
+
+    class _FleetStub:  # weakref-able stand-in; no replicas spawn
+        pass
+
+    stub = _FleetStub()
+    sup = WorkerSupervisor(stub, ProcFleetOptions(heartbeat_ms=30000))
+    try:
+        t0 = time.monotonic()
+        sup.shutdown(drain=False)
+        assert time.monotonic() - t0 < 5.0
+        sup._monitor_thread.join(timeout=5.0)
+        assert not sup._monitor_thread.is_alive()
+    finally:
+        sup.shutdown(drain=False)

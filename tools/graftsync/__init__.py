@@ -1,0 +1,43 @@
+"""graftsync — AST-based concurrency analyzer for this repo's
+threaded serving & robustness planes.
+
+The third static-analysis leg next to graftlint (source AST, JAX/TPU
+invariants) and graftcheck (compiled HLO contracts): graftsync sees
+the thread/lock/socket layer neither of those looks at. Per module it
+builds a lock map (threading.Lock/RLock/Condition attributes and
+their ``with self._lock:`` acquisition sites), propagates held-lock
+sets through the intra-module call graph, and reports:
+
+  GS101  lock-order inversion (two locks acquired in both orders)
+  GS102  blocking call under a held lock
+  GS103  user/callback invocation while holding a lock
+  GS201  shared mutable attribute written from >=2 thread entry
+         points with no inferred owning lock
+  GS301  thread created without daemon= or a reachable join()
+  GS302  unbounded ``while True`` thread loop with no stop check
+  GS401  non-reentrant work in a signal handler
+
+Static analysis is complemented by the dynamic half
+(``tools.graftsync.runtime``): ``lock_order_guard()`` instruments
+every lock created in scope, records per-thread acquisition order
+into a global graph and fails on cycle formation at release time;
+``no_leaked_threads()`` asserts every non-daemon thread spawned in
+scope is joined by exit. Both are armed across the procfleet / fleet
+/ federation / elastic test suites and the CI chaos-soak.
+
+Run: ``python -m tools.graftsync`` (analyzes ``lightgbm_tpu/``
+against the committed baseline); see docs/StaticAnalysis.md.
+"""
+
+from tools.graftlint.baseline import (apply_baseline, load_baseline,
+                                      save_baseline)
+from tools.graftlint.findings import Finding
+
+from .core import analyze_file, run_paths
+from .rules import ALL_RULES, ALL_RULE_IDS, RULES_BY_ID, select_rules
+
+__all__ = [
+    "Finding", "analyze_file", "run_paths", "load_baseline",
+    "save_baseline", "apply_baseline", "ALL_RULES", "ALL_RULE_IDS",
+    "RULES_BY_ID", "select_rules",
+]

@@ -1,0 +1,100 @@
+"""graftsync CLI: ``python -m tools.graftsync [paths...]``.
+
+Exit codes: 0 = clean (all findings baselined), 1 = new findings (or
+stale baseline entries under --strict-baseline), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from tools.graftlint.baseline import (apply_baseline, load_baseline,
+                                      save_baseline)
+
+from .core import DEFAULT_PATHS, run_paths
+from .reporters import render_json, render_table
+from .rules import ALL_RULES, select_rules
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftsync",
+        description="thread/lock concurrency analyzer (see "
+                    "docs/StaticAnalysis.md)")
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                   help="files/directories to analyze "
+                        f"(default: {' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--format", choices=("table", "json"),
+                   default="table")
+    p.add_argument("--output", default="",
+                   help="write the report to a file as well as stdout")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON (default: the committed "
+                        "tools/graftsync/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, baselined or not")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from this run's "
+                        "findings and exit 0")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="stale baseline entries also fail the run "
+                        "(CI keeps the file honest)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print baselined findings in the table")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.rule_id}  {r.name}\n    {r.description}")
+        return 0
+    try:
+        if not args.rules or args.rules == "all":
+            rules = list(ALL_RULES)
+        else:
+            rules = select_rules(
+                [r.strip() for r in args.rules.split(",") if r.strip()])
+    except KeyError as e:
+        print(f"graftsync: {e.args[0]}", file=sys.stderr)
+        return 2
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"graftsync: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings = run_paths(args.paths, rules)
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"graftsync: baseline updated with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined, stale = apply_baseline(findings, baseline)
+
+    rules_run = [r.rule_id for r in rules]
+    if args.format == "json":
+        report = render_json(new, baselined, stale, rules_run)
+    else:
+        report = render_table(new, baselined, stale,
+                              verbose=args.verbose)
+    print(report, end="" if report.endswith("\n") else "\n")
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(render_json(new, baselined, stale, rules_run)
+                    if args.output.endswith(".json") else report + "\n")
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0

@@ -1,0 +1,95 @@
+"""graftsync visitor core: file loading, per-module model
+construction, rule dispatch, suppression filtering.
+
+Same shape as tools/graftlint/core.py, with one difference: the
+shared per-module artifact is a concurrency ``ModuleModel`` (lock
+map + call graph), built once and consumed by every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+from tools.graftlint.findings import Finding, sort_findings
+
+from .model import ModuleModel
+from .suppress import is_suppressed, parse_suppressions
+
+DEFAULT_PATHS = ("lightgbm_tpu",)
+EXCLUDE_DIRS = {"__pycache__", ".git", ".jax_cache_tpu",
+                "lint_fixtures", "node_modules"}
+
+
+class SyncModuleContext:
+    def __init__(self, path: str, tree: ast.Module,
+                 lines: List[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.model = ModuleModel(tree)
+
+
+class Rule:
+    rule_id: str = "GS000"
+    name: str = "base"
+    description: str = ""
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: SyncModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = module.lines[line - 1].strip() \
+            if 0 < line <= len(module.lines) else ""
+        return Finding(rule=self.rule_id, name=self.name,
+                       path=module.path, line=line, col=col,
+                       message=message, snippet=snippet)
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in EXCLUDE_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def analyze_file(path: str, rules: Iterable[Rule],
+                 rel_to: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, rel_to) if rel_to else path
+    rel = rel.replace(os.sep, "/")
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="GS000", name="syntax-error", path=rel,
+                        line=e.lineno or 1, col=e.offset or 0,
+                        message=f"syntax error: {e.msg}", snippet="")]
+    lines = src.splitlines()
+    module = SyncModuleContext(rel, tree, lines)
+    suppressions = parse_suppressions(lines)
+    out: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(module):
+            if not is_suppressed(suppressions, f.line, f.rule):
+                out.append(f)
+    return sort_findings(out)
+
+
+def run_paths(paths: Sequence[str], rules: Iterable[Rule],
+              rel_to: Optional[str] = None) -> List[Finding]:
+    rules = list(rules)
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(analyze_file(path, rules, rel_to=rel_to))
+    return sort_findings(findings)

@@ -1,0 +1,704 @@
+"""Per-module concurrency model: lock map, held-set tracking,
+intra-module call graph.
+
+The model is built once per module and shared by every rule (the
+analysis pass dominates; rule dispatch over the collected facts is
+cheap — same economics as graftlint's traced-context analysis).
+
+Scope decisions, so the rules stay predictable:
+
+* Lock discovery: ``self.X = threading.Lock()/RLock()/Condition()``
+  anywhere in a class, plus module-level ``X = threading.Lock()``.
+  ``threading.Condition(self.Y)`` ALIASES the condition attribute to
+  the underlying lock ``Y`` — acquiring the condition acquires that
+  lock, and treating them as distinct would fabricate inversions.
+* Held-set tracking: ``with self.X:`` blocks (incl. multi-item
+  ``with``). A bare blocking ``X.acquire()`` records an acquisition
+  *event* (a lock-order edge source) but does not extend the held
+  set — its release is not reliably findable. ``acquire(
+  blocking=False)`` is non-blocking and can never deadlock, so it is
+  neither an event nor an edge (the PR 15 redispatch fix is the
+  canonical safe pattern).
+* Call graph: calls are resolved by bare name against the module's
+  own function/method defs (``self.foo()`` prefers the same class).
+  Blocking-ness and acquired-lock sets propagate through this graph
+  to a fixpoint, so ``supervisor._lock`` held across
+  ``handle.request_sync()`` is seen even though the wait lives two
+  frames down.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# internally-synchronized primitives: attributes holding these are
+# never GS201 "unguarded shared state"
+SYNC_SAFE_FACTORIES = {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "local",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "deque",
+    "Thread", "Timer", "Lock", "RLock", "Condition",
+}
+
+# receiver-method names that block (GS102): the ISSUE-pinned set —
+# socket recv/accept, queue.get / thread.join / event.wait /
+# condition.wait without a timeout, subprocess waits, jax dispatch.
+_BLOCKING_ATTR_ALWAYS = {"recv", "recv_into", "recv_bytes", "accept",
+                         "makefile", "block_until_ready"}
+# block only when called with no positional args and no timeout kwarg
+_BLOCKING_ATTR_UNBOUNDED = {"get", "join", "wait", "result",
+                            "communicate"}
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"),
+    ("jax", "device_get"),
+    ("jax", "block_until_ready"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+}
+
+_STOP_NAME_TOKENS = ("stop", "stopping", "stopped", "closed",
+                     "closing", "shutdown", "done", "running",
+                     "alive", "failure", "failed", "quit", "exit")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_threading_call(node: ast.AST, names: Set[str]
+                       ) -> Optional[str]:
+    """'Lock' when node is ``threading.Lock(...)`` / ``Lock(...)``
+    (from-imported) for a name in *names*."""
+    if not isinstance(node, ast.Call):
+        return None
+    d = _dotted(node.func)
+    if d is None:
+        return None
+    base = d.split(".")[-1]
+    if base not in names:
+        return None
+    if "." in d and not d.startswith(("threading.", "queue.",
+                                      "collections.",
+                                      "multiprocessing.")):
+        return None
+    return base
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(k.arg == "timeout" for k in call.keywords):
+        return True
+    return bool(call.args)
+
+
+FuncId = Tuple[Optional[str], str]  # (class name or None, func name)
+
+
+@dataclasses.dataclass
+class Acquisition:
+    key: str                 # lock key, e.g. "Fleet._lock"
+    node: ast.AST
+    held: Tuple[str, ...]    # locks already held at this site
+
+
+@dataclasses.dataclass
+class CallSite:
+    name: str                # bare callee name
+    dotted: Optional[str]    # full dotted callee, when resolvable
+    node: ast.Call
+    held: Tuple[str, ...]
+    self_call: bool          # prefers same-class resolution
+    via_self: bool = False   # receiver is literally ``self`` — the
+    # only edges that can mutate this object's own attributes (GS201)
+
+
+@dataclasses.dataclass
+class BlockingSite:
+    desc: str
+    node: ast.AST
+    held: Tuple[str, ...]
+    releases: Tuple[str, ...] = ()   # cond.wait() releases its own lock
+
+
+@dataclasses.dataclass
+class AttrAccess:
+    attr: str
+    node: ast.AST
+    held: Tuple[str, ...]
+    write: bool
+    fid: Optional[FuncId] = None   # owning function (set by rules)
+
+
+@dataclasses.dataclass
+class ThreadCreation:
+    node: ast.Call
+    kind: str                        # "Thread" | "Timer"
+    target: Optional[FuncId]         # resolved target function
+    daemon: Optional[bool]           # daemon= kwarg constant, if any
+    bound_name: Optional[str]        # "t" / "self._mon" / None
+    appended_to: Optional[str]       # "self._threads" when .append()d
+    target_param: Optional[str] = None  # target is a parameter of the
+    # creating function — a spawner helper like elastic's _spawn(fn)
+    func: "FuncModel" = None         # creating function (set later)
+
+
+@dataclasses.dataclass
+class FuncModel:
+    fid: FuncId
+    node: ast.AST                    # FunctionDef / AsyncFunctionDef
+    acquisitions: List[Acquisition] = dataclasses.field(
+        default_factory=list)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    blocking: List[BlockingSite] = dataclasses.field(
+        default_factory=list)
+    accesses: List[AttrAccess] = dataclasses.field(
+        default_factory=list)
+    threads: List[ThreadCreation] = dataclasses.field(
+        default_factory=list)
+    while_true: List[ast.While] = dataclasses.field(
+        default_factory=list)
+    sleep_loops: List[Tuple[ast.While, ast.Call]] = dataclasses.field(
+        default_factory=list)   # while-loops ticking via time.sleep
+    # fixpoint results
+    trans_blocking: Optional[str] = None   # reason chain, or None
+    trans_acquired: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ClassModel:
+    name: str
+    node: ast.ClassDef
+    locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    lock_alias: Dict[str, str] = dataclasses.field(
+        default_factory=dict)  # cond attr -> underlying lock attr
+    safe_attrs: Set[str] = dataclasses.field(default_factory=set)
+    methods: Dict[str, FuncModel] = dataclasses.field(
+        default_factory=dict)
+
+    def lock_key(self, attr: str) -> str:
+        return f"{self.name}.{self.lock_alias.get(attr, attr)}"
+
+
+class ModuleModel:
+    """All concurrency facts for one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.classes: Dict[str, ClassModel] = {}
+        self.module_locks: Dict[str, str] = {}
+        self.funcs: Dict[FuncId, FuncModel] = {}
+        self.by_name: Dict[str, List[FuncId]] = {}
+        self.signal_handlers: List[Tuple[FuncId, ast.Call]] = []
+        self.thread_targets: Set[FuncId] = set()
+        self._discover()
+        self._scan()
+        self._propagate_spawners()
+        self._resolve_signal_handlers()
+        self._fixpoint()
+
+    # -- discovery -----------------------------------------------------
+    def lock_attr_classes(self, attr: str) -> List[str]:
+        """Classes in this module declaring *attr* as a lock."""
+        return [c.name for c in self.classes.values()
+                if attr in c.locks or attr in c.lock_alias]
+
+    def _discover(self) -> None:
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = _is_threading_call(stmt.value, LOCK_FACTORIES)
+                if kind:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[t.id] = kind
+            elif isinstance(stmt, ast.ClassDef):
+                self._discover_class(stmt)
+
+    def _discover_class(self, node: ast.ClassDef) -> None:
+        cm = ClassModel(node.name, node)
+        self.classes[node.name] = cm
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            kind = _is_threading_call(sub.value, LOCK_FACTORIES)
+            safe = _is_threading_call(sub.value, SYNC_SAFE_FACTORIES)
+            for t in sub.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    if kind:
+                        cm.locks[t.attr] = kind
+                        if kind == "Condition" and sub.value.args:
+                            under = sub.value.args[0]
+                            if (isinstance(under, ast.Attribute)
+                                    and isinstance(under.value,
+                                                   ast.Name)
+                                    and under.value.id == "self"):
+                                cm.lock_alias[t.attr] = under.attr
+                    elif safe:
+                        cm.safe_attrs.add(t.attr)
+
+    # -- per-function scan ---------------------------------------------
+    def _scan(self) -> None:
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self._scan_func(stmt, None)
+            elif isinstance(stmt, ast.ClassDef):
+                cm = self.classes[stmt.name]
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fm = self._scan_func(sub, cm)
+                        cm.methods[sub.name] = fm
+
+    def _scan_func(self, node, cm: Optional[ClassModel]) -> FuncModel:
+        fid: FuncId = (cm.name if cm else None, node.name)
+        fm = FuncModel(fid, node)
+        self.funcs[fid] = fm
+        self.by_name.setdefault(node.name, []).append(fid)
+        _FuncScanner(self, cm, fm).scan()
+        for tc in fm.threads:
+            tc.func = fm
+            if tc.target is not None:
+                self.thread_targets.add(tc.target)
+        return fm
+
+    def _propagate_spawners(self) -> None:
+        """Resolve thread targets routed through a local spawner
+        helper — ``def _spawn(self, fn, name): Thread(target=fn)`` —
+        by mapping the spawner's target parameter back to the
+        argument at each call site (incl. ``lambda: self._f(x)``)."""
+        spawners: Dict[FuncId, Tuple[str, int]] = {}
+        for fm in self.funcs.values():
+            params = [a.arg for a in fm.node.args.args]
+            for tc in fm.threads:
+                if tc.target is None and tc.target_param in params:
+                    spawners[fm.fid] = (
+                        tc.target_param,
+                        params.index(tc.target_param))
+        if not spawners:
+            return
+        for fm in self.funcs.values():
+            for site in fm.calls:
+                for gid in self.resolve_call(site, fm.fid):
+                    if gid not in spawners:
+                        continue
+                    pname, pidx = spawners[gid]
+                    expr = None
+                    for k in site.node.keywords:
+                        if k.arg == pname:
+                            expr = k.value
+                    if expr is None:
+                        idx = pidx - (1 if gid[0] is not None else 0)
+                        if 0 <= idx < len(site.node.args):
+                            expr = site.node.args[idx]
+                    tid = self._spawn_arg_target(expr, fm.fid[0])
+                    if tid is not None:
+                        self.thread_targets.add(tid)
+
+    def _spawn_arg_target(self, expr: Optional[ast.expr],
+                          cls: Optional[str]) -> Optional[FuncId]:
+        if isinstance(expr, ast.Lambda):
+            body = expr.body
+            if isinstance(body, ast.Call):
+                expr = body.func
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls:
+            fid = (cls, expr.attr)
+            return fid if fid in self.funcs else None
+        if isinstance(expr, ast.Name):
+            fid = (None, expr.id)
+            return fid if fid in self.funcs else None
+        return None
+
+    # -- resolution helpers --------------------------------------------
+    def resolve_call(self, site: CallSite,
+                     caller: FuncId) -> List[FuncId]:
+        cands = self.by_name.get(site.name, [])
+        if site.self_call and caller[0] is not None:
+            own = [(caller[0], site.name)]
+            if own[0] in self.funcs:
+                return own
+        return list(cands)
+
+    def _resolve_signal_handlers(self) -> None:
+        for fm in self.funcs.values():
+            for site in fm.calls:
+                if site.dotted not in ("signal.signal", "signal"):
+                    continue
+                if len(site.node.args) < 2:
+                    continue
+                h = site.node.args[1]
+                hid: Optional[FuncId] = None
+                if isinstance(h, ast.Attribute) \
+                        and isinstance(h.value, ast.Name) \
+                        and h.value.id == "self" and fm.fid[0]:
+                    hid = (fm.fid[0], h.attr)
+                elif isinstance(h, ast.Name):
+                    hid = (None, h.id)
+                    if hid not in self.funcs and fm.fid[0]:
+                        hid = (fm.fid[0], h.id)
+                if hid in self.funcs:
+                    self.signal_handlers.append((hid, site.node))
+
+    # -- fixpoints ------------------------------------------------------
+    def _fixpoint(self) -> None:
+        for fm in self.funcs.values():
+            if fm.blocking:
+                fm.trans_blocking = fm.blocking[0].desc
+            fm.trans_acquired = {a.key for a in fm.acquisitions}
+        changed = True
+        while changed:
+            changed = False
+            for fm in self.funcs.values():
+                for site in fm.calls:
+                    for gid in self.resolve_call(site, fm.fid):
+                        g = self.funcs[gid]
+                        if g.trans_blocking and not fm.trans_blocking:
+                            fm.trans_blocking = (
+                                f"calls {site.name}() -> "
+                                f"{g.trans_blocking}")
+                            changed = True
+                        extra = g.trans_acquired - fm.trans_acquired
+                        if extra:
+                            fm.trans_acquired |= extra
+                            changed = True
+
+    # -- derived views --------------------------------------------------
+    def thread_entry_funcs(self) -> Set[FuncId]:
+        """Thread targets plus ``run`` methods of Thread subclasses."""
+        out = set(self.thread_targets)
+        for cm in self.classes.values():
+            bases = {_dotted(b) for b in cm.node.bases}
+            if bases & {"threading.Thread", "Thread"}:
+                if "run" in cm.methods:
+                    out.add((cm.name, "run"))
+        return out
+
+    def reachable_from(self, roots: Sequence[FuncId]) -> Set[FuncId]:
+        seen: Set[FuncId] = set()
+        stack = [r for r in roots if r in self.funcs]
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            for site in self.funcs[fid].calls:
+                for gid in self.resolve_call(site, fid):
+                    if gid not in seen:
+                        stack.append(gid)
+        return seen
+
+    def reachable_self(self, cls: str,
+                       roots: Sequence[FuncId]) -> Set[FuncId]:
+        """Reachability following only ``self.foo()`` edges inside
+        one class — the only paths that can write this object's own
+        attributes. Cross-object ``rep.stop()`` must NOT pull every
+        same-named method into a thread root (GS201 precision)."""
+        seen: Set[FuncId] = set()
+        stack = [r for r in roots if r in self.funcs
+                 and r[0] == cls]
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            for site in self.funcs[fid].calls:
+                if not site.via_self:
+                    continue
+                gid = (cls, site.name)
+                if gid in self.funcs and gid not in seen:
+                    stack.append(gid)
+        return seen
+
+
+class _FuncScanner:
+    """Walks one function body tracking the held-lock set."""
+
+    def __init__(self, mm: ModuleModel, cm: Optional[ClassModel],
+                 fm: FuncModel):
+        self.mm = mm
+        self.cm = cm
+        self.fm = fm
+
+    def scan(self) -> None:
+        self._stmts(self.fm.node.body, ())
+
+    # lock key for an acquirable expression, or None
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and self.cm
+                and (expr.attr in self.cm.locks
+                     or expr.attr in self.cm.lock_alias)):
+            return self.cm.lock_key(expr.attr)
+        if isinstance(expr, ast.Name) \
+                and expr.id in self.mm.module_locks:
+            return f"<module>.{expr.id}"
+        if isinstance(expr, ast.Attribute):
+            # cross-object: ``with rep._lock:`` — resolve the attr
+            # name against the module's class lock maps. Unique owner
+            # -> precise key; shared name -> one merged "~.attr"
+            # bucket (held-ness is still tracked; same-key edges are
+            # dropped, so the merge cannot fabricate an inversion)
+            owners = self.mm.lock_attr_classes(expr.attr)
+            if len(owners) == 1:
+                return self.mm.classes[owners[0]].lock_key(expr.attr)
+            if len(owners) > 1:
+                return f"~.{expr.attr}"
+        return None
+
+    def _stmts(self, body, held: Tuple[str, ...]) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, ast.With):
+            inner = held
+            for item in stmt.items:
+                key = self._lock_key(item.context_expr)
+                if key is not None:
+                    self.fm.acquisitions.append(
+                        Acquisition(key, item.context_expr, inner))
+                    if key not in inner:
+                        inner = inner + (key,)
+                else:
+                    self._expr(item.context_expr, inner)
+            self._stmts(stmt.body, inner)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: scan with the same held set (closures run
+            # later, but the conservative view keeps thread bodies
+            # declared inline visible)
+            self._stmts(stmt.body, held)
+            return
+        if isinstance(stmt, ast.While):
+            if isinstance(stmt.test, ast.Constant) \
+                    and stmt.test.value is True:
+                self.fm.while_true.append(stmt)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) \
+                        and _dotted(node.func) == "time.sleep":
+                    self.fm.sleep_loops.append((stmt, node))
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._expr(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter, held)
+            self._note_store(stmt.target, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, held)
+            for t in stmt.targets:
+                self._note_store(t, held)
+            self._note_thread_creation(stmt, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, held)
+            self._note_store(stmt.target, held)
+            return
+        if isinstance(stmt, (ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+            if isinstance(stmt, ast.Expr):
+                self._note_thread_creation(stmt, held)
+            return
+        # default: visit all child expressions with the same held set
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, held)
+
+    # -- expression walk ------------------------------------------------
+    def _expr(self, expr: ast.expr, held: Tuple[str, ...]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._note_call(node, held)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and isinstance(node.ctx, ast.Load):
+                self.fm.accesses.append(
+                    AttrAccess(node.attr, node, held, write=False))
+
+    def _note_store(self, target: ast.expr,
+                    held: Tuple[str, ...]) -> None:
+        base = target
+        if isinstance(base, (ast.Subscript,)):
+            base = base.value
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self":
+            self.fm.accesses.append(
+                AttrAccess(base.attr, target, held, write=True))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._note_store(el, held)
+
+    def _note_call(self, call: ast.Call,
+                   held: Tuple[str, ...]) -> None:
+        d = _dotted(call.func)
+        name = d.split(".")[-1] if d else None
+        # bare blocking .acquire(): an edge source, not a held-set
+        # extension; acquire(blocking=False) is exempt entirely
+        if name == "acquire" and isinstance(call.func, ast.Attribute):
+            key = self._lock_key(call.func.value)
+            if key is not None and not self._nonblocking_acquire(call):
+                self.fm.acquisitions.append(
+                    Acquisition(key, call, held))
+            return
+        self._note_blocking(call, d, name, held)
+        if name is not None:
+            via_self = (isinstance(call.func, ast.Attribute)
+                        and isinstance(call.func.value, ast.Name)
+                        and call.func.value.id == "self")
+            plain = isinstance(call.func, ast.Name)
+            self.fm.calls.append(
+                CallSite(name, d, call, held, via_self or plain,
+                         via_self=via_self))
+
+    @staticmethod
+    def _nonblocking_acquire(call: ast.Call) -> bool:
+        for k in call.keywords:
+            if k.arg == "blocking" \
+                    and isinstance(k.value, ast.Constant) \
+                    and k.value.value is False:
+                return True
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        return False
+
+    def _note_blocking(self, call: ast.Call, d: Optional[str],
+                       name: Optional[str],
+                       held: Tuple[str, ...]) -> None:
+        if d and tuple(d.split(".")[:2]) in _BLOCKING_MODULE_CALLS \
+                and len(d.split(".")) == 2:
+            if d == "subprocess.run" or d.startswith("subprocess."):
+                if any(k.arg == "timeout" for k in call.keywords):
+                    return
+            self.fm.blocking.append(BlockingSite(f"{d}()", call, held))
+            return
+        if name in _BLOCKING_ATTR_ALWAYS \
+                and isinstance(call.func, ast.Attribute):
+            self.fm.blocking.append(
+                BlockingSite(f".{name}()", call, held))
+            return
+        if name in _BLOCKING_ATTR_UNBOUNDED \
+                and isinstance(call.func, ast.Attribute) \
+                and not _has_timeout(call):
+            releases: Tuple[str, ...] = ()
+            if name == "wait":
+                # cond.wait() releases the condition's own lock for
+                # the duration — only the OTHER held locks stay held
+                key = self._lock_key(call.func.value)
+                if key is not None:
+                    releases = (key,)
+            self.fm.blocking.append(
+                BlockingSite(f".{name}() without timeout", call,
+                             held, releases))
+
+    def _note_thread_creation(self, stmt: ast.stmt,
+                              held: Tuple[str, ...]) -> None:
+        call, bound, appended = None, None, None
+        if isinstance(stmt, ast.Assign):
+            call = stmt.value
+            if stmt.targets and isinstance(stmt.targets[0],
+                                           (ast.Name, ast.Attribute)):
+                bound = _dotted(stmt.targets[0])
+        elif isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Call):
+            out = stmt.value
+            d = _dotted(out.func)
+            if d and d.endswith(".append") and out.args:
+                call = out.args[0]
+                appended = d[:-len(".append")]
+            elif (d is None and isinstance(out.func, ast.Attribute)
+                    and out.func.attr == "start"
+                    and isinstance(out.func.value, ast.Call)):
+                # fire-and-forget ``threading.Thread(...).start()``
+                call = out.func.value
+            else:
+                call = out
+        kind = _is_threading_call(call, {"Thread", "Timer"})
+        if not kind:
+            return
+        daemon = None
+        target: Optional[FuncId] = None
+        target_param: Optional[str] = None
+        target_exprs = [k.value for k in call.keywords
+                        if k.arg in ("target", "function")]
+        if kind == "Timer" and not target_exprs \
+                and len(call.args) >= 2:
+            target_exprs = [call.args[1]]
+        for k in call.keywords:
+            if k.arg == "daemon" and isinstance(k.value, ast.Constant):
+                daemon = bool(k.value.value)
+        for te in target_exprs:
+            target = self._target_fid(te)
+            if target is None and isinstance(te, ast.Name):
+                target_param = te.id
+        self.fm.threads.append(
+            ThreadCreation(call, kind, target, daemon, bound,
+                           appended, target_param))
+
+    def _target_fid(self, expr: ast.expr) -> Optional[FuncId]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and self.cm:
+            return (self.cm.name, expr.attr)
+        if isinstance(expr, ast.Name):
+            if (None, expr.id) in self.mm.funcs:
+                return (None, expr.id)
+            if self.cm and (self.cm.name, expr.id) in self.mm.funcs:
+                return (self.cm.name, expr.id)
+        if isinstance(expr, ast.Lambda):
+            return None
+        return None
+
+
+def stop_checked(loop: ast.While) -> bool:
+    """True when a ``while True`` loop body consults a stop signal:
+    reads an attr/name with a stop-ish token, calls ``.is_set()`` /
+    ``.wait(...)`` on something, or can leave via break/return."""
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.Break, ast.Return)):
+            return True
+        if isinstance(node, ast.Attribute):
+            low = node.attr.lower()
+            if any(tok in low for tok in _STOP_NAME_TOKENS):
+                return True
+            if node.attr in ("is_set", "wait") \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+        if isinstance(node, ast.Name):
+            low = node.id.lower()
+            if any(tok in low for tok in _STOP_NAME_TOKENS):
+                return True
+    return False

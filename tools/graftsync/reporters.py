@@ -1,0 +1,61 @@
+"""Human (table) and machine (JSON) reporters — graftlint's format
+with a graftsync verdict line, so CI artifacts stay grep-compatible
+across the three gates."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from tools.graftlint.findings import Finding
+
+
+def render_table(new: List[Finding], baselined: List[Finding],
+                 stale: List[tuple], verbose: bool = False) -> str:
+    lines: List[str] = []
+    if new:
+        widths = (max(len(f"{f.path}:{f.line}") for f in new),
+                  max(len(f.rule) for f in new))
+        for f in new:
+            loc = f"{f.path}:{f.line}"
+            lines.append(f"{loc:<{widths[0]}}  {f.rule:<{widths[1]}}  "
+                         f"{f.message}")
+            if f.snippet:
+                lines.append(f"{'':<{widths[0]}}  {'':<{widths[1]}}  "
+                             f"| {f.snippet}")
+    if verbose and baselined:
+        lines.append("")
+        lines.append(f"baselined ({len(baselined)}):")
+        for f in baselined:
+            lines.append(f"  {f.path}:{f.line}  {f.rule}  {f.message}")
+    if stale:
+        lines.append("")
+        lines.append(f"stale baseline entries ({len(stale)}) — the "
+                     "violation is gone; regenerate with "
+                     "--update-baseline:")
+        for path, rule, snippet in stale:
+            lines.append(f"  {path}  {rule}  | {snippet}")
+    lines.append("")
+    verdict = "FAIL" if new else "OK"
+    lines.append(f"graftsync: {verdict} — {len(new)} new finding(s), "
+                 f"{len(baselined)} baselined, {len(stale)} stale "
+                 f"baseline entr{'y' if len(stale) == 1 else 'ies'}")
+    return "\n".join(lines)
+
+
+def render_json(new: List[Finding], baselined: List[Finding],
+                stale: List[tuple],
+                rules_run: Optional[List[str]] = None) -> str:
+    doc: Dict = {
+        "version": 1,
+        "tool": "graftsync",
+        "ok": not new,
+        "counts": {"new": len(new), "baselined": len(baselined),
+                   "stale_baseline": len(stale)},
+        "rules_run": rules_run or [],
+        "findings": [f.to_json() for f in new],
+        "baselined": [f.to_json() for f in baselined],
+        "stale_baseline": [{"path": p, "rule": r, "snippet": s}
+                           for p, r, s in stale],
+    }
+    return json.dumps(doc, indent=2) + "\n"

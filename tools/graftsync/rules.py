@@ -1,0 +1,361 @@
+"""The GS rule set (docs/StaticAnalysis.md has the catalog).
+
+Every rule consumes the shared per-module ``ModuleModel`` — one
+analysis pass, many cheap rule sweeps, graftlint economics.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from tools.graftlint.findings import Finding
+
+from .core import Rule, SyncModuleContext
+from .model import (AttrAccess, FuncId, ModuleModel, ThreadCreation,
+                    _dotted, stop_checked)
+
+_CALLBACK_RE = re.compile(
+    r"(^_?on_[a-z0-9_]+$)|(_(cb|cbs|fn|fns|hook|hooks|callback|"
+    r"callbacks)$)|(^callback$)")
+
+
+def _fmt_locks(held: Tuple[str, ...]) -> str:
+    return ", ".join(held)
+
+
+class LockOrderInversion(Rule):
+    rule_id = "GS101"
+    name = "lock-order-inversion"
+    description = ("two locks acquired in both orders on some call "
+                   "path — a thread scheduling away between them "
+                   "deadlocks (the PR 15 redispatch shape)")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        # edge (held -> acquired) -> earliest site node
+        edges: Dict[Tuple[str, str], ast.AST] = {}
+
+        def note(a: str, b: str, node: ast.AST) -> None:
+            if a == b:
+                return
+            prev = edges.get((a, b))
+            if prev is None or node.lineno < prev.lineno:
+                edges[(a, b)] = node
+
+        for fm in mm.funcs.values():
+            for acq in fm.acquisitions:
+                for h in acq.held:
+                    note(h, acq.key, acq.node)
+            for site in fm.calls:
+                if not site.held:
+                    continue
+                for gid in mm.resolve_call(site, fm.fid):
+                    if gid == fm.fid:
+                        continue
+                    for m in mm.funcs[gid].trans_acquired:
+                        for h in site.held:
+                            note(h, m, site.node)
+        seen = set()
+        for (a, b), node in sorted(
+                edges.items(),
+                key=lambda kv: (kv[1].lineno, kv[0])):
+            if (b, a) not in edges or frozenset((a, b)) in seen:
+                continue
+            seen.add(frozenset((a, b)))
+            other = edges[(b, a)]
+            first, second = ((a, b), node), ((b, a), other)
+            if other.lineno > node.lineno:
+                first, second = second, first
+            (x, y), site = first
+            yield self.finding(
+                module, site,
+                f"lock-order inversion: {x} -> {y} here, but "
+                f"{y} -> {x} at line {second[1].lineno} — two "
+                "threads interleaving these paths deadlock")
+
+
+class BlockingUnderLock(Rule):
+    rule_id = "GS102"
+    name = "blocking-under-lock"
+    description = ("blocking call (socket recv/accept, queue.get / "
+                   "join / wait without timeout, subprocess wait, "
+                   "time.sleep, jax dispatch) while holding a lock")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        for fm in mm.funcs.values():
+            for b in fm.blocking:
+                eff = tuple(h for h in b.held if h not in b.releases)
+                if eff:
+                    yield self.finding(
+                        module, b.node,
+                        f"blocking {b.desc} while holding "
+                        f"{_fmt_locks(eff)} — every other thread "
+                        "needing the lock stalls behind it")
+            for site in fm.calls:
+                if not site.held:
+                    continue
+                for gid in mm.resolve_call(site, fm.fid):
+                    if gid == fm.fid:
+                        continue
+                    g = mm.funcs[gid]
+                    if g.trans_blocking:
+                        yield self.finding(
+                            module, site.node,
+                            f"{site.name}() blocks "
+                            f"({g.trans_blocking}) and is called "
+                            f"holding {_fmt_locks(site.held)}")
+                        break
+
+
+class CallbackUnderLock(Rule):
+    rule_id = "GS103"
+    name = "callback-under-lock"
+    description = ("user/callback invocation (on_* / *_fn / *_cb / "
+                   "*_hook) while holding a lock — re-entry into "
+                   "the locked object deadlocks or corrupts state")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        for fm in mm.funcs.values():
+            for site in fm.calls:
+                if not site.held:
+                    continue
+                if _CALLBACK_RE.search(site.name):
+                    yield self.finding(
+                        module, site.node,
+                        f"callback {site.name}() invoked while "
+                        f"holding {_fmt_locks(site.held)} — callee "
+                        "code (or anything it calls back into) that "
+                        "touches the same lock deadlocks")
+
+
+class UnguardedSharedWrite(Rule):
+    rule_id = "GS201"
+    name = "unguarded-shared-write"
+    description = ("attribute written from >=2 thread entry points "
+                   "with no inferred owning lock (ownership = the "
+                   "lock guarding the majority of accesses)")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        entries = mm.thread_entry_funcs()
+        for cm in mm.classes.values():
+            cls_entries = {e for e in entries if e[0] == cm.name}
+            if not cls_entries:
+                continue
+            reach = {e: mm.reachable_self(cm.name, [e])
+                     for e in cls_entries}
+            public = [(cm.name, n) for n in cm.methods
+                      if not n.startswith("_")]
+            ext_reach = mm.reachable_self(cm.name, public)
+            accesses: Dict[str, List[AttrAccess]] = {}
+            for name, fm in cm.methods.items():
+                if name == "__init__":
+                    continue
+                for a in fm.accesses:
+                    accesses.setdefault(a.attr, []).append(
+                        self._tag(a, fm.fid))
+            for attr, accs in sorted(accesses.items()):
+                if attr in cm.locks or attr in cm.lock_alias \
+                        or attr in cm.safe_attrs:
+                    continue
+                writes = [a for a in accs if a.write]
+                if not writes:
+                    continue
+                roots = set()
+                for a in writes:
+                    fid = a.fid
+                    for e in cls_entries:
+                        if fid in reach[e]:
+                            roots.add(e)
+                    if fid in ext_reach:
+                        roots.add("external")
+                if len(roots) < 2:
+                    continue
+                if self._owner(cm, accs) is not None:
+                    continue
+                first = min(writes, key=lambda a: a.node.lineno)
+                names = sorted(
+                    r if r == "external" else r[1] for r in roots)
+                yield self.finding(
+                    module, first.node,
+                    f"{cm.name}.{attr} is written from "
+                    f"{len(roots)} thread entry points "
+                    f"({', '.join(names)}) with no owning lock "
+                    "guarding a majority of its accesses")
+
+    @staticmethod
+    def _tag(a: AttrAccess, fid: FuncId) -> AttrAccess:
+        a.fid = fid  # annotate in place; model objects are per-run
+        return a
+
+    @staticmethod
+    def _owner(cm, accs: List[AttrAccess]) -> Optional[str]:
+        counts: Dict[str, int] = {}
+        for a in accs:
+            for h in a.held:
+                counts[h] = counts.get(h, 0) + 1
+        total = len(accs)
+        for key, n in sorted(counts.items()):
+            if n * 2 >= total:
+                return key
+        return None
+
+
+class ThreadWithoutCleanup(Rule):
+    rule_id = "GS301"
+    name = "thread-without-cleanup"
+    description = ("thread created without daemon= and with no "
+                   "reachable join() / daemon flag / registered "
+                   "cleanup — it outlives its owner on shutdown")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        for fm in mm.funcs.values():
+            cm = mm.classes.get(fm.fid[0]) if fm.fid[0] else None
+            for tc in fm.threads:
+                if tc.daemon is True:
+                    continue
+                if self._cleanup_found(mm, cm, fm, tc):
+                    continue
+                yield self.finding(
+                    module, tc.node,
+                    f"{tc.kind} created without daemon= and never "
+                    "joined / flagged daemon / registered for "
+                    "cleanup — shutdown leaks it")
+
+    @staticmethod
+    def _cleanup_found(mm: ModuleModel, cm, fm,
+                       tc: ThreadCreation) -> bool:
+        def scope_nodes(name: str):
+            # self.X lives class-wide; a local lives in the creator
+            if name.startswith("self.") and cm is not None:
+                return [cm.node]
+            return [fm.node]
+
+        def has_cleanup(scope: ast.AST, name: str) -> bool:
+            tail = name.split(".")[-1]
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func) or ""
+                    if d == f"{name}.join":
+                        return True
+                    if ("register" in d or "cleanup" in d
+                            or "atexit" in d):
+                        for arg in list(node.args) + [
+                                k.value for k in node.keywords]:
+                            if _dotted(arg) == name:
+                                return True
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if _dotted(t) == f"{name}.daemon":
+                            return True
+                if isinstance(node, ast.For) and tail:
+                    # `for t in self._threads: t.join(...)`
+                    if _dotted(node.iter) == name and any(
+                            isinstance(n, ast.Call)
+                            and (_dotted(n.func) or "").endswith(
+                                ".join")
+                            for n in ast.walk(node)):
+                        return True
+            return False
+
+        for name in (tc.bound_name, tc.appended_to):
+            if not name:
+                continue
+            for scope in scope_nodes(name):
+                if has_cleanup(scope, name):
+                    return True
+        return False
+
+
+class UnstoppableThreadLoop(Rule):
+    rule_id = "GS302"
+    name = "unstoppable-thread-loop"
+    description = ("thread loop without an interruptible stop "
+                   "signal: unbounded `while True` with no stop "
+                   "check, or a flag-polled loop ticking via bare "
+                   "time.sleep (stop() waits out the sleep)")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        for fid in sorted(mm.thread_entry_funcs(),
+                          key=lambda f: (f[0] or "", f[1])):
+            fm = mm.funcs.get(fid)
+            if fm is None:
+                continue
+            for loop in fm.while_true:
+                if not stop_checked(loop):
+                    yield self.finding(
+                        module, loop,
+                        f"thread body {fid[1]}() loops forever with "
+                        "no stop-event check, break or return")
+            for loop, sleep in fm.sleep_loops:
+                yield self.finding(
+                    module, sleep,
+                    f"thread body {fid[1]}() ticks via time.sleep "
+                    "in its loop — stop() cannot interrupt the "
+                    "sleep; wait on a threading.Event "
+                    "(stop_event.wait(interval)) instead")
+
+
+class SignalHandlerNonReentrant(Rule):
+    rule_id = "GS401"
+    name = "signal-handler-non-reentrant"
+    description = ("signal handler acquires locks or blocks — a "
+                   "signal landing while the interrupted thread "
+                   "holds the lock deadlocks the process")
+
+    def check(self, module: SyncModuleContext) -> Iterator[Finding]:
+        mm = module.model
+        reported = set()
+        for hid, _reg in mm.signal_handlers:
+            if hid in reported:
+                continue
+            reported.add(hid)
+            fm = mm.funcs[hid]
+            for acq in fm.acquisitions:
+                yield self.finding(
+                    module, acq.node,
+                    f"signal handler {hid[1]}() acquires {acq.key} "
+                    "— non-reentrant against the interrupted thread")
+            for b in fm.blocking:
+                yield self.finding(
+                    module, b.node,
+                    f"signal handler {hid[1]}() performs blocking "
+                    f"{b.desc}")
+            for site in fm.calls:
+                for gid in mm.resolve_call(site, fm.fid):
+                    g = mm.funcs[gid]
+                    if g.trans_acquired or g.trans_blocking:
+                        why = ("acquires "
+                               + ", ".join(sorted(g.trans_acquired))
+                               if g.trans_acquired
+                               else f"blocks ({g.trans_blocking})")
+                        yield self.finding(
+                            module, site.node,
+                            f"signal handler {hid[1]}() calls "
+                            f"{site.name}() which {why}")
+                        break
+
+
+ALL_RULES: Sequence[Rule] = (
+    LockOrderInversion(), BlockingUnderLock(), CallbackUnderLock(),
+    UnguardedSharedWrite(), ThreadWithoutCleanup(),
+    UnstoppableThreadLoop(), SignalHandlerNonReentrant(),
+)
+RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}
+ALL_RULE_IDS = [r.rule_id for r in ALL_RULES]
+
+
+def select_rules(ids) -> List[Rule]:
+    out = []
+    for rid in ids:
+        if rid not in RULES_BY_ID:
+            raise KeyError(f"unknown rule id: {rid} "
+                           f"(known: {', '.join(ALL_RULE_IDS)})")
+        out.append(RULES_BY_ID[rid])
+    return out

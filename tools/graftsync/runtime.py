@@ -1,0 +1,399 @@
+"""Dynamic concurrency guards: lock-order recording + thread-leak.
+
+The static rules (GS1xx) only see one module's AST; the runtime half
+catches the same invariants end-to-end, across modules and through
+code the analyzer cannot resolve (graftlint's
+``no_implicit_host_transfers`` is the architectural template).
+
+* :func:`lock_order_guard` — while armed, ``threading.Lock`` /
+  ``RLock`` / ``Condition`` construct instrumented locks that record
+  per-thread acquisition order into one global site graph (a site is
+  the ``file:line`` that created the lock). The moment an acquisition
+  closes a cycle in that graph — lock A held while taking B on one
+  path, B held while taking A on another — the violation is recorded
+  and a :class:`LockOrderError` is raised when the offending lock is
+  *released* (never mid-acquire: the critical section completes and
+  the real lock is returned cleanly, so the failure cannot cascade
+  into the deadlock it is reporting). Scope exit re-raises anything a
+  daemon thread swallowed. Each site also keeps a log2 hold-time
+  histogram (``guard_stats()``), which serve-soak publishes and
+  run_report renders.
+
+* :func:`no_leaked_threads` — snapshot ``threading.enumerate()`` on
+  entry; on exit, any *new* thread still alive after a grace period
+  raises :class:`ThreadLeakError` naming it. The tier-1 session
+  fixture and the chaos-soak both arm this, so an unjoined helper
+  thread fails the suite outright instead of showing up as a flaky
+  hang three PRs later.
+
+Only locks *created while armed* are instrumented — arming happens at
+fixture/soak start, before the engines under test construct theirs.
+Pre-existing module-level locks stay untracked, which is what keeps
+the guard cheap enough to leave on for whole suites. Limitation: two
+locks born on the same source line (per-instance locks from one
+``__init__``) share a site and same-site edges are dropped, so an
+inversion purely between instances of one class is invisible — the
+static GS101 covers that shape instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Tuple
+
+_thread = __import__("_thread")
+
+
+class LockOrderError(RuntimeError):
+    """Two locks were acquired in both orders on some pair of paths."""
+
+
+class ThreadLeakError(RuntimeError):
+    """A thread created inside the scope outlived it."""
+
+
+# bookkeeping uses raw _thread locks so it is immune to the patching
+_GRAPH_LOCK = _thread.allocate_lock()
+_PATCH_LOCK = _thread.allocate_lock()
+_TLS = threading.local()
+
+_DEPTH = 0
+_ORIGINALS: Dict[str, object] = {}
+# edge (site_a -> site_b): a lock born at site_a was held while one
+# born at site_b was acquired; value = (thread name, acquire site)
+_EDGES: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_SITES: Dict[str, Dict] = {}
+_VIOLATIONS: List[Dict] = []
+
+
+def _short(path: str) -> str:
+    parts = path.replace(os.sep, "/").split("/")
+    return "/".join(parts[-2:])
+
+
+def _site_of_caller() -> str:
+    """file:line of the nearest frame outside threading/this module —
+    Event() builds Condition(Lock()) inside threading, and the useful
+    site is whoever called Event()."""
+    f = sys._getframe(2)
+    own = __name__.partition(".")[0]
+    while f is not None:
+        mod = f.f_globals.get("__name__", "")
+        root = mod.partition(".")[0]
+        if root not in ("threading", own, "_pytest", "contextlib"):
+            return f"{_short(f.f_code.co_filename)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _thread_name() -> str:
+    """Current thread's name WITHOUT threading.current_thread(): that
+    constructs a _DummyThread in unregistered threads, whose __init__
+    sets an Event -> guarded lock -> this tracking -> recursion."""
+    ident = _thread.get_ident()
+    t = threading._active.get(ident)
+    return t.name if t is not None else f"tid-{ident}"
+
+
+def _held() -> List:
+    h = getattr(_TLS, "held", None)
+    if h is None:
+        h = _TLS.held = []
+    return h
+
+
+def _hist_bucket(ms: float) -> int:
+    # log2 buckets in milliseconds: ... -1 => <=0.5ms, 0 => <=1ms ...
+    b = 0
+    if ms > 1.0:
+        while ms > 1.0 and b < 20:
+            ms /= 2.0
+            b += 1
+    else:
+        while ms <= 0.5 and b > -10:
+            ms *= 2.0
+            b -= 1
+    return b
+
+
+class _GuardedLockBase:
+    """Instrumented lock. Delegates to a real primitive; tracks the
+    per-thread held stack, the global order graph and hold times."""
+
+    _reentrant = False
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._site = _site_of_caller()
+        with _GRAPH_LOCK:
+            _SITES.setdefault(self._site,
+                              {"acquires": 0, "hold_ms_hist": {}})
+
+    # -- tracking ------------------------------------------------------
+    def _note_acquired(self, blocking: bool) -> None:
+        held = _held()
+        if blocking and not (self._reentrant
+                             and any(e[0] is self for e in held)):
+            self._record_edges(held)
+        held.append((self, time.monotonic()))
+
+    def _record_edges(self, held) -> None:
+        me = self._site
+        tname = _thread_name()
+        with _GRAPH_LOCK:
+            # setdefault: a singleton's lock can outlive the guard
+            # session that created it, and the next session's reset
+            # wipes its site entry — never let bookkeeping raise
+            # around a real acquire/release
+            site = _SITES.setdefault(
+                me, {"acquires": 0, "hold_ms_hist": {}})
+            site["acquires"] += 1
+            for other, _t0 in held:
+                a = other._site
+                if a == me or other is self:
+                    continue
+                _EDGES.setdefault((a, me), (tname, me))
+                if self._path_exists(me, a):
+                    back = _EDGES.get((me, a)) or next(
+                        (v for (x, y), v in _EDGES.items()
+                         if x == me), ("?", "?"))
+                    _VIOLATIONS.append({
+                        "held_site": a, "acquired_site": me,
+                        "thread": tname,
+                        "reverse_thread": back[0],
+                    })
+                    pending = getattr(_TLS, "pending", None)
+                    if pending is None:
+                        pending = _TLS.pending = []
+                    pending.append(self)
+
+    @staticmethod
+    def _path_exists(src: str, dst: str) -> bool:
+        # graph is tiny (sites, not locks); plain DFS
+        stack, seen = [src], set()
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(y for (x, y) in _EDGES if x == n)
+        return False
+
+    def _note_released(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                _, t0 = held.pop(i)
+                ms = (time.monotonic() - t0) * 1000.0
+                with _GRAPH_LOCK:
+                    site = _SITES.setdefault(
+                        self._site, {"acquires": 0, "hold_ms_hist": {}})
+                    hist = site["hold_ms_hist"]
+                    b = _hist_bucket(ms)
+                    hist[b] = hist.get(b, 0) + 1
+                break
+
+    def _raise_pending(self) -> None:
+        pending = getattr(_TLS, "pending", None)
+        if pending and self in pending:
+            pending.remove(self)
+            v = _VIOLATIONS[-1]
+            raise LockOrderError(
+                f"lock-order inversion: {v['held_site']} held while "
+                f"acquiring {v['acquired_site']} "
+                f"(thread {v['thread']}), but the opposite order "
+                f"exists in the acquisition graph (thread "
+                f"{v['reverse_thread']}) — two threads interleaving "
+                "these paths deadlock (graftsync GS101; "
+                "docs/StaticAnalysis.md)")
+
+    # -- lock protocol -------------------------------------------------
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquired(bool(blocking))
+        return ok
+
+    def release(self):
+        self._note_released()
+        self._inner.release()
+        self._raise_pending()
+
+    def locked(self):
+        return self._inner.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _at_fork_reinit(self):  # pragma: no cover - fork safety
+        self._inner._at_fork_reinit()
+        _TLS.held = []
+
+    def __repr__(self):
+        return f"<guarded {self._inner!r} @ {self._site}>"
+
+
+class _GuardedLock(_GuardedLockBase):
+    # deliberately NO _release_save/_acquire_restore/_is_owned:
+    # Condition falls back to its own emulations, which route through
+    # acquire()/release() above and stay tracked
+    pass
+
+
+class _GuardedRLock(_GuardedLockBase):
+    _reentrant = True
+
+    # Condition-over-RLock integration: wait() drops the WHOLE
+    # recursion level via _release_save and reinstates it after
+    def _release_save(self):
+        held = _held()
+        depth = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is self:
+                held.pop(i)
+                depth += 1
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        held = _held()
+        now = time.monotonic()
+        for _ in range(depth):
+            held.append((self, now))
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def _make_lock():
+    return _GuardedLock(_ORIGINALS["Lock"]())
+
+
+def _make_rlock():
+    return _GuardedRLock(_ORIGINALS["RLock"]())
+
+
+def _make_condition(lock=None):
+    if lock is None:
+        lock = _make_rlock()
+    return _ORIGINALS["Condition"](lock)
+
+
+def _install() -> None:
+    with _PATCH_LOCK:
+        if _ORIGINALS:
+            return
+        _ORIGINALS["Lock"] = threading.Lock
+        _ORIGINALS["RLock"] = threading.RLock
+        _ORIGINALS["Condition"] = threading.Condition
+        threading.Lock = _make_lock
+        threading.RLock = _make_rlock
+        threading.Condition = _make_condition
+
+
+def _uninstall() -> None:
+    with _PATCH_LOCK:
+        if not _ORIGINALS:
+            return
+        threading.Lock = _ORIGINALS["Lock"]
+        threading.RLock = _ORIGINALS["RLock"]
+        threading.Condition = _ORIGINALS["Condition"]
+        _ORIGINALS.clear()
+
+
+def guard_active() -> bool:
+    return _DEPTH > 0
+
+
+def guard_stats() -> Dict:
+    """Snapshot of the acquisition graph and hold-time histograms —
+    the soak publishes this into its report JSON."""
+    with _GRAPH_LOCK:
+        return {
+            "version": 1,
+            "tool": "graftsync-runtime",
+            "sites": {
+                s: {"acquires": d["acquires"],
+                    "hold_ms_hist": {str(k): v for k, v
+                                     in sorted(d["hold_ms_hist"]
+                                               .items())}}
+                for s, d in sorted(_SITES.items())},
+            "edges": [{"from": a, "to": b, "thread": t}
+                      for (a, b), (t, _s) in sorted(_EDGES.items())],
+            "violations": list(_VIOLATIONS),
+        }
+
+
+def _reset_graph() -> None:
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+        _SITES.clear()
+        _VIOLATIONS.clear()
+
+
+@contextlib.contextmanager
+def lock_order_guard(reset: bool = True):
+    """Arm instrumented locks for the scope; yields :func:`guard_stats`
+    for live snapshots. Raises :class:`LockOrderError` on exit when
+    any violation was recorded (incl. ones a worker thread swallowed).
+    Nestable; only the outermost scope patches/unpatches and resets."""
+    global _DEPTH
+    _DEPTH += 1
+    if _DEPTH == 1:
+        if reset:
+            _reset_graph()
+        _install()
+    try:
+        yield guard_stats
+    finally:
+        _DEPTH -= 1
+        if _DEPTH == 0:
+            _uninstall()
+            if _VIOLATIONS:
+                v = _VIOLATIONS[0]
+                raise LockOrderError(
+                    f"{len(_VIOLATIONS)} lock-order inversion(s) "
+                    f"recorded: {v['held_site']} <-> "
+                    f"{v['acquired_site']} (threads {v['thread']} / "
+                    f"{v['reverse_thread']}) — see guard_stats() "
+                    "(graftsync GS101; docs/StaticAnalysis.md)")
+
+
+@contextlib.contextmanager
+def no_leaked_threads(grace_s: float = 2.0,
+                      include_daemon: bool = False,
+                      allow: Tuple[str, ...] = ()):
+    """Fail if a thread born inside the scope is still alive at exit
+    (after *grace_s* of settling). ``allow`` whitelists thread-name
+    substrings (e.g. pool internals owned by a longer-lived fixture)."""
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + max(grace_s, 0.0)
+    leaked: List[threading.Thread] = []
+    while True:
+        leaked = [
+            t for t in threading.enumerate()
+            if t not in before and t.is_alive()
+            and (include_daemon or not t.daemon)
+            and not any(a in t.name for a in allow)]
+        if not leaked or time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    if leaked:
+        names = ", ".join(
+            f"{t.name}{' (daemon)' if t.daemon else ''}"
+            for t in leaked)
+        raise ThreadLeakError(
+            f"{len(leaked)} thread(s) outlived their scope after "
+            f"{grace_s:.1f}s grace: {names} — join them in "
+            "stop()/shutdown() (graftsync GS301; "
+            "docs/StaticAnalysis.md)")

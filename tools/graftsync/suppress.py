@@ -1,0 +1,33 @@
+"""Inline suppressions: ``# graftsync: allow[GS102]`` (comma-
+separated rule ids, or ``*``) on the finding's physical line, or on
+a comment-only line directly above it. Same semantics as graftlint's
+``# graftlint: allow[...]`` — always pair one with a reason note."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set
+
+_ALLOW_RE = re.compile(
+    r"#\s*graftsync:\s*allow\[([A-Za-z0-9_*,\s-]+)\]")
+
+
+def parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if line.strip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def is_suppressed(suppressions: Dict[int, Set[str]], line: int,
+                  rule: str) -> bool:
+    allowed = suppressions.get(line)
+    if not allowed:
+        return False
+    return "*" in allowed or rule in allowed

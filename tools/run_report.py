@@ -741,6 +741,69 @@ def render_graftcheck(d: Dict[str, Any]) -> str:
 
 
 # ----------------------------------------------------------------------
+# graftsync runtime guard stats (tools/graftsync/runtime.py): the
+# per-creation-site lock hold-time histograms + acquisition-order
+# graph a --sync-guards soak publishes into its report JSON
+def load_syncguard(path: str):
+    """The guard_stats() block when ``path`` is one (raw, or nested
+    under ``sync_guards`` in a serve_bench result); None otherwise."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if isinstance(d, dict) and isinstance(d.get("sync_guards"), dict):
+        d = d["sync_guards"]
+    if isinstance(d, dict) and d.get("tool") == "graftsync-runtime" \
+            and isinstance(d.get("sites"), dict):
+        return d
+    return None
+
+
+def _hold_bucket_label(k: int) -> str:
+    lo, hi = 2.0 ** k, 2.0 ** (k + 1)
+    if k <= -10:
+        return f"<{hi * 1000:.3g}us"
+    if k >= 20:
+        return f">={lo:g}ms"
+    if hi <= 1.0:
+        return f"{lo * 1000:.3g}-{hi * 1000:.3g}us"
+    return f"{lo:g}-{hi:g}ms"
+
+
+def render_syncguard(d: Dict[str, Any]) -> str:
+    sites = d.get("sites") or {}
+    violations = d.get("violations") or []
+    total_acq = sum(s.get("acquires", 0) for s in sites.values())
+    agg: Dict[int, int] = {}
+    for s in sites.values():
+        for k, v in (s.get("hold_ms_hist") or {}).items():
+            agg[int(k)] = agg.get(int(k), 0) + v
+    verdict = "PASS" if not violations else \
+        f"FAIL ({len(violations)} inversion(s))"
+    L = ["== lock-order guard (tools/graftsync runtime) ==",
+         f"sites={len(sites)} acquires={total_acq} "
+         f"edges={len(d.get('edges') or [])} verdict: {verdict}",
+         "hold-time histogram (all sites, log2 ms buckets):"]
+    peak = max(agg.values(), default=1)
+    for k in sorted(agg):
+        bar = "#" * max(1, round(28 * agg[k] / peak))
+        L.append(f"  [{_hold_bucket_label(k):>12}] {bar} {agg[k]}")
+    L.append("hottest sites:")
+    hot = sorted(sites.items(), key=lambda kv: -kv[1].get("acquires", 0))
+    for site, s in hot[:10]:
+        hist = s.get("hold_ms_hist") or {}
+        worst = _hold_bucket_label(max((int(k) for k in hist), default=-10))
+        L.append(f"  {site:<44} acquires={s.get('acquires', 0):<7} "
+                 f"max-hold {worst}")
+    for v in violations:
+        L.append(f"  INVERSION {v.get('held_site')} <-> "
+                 f"{v.get('acquired_site')} (threads "
+                 f"{v.get('thread')} / {v.get('reverse_thread')})")
+    return "\n".join(L) + "\n"
+
+
+# ----------------------------------------------------------------------
 # Chrome-trace timelines (observability/tracing.py): the Perfetto-
 # loadable span export, summarized offline — per-category totals plus
 # the slowest requests' full span chains with their trace ids
@@ -967,6 +1030,13 @@ def main(argv: List[str]) -> int:
             print(json.dumps(gc))
         else:
             sys.stdout.write(render_graftcheck(gc))
+        return 0
+    sg = load_syncguard(args[0])
+    if sg is not None:
+        if "--json" in argv:
+            print(json.dumps(sg))
+        else:
+            sys.stdout.write(render_syncguard(sg))
         return 0
     records = load(args[0])
     if not records:

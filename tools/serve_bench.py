@@ -44,6 +44,7 @@ still prints, flagged ``"preempted": true``.
 """
 
 import argparse
+import contextlib
 import json
 import os
 import signal
@@ -414,6 +415,14 @@ def main(argv=None) -> int:
                          "block (scrape failures, federated worker "
                          "series, stale sightings, dropped-series "
                          "overflow) — the CI observability-soak gate")
+    ap.add_argument("--sync-guards", action="store_true",
+                    help="arm the graftsync dynamic guards for the "
+                         "soak: every lock the fleet creates is "
+                         "instrumented (fail on lock-order "
+                         "inversion), every non-daemon thread must "
+                         "be joined by engine.stop(), and the "
+                         "report JSON gains a 'sync_guards' block "
+                         "with per-site lock hold-time histograms")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -437,6 +446,17 @@ def main(argv=None) -> int:
               "backend": jax.default_backend(),
               "device": args.device,
               "batch_sizes": batch_sizes}
+
+    guards = contextlib.ExitStack()
+    guard_snap = None
+    if args.sync_guards:
+        from tools.graftsync.runtime import (lock_order_guard,
+                                             no_leaked_threads)
+        # leak guard outermost so it sees the world after the order
+        # guard unpatches; both must be armed BEFORE the fleet builds
+        # so every lock the engines create is an instrumented one
+        guards.enter_context(no_leaked_threads(grace_s=5.0))
+        guard_snap = guards.enter_context(lock_order_guard())
 
     if fleet_mode:
         os.makedirs(args.workdir, exist_ok=True)
@@ -531,6 +551,12 @@ def main(argv=None) -> int:
         # the headline block: closed loop if measured, else open
         head = result.get("closed") or result.get("open") or {}
         result["serving"] = head
+
+    if guard_snap is not None:
+        result["sync_guards"] = guard_snap()
+    # closing raises LockOrderError / ThreadLeakError if the soak
+    # tripped either guard — the run fails loudly, not in a summary
+    guards.close()
 
     tracer = get_tracer()
     if tracer.enabled:
